@@ -1,0 +1,14 @@
+"""petastorm_tpu: a TPU-native Apache Parquet data access framework for ML.
+
+Brand-new JAX/XLA-first implementation of the capabilities of uber/petastorm
+v0.13.1 (see SURVEY.md at the repo root for the layer map this follows).
+"""
+
+__version__ = "0.1.0"
+
+from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401
+from petastorm_tpu.transform import TransformSpec  # noqa: F401
+from petastorm_tpu.errors import (  # noqa: F401
+    PetastormTpuError, MetadataError, MetadataGenerationError,
+    NoDataAvailableError, SchemaError,
+)

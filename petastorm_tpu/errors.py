@@ -1,0 +1,26 @@
+"""Framework-wide exception types.
+
+Parity notes: reference defines ``PetastormMetadataError``/
+``PetastormMetadataGenerationError`` (petastorm/etl/dataset_metadata.py:38-48) and
+``NoDataAvailableError`` (petastorm/errors.py). We keep one coherent hierarchy.
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class MetadataError(PetastormTpuError):
+    """Dataset metadata is missing or malformed."""
+
+
+class MetadataGenerationError(PetastormTpuError):
+    """Metadata could not be generated for the dataset."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """A shard or filtered view of the dataset contains no row groups."""
+
+
+class SchemaError(PetastormTpuError):
+    """A value does not conform to its UnischemaField declaration."""

@@ -1,0 +1,61 @@
+"""User-defined row/batch transforms executed *inside reader workers*.
+
+A :class:`TransformSpec` lets the user run arbitrary preprocessing (augment,
+normalize, tokenize) on the worker side — in parallel, before rows ever reach
+the consumer — and declares how it mutates the schema so downstream consumers
+(including the JAX loader's ShapeDtypeStruct render) stay accurate.
+
+Parity: reference petastorm/transform.py — ``TransformSpec`` (:27),
+``transform_schema`` (:60).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class TransformSpec:
+    """Describes a worker-side transform.
+
+    :param func: callable applied to each row dict (``make_reader`` path) or
+        to each row-group pandas DataFrame (``make_batch_reader`` path);
+        returns the transformed object. May be ``None`` for pure schema edits.
+    :param edit_fields: fields added or retyped by ``func`` — a list of
+        :class:`UnischemaField` or ``(name, numpy_dtype, shape, nullable)``
+        tuples.
+    :param removed_fields: names deleted by ``func``.
+    :param selected_fields: if set, the output schema is exactly these names
+        (applied after edits/removals).
+    """
+
+    def __init__(self,
+                 func: Optional[Callable] = None,
+                 edit_fields: Optional[Sequence] = None,
+                 removed_fields: Optional[Sequence[str]] = None,
+                 selected_fields: Optional[Sequence[str]] = None):
+        self.func = func
+        self.edit_fields: List[UnischemaField] = [
+            f if isinstance(f, UnischemaField) else UnischemaField(*f)
+            for f in (edit_fields or [])
+        ]
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+
+def transform_schema(schema: Unischema, transform_spec: TransformSpec) -> Unischema:
+    """Apply a TransformSpec's schema mutations to produce the output schema.
+
+    Parity: reference transform.py:60.
+    """
+    fields = dict(schema.fields)
+    for name in transform_spec.removed_fields:
+        fields.pop(name, None)
+    for f in transform_spec.edit_fields:
+        fields[f.name] = f
+    if transform_spec.selected_fields is not None:
+        missing = [n for n in transform_spec.selected_fields if n not in fields]
+        if missing:
+            raise ValueError(f"selected_fields not present after transform: {missing}")
+        fields = {n: fields[n] for n in transform_spec.selected_fields}
+    return Unischema(schema.name + "_transformed", list(fields.values()))

@@ -1,0 +1,1 @@
+from petastorm_tpu.utils.decode import decode_row  # noqa: F401

@@ -1,0 +1,28 @@
+"""Row decode helper shared by reader workers.
+
+Parity: reference petastorm/utils.py:52 ``decode_row``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.unischema import Unischema, _default_codec
+
+
+def decode_row(row: dict, schema: Unischema) -> dict:
+    """Decode one storage row dict into in-memory numpy values.
+
+    Fields present in ``row`` but absent from ``schema`` are dropped (the
+    schema may be a narrowed view). ``None`` cells stay ``None``.
+    """
+    decoded = {}
+    for name, field in schema.fields.items():
+        if name not in row:
+            continue
+        value = row[name]
+        if value is None:
+            decoded[name] = None
+            continue
+        codec = field.codec or _default_codec(field)
+        decoded[name] = codec.decode(field, value)
+    return decoded

@@ -1,0 +1,22 @@
+"""Test session setup: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of testing distributed behavior on a single
+machine (reference petastorm/tests/conftest.py) — here, multi-chip sharding is
+exercised with ``--xla_force_host_platform_device_count=8`` so tests never need
+TPU hardware.
+"""
+import os
+
+# Must run before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

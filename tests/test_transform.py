@@ -1,0 +1,45 @@
+"""TransformSpec / transform_schema tests (parity: reference test_transform_spec semantics)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _schema():
+    return Unischema("S", [
+        UnischemaField("a", np.int32, ()),
+        UnischemaField("b", np.float32, (4,)),
+        UnischemaField("c", str, ()),
+    ])
+
+
+def test_remove_fields():
+    out = transform_schema(_schema(), TransformSpec(removed_fields=["c"]))
+    assert set(out.fields) == {"a", "b"}
+
+
+def test_edit_fields_add_and_retype():
+    spec = TransformSpec(edit_fields=[
+        UnischemaField("d", np.float32, (2, 2)),
+        ("a", np.float64, (), False),  # tuple form retypes existing field
+    ])
+    out = transform_schema(_schema(), spec)
+    assert out.d.shape == (2, 2)
+    assert np.dtype(out.a.numpy_dtype) == np.float64
+
+
+def test_selected_fields():
+    out = transform_schema(_schema(), TransformSpec(selected_fields=["b", "a"]))
+    assert set(out.fields) == {"a", "b"}
+    with pytest.raises(ValueError, match="not present"):
+        transform_schema(_schema(), TransformSpec(selected_fields=["zzz"]))
+
+
+def test_decode_row_with_view():
+    from petastorm_tpu.utils import decode_row
+    s = _schema()
+    view = s.create_schema_view(["a"])
+    row = {"a": 1, "b": b"ignored", "c": "x"}
+    out = decode_row(row, view)
+    assert set(out) == {"a"}

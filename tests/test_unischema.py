@@ -1,0 +1,158 @@
+"""Unischema unit tests (strategy parity: reference petastorm/tests/test_unischema.py)."""
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.unischema import (Unischema, UnischemaField,
+                                     dict_to_encoded_row, insert_explicit_nulls,
+                                     match_unischema_fields)
+
+
+def _schema():
+    return Unischema("TestSchema", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("image", np.uint8, (32, 16, 3), CompressedImageCodec("png"), False),
+        UnischemaField("matrix", np.float32, (3, 4), NdarrayCodec(), False),
+        UnischemaField("varlen", np.int32, (None,), NdarrayCodec(), True),
+        UnischemaField("label", str, (), ScalarCodec(str), True),
+    ])
+
+
+def test_field_access_and_order():
+    s = _schema()
+    assert s.id.name == "id"
+    assert list(s.fields) == sorted(["id", "image", "matrix", "varlen", "label"])
+    assert len(s) == 5
+
+
+def test_duplicate_field_names_raise():
+    with pytest.raises(ValueError, match="Duplicate"):
+        Unischema("S", [UnischemaField("a", np.int32, ()),
+                        UnischemaField("a", np.int64, ())])
+
+
+def test_create_schema_view_by_name_field_and_regex():
+    s = _schema()
+    v1 = s.create_schema_view(["id", "label"])
+    assert set(v1.fields) == {"id", "label"}
+    v2 = s.create_schema_view([s.image])
+    assert set(v2.fields) == {"image"}
+    v3 = s.create_schema_view(["ma.*"])
+    assert set(v3.fields) == {"matrix"}
+    with pytest.raises(ValueError, match="matched no fields"):
+        s.create_schema_view(["nope.*"])
+    with pytest.raises(ValueError, match="does not belong"):
+        s.create_schema_view([UnischemaField("other", np.int32, ())])
+
+
+def test_match_unischema_fields_fullmatch_only():
+    s = _schema()
+    assert {f.name for f in match_unischema_fields(s, ["i.*"])} == {"id", "image"}
+    # 'i' alone must not partial-match 'id'
+    assert match_unischema_fields(s, ["i"]) == []
+    assert match_unischema_fields(s, []) == []
+
+
+def test_namedtuple_identity_cached():
+    s = _schema()
+    t1 = s.make_namedtuple(id=1, image=None, matrix=None, varlen=None, label="x")
+    t2 = s.make_namedtuple(id=2, image=None, matrix=None, varlen=None, label="y")
+    assert type(t1) is type(t2)
+    assert t1.id == 1 and t2.label == "y"
+
+
+def test_insert_explicit_nulls():
+    s = _schema()
+    row = {"id": 1, "image": np.zeros((32, 16, 3), np.uint8),
+           "matrix": np.zeros((3, 4), np.float32)}
+    insert_explicit_nulls(s, row)
+    assert row["varlen"] is None and row["label"] is None
+    with pytest.raises(SchemaError, match="required"):
+        insert_explicit_nulls(s, {"id": 3})
+
+
+def test_dict_to_encoded_row_roundtrip_types():
+    s = _schema()
+    row = {"id": 7,
+           "image": np.random.default_rng(0).integers(0, 255, (32, 16, 3)).astype(np.uint8),
+           "matrix": np.arange(12, dtype=np.float32).reshape(3, 4),
+           "varlen": np.array([1, 2, 3], np.int32),
+           "label": "cat"}
+    enc = dict_to_encoded_row(s, row)
+    assert isinstance(enc["image"], bytes)
+    assert isinstance(enc["matrix"], bytes)
+    assert enc["id"] == 7 and enc["label"] == "cat"
+
+
+def test_dict_to_encoded_row_rejects_unknown_field():
+    s = _schema()
+    with pytest.raises(ValueError, match="not in schema"):
+        dict_to_encoded_row(s, {"bogus": 1})
+
+
+def test_arrow_schema_render():
+    s = _schema()
+    arrow = s.as_arrow_schema()
+    assert arrow.field("image").type == pa.binary()
+    assert arrow.field("id").type == pa.int64()
+    assert arrow.field("label").type == pa.string()
+    assert arrow.field("label").nullable is True
+    assert arrow.field("id").nullable is False
+
+
+def test_from_arrow_schema_inference():
+    arrow = pa.schema([
+        pa.field("a", pa.int32()),
+        pa.field("b", pa.float64()),
+        pa.field("s", pa.string()),
+        pa.field("lst", pa.list_(pa.int64())),
+        pa.field("ts", pa.timestamp("ns")),
+        pa.field("dec", pa.decimal128(10, 2)),
+    ])
+    s = Unischema.from_arrow_schema(arrow)
+    assert s.a.numpy_dtype == np.int32 and s.a.shape == ()
+    assert s.lst.shape == (None,) and s.lst.numpy_dtype == np.int64
+    assert s.s.numpy_dtype is str
+    assert s.ts.numpy_dtype is np.datetime64
+    assert s.dec.numpy_dtype is Decimal
+
+
+def test_from_arrow_schema_unsupported():
+    arrow = pa.schema([pa.field("m", pa.map_(pa.string(), pa.int32()))])
+    with pytest.raises(ValueError, match="Cannot map"):
+        Unischema.from_arrow_schema(arrow)
+    s = Unischema.from_arrow_schema(arrow, omit_unsupported_fields=True)
+    assert len(s) == 0
+
+
+def test_schema_json_roundtrip():
+    s = _schema()
+    doc = s.to_dict()
+    s2 = Unischema.from_dict(doc)
+    assert s == s2
+    assert isinstance(s2.image.codec, CompressedImageCodec)
+    assert s2.image.codec.image_codec == "png"
+    assert isinstance(s2.matrix.codec, NdarrayCodec)
+    assert s2.varlen.nullable is True
+
+
+def test_shape_dtype_structs():
+    s = _schema()
+    structs = s.as_shape_dtype_structs(batch_size=8, variable_dim=100)
+    assert structs["image"].shape == (8, 32, 16, 3)
+    assert structs["image"].dtype == np.uint8
+    assert structs["varlen"].shape == (8, 100)
+    assert "label" not in structs  # strings are not device-representable
+    with pytest.raises(ValueError, match="variable dimension"):
+        s.as_shape_dtype_structs(batch_size=8)
+
+
+def test_compressed_ndarray_codec_in_schema():
+    s = Unischema("S", [UnischemaField("m", np.float64, (2, 2), CompressedNdarrayCodec(), False)])
+    enc = dict_to_encoded_row(s, {"m": np.eye(2)})
+    assert isinstance(enc["m"], bytes)
