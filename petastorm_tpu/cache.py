@@ -1,0 +1,28 @@
+"""Row-group cache interface.
+
+A cache intercepts row-group loads in the reader workers: ``get(key, fill)``
+returns the cached value or computes, stores and returns it. Useful when the
+dataset lives on slow remote storage (S3/GCS) and the TPU VM has fast local
+NVMe.
+
+Parity: reference petastorm/cache.py — ``CacheBase.get`` (:23),
+``NullCache`` (:35).
+"""
+from __future__ import annotations
+
+
+class CacheBase:
+    def get(self, key, fill_cache_func):
+        """Return the value for ``key``; on miss call ``fill_cache_func()``,
+        store its result and return it."""
+        raise NotImplementedError
+
+    def cleanup(self):
+        """Release any resources held by the cache."""
+
+
+class NullCache(CacheBase):
+    """A cache that caches nothing (the default)."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()

@@ -1,0 +1,330 @@
+"""Dataset metadata: schema storage/recovery and row-group planning.
+
+Storage format
+--------------
+Metadata lives in the key-value section of the dataset's ``_common_metadata``
+Parquet sidecar file:
+
+* ``petastorm-tpu.unischema.v1`` — the Unischema as a **JSON document**
+  (safe; no pickle), see :meth:`Unischema.to_dict`;
+* ``petastorm-tpu.num_row_groups_per_file.v1`` — JSON map of relative file
+  path -> row-group count, so planning never scans footers.
+
+Legacy petastorm stores are fully readable: the pickled
+``dataset-toolkit.unischema.v1`` key is decoded through a restricted
+unpickler that maps reference classes onto this package's
+(:mod:`petastorm_tpu.etl.legacy`), and the JSON
+``dataset-toolkit.num_row_groups_per_file.v1`` key is honored.
+
+Parity: reference petastorm/etl/dataset_metadata.py — ``materialize_dataset``
+(:52), ``load_row_groups`` (:244, with the three fallbacks: metadata key
+:265, summary ``_metadata`` split :296, footer scan threadpool :340),
+``get_schema`` (:356), ``get_schema_from_dataset_url`` (:388),
+``infer_or_load_unischema`` (:410).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import posixpath
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError, MetadataGenerationError
+from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+from petastorm_tpu.unischema import Unischema
+
+logger = logging.getLogger(__name__)
+
+# This package's metadata keys (JSON payloads).
+TPU_UNISCHEMA_KEY = b"petastorm-tpu.unischema.v1"
+TPU_ROW_GROUPS_PER_FILE_KEY = b"petastorm-tpu.num_row_groups_per_file.v1"
+
+# Reference petastorm keys, honored for reading legacy stores
+# (reference etl/dataset_metadata.py:34-35).
+LEGACY_UNISCHEMA_KEY = b"dataset-toolkit.unischema.v1"
+LEGACY_ROW_GROUPS_PER_FILE_KEY = b"dataset-toolkit.num_row_groups_per_file.v1"
+
+_METADATA_FILENAMES = ("_metadata", "_common_metadata")
+
+
+@dataclass(frozen=True)
+class RowGroupRef:
+    """One unit of read work: a single row group of a single Parquet file."""
+    path: str                      # filesystem path of the parquet file
+    row_group: int                 # row-group ordinal within the file
+    partition_values: tuple = ()   # hive-style ((key, value), ...) from the path
+
+    @property
+    def partition_dict(self) -> dict:
+        return dict(self.partition_values)
+
+
+class DatasetContext:
+    """Resolved handle on a dataset: filesystem, root path(s), lazily-opened
+    pyarrow objects and metadata key-values. Built once per Reader."""
+
+    def __init__(self, dataset_url_or_urls: Union[str, Sequence[str]],
+                 storage_options: Optional[dict] = None, filesystem=None,
+                 hadoop_configuration=None):
+        self.filesystem, self.path_or_paths = get_filesystem_and_path_or_paths(
+            dataset_url_or_urls, hadoop_configuration=hadoop_configuration,
+            storage_options=storage_options, filesystem=filesystem)
+        self._file_paths: Optional[List[str]] = None
+        self._kv_metadata: Optional[Dict[bytes, bytes]] = None
+        self._arrow_schema = None
+
+    @property
+    def is_multi_path(self) -> bool:
+        return isinstance(self.path_or_paths, list)
+
+    @property
+    def root_path(self) -> str:
+        return self.path_or_paths[0] if self.is_multi_path else self.path_or_paths
+
+    def file_paths(self) -> List[str]:
+        """All data file paths (metadata sidecars and hidden files excluded),
+        sorted for deterministic planning."""
+        if self._file_paths is None:
+            paths = self.path_or_paths if self.is_multi_path else [self.path_or_paths]
+            found = []
+            for p in paths:
+                if self.filesystem.isdir(p):
+                    for f in self.filesystem.find(p):
+                        base = posixpath.basename(f)
+                        if base.startswith(("_", ".")):
+                            continue
+                        if not (base.endswith(".parquet") or base.endswith(".parq")
+                                or "." not in base):
+                            continue
+                        found.append(f)
+                else:
+                    found.append(p)
+            self._file_paths = sorted(found)
+        return self._file_paths
+
+    def arrow_schema(self):
+        if self._arrow_schema is None:
+            md_schema = self._read_sidecar_schema("_common_metadata") \
+                or self._read_sidecar_schema("_metadata")
+            if md_schema is not None:
+                self._arrow_schema = md_schema
+            else:
+                files = self.file_paths()
+                if not files:
+                    raise MetadataError(f"No parquet files found under {self.path_or_paths}")
+                with self.filesystem.open(files[0], "rb") as f:
+                    self._arrow_schema = pq.ParquetFile(f).schema_arrow
+        return self._arrow_schema
+
+    def _read_sidecar_schema(self, name):
+        if self.is_multi_path:
+            return None
+        p = posixpath.join(self.root_path, name)
+        try:
+            if not self.filesystem.exists(p):
+                return None
+            with self.filesystem.open(p, "rb") as f:
+                return pq.read_schema(f)
+        except (OSError, IOError):
+            return None
+
+    def key_value_metadata(self) -> Dict[bytes, bytes]:
+        """Merged key-value metadata from ``_metadata`` and
+        ``_common_metadata`` (the latter wins ties, matching the reference's
+        read order)."""
+        if self._kv_metadata is None:
+            merged: Dict[bytes, bytes] = {}
+            for name in _METADATA_FILENAMES:
+                schema = self._read_sidecar_schema(name)
+                if schema is not None and schema.metadata:
+                    merged.update(schema.metadata)
+            self._kv_metadata = merged
+        return self._kv_metadata
+
+    def partition_values_for(self, file_path: str) -> tuple:
+        """Hive-style partition key/values parsed from the file's directory
+        components relative to the dataset root."""
+        rel = os.path.relpath(file_path, self.root_path)
+        parts = []
+        for comp in rel.split("/")[:-1]:
+            if "=" in comp:
+                k, _, v = comp.partition("=")
+                parts.append((k, v))
+        return tuple(parts)
+
+
+# --------------------------------------------------------------------- read
+def load_row_groups(ctx: DatasetContext) -> List[RowGroupRef]:
+    """Enumerate every row group of the dataset as :class:`RowGroupRef`.
+
+    Strategy (reference etl/dataset_metadata.py:244):
+    1. row-groups-per-file map from metadata (ours, then legacy key);
+    2. footer scan of every data file through a thread pool.
+    """
+    kv = ctx.key_value_metadata()
+    per_file: Optional[Dict[str, int]] = None
+    for key in (TPU_ROW_GROUPS_PER_FILE_KEY, LEGACY_ROW_GROUPS_PER_FILE_KEY):
+        if key in kv:
+            per_file = json.loads(kv[key].decode("utf-8"))
+            break
+
+    files = ctx.file_paths()
+    row_groups: List[RowGroupRef] = []
+    if per_file is not None and not ctx.is_multi_path:
+        root = ctx.root_path
+        by_rel = {os.path.relpath(f, root): f for f in files}
+        missing = [rel for rel in per_file if rel not in by_rel]
+        if missing:
+            logger.warning("Metadata row-group index lists %d files not present in the "
+                           "store (moved/rewritten?); falling back to footer scan", len(missing))
+            per_file = None
+        else:
+            for rel in sorted(per_file):
+                path = by_rel[rel]
+                pv = ctx.partition_values_for(path)
+                for i in range(per_file[rel]):
+                    row_groups.append(RowGroupRef(path, i, pv))
+            return row_groups
+
+    # Footer-scan fallback (reference :340).
+    def _count(path):
+        with ctx.filesystem.open(path, "rb") as f:
+            return path, pq.ParquetFile(f).metadata.num_row_groups
+
+    with ThreadPoolExecutor(max_workers=10) as pool:
+        counts = dict(pool.map(_count, files))
+    for path in files:
+        pv = ctx.partition_values_for(path)
+        for i in range(counts[path]):
+            row_groups.append(RowGroupRef(path, i, pv))
+    return row_groups
+
+
+def get_schema(ctx: DatasetContext) -> Unischema:
+    """Recover the Unischema stored in dataset metadata.
+
+    Reads this package's JSON document first; falls back to the reference's
+    pickled key through the restricted legacy unpickler. Raises
+    :class:`MetadataError` when neither exists. Parity: reference :356.
+    """
+    kv = ctx.key_value_metadata()
+    if TPU_UNISCHEMA_KEY in kv:
+        return Unischema.from_dict(json.loads(kv[TPU_UNISCHEMA_KEY].decode("utf-8")))
+    if LEGACY_UNISCHEMA_KEY in kv:
+        from petastorm_tpu.etl.legacy import depickle_legacy_unischema
+        return depickle_legacy_unischema(kv[LEGACY_UNISCHEMA_KEY])
+    raise MetadataError(
+        f"Could not find a Unischema in dataset metadata at {ctx.path_or_paths}. "
+        "Was the dataset written with materialize_dataset()? "
+        "(generate metadata with the petastorm-tpu-generate-metadata CLI, or use "
+        "make_batch_reader() for plain Parquet stores)")
+
+
+def get_schema_from_dataset_url(dataset_url_or_urls, storage_options=None,
+                                filesystem=None) -> Unischema:
+    """Parity: reference :388."""
+    return get_schema(DatasetContext(dataset_url_or_urls, storage_options=storage_options,
+                                     filesystem=filesystem))
+
+
+def infer_or_load_unischema(ctx: DatasetContext) -> Unischema:
+    """Stored Unischema if present, else inference from the Arrow schema
+    (every column a scalar/1-D field, no codecs). Parity: reference :410."""
+    try:
+        return get_schema(ctx)
+    except MetadataError:
+        logger.debug("Dataset has no stored Unischema; inferring from Arrow schema")
+        return Unischema.from_arrow_schema(ctx.arrow_schema(), omit_unsupported_fields=True)
+
+
+# -------------------------------------------------------------------- write
+def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
+                           extra_kv: Optional[Dict[bytes, bytes]] = None) -> None:
+    """(Re)write ``_common_metadata`` with schema + row-group index.
+
+    Scans data-file footers to build the row-groups-per-file map, so it also
+    serves as the 'regenerate metadata' operation for stores written by other
+    writers (reference etl/petastorm_generate_metadata.py:47).
+    """
+    ctx = ctx_or_url if isinstance(ctx_or_url, DatasetContext) else DatasetContext(ctx_or_url)
+    if ctx.is_multi_path:
+        raise MetadataGenerationError("Cannot write metadata for a multi-URL dataset view")
+
+    files = ctx.file_paths()
+    if not files:
+        raise MetadataGenerationError(f"No parquet data files under {ctx.root_path}")
+
+    def _count(path):
+        with ctx.filesystem.open(path, "rb") as f:
+            return os.path.relpath(path, ctx.root_path), pq.ParquetFile(f).metadata.num_row_groups
+
+    with ThreadPoolExecutor(max_workers=10) as pool:
+        per_file = dict(pool.map(_count, files))
+
+    kv: Dict[bytes, bytes] = dict(ctx.key_value_metadata())
+    kv[TPU_ROW_GROUPS_PER_FILE_KEY] = json.dumps(per_file, sort_keys=True).encode("utf-8")
+    if schema is not None:
+        kv[TPU_UNISCHEMA_KEY] = json.dumps(schema.to_dict()).encode("utf-8")
+
+    with ctx.filesystem.open(files[0], "rb") as f:
+        arrow_schema = pq.ParquetFile(f).schema_arrow
+    arrow_schema = arrow_schema.with_metadata(kv)
+    sidecar = posixpath.join(ctx.root_path, "_common_metadata")
+    with ctx.filesystem.open(sidecar, "wb") as f:
+        pq.write_metadata(arrow_schema, f)
+    # Invalidate caches so subsequent reads see fresh metadata.
+    ctx._kv_metadata = None
+    ctx._file_paths = None
+
+
+@contextmanager
+def materialize_dataset(spark, dataset_url: str, schema: Unischema,
+                        row_group_size_mb: Optional[int] = None,
+                        use_summary_metadata: bool = False,
+                        filesystem_factory=None):
+    """Context manager wrapping a **Spark** Parquet write (optional path;
+    requires pyspark). Configures the Parquet block size before the user's
+    write job and stores Unischema + row-group index metadata after it.
+
+    Parity: reference etl/dataset_metadata.py:52. For Spark-free writing use
+    :func:`petastorm_tpu.etl.writer.materialize_dataset_local`.
+    """
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "materialize_dataset requires pyspark. For a Spark-free write path use "
+            "petastorm_tpu.etl.writer.materialize_dataset_local") from e
+
+    spark_config = {}
+    _spark_set_parquet_conf(spark, row_group_size_mb, spark_config)
+    try:
+        yield
+        write_dataset_metadata(dataset_url, schema)
+    finally:
+        _spark_restore_parquet_conf(spark, spark_config)
+
+
+def _spark_set_parquet_conf(spark, row_group_size_mb, saved):  # pragma: no cover - spark only
+    hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+    keys = ["parquet.block.size", "parquet.enable.summary-metadata"]
+    for k in keys:
+        saved[k] = hadoop_conf.get(k)
+    if row_group_size_mb is not None:
+        hadoop_conf.setInt("parquet.block.size", row_group_size_mb * (1 << 20))
+    hadoop_conf.setBoolean("parquet.enable.summary-metadata", False)
+
+
+def _spark_restore_parquet_conf(spark, saved):  # pragma: no cover - spark only
+    hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+    for k, v in saved.items():
+        if v is None:
+            hadoop_conf.unset(k)
+        else:
+            hadoop_conf.set(k, v)

@@ -1,0 +1,200 @@
+"""Reading legacy petastorm metadata pickles — safely.
+
+Original petastorm stores carry a **pickled** ``Unischema`` under the
+``dataset-toolkit.unischema.v1`` key of ``_common_metadata``. To read such
+stores without importing petastorm (not a dependency) and without arbitrary
+code execution, this module unpickles through a restricted
+``pickle.Unpickler`` whose ``find_class`` only resolves an allowlist of
+names, mapping reference classes onto this package's equivalents and Spark
+type objects onto lightweight stubs.
+
+Parity: reference petastorm/etl/legacy.py — ``RestrictedUnpickler`` (:33,
+allowlist :22), legacy package-name rewrite
+``depickle_legacy_package_name_compatible`` (:57; old stores used the
+``dataset_toolkit`` package name).
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from collections import OrderedDict
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu import codecs as _codecs
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class _SparkTypeStub:
+    """Minimal stand-in for a pyspark DataType instance inside a ScalarCodec
+    pickle; carries only the numpy dtype it implies."""
+    numpy_dtype = None
+
+    def __reduce__(self):  # keep stubs picklable for caching layers
+        return (type(self), ())
+
+
+def _spark_stub(name, np_dtype):
+    return type(name, (_SparkTypeStub,), {"_np": np_dtype})
+
+
+_SPARK_TYPE_STUBS = {
+    "StringType": _spark_stub("StringType", str),
+    "BinaryType": _spark_stub("BinaryType", bytes),
+    "BooleanType": _spark_stub("BooleanType", np.bool_),
+    "ByteType": _spark_stub("ByteType", np.int8),
+    "ShortType": _spark_stub("ShortType", np.int16),
+    "IntegerType": _spark_stub("IntegerType", np.int32),
+    "LongType": _spark_stub("LongType", np.int64),
+    "FloatType": _spark_stub("FloatType", np.float32),
+    "DoubleType": _spark_stub("DoubleType", np.float64),
+    "DecimalType": _spark_stub("DecimalType", Decimal),
+    "TimestampType": _spark_stub("TimestampType", np.datetime64),
+    "DateType": _spark_stub("DateType", np.datetime64),
+}
+
+
+class _LegacyScalarCodec(_codecs.ScalarCodec):
+    """ScalarCodec whose pickled state holds a Spark type (stub)."""
+
+    def __setstate__(self, state):
+        spark_type = state.get("_spark_type") or state.get("spark_type")
+        np_dtype = getattr(spark_type, "_np", None) if spark_type is not None else None
+        self.storage_dtype = np_dtype
+
+
+class _LegacyUnischema(Unischema):
+    """Unischema reconstructed from a reference pickle's instance dict."""
+
+    def __init__(self):  # state arrives via __setstate__
+        pass
+
+    def __setstate__(self, state):
+        name = state.get("_name", "legacy")
+        fields_dict = state.get("_fields", {})
+        Unischema.__init__(self, name, list(fields_dict.values()))
+
+
+class _LegacyUnischemaField(UnischemaField):
+    """Reference UnischemaField is a NamedTuple (name, numpy_dtype, shape,
+    codec, nullable); its pickle reconstructs via ``cls.__new__(cls, *args)``
+    (``__getnewargs__`` protocol), so all work happens in ``__new__``."""
+
+    def __new__(cls, name, numpy_dtype, shape, codec=None, nullable=False):
+        obj = object.__new__(cls)
+        UnischemaField.__init__(obj, name, numpy_dtype, shape, codec, nullable)
+        return obj
+
+
+_ALLOWED = {
+    # petastorm classes (old and ancient package names) -> ours
+    ("petastorm.unischema", "Unischema"): _LegacyUnischema,
+    ("petastorm.unischema", "UnischemaField"): _LegacyUnischemaField,
+    ("dataset_toolkit.unischema", "Unischema"): _LegacyUnischema,
+    ("dataset_toolkit.unischema", "UnischemaField"): _LegacyUnischemaField,
+    ("petastorm.codecs", "ScalarCodec"): _LegacyScalarCodec,
+    ("petastorm.codecs", "NdarrayCodec"): _codecs.NdarrayCodec,
+    ("petastorm.codecs", "CompressedNdarrayCodec"): _codecs.CompressedNdarrayCodec,
+    ("petastorm.codecs", "CompressedImageCodec"): _codecs.CompressedImageCodec,
+    ("dataset_toolkit.codecs", "ScalarCodec"): _LegacyScalarCodec,
+    ("dataset_toolkit.codecs", "NdarrayCodec"): _codecs.NdarrayCodec,
+    ("dataset_toolkit.codecs", "CompressedNdarrayCodec"): _codecs.CompressedNdarrayCodec,
+    ("dataset_toolkit.codecs", "CompressedImageCodec"): _codecs.CompressedImageCodec,
+    ("collections", "OrderedDict"): OrderedDict,
+    ("builtins", "str"): str,
+    ("builtins", "bytes"): bytes,
+    ("builtins", "int"): int,
+    ("builtins", "float"): float,
+    ("builtins", "bool"): bool,
+    ("builtins", "set"): set,
+    ("builtins", "frozenset"): frozenset,
+    ("decimal", "Decimal"): Decimal,
+    ("builtins", "object"): object,
+    ("builtins", "list"): list,
+    ("builtins", "tuple"): tuple,
+    ("builtins", "dict"): dict,
+}
+
+_ALLOWED_NUMPY = {"dtype", "ndarray", "int8", "int16", "int32", "int64",
+                  "uint8", "uint16", "uint32", "uint64", "float16", "float32",
+                  "float64", "bool_", "str_", "bytes_", "datetime64", "object_"}
+
+
+def _legacy_reconstructor(cls, base, state):
+    """copyreg._reconstructor shim: ancient UnischemaField pickles were plain
+    namedtuples (tuple subclasses); ours is not, so construct directly."""
+    if base is tuple and issubclass(cls, UnischemaField):
+        return cls(*state)
+    import copyreg
+    return copyreg._reconstructor(cls, base, state)
+
+
+def _pyspark_restore(name, fields, values):
+    if name == "UnischemaField":
+        kwargs = dict(zip(fields, values))
+        return _LegacyUnischemaField(
+            kwargs["name"], kwargs["numpy_dtype"], kwargs["shape"],
+            kwargs.get("codec"), kwargs.get("nullable", False))
+    from collections import namedtuple
+    return namedtuple(name, fields)(*values)
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    """Unpickler resolving only allowlisted classes (reference legacy.py:33)."""
+
+    def find_class(self, module, name):
+        # Python-2-era pickles (oldest petastorm stores) use old module names.
+        if module == "__builtin__":
+            module = "builtins"
+        elif module == "copy_reg":
+            module = "copyreg"
+        if (module, name) == ("copyreg", "_reconstructor"):
+            return _legacy_reconstructor
+        if (module, name) in _ALLOWED:
+            return _ALLOWED[(module, name)]
+        if module in ("numpy", "numpy.core.multiarray", "numpy._core.multiarray"):
+            # Aliases removed in numpy>=1.20/2.0 but present in old pickles.
+            numpy2_compat = {"unicode_": np.str_, "string_": np.bytes_,
+                             "float": np.float64, "int": np.int64,
+                             "bool": np.bool_, "object": np.object_,
+                             "long": np.int64}
+            if name in numpy2_compat:
+                return numpy2_compat[name]
+            if name in _ALLOWED_NUMPY or name in ("_reconstruct", "scalar"):
+                return getattr(__import__("numpy.core.multiarray", fromlist=[name])
+                               if "multiarray" in module else np, name)
+        if module in ("pyspark.sql.types",) and name in _SPARK_TYPE_STUBS:
+            return _SPARK_TYPE_STUBS[name]
+        if (module, name) == ("pyspark.serializers", "_restore"):
+            # pyspark hijacks namedtuple pickling into _restore(name, fields,
+            # values); legacy UnischemaFields written from Spark jobs use it.
+            return _pyspark_restore
+        raise pickle.UnpicklingError(
+            f"Legacy metadata pickle references disallowed class {module}.{name}")
+
+
+def restricted_loads(data: bytes):
+    return RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def depickle_legacy_unischema(pickled: bytes) -> Unischema:
+    """Decode a reference-petastorm pickled Unischema into this package's
+    :class:`Unischema`."""
+    obj = restricted_loads(pickled)
+    if not isinstance(obj, Unischema):
+        raise pickle.UnpicklingError(
+            f"Legacy unischema pickle decoded to unexpected type {type(obj)}")
+    # Rebuild as plain classes (drop the _Legacy* shim types).
+    fields = [UnischemaField(f.name, f.numpy_dtype, f.shape,
+                             _plain_codec(f.codec), f.nullable)
+              for f in obj.fields.values()]
+    return Unischema(obj.name, fields)
+
+
+def _plain_codec(codec):
+    if codec is None:
+        return None
+    if isinstance(codec, _LegacyScalarCodec):
+        return _codecs.ScalarCodec(codec.storage_dtype)
+    return codec

@@ -1,0 +1,128 @@
+"""Filesystem/URL resolution: one URL in, (filesystem, path) out.
+
+Dispatches ``file://``, ``hdfs://`` (with HA namenode resolution, see
+:mod:`petastorm_tpu.hdfs.namenode`) and any fsspec scheme (``s3://``,
+``gs://``, ``memory://`` …) to a filesystem object usable by
+``pyarrow.parquet`` and ``pyarrow.dataset``.
+
+Parity: reference petastorm/fs_utils.py — ``FilesystemResolver`` (:41),
+``get_filesystem_and_path_or_paths`` (:179), ``normalize_dir_url`` (:212).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+from urllib.parse import urlparse
+
+
+def normalize_dir_url(dataset_url: str) -> str:
+    """Normalize a dataset URL: require a string, default the ``file://``
+    scheme for bare paths, and strip trailing slashes.
+
+    Parity: reference fs_utils.py:212.
+    """
+    if not isinstance(dataset_url, str):
+        raise ValueError(f"dataset_url must be a string, got {type(dataset_url)}")
+    dataset_url = dataset_url.rstrip("/")
+    parsed = urlparse(dataset_url)
+    if not parsed.scheme:
+        dataset_url = "file://" + dataset_url
+    return dataset_url
+
+
+def normalize_dataset_url_or_urls(url_or_urls):
+    if isinstance(url_or_urls, (list, tuple)):
+        if not url_or_urls:
+            raise ValueError("empty url list")
+        return [normalize_dir_url(u) for u in url_or_urls]
+    return normalize_dir_url(url_or_urls)
+
+
+class FilesystemResolver:
+    """Resolves a dataset URL into an fsspec filesystem plus a bare path.
+
+    :param dataset_url: e.g. ``file:///tmp/ds``, ``s3://bucket/ds``,
+        ``hdfs://nameservice1/ds``, ``memory://ds``
+    :param hadoop_configuration: optional Hadoop config mapping used for HDFS
+        HA namenode resolution
+    :param storage_options: extra kwargs forwarded to the fsspec filesystem
+        constructor (credentials, endpoints, ...)
+    :param filesystem: pre-built filesystem to use as-is (skips dispatch)
+    """
+
+    def __init__(self, dataset_url: str, hadoop_configuration=None,
+                 storage_options: Optional[dict] = None, filesystem=None,
+                 user: Optional[str] = None):
+        self._dataset_url = normalize_dir_url(dataset_url)
+        self._parsed = urlparse(self._dataset_url)
+        storage_options = storage_options or {}
+
+        if filesystem is not None:
+            self._filesystem = filesystem
+            self._path = self._parsed.path if self._parsed.scheme in ("file", "") \
+                else (self._parsed.netloc + self._parsed.path)
+            return
+
+        scheme = self._parsed.scheme
+        if scheme == "file":
+            import fsspec
+            self._filesystem = fsspec.filesystem("file")
+            self._path = self._parsed.path
+        elif scheme == "hdfs":
+            from petastorm_tpu.hdfs.namenode import HdfsConnector, HdfsNamenodeResolver
+            resolver = HdfsNamenodeResolver(hadoop_configuration)
+            if self._parsed.netloc:
+                namenodes = resolver.resolve_hdfs_name_service(self._parsed.netloc)
+                if namenodes is None:
+                    namenodes = [self._parsed.netloc]
+            else:
+                namenodes = resolver.resolve_default_hdfs_service()[1]
+            self._filesystem = HdfsConnector.connect_to_either_namenode(
+                namenodes, user=user, storage_options=storage_options)
+            self._path = self._parsed.path
+        else:
+            import fsspec
+            fs, path = fsspec.core.url_to_fs(self._dataset_url, **storage_options)
+            self._filesystem = fs
+            self._path = path
+
+    def filesystem(self):
+        return self._filesystem
+
+    def get_dataset_path(self) -> str:
+        return self._path
+
+    @property
+    def parsed_dataset_url(self):
+        return self._parsed
+
+
+def get_filesystem_and_path_or_paths(
+        url_or_urls: Union[str, Sequence[str]],
+        hadoop_configuration=None,
+        storage_options: Optional[dict] = None,
+        filesystem=None) -> Tuple[object, Union[str, list]]:
+    """Resolve one URL or a homogeneous list of URLs to (filesystem, path(s)).
+
+    All URLs in a list must share scheme and netloc (they are read through a
+    single filesystem object). Parity: reference fs_utils.py:179.
+    """
+    urls = normalize_dataset_url_or_urls(url_or_urls)
+    url_list = urls if isinstance(urls, list) else [urls]
+    parsed = [urlparse(u) for u in url_list]
+    if len({(p.scheme, p.netloc) for p in parsed}) != 1:
+        raise ValueError(f"All dataset URLs must share scheme and netloc, got {url_list}")
+    resolver = FilesystemResolver(url_list[0], hadoop_configuration=hadoop_configuration,
+                                  storage_options=storage_options, filesystem=filesystem)
+    fs = resolver.filesystem()
+
+    def _strip(url, parsed_url):
+        if hasattr(fs, "_strip_protocol"):
+            return fs._strip_protocol(url)
+        if parsed_url.scheme in ("file", "") or not parsed_url.netloc:
+            return parsed_url.path
+        # Object stores address by bucket: keep the netloc in the path.
+        return parsed_url.netloc + parsed_url.path
+
+    if isinstance(urls, list):
+        return fs, [_strip(u, p) for u, p in zip(url_list, parsed)]
+    return fs, _strip(url_list[0], parsed[0])

@@ -1,0 +1,209 @@
+"""HDFS HA namenode resolution and failover-safe connection.
+
+Reads the standard Hadoop client configuration (``core-site.xml`` /
+``hdfs-site.xml`` discovered through ``HADOOP_HOME``-family env vars) to turn
+a logical nameservice into its list of namenode host:port endpoints, and
+connects to whichever namenode is active, retrying through the list and
+failing over on error.
+
+Parity: reference petastorm/hdfs/namenode.py — ``HdfsNamenodeResolver``
+(:31, hadoop XML parse :67), ``HAHdfsClient`` (:211) with the
+``namenode_failover`` retry decorator (:146, max 3 attempts :152),
+``HdfsConnector`` (:241) with round-robin ``_try_next_namenode`` (:288).
+Implementation is new and fsspec/pyarrow.fs-based.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import xml.etree.ElementTree as ET
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+MAX_NAMENODE_FAILOVER_ATTEMPTS = 2  # total tries = attempts + 1
+
+
+class HdfsConnectError(IOError):
+    pass
+
+
+class HadoopConfiguration(dict):
+    """A plain dict of hadoop config properties with a ``get`` interface."""
+
+
+def _read_hadoop_xml(path: str) -> dict:
+    props = {}
+    tree = ET.parse(path)
+    for prop in tree.getroot().iter("property"):
+        name = prop.findtext("name")
+        value = prop.findtext("value")
+        if name is not None:
+            props[name] = value
+    return props
+
+
+class HdfsNamenodeResolver:
+    """Resolve logical HDFS nameservices to namenode endpoints.
+
+    :param hadoop_configuration: a mapping of hadoop properties; when absent,
+        config files are discovered from ``HADOOP_CONF_DIR``,
+        ``HADOOP_INSTALL`` or ``HADOOP_HOME`` (reference namenode.py:45).
+    """
+
+    def __init__(self, hadoop_configuration=None):
+        if hadoop_configuration is None:
+            hadoop_configuration = self._discover_configuration()
+        self._config = hadoop_configuration or {}
+
+    @staticmethod
+    def _discover_configuration() -> Optional[dict]:
+        candidates = []
+        if os.environ.get("HADOOP_CONF_DIR"):
+            candidates.append(os.environ["HADOOP_CONF_DIR"])
+        for env in ("HADOOP_INSTALL", "HADOOP_HOME", "HADOOP_PREFIX"):
+            if os.environ.get(env):
+                candidates.append(os.path.join(os.environ[env], "etc", "hadoop"))
+        for conf_dir in candidates:
+            props = {}
+            found = False
+            for fname in ("core-site.xml", "hdfs-site.xml"):
+                fpath = os.path.join(conf_dir, fname)
+                if os.path.isfile(fpath):
+                    props.update(_read_hadoop_xml(fpath))
+                    found = True
+            if found:
+                return HadoopConfiguration(props)
+        return None
+
+    def resolve_hdfs_name_service(self, nameservice: str) -> Optional[List[str]]:
+        """Return namenode ``host:port`` endpoints for a nameservice, or
+        ``None`` if the nameservice is not configured (i.e. the netloc is
+        already a direct ``host:port``)."""
+        services = (self._config.get("dfs.nameservices") or "").split(",")
+        if nameservice not in [s.strip() for s in services if s]:
+            return None
+        ha_key = f"dfs.ha.namenodes.{nameservice}"
+        namenode_ids = [n.strip() for n in (self._config.get(ha_key) or "").split(",") if n.strip()]
+        if not namenode_ids:
+            raise HdfsConnectError(
+                f"Nameservice {nameservice!r} is declared but {ha_key} is missing/empty")
+        endpoints = []
+        for nn in namenode_ids:
+            addr = self._config.get(f"dfs.namenode.rpc-address.{nameservice}.{nn}")
+            if not addr:
+                raise HdfsConnectError(
+                    f"Missing dfs.namenode.rpc-address.{nameservice}.{nn} in hadoop config")
+            endpoints.append(addr)
+        return endpoints
+
+    def resolve_default_hdfs_service(self) -> Tuple[str, List[str]]:
+        """Resolve ``fs.defaultFS`` into (nameservice, namenode endpoints)."""
+        default_fs = self._config.get("fs.defaultFS") or ""
+        if not default_fs.startswith("hdfs://"):
+            raise HdfsConnectError(f"fs.defaultFS is not an HDFS URL: {default_fs!r}")
+        netloc = default_fs[len("hdfs://"):].rstrip("/")
+        endpoints = self.resolve_hdfs_name_service(netloc)
+        if endpoints is None:
+            endpoints = [netloc]
+        return netloc, endpoints
+
+
+# OSError subclasses that indicate a definite answer from a healthy
+# namenode — failing over on these would mask the real error.
+_NON_FAILOVER_ERRORS = (FileNotFoundError, PermissionError, FileExistsError,
+                        IsADirectoryError, NotADirectoryError)
+
+
+def namenode_failover(func):
+    """Method decorator: on connection-level IO/OS errors, reconnect to the
+    next namenode and retry, up to ``MAX_NAMENODE_FAILOVER_ATTEMPTS``
+    failovers. Definite filesystem answers (missing file, permission denied)
+    propagate untouched."""
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        last_exc = None
+        for attempt in range(MAX_NAMENODE_FAILOVER_ATTEMPTS + 1):
+            try:
+                return func(self, *args, **kwargs)
+            except _NON_FAILOVER_ERRORS:
+                raise
+            except (IOError, OSError) as e:  # ArrowIOError subclasses OSError
+                last_exc = e
+                logger.warning("HDFS call %s failed (attempt %d): %s; failing over",
+                               func.__name__, attempt + 1, e)
+                self._do_failover()
+        raise HdfsConnectError(
+            f"HDFS call {func.__name__} failed after "
+            f"{MAX_NAMENODE_FAILOVER_ATTEMPTS + 1} attempts") from last_exc
+    return wrapper
+
+
+class HAHdfsClient:
+    """A filesystem proxy that fails over between namenodes per call.
+
+    Wraps the subset of the fsspec filesystem API the framework touches; each
+    method retries against the next namenode on connection errors.
+    """
+
+    _PROXIED = ("ls", "isdir", "isfile", "exists", "open", "info", "glob",
+                "makedirs", "rm", "mkdir", "cat_file", "pipe_file")
+
+    def __init__(self, connector_cls, namenodes: List[str], user=None, storage_options=None):
+        self._connector_cls = connector_cls
+        self._namenodes = list(namenodes)
+        self._index = 0
+        self._user = user
+        self._storage_options = storage_options or {}
+        self._fs = self._connect(self._namenodes[self._index])
+
+    def _connect(self, namenode: str):
+        return self._connector_cls.hdfs_connect_namenode(
+            namenode, user=self._user, **self._storage_options)
+
+    def _do_failover(self):
+        self._index = (self._index + 1) % len(self._namenodes)
+        try:
+            self._fs = self._connect(self._namenodes[self._index])
+        except (IOError, OSError) as e:
+            logger.warning("Failover connect to %s failed: %s", self._namenodes[self._index], e)
+
+    def __getattr__(self, name):
+        if name in type(self)._PROXIED:
+            @namenode_failover
+            def call(self, *args, __name=name, **kwargs):
+                return getattr(self._fs, __name)(*args, **kwargs)
+            return functools.partial(call, self)
+        return getattr(self._fs, name)
+
+
+class HdfsConnector:
+    """Connect to the first healthy namenode of a list."""
+
+    MAX_NAMENODES = 2
+
+    @classmethod
+    def hdfs_connect_namenode(cls, netloc: str, user=None, **kwargs):
+        host, _, port = netloc.partition(":")
+        from pyarrow import fs as pafs
+        hdfs = pafs.HadoopFileSystem(host=host, port=int(port or 8020), user=user, **kwargs)
+        from fsspec.implementations.arrow import ArrowFSWrapper
+        return ArrowFSWrapper(hdfs)
+
+    @classmethod
+    def connect_to_either_namenode(cls, namenodes: List[str], user=None, storage_options=None):
+        """Try each namenode round-robin; return an HA failover client.
+
+        Parity: reference namenode.py:241,:288 (round-robin namenode retry).
+        """
+        errors = []
+        for i, nn in enumerate(namenodes[:cls.MAX_NAMENODES + 1]):
+            try:
+                client = HAHdfsClient(cls, namenodes[i:] + namenodes[:i],
+                                      user=user, storage_options=storage_options)
+                return client
+            except (IOError, OSError) as e:
+                errors.append((nn, e))
+                logger.warning("Could not connect to namenode %s: %s", nn, e)
+        raise HdfsConnectError(f"Could not connect to any namenode of {namenodes}: {errors}")

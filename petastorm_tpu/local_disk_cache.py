@@ -1,0 +1,119 @@
+"""SQLite-backed local disk cache for decoded row groups.
+
+The reference delegates to the ``diskcache`` package (FanoutCache,
+petastorm/local_disk_cache.py:23). That package is not a dependency here;
+this is a self-contained implementation over the stdlib ``sqlite3`` (a C
+library — the native path) with:
+
+* values pickled into BLOBs, one row per key;
+* least-recently-*stored* eviction down to ``size_limit`` on insert;
+* WAL journaling so concurrent reader threads/processes can share the cache;
+* a capacity sanity check mirroring the reference's
+  (local_disk_cache.py:47): refuses a cache too small to hold a meaningful
+  number of row groups.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import sqlite3
+import threading
+import time
+
+from petastorm_tpu.cache import CacheBase
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS cache (
+    key TEXT PRIMARY KEY,
+    value BLOB NOT NULL,
+    size INTEGER NOT NULL,
+    stored_at REAL NOT NULL
+);
+"""
+
+
+class LocalDiskCache(CacheBase):
+    """:param path: directory for the cache database (created if missing)
+    :param size_limit_bytes: max total size of cached values
+    :param expected_row_size_bytes: approximate size of one cached entry, used
+        only for the capacity sanity check
+    :param shards: kept for API familiarity (sqlite needs no fanout sharding)
+    :param cleanup: if True, delete the cache directory on :meth:`cleanup`
+    """
+
+    def __init__(self, path: str, size_limit_bytes: int, expected_row_size_bytes: int = 0,
+                 shards: int = 6, cleanup: bool = False, **_ignored):
+        min_rows = 100
+        if expected_row_size_bytes and size_limit_bytes < min_rows * expected_row_size_bytes:
+            raise ValueError(
+                f"Cache size_limit_bytes={size_limit_bytes} is too small to hold {min_rows} "
+                f"rows of {expected_row_size_bytes} bytes each; increase the cache size")
+        self._path = path
+        self._cleanup_on_exit = cleanup
+        self._size_limit = size_limit_bytes
+        os.makedirs(path, exist_ok=True)
+        self._db_path = os.path.join(path, "cache.sqlite3")
+        self._local = threading.local()
+        self._all_conns = []
+        self._conns_lock = threading.Lock()
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._db_path, timeout=60.0,
+                                   check_same_thread=False)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+            with self._conns_lock:
+                self._all_conns.append(conn)
+        return conn
+
+    def get(self, key, fill_cache_func):
+        key = str(key)
+        conn = self._conn()
+        row = conn.execute("SELECT value FROM cache WHERE key = ?", (key,)).fetchone()
+        if row is not None:
+            return pickle.loads(row[0])
+        value = fill_cache_func()
+        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        with conn:
+            conn.execute(
+                "INSERT OR REPLACE INTO cache (key, value, size, stored_at) VALUES (?, ?, ?, ?)",
+                (key, sqlite3.Binary(blob), len(blob), time.time()))
+            self._evict_locked(conn)
+        return value
+
+    def _evict_locked(self, conn):
+        (total,) = conn.execute("SELECT COALESCE(SUM(size), 0) FROM cache").fetchone()
+        if total <= self._size_limit:
+            return
+        for key, size in conn.execute(
+                "SELECT key, size FROM cache ORDER BY stored_at ASC").fetchall():
+            conn.execute("DELETE FROM cache WHERE key = ?", (key,))
+            total -= size
+            if total <= self._size_limit:
+                break
+
+    def __len__(self):
+        (n,) = self._conn().execute("SELECT COUNT(*) FROM cache").fetchone()
+        return n
+
+    def size_bytes(self) -> int:
+        (total,) = self._conn().execute("SELECT COALESCE(SUM(size), 0) FROM cache").fetchone()
+        return total
+
+    def cleanup(self):
+        with self._conns_lock:
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except sqlite3.Error:
+                    pass
+            self._all_conns.clear()
+        self._local.conn = None
+        if self._cleanup_on_exit:
+            import shutil
+            shutil.rmtree(self._path, ignore_errors=True)

@@ -36,11 +36,20 @@ class TransformSpec:
                  selected_fields: Optional[Sequence[str]] = None):
         self.func = func
         self.edit_fields: List[UnischemaField] = [
-            f if isinstance(f, UnischemaField) else UnischemaField(*f)
+            f if isinstance(f, UnischemaField) else self._field_from_tuple(f)
             for f in (edit_fields or [])
         ]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+    @staticmethod
+    def _field_from_tuple(t) -> UnischemaField:
+        # 4-tuple form is (name, numpy_dtype, shape, nullable) — the
+        # reference's edit_fields contract; 5-tuple includes a codec.
+        if len(t) == 4:
+            name, numpy_dtype, shape, nullable = t
+            return UnischemaField(name, numpy_dtype, shape, None, nullable)
+        return UnischemaField(*t)
 
 
 def transform_schema(schema: Unischema, transform_spec: TransformSpec) -> Unischema:

@@ -138,10 +138,14 @@ class Unischema:
             names = [f.name for f in fields]
             dupes = sorted({n for n in names if names.count(n) > 1})
             raise ValueError(f"Duplicate field names in schema {name!r}: {dupes}")
-        for f in self._fields.values():
-            if hasattr(self, f.name):
-                raise ValueError(f"Field name {f.name!r} collides with a Unischema attribute")
-            setattr(self, f.name, f)
+    def __getattr__(self, item):
+        # Field access by attribute (schema.my_field). Real attributes and
+        # properties win; fields shadowed by them (e.g. one named 'name')
+        # remain reachable via schema.fields['name'].
+        fields = self.__dict__.get("_fields")
+        if fields is not None and item in fields:
+            return fields[item]
+        raise AttributeError(f"{type(self).__name__!s} has no attribute/field {item!r}")
 
     # ------------------------------------------------------------------ basic
     @property
@@ -168,7 +172,9 @@ class Unischema:
         return list(self._fields.values()) == list(other._fields.values())
 
     def __hash__(self):
-        return hash((self._name, tuple(self._fields.values())))
+        # Name intentionally excluded: __eq__ compares fields only, and
+        # views ('X_view') must stay hash-equal to their source schema.
+        return hash(tuple(self._fields.values()))
 
     # ------------------------------------------------------------------ views
     def create_schema_view(self, fields) -> "Unischema":

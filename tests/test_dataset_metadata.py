@@ -1,0 +1,148 @@
+"""Writer + metadata + row-group planning tests
+(strategy parity: reference test_dataset_metadata.py / test_generate_metadata.py)."""
+import glob
+import json
+import os
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                TPU_ROW_GROUPS_PER_FILE_KEY,
+                                                TPU_UNISCHEMA_KEY,
+                                                get_schema,
+                                                get_schema_from_dataset_url,
+                                                infer_or_load_unischema,
+                                                load_row_groups,
+                                                write_dataset_metadata)
+from petastorm_tpu.etl.writer import DatasetWriter, materialize_dataset_local
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SCHEMA = Unischema("WriteSchema", [
+    UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField("vec", np.float32, (4,), NdarrayCodec(), False),
+])
+
+
+def _write(url, n=100, **kwargs):
+    rng = np.random.default_rng(0)
+    with materialize_dataset_local(url, SCHEMA, **kwargs) as w:
+        for i in range(n):
+            w.write_row({"id": i, "vec": rng.normal(size=4).astype(np.float32)})
+
+
+def test_write_creates_parquet_and_metadata(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=50, rows_per_row_group=10)
+    files = glob.glob(f"{tmp_path}/ds/*.parquet")
+    assert files
+    assert os.path.exists(f"{tmp_path}/ds/_common_metadata")
+    # all 50 rows present
+    total = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+    assert total == 50
+
+
+def test_schema_roundtrip_through_store(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=20, rows_per_row_group=5)
+    schema = get_schema_from_dataset_url(url)
+    assert schema == SCHEMA
+
+
+def test_load_row_groups_from_metadata(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=50, rows_per_row_group=10, rows_per_file=20)
+    ctx = DatasetContext(url)
+    rgs = load_row_groups(ctx)
+    # 50 rows / 20-per-file = 3 files; 20-row files have 2 rgs of 10
+    assert len(rgs) == 5
+    assert all(rg.path.endswith(".parquet") for rg in rgs)
+    # metadata key actually present (no footer scan needed)
+    assert TPU_ROW_GROUPS_PER_FILE_KEY in ctx.key_value_metadata()
+    assert TPU_UNISCHEMA_KEY in ctx.key_value_metadata()
+
+
+def test_load_row_groups_footer_scan_fallback(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=30, rows_per_row_group=10, rows_per_file=30)
+    os.remove(f"{tmp_path}/ds/_common_metadata")
+    ctx = DatasetContext(url)
+    rgs = load_row_groups(ctx)
+    assert len(rgs) == 3
+
+
+def test_row_group_content_readable(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=25, rows_per_row_group=10, rows_per_file=25)
+    ctx = DatasetContext(url)
+    rgs = load_row_groups(ctx)
+    sizes = []
+    for rg in rgs:
+        with ctx.filesystem.open(rg.path, "rb") as f:
+            t = pq.ParquetFile(f).read_row_group(rg.row_group)
+        sizes.append(t.num_rows)
+    assert sorted(sizes) == [5, 10, 10]
+    ids = []
+    for rg in rgs:
+        with ctx.filesystem.open(rg.path, "rb") as f:
+            ids.extend(pq.ParquetFile(f).read_row_group(rg.row_group).column("id").to_pylist())
+    assert sorted(ids) == list(range(25))
+
+
+def test_infer_schema_plain_parquet(tmp_path):
+    """A non-petastorm store gets an inferred schema (make_batch_reader path)."""
+    import pyarrow as pa
+    path = tmp_path / "plain"
+    path.mkdir()
+    t = pa.table({"a": np.arange(10), "b": np.linspace(0, 1, 10)})
+    pq.write_table(t, f"{path}/x.parquet")
+    ctx = DatasetContext(f"file://{path}")
+    with pytest.raises(MetadataError):
+        get_schema(ctx)
+    inferred = infer_or_load_unischema(ctx)
+    assert set(inferred.fields) == {"a", "b"}
+    assert np.dtype(inferred.a.numpy_dtype) == np.int64
+
+
+def test_generate_metadata_on_plain_store(tmp_path):
+    import pyarrow as pa
+    path = tmp_path / "plain"
+    path.mkdir()
+    t = pa.table({"a": np.arange(100)})
+    pq.write_table(t, f"{path}/x.parquet", row_group_size=25)
+    write_dataset_metadata(f"file://{path}", None)
+    ctx = DatasetContext(f"file://{path}")
+    assert len(load_row_groups(ctx)) == 4
+    doc = json.loads(ctx.key_value_metadata()[TPU_ROW_GROUPS_PER_FILE_KEY])
+    assert doc == {"x.parquet": 4}
+
+
+def test_partitioned_write_and_partition_values(tmp_path):
+    url = f"file://{tmp_path}/part_ds"
+    schema = Unischema("P", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("split", str, (), ScalarCodec(str), False),
+    ])
+    with materialize_dataset_local(url, schema, rows_per_row_group=5,
+                                   partition_by=["split"]) as w:
+        for i in range(20):
+            w.write_row({"id": i, "split": "train" if i % 2 else "test"})
+    ctx = DatasetContext(url)
+    rgs = load_row_groups(ctx)
+    assert len(rgs) == 4
+    parts = {rg.partition_dict.get("split") for rg in rgs}
+    assert parts == {"train", "test"}
+
+
+def test_moved_dataset_still_readable(tmp_path):
+    """Metadata stores relative paths, so a moved store keeps working
+    (parity: reference test_end_to_end.py:306)."""
+    url = f"file://{tmp_path}/orig"
+    _write(url, n=20, rows_per_row_group=5)
+    os.rename(f"{tmp_path}/orig", f"{tmp_path}/moved")
+    ctx = DatasetContext(f"file://{tmp_path}/moved")
+    assert get_schema(ctx) == SCHEMA
+    assert len(load_row_groups(ctx)) == 4

@@ -1,0 +1,65 @@
+"""LocalDiskCache tests (strategy parity: reference test_disk_cache.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.local_disk_cache import LocalDiskCache
+
+
+def test_null_cache_always_fills():
+    calls = []
+    c = NullCache()
+    assert c.get("k", lambda: calls.append(1) or 41) == 41
+    assert c.get("k", lambda: calls.append(1) or 42) == 42
+    assert len(calls) == 2
+
+
+def test_disk_cache_hit_and_miss(tmp_path):
+    c = LocalDiskCache(str(tmp_path / "c"), size_limit_bytes=10 << 20)
+    calls = []
+
+    def fill():
+        calls.append(1)
+        return {"x": np.arange(5)}
+
+    v1 = c.get("key1", fill)
+    v2 = c.get("key1", fill)
+    assert len(calls) == 1
+    np.testing.assert_array_equal(v1["x"], v2["x"])
+
+
+def test_disk_cache_eviction(tmp_path):
+    c = LocalDiskCache(str(tmp_path / "c"), size_limit_bytes=50_000)
+    big = np.zeros(10_000, np.uint8)  # ~10KB pickled
+    for i in range(20):
+        c.get(f"k{i}", lambda: big)
+    assert c.size_bytes() <= 50_000
+    assert len(c) < 20
+    # newest keys survive
+    hits = []
+    c.get("k19", lambda: hits.append(1) or big)
+    assert not hits
+
+
+def test_capacity_sanity_check(tmp_path):
+    with pytest.raises(ValueError, match="too small"):
+        LocalDiskCache(str(tmp_path / "c"), size_limit_bytes=1000,
+                       expected_row_size_bytes=1000)
+
+
+def test_cache_persists_across_instances(tmp_path):
+    path = str(tmp_path / "c")
+    c1 = LocalDiskCache(path, size_limit_bytes=1 << 20)
+    c1.get("k", lambda: "value")
+    c1.cleanup()
+    c2 = LocalDiskCache(path, size_limit_bytes=1 << 20)
+    assert c2.get("k", lambda: "WRONG") == "value"
+
+
+def test_cleanup_removes_dir_when_requested(tmp_path):
+    import os
+    path = str(tmp_path / "c")
+    c = LocalDiskCache(path, size_limit_bytes=1 << 20, cleanup=True)
+    c.get("k", lambda: 1)
+    c.cleanup()
+    assert not os.path.exists(path)

@@ -1,0 +1,50 @@
+"""Filesystem resolution tests (strategy parity: reference test_fs_utils.py)."""
+import pytest
+
+from petastorm_tpu.fs_utils import (FilesystemResolver,
+                                    get_filesystem_and_path_or_paths,
+                                    normalize_dir_url)
+
+
+def test_normalize_dir_url():
+    assert normalize_dir_url("/tmp/ds") == "file:///tmp/ds"
+    assert normalize_dir_url("file:///tmp/ds/") == "file:///tmp/ds"
+    assert normalize_dir_url("s3://bucket/ds/") == "s3://bucket/ds"
+    with pytest.raises(ValueError):
+        normalize_dir_url(123)
+
+
+def test_file_scheme_resolution(tmp_path):
+    fs, path = get_filesystem_and_path_or_paths(f"file://{tmp_path}")
+    assert path == str(tmp_path)
+    assert fs.exists(str(tmp_path))
+
+
+def test_bare_path_resolution(tmp_path):
+    fs, path = get_filesystem_and_path_or_paths(str(tmp_path))
+    assert path == str(tmp_path)
+
+
+def test_memory_scheme_resolution():
+    fs, path = get_filesystem_and_path_or_paths("memory://somewhere/ds")
+    assert path.endswith("somewhere/ds")
+    assert type(fs).__name__ == "MemoryFileSystem"
+
+
+def test_multiple_urls_same_scheme(tmp_path):
+    urls = [f"file://{tmp_path}/a.parquet", f"file://{tmp_path}/b.parquet"]
+    fs, paths = get_filesystem_and_path_or_paths(urls)
+    assert paths == [f"{tmp_path}/a.parquet", f"{tmp_path}/b.parquet"]
+
+
+def test_multiple_urls_mixed_scheme_rejected(tmp_path):
+    with pytest.raises(ValueError, match="share scheme"):
+        get_filesystem_and_path_or_paths([f"file://{tmp_path}/a", "s3://bucket/b"])
+
+
+def test_explicit_filesystem_passthrough(tmp_path):
+    import fsspec
+    myfs = fsspec.filesystem("file")
+    resolver = FilesystemResolver(f"file://{tmp_path}", filesystem=myfs)
+    assert resolver.filesystem() is myfs
+    assert resolver.get_dataset_path() == str(tmp_path)

@@ -1,0 +1,98 @@
+"""HDFS HA resolution/failover tests with mock configs and filesystems
+(strategy parity: reference hdfs/tests/test_hdfs_namenode.py — no Hadoop)."""
+import pytest
+
+from petastorm_tpu.hdfs.namenode import (HAHdfsClient, HdfsConnectError,
+                                         HdfsConnector, HdfsNamenodeResolver,
+                                         MAX_NAMENODE_FAILOVER_ATTEMPTS)
+
+HADOOP_CONFIG = {
+    "fs.defaultFS": "hdfs://nameservice1",
+    "dfs.nameservices": "nameservice1",
+    "dfs.ha.namenodes.nameservice1": "nn1,nn2",
+    "dfs.namenode.rpc-address.nameservice1.nn1": "host1:8020",
+    "dfs.namenode.rpc-address.nameservice1.nn2": "host2:8020",
+}
+
+
+def test_resolve_nameservice():
+    r = HdfsNamenodeResolver(HADOOP_CONFIG)
+    assert r.resolve_hdfs_name_service("nameservice1") == ["host1:8020", "host2:8020"]
+    # direct host:port netloc is not a nameservice
+    assert r.resolve_hdfs_name_service("somehost:8020") is None
+
+
+def test_resolve_default_service():
+    r = HdfsNamenodeResolver(HADOOP_CONFIG)
+    svc, nns = r.resolve_default_hdfs_service()
+    assert svc == "nameservice1"
+    assert nns == ["host1:8020", "host2:8020"]
+
+
+def test_missing_rpc_address_raises():
+    cfg = dict(HADOOP_CONFIG)
+    del cfg["dfs.namenode.rpc-address.nameservice1.nn2"]
+    with pytest.raises(HdfsConnectError, match="rpc-address"):
+        HdfsNamenodeResolver(cfg).resolve_hdfs_name_service("nameservice1")
+
+
+def test_non_hdfs_default_fs_raises():
+    with pytest.raises(HdfsConnectError, match="not an HDFS URL"):
+        HdfsNamenodeResolver({"fs.defaultFS": "file:///"}).resolve_default_hdfs_service()
+
+
+class _MockFs:
+    """Counts calls; fails the first ``failures`` ls() calls with IOError."""
+
+    def __init__(self, name, failures=0):
+        self.name = name
+        self.failures = failures
+        self.calls = 0
+
+    def ls(self, path):
+        self.calls += 1
+        if self.failures > 0:
+            self.failures -= 1
+            raise IOError(f"{self.name} down")
+        return [f"{path}/ok-from-{self.name}"]
+
+
+class _MockConnector(HdfsConnector):
+    fs_by_host = {}
+
+    @classmethod
+    def hdfs_connect_namenode(cls, netloc, user=None, **kwargs):
+        fs = cls.fs_by_host.get(netloc)
+        if fs is None:
+            raise IOError(f"no route to {netloc}")
+        return fs
+
+
+def test_failover_to_second_namenode():
+    _MockConnector.fs_by_host = {"host1:8020": _MockFs("host1", failures=10),
+                                 "host2:8020": _MockFs("host2")}
+    client = HAHdfsClient(_MockConnector, ["host1:8020", "host2:8020"])
+    assert client.ls("/x") == ["/x/ok-from-host2"]
+
+
+def test_failover_exhaustion_raises():
+    _MockConnector.fs_by_host = {"host1:8020": _MockFs("host1", failures=100),
+                                 "host2:8020": _MockFs("host2", failures=100)}
+    client = HAHdfsClient(_MockConnector, ["host1:8020", "host2:8020"])
+    with pytest.raises(HdfsConnectError, match="failed after"):
+        client.ls("/x")
+    total = (_MockConnector.fs_by_host["host1:8020"].calls
+             + _MockConnector.fs_by_host["host2:8020"].calls)
+    assert total == MAX_NAMENODE_FAILOVER_ATTEMPTS + 1
+
+
+def test_connect_to_either_namenode_skips_dead():
+    _MockConnector.fs_by_host = {"host2:8020": _MockFs("host2")}
+    client = _MockConnector.connect_to_either_namenode(["host1:8020", "host2:8020"])
+    assert client.ls("/y") == ["/y/ok-from-host2"]
+
+
+def test_connect_all_dead_raises():
+    _MockConnector.fs_by_host = {}
+    with pytest.raises(HdfsConnectError, match="any namenode"):
+        _MockConnector.connect_to_either_namenode(["host1:8020", "host2:8020"])

@@ -1,0 +1,151 @@
+"""Legacy petastorm metadata compatibility tests.
+
+Two layers: (1) a synthetic pickle crafted with fake ``petastorm.*`` modules
+validates the restricted-unpickler mapping; (2) when the reference checkout
+is present, its checked-in legacy stores (tests/data/legacy/<ver>) are read
+end-to-end (strategy parity: reference test_reading_legacy_datasets.py).
+"""
+import os
+import pickle
+import sys
+import types
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.legacy import depickle_legacy_unischema, restricted_loads
+from petastorm_tpu.unischema import Unischema
+
+REFERENCE_LEGACY_DIR = "/root/reference/petastorm/tests/data/legacy"
+
+
+@pytest.fixture
+def fake_petastorm_modules():
+    """Install fake petastorm modules shaped like the reference's pickled
+    classes, produce pickles with them, then remove them."""
+    import typing
+
+    class FakeUnischemaField(typing.NamedTuple):
+        name: str
+        numpy_dtype: object
+        shape: tuple
+        codec: object = None
+        nullable: bool = False
+
+    class FakeUnischema:
+        def __init__(self, name, fields):
+            self._name = name
+            self._fields = OrderedDict((f.name, f) for f in fields)
+
+    class FakeScalarCodec:
+        def __init__(self, spark_type):
+            self._spark_type = spark_type
+
+    class FakeNdarrayCodec:
+        pass
+
+    class FakeCompressedImageCodec:
+        def __init__(self, image_codec="png", quality=80):
+            self.image_codec = image_codec
+            self.quality = quality
+
+    class FakeStringType:
+        pass
+
+    class FakeIntegerType:
+        pass
+
+    uni_mod = types.ModuleType("petastorm.unischema")
+    uni_mod.Unischema = FakeUnischema
+    uni_mod.UnischemaField = FakeUnischemaField
+    codecs_mod = types.ModuleType("petastorm.codecs")
+    codecs_mod.ScalarCodec = FakeScalarCodec
+    codecs_mod.NdarrayCodec = FakeNdarrayCodec
+    codecs_mod.CompressedImageCodec = FakeCompressedImageCodec
+    spark_mod = types.ModuleType("pyspark.sql.types")
+    spark_mod.StringType = FakeStringType
+    spark_mod.IntegerType = FakeIntegerType
+    pkg = types.ModuleType("petastorm")
+    for cls, mod in [(FakeUnischema, "petastorm.unischema"),
+                     (FakeUnischemaField, "petastorm.unischema"),
+                     (FakeScalarCodec, "petastorm.codecs"),
+                     (FakeNdarrayCodec, "petastorm.codecs"),
+                     (FakeCompressedImageCodec, "petastorm.codecs"),
+                     (FakeStringType, "pyspark.sql.types"),
+                     (FakeIntegerType, "pyspark.sql.types")]:
+        cls.__module__ = mod
+        cls.__qualname__ = cls.__name__.replace("Fake", "")
+    FakeUnischemaField.__name__ = "UnischemaField"
+    FakeUnischema.__name__ = "Unischema"
+    FakeScalarCodec.__name__ = "ScalarCodec"
+    FakeNdarrayCodec.__name__ = "NdarrayCodec"
+    FakeCompressedImageCodec.__name__ = "CompressedImageCodec"
+    FakeStringType.__name__ = "StringType"
+    FakeIntegerType.__name__ = "IntegerType"
+    mods = {"petastorm": pkg, "petastorm.unischema": uni_mod,
+            "petastorm.codecs": codecs_mod}
+    spark_pkg = types.ModuleType("pyspark")
+    spark_sql = types.ModuleType("pyspark.sql")
+    mods.update({"pyspark": spark_pkg, "pyspark.sql": spark_sql,
+                 "pyspark.sql.types": spark_mod})
+    saved = {k: sys.modules.get(k) for k in mods}
+    sys.modules.update(mods)
+    ns = types.SimpleNamespace(Unischema=FakeUnischema, UnischemaField=FakeUnischemaField,
+                               ScalarCodec=FakeScalarCodec, NdarrayCodec=FakeNdarrayCodec,
+                               CompressedImageCodec=FakeCompressedImageCodec,
+                               StringType=FakeStringType, IntegerType=FakeIntegerType)
+    yield ns
+    for k, v in saved.items():
+        if v is None:
+            sys.modules.pop(k, None)
+        else:
+            sys.modules[k] = v
+
+
+def test_depickle_synthetic_legacy_unischema(fake_petastorm_modules):
+    m = fake_petastorm_modules
+    legacy = m.Unischema("Old", [
+        m.UnischemaField("id", np.int32, (), m.ScalarCodec(m.IntegerType()), False),
+        m.UnischemaField("name", np.str_, (), m.ScalarCodec(m.StringType()), True),
+        m.UnischemaField("image", np.uint8, (10, 10, 3), m.CompressedImageCodec("jpeg", 90), False),
+        m.UnischemaField("mat", np.float64, (2, 3), m.NdarrayCodec(), False),
+    ])
+    data = pickle.dumps(legacy, protocol=2)
+    schema = depickle_legacy_unischema(data)
+    assert isinstance(schema, Unischema)
+    assert set(schema.fields) == {"id", "name", "image", "mat"}
+    assert isinstance(schema.id.codec, ScalarCodec)
+    assert np.dtype(schema.id.codec.storage_dtype) == np.int32
+    assert isinstance(schema.image.codec, CompressedImageCodec)
+    assert schema.image.codec.quality == 90
+    assert isinstance(schema.mat.codec, NdarrayCodec)
+    assert schema.fields["name"].nullable is True
+    assert schema.mat.shape == (2, 3)
+
+
+def test_restricted_unpickler_blocks_arbitrary_classes():
+    data = pickle.dumps(os.system)
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        restricted_loads(data)
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
+                    reason="reference legacy stores not available")
+def test_read_real_legacy_petastorm_stores():
+    """Read every checked-in legacy petastorm store's schema + row groups."""
+    from petastorm_tpu.etl.dataset_metadata import (DatasetContext, get_schema,
+                                                    load_row_groups)
+    versions = sorted(os.listdir(REFERENCE_LEGACY_DIR))
+    assert versions
+    checked = 0
+    for ver in versions:
+        url = f"file://{REFERENCE_LEGACY_DIR}/{ver}"
+        ctx = DatasetContext(url)
+        schema = get_schema(ctx)
+        assert len(schema) > 0, ver
+        rgs = load_row_groups(ctx)
+        assert rgs, ver
+        checked += 1
+    assert checked == len(versions)
