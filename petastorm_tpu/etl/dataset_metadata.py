@@ -180,9 +180,12 @@ def load_row_groups(ctx: DatasetContext) -> List[RowGroupRef]:
         root = ctx.root_path
         by_rel = {os.path.relpath(f, root): f for f in files}
         missing = [rel for rel in per_file if rel not in by_rel]
-        if missing:
-            logger.warning("Metadata row-group index lists %d files not present in the "
-                           "store (moved/rewritten?); falling back to footer scan", len(missing))
+        unindexed = [rel for rel in by_rel if rel not in per_file]
+        if missing or unindexed:
+            logger.warning(
+                "Metadata row-group index is stale (%d indexed files absent on disk, "
+                "%d on-disk files not indexed — appended without regenerating "
+                "metadata?); falling back to footer scan", len(missing), len(unindexed))
             per_file = None
         else:
             for rel in sorted(per_file):
@@ -303,7 +306,8 @@ def materialize_dataset(spark, dataset_url: str, schema: Unischema,
             "petastorm_tpu.etl.writer.materialize_dataset_local") from e
 
     spark_config = {}
-    _spark_set_parquet_conf(spark, row_group_size_mb, spark_config)
+    _spark_set_parquet_conf(spark, row_group_size_mb, spark_config,
+                            use_summary_metadata=use_summary_metadata)
     try:
         yield
         write_dataset_metadata(dataset_url, schema)
@@ -311,14 +315,15 @@ def materialize_dataset(spark, dataset_url: str, schema: Unischema,
         _spark_restore_parquet_conf(spark, spark_config)
 
 
-def _spark_set_parquet_conf(spark, row_group_size_mb, saved):  # pragma: no cover - spark only
+def _spark_set_parquet_conf(spark, row_group_size_mb, saved,
+                            use_summary_metadata=False):  # pragma: no cover - spark only
     hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
     keys = ["parquet.block.size", "parquet.enable.summary-metadata"]
     for k in keys:
         saved[k] = hadoop_conf.get(k)
     if row_group_size_mb is not None:
         hadoop_conf.setInt("parquet.block.size", row_group_size_mb * (1 << 20))
-    hadoop_conf.setBoolean("parquet.enable.summary-metadata", False)
+    hadoop_conf.setBoolean("parquet.enable.summary-metadata", bool(use_summary_metadata))
 
 
 def _spark_restore_parquet_conf(spark, saved):  # pragma: no cover - spark only

@@ -56,10 +56,16 @@ class LocalDiskCache(CacheBase):
         self._local = threading.local()
         self._all_conns = []
         self._conns_lock = threading.Lock()
+        self._generation = 0
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
 
     def _conn(self) -> sqlite3.Connection:
+        # A cleanup() bumps the generation; threads holding a connection from
+        # an older generation (closed under them) transparently reconnect.
+        if getattr(self._local, "generation", -1) != self._generation:
+            self._local.conn = None
+            self._local.generation = self._generation
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._db_path, timeout=60.0,
@@ -113,6 +119,7 @@ class LocalDiskCache(CacheBase):
                 except sqlite3.Error:
                     pass
             self._all_conns.clear()
+            self._generation += 1
         self._local.conn = None
         if self._cleanup_on_exit:
             import shutil

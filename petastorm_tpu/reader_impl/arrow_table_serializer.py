@@ -1,0 +1,18 @@
+"""Arrow IPC stream serializer: zero-copy-friendly transport of pyarrow
+Tables between worker processes and the consumer.
+
+Parity: reference petastorm/reader_impl/arrow_table_serializer.py:19.
+"""
+import pyarrow as pa
+
+
+class ArrowTableSerializer:
+    def serialize(self, table: pa.Table) -> bytes:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return sink.getvalue().to_pybytes()
+
+    def deserialize(self, serialized) -> pa.Table:
+        # Accepts bytes or a zero-copy buffer (memoryview / pa.Buffer).
+        return pa.ipc.open_stream(pa.py_buffer(serialized)).read_all()

@@ -1,0 +1,13 @@
+"""Pickle payload serializer for the process pool.
+
+Parity: reference petastorm/reader_impl/pickle_serializer.py:18.
+"""
+import pickle
+
+
+class PickleSerializer:
+    def serialize(self, rows) -> bytes:
+        return pickle.dumps(rows, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, serialized: bytes):
+        return pickle.loads(serialized)

@@ -1,0 +1,134 @@
+"""Client-side row shuffling buffers.
+
+Row groups arrive in (possibly deterministic) group order; a shuffling buffer
+decorrelates rows *across* groups before batching. ``RandomShufflingBuffer``
+keeps up to ``shuffling_buffer_capacity`` rows and pops uniformly at random
+using the swap-with-last trick (O(1) per pop, no reallocation) — and with a
+seeded RNG the whole pipeline stays reproducible.
+
+Parity: reference petastorm/reader_impl/shuffling_buffer.py —
+``RandomShufflingBuffer`` (:103, swap-with-last ``retrieve`` :158),
+``NoopShufflingBuffer`` (:75). The jax-batched variant lives in
+:mod:`petastorm_tpu.jax.batched_buffer`.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+class ShufflingBufferBase:
+    """Contract: feed ``add_many`` while ``can_add``; drain via ``retrieve``
+    while ``can_retrieve``; call ``finish`` to flush the tail."""
+
+    def add_many(self, items):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+    def finish(self):
+        raise NotImplementedError
+
+    @property
+    def can_add(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def can_retrieve(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+
+class NoopShufflingBuffer(ShufflingBufferBase):
+    """Pass-through FIFO (shuffling disabled)."""
+
+    def __init__(self):
+        self._q = deque()
+        self._done = False
+
+    def add_many(self, items):
+        self._q.extend(items)
+
+    def retrieve(self):
+        return self._q.popleft()
+
+    def finish(self):
+        self._done = True
+
+    @property
+    def can_add(self):
+        return not self._done
+
+    @property
+    def can_retrieve(self):
+        return len(self._q) > 0
+
+    @property
+    def size(self):
+        return len(self._q)
+
+
+class RandomShufflingBuffer(ShufflingBufferBase):
+    """:param shuffling_buffer_capacity: max rows held
+    :param min_after_retrieve: keep at least this many rows buffered before
+        allowing retrieval (until ``finish``), bounding shuffle quality
+    :param extra_capacity: allowance above capacity for bulk ``add_many``
+        (a whole row group may arrive at once)
+    :param seed: RNG seed for reproducible shuffles
+    """
+
+    def __init__(self, shuffling_buffer_capacity: int,
+                 min_after_retrieve: int = 0,
+                 extra_capacity: int = 1000,
+                 seed: Optional[int] = None):
+        if min_after_retrieve >= shuffling_buffer_capacity:
+            raise ValueError("min_after_retrieve must be smaller than "
+                             "shuffling_buffer_capacity")
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._extra_capacity = extra_capacity
+        self._rng = np.random.default_rng(seed)
+        self._items = []
+        self._done_adding = False
+
+    def add_many(self, items):
+        if self._done_adding:
+            raise RuntimeError("Cannot add to a finished shuffling buffer")
+        items = list(items)
+        if len(self._items) + len(items) > self._capacity + self._extra_capacity:
+            raise RuntimeError(
+                f"Attempt to overfill shuffling buffer: {len(self._items)} buffered + "
+                f"{len(items)} new > {self._capacity} + {self._extra_capacity} slack. "
+                f"Check can_add before adding.")
+        self._items.extend(items)
+
+    def retrieve(self):
+        if not self.can_retrieve:
+            raise RuntimeError("Cannot retrieve: buffer below min_after_retrieve "
+                               "and not finished, or empty")
+        idx = int(self._rng.integers(0, len(self._items)))
+        self._items[idx], self._items[-1] = self._items[-1], self._items[idx]
+        return self._items.pop()
+
+    def finish(self):
+        self._done_adding = True
+
+    @property
+    def can_add(self):
+        return len(self._items) < self._capacity and not self._done_adding
+
+    @property
+    def can_retrieve(self):
+        if self._done_adding:
+            return len(self._items) > 0
+        return len(self._items) > self._min_after_retrieve
+
+    @property
+    def size(self):
+        return len(self._items)

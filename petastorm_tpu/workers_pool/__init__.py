@@ -1,0 +1,27 @@
+"""Worker-pool execution runtime.
+
+Protocol parity: reference petastorm/workers_pool/__init__.py.
+"""
+
+
+class EmptyResultError(RuntimeError):
+    """No results are available and none are expected until the next
+    ``ventilate`` call."""
+
+
+class TimeoutWaitingForResultError(RuntimeError):
+    """Timed out waiting for a worker result."""
+
+
+class VentilatedItemProcessedMessage:
+    """Worker -> pool signal: one ventilated item fully processed (used for
+    ventilator backpressure accounting)."""
+
+
+class WorkerFailure:
+    """Wraps a worker exception plus its formatted traceback for transport to
+    the consumer, where it is re-raised."""
+
+    def __init__(self, exception, traceback_str):
+        self.exception = exception
+        self.traceback_str = traceback_str

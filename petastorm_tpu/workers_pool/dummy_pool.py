@@ -1,0 +1,76 @@
+"""Inline single-threaded pool: work executes lazily inside ``get_results``.
+
+No threads, no processes — the debugging/profiling flavor. Ventilated items
+are queued; each ``get_results`` call processes items until the worker
+publishes at least one result, then drains publications in order.
+
+Parity: reference petastorm/workers_pool/dummy_pool.py — ``DummyPool`` (:20),
+``get_results`` (:50).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from petastorm_tpu.workers_pool import (EmptyResultError,
+                                        VentilatedItemProcessedMessage)
+
+
+class DummyPool:
+    def __init__(self, workers_count: int = 1, results_queue_size: int = 0,
+                 profiling_enabled: bool = False, **_ignored):
+        self.workers_count = 1
+        self._pending = deque()      # ventilated (args, kwargs) not yet processed
+        self._results = deque()      # published results not yet consumed
+        self._worker = None
+        self._ventilator = None
+        self._stopped = False
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        if self._worker is not None:
+            raise RuntimeError("DummyPool already started")
+        self._worker = worker_class(0, self._publish, worker_args)
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def _publish(self, data):
+        self._results.append(data)
+
+    def ventilate(self, *args, **kwargs):
+        self._pending.append((args, kwargs))
+
+    def get_results(self):
+        while True:
+            while self._results:
+                result = self._results.popleft()
+                if isinstance(result, VentilatedItemProcessedMessage):
+                    if self._ventilator:
+                        self._ventilator.processed_item()
+                    continue
+                return result
+            if self._pending:
+                args, kwargs = self._pending.popleft()
+                self._worker.process(*args, **kwargs)
+                self._results.append(VentilatedItemProcessedMessage())
+                continue
+            if self._ventilator is None or self._ventilator.completed():
+                raise EmptyResultError()
+            # The ventilator thread may still be feeding us; yield briefly.
+            import time
+            time.sleep(0.001)
+
+    def stop(self):
+        if self._ventilator:
+            self._ventilator.stop()
+        self._stopped = True
+
+    def join(self):
+        if self._worker is not None:
+            self._worker.shutdown()
+
+    def results_qsize(self) -> int:
+        return len(self._results)
+
+    @property
+    def diagnostics(self):
+        return {"output_queue_size": len(self._results)}

@@ -1,0 +1,31 @@
+"""Spawn a function in a brand-new Python process (no fork).
+
+fork is unsafe on TPU VMs (libtpu state must never be inherited) and with
+most threaded runtimes; this helper dill-serializes ``(func, args, kwargs)``
+to a temp file and execs a fresh interpreter running the entrypoint module,
+exactly the spawn discipline the reference uses
+(petastorm/workers_pool/exec_in_new_process.py:26).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import dill
+
+
+def exec_in_new_process(func, *args, **kwargs) -> subprocess.Popen:
+    """Launch ``func(*args, **kwargs)`` in a new interpreter; returns the
+    Popen handle. The child deletes the payload file after loading it."""
+    fd, payload_path = tempfile.mkstemp(suffix=".dill", prefix="pt_spawn_")
+    with os.fdopen(fd, "wb") as f:
+        dill.dump((func, args, kwargs), f, recurse=False)
+    env = dict(os.environ)
+    # Workers must never initialize a TPU backend; pin them to host CPU.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "petastorm_tpu.workers_pool.exec_in_new_process_entrypoint",
+         payload_path],
+        env=env)

@@ -1,0 +1,20 @@
+"""Child-process entrypoint for :mod:`exec_in_new_process`."""
+import os
+import sys
+
+import dill
+
+
+def main():
+    payload_path = sys.argv[1]
+    with open(payload_path, "rb") as f:
+        func, args, kwargs = dill.load(f)
+    try:
+        os.remove(payload_path)
+    except OSError:
+        pass
+    func(*args, **kwargs)
+
+
+if __name__ == "__main__":
+    main()

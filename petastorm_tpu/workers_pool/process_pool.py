@@ -1,0 +1,303 @@
+"""Process pool: spawned worker processes over ZeroMQ ``ipc://`` sockets.
+
+Topology (three sockets, mirroring the reference's diagram
+petastorm/workers_pool/process_pool.py:53-74, but over ipc:// instead of
+tcp://127.0.0.1 — unix domain sockets skip the loopback TCP stack):
+
+```
+   main process                               worker process (xN, spawned)
+   ───────────                                ──────────────
+   PUSH ──── work items (pickle) ───────────▶ PULL
+   PUB  ──── control: FINISH/STOP ──────────▶ SUB
+   PULL ◀─── results (serializer) / ctrl ──── PUSH
+```
+
+Result frames are multipart ``[kind, payload]``: ``b"data"`` payloads go
+through the pluggable serializer (pickle or Arrow IPC — the Arrow path hands
+the consumer a zero-copy view of the receive buffer), ``b"ctrl"`` payloads
+(ready-handshake, item-processed markers, worker exceptions) are always
+pickle.
+
+Safety: workers watch the parent PID and exit if it dies (no orphans,
+reference :320); worker start blocks on a ready-handshake from every worker
+so no ventilated item is ever lost to a ZMQ slow joiner (reference :292).
+
+Workers are **spawned, never forked**, and pinned to ``JAX_PLATFORMS=cpu``
+so a worker can never initialize (or corrupt) the parent's TPU runtime —
+the TPU-specific constraint that rules out fork-based pools entirely.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from traceback import format_exc
+
+from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+from petastorm_tpu.workers_pool import (EmptyResultError,
+                                        TimeoutWaitingForResultError,
+                                        VentilatedItemProcessedMessage,
+                                        WorkerFailure)
+from petastorm_tpu.workers_pool.exec_in_new_process import exec_in_new_process
+
+logger = logging.getLogger(__name__)
+
+_KIND_DATA = b"data"
+_KIND_CTRL = b"ctrl"
+_CONTROL_FINISH = b"FINISH"
+_WORKER_START_TIMEOUT_S = 60
+_JOIN_TIMEOUT_S = 30
+_POLL_MS = 100
+
+
+class _WorkerReady:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+class ProcessPool:
+    """:param workers_count: number of spawned worker processes
+    :param serializer: result payload serializer (default pickle; pass
+        :class:`ArrowTableSerializer` for columnar zero-copy transport)
+    :param zmq_copy_buffers: when False, Arrow payloads are exposed to the
+        serializer as zero-copy buffers (reference :127-130)
+    """
+
+    def __init__(self, workers_count: int, serializer=None,
+                 zmq_copy_buffers: bool = True, results_queue_size: int = 50):
+        self.workers_count = workers_count
+        self._serializer = serializer or PickleSerializer()
+        self._zmq_copy = zmq_copy_buffers
+        self._results_hwm = results_queue_size
+        self._context = None
+        self._work_socket = None
+        self._control_socket = None
+        self._results_socket = None
+        self._processes = []
+        self._ventilator = None
+        self._ventilated = 0
+        self._processed = 0
+        self._stopped = False
+        ipc_dir = tempfile.mkdtemp(prefix="pt_pool_")
+        token = uuid.uuid4().hex[:8]
+        self._endpoints = {
+            "work": f"ipc://{ipc_dir}/work-{token}",
+            "control": f"ipc://{ipc_dir}/ctrl-{token}",
+            "results": f"ipc://{ipc_dir}/res-{token}",
+        }
+        self._ipc_dir = ipc_dir
+
+    # ------------------------------------------------------------------ api
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        import zmq
+        if self._context is not None:
+            raise RuntimeError("ProcessPool already started")
+        self._context = zmq.Context()
+        self._work_socket = self._context.socket(zmq.PUSH)
+        self._work_socket.bind(self._endpoints["work"])
+        self._control_socket = self._context.socket(zmq.PUB)
+        self._control_socket.bind(self._endpoints["control"])
+        self._results_socket = self._context.socket(zmq.PULL)
+        self._results_socket.set_hwm(self._results_hwm)
+        self._results_socket.bind(self._endpoints["results"])
+
+        for worker_id in range(self.workers_count):
+            p = exec_in_new_process(
+                _worker_bootstrap, worker_id, worker_class, worker_args,
+                type(self._serializer), self._endpoints, os.getpid())
+            self._processes.append(p)
+
+        # Ready-handshake: every worker's PUSH is connected before any
+        # ventilation, so no work item can hit a half-built topology.
+        ready = set()
+        deadline = time.time() + _WORKER_START_TIMEOUT_S
+        while len(ready) < self.workers_count:
+            if time.time() > deadline:
+                self.stop(); self.join()
+                raise RuntimeError(
+                    f"Only {len(ready)}/{self.workers_count} workers started within "
+                    f"{_WORKER_START_TIMEOUT_S}s")
+            msg = self._poll_result(timeout_ms=_POLL_MS)
+            if msg is None:
+                self._check_processes_alive()
+                continue
+            if isinstance(msg, _WorkerReady):
+                ready.add(msg.worker_id)
+            elif isinstance(msg, WorkerFailure):
+                self.stop(); self.join()
+                raise msg.exception
+
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._ventilated += 1
+        self._work_socket.send_pyobj((args, kwargs))
+
+    def get_results(self, timeout: float = None):
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            all_done = (self._processed == self._ventilated)
+            if all_done and (self._ventilator is None or self._ventilator.completed()):
+                raise EmptyResultError()
+            msg = self._poll_result(timeout_ms=_POLL_MS)
+            if msg is None:
+                self._check_processes_alive()
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutWaitingForResultError()
+                continue
+            if isinstance(msg, VentilatedItemProcessedMessage):
+                self._processed += 1
+                if self._ventilator:
+                    self._ventilator.processed_item()
+                continue
+            if isinstance(msg, WorkerFailure):
+                logger.error("Worker failed:\n%s", msg.traceback_str)
+                self.stop(); self.join()
+                raise msg.exception
+            if isinstance(msg, _WorkerReady):
+                continue
+            return msg
+
+    def stop(self):
+        if self._ventilator:
+            self._ventilator.stop()
+        if self._control_socket is not None and not self._stopped:
+            try:
+                self._control_socket.send(_CONTROL_FINISH)
+            except Exception:  # noqa: BLE001 - socket may already be dead
+                pass
+        self._stopped = True
+
+    def join(self):
+        # Re-send FINISH while waiting: a worker whose SUB connected after
+        # the first send (slow joiner) would otherwise never hear it.
+        deadline = time.time() + _JOIN_TIMEOUT_S
+        while any(p.poll() is None for p in self._processes) and time.time() < deadline:
+            if self._control_socket is not None:
+                try:
+                    self._control_socket.send(_CONTROL_FINISH)
+                except Exception:  # noqa: BLE001
+                    break
+            time.sleep(0.05)
+        for p in self._processes:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for sock in (self._work_socket, self._control_socket, self._results_socket):
+            if sock is not None:
+                sock.close(linger=0)
+        if self._context is not None:
+            self._context.term()
+            self._context = None
+        import shutil
+        shutil.rmtree(self._ipc_dir, ignore_errors=True)
+
+    def results_qsize(self) -> int:
+        return 0  # not observable across the socket; parity with reference :303
+
+    @property
+    def diagnostics(self):
+        return {"items_ventilated": self._ventilated,
+                "items_processed": self._processed,
+                "items_inprocess": self._ventilated - self._processed,
+                "socket_hwm": self._results_hwm}
+
+    # ------------------------------------------------------------ internals
+    def _poll_result(self, timeout_ms: int):
+        import zmq
+        if not self._results_socket.poll(timeout_ms, zmq.POLLIN):
+            return None
+        kind, payload = self._results_socket.recv_multipart(copy=self._zmq_copy)
+        kind = bytes(memoryview(kind)) if not isinstance(kind, bytes) else kind
+        if kind == _KIND_CTRL:
+            payload = payload if isinstance(payload, bytes) else bytes(memoryview(payload))
+            return pickle.loads(payload)
+        if isinstance(payload, bytes):
+            return self._serializer.deserialize(payload)
+        return self._serializer.deserialize(memoryview(payload))
+
+    def _check_processes_alive(self):
+        for i, p in enumerate(self._processes):
+            rc = p.poll()
+            if rc is not None and rc != 0 and not self._stopped:
+                self.stop(); self.join()
+                raise RuntimeError(
+                    f"Worker process {i} died unexpectedly with exit code {rc}")
+
+
+# ------------------------------------------------------------- worker side
+def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
+                      endpoints, parent_pid):
+    """Entry function of a spawned worker process (reference :330)."""
+    import zmq
+
+    context = zmq.Context()
+    work_socket = context.socket(zmq.PULL)
+    work_socket.connect(endpoints["work"])
+    control_socket = context.socket(zmq.SUB)
+    control_socket.connect(endpoints["control"])
+    control_socket.setsockopt(zmq.SUBSCRIBE, b"")
+    results_socket = context.socket(zmq.PUSH)
+    results_socket.connect(endpoints["results"])
+
+    serializer = serializer_cls()
+
+    def send_ctrl(obj):
+        results_socket.send_multipart([_KIND_CTRL, pickle.dumps(obj)])
+
+    def publish(data):
+        results_socket.send_multipart([_KIND_DATA, serializer.serialize(data)])
+
+    # Orphan watchdog: exit hard if the parent dies (reference :320-327).
+    def _watch_parent():
+        import psutil
+        try:
+            parent = psutil.Process(parent_pid)
+            while parent.is_running() and parent.status() != psutil.STATUS_ZOMBIE:
+                time.sleep(1)
+        except psutil.NoSuchProcess:
+            pass
+        os._exit(0)
+
+    threading.Thread(target=_watch_parent, daemon=True).start()
+
+    worker = worker_class(worker_id, publish, worker_args)
+    send_ctrl(_WorkerReady(worker_id))
+
+    poller = zmq.Poller()
+    poller.register(work_socket, zmq.POLLIN)
+    poller.register(control_socket, zmq.POLLIN)
+    try:
+        while True:
+            events = dict(poller.poll())
+            if control_socket in events:
+                if control_socket.recv() == _CONTROL_FINISH:
+                    break
+            if work_socket in events:
+                args, kwargs = work_socket.recv_pyobj()
+                try:
+                    worker.process(*args, **kwargs)
+                    send_ctrl(VentilatedItemProcessedMessage())
+                except Exception as e:  # noqa: BLE001 - ship to parent
+                    sys.stderr.write(f"Worker {worker_id} exception:\n{format_exc()}\n")
+                    try:
+                        send_ctrl(WorkerFailure(e, format_exc()))
+                    except Exception:  # noqa: BLE001 - unpicklable exception
+                        send_ctrl(WorkerFailure(
+                            RuntimeError(f"Worker {worker_id} failed: {e!r} "
+                                         f"(original exception not picklable)"),
+                            format_exc()))
+                    break
+    finally:
+        worker.shutdown()
+        for sock in (work_socket, control_socket, results_socket):
+            sock.close(linger=1000)
+        context.term()
+        os._exit(0)

@@ -1,0 +1,221 @@
+"""Thread pool with deterministic round-robin result readout.
+
+Work items are assigned round-robin to per-worker input queues, and results
+are read round-robin from per-worker output queues. With a seeded ventilator
+this makes the whole pipeline **order-deterministic** — the property the TPU
+reader leans on for reproducible training input and for keeping multi-host
+shards in lockstep. When the consumer explicitly opts out of determinism
+(unseeded row shuffling), readout switches to non-blocking first-come order
+for better latency.
+
+Workers publish a :class:`VentilatedItemProcessedMessage` marker after each
+item; since markers trail the item's data in the same queue, the pool's
+accounting (items assigned == markers seen and queues drained) is exact with
+no data race on end-of-epoch detection.
+
+Parity: reference petastorm/workers_pool/thread_pool.py — ``WorkerThread``
+(:36), ``ThreadPool`` (:77), round-robin assign (:155), ``get_results``
+(:172), ``_stop_aware_put`` (:242), ``diagnostics`` (:261).
+"""
+from __future__ import annotations
+
+import cProfile
+import logging
+import pstats
+import queue
+import sys
+import time
+import threading
+from traceback import format_exc
+from typing import Optional
+
+from petastorm_tpu.workers_pool import (EmptyResultError,
+                                        VentilatedItemProcessedMessage,
+                                        WorkerFailure)
+
+logger = logging.getLogger(__name__)
+
+_IO_TIMEOUT_S = 0.001
+_END_OF_VENTILATION_POLL_S = 0.1
+
+
+class WorkerTerminationRequested(Exception):
+    """Raised inside a worker thread to unwind when the pool is stopping."""
+
+
+class _WorkerThread(threading.Thread):
+    def __init__(self, worker_impl, input_queue, result_queue, stop_event,
+                 put_fn, profiling_enabled=False):
+        super().__init__(name=f"pt-worker-{worker_impl.worker_id}", daemon=True)
+        self._worker_impl = worker_impl
+        self._input_queue = input_queue
+        self._result_queue = result_queue
+        self._stop_event = stop_event
+        self._put = put_fn
+        self.prof = cProfile.Profile() if profiling_enabled else None
+
+    def run(self):
+        if self.prof:
+            self.prof.enable()
+        while not self._stop_event.is_set():
+            try:
+                args, kwargs = self._input_queue.get(block=True, timeout=_IO_TIMEOUT_S)
+            except queue.Empty:
+                continue
+            try:
+                self._worker_impl.process(*args, **kwargs)
+                self._put(VentilatedItemProcessedMessage())
+            except WorkerTerminationRequested:
+                break
+            except Exception as e:  # noqa: BLE001 - propagate to consumer
+                tb = format_exc()
+                sys.stderr.write(f"Worker {self._worker_impl.worker_id} terminated: {tb}\n")
+                try:
+                    self._put(WorkerFailure(e, tb))
+                except WorkerTerminationRequested:
+                    pass
+                break
+        self._worker_impl.shutdown()
+        if self.prof:
+            self.prof.disable()
+
+
+class ThreadPool:
+    """:param workers_count: number of worker threads
+    :param results_queue_size: bound of each per-worker result queue
+    :param profiling_enabled: wrap workers in cProfile; merged stats print on join
+    :param shuffle_rows/seed: when rows are shuffled without a seed, result
+        readout is non-blocking (no determinism to preserve)
+    """
+
+    def __init__(self, workers_count: int, results_queue_size: int = 50,
+                 profiling_enabled: bool = False, shuffle_rows: bool = False,
+                 seed: Optional[int] = None):
+        self.workers_count = workers_count
+        self._results_queue_size = results_queue_size
+        self._profiling_enabled = profiling_enabled
+        self._strict_order = not (shuffle_rows and not seed)
+        self._stop_event = threading.Event()
+        self._workers = []
+        self._input_queues = []
+        self._result_queues = []
+        self._assigned = [0] * workers_count
+        self._processed = [0] * workers_count
+        self._next_assign = 0
+        self._next_read = 0
+        self._ventilator = None
+
+    # ------------------------------------------------------------------ api
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        if self._stop_event.is_set():
+            raise RuntimeError("A ThreadPool cannot be restarted after stop()")
+        if self._workers:
+            raise RuntimeError("ThreadPool already started")
+        for i in range(self.workers_count):
+            in_q = queue.Queue()
+            out_q = queue.Queue(maxsize=self._results_queue_size)
+            self._input_queues.append(in_q)
+            self._result_queues.append(out_q)
+            worker = worker_class(i, self._make_put(i), worker_args)
+            self._workers.append(_WorkerThread(worker, in_q, out_q, self._stop_event,
+                                               self._make_put(i), self._profiling_enabled))
+        for w in self._workers:
+            w.start()
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def _make_put(self, worker_id):
+        def _put(data):
+            # Bounded put that aborts when the pool is stopping, so workers
+            # never deadlock against a full queue (reference :242).
+            while True:
+                try:
+                    self._result_queues[worker_id].put(data, block=True, timeout=_IO_TIMEOUT_S)
+                    return
+                except queue.Full:
+                    if self._stop_event.is_set():
+                        raise WorkerTerminationRequested()
+        return _put
+
+    def ventilate(self, *args, **kwargs):
+        wid = self._next_assign
+        self._next_assign = (self._next_assign + 1) % self.workers_count
+        self._assigned[wid] += 1
+        self._input_queues[wid].put((args, kwargs))
+
+    def _worker_drained(self, wid) -> bool:
+        return (self._processed[wid] == self._assigned[wid]
+                and self._result_queues[wid].empty())
+
+    def get_results(self):
+        """Next published result, in deterministic round-robin order.
+
+        Raises :class:`EmptyResultError` when all ventilated work is done and
+        drained; re-raises worker exceptions.
+        """
+        empty_sweeps = 0
+        while True:
+            if all(self._worker_drained(i) for i in range(self.workers_count)):
+                if self._ventilator is None or self._ventilator.completed():
+                    raise EmptyResultError()
+
+            wid = self._next_read
+            if self._worker_drained(wid):
+                self._next_read = (self._next_read + 1) % self.workers_count
+                empty_sweeps += 1
+                if empty_sweeps >= self.workers_count:
+                    time.sleep(_IO_TIMEOUT_S)
+                    empty_sweeps = 0
+                continue
+            try:
+                result = self._result_queues[wid].get(
+                    block=self._strict_order, timeout=_END_OF_VENTILATION_POLL_S)
+            except queue.Empty:
+                if not self._strict_order:
+                    self._next_read = (self._next_read + 1) % self.workers_count
+                    empty_sweeps += 1
+                    if empty_sweeps >= self.workers_count:
+                        time.sleep(_IO_TIMEOUT_S)
+                        empty_sweeps = 0
+                continue
+            empty_sweeps = 0
+            if isinstance(result, VentilatedItemProcessedMessage):
+                self._processed[wid] += 1
+                if self._ventilator:
+                    self._ventilator.processed_item()
+                self._next_read = (self._next_read + 1) % self.workers_count
+                continue
+            if isinstance(result, WorkerFailure):
+                self.stop()
+                self.join()
+                raise result.exception
+            return result
+
+    def stop(self):
+        if self._ventilator:
+            self._ventilator.stop()
+        self._stop_event.set()
+
+    def join(self):
+        for w in self._workers:
+            if w.is_alive():
+                w.join()
+        if self._profiling_enabled and self._workers:
+            stats = None
+            for w in self._workers:
+                if w.prof is None:
+                    continue
+                if stats is None:
+                    stats = pstats.Stats(w.prof)
+                else:
+                    stats.add(w.prof)
+            if stats is not None:
+                stats.sort_stats("cumulative").print_stats()
+
+    def results_qsize(self) -> int:
+        return sum(q.qsize() for q in self._result_queues)
+
+    @property
+    def diagnostics(self):
+        return {"output_queue_size": self.results_qsize()}

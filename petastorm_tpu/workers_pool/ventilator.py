@@ -1,0 +1,149 @@
+"""Ventilator: feeds work items (row-group reads) into a pool with
+backpressure and epoch semantics.
+
+``ConcurrentVentilator`` owns the list of work items and ventilates them from
+a daemon thread: ``iterations`` full passes (``None`` = infinite), optional
+per-epoch order randomization (seeded for determinism — the property the
+TPU reader relies on for reproducible input pipelines), and a cap on
+in-flight items (``max_ventilation_queue_size``) so a slow consumer never
+causes unbounded memory growth.
+
+Parity: reference petastorm/workers_pool/ventilator.py — ``Ventilator`` (:26),
+``ConcurrentVentilator`` (:55), ``_ventilate`` (:139), ``processed_item``
+(:121), ``completed`` (:124), ``reset`` (:128).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+_VENTILATION_INTERVAL_S = 0.01
+
+
+class Ventilator:
+    """Base: pushes work items into a pool via ``ventilate_fn``."""
+
+    def __init__(self, ventilate_fn):
+        self._ventilate_fn = ventilate_fn
+
+    def start(self):
+        raise NotImplementedError
+
+    def processed_item(self):
+        """Consumer reports one item completed (backpressure credit)."""
+
+    def completed(self) -> bool:
+        """True when every item of every iteration has been ventilated."""
+        raise NotImplementedError
+
+    def stop(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class ConcurrentVentilator(Ventilator):
+    """:param ventilate_fn: callable receiving one item's kwargs
+    :param items_to_ventilate: list of kwarg-dicts, one per work item
+    :param iterations: number of passes over the items (``None`` = forever)
+    :param randomize_item_order: shuffle item order each pass
+    :param random_seed: seed for the order shuffle; with a seed, pass N's
+        order is identical across runs *and across shards* (each pass
+        reseeds with ``seed + pass_index``)
+    :param max_ventilation_queue_size: max in-flight (ventilated minus
+        processed) items; defaults to the full item count
+    """
+
+    def __init__(self,
+                 ventilate_fn,
+                 items_to_ventilate: List[Dict[str, Any]],
+                 iterations: Optional[int] = 1,
+                 randomize_item_order: bool = False,
+                 random_seed: Optional[int] = None,
+                 max_ventilation_queue_size: Optional[int] = None,
+                 ventilation_interval: float = _VENTILATION_INTERVAL_S):
+        super().__init__(ventilate_fn)
+        if iterations is not None and iterations <= 0:
+            raise ValueError(f"iterations must be positive or None, got {iterations}")
+        self._items = list(items_to_ventilate)
+        self._iterations_total = iterations
+        self._randomize = randomize_item_order
+        self._seed = random_seed
+        self._max_inflight = max_ventilation_queue_size or max(1, len(self._items))
+        self._interval = ventilation_interval
+
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._stop_event = threading.Event()
+        self._completed_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._epoch = 0
+
+    # ------------------------------------------------------------------ api
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("Ventilator already started")
+        self._thread = threading.Thread(target=self._ventilate_loop,
+                                        name="ventilator", daemon=True)
+        self._thread.start()
+
+    def processed_item(self):
+        with self._inflight_cv:
+            self._inflight = max(0, self._inflight - 1)
+            self._inflight_cv.notify_all()
+
+    def completed(self) -> bool:
+        return self._completed_event.is_set()
+
+    def stop(self):
+        self._stop_event.set()
+        with self._inflight_cv:
+            self._inflight_cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def reset(self):
+        """Restart ventilation for another run. Only legal once the current
+        ventilation has completed (parity: reference ventilator.py:128)."""
+        if not self.completed():
+            raise NotImplementedError(
+                "Resetting a ventilator while ventilation is in progress is not supported")
+        self.stop()
+        self._stop_event.clear()
+        self._completed_event.clear()
+        with self._inflight_cv:
+            self._inflight = 0
+        # Restart from epoch 0 so a reset ventilator replays the exact same
+        # seeded order as a fresh one (multi-host shards stay in lockstep).
+        self._epoch = 0
+        self.start()
+
+    # ------------------------------------------------------------ internals
+    def _epoch_order(self, epoch: int) -> List[Dict[str, Any]]:
+        items = list(self._items)
+        if self._randomize:
+            rng = random.Random(None if self._seed is None else self._seed + epoch)
+            rng.shuffle(items)
+        return items
+
+    def _ventilate_loop(self):
+        iterations_left = self._iterations_total
+        while not self._stop_event.is_set():
+            if iterations_left is not None and iterations_left <= 0:
+                break
+            for item in self._epoch_order(self._epoch):
+                with self._inflight_cv:
+                    while (self._inflight >= self._max_inflight
+                           and not self._stop_event.is_set()):
+                        self._inflight_cv.wait(self._interval)
+                    if self._stop_event.is_set():
+                        return
+                    self._inflight += 1
+                self._ventilate_fn(**item)
+            self._epoch += 1
+            if iterations_left is not None:
+                iterations_left -= 1
+        self._completed_event.set()

@@ -1,0 +1,128 @@
+"""Ventilator tests (strategy parity: reference test_ventilator.py —
+backpressure, iterations, reset, randomized order determinism)."""
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu.workers_pool.ventilator import ConcurrentVentilator
+
+
+class _Collector:
+    def __init__(self):
+        self.items = []
+        self.lock = threading.Lock()
+
+    def __call__(self, **kwargs):
+        with self.lock:
+            self.items.append(kwargs)
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_single_pass_ventilates_all():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(10)])
+    v.start()
+    assert _wait_for(lambda: len(c.items) == 10)
+    assert _wait_for(v.completed)
+    assert [d["i"] for d in c.items] == list(range(10))
+    v.stop()
+
+
+def test_multiple_iterations():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(4)], iterations=3,
+                             max_ventilation_queue_size=1000)
+    v.start()
+    assert _wait_for(lambda: len(c.items) == 12)
+    assert _wait_for(v.completed)
+    v.stop()
+
+
+def test_infinite_iterations_never_complete():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(2)], iterations=None,
+                             max_ventilation_queue_size=1000)
+    v.start()
+    assert _wait_for(lambda: len(c.items) >= 20)
+    assert not v.completed()
+    v.stop()
+
+
+def test_bad_iterations_rejected():
+    with pytest.raises(ValueError):
+        ConcurrentVentilator(lambda **kw: None, [], iterations=0)
+    with pytest.raises(ValueError):
+        ConcurrentVentilator(lambda **kw: None, [], iterations=-1)
+
+
+def test_backpressure_blocks_until_processed():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(100)],
+                             max_ventilation_queue_size=5)
+    v.start()
+    assert _wait_for(lambda: len(c.items) == 5)
+    time.sleep(0.05)
+    assert len(c.items) == 5  # stalled at the cap
+    for _ in range(3):
+        v.processed_item()
+    assert _wait_for(lambda: len(c.items) == 8)
+    time.sleep(0.05)
+    assert len(c.items) == 8
+    v.stop()
+
+
+def test_seeded_randomized_order_is_deterministic():
+    orders = []
+    for _ in range(2):
+        c = _Collector()
+        v = ConcurrentVentilator(c, [{"i": i} for i in range(30)],
+                                 randomize_item_order=True, random_seed=123)
+        v.start()
+        assert _wait_for(v.completed)
+        v.stop()
+        orders.append([d["i"] for d in c.items])
+    assert orders[0] == orders[1]
+    assert orders[0] != list(range(30))  # actually shuffled
+    assert sorted(orders[0]) == list(range(30))
+
+
+def test_epochs_have_different_orders_with_same_seed():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(20)], iterations=2,
+                             randomize_item_order=True, random_seed=7,
+                             max_ventilation_queue_size=1000)
+    v.start()
+    assert _wait_for(v.completed)
+    v.stop()
+    first, second = c.items[:20], c.items[20:]
+    assert sorted(d["i"] for d in first) == sorted(d["i"] for d in second)
+    assert first != second  # per-epoch reshuffle
+
+
+def test_reset_replays_ventilation():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(5)])
+    v.start()
+    assert _wait_for(v.completed)
+    v.reset()
+    assert _wait_for(lambda: len(c.items) == 10)
+    v.stop()
+
+
+def test_reset_before_completion_rejected():
+    c = _Collector()
+    v = ConcurrentVentilator(c, [{"i": i} for i in range(1000)],
+                             max_ventilation_queue_size=1)
+    v.start()
+    with pytest.raises(NotImplementedError):
+        v.reset()
+    v.stop()
