@@ -274,6 +274,8 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
     kv[TPU_ROW_GROUPS_PER_FILE_KEY] = json.dumps(per_file, sort_keys=True).encode("utf-8")
     if schema is not None:
         kv[TPU_UNISCHEMA_KEY] = json.dumps(schema.to_dict()).encode("utf-8")
+    if extra_kv:
+        kv.update(extra_kv)
 
     with ctx.filesystem.open(files[0], "rb") as f:
         arrow_schema = pq.ParquetFile(f).schema_arrow

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import io
 import pickle
-from collections import OrderedDict
+from collections import OrderedDict, defaultdict
 from decimal import Decimal
 
 import numpy as np
@@ -102,6 +102,7 @@ _ALLOWED = {
     ("dataset_toolkit.codecs", "CompressedNdarrayCodec"): _codecs.CompressedNdarrayCodec,
     ("dataset_toolkit.codecs", "CompressedImageCodec"): _codecs.CompressedImageCodec,
     ("collections", "OrderedDict"): OrderedDict,
+    ("collections", "defaultdict"): defaultdict,
     ("builtins", "str"): str,
     ("builtins", "bytes"): bytes,
     ("builtins", "int"): int,
@@ -115,6 +116,16 @@ _ALLOWED = {
     ("builtins", "tuple"): tuple,
     ("builtins", "dict"): dict,
 }
+
+
+def _allow_own_indexers():
+    from petastorm_tpu.etl import rowgroup_indexers as _ri
+    for cls_name in ("SingleFieldIndexer", "FieldNotNullIndexer"):
+        cls = getattr(_ri, cls_name)
+        _ALLOWED[("petastorm_tpu.etl.rowgroup_indexers", cls_name)] = cls
+        # Reference-written indexes map onto our classes.
+        _ALLOWED[("petastorm.etl.rowgroup_indexers", cls_name)] = cls
+        _ALLOWED[("dataset_toolkit.etl.rowgroup_indexers", cls_name)] = cls
 
 _ALLOWED_NUMPY = {"dtype", "ndarray", "int8", "int16", "int32", "int64",
                   "uint8", "uint16", "uint32", "uint64", "float16", "float32",
@@ -198,3 +209,6 @@ def _plain_codec(codec):
     if isinstance(codec, _LegacyScalarCodec):
         return _codecs.ScalarCodec(codec.storage_dtype)
     return codec
+
+
+_allow_own_indexers()

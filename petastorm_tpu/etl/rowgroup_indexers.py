@@ -1,0 +1,117 @@
+"""Indexers: build value -> {row-group ordinals} inverted maps.
+
+Parity: reference petastorm/etl/rowgroup_indexers.py — ``SingleFieldIndexer``
+(:21), ``FieldNotNullIndexer`` (:78); base protocol
+``RowGroupIndexerBase`` (etl/__init__.py:21).
+"""
+from __future__ import annotations
+
+
+class RowGroupIndexerBase:
+    """Protocol: feed rows per row group via ``process_row_group``; query by
+    ``get_row_group_indexes``."""
+
+    @property
+    def index_name(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def column_names(self):
+        """Columns this indexer needs to read."""
+        raise NotImplementedError
+
+    @property
+    def indexed_values(self):
+        raise NotImplementedError
+
+    def get_row_group_indexes(self, value):
+        raise NotImplementedError
+
+    def process_row_group(self, row_group_ordinal: int, rows) -> None:
+        raise NotImplementedError
+
+
+class SingleFieldIndexer(RowGroupIndexerBase):
+    """Maps each distinct value of one field to the row groups containing it."""
+
+    def __init__(self, index_name: str, index_field: str):
+        self._index_name = index_name
+        self._field = index_field
+        self._index: dict = {}
+
+    @property
+    def index_name(self):
+        return self._index_name
+
+    @property
+    def column_names(self):
+        return [self._field]
+
+    @property
+    def indexed_values(self):
+        return list(self._index.keys())
+
+    def get_row_group_indexes(self, value):
+        return self._index.get(value, set())
+
+    def process_row_group(self, row_group_ordinal, rows):
+        import numpy as np
+        for row in rows:
+            value = row[self._field]
+            if value is None:
+                continue
+            # Array-valued fields index each element (parity: reference
+            # rowgroup_indexers.py:69-73).
+            if isinstance(value, (np.ndarray, list, tuple)):
+                for v in value:
+                    self._index.setdefault(v, set()).add(row_group_ordinal)
+            else:
+                self._index.setdefault(value, set()).add(row_group_ordinal)
+
+    def __eq__(self, other):
+        return (type(self) is type(other) and self._field == other._field
+                and self._index == other._index)
+
+    def __setstate__(self, state):
+        # Accept both this package's attribute names and the reference's
+        # (_column_name/_index_data) so legacy pickled indexes load cleanly.
+        self._index_name = state.get("_index_name")
+        self._field = state.get("_field", state.get("_column_name"))
+        self._index = dict(state.get("_index", state.get("_index_data", {})))
+
+
+class FieldNotNullIndexer(RowGroupIndexerBase):
+    """Indexes row groups that contain at least one non-null value of a field."""
+
+    NOT_NULL_KEY = "__not_null__"
+
+    def __init__(self, index_name: str, index_field: str):
+        self._index_name = index_name
+        self._field = index_field
+        self._row_groups: set = set()
+
+    @property
+    def index_name(self):
+        return self._index_name
+
+    @property
+    def column_names(self):
+        return [self._field]
+
+    @property
+    def indexed_values(self):
+        return [self.NOT_NULL_KEY]
+
+    def get_row_group_indexes(self, value=None):
+        return self._row_groups
+
+    def process_row_group(self, row_group_ordinal, rows):
+        for row in rows:
+            if row[self._field] is not None:
+                self._row_groups.add(row_group_ordinal)
+                return
+
+    def __setstate__(self, state):
+        self._index_name = state.get("_index_name")
+        self._field = state.get("_field", state.get("_column_name"))
+        self._row_groups = set(state.get("_row_groups", state.get("_index_data", set())))

@@ -1,0 +1,71 @@
+"""Build and load row-group inverted indexes (Spark-free).
+
+``build_rowgroup_index`` scans every row group through a thread pool, feeds
+the requested indexers (only their columns are read), and stores the pickled
+index map in ``_common_metadata``. Reading goes through the restricted
+unpickler (allowlisting only this package's indexer classes and primitives),
+and the reference's legacy ``dataset-toolkit.rowgroups_index.v1`` key is
+honored for old stores.
+
+Parity: reference petastorm/etl/rowgroup_indexing.py —
+``build_rowgroup_index`` (:37-80, a Spark job there), key constant (:32),
+``get_row_group_indexes`` (:136).
+"""
+from __future__ import annotations
+
+import pickle
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Sequence
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import (DatasetContext, load_row_groups)
+from petastorm_tpu.etl.rowgroup_indexers import RowGroupIndexerBase
+
+TPU_ROWGROUPS_INDEX_KEY = b"petastorm-tpu.rowgroups_index.v1"
+LEGACY_ROWGROUPS_INDEX_KEY = b"dataset-toolkit.rowgroups_index.v1"
+
+
+def build_rowgroup_index(dataset_url_or_ctx, indexers: Sequence[RowGroupIndexerBase],
+                         num_workers: int = 10) -> Dict[str, RowGroupIndexerBase]:
+    """Populate ``indexers`` over every row group and persist the index."""
+    ctx = (dataset_url_or_ctx if isinstance(dataset_url_or_ctx, DatasetContext)
+           else DatasetContext(dataset_url_or_ctx))
+    row_groups = load_row_groups(ctx)
+    columns = sorted({c for ix in indexers for c in ix.column_names})
+
+    def _read(job):
+        ordinal, rg = job
+        with ctx.filesystem.open(rg.path, "rb") as f:
+            table = pq.ParquetFile(f).read_row_group(rg.row_group, columns=columns)
+        return ordinal, table.to_pylist()
+
+    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+        for ordinal, rows in pool.map(_read, enumerate(row_groups)):
+            for ix in indexers:
+                ix.process_row_group(ordinal, rows)
+
+    index_dict = {ix.index_name: ix for ix in indexers}
+    _store_index(ctx, index_dict)
+    return index_dict
+
+
+def _store_index(ctx: DatasetContext, index_dict) -> None:
+    from petastorm_tpu.etl.dataset_metadata import write_dataset_metadata
+    payload = pickle.dumps(index_dict, protocol=pickle.HIGHEST_PROTOCOL)
+    write_dataset_metadata(ctx, None, extra_kv={TPU_ROWGROUPS_INDEX_KEY: payload})
+
+
+def get_row_group_indexes(ctx: DatasetContext) -> Dict[str, RowGroupIndexerBase]:
+    """Load the stored index map; raises MetadataError when absent."""
+    kv = ctx.key_value_metadata()
+    if TPU_ROWGROUPS_INDEX_KEY in kv:
+        from petastorm_tpu.etl.legacy import restricted_loads
+        return restricted_loads(kv[TPU_ROWGROUPS_INDEX_KEY])
+    if LEGACY_ROWGROUPS_INDEX_KEY in kv:
+        from petastorm_tpu.etl.legacy import restricted_loads
+        return restricted_loads(kv[LEGACY_ROWGROUPS_INDEX_KEY])
+    raise MetadataError(
+        f"Dataset at {ctx.path_or_paths} has no row-group index. "
+        "Build one with build_rowgroup_index().")

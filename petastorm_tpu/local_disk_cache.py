@@ -41,6 +41,11 @@ class LocalDiskCache(CacheBase):
     :param cleanup: if True, delete the cache directory on :meth:`cleanup`
     """
 
+    def __reduce__(self):
+        # Crossing a process boundary (worker args) re-opens the same cache
+        # directory in the child; live sqlite connections never travel.
+        return (type(self), (self._path, self._size_limit, 0, 6, self._cleanup_on_exit))
+
     def __init__(self, path: str, size_limit_bytes: int, expected_row_size_bytes: int = 0,
                  shards: int = 6, cleanup: bool = False, **_ignored):
         min_rows = 100

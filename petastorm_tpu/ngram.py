@@ -1,0 +1,152 @@
+"""NGram: windowed sequence readout over timestamp-sorted rows.
+
+An :class:`NGram` turns a row dataset into a dataset of fixed-length time
+windows: each yielded sample is ``{offset: row_namedtuple}`` for every offset
+key in ``fields``. Windows are assembled **within a row group** (never
+crossing its boundary — parity with the reference's documented behavior,
+ngram.py:86-91), after sorting the group's rows by ``timestamp_field``;
+``delta_threshold`` drops windows with a timestamp gap, and
+``timestamp_overlap=False`` yields disjoint windows.
+
+This is the building block for token-stream/sequence datasets feeding
+long-context LLM training: windows are assembled host-side per row group,
+and the row-group sharding above distributes them across TPU hosts.
+
+Parity: reference petastorm/ngram.py — ``NGram.__init__`` (:102),
+``form_ngram`` (:225), ``_ngram_pass_threshold`` (:179), regex field
+resolution (:195), ``get_schema_at_timestep`` (:215).
+"""
+from __future__ import annotations
+
+import decimal
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
+
+
+class NGram:
+    """:param fields: ``{offset: [UnischemaField or field-name regex, ...]}``
+        — which fields are read at each relative timestep
+    :param delta_threshold: max allowed timestamp delta between *consecutive*
+        rows of a window; windows containing a larger gap are dropped
+    :param timestamp_field: the field (or its name) windows are ordered by
+    :param timestamp_overlap: when False, yielded windows do not share rows
+    """
+
+    def __init__(self,
+                 fields: Dict[int, Sequence[Union[UnischemaField, str]]],
+                 delta_threshold: Union[int, float, decimal.Decimal],
+                 timestamp_field: Union[UnischemaField, str],
+                 timestamp_overlap: bool = True):
+        if not isinstance(fields, dict) or not fields:
+            raise ValueError("fields must be a non-empty dict of {offset: [fields]}")
+        keys = sorted(fields.keys())
+        if keys != list(range(min(keys), max(keys) + 1)):
+            raise ValueError(f"fields offsets must be consecutive integers, got {keys}")
+        self._fields = {k: list(v) for k, v in fields.items()}
+        self._delta_threshold = delta_threshold
+        self._timestamp_field = timestamp_field
+        self._timestamp_overlap = timestamp_overlap
+        self._resolved: Optional[Dict[int, List[UnischemaField]]] = None
+
+    @property
+    def length(self) -> int:
+        return max(self._fields) - min(self._fields) + 1
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def timestamp_field_name(self) -> str:
+        f = self._timestamp_field
+        return f.name if isinstance(f, UnischemaField) else f
+
+    @property
+    def timestamp_overlap(self) -> bool:
+        return self._timestamp_overlap
+
+    # -------------------------------------------------------------- schemas
+    def resolve_regex_field_names(self, schema: Unischema) -> None:
+        """Expand any string patterns in ``fields`` against ``schema``
+        (parity: reference :195)."""
+        resolved = {}
+        for offset, specs in self._fields.items():
+            out: List[UnischemaField] = []
+            for spec in specs:
+                if isinstance(spec, UnischemaField):
+                    out.append(spec)
+                else:
+                    matched = match_unischema_fields(schema, [spec])
+                    if not matched:
+                        raise ValueError(f"NGram field pattern {spec!r} matched nothing")
+                    out.extend(matched)
+            # de-dup, stable
+            seen = set()
+            resolved[offset] = [f for f in out if not (f.name in seen or seen.add(f.name))]
+        self._resolved = resolved
+        self._fields = resolved
+
+    def get_field_names_at_timestep(self, timestep: int) -> List[str]:
+        if timestep not in self._fields:
+            return []
+        return [f.name if isinstance(f, UnischemaField) else f
+                for f in self._fields[timestep]]
+
+    def get_schema_at_timestep(self, schema: Unischema, timestep: int) -> Unischema:
+        """Schema view of the fields read at one timestep (parity: :215)."""
+        names = [n for n in self.get_field_names_at_timestep(timestep)
+                 if n in schema.fields]
+        return schema.create_schema_view(names)
+
+    def get_field_names_at_all_timesteps(self) -> List[str]:
+        names = set()
+        for ts in self._fields:
+            names.update(self.get_field_names_at_timestep(ts))
+        names.add(self.timestamp_field_name)
+        return sorted(names)
+
+    # ------------------------------------------------------------- assembly
+    def _pass_threshold(self, timestamps) -> bool:
+        """True when every consecutive delta is <= delta_threshold
+        (parity: reference :179)."""
+        for prev, cur in zip(timestamps, timestamps[1:]):
+            if cur - prev > self._delta_threshold:
+                return False
+        return True
+
+    def form_ngram(self, data: List[dict], schema: Unischema) -> List[Dict[int, object]]:
+        """Assemble windows from one row group's decoded rows.
+
+        ``data`` must be sorted by the timestamp field. Returns a list of
+        ``{offset: namedtuple}`` dicts (parity: reference :225).
+        """
+        ts_name = self.timestamp_field_name
+        offsets = sorted(self._fields)
+        length = self.length
+        out = []
+        i = 0
+        n = len(data)
+        while i + length <= n:
+            window = data[i:i + length]
+            timestamps = [row[ts_name] for row in window]
+            if self._pass_threshold(timestamps):
+                sample = {}
+                for pos, offset in enumerate(offsets):
+                    ts_schema = self.get_schema_at_timestep(schema, offset)
+                    row = {k: window[pos][k] for k in ts_schema.fields if k in window[pos]}
+                    sample[offset] = ts_schema.make_namedtuple_from_dict(row)
+                out.append(sample)
+                i += length if not self._timestamp_overlap else 1
+            else:
+                i += 1
+        return out
+
+    def make_namedtuple(self, schema: Unischema, sample_by_offset: dict) -> dict:
+        return sample_by_offset  # samples are already {offset: namedtuple}

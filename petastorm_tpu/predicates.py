@@ -1,0 +1,141 @@
+"""Row-level predicates evaluated inside reader workers.
+
+A predicate declares the fields it needs (``get_fields``) and a vectorizable
+``do_include`` decision. Workers load *only* the predicate fields first and
+read the remaining columns just for the surviving rows — predicate pushdown
+without any query engine (reference py_dict_reader_worker predicate-first
+loading). When every predicate field is a partition key, the Reader evaluates
+it at planning time and skips whole row groups.
+
+Parity: reference petastorm/predicates.py — ``PredicateBase`` (:27),
+``in_set`` (:44), ``in_intersection`` (:58), ``in_lambda`` (:74),
+``in_negate`` (:103), ``in_reduce`` (:119), ``in_pseudorandom_split`` (:144,
+md5 bucketing :39).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Sequence
+
+
+class PredicateBase:
+    def get_fields(self) -> set:
+        """Names of the fields ``do_include`` reads."""
+        raise NotImplementedError
+
+    def do_include(self, values: dict) -> bool:
+        """Decide inclusion given ``{field_name: value}`` for one row."""
+        raise NotImplementedError
+
+
+class in_set(PredicateBase):
+    """Include rows whose ``predicate_field`` value is in ``inclusion_values``."""
+
+    def __init__(self, inclusion_values, predicate_field: str):
+        self._values = set(inclusion_values)
+        self._field = predicate_field
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        return values[self._field] in self._values
+
+
+class in_intersection(PredicateBase):
+    """Include rows whose iterable ``predicate_field`` intersects
+    ``inclusion_values``."""
+
+    def __init__(self, inclusion_values, predicate_field: str):
+        self._values = set(inclusion_values)
+        self._field = predicate_field
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        return bool(self._values.intersection(values[self._field]))
+
+
+class in_lambda(PredicateBase):
+    """Arbitrary predicate: ``predicate_func(values_dict [, state])``."""
+
+    def __init__(self, predicate_fields: Sequence[str], predicate_func: Callable,
+                 state=None):
+        self._fields = set(predicate_fields)
+        self._func = predicate_func
+        self._state = state
+
+    def get_fields(self):
+        return self._fields
+
+    def do_include(self, values):
+        if self._state is not None:
+            return self._func(values, self._state)
+        return self._func(values)
+
+
+class in_negate(PredicateBase):
+    def __init__(self, predicate: PredicateBase):
+        self._predicate = predicate
+
+    def get_fields(self):
+        return self._predicate.get_fields()
+
+    def do_include(self, values):
+        return not self._predicate.do_include(values)
+
+
+class in_reduce(PredicateBase):
+    """Combine predicates with a reduce function (e.g. ``all``/``any`` over
+    the list of member decisions)."""
+
+    def __init__(self, predicate_list: Sequence[PredicateBase], reduce_func: Callable):
+        self._predicates = list(predicate_list)
+        self._reduce = reduce_func
+
+    def get_fields(self):
+        fields = set()
+        for p in self._predicates:
+            fields |= p.get_fields()
+        return fields
+
+    def do_include(self, values):
+        return self._reduce([p.do_include(values) for p in self._predicates])
+
+
+def _hash_bucket(value, num_buckets: int) -> int:
+    """Stable md5 bucketing of a value's string form (reference :39)."""
+    digest = hashlib.md5(str(value).encode("utf-8")).hexdigest()
+    return int(digest, 16) % num_buckets
+
+
+class in_pseudorandom_split(PredicateBase):
+    """Deterministic train/val/test splitting by hashing an id field.
+
+    :param fraction_list: split fractions summing to <= 1.0
+    :param subset_index: which split this predicate selects
+    :param predicate_field: the id field hashed for bucketing
+    """
+
+    _NUM_BUCKETS = 1 << 20
+
+    def __init__(self, fraction_list, subset_index: int, predicate_field: str):
+        if subset_index >= len(fraction_list):
+            raise ValueError("subset_index out of range")
+        self._field = predicate_field
+        cumulative = 0.0
+        bounds = []
+        for frac in fraction_list:
+            bounds.append((cumulative, cumulative + frac))
+            cumulative += frac
+        if cumulative > 1.0 + 1e-9:
+            raise ValueError(f"fractions sum to {cumulative} > 1")
+        self._low, self._high = bounds[subset_index]
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        u = _hash_bucket(values[self._field], self._NUM_BUCKETS) / self._NUM_BUCKETS
+        return self._low <= u < self._high

@@ -1,0 +1,456 @@
+"""Reader API: ``make_reader`` (petastorm row datasets) and
+``make_batch_reader`` (any Parquet store).
+
+A :class:`Reader` plans the dataset's row groups (predicate pushdown,
+index-selector pruning, multi-host sharding), feeds them through a worker
+pool behind a backpressured ventilator, and yields decoded samples:
+per-row namedtuples (``make_reader``) or namedtuples of numpy arrays, one
+per row group (``make_batch_reader``).
+
+TPU-first behaviors beyond the reference:
+
+* ``cur_shard="auto"`` derives the shard from ``jax.process_index()`` /
+  ``jax.process_count()`` so every TPU host reads a disjoint row-group slice
+  of the same seeded global order with zero configuration;
+* fully seeded determinism end-to-end (shard pre-shuffle, ventilation order,
+  in-group shuffling, round-robin readout) so multi-host input pipelines stay
+  in lockstep — a requirement for GSPMD global-batch assembly;
+* the columnar path keeps data in Arrow until the JAX loader stages it.
+
+Parity: reference petastorm/reader.py — ``make_reader`` (:60),
+``make_batch_reader`` (:209), ``Reader`` (:355), ``_filter_row_groups``
+(:533), ``_partition_row_groups`` (:573, ``index % shard_count == cur_shard``
+:596), ``_create_ventilator`` (:666), ``__next__`` (:708), ``reset`` (:503).
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+from collections import deque
+from typing import Optional
+
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.errors import MetadataError, NoDataAvailableError
+from petastorm_tpu.etl.dataset_metadata import (DatasetContext, get_schema,
+                                                infer_or_load_unischema,
+                                                load_row_groups)
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader_impl.batch_reader_worker import (BatchReaderWorker,
+                                                           arrow_table_to_numpy_dict)
+from petastorm_tpu.reader_impl.row_reader_worker import RowReaderWorker
+from petastorm_tpu.transform import transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
+from petastorm_tpu.workers_pool import EmptyResultError
+from petastorm_tpu.workers_pool.dummy_pool import DummyPool
+from petastorm_tpu.workers_pool.process_pool import ProcessPool
+from petastorm_tpu.workers_pool.thread_pool import ThreadPool
+from petastorm_tpu.workers_pool.ventilator import ConcurrentVentilator
+
+logger = logging.getLogger(__name__)
+
+# In-flight row groups beyond one per worker (reference reader.py:45).
+_VENTILATE_EXTRA_ROWGROUPS = 3
+
+
+def _resolve_shard(cur_shard, shard_count):
+    """``cur_shard="auto"`` -> this JAX process's (index, count)."""
+    if cur_shard == "auto":
+        import jax
+        return jax.process_index(), (shard_count or jax.process_count())
+    return cur_shard, shard_count
+
+
+def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
+               shuffle_rows, seed, zmq_copy_buffers=True):
+    if reader_pool_type == "thread":
+        return ThreadPool(workers_count, results_queue_size=results_queue_size,
+                          shuffle_rows=shuffle_rows, seed=seed)
+    if reader_pool_type == "process":
+        return ProcessPool(workers_count, serializer=serializer,
+                           zmq_copy_buffers=zmq_copy_buffers,
+                           results_queue_size=results_queue_size)
+    if reader_pool_type == "dummy":
+        return DummyPool()
+    raise ValueError(f"Unknown reader_pool_type {reader_pool_type!r} "
+                     f"(expected 'thread', 'process' or 'dummy')")
+
+
+def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
+                cache_extra_settings):
+    if cache_type in (None, "null"):
+        return NullCache()
+    if cache_type == "local-disk":
+        from petastorm_tpu.local_disk_cache import LocalDiskCache
+        return LocalDiskCache(cache_location, cache_size_limit,
+                              cache_row_size_estimate or 0,
+                              **(cache_extra_settings or {}))
+    raise ValueError(f"Unknown cache_type {cache_type!r}")
+
+
+def make_reader(dataset_url,
+                schema_fields=None,
+                reader_pool_type: str = "thread",
+                workers_count: int = 4,
+                results_queue_size: int = 50,
+                shuffle_row_groups: bool = True,
+                shuffle_rows: bool = False,
+                shuffle_row_drop_partitions: int = 1,
+                predicate=None,
+                rowgroup_selector=None,
+                num_epochs: Optional[int] = 1,
+                cur_shard=None,
+                shard_count: Optional[int] = None,
+                shard_seed: Optional[int] = None,
+                seed: Optional[int] = None,
+                cache_type: str = "null",
+                cache_location: Optional[str] = None,
+                cache_size_limit: Optional[int] = None,
+                cache_row_size_estimate: Optional[int] = None,
+                cache_extra_settings: Optional[dict] = None,
+                transform_spec=None,
+                storage_options: Optional[dict] = None,
+                filesystem=None,
+                zmq_copy_buffers: bool = True):
+    """Reader for **petastorm-written** datasets (codec-decoded rows).
+
+    :param schema_fields: list of UnischemaField / name regexes narrowing the
+        output, or an :class:`NGram` for windowed sequence readout
+    :param reader_pool_type: 'thread' | 'process' | 'dummy'
+    :param shuffle_row_groups: shuffle row-group order (seeded by ``seed``)
+    :param shuffle_rows: shuffle rows inside each row group
+    :param shuffle_row_drop_partitions: ventilate each row group N times,
+        each reading a different 1/N slice (decorrelates at memory cost)
+    :param num_epochs: passes over the dataset; ``None`` = infinite
+    :param cur_shard/shard_count: this process's shard; ``cur_shard="auto"``
+        derives both from the JAX distributed runtime
+    :param shard_seed: seed for pre-shard row-group shuffling
+    :param seed: master seed for all shuffling (determinism when set)
+
+    Parity: reference reader.py:60.
+    """
+    ctx = DatasetContext(dataset_url, storage_options=storage_options,
+                         filesystem=filesystem)
+    try:
+        stored_schema = get_schema(ctx)
+    except MetadataError as e:
+        raise MetadataError(
+            f"Dataset at {dataset_url} is missing petastorm metadata "
+            f"(underlying error: {e}). If this is a plain Parquet store, use "
+            f"make_batch_reader() instead.") from e
+
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, cache_extra_settings)
+
+    from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      PickleSerializer(), shuffle_rows, seed, zmq_copy_buffers)
+
+    return Reader(ctx, stored_schema,
+                  dataset_url_or_urls=dataset_url,
+                  schema_fields=schema_fields,
+                  worker_class=RowReaderWorker,
+                  pool=pool,
+                  is_batched_reader=False,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_rows=shuffle_rows,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate,
+                  rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs,
+                  cur_shard=cur_shard,
+                  shard_count=shard_count,
+                  shard_seed=shard_seed,
+                  seed=seed,
+                  cache=cache,
+                  transform_spec=transform_spec,
+                  storage_options=storage_options)
+
+
+def make_batch_reader(dataset_url_or_urls,
+                      schema_fields=None,
+                      reader_pool_type: str = "thread",
+                      workers_count: int = 4,
+                      results_queue_size: int = 50,
+                      shuffle_row_groups: bool = True,
+                      shuffle_rows: bool = False,
+                      shuffle_row_drop_partitions: int = 1,
+                      predicate=None,
+                      num_epochs: Optional[int] = 1,
+                      cur_shard=None,
+                      shard_count: Optional[int] = None,
+                      shard_seed: Optional[int] = None,
+                      seed: Optional[int] = None,
+                      cache_type: str = "null",
+                      cache_location: Optional[str] = None,
+                      cache_size_limit: Optional[int] = None,
+                      cache_row_size_estimate: Optional[int] = None,
+                      cache_extra_settings: Optional[dict] = None,
+                      transform_spec=None,
+                      storage_options: Optional[dict] = None,
+                      filesystem=None,
+                      zmq_copy_buffers: bool = True):
+    """Columnar reader for **any** Parquet store (one numpy batch per row
+    group; batch size = row-group size).
+
+    ``schema_fields`` is a list of column names or name regexes.
+    Parity: reference reader.py:209.
+    """
+    ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
+                         filesystem=filesystem)
+    schema = infer_or_load_unischema(ctx)
+
+    if isinstance(schema_fields, NGram):
+        raise ValueError("NGram is not supported by make_batch_reader; use make_reader")
+
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, cache_extra_settings)
+
+    from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      ArrowTableSerializer(), shuffle_rows, seed, zmq_copy_buffers)
+
+    return Reader(ctx, schema,
+                  dataset_url_or_urls=dataset_url_or_urls,
+                  schema_fields=schema_fields,
+                  worker_class=BatchReaderWorker,
+                  pool=pool,
+                  is_batched_reader=True,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_rows=shuffle_rows,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  predicate=predicate,
+                  rowgroup_selector=None,
+                  num_epochs=num_epochs,
+                  cur_shard=cur_shard,
+                  shard_count=shard_count,
+                  shard_seed=shard_seed,
+                  seed=seed,
+                  cache=cache,
+                  transform_spec=transform_spec,
+                  storage_options=storage_options)
+
+
+class Reader:
+    """Iterator over dataset samples. Context manager; supports ``reset()``
+    after an epoch ends, ``stop()``/``join()`` for shutdown, and
+    ``diagnostics`` for queue introspection."""
+
+    def __init__(self, ctx: DatasetContext, stored_schema: Unischema, *,
+                 dataset_url_or_urls, schema_fields, worker_class, pool,
+                 is_batched_reader, shuffle_row_groups, shuffle_rows,
+                 shuffle_row_drop_partitions, predicate, rowgroup_selector,
+                 num_epochs, cur_shard, shard_count, shard_seed, seed, cache,
+                 transform_spec, storage_options):
+        self._ctx = ctx
+        self._pool = pool
+        self.is_batched_reader = is_batched_reader
+        self.last_row_consumed = False
+        self._error = None
+
+        cur_shard, shard_count = _resolve_shard(cur_shard, shard_count)
+        if (cur_shard is None) != (shard_count is None):
+            raise ValueError("cur_shard and shard_count must be used together")
+        if cur_shard is not None and not (0 <= cur_shard < shard_count):
+            raise ValueError(f"cur_shard {cur_shard} out of range [0, {shard_count})")
+
+        # ---------------- schema views
+        self.ngram: Optional[NGram] = None
+        if isinstance(schema_fields, NGram):
+            self.ngram = schema_fields
+            self.ngram.resolve_regex_field_names(stored_schema)
+            view_schema = stored_schema
+        elif schema_fields is not None:
+            view_schema = stored_schema.create_schema_view(schema_fields)
+        else:
+            view_schema = stored_schema
+
+        if self.ngram is not None and not self.ngram.timestamp_overlap \
+                and shuffle_row_drop_partitions > 1:
+            raise NotImplementedError("shuffle_row_drop_partitions with "
+                                      "non-overlapping ngrams is not supported")
+
+        self._stored_schema = stored_schema
+        if transform_spec is not None:
+            self.schema = transform_schema(view_schema, transform_spec)
+        else:
+            self.schema = view_schema
+
+        # ---------------- row-group planning
+        all_row_groups = load_row_groups(ctx)
+        filtered = self._filter_row_groups(all_row_groups, predicate,
+                                           rowgroup_selector, cur_shard,
+                                           shard_count, shard_seed)
+        if not filtered:
+            raise NoDataAvailableError(
+                "No row groups left after predicate/selector/shard filtering. "
+                f"(dataset has {len(all_row_groups)} row groups; "
+                f"cur_shard={cur_shard}, shard_count={shard_count})")
+        logger.debug("Reading %d/%d row groups", len(filtered), len(all_row_groups))
+
+        # ---------------- ventilation items
+        items = []
+        for rg in filtered:
+            for part in range(shuffle_row_drop_partitions):
+                items.append({"rowgroup": rg,
+                              "shuffle_row_drop_partition": (part, shuffle_row_drop_partitions)})
+
+        worker_args = {
+            "dataset_url_or_urls": dataset_url_or_urls,
+            "storage_options": storage_options,
+            "schema": stored_schema,
+            "view_schema": view_schema,
+            "output_schema": self.schema,
+            "ngram": self.ngram,
+            "predicate": predicate,
+            "transform_spec": transform_spec,
+            "cache": cache,
+            "shuffle_rows": shuffle_rows,
+            "seed": seed,
+        }
+
+        self._ventilator = ConcurrentVentilator(
+            self._pool.ventilate, items,
+            iterations=num_epochs,
+            randomize_item_order=shuffle_row_groups,
+            random_seed=seed,
+            max_ventilation_queue_size=self._pool.workers_count * (1 + _VENTILATE_EXTRA_ROWGROUPS))
+        self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
+
+        if is_batched_reader:
+            self._results_reader = _BatchResultsReader(self._pool, self.schema)
+        else:
+            self._results_reader = _RowResultsReader(self._pool, self.schema, self.ngram)
+
+    # ------------------------------------------------------------- planning
+    def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
+                           cur_shard, shard_count, shard_seed):
+        filtered = list(row_groups)
+        if predicate is not None:
+            filtered = self._apply_partition_predicate(filtered, predicate)
+        if rowgroup_selector is not None:
+            filtered = self._apply_selector(row_groups, filtered, rowgroup_selector)
+        if cur_shard is not None:
+            filtered = self._partition_row_groups(filtered, cur_shard, shard_count,
+                                                  shard_seed)
+        return filtered
+
+    @staticmethod
+    def _apply_partition_predicate(row_groups, predicate):
+        """When every predicate field is a hive partition key, whole row
+        groups are pruned at planning time (reference reader.py:620)."""
+        fields = predicate.get_fields()
+        if not row_groups:
+            return row_groups
+        partition_keys = {k for k, _ in row_groups[0].partition_values}
+        if not fields or not fields.issubset(partition_keys):
+            return row_groups
+        return [rg for rg in row_groups if predicate.do_include(rg.partition_dict)]
+
+    def _apply_selector(self, all_row_groups, filtered, selector):
+        from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+        indexes = get_row_group_indexes(self._ctx)
+        for name in selector.get_index_names():
+            if name not in indexes:
+                raise ValueError(f"Index {name!r} not found in dataset metadata "
+                                 f"(available: {sorted(indexes)})")
+        selected_ordinals = selector.select_row_groups(indexes)
+        # Ordinals refer to the unfiltered, deterministic row-group order.
+        selected = {id(all_row_groups[i]) for i in selected_ordinals
+                    if i < len(all_row_groups)}
+        return [rg for rg in filtered if id(rg) in selected]
+
+    @staticmethod
+    def _partition_row_groups(row_groups, cur_shard, shard_count, shard_seed):
+        """Deterministic ``index % shard_count == cur_shard`` sharding, with
+        an optional seeded pre-shuffle (reference reader.py:573-597)."""
+        if shard_seed is not None:
+            import random
+            rng = random.Random(shard_seed)
+            row_groups = list(row_groups)
+            rng.shuffle(row_groups)
+        shard = [rg for i, rg in enumerate(row_groups) if i % shard_count == cur_shard]
+        if not shard:
+            raise NoDataAvailableError(
+                f"Shard {cur_shard}/{shard_count} received zero row groups "
+                f"({len(row_groups)} total). Use fewer shards or larger datasets.")
+        return shard
+
+    # ------------------------------------------------------------ iteration
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            sample = self._results_reader.read_next()
+            return sample
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        """Start another pass. Only legal after the current pass finished
+        (parity: reference reader.py:503-527)."""
+        if not self.last_row_consumed:
+            raise RuntimeError(
+                "reset() is only supported after the previous pass was fully consumed")
+        self._ventilator.reset()
+        self.last_row_consumed = False
+
+    # ------------------------------------------------------------- lifetime
+    def stop(self):
+        self._pool.stop()
+
+    def join(self):
+        self._pool.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()
+        return False
+
+    @property
+    def diagnostics(self):
+        return self._pool.diagnostics
+
+    @property
+    def batched_output(self):
+        return self.is_batched_reader
+
+
+class _RowResultsReader:
+    """Buffers published row lists; yields one namedtuple (or ngram dict of
+    namedtuples) per ``read_next`` (parity: py_dict_reader_worker.py:64-97)."""
+
+    def __init__(self, pool, schema, ngram):
+        self._pool = pool
+        self._schema = schema
+        self._ngram = ngram
+        self._buffer = deque()
+
+    def read_next(self):
+        while not self._buffer:
+            self._buffer.extend(self._pool.get_results())
+        item = self._buffer.popleft()
+        if self._ngram is not None:
+            return item  # already {offset: namedtuple}
+        return self._schema.make_namedtuple_from_dict(item)
+
+
+class _BatchResultsReader:
+    """Yields one namedtuple-of-numpy-arrays per row group
+    (parity: arrow_reader_worker.py:89-111, batched_output=True)."""
+
+    def __init__(self, pool, schema):
+        self._pool = pool
+        self._schema = schema
+
+    def read_next(self):
+        table = self._pool.get_results()
+        numpy_dict = arrow_table_to_numpy_dict(table, self._schema)
+        return self._schema.make_namedtuple_from_dict(numpy_dict)

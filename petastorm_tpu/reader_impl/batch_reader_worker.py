@@ -1,0 +1,151 @@
+"""Columnar reader worker: one row group -> one pyarrow Table.
+
+The ``make_batch_reader`` hot path for plain Parquet stores. Stays columnar
+end-to-end: reads the row group as an Arrow table, evaluates predicates
+vectorized over pandas, applies the TransformSpec to the whole row-group
+DataFrame, and publishes an Arrow table (which the Arrow-IPC serializer moves
+across the process boundary without a row loop; the consumer converts it to
+a namedtuple of numpy arrays ready for device staging).
+
+Parity: reference petastorm/arrow_reader_worker.py — ``ArrowReaderWorker``
+(:117), ``process`` (:150), ``_load_rows`` (:240), ``_load_rows_with_predicate``
+(:286), ``_read_with_shuffle_row_drop`` (:354).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.reader_impl.row_reader_worker import (_ParquetFileLRU,
+                                                         select_drop_partition)
+from petastorm_tpu.workers_pool.worker_base import WorkerBase
+
+
+class BatchReaderWorker(WorkerBase):
+    """``args`` dict keys: as :class:`RowReaderWorker` minus codecs/ngram
+    (plain Parquet has neither), plus the same cache/shuffle/predicate."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._ctx = None
+        self._files = None
+        self._rng = np.random.default_rng(
+            None if args.get("seed") is None else args["seed"] + worker_id)
+
+    def _ensure_open(self):
+        if self._ctx is None:
+            from petastorm_tpu.etl.dataset_metadata import DatasetContext
+            self._ctx = DatasetContext(self.args["dataset_url_or_urls"],
+                                       storage_options=self.args.get("storage_options"))
+            self._files = _ParquetFileLRU(self._ctx.filesystem)
+        return self._ctx
+
+    def process(self, rowgroup, shuffle_row_drop_partition=(0, 1)):
+        self._ensure_open()
+        view_schema = self.args["view_schema"]
+        predicate = self.args.get("predicate")
+        transform_spec = self.args.get("transform_spec")
+        cache = self.args.get("cache")
+
+        needed = set(view_schema.fields.keys())
+        if predicate is not None:
+            needed_with_pred = needed | set(predicate.get_fields())
+        else:
+            needed_with_pred = needed
+
+        if cache is not None:
+            key = self._cache_key(rowgroup, needed_with_pred, shuffle_row_drop_partition)
+            table = cache.get(key, lambda: self._load_table(
+                rowgroup, needed_with_pred, predicate, shuffle_row_drop_partition))
+        else:
+            table = self._load_table(rowgroup, needed_with_pred, predicate,
+                                     shuffle_row_drop_partition)
+        if table is None or table.num_rows == 0:
+            return
+
+        if transform_spec is not None and transform_spec.func is not None:
+            df = table.to_pandas()
+            df = transform_spec.func(df)
+            table = pa.Table.from_pandas(df, preserve_index=False)
+
+        # Narrow to the output view (post-transform schema).
+        out_schema = self.args.get("output_schema", view_schema)
+        keep = [n for n in table.column_names if n in out_schema.fields]
+        table = table.select(keep)
+        self.publish_func(table)
+
+    # ------------------------------------------------------------ internals
+    def _cache_key(self, rowgroup, columns, drop_part) -> str:
+        import hashlib
+        url = self.args["dataset_url_or_urls"]
+        url = url if isinstance(url, str) else "|".join(url)
+        h = hashlib.md5(url.encode()).hexdigest()
+        return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}:{drop_part}"
+
+    def _read_table(self, rowgroup, columns) -> pa.Table:
+        pf = self._files.get(rowgroup.path)
+        file_cols = [c for c in sorted(columns) if c in set(pf.schema_arrow.names)]
+        table = pf.read_row_group(rowgroup.row_group, columns=file_cols)
+        # Surface hive partition keys as constant columns when requested.
+        for key, value in rowgroup.partition_values:
+            if key in columns and key not in table.column_names:
+                table = table.append_column(
+                    key, pa.array([value] * table.num_rows))
+        return table
+
+    def _load_table(self, rowgroup, needed, predicate, drop_part):
+        part_index, num_parts = drop_part
+        if predicate is not None:
+            pred_fields = sorted(predicate.get_fields())
+            pred_table = self._read_table(rowgroup, set(pred_fields))
+            df = pred_table.to_pandas()
+            mask = df.apply(lambda r: predicate.do_include(r.to_dict()), axis=1).values \
+                if len(df) else np.array([], dtype=bool)
+            if not mask.any():
+                return None
+            rest = needed - set(pred_fields)
+            if rest:
+                rest_table = self._read_table(rowgroup, rest)
+                for name in rest_table.column_names:
+                    pred_table = pred_table.append_column(name, rest_table.column(name))
+            keep = [n for n in pred_table.column_names if n in needed]
+            table = pred_table.select(keep).filter(pa.array(mask))
+        else:
+            table = self._read_table(rowgroup, needed)
+
+        indices = select_drop_partition(table.num_rows, part_index, num_parts,
+                                        self.args.get("shuffle_rows", False), self._rng)
+        if num_parts > 1 or self.args.get("shuffle_rows", False):
+            table = table.take(pa.array(indices))
+        return table
+
+
+def arrow_table_to_numpy_dict(table: pa.Table, schema) -> dict:
+    """Convert an Arrow table to ``{name: numpy array}``, reassembling
+    list-columns into fixed-shape matrices per the schema's declared shapes
+    (parity: reference arrow_reader_worker.py:31-75)."""
+    out = {}
+    for name in table.column_names:
+        col = table.column(name)
+        field = schema.fields.get(name)
+        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+            rows = col.to_pylist()
+            value_dtype = None
+            if field is not None and not isinstance(field.numpy_dtype, type):
+                value_dtype = np.dtype(field.numpy_dtype)
+            arrays = [np.asarray(r, dtype=value_dtype) for r in rows]
+            if field is not None and field.shape and all(d is not None for d in field.shape):
+                stacked = np.vstack([a.reshape(-1) for a in arrays]) if arrays \
+                    else np.empty((0,), dtype=value_dtype)
+                out[name] = stacked.reshape((len(arrays),) + tuple(field.shape))
+            else:
+                obj = np.empty(len(arrays), dtype=object)
+                for i, a in enumerate(arrays):
+                    obj[i] = a
+                out[name] = obj
+        else:
+            try:
+                out[name] = col.to_numpy(zero_copy_only=False)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out

@@ -1,0 +1,74 @@
+"""Row-group selectors: prune row groups using stored inverted indexes.
+
+Parity: reference petastorm/selectors.py — ``RowGroupSelectorBase`` (:20),
+``SingleIndexSelector`` (:32), ``IntersectIndexSelector`` (:53),
+``UnionIndexSelector`` (:78).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+
+class RowGroupSelectorBase:
+    def get_index_names(self) -> Sequence[str]:
+        """Names of the stored indexes this selector needs."""
+        raise NotImplementedError
+
+    def select_row_groups(self, index_dict) -> set:
+        """Return the set of selected row-group ordinals given
+        ``{index_name: RowGroupIndexBase}``."""
+        raise NotImplementedError
+
+
+class SingleIndexSelector(RowGroupSelectorBase):
+    """Row groups containing any of ``values_list`` in the named index."""
+
+    def __init__(self, index_name: str, values_list):
+        self._index_name = index_name
+        self._values = list(values_list)
+
+    def get_index_names(self):
+        return [self._index_name]
+
+    def select_row_groups(self, index_dict):
+        indexer = index_dict[self._index_name]
+        selected = set()
+        for v in self._values:
+            selected |= set(indexer.get_row_group_indexes(v))
+        return selected
+
+
+class IntersectIndexSelector(RowGroupSelectorBase):
+    """Row groups selected by *all* member selectors."""
+
+    def __init__(self, selectors: Sequence[SingleIndexSelector]):
+        self._selectors = list(selectors)
+
+    def get_index_names(self):
+        names = []
+        for s in self._selectors:
+            names.extend(s.get_index_names())
+        return names
+
+    def select_row_groups(self, index_dict):
+        sets = [s.select_row_groups(index_dict) for s in self._selectors]
+        return set.intersection(*sets) if sets else set()
+
+
+class UnionIndexSelector(RowGroupSelectorBase):
+    """Row groups selected by *any* member selector."""
+
+    def __init__(self, selectors: Sequence[SingleIndexSelector]):
+        self._selectors = list(selectors)
+
+    def get_index_names(self):
+        names = []
+        for s in self._selectors:
+            names.extend(s.get_index_names())
+        return names
+
+    def select_row_groups(self, index_dict):
+        result = set()
+        for s in self._selectors:
+            result |= s.select_row_groups(index_dict)
+        return result

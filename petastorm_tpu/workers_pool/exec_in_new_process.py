@@ -23,8 +23,9 @@ def exec_in_new_process(func, *args, **kwargs) -> subprocess.Popen:
     with os.fdopen(fd, "wb") as f:
         dill.dump((func, args, kwargs), f, recurse=False)
     env = dict(os.environ)
-    # Workers must never initialize a TPU backend; pin them to host CPU.
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Workers must never initialize a TPU backend; pin them to host CPU even
+    # when the parent exported JAX_PLATFORMS=tpu.
+    env["JAX_PLATFORMS"] = "cpu"
     return subprocess.Popen(
         [sys.executable, "-m", "petastorm_tpu.workers_pool.exec_in_new_process_entrypoint",
          payload_path],

@@ -94,7 +94,7 @@ class ThreadPool:
         self.workers_count = workers_count
         self._results_queue_size = results_queue_size
         self._profiling_enabled = profiling_enabled
-        self._strict_order = not (shuffle_rows and not seed)
+        self._strict_order = not (shuffle_rows and seed is None)
         self._stop_event = threading.Event()
         self._workers = []
         self._input_queues = []

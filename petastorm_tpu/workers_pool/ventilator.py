@@ -95,7 +95,10 @@ class ConcurrentVentilator(Ventilator):
             self._inflight_cv.notify_all()
 
     def completed(self) -> bool:
-        return self._completed_event.is_set()
+        # A stopped ventilator will never ventilate again: report completed
+        # so consumers drain and raise EmptyResultError instead of spinning
+        # (parity: reference ventilator.py:124-126 includes _stop_requested).
+        return self._completed_event.is_set() or self._stop_event.is_set()
 
     def stop(self):
         self._stop_event.set()
@@ -130,6 +133,9 @@ class ConcurrentVentilator(Ventilator):
         return items
 
     def _ventilate_loop(self):
+        if not self._items:
+            self._completed_event.set()
+            return
         iterations_left = self._iterations_total
         while not self._stop_event.is_set():
             if iterations_left is not None and iterations_left <= 0:

@@ -20,3 +20,26 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def synthetic_dataset(tmp_path_factory):
+    """Session-scoped synthetic petastorm dataset (100 rows, 10 row groups)
+    — parity with reference conftest.py:89."""
+    from dataset_utils import create_test_dataset
+    path = tmp_path_factory.mktemp("synthetic")
+    url = f"file://{path}/ds"
+    rows = create_test_dataset(url, num_rows=100, rows_per_row_group=10)
+    return type("SyntheticDataset", (), {"url": url, "rows": rows,
+                                         "path": f"{path}/ds"})
+
+
+@pytest.fixture(scope="session")
+def scalar_dataset(tmp_path_factory):
+    """Session-scoped plain (non-petastorm) Parquet store — parity with
+    reference conftest.py:101."""
+    from dataset_utils import create_test_scalar_dataset
+    path = tmp_path_factory.mktemp("scalar")
+    url = f"file://{path}/ds"
+    data = create_test_scalar_dataset(url, num_rows=100, row_group_size=10)
+    return type("ScalarDataset", (), {"url": url, "data": data})

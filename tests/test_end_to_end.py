@@ -1,0 +1,258 @@
+"""End-to-end reader tests over the synthetic dataset
+(strategy parity: reference petastorm/tests/test_end_to_end.py)."""
+import numpy as np
+import pytest
+
+from dataset_utils import TestSchema, rows_equal
+from petastorm_tpu.errors import MetadataError, NoDataAvailableError
+from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split, in_set
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.unischema import UnischemaField
+
+# Dummy is the fast flavor used for most assertions; thread covers
+# concurrency; process runs in its own marked tests (slow spawn).
+MINIMAL_FLAVORS = ["dummy"]
+ALL_FLAVORS = ["dummy", "thread"]
+
+
+def _read_all(reader):
+    return list(reader)
+
+
+@pytest.mark.parametrize("pool", ALL_FLAVORS)
+def test_simple_read_roundtrip(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url, reader_pool_type=pool,
+                     workers_count=3, shuffle_row_groups=False) as reader:
+        samples = _read_all(reader)
+    assert len(samples) == 100
+    by_id = {s.id: s for s in samples}
+    assert set(by_id) == set(range(100))
+    for expected in synthetic_dataset.rows[:5]:
+        assert rows_equal(by_id[expected["id"]],
+                          {k: v for k, v in expected.items()})
+    # nullable field: missing rows come back as None
+    assert by_id[1].nullable_int is None
+    assert by_id[0].nullable_int == 0
+    # dtypes survive decode
+    assert by_id[3].image_png.dtype == np.uint8
+    assert by_id[3].matrix.dtype == np.float32
+    assert by_id[3].matrix_uint16.dtype == np.uint16
+
+
+@pytest.mark.process_pool
+def test_simple_read_process_pool(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type="process",
+                     workers_count=2, shuffle_row_groups=False) as reader:
+        samples = _read_all(reader)
+    assert {s.id for s in samples} == set(range(100))
+    assert samples[0].image_png.shape == (32, 16, 3)
+
+
+@pytest.mark.parametrize("pool", MINIMAL_FLAVORS)
+def test_schema_field_narrowing_by_regex(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url, schema_fields=["id.*"],
+                     reader_pool_type=pool, shuffle_row_groups=False) as reader:
+        sample = next(reader)
+    assert set(sample._fields) == {"id", "id2"}
+
+
+def test_schema_field_narrowing_by_field_objects(synthetic_dataset):
+    with make_reader(synthetic_dataset.url,
+                     schema_fields=[TestSchema.id, TestSchema.matrix],
+                     shuffle_row_groups=False) as reader:
+        sample = next(reader)
+    assert set(sample._fields) == {"id", "matrix"}
+
+
+@pytest.mark.parametrize("pool", ALL_FLAVORS)
+def test_worker_predicate(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url,
+                     predicate=in_lambda(["id"], lambda row: row["id"] % 2 == 0),
+                     reader_pool_type=pool, shuffle_row_groups=False) as reader:
+        ids = sorted(s.id for s in reader)
+    assert ids == [i for i in range(100) if i % 2 == 0]
+
+
+def test_predicate_on_partition_key(synthetic_dataset):
+    with make_reader(synthetic_dataset.url,
+                     predicate=in_set({"p_1"}, "partition_key"),
+                     shuffle_row_groups=False) as reader:
+        samples = _read_all(reader)
+    assert samples
+    assert all(s.partition_key == "p_1" for s in samples)
+    assert sorted(s.id for s in samples) == [i for i in range(100) if i % 4 == 1]
+
+
+def test_pseudorandom_split_disjoint_and_complete(synthetic_dataset):
+    all_ids = []
+    for subset in range(2):
+        pred = in_pseudorandom_split([0.5, 0.5], subset, "id")
+        with make_reader(synthetic_dataset.url, predicate=pred,
+                         shuffle_row_groups=False) as reader:
+            all_ids.append({s.id for s in reader})
+    assert all_ids[0].isdisjoint(all_ids[1])
+    assert all_ids[0] | all_ids[1] == set(range(100))
+    assert 20 < len(all_ids[0]) < 80  # roughly balanced
+
+
+def test_sharding_disjoint_and_complete(synthetic_dataset):
+    """Every shard reads a disjoint subset; union over shards is complete
+    (parity: reference test_partition_multi_node:511)."""
+    shard_ids = []
+    for shard in range(3):
+        with make_reader(synthetic_dataset.url, cur_shard=shard, shard_count=3,
+                         shuffle_row_groups=False) as reader:
+            shard_ids.append({s.id for s in reader})
+    union = set()
+    for ids in shard_ids:
+        assert ids, "every shard must receive rows"
+        assert union.isdisjoint(ids)
+        union |= ids
+    assert union == set(range(100))
+
+
+def test_too_many_shards_raises(synthetic_dataset):
+    with pytest.raises(NoDataAvailableError):
+        make_reader(synthetic_dataset.url, cur_shard=11, shard_count=1000)
+
+
+def test_shard_args_validation(synthetic_dataset):
+    with pytest.raises(ValueError, match="together"):
+        make_reader(synthetic_dataset.url, cur_shard=0)
+    with pytest.raises(ValueError, match="out of range"):
+        make_reader(synthetic_dataset.url, cur_shard=5, shard_count=3)
+
+
+def test_shuffle_changes_order_and_seed_fixes_it(synthetic_dataset):
+    orders = []
+    for seed in (17, 17, 18):
+        with make_reader(synthetic_dataset.url, shuffle_row_groups=True,
+                         seed=seed, reader_pool_type="dummy") as reader:
+            orders.append([s.id for s in reader])
+    assert orders[0] == orders[1]          # same seed -> identical order
+    assert orders[0] != orders[2]          # different seed -> different order
+    assert sorted(orders[0]) == list(range(100))
+
+
+def test_unshuffled_dummy_order_is_sequential(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        ids = [s.id for s in reader]
+    assert ids == list(range(100))
+
+
+def test_shuffle_rows_within_groups(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                     shuffle_rows=True, seed=3,
+                     reader_pool_type="dummy") as reader:
+        ids = [s.id for s in reader]
+    assert ids != list(range(100))
+    assert sorted(ids) == list(range(100))
+    # rows stay within their group of 10
+    for start in range(0, 100, 10):
+        assert sorted(ids[start:start + 10]) == list(range(start, start + 10))
+
+
+def test_shuffle_row_drop_partitions(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                     shuffle_row_drop_partitions=2,
+                     reader_pool_type="dummy") as reader:
+        ids = [s.id for s in reader]
+    assert sorted(ids) == list(range(100))  # everything still read once
+
+
+@pytest.mark.parametrize("pool", ALL_FLAVORS)
+def test_multiple_epochs(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url, num_epochs=3,
+                     shuffle_row_groups=False, reader_pool_type=pool) as reader:
+        ids = [s.id for s in reader]
+    assert len(ids) == 300
+    assert sorted(ids) == sorted(list(range(100)) * 3)
+
+
+def test_reset_after_epoch(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        first = [s.id for s in reader]
+        reader.reset()
+        second = [s.id for s in reader]
+    assert first == second == list(range(100))
+
+
+def test_reset_mid_epoch_rejected(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy") as reader:
+        next(reader)
+        with pytest.raises(RuntimeError, match="fully consumed"):
+            reader.reset()
+
+
+def test_transform_spec_row_path(synthetic_dataset):
+    def double_id(row):
+        row = dict(row)
+        row["id_doubled"] = np.int64(row["id"] * 2)
+        del row["matrix"]
+        return row
+
+    spec = TransformSpec(double_id,
+                         edit_fields=[UnischemaField("id_doubled", np.int64, ())],
+                         removed_fields=["matrix"])
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "matrix"],
+                     transform_spec=spec, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        sample = next(reader)
+    assert set(sample._fields) == {"id", "id_doubled"}
+    assert sample.id_doubled == sample.id * 2
+
+
+def test_ngram_not_supported_in_batch_reader(scalar_dataset):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader import make_batch_reader
+    ngram = NGram({0: ["id"]}, delta_threshold=1, timestamp_field="id")
+    with pytest.raises(ValueError, match="NGram"):
+        make_batch_reader(scalar_dataset.url, schema_fields=ngram)
+
+
+def test_make_reader_on_plain_parquet_suggests_batch_reader(scalar_dataset):
+    with pytest.raises(MetadataError, match="make_batch_reader"):
+        make_reader(scalar_dataset.url)
+
+
+def test_local_disk_cache_round(synthetic_dataset, tmp_path):
+    kwargs = dict(cache_type="local-disk", cache_location=str(tmp_path / "cache"),
+                  cache_size_limit=1 << 30, shuffle_row_groups=False,
+                  reader_pool_type="dummy", schema_fields=["id"])
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        first = [s.id for s in reader]
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        second = [s.id for s in reader]
+    assert first == second == list(range(100))
+    from petastorm_tpu.local_disk_cache import LocalDiskCache
+    cache = LocalDiskCache(str(tmp_path / "cache"), 1 << 30)
+    assert len(cache) == 10  # one entry per row group
+    cache.cleanup()
+
+
+def test_weighted_sampling_mix(synthetic_dataset):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, schema_fields=["id"], num_epochs=None,
+                     shuffle_row_groups=False, reader_pool_type="dummy")
+    r2 = make_reader(synthetic_dataset.url, schema_fields=["id"], num_epochs=None,
+                     shuffle_row_groups=False, reader_pool_type="dummy")
+    with WeightedSamplingReader([r1, r2], [0.8, 0.2], seed=0) as mixer:
+        samples = [next(mixer) for _ in range(50)]
+    assert len(samples) == 50
+
+
+def test_weighted_sampling_schema_mismatch(synthetic_dataset):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     reader_pool_type="dummy")
+    r2 = make_reader(synthetic_dataset.url, schema_fields=["id2"],
+                     reader_pool_type="dummy")
+    try:
+        with pytest.raises(ValueError, match="same output schema"):
+            WeightedSamplingReader([r1, r2], [0.5, 0.5])
+    finally:
+        for r in (r1, r2):
+            r.stop(); r.join()

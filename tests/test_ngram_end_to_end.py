@@ -1,0 +1,112 @@
+"""NGram end-to-end tests with purpose-built timestamped datasets
+(strategy parity: reference test_ngram_end_to_end.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SeqSchema = Unischema("SeqSchema", [
+    UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField("value", np.float32, (2,), NdarrayCodec(), False),
+    UnischemaField("label", np.int32, (), ScalarCodec(np.int32), False),
+])
+
+
+@pytest.fixture(scope="module")
+def seq_dataset(tmp_path_factory):
+    """20 rows, one row group, timestamps 0..19 with a gap at 10->15."""
+    path = tmp_path_factory.mktemp("seq")
+    url = f"file://{path}/ds"
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(20):
+        ts = i if i <= 10 else i + 4  # gap of 5 between ts=10 and ts=15
+        rows.append({"ts": ts, "value": rng.normal(size=2).astype(np.float32),
+                     "label": np.int32(i)})
+    with materialize_dataset_local(url, SeqSchema, rows_per_row_group=20) as w:
+        w.write_rows(rows)
+    return url
+
+
+def test_basic_window(seq_dataset):
+    ngram = NGram({0: ["ts", "value"], 1: ["ts", "value"]},
+                  delta_threshold=1, timestamp_field="ts")
+    with make_reader(seq_dataset, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    # timestamps 0..10 give 10 consecutive pairs; 15..23 give 8 pairs.
+    assert len(windows) == 18
+    for w in windows:
+        assert set(w.keys()) == {0, 1}
+        assert w[1].ts - w[0].ts == 1
+        assert w[0].value.shape == (2,)
+
+
+def test_delta_threshold_drops_gap_windows(seq_dataset):
+    loose = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=100, timestamp_field="ts")
+    with make_reader(seq_dataset, schema_fields=loose, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        n_loose = len(list(reader))
+    assert n_loose == 19  # every adjacent pair, gap included
+
+
+def test_window_length_three_with_offset_fields(seq_dataset):
+    ngram = NGram({0: ["ts", "value"], 1: ["ts"], 2: ["ts", "label"]},
+                  delta_threshold=1, timestamp_field="ts")
+    with make_reader(seq_dataset, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    for w in windows:
+        assert set(w.keys()) == {0, 1, 2}
+        assert set(w[0]._fields) == {"ts", "value"}
+        assert set(w[1]._fields) == {"ts"}
+        assert set(w[2]._fields) == {"ts", "label"}
+        assert w[2].ts - w[0].ts == 2
+
+
+def test_non_overlapping_windows(seq_dataset):
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    with make_reader(seq_dataset, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    seen_ts = [w[k].ts for w in windows for k in (0, 1)]
+    assert len(seen_ts) == len(set(seen_ts))  # no row reused
+
+
+def test_ngram_regex_fields(seq_dataset):
+    ngram = NGram({0: ["ts", "val.*"], 1: ["ts"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    with make_reader(seq_dataset, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        w = next(reader)
+    assert set(w[0]._fields) == {"ts", "value"}
+
+
+def test_ngram_validation():
+    with pytest.raises(ValueError, match="consecutive"):
+        NGram({0: ["a"], 2: ["a"]}, delta_threshold=1, timestamp_field="a")
+    with pytest.raises(ValueError, match="non-empty"):
+        NGram({}, delta_threshold=1, timestamp_field="a")
+
+
+def test_ngram_windows_never_cross_row_groups(tmp_path):
+    """Rows in different row groups never share a window."""
+    url = f"file://{tmp_path}/ds"
+    rng = np.random.default_rng(0)
+    rows = [{"ts": i, "value": rng.normal(size=2).astype(np.float32),
+             "label": np.int32(i)} for i in range(20)]
+    with materialize_dataset_local(url, SeqSchema, rows_per_row_group=5) as w:
+        w.write_rows(rows)
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    # 4 groups of 5 rows -> 4 per group = 16 windows (not 19)
+    assert len(windows) == 16
+    for w in windows:
+        assert w[0].ts // 5 == w[1].ts // 5

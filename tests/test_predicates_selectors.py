@@ -1,0 +1,112 @@
+"""Predicate unit tests + row-group selector/indexing end-to-end
+(strategy parity: reference test_predicates.py + rowgroup indexing suites)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.etl.dataset_metadata import DatasetContext
+from petastorm_tpu.etl.rowgroup_indexers import (FieldNotNullIndexer,
+                                                 SingleFieldIndexer)
+from petastorm_tpu.etl.rowgroup_indexing import (build_rowgroup_index,
+                                                 get_row_group_indexes)
+from petastorm_tpu.predicates import (in_intersection, in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.selectors import (IntersectIndexSelector,
+                                     SingleIndexSelector, UnionIndexSelector)
+
+
+# ------------------------------------------------------------- predicates
+def test_in_set():
+    p = in_set({1, 2}, "x")
+    assert p.get_fields() == {"x"}
+    assert p.do_include({"x": 1}) and not p.do_include({"x": 3})
+
+
+def test_in_intersection():
+    p = in_intersection({1, 2}, "x")
+    assert p.do_include({"x": [2, 5]}) and not p.do_include({"x": [7]})
+
+
+def test_in_negate_and_reduce():
+    p = in_reduce([in_set({1}, "x"), in_set({2}, "y")], all)
+    assert p.get_fields() == {"x", "y"}
+    assert p.do_include({"x": 1, "y": 2})
+    assert not p.do_include({"x": 1, "y": 3})
+    q = in_negate(p)
+    assert q.do_include({"x": 1, "y": 3})
+    r = in_reduce([in_set({1}, "x"), in_set({2}, "y")], any)
+    assert r.do_include({"x": 0, "y": 2})
+
+
+def test_in_lambda_with_state():
+    p = in_lambda(["x"], lambda row, state: row["x"] in state, {4, 5})
+    assert p.do_include({"x": 4}) and not p.do_include({"x": 6})
+
+
+def test_pseudorandom_split_stability():
+    p0 = in_pseudorandom_split([0.3, 0.7], 0, "id")
+    decisions = [p0.do_include({"id": i}) for i in range(1000)]
+    assert decisions == [p0.do_include({"id": i}) for i in range(1000)]
+    frac = sum(decisions) / 1000
+    assert 0.2 < frac < 0.4
+
+
+def test_pseudorandom_split_validation():
+    with pytest.raises(ValueError, match="out of range"):
+        in_pseudorandom_split([0.5], 1, "id")
+    with pytest.raises(ValueError, match="sum"):
+        in_pseudorandom_split([0.8, 0.8], 0, "id")
+
+
+# ------------------------------------------------- indexers / selectors e2e
+def test_build_and_query_index(synthetic_dataset):
+    indexers = [SingleFieldIndexer("by_partition", "partition_key"),
+                FieldNotNullIndexer("has_nullable", "nullable_int")]
+    built = build_rowgroup_index(synthetic_dataset.url, indexers)
+    assert set(built) == {"by_partition", "has_nullable"}
+    loaded = get_row_group_indexes(DatasetContext(synthetic_dataset.url))
+    assert set(loaded) == {"by_partition", "has_nullable"}
+    # partition_key cycles p_0..p_3 within every row group -> all groups match
+    assert loaded["by_partition"].get_row_group_indexes("p_1") == set(range(10))
+    assert sorted(loaded["by_partition"].indexed_values) == ["p_0", "p_1", "p_2", "p_3"]
+
+
+def test_selector_end_to_end(tmp_path):
+    """An indexed field that varies per row group actually prunes groups."""
+    from dataset_utils import TestSchema, make_test_row
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    url = f"file://{tmp_path}/ds"
+    rng = np.random.default_rng(0)
+    rows = [make_test_row(i, rng) for i in range(100)]
+    for r in rows:
+        r["partition_key"] = f"p_{r['id'] // 25}"  # 25-row runs: p_0..p_3
+    with materialize_dataset_local(url, TestSchema, rows_per_row_group=25,
+                                   rows_per_file=50) as w:
+        w.write_rows(rows)
+    build_rowgroup_index(url, [SingleFieldIndexer("by_pk", "partition_key")])
+
+    selector = SingleIndexSelector("by_pk", ["p_2"])
+    with make_reader(url, rowgroup_selector=selector, shuffle_row_groups=False,
+                     reader_pool_type="dummy", schema_fields=["id", "partition_key"]) as r:
+        ids = sorted(s.id for s in r)
+    assert ids == list(range(50, 75))
+
+    union = UnionIndexSelector([SingleIndexSelector("by_pk", ["p_0"]),
+                                SingleIndexSelector("by_pk", ["p_3"])])
+    with make_reader(url, rowgroup_selector=union, shuffle_row_groups=False,
+                     reader_pool_type="dummy", schema_fields=["id"]) as r:
+        ids = sorted(s.id for s in r)
+    assert ids == list(range(0, 25)) + list(range(75, 100))
+
+    intersect = IntersectIndexSelector([SingleIndexSelector("by_pk", ["p_0", "p_1"]),
+                                        SingleIndexSelector("by_pk", ["p_1", "p_2"])])
+    with make_reader(url, rowgroup_selector=intersect, shuffle_row_groups=False,
+                     reader_pool_type="dummy", schema_fields=["id"]) as r:
+        ids = sorted(s.id for s in r)
+    assert ids == list(range(25, 50))
+
+
+def test_missing_index_raises(synthetic_dataset):
+    selector = SingleIndexSelector("no_such_index", ["x"])
+    with pytest.raises(ValueError, match="no_such_index"):
+        make_reader(synthetic_dataset.url, rowgroup_selector=selector)
