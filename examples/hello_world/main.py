@@ -1,0 +1,44 @@
+"""hello_world: write a 3-field petastorm-tpu dataset, read it back as a
+pytree of jax.Array on one chip (BASELINE config 1)."""
+import numpy as np
+
+from petastorm_tpu import Unischema, UnischemaField
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.jax import DataLoader
+from petastorm_tpu.reader import make_reader
+
+HelloWorldSchema = Unischema("HelloWorldSchema", [
+    UnischemaField("id", np.int32, (), ScalarCodec(np.int32), False),
+    UnischemaField("image1", np.uint8, (128, 256, 3), CompressedImageCodec("png"), False),
+    UnischemaField("array_4d", np.uint8, (4, 128, 30, 3), NdarrayCodec(), False),
+])
+
+
+def generate(url: str, rows: int = 32):
+    rng = np.random.default_rng(0)
+    with materialize_dataset_local(url, HelloWorldSchema, rows_per_row_group=8) as w:
+        for i in range(rows):
+            w.write_row({"id": np.int32(i),
+                         "image1": rng.integers(0, 255, (128, 256, 3)).astype(np.uint8),
+                         "array_4d": rng.integers(0, 255, (4, 128, 30, 3)).astype(np.uint8)})
+
+
+def main(url: str = "file:///tmp/hello_world_tpu"):
+    import jax
+    generate(url)
+    # Row-at-a-time python access:
+    with make_reader(url, num_epochs=1, shuffle_row_groups=False) as reader:
+        sample = next(reader)
+        print("row sample: id =", sample.id, "image1", sample.image1.shape)
+    # Device-staged batches:
+    with make_reader(url, num_epochs=1, shuffle_row_groups=False) as reader:
+        for batch in DataLoader(reader, batch_size=8):
+            assert isinstance(batch["image1"], jax.Array)
+            print("jax batch:", batch["image1"].shape, batch["image1"].dtype,
+                  "on", list(batch["image1"].devices())[0])
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""``petastorm-tpu-throughput`` CLI (parity: reference benchmark/cli.py,
+``petastorm-throughput.py``)."""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        description="Measure petastorm-tpu reader throughput on a dataset")
+    parser.add_argument("dataset_url", help="Dataset URL (file://, s3://, hdfs://, ...)")
+    parser.add_argument("-f", "--field-regex", nargs="+",
+                        help="Read only fields matching these regexes")
+    parser.add_argument("-w", "--workers-count", type=int, default=3)
+    parser.add_argument("-p", "--pool-type", default="thread",
+                        choices=["thread", "process", "dummy"])
+    parser.add_argument("-m", "--warmup-cycles", type=int, default=200)
+    parser.add_argument("-n", "--measure-cycles", type=int, default=1000)
+    parser.add_argument("-d", "--read-method", default="python",
+                        choices=["python", "jax"])
+    parser.add_argument("-q", "--shuffling-queue-size", type=int, default=500)
+    parser.add_argument("--min-after-dequeue", type=int, default=400)
+    parser.add_argument("--json", action="store_true", help="Emit one JSON line")
+    parser.add_argument("-v", action="store_true", help="INFO logging")
+    parser.add_argument("-vv", action="store_true", help="DEBUG logging")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.vv:
+        logging.basicConfig(level=logging.DEBUG)
+    elif args.v:
+        logging.basicConfig(level=logging.INFO)
+
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex,
+        warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
+        pool_type=args.pool_type, loaders_count=args.workers_count,
+        shuffling_queue_size=args.shuffling_queue_size,
+        min_after_dequeue=args.min_after_dequeue,
+        read_method=args.read_method)
+    if args.json:
+        print(json.dumps({"samples_per_second": result.samples_per_second,
+                          "memory_rss_mb": result.memory_rss_mb,
+                          "cpu_percent": result.cpu_percent,
+                          "input_stall_percent": result.input_stall_percent}))
+    else:
+        print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""The reference's hello-world benchmark dataset, written Spark-free.
+
+Reproduces the exact schema and row count of the reference's benchmark
+tutorial store (examples/hello_world/petastorm_dataset/
+generate_petastorm_dataset.py:29-41 — id int32, image1 (128,256,3) png,
+array_4d variable uint8; 10 rows) so throughput numbers are comparable with
+the published 709.84 samples/sec baseline (docs/benchmarks_tutorial.rst:20).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+HelloWorldSchema = Unischema("HelloWorldSchema", [
+    UnischemaField("id", np.int32, (), ScalarCodec(np.int32), False),
+    UnischemaField("image1", np.uint8, (128, 256, 3), CompressedImageCodec("png"), False),
+    UnischemaField("array_4d", np.uint8, (None, 128, 30, None), NdarrayCodec(), False),
+])
+
+
+def generate_hello_world_dataset(output_url: str = "file:///tmp/hello_world_dataset",
+                                 rows_count: int = 10, seed: int = 0) -> str:
+    rng = np.random.default_rng(seed)
+    with materialize_dataset_local(output_url, HelloWorldSchema,
+                                   rows_per_row_group=1) as writer:
+        for i in range(rows_count):
+            writer.write_row({
+                "id": np.int32(i),
+                "image1": rng.integers(0, 255, (128, 256, 3)).astype(np.uint8),
+                "array_4d": rng.integers(0, 255, (4, 128, 30, 3)).astype(np.uint8),
+            })
+    return output_url

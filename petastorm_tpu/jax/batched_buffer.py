@@ -1,0 +1,144 @@
+"""Batched shuffling buffers operating on whole column tensors.
+
+Instead of shuffling python row objects (``reader_impl.shuffling_buffer``),
+these buffers hold one pre-allocated numpy tensor per column and move data
+with vectorized slice/permutation ops — the same idea as the reference's
+torch-tensor buffers (reference pytorch_shuffling_buffer.py:137
+``BatchedRandomShufflingBuffer``, ``_add_many`` :208, ``retrieve`` :252,
+``BatchedNoopShufflingBuffer`` :85), built on numpy so batches flow straight
+into ``jax.device_put``.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class BatchedNoopShufflingBuffer:
+    """FIFO of column-dict batches, re-chunked to the requested batch size.
+
+    ``can_add`` turns False once two full batches are buffered so the
+    producer streams instead of materializing the dataset."""
+
+    def __init__(self, batch_size: int):
+        self._batch_size = batch_size
+        self._chunks = deque()
+        self._size = 0
+        self._done = False
+
+    def add_many(self, batch: Dict[str, np.ndarray]):
+        n = len(next(iter(batch.values())))
+        self._chunks.append(batch)
+        self._size += n
+
+    def retrieve(self) -> Dict[str, np.ndarray]:
+        if not self.can_retrieve:
+            raise RuntimeError("Nothing to retrieve")
+        need = min(self._batch_size, self._size)
+        parts = []
+        got = 0
+        while got < need:
+            chunk = self._chunks[0]
+            n = len(next(iter(chunk.values())))
+            take = min(n, need - got)
+            if take == n:
+                parts.append(self._chunks.popleft())
+            else:
+                parts.append({k: v[:take] for k, v in chunk.items()})
+                self._chunks[0] = {k: v[take:] for k, v in chunk.items()}
+            got += take
+        self._size -= need
+        if len(parts) == 1:
+            return parts[0]
+        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+    def finish(self):
+        self._done = True
+
+    @property
+    def can_add(self) -> bool:
+        return not self._done and self._size < 2 * self._batch_size
+
+    @property
+    def can_retrieve(self) -> bool:
+        if self._done:
+            return self._size > 0
+        return self._size >= self._batch_size
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+
+class BatchedRandomShufflingBuffer:
+    """Uniform random batch sampling out of a growable column-tensor pool.
+
+    :param shuffling_queue_capacity: target number of buffered rows
+    :param min_after_retrieve: keep at least this many rows before allowing
+        retrieval (shuffle quality floor) until ``finish``
+    :param batch_size: rows per retrieved batch
+    :param seed: RNG seed for reproducibility
+    """
+
+    def __init__(self, shuffling_queue_capacity: int, min_after_retrieve: int,
+                 batch_size: int, extra_capacity: int = 250000,
+                 seed: Optional[int] = None):
+        if min_after_retrieve >= shuffling_queue_capacity:
+            raise ValueError("min_after_retrieve must be < shuffling_queue_capacity")
+        self._capacity = shuffling_queue_capacity
+        self._min_after = min_after_retrieve
+        self._extra = extra_capacity
+        self._batch_size = batch_size
+        self._rng = np.random.default_rng(seed)
+        self._store: Optional[Dict[str, np.ndarray]] = None
+        self._size = 0
+        self._done = False
+
+    def add_many(self, batch: Dict[str, np.ndarray]):
+        if self._done:
+            raise RuntimeError("Cannot add to a finished buffer")
+        n = len(next(iter(batch.values())))
+        if self._size + n > self._capacity + self._extra:
+            raise RuntimeError("Buffer overfill: check can_add before adding")
+        if self._store is None:
+            # Allocate once at capacity+extra; grow only if a bulk add needs it.
+            self._store = {k: np.empty((self._capacity + self._extra,) + v.shape[1:],
+                                       dtype=v.dtype)
+                           for k, v in batch.items()}
+        for k, v in batch.items():
+            self._store[k][self._size:self._size + n] = v
+        self._size += n
+
+    def retrieve(self) -> Dict[str, np.ndarray]:
+        if not self.can_retrieve:
+            raise RuntimeError("Below min_after_retrieve (and not finished) or empty")
+        take = min(self._batch_size, self._size)
+        picked = self._rng.choice(self._size, size=take, replace=False)
+        out = {k: v[picked].copy() for k, v in self._store.items()}
+        # Backfill the holes from the tail (vectorized swap-with-last).
+        keep_tail = np.setdiff1d(np.arange(self._size - take, self._size), picked,
+                                 assume_unique=True)
+        holes = picked[picked < self._size - take]
+        for k, v in self._store.items():
+            v[holes] = v[keep_tail[:len(holes)]]
+        self._size -= take
+        return out
+
+    def finish(self):
+        self._done = True
+
+    @property
+    def can_add(self) -> bool:
+        return self._size < self._capacity and not self._done
+
+    @property
+    def can_retrieve(self) -> bool:
+        if self._done:
+            return self._size > 0
+        return self._size >= max(self._min_after + self._batch_size, self._batch_size)
+
+    @property
+    def size(self) -> int:
+        return self._size

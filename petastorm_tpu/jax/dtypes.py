@@ -1,0 +1,91 @@
+"""Type sanitization policies for staging numpy batches onto TPU.
+
+XLA supports a narrower dtype lattice than Parquet/numpy, so batches are
+sanitized before ``device_put``:
+
+* ``Decimal`` -> float64 (or str, kept on host) — analogous to the TF
+  adapter's Decimal->str rule (reference tf_utils.py:57) but numeric by
+  default because training code wants numbers;
+* ``datetime64[*]`` -> int64 nanoseconds (reference tf_utils.py:57);
+* ``str``/``bytes``/object columns stay host-side (never device_put);
+* optional ``float64 -> float32`` and ``uint16/uint32 promotion`` knobs
+  (reference pytorch.py:40 promotes uint16->int32, uint32->int64 because
+  torch lacks them; XLA *has* unsigned types so promotion is opt-in here);
+* optional ``cast_to_bfloat16`` for floating fields — the MXU-native dtype.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DTypePolicy:
+    decimal_to: str = "float64"          # 'float64' | 'float32' | 'str'
+    datetime_to_int64_ns: bool = True
+    float64_to_float32: bool = False
+    promote_unsigned: bool = False       # uint16->int32, uint32->int64
+    cast_floats_to_bfloat16: bool = False
+
+
+DEFAULT_POLICY = DTypePolicy()
+
+
+def is_device_representable(dtype) -> bool:
+    """Can a column of this numpy dtype live on a TPU device?"""
+    dtype = np.dtype(dtype) if not isinstance(dtype, type) or dtype not in (
+        str, bytes, Decimal) else dtype
+    if dtype in (str, bytes, Decimal):
+        return False
+    return np.dtype(dtype).kind in "biufc" or np.dtype(dtype).kind == "M"
+
+
+def sanitize_array(arr: np.ndarray, policy: DTypePolicy = DEFAULT_POLICY
+                   ) -> Optional[np.ndarray]:
+    """Sanitize one batch column. Returns a device-ready array, or ``None``
+    when the column must stay on host (strings/objects)."""
+    if arr.dtype == object:
+        first = next((x for x in arr.flat if x is not None), None)
+        if isinstance(first, Decimal):
+            if policy.decimal_to == "str":
+                return None
+            return np.asarray([float(x) if x is not None else np.nan
+                               for x in arr.flat],
+                              dtype=policy.decimal_to).reshape(arr.shape)
+        if isinstance(first, np.ndarray):
+            return None  # ragged
+        return None
+    if arr.dtype.kind in ("U", "S"):
+        return None
+    if arr.dtype.kind == "M":
+        if policy.datetime_to_int64_ns:
+            return arr.astype("datetime64[ns]").astype(np.int64)
+        return None
+    out = arr
+    if policy.promote_unsigned:
+        if out.dtype == np.uint16:
+            out = out.astype(np.int32)
+        elif out.dtype == np.uint32:
+            out = out.astype(np.int64)
+    if policy.float64_to_float32 and out.dtype == np.float64:
+        out = out.astype(np.float32)
+    if policy.cast_floats_to_bfloat16 and out.dtype.kind == "f":
+        import ml_dtypes
+        out = out.astype(ml_dtypes.bfloat16)
+    return out
+
+
+def sanitize_batch(batch: dict, policy: DTypePolicy = DEFAULT_POLICY):
+    """Split a ``{name: np.ndarray}`` batch into (device_batch, host_batch)."""
+    device, host = {}, {}
+    for name, arr in batch.items():
+        arr = np.asarray(arr)
+        clean = sanitize_array(arr, policy)
+        if clean is None:
+            host[name] = arr
+        else:
+            device[name] = clean
+    return device, host

@@ -1,0 +1,191 @@
+"""Llama-style decoder-only transformer — the NGram->token-stream consumer
+(BASELINE config 5), built TPU-first:
+
+* RMSNorm (float32 stats), RoPE, grouped-query attention, SwiGLU MLP;
+* bfloat16 activations, float32 master params;
+* **3-D parallelism layout**: batch on ``data``, sequence on ``seq``
+  (ring attention over the ICI ring — :mod:`petastorm_tpu.parallel.ring_attention`),
+  and megatron-style tensor parallelism on ``model`` —
+  :func:`param_shardings` returns the NamedSharding pytree and ``apply``
+  constrains activations so GSPMD inserts the right collectives;
+* static config via :class:`LlamaConfig` (never traced).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+TINY = LlamaConfig(vocab=256, dim=64, n_layers=2, n_heads=8, n_kv_heads=4,
+                   hidden=128)
+
+
+def init_params(rng_key, cfg: LlamaConfig):
+    keys = iter(jax.random.split(rng_key, 4 + cfg.n_layers * 8))
+
+    def mat(key, fan_in, fan_out):
+        return jax.random.normal(key, (fan_in, fan_out), jnp.float32) / np.sqrt(fan_in)
+
+    params = {
+        "embed": jax.random.normal(next(keys), (cfg.vocab, cfg.dim), jnp.float32) * 0.02,
+        "layers": [],
+        "norm_out": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": mat(next(keys), cfg.dim, cfg.vocab),
+    }
+    hd = cfg.head_dim
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "wq": mat(next(keys), cfg.dim, cfg.n_heads * hd),
+            "wk": mat(next(keys), cfg.dim, cfg.n_kv_heads * hd),
+            "wv": mat(next(keys), cfg.dim, cfg.n_kv_heads * hd),
+            "wo": mat(next(keys), cfg.n_heads * hd, cfg.dim),
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "w1": mat(next(keys), cfg.dim, cfg.hidden),   # gate
+            "w3": mat(next(keys), cfg.dim, cfg.hidden),   # up
+            "w2": mat(next(keys), cfg.hidden, cfg.dim),   # down
+        })
+    return params
+
+
+def param_shardings(mesh, cfg: LlamaConfig, model_axis: str = "model"):
+    """Megatron TP layout as a NamedSharding pytree matching init_params."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "attn_norm": ns(),
+        "wq": ns(None, model_axis), "wk": ns(None, model_axis),
+        "wv": ns(None, model_axis), "wo": ns(model_axis, None),
+        "mlp_norm": ns(),
+        "w1": ns(None, model_axis), "w3": ns(None, model_axis),
+        "w2": ns(model_axis, None),
+    }
+    return {
+        "embed": ns(model_axis, None),     # vocab-sharded embedding
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "norm_out": ns(),
+        "lm_head": ns(None, model_axis),
+    }
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (x32 * inv * scale).astype(x.dtype)
+
+
+def _rope(x, theta):
+    """x: (b, s, h, d) -> rotated. Positions are global sequence indices."""
+    b, s, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(s, dtype=jnp.float32)
+    angles = pos[:, None] * freqs[None, :]               # (s, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _dense_causal_attention(q, k, v):
+    b, s, h, d = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, -1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
+          activation_spec=None, compute_dtype=jnp.bfloat16):
+    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab).
+
+    :param attn_fn: attention callable ``(q, k, v) -> out`` on
+        (b, s, h, hd) tensors; ``None`` uses dense causal attention. Pass a
+        :func:`petastorm_tpu.parallel.ring_attention.make_ring_attention`
+        instance for sequence parallelism.
+    :param activation_spec: optional ``PartitionSpec`` for (b, s, d)
+        activations; applied with ``with_sharding_constraint`` so GSPMD keeps
+        the intended layout between layers.
+    """
+    constrain = (lambda x: x) if activation_spec is None else \
+        (lambda x: jax.lax.with_sharding_constraint(x, activation_spec))
+    hd = cfg.head_dim
+    x = params["embed"].astype(compute_dtype)[tokens]
+    x = constrain(x)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["attn_norm"], cfg.norm_eps)
+        b, s, _ = h.shape
+        q = (h @ layer["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, hd)
+        k = (h @ layer["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (h @ layer["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+        q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+        # Grouped-query: expand kv heads to full head count.
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        attn = (attn_fn or _dense_causal_attention)(q, k, v)
+        attn = attn.reshape(b, s, cfg.n_heads * hd)
+        x = constrain(x + attn @ layer["wo"].astype(attn.dtype))
+        h = _rmsnorm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer["w1"].astype(h.dtype))
+        up = h @ layer["w3"].astype(h.dtype)
+        x = constrain(x + (gate * up) @ layer["w2"].astype(h.dtype))
+    x = _rmsnorm(x, params["norm_out"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None):
+    """Next-token cross entropy. batch: {'tokens': (b, s) int32}."""
+    tokens = batch["tokens"]
+    logits = apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
+                   activation_spec=activation_spec)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    return nll
+
+
+def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
+                    attn_fn=None, activation_spec=None):
+    """AdamW train step via optax; jit with sharded params for TP/DP/SP."""
+    import optax
+    tx = optax.adamw(learning_rate, weight_decay=0.1)
+
+    def init_opt(params):
+        return tx.init(params)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            partial(loss_fn, cfg=cfg, attn_fn=attn_fn,
+                    activation_spec=activation_spec))(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_opt, train_step

@@ -1,0 +1,50 @@
+"""MNIST MLP — the minimal end-to-end training consumer (BASELINE config 2).
+
+Pure JAX: params are a pytree dict, the apply function is jit-friendly, and
+batches come straight from :class:`petastorm_tpu.jax.DataLoader`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(rng_key, in_dim: int = 784, hidden: int = 512, classes: int = 10):
+    k1, k2, k3 = jax.random.split(rng_key, 3)
+
+    def dense(key, fan_in, fan_out):
+        scale = np.sqrt(2.0 / fan_in)
+        return {"w": jax.random.normal(key, (fan_in, fan_out), jnp.float32) * scale,
+                "b": jnp.zeros((fan_out,), jnp.float32)}
+
+    return {"fc1": dense(k1, in_dim, hidden),
+            "fc2": dense(k2, hidden, hidden),
+            "out": dense(k3, hidden, classes)}
+
+
+def apply(params, x):
+    """x: (batch, 784) float32 -> logits (batch, 10)."""
+    h = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+    h = jax.nn.relu(h @ params["fc2"]["w"] + params["fc2"]["b"])
+    return h @ params["out"]["w"] + params["out"]["b"]
+
+
+def loss_fn(params, batch):
+    logits = apply(params, batch["image"])
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return nll, acc
+
+
+def make_train_step(learning_rate: float = 1e-3):
+    """SGD-with-momentum train step, jit-ready."""
+    def train_step(params, momentum, batch):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        new_momentum = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
+        new_params = jax.tree.map(lambda p, m: p - learning_rate * m,
+                                  params, new_momentum)
+        return new_params, new_momentum, loss, acc
+    return train_step

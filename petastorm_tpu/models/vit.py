@@ -1,0 +1,102 @@
+"""ViT-B/16 in pure JAX — the converter->ViT consumer (BASELINE config 4).
+
+TPU notes: patchify is a single strided conv (one big MXU matmul per image),
+attention/MLP in bfloat16 with float32 layernorms and softmax, learned
+position embeddings, CLS token.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(rng_key, image_size=224, patch=16, dim=768, depth=12, heads=12,
+                mlp_dim=3072, num_classes=1000):
+    n_patches = (image_size // patch) ** 2
+    keys = iter(jax.random.split(rng_key, 8 + depth * 8))
+
+    def dense(key, fan_in, fan_out, scale=None):
+        scale = scale if scale is not None else np.sqrt(2.0 / fan_in)
+        return {"w": jax.random.normal(key, (fan_in, fan_out), jnp.float32) * scale,
+                "b": jnp.zeros((fan_out,), jnp.float32)}
+
+    def ln():
+        return {"scale": jnp.ones((dim,), jnp.float32),
+                "bias": jnp.zeros((dim,), jnp.float32)}
+
+    params = {
+        "patch_embed": {"w": jax.random.normal(next(keys), (patch, patch, 3, dim),
+                                               jnp.float32) * 0.02,
+                        "b": jnp.zeros((dim,), jnp.float32)},
+        "cls": jnp.zeros((1, 1, dim), jnp.float32),
+        "pos": jax.random.normal(next(keys), (1, n_patches + 1, dim), jnp.float32) * 0.02,
+        "blocks": [],
+        "ln_out": ln(),
+        "head": dense(next(keys), dim, num_classes, scale=0.01),
+    }
+    for _ in range(depth):
+        params["blocks"].append({
+            "ln1": ln(),
+            "qkv": dense(next(keys), dim, 3 * dim),
+            "proj": dense(next(keys), dim, dim),
+            "ln2": ln(),
+            "mlp1": dense(next(keys), dim, mlp_dim),
+            "mlp2": dense(next(keys), mlp_dim, dim),
+        })
+    return params
+
+
+def _layer_norm(x, p, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _attention(x, block, heads):
+    b, n, d = x.shape
+    qkv = x @ block["qkv"]["w"].astype(x.dtype) + block["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    hd = d // heads
+    q = q.reshape(b, n, heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, n, heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, n, heads, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+    weights = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, n, d)
+    return out @ block["proj"]["w"].astype(x.dtype) + block["proj"]["b"].astype(x.dtype)
+
+
+def apply(params, images, patch: int = 16, heads: int = 12,
+          compute_dtype=jnp.bfloat16):
+    """images: (N, H, W, 3) -> logits. ``patch``/``heads`` are static config
+    (never traced) and must match init_params."""
+    x = images.astype(compute_dtype)
+    x = jax.lax.conv_general_dilated(
+        x, params["patch_embed"]["w"].astype(compute_dtype),
+        window_strides=(patch, patch), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    b, gh, gw, d = x.shape
+    x = x.reshape(b, gh * gw, d) + params["patch_embed"]["b"].astype(compute_dtype)
+    cls = jnp.broadcast_to(params["cls"].astype(compute_dtype), (b, 1, d))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"].astype(compute_dtype)
+    for block in params["blocks"]:
+        x = x + _attention(_layer_norm(x, block["ln1"]), block, heads)
+        h = _layer_norm(x, block["ln2"])
+        h = jax.nn.gelu(h @ block["mlp1"]["w"].astype(x.dtype) + block["mlp1"]["b"].astype(x.dtype))
+        x = x + (h @ block["mlp2"]["w"].astype(x.dtype) + block["mlp2"]["b"].astype(x.dtype))
+    x = _layer_norm(x, params["ln_out"])
+    cls_out = x[:, 0].astype(jnp.float32)
+    return cls_out @ params["head"]["w"] + params["head"]["b"]
+
+
+def loss_fn(params, batch, patch: int = 16, heads: int = 12):
+    logits = apply(params, batch["image"], patch=patch, heads=heads)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return nll, acc

@@ -130,6 +130,9 @@ class NGram:
         ts_name = self.timestamp_field_name
         offsets = sorted(self._fields)
         length = self.length
+        # Schema views depend only on the offset — hoist them off the
+        # per-window hot path.
+        schemas = {off: self.get_schema_at_timestep(schema, off) for off in offsets}
         out = []
         i = 0
         n = len(data)
@@ -139,7 +142,7 @@ class NGram:
             if self._pass_threshold(timestamps):
                 sample = {}
                 for pos, offset in enumerate(offsets):
-                    ts_schema = self.get_schema_at_timestep(schema, offset)
+                    ts_schema = schemas[offset]
                     row = {k: window[pos][k] for k in ts_schema.fields if k in window[pos]}
                     sample[offset] = ts_schema.make_namedtuple_from_dict(row)
                 out.append(sample)

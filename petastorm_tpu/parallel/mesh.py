@@ -1,0 +1,74 @@
+"""Device-mesh and sharding helpers for feeding arbitrary GSPMD layouts.
+
+The data framework's contract with model parallelism (SURVEY.md §2 table):
+it must *feed* any ``jax.sharding`` layout — DP x TP x PP x SP meshes — by
+accepting a ``NamedSharding`` for the batch and contributing each host's
+disjoint shard. These helpers build standard meshes and batch shardings, and
+derive the reader's shard arithmetic from a mesh so reader sharding and
+GSPMD placement always agree.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
+              devices=None):
+    """Build a ``jax.sharding.Mesh`` of the given shape.
+
+    ``axis_sizes`` may contain one ``-1`` which absorbs the remaining
+    devices (like a reshape).
+    """
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = list(axis_sizes)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known:
+            raise ValueError(f"{len(devices)} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(f"Mesh {sizes} needs {total} devices, have {len(devices)}")
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def data_sharding(mesh, data_axis: str = "data"):
+    """NamedSharding placing dim-0 (batch) on ``data_axis``, rest replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(data_axis))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def reader_shard_for_mesh(mesh=None, data_axis: str = "data") -> Tuple[int, int]:
+    """(cur_shard, shard_count) for this *process* feeding ``mesh``.
+
+    Row groups are sharded per host (process), not per device: each host
+    reads a disjoint slice and contributes it via
+    ``make_array_from_process_local_data``. Returns JAX's process
+    index/count — the idiomatic TPU equivalent of the reference's
+    Horovod-rank sharding (reference spark_dataset_converter.py:124-161).
+    """
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def global_batch_size(per_device_batch: int, mesh, data_axis: str = "data") -> int:
+    return per_device_batch * mesh.shape[data_axis]
+
+
+def process_local_batch_size(global_batch: int, mesh, data_axis: str = "data") -> int:
+    """Rows this process must contribute per step for a given global batch."""
+    import jax
+    if global_batch % jax.process_count():
+        raise ValueError(f"global_batch {global_batch} not divisible by "
+                         f"process_count {jax.process_count()}")
+    return global_batch // jax.process_count()

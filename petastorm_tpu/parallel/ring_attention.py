@@ -1,0 +1,114 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Each device of the ``seq`` mesh axis holds a contiguous block of the
+sequence. K/V blocks rotate around the ring with ``lax.ppermute`` while
+every device streams them into a numerically-stable online-softmax
+accumulator (the flash-attention recurrence), so peak memory per device is
+O(block²) instead of O(seq²) and the K/V transfers ride the ICI ring —
+this is the TPU-native long-context mechanism (Liu et al., Ring Attention
+with Blockwise Transformers, arXiv:2310.01889; see PAPERS.md).
+
+Intended use: inside ``shard_map`` over a mesh with a ``seq`` axis, e.g.::
+
+    attn = shard_map(
+        partial(ring_attention, axis_name="seq", causal=True),
+        mesh=mesh,
+        in_specs=(P("data", "seq", None, None),) * 3,
+        out_specs=P("data", "seq", None, None))
+
+Shapes inside the shard: q/k/v are (batch_shard, block_len, heads, head_dim).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attention(q, k, v, bias):
+    """One (q-block, kv-block) pair -> (unnormalized out, row max, row sumexp).
+
+    q: (b, lq, h, d); k/v: (b, lk, h, d); bias: broadcastable to (b, h, lq, lk).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d)) + bias
+    m = jnp.max(scores, axis=-1)                        # (b, h, lq)
+    # A fully-masked block has m = -inf; subtracting it from -inf scores
+    # would produce nan. Use 0 there so exp(-inf - 0) = 0 rows fall out.
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(scores - m_safe[..., None])             # (b, h, lq, lk)
+    l = jnp.sum(p, axis=-1)                             # (b, h, lq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Exact (optionally causal) attention across a sequence-sharded ring.
+
+    Must run inside ``shard_map``; ``axis_name`` is the sequence mesh axis.
+    Returns the attention output for the local q block, same shape/dtype as q.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+
+    # Global positions of the local q rows.
+    q_pos = my_index * lq + jnp.arange(lq)
+
+    def step(carry, step_idx):
+        k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        # The block currently held arrived from device (my_index - step).
+        kv_index = (my_index - step_idx) % axis_size
+        k_pos = kv_index * lk + jnp.arange(lk)
+        if causal:
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
+        else:
+            bias = jnp.zeros((lq, lk), jnp.float32)
+        bias = bias[None, None]                          # (1, 1, lq, lk)
+
+        o_blk, m_blk, l_blk = _block_attention(q, k_blk, v_blk, bias)
+        # Online-softmax merge of the running and new block statistics.
+        m_new = jnp.maximum(m_acc, m_blk)
+        # Guard fully-masked blocks: exp(-inf - -inf) -> use finite fallback.
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m_acc), -jnp.inf, m_acc - m_new))
+        beta = jnp.exp(jnp.where(jnp.isneginf(m_blk), -jnp.inf, m_blk - m_new))
+        l_new = alpha * l_acc + beta * l_blk
+        o_new = (alpha.transpose(0, 2, 1)[..., None] * o_acc
+                 + beta.transpose(0, 2, 1)[..., None] * o_blk)
+
+        # Rotate K/V to the next device on the ICI ring.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, lq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    (_, _, o, _, l), _ = jax.lax.scan(step, (k, v, o0, m0, l0),
+                                      jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-20)  # rows with no visible keys (strict causal edge)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, seq_axis: str = "seq", data_axis: str = "data",
+                        head_axis: Optional[str] = None, causal: bool = True):
+    """Build a ``shard_map``-wrapped ring attention over ``mesh``.
+
+    Input/output layout: (batch, seq, heads, head_dim) with batch sharded on
+    ``data_axis``, seq sharded on ``seq_axis``, and heads optionally sharded
+    on ``head_axis`` (tensor parallelism composes: each model shard rings its
+    own heads).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(data_axis, seq_axis, head_axis, None)
+    fn = partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

@@ -53,13 +53,8 @@ class BatchReaderWorker(WorkerBase):
         else:
             needed_with_pred = needed
 
-        if cache is not None:
-            key = self._cache_key(rowgroup, needed_with_pred, shuffle_row_drop_partition)
-            table = cache.get(key, lambda: self._load_table(
-                rowgroup, needed_with_pred, predicate, shuffle_row_drop_partition))
-        else:
-            table = self._load_table(rowgroup, needed_with_pred, predicate,
-                                     shuffle_row_drop_partition)
+        table = self._load_table(rowgroup, needed_with_pred, predicate,
+                                 shuffle_row_drop_partition, cache)
         if table is None or table.num_rows == 0:
             return
 
@@ -75,12 +70,12 @@ class BatchReaderWorker(WorkerBase):
         self.publish_func(table)
 
     # ------------------------------------------------------------ internals
-    def _cache_key(self, rowgroup, columns, drop_part) -> str:
+    def _cache_key(self, rowgroup, columns) -> str:
         import hashlib
         url = self.args["dataset_url_or_urls"]
         url = url if isinstance(url, str) else "|".join(url)
         h = hashlib.md5(url.encode()).hexdigest()
-        return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}:{drop_part}"
+        return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
     def _read_table(self, rowgroup, columns) -> pa.Table:
         pf = self._files.get(rowgroup.path)
@@ -93,7 +88,16 @@ class BatchReaderWorker(WorkerBase):
                     key, pa.array([value] * table.num_rows))
         return table
 
-    def _load_table(self, rowgroup, needed, predicate, drop_part):
+    def _maybe_cached_table(self, rowgroup, columns, cache):
+        # Raw table only — shuffle/slice applied after retrieval so cache
+        # hits never freeze or leak shuffle order.
+        from petastorm_tpu.cache import NullCache
+        if cache is None or isinstance(cache, NullCache):
+            return self._read_table(rowgroup, columns)
+        key = self._cache_key(rowgroup, columns)
+        return cache.get(key, lambda: self._read_table(rowgroup, columns))
+
+    def _load_table(self, rowgroup, needed, predicate, drop_part, cache=None):
         part_index, num_parts = drop_part
         if predicate is not None:
             pred_fields = sorted(predicate.get_fields())
@@ -111,7 +115,7 @@ class BatchReaderWorker(WorkerBase):
             keep = [n for n in pred_table.column_names if n in needed]
             table = pred_table.select(keep).filter(pa.array(mask))
         else:
-            table = self._read_table(rowgroup, needed)
+            table = self._maybe_cached_table(rowgroup, needed, cache)
 
         indices = select_drop_partition(table.num_rows, part_index, num_parts,
                                         self.args.get("shuffle_rows", False), self._rng)

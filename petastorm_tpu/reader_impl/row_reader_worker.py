@@ -36,6 +36,7 @@ class _ParquetFileLRU:
 
     def get(self, path: str) -> pq.ParquetFile:
         if path in self._files:
+            self._files[path] = self._files.pop(path)  # refresh recency (LRU)
             return self._files[path]
         if len(self._files) >= self._capacity:
             old_path, old = next(iter(self._files.items()))
@@ -137,18 +138,28 @@ class RowReaderWorker(WorkerBase):
             self.publish_func(result)
 
     # ------------------------------------------------------------ load paths
-    def _cache_key(self, rowgroup, columns, drop_part) -> str:
+    def _cache_key(self, rowgroup, columns) -> str:
         url = self.args["dataset_url_or_urls"]
         url = url if isinstance(url, str) else "|".join(url)
         h = hashlib.md5(url.encode()).hexdigest()
-        return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}:{drop_part}"
+        return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
     def _maybe_cached(self, rowgroup, needed, drop_part):
+        # Cache the RAW columns only — shuffling and drop-partition slicing
+        # happen after retrieval, so a cache hit never freezes an epoch's
+        # shuffle order or leaks one reader's shuffle into another's.
         cache = self.args.get("cache")
-        loader = lambda: self._load_rows(rowgroup, needed, drop_part)  # noqa: E731
-        if cache is None:
-            return loader()
-        return cache.get(self._cache_key(rowgroup, needed, drop_part), loader)
+        from petastorm_tpu.cache import NullCache
+        if cache is None or isinstance(cache, NullCache):
+            data = self._read_columns(rowgroup, needed)
+        else:
+            data = cache.get(self._cache_key(rowgroup, needed),
+                             lambda: self._read_columns(rowgroup, needed))
+        num_rows = len(next(iter(data.values()))) if data else 0
+        part_index, num_parts = drop_part
+        indices = select_drop_partition(num_rows, part_index, num_parts,
+                                        self.args.get("shuffle_rows", False), self._rng)
+        return self._columns_to_rows(data, indices)
 
     def _read_columns(self, rowgroup, columns) -> dict:
         """Read the row group; returns {column: list} incl. partition keys."""
@@ -163,14 +174,6 @@ class RowReaderWorker(WorkerBase):
     def _columns_to_rows(data: dict, indices) -> List[dict]:
         names = list(data.keys())
         return [{n: data[n][i] for n in names} for i in indices]
-
-    def _load_rows(self, rowgroup, needed, drop_part) -> List[dict]:
-        data = self._read_columns(rowgroup, needed)
-        num_rows = len(next(iter(data.values()))) if data else 0
-        part_index, num_parts = drop_part
-        indices = select_drop_partition(num_rows, part_index, num_parts,
-                                        self.args.get("shuffle_rows", False), self._rng)
-        return self._columns_to_rows(data, indices)
 
     def _load_rows_with_predicate(self, rowgroup, needed, predicate, drop_part) -> List[dict]:
         """Load predicate columns first; early-exit if nothing matches

@@ -56,6 +56,13 @@ class WeightedSamplingReader:
             self.last_row_consumed = True
             raise
 
+    def reset(self):
+        """Start another pass: resets exhausted member readers."""
+        for r in self._readers:
+            if r.last_row_consumed:
+                r.reset()
+        self.last_row_consumed = False
+
     def stop(self):
         for r in self._readers:
             r.stop()

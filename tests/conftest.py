@@ -7,11 +7,18 @@ TPU hardware.
 """
 import os
 
-# Must run before jax is imported anywhere.
+# Must run before jax backends initialize anywhere.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU site-hook (sitecustomize) force-registers the TPU platform and
+# sets jax_platforms='axon,cpu' regardless of the env var; override it back to
+# CPU before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

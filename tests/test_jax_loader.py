@@ -1,0 +1,170 @@
+"""JAX loader tests on the virtual 8-device CPU mesh
+(strategy parity: reference test_pytorch_dataloader.py, retargeted at JAX)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.jax import (BatchedDataLoader, DataLoader, DTypePolicy,
+                               InMemBatchedDataLoader)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_row_loader_yields_jax_arrays(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "matrix"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        loader = DataLoader(reader, batch_size=10)
+        batches = list(loader)
+    assert len(batches) == 10
+    b = batches[0]
+    assert isinstance(b["id"], jax.Array)
+    assert b["id"].shape == (10,)
+    assert b["matrix"].shape == (10, 32, 16, 3)
+    assert b["matrix"].dtype == jnp.float32
+    all_ids = np.concatenate([np.asarray(b["id"]) for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(100))
+
+
+def test_row_loader_host_fields_kept(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "partition_key"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        b = next(iter(DataLoader(reader, batch_size=10)))
+    assert isinstance(b["id"], jax.Array)
+    assert isinstance(b["partition_key"], np.ndarray)  # strings stay on host
+    assert b["partition_key"].dtype.kind == "U"
+
+
+def test_row_loader_drop_last(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        batches = list(DataLoader(reader, batch_size=30, drop_last=True))
+    assert [len(b["id"]) for b in batches] == [30, 30, 30]
+
+
+def test_row_loader_pad_last_with_mask(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        batches = list(DataLoader(reader, batch_size=30, pad_last=True))
+    assert len(batches) == 4
+    last = batches[-1]
+    assert last["id"].shape == (30,)
+    mask = np.asarray(last["__valid__"])
+    assert mask.sum() == 10 and mask[:10].all() and not mask[10:].any()
+
+
+def test_row_loader_varlen_padding(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "varlen"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        b = next(iter(DataLoader(reader, batch_size=10, pad_variable_length_to=8)))
+    assert b["varlen"].shape == (10, 8)
+    lens = np.asarray(b["varlen__len"])
+    ids = np.asarray(b["id"])
+    np.testing.assert_array_equal(lens, ids % 5 + 1)
+    row3 = np.asarray(b["varlen"])[3]
+    np.testing.assert_array_equal(row3[:lens[3]], np.arange(lens[3]))
+    assert (row3[lens[3]:] == 0).all()
+
+
+def test_row_loader_nulls_rejected(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "nullable_int"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        with pytest.raises(ValueError, match="nulls"):
+            list(DataLoader(reader, batch_size=10))
+
+
+def test_row_loader_shuffling_buffer(synthetic_dataset):
+    def ids_with(seed):
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+            loader = DataLoader(reader, batch_size=10,
+                                shuffling_queue_capacity=50, seed=seed)
+            return np.concatenate([np.asarray(b["id"]) for b in loader])
+
+    a, b2, c = ids_with(5), ids_with(5), ids_with(6)
+    np.testing.assert_array_equal(a, b2)     # seeded determinism
+    assert not np.array_equal(a, c)
+    assert sorted(a.tolist()) == list(range(100))
+    assert not np.array_equal(a, np.arange(100))  # actually shuffled
+
+
+def test_batched_loader_rebatching(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=["id", "float_col"],
+                           shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        batches = list(BatchedDataLoader(reader, batch_size=32))
+    # 100 rows -> 3 full batches of 32 (drop_last)
+    assert [len(b["id"]) for b in batches] == [32, 32, 32]
+    assert isinstance(batches[0]["float_col"], jax.Array)
+
+
+def test_batched_loader_shuffled(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=["id"],
+                           shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        loader = BatchedDataLoader(reader, batch_size=25,
+                                   shuffling_queue_capacity=60, seed=0,
+                                   drop_last=False)
+        ids = np.concatenate([np.asarray(b["id"]) for b in loader])
+    assert sorted(ids.tolist()) == list(range(100))
+    assert not np.array_equal(ids, np.arange(100))
+
+
+def test_dtype_policy_applied(scalar_dataset):
+    policy = DTypePolicy(float64_to_float32=True)
+    with make_batch_reader(scalar_dataset.url, schema_fields=["float_col"],
+                           shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        b = next(iter(BatchedDataLoader(reader, batch_size=10, dtype_policy=policy)))
+    assert b["float_col"].dtype == jnp.float32
+
+
+def test_sharded_global_batch_assembly(synthetic_dataset):
+    """Batches land as one global jax.Array sharded over the 8-device mesh."""
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "matrix"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        loader = DataLoader(reader, batch_size=16, sharding=sharding)
+        b = next(iter(loader))
+    assert b["id"].sharding == sharding
+    assert b["matrix"].shape == (16, 32, 16, 3)
+    # each device holds 16/8 = 2 rows
+    shard_shapes = {s.data.shape for s in b["matrix"].addressable_shards}
+    assert shard_shapes == {(2, 32, 16, 3)}
+    # the sharded batch is directly consumable by a jitted function
+    total = jax.jit(lambda x: jnp.sum(x))(b["matrix"])
+    assert np.isfinite(float(total))
+
+
+def test_in_mem_loader_epochs(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "matrix"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        loader = InMemBatchedDataLoader(reader, batch_size=20, num_epochs=3, seed=0)
+        batches = list(loader)
+    assert len(batches) == 15  # 5 per epoch x 3
+    ids = np.concatenate([np.asarray(b["id"]) for b in batches])
+    assert sorted(ids.tolist()) == sorted(list(range(100)) * 3)
+    # epoch orders differ
+    e1, e2 = ids[:100], ids[100:200]
+    assert not np.array_equal(e1, e2)
+
+
+def test_loader_type_mismatch_rejected(synthetic_dataset, scalar_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy") as r:
+        with pytest.raises(TypeError, match="BatchedDataLoader"):
+            BatchedDataLoader(r, batch_size=4)
+    with make_batch_reader(scalar_dataset.url, reader_pool_type="dummy") as r:
+        with pytest.raises(TypeError, match="make_reader"):
+            DataLoader(r, batch_size=4)
+
+
+def test_loader_reiteration_resets_reader(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        loader = DataLoader(reader, batch_size=50)
+        first = list(loader)
+        second = list(loader)  # triggers reader.reset()
+    assert len(first) == len(second) == 2
