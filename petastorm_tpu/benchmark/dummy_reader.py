@@ -1,0 +1,50 @@
+"""Synthetic reader for loader-only throughput benchmarking — isolates the
+DataLoader/collate/staging cost from Parquet I/O.
+
+Parity: reference petastorm/benchmark/dummy_reader.py:26 (and its
+batch-size sweep :46-87).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+DummyBenchSchema = Unischema("DummyBench", [
+    UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField("value", np.float32, (128,), NdarrayCodec(), False),
+])
+
+
+def make_dummy_reader(num_rows: int = 100000, seed: int = 0) -> ReaderMock:
+    rng = np.random.default_rng(seed)
+    row = {"id": np.int64(0), "value": rng.normal(size=128).astype(np.float32)}
+
+    def gen(_schema):
+        return row  # constant row: measures loader overhead, not row-gen cost
+    return ReaderMock(DummyBenchSchema, gen, num_rows=num_rows)
+
+
+def loader_throughput_sweep(batch_sizes=(10, 100, 1000, 10000), rows: int = 50000):
+    """Print samples/sec of the JAX DataLoader per batch size."""
+    from petastorm_tpu.jax import DataLoader
+    results = {}
+    for bs in batch_sizes:
+        reader = make_dummy_reader(rows)
+        loader = DataLoader(reader, batch_size=bs)
+        t0 = time.perf_counter()
+        n = 0
+        for batch in loader:
+            n += len(batch["id"])
+        dt = time.perf_counter() - t0
+        results[bs] = n / dt
+        print(f"batch_size={bs}: {n / dt:,.0f} samples/sec")
+    return results
+
+
+if __name__ == "__main__":
+    loader_throughput_sweep()

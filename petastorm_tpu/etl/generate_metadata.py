@@ -1,0 +1,49 @@
+"""``petastorm-tpu-generate-metadata``: (re)generate dataset metadata on an
+existing Parquet store — Spark-free.
+
+Parity: reference petastorm/etl/petastorm_generate_metadata.py:47 (a Spark
+job there). Recovers the schema from existing metadata (including legacy
+pickled petastorm schemas) or infers it from the Arrow schema, then rewrites
+``_common_metadata`` with the JSON unischema + row-group index.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                infer_or_load_unischema,
+                                                load_row_groups,
+                                                write_dataset_metadata)
+
+
+def generate_metadata(dataset_url: str, use_inferred_schema: bool = False) -> int:
+    """Returns the number of row groups indexed."""
+    ctx = DatasetContext(dataset_url)
+    if use_inferred_schema:
+        from petastorm_tpu.unischema import Unischema
+        schema = Unischema.from_arrow_schema(ctx.arrow_schema(),
+                                             omit_unsupported_fields=True)
+    else:
+        schema = infer_or_load_unischema(ctx)
+    write_dataset_metadata(ctx, schema)
+    return len(load_row_groups(ctx))
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("dataset_url")
+    parser.add_argument("--use-inferred-schema", action="store_true",
+                        help="Ignore any stored unischema; infer from Arrow")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    n = generate_metadata(args.dataset_url, args.use_inferred_schema)
+    print(f"metadata written; {n} row groups indexed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,253 @@
+"""Native (C++) components, loaded through ctypes.
+
+``libptring`` — the shared-memory SPSC ring buffer used as the process
+pool's zero-copy data plane. The library is compiled on first use with g++
+(no network, no pip) and cached; import never fails — ``ring_available()``
+reports whether the native path is usable.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "ringbuf.cpp")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+_LIB_ERR = None
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("PETASTORM_TPU_CACHE",
+                       os.path.join(tempfile.gettempdir(), "petastorm_tpu_native"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build_library() -> str:
+    """Compile ringbuf.cpp (cached by source mtime+size)."""
+    src_stat = os.stat(_SRC)
+    tag = f"{src_stat.st_mtime_ns}_{src_stat.st_size}"
+    out = os.path.join(_cache_dir(), f"libptring_{tag}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".build{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp, "-lrt"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)  # atomic for concurrent builders
+    return out
+
+
+def _load():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        try:
+            lib = ctypes.CDLL(_build_library())
+            lib.pt_ring_open.restype = ctypes.c_void_p
+            lib.pt_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+            lib.pt_ring_capacity.restype = ctypes.c_uint64
+            lib.pt_ring_capacity.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_data_ptr.restype = ctypes.c_void_p
+            lib.pt_ring_data_ptr.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_write.restype = ctypes.c_int
+            lib.pt_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint32, ctypes.c_int]
+            lib.pt_ring_write2.restype = ctypes.c_int
+            lib.pt_ring_write2.argtypes = [ctypes.c_void_p, ctypes.c_uint8,
+                                           ctypes.c_void_p, ctypes.c_uint32,
+                                           ctypes.c_int]
+            lib.pt_ring_peek.restype = ctypes.c_int
+            lib.pt_ring_peek.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_uint64),
+                                         ctypes.POINTER(ctypes.c_uint32), ctypes.c_int]
+            lib.pt_ring_advance.restype = None
+            lib.pt_ring_advance.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_read.restype = ctypes.c_long
+            lib.pt_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_uint64, ctypes.c_int]
+            lib.pt_ring_close_producer.restype = None
+            lib.pt_ring_close_producer.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_free.restype = None
+            lib.pt_ring_free.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            _LIB = lib
+        except Exception as e:  # noqa: BLE001 - record, degrade gracefully
+            logger.warning("Native ring buffer unavailable (%s); "
+                           "process pools fall back to ZeroMQ", e)
+            _LIB_ERR = e
+    return _LIB
+
+
+def ring_available() -> bool:
+    return _load() is not None
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+class RingClosed(Exception):
+    pass
+
+
+class ShmRing:
+    """Python handle on one SPSC shared-memory ring.
+
+    Producer side: ``write(bytes)``, ``close_producer()``.
+    Consumer side: ``read(timeout_ms)`` -> bytes (copy) or
+    ``read_zero_copy(timeout_ms)`` -> context manager yielding a memoryview
+    valid until exit (the ring advances on exit).
+    """
+
+    def __init__(self, name: str, capacity: int = 64 << 20, create: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native ring unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self.name = name
+        self._handle = lib.pt_ring_open(name.encode(), capacity, 1 if create else 0)
+        if not self._handle:
+            raise OSError(f"could not {'create' if create else 'attach'} ring {name!r}")
+        self._owner = create
+        cap = lib.pt_ring_capacity(self._handle)
+        ptr = lib.pt_ring_data_ptr(self._handle)
+        self._data = (ctypes.c_char * cap).from_address(ptr)
+
+    # ------------------------------------------------------------- producer
+    def write(self, payload: bytes, timeout_ms: int = -1) -> None:
+        rc = self._lib.pt_ring_write(self._handle, payload, len(payload), timeout_ms)
+        self._check_write_rc(rc, len(payload))
+
+    def write_tagged(self, kind: int, payload, timeout_ms: int = -1) -> None:
+        """Write a 1-byte kind tag + payload in one record, without the
+        prefix-concat copy. ``payload`` may be bytes or a (possibly
+        read-only) memoryview — numpy's buffer view supplies the raw pointer
+        with zero python-side copies."""
+        import numpy as np
+        view = memoryview(payload)
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
+        arr = np.frombuffer(view, dtype=np.uint8)
+        rc = self._lib.pt_ring_write2(
+            self._handle, kind, ctypes.c_void_p(arr.ctypes.data),
+            arr.nbytes, timeout_ms)
+        self._check_write_rc(rc, arr.nbytes)
+
+    def _check_write_rc(self, rc, n):
+        if rc == 0:
+            return
+        if rc == -1:
+            raise TimeoutError_(f"ring {self.name} write timed out")
+        if rc == -2:
+            raise ValueError(f"payload of {n} bytes exceeds ring capacity")
+        raise RingClosed(f"ring {self.name} is closed")
+
+    def close_producer(self) -> None:
+        self._lib.pt_ring_close_producer(self._handle)
+
+    # ------------------------------------------------------------- consumer
+    def read(self, timeout_ms: int = -1) -> bytes:
+        offset = ctypes.c_uint64()
+        length = ctypes.c_uint32()
+        rc = self._lib.pt_ring_peek(self._handle, ctypes.byref(offset),
+                                    ctypes.byref(length), timeout_ms)
+        if rc == -1:
+            raise TimeoutError_(f"ring {self.name} read timed out")
+        if rc == -3:
+            raise RingClosed(f"ring {self.name} drained")
+        data = bytes(memoryview(self._data)[offset.value:offset.value + length.value])
+        self._lib.pt_ring_advance(self._handle)
+        return data
+
+    def read_tagged(self, timeout_ms: int = -1):
+        """Read one tagged record -> (kind, payload bytes). One copy out of
+        the mapped region; no slice-off-the-prefix second copy."""
+        offset = ctypes.c_uint64()
+        length = ctypes.c_uint32()
+        rc = self._lib.pt_ring_peek(self._handle, ctypes.byref(offset),
+                                    ctypes.byref(length), timeout_ms)
+        if rc == -1:
+            raise TimeoutError_(f"ring {self.name} read timed out")
+        if rc == -3:
+            raise RingClosed(f"ring {self.name} drained")
+        mv = memoryview(self._data).cast("B")[offset.value:offset.value + length.value]
+        kind = mv[0]
+        payload = bytes(mv[1:])
+        mv.release()
+        self._lib.pt_ring_advance(self._handle)
+        return kind, payload
+
+    def read_tagged_view(self, timeout_ms: int = -1):
+        """Read one tagged record as (kind, zero-copy payload memoryview)
+        WITHOUT advancing. The caller must call :meth:`advance` once done
+        with the view (and after dropping anything deserialized from it)."""
+        offset = ctypes.c_uint64()
+        length = ctypes.c_uint32()
+        rc = self._lib.pt_ring_peek(self._handle, ctypes.byref(offset),
+                                    ctypes.byref(length), timeout_ms)
+        if rc == -1:
+            raise TimeoutError_(f"ring {self.name} read timed out")
+        if rc == -3:
+            raise RingClosed(f"ring {self.name} drained")
+        mv = memoryview(self._data).cast("B")[offset.value:offset.value + length.value]
+        return mv[0], mv[1:]
+
+    def advance(self) -> None:
+        """Consume the record most recently returned by read_tagged_view."""
+        self._lib.pt_ring_advance(self._handle)
+
+    def read_zero_copy(self, timeout_ms: int = -1):
+        """Context manager yielding a zero-copy memoryview of the next
+        message; the ring advances when the context exits. Everything that
+        references the view (e.g. an Arrow table deserialized from it) must
+        be dropped before the context exits — the memory is reused."""
+        ring = self
+
+        class _View:
+            def __enter__(self_inner):
+                offset = ctypes.c_uint64()
+                length = ctypes.c_uint32()
+                rc = ring._lib.pt_ring_peek(ring._handle, ctypes.byref(offset),
+                                            ctypes.byref(length), timeout_ms)
+                if rc == -1:
+                    raise TimeoutError_(f"ring {ring.name} read timed out")
+                if rc == -3:
+                    raise RingClosed(f"ring {ring.name} drained")
+                self_inner._view = memoryview(ring._data)[
+                    offset.value:offset.value + length.value]
+                return self_inner._view
+
+            def __exit__(self_inner, *exc):
+                self_inner._view.release()
+                ring._lib.pt_ring_advance(ring._handle)
+                return False
+
+        return _View()
+
+    def poll(self, timeout_ms: int = 0) -> bool:
+        """True if a message is ready (does not consume)."""
+        offset = ctypes.c_uint64()
+        length = ctypes.c_uint32()
+        rc = self._lib.pt_ring_peek(self._handle, ctypes.byref(offset),
+                                    ctypes.byref(length), timeout_ms)
+        return rc == 0
+
+    def close(self) -> None:
+        if self._handle:
+            self._data = None
+            self._lib.pt_ring_free(self._handle, 1 if self._owner else 0)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,47 @@
+"""FIFO of Arrow tables re-chunked to fixed-size batches.
+
+Parity: reference petastorm/pyarrow_helpers/batching_table_queue.py:20
+(``BatchingTableQueue``, ``get`` :53).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import pyarrow as pa
+
+
+class BatchingTableQueue:
+    """``put`` arbitrary-size tables; ``get`` returns tables of exactly
+    ``batch_size`` rows (zero-copy slices/concats)."""
+
+    def __init__(self, batch_size: int):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self._batch_size = batch_size
+        self._chunks = deque()
+        self._rows = 0
+
+    def put(self, table: pa.Table) -> None:
+        if table.num_rows:
+            self._chunks.append(table)
+            self._rows += table.num_rows
+
+    def empty(self) -> bool:
+        return self._rows < self._batch_size
+
+    def get(self) -> pa.Table:
+        if self.empty():
+            raise RuntimeError("Not enough rows buffered; check empty() first")
+        parts = []
+        need = self._batch_size
+        while need:
+            chunk = self._chunks[0]
+            if chunk.num_rows <= need:
+                parts.append(self._chunks.popleft())
+                need -= chunk.num_rows
+            else:
+                parts.append(chunk.slice(0, need))
+                self._chunks[0] = chunk.slice(need)
+                need = 0
+        self._rows -= self._batch_size
+        return pa.concat_tables(parts) if len(parts) > 1 else parts[0]

@@ -1,0 +1,103 @@
+"""PyTorch adapter: readers -> torch-tensor batch loaders.
+
+Kept for capability parity with the reference's ``petastorm.pytorch``
+(DataLoader:131, BatchedDataLoader:259, InMemBatchedDataLoader:437); the
+first-class consumer here is :mod:`petastorm_tpu.jax`. The host-batch
+machinery is shared with the JAX loaders — this module converts the final
+numpy column batches to torch tensors.
+
+Type sanitization parity (reference pytorch.py:40): bool->uint8,
+uint16->int32, uint32->int64 (torch lacks those dtypes),
+Decimal->float64 via the shared DTypePolicy.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from petastorm_tpu.jax.dtypes import DTypePolicy
+from petastorm_tpu.jax.loader import (BatchedDataLoader as _JaxBatchedLoader,
+                                      DataLoader as _JaxLoader,
+                                      InMemBatchedDataLoader as _JaxInMemLoader,
+                                      LoaderBase)
+
+TORCH_POLICY = DTypePolicy(decimal_to="float64", datetime_to_int64_ns=True,
+                           promote_unsigned=True)
+
+
+def _sanitize_for_torch(arr: np.ndarray) -> Optional[np.ndarray]:
+    if arr.dtype == np.bool_:
+        return arr.astype(np.uint8)
+    if arr.dtype == np.uint16:
+        return arr.astype(np.int32)
+    if arr.dtype == np.uint32:
+        return arr.astype(np.int64)
+    if arr.dtype.kind in ("U", "S", "O"):
+        return None
+    if arr.dtype.kind == "M":
+        return arr.astype("datetime64[ns]").astype(np.int64)
+    return arr
+
+
+class _TorchStagingMixin(LoaderBase):
+    """Overrides device staging: numpy -> torch tensors (CPU or given device)."""
+
+    def _init_torch(self, torch_device=None):
+        self._torch_device = torch_device
+
+    def _stage(self, host_batch):
+        import torch
+        out = {}
+        for name, arr in host_batch.items():
+            arr = np.asarray(arr)
+            clean = _sanitize_for_torch(arr)
+            if clean is None:
+                out[name] = arr  # strings/objects stay numpy
+                continue
+            t = torch.from_numpy(np.ascontiguousarray(clean))
+            if self._torch_device is not None:
+                t = t.to(self._torch_device, non_blocking=True)
+            out[name] = t
+        return out
+
+
+class DataLoader(_TorchStagingMixin, _JaxLoader):
+    """Row-reader torch loader (parity: reference pytorch.py:131)."""
+
+    def __init__(self, reader, batch_size: int, torch_device=None, **kwargs):
+        super().__init__(reader, batch_size, **kwargs)
+        self._init_torch(torch_device)
+
+
+class BatchedDataLoader(_TorchStagingMixin, _JaxBatchedLoader):
+    """Columnar torch loader (parity: reference pytorch.py:259)."""
+
+    def __init__(self, reader, batch_size: int, torch_device=None, **kwargs):
+        super().__init__(reader, batch_size, **kwargs)
+        self._init_torch(torch_device)
+
+
+class InMemBatchedDataLoader(_TorchStagingMixin, _JaxInMemLoader):
+    """One-pass in-memory torch loader (parity: reference pytorch.py:437)."""
+
+    def __init__(self, reader, batch_size: int, torch_device=None, **kwargs):
+        super().__init__(reader, batch_size, **kwargs)
+        self._init_torch(torch_device)
+
+
+def decimal_friendly_collate(batch):
+    """Collate helper accepting Decimals (stringified) inside rows
+    (parity: reference pytorch.py:73)."""
+    import torch
+    from decimal import Decimal
+    if isinstance(batch, (list, tuple)) and batch and isinstance(batch[0], Decimal):
+        return [str(x) for x in batch]
+    if isinstance(batch, (list, tuple)) and batch and isinstance(batch[0], dict):
+        return {k: decimal_friendly_collate([b[k] for b in batch]) for k in batch[0]}
+    if isinstance(batch, (list, tuple)) and batch and isinstance(batch[0], np.ndarray):
+        return torch.from_numpy(np.stack(batch))
+    if isinstance(batch, (list, tuple)) and batch and isinstance(
+            batch[0], (int, float, np.integer, np.floating)):
+        return torch.tensor(batch)
+    return batch

@@ -308,6 +308,14 @@ class Reader:
             "seed": seed,
         }
 
+        if is_batched_reader and hasattr(self._pool, "result_transform"):
+            # Process pool: convert Arrow -> numpy inside the poll, while the
+            # shm transport's zero-copy view is still valid.
+            from functools import partial as _partial
+            self._pool.result_transform = _partial(arrow_table_to_numpy_dict,
+                                                   schema=self.schema,
+                                                   force_copy=True)
+
         self._ventilator = ConcurrentVentilator(
             self._pool.ventilate, items,
             iterations=num_epochs,
@@ -451,6 +459,7 @@ class _BatchResultsReader:
         self._schema = schema
 
     def read_next(self):
-        table = self._pool.get_results()
-        numpy_dict = arrow_table_to_numpy_dict(table, self._schema)
-        return self._schema.make_namedtuple_from_dict(numpy_dict)
+        result = self._pool.get_results()
+        if not isinstance(result, dict):  # thread/dummy pools publish Tables
+            result = arrow_table_to_numpy_dict(result, self._schema)
+        return self._schema.make_namedtuple_from_dict(result)

@@ -124,10 +124,14 @@ class BatchReaderWorker(WorkerBase):
         return table
 
 
-def arrow_table_to_numpy_dict(table: pa.Table, schema) -> dict:
+def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False) -> dict:
     """Convert an Arrow table to ``{name: numpy array}``, reassembling
     list-columns into fixed-shape matrices per the schema's declared shapes
-    (parity: reference arrow_reader_worker.py:31-75)."""
+    (parity: reference arrow_reader_worker.py:31-75).
+
+    ``force_copy=True`` guarantees no output array aliases the table's
+    buffers — required when the table was deserialized zero-copy from
+    transient shared memory."""
     out = {}
     for name in table.column_names:
         col = table.column(name)
@@ -149,7 +153,10 @@ def arrow_table_to_numpy_dict(table: pa.Table, schema) -> dict:
                 out[name] = obj
         else:
             try:
-                out[name] = col.to_numpy(zero_copy_only=False)
+                arr = col.to_numpy(zero_copy_only=False)
             except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-                out[name] = np.asarray(col.to_pylist(), dtype=object)
+                arr = np.asarray(col.to_pylist(), dtype=object)
+            if force_copy and arr.base is not None:
+                arr = arr.copy()
+            out[name] = arr
     return out

@@ -1,0 +1,220 @@
+"""Spark DataFrame -> cached Parquet -> TPU/TF/Torch loaders.
+
+``make_spark_converter(df)`` materializes a DataFrame once into a cached
+Parquet store and hands out readers/loaders over it. The cache is keyed by
+the DataFrame's analyzed plan so converting the same frame twice reuses the
+store; deletion is registered at exit.
+
+All pyspark imports are lazy: the module imports fine on TPU pods without a
+JVM; only calling the converter requires pyspark.
+
+Parity: reference petastorm/spark/spark_dataset_converter.py —
+``make_spark_converter`` (:664), ``SparkDatasetConverter`` (:164), cache-dir
+conf (:172), plan-equality dedupe (:494), atexit deletion (:117), precision
+and Spark-vector conversion (:542,:565), Horovod/JAX rank shard defaults
+(:124), small-file warning (:642-658).
+"""
+from __future__ import annotations
+
+import atexit
+import hashlib
+import logging
+import os
+import threading
+import uuid
+import warnings
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# Spark conf key naming the parent cache directory (parity: reference :172).
+PARENT_CACHE_DIR_URL_CONF = "petastorm.spark.converter.parentCacheDirUrl"
+
+_cache_lock = threading.Lock()
+_converter_cache = {}      # plan-hash -> SparkDatasetConverter
+_dirs_to_delete = set()
+
+
+def _delete_cached_dirs():  # pragma: no cover - atexit
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    for url in list(_dirs_to_delete):
+        try:
+            fs, path = get_filesystem_and_path_or_paths(url)
+            fs.rm(path, recursive=True)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("Could not delete converter cache %s: %s", url, e)
+
+
+atexit.register(_delete_cached_dirs)
+
+
+class SparkDatasetConverter:
+    """A handle on a materialized DataFrame cache.
+
+    :param cache_dir_url: URL of this converter's Parquet store
+    :param dataset_size: row count of the materialized frame
+    :param parent_cache_dir_url: parent directory (for bookkeeping)
+    """
+
+    def __init__(self, cache_dir_url: str, dataset_size: int,
+                 parent_cache_dir_url: Optional[str] = None):
+        self.cache_dir_url = cache_dir_url
+        self.dataset_size = dataset_size
+        self.parent_cache_dir_url = parent_cache_dir_url
+
+    def __len__(self):
+        return self.dataset_size
+
+    # ------------------------------------------------------------ consumers
+    def make_jax_loader(self, batch_size: int, sharding=None, cur_shard="auto",
+                        num_epochs: Optional[int] = None, **reader_kwargs):
+        """Batched JAX loader over the cached store; shards per TPU host by
+        default (the reference's Horovod-rank behavior, :124, rebuilt on
+        jax.process_index)."""
+        from petastorm_tpu.jax import BatchedDataLoader
+        from petastorm_tpu.reader import make_batch_reader
+        try:
+            reader = make_batch_reader(self.cache_dir_url, cur_shard=cur_shard,
+                                       num_epochs=num_epochs, **reader_kwargs)
+        except Exception:
+            if cur_shard != "auto":
+                raise
+            reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
+                                       **reader_kwargs)
+        return BatchedDataLoader(reader, batch_size=batch_size, sharding=sharding)
+
+    def make_tf_dataset(self, batch_size: Optional[int] = None,
+                        num_epochs: Optional[int] = None, **reader_kwargs):
+        from petastorm_tpu.reader import make_batch_reader
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
+                                   **reader_kwargs)
+        dataset = make_petastorm_dataset(reader)
+        if batch_size is not None:
+            dataset = dataset.unbatch().batch(batch_size)
+        return _ContextManagedAdapter(dataset, reader)
+
+    def make_torch_dataloader(self, batch_size: int = 32,
+                              num_epochs: Optional[int] = None, **reader_kwargs):
+        from petastorm_tpu.pytorch import BatchedDataLoader
+        from petastorm_tpu.reader import make_batch_reader
+        reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
+                                   **reader_kwargs)
+        return _ContextManagedAdapter(
+            BatchedDataLoader(reader, batch_size=batch_size), reader)
+
+    def delete(self):
+        """Delete the cached store now."""
+        from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+        fs, path = get_filesystem_and_path_or_paths(self.cache_dir_url)
+        fs.rm(path, recursive=True)
+        _dirs_to_delete.discard(self.cache_dir_url)
+        with _cache_lock:
+            for k, v in list(_converter_cache.items()):
+                if v is self:
+                    del _converter_cache[k]
+
+
+class _ContextManagedAdapter:
+    """`with converter.make_tf_dataset() as dataset:` — closes the reader on
+    exit (parity: reference ctx managers :297,:361)."""
+
+    def __init__(self, inner, reader):
+        self._inner = inner
+        self._reader = reader
+
+    def __enter__(self):
+        return self._inner
+
+    def __exit__(self, *exc):
+        self._reader.stop()
+        self._reader.join()
+        return False
+
+    def __iter__(self):
+        return iter(self._inner)
+
+
+def _spark_df_plan_hash(df) -> str:
+    """Hash the analyzed logical plan (parity: reference :494)."""
+    plan = df._jdf.queryExecution().analyzed().toString()
+    return hashlib.sha256(plan.encode("utf-8")).hexdigest()[:24]
+
+
+def _convert_precision_and_vectors(df, dtype: Optional[str]):
+    """float precision unification + Spark ML vector -> array conversion
+    (parity: reference :542,:565)."""
+    from pyspark.sql import functions as F
+    from pyspark.sql import types as T
+    converted = df
+    for field in df.schema.fields:
+        type_name = field.dataType.typeName()
+        if type_name in ("vectorudt",):
+            from pyspark.ml.functions import vector_to_array
+            converted = converted.withColumn(field.name, vector_to_array(F.col(field.name)))
+        elif dtype == "float32" and isinstance(field.dataType, T.DoubleType):
+            converted = converted.withColumn(field.name,
+                                             F.col(field.name).cast(T.FloatType()))
+        elif dtype == "float64" and isinstance(field.dataType, T.FloatType):
+            converted = converted.withColumn(field.name,
+                                             F.col(field.name).cast(T.DoubleType()))
+    return converted
+
+
+def _check_parquet_file_sizes(cache_dir_url: str):
+    """Warn when the materialized files are tiny (parity: reference :642)."""
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    fs, path = get_filesystem_and_path_or_paths(cache_dir_url)
+    try:
+        sizes = [fs.info(f)["size"] for f in fs.find(path)
+                 if f.endswith(".parquet")]
+    except Exception:  # noqa: BLE001
+        return
+    if sizes and sorted(sizes)[len(sizes) // 2] < 50 * (1 << 20):
+        warnings.warn(
+            "The median materialized Parquet file is smaller than 50 MB; "
+            "repartition the DataFrame to fewer partitions for better read "
+            "throughput (reference guidance).")
+
+
+def make_spark_converter(df, parent_cache_dir_url: Optional[str] = None,
+                         compression_codec: Optional[str] = None,
+                         dtype: Optional[str] = "float32") -> SparkDatasetConverter:
+    """Materialize ``df`` once into a cached Parquet store and return a
+    converter handle (parity: reference :664). Requires pyspark."""
+    try:
+        from pyspark.sql import SparkSession
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("make_spark_converter requires pyspark") from e
+
+    spark = SparkSession.builder.getOrCreate()
+    if parent_cache_dir_url is None:
+        parent_cache_dir_url = spark.conf.get(PARENT_CACHE_DIR_URL_CONF, None)
+    if not parent_cache_dir_url:
+        raise ValueError(
+            f"No cache directory: pass parent_cache_dir_url or set the "
+            f"{PARENT_CACHE_DIR_URL_CONF} Spark conf")
+
+    df = _convert_precision_and_vectors(df, dtype)
+    key = (_spark_df_plan_hash(df), parent_cache_dir_url, compression_codec)
+    with _cache_lock:
+        if key in _converter_cache:
+            return _converter_cache[key]
+
+    cache_dir_url = os.path.join(parent_cache_dir_url, uuid.uuid4().hex)
+    writer = df.write
+    if compression_codec:
+        writer = writer.option("compression", compression_codec)
+    writer.parquet(cache_dir_url)
+
+    from petastorm_tpu.etl.dataset_metadata import write_dataset_metadata
+    write_dataset_metadata(cache_dir_url, None)
+    _check_parquet_file_sizes(cache_dir_url)
+
+    dataset_size = df.count()
+    converter = SparkDatasetConverter(cache_dir_url, dataset_size,
+                                      parent_cache_dir_url)
+    with _cache_lock:
+        _converter_cache[key] = converter
+    _dirs_to_delete.add(cache_dir_url)
+    return converter

@@ -1,0 +1,24 @@
+"""Shuffle-quality analysis: rank correlation of read order against the
+unshuffled order (parity: reference petastorm/test_util/shuffling_analysis.py
+:29,:52)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_correlation_distance(reader_factory, id_field: str = "id",
+                                 num_runs: int = 1) -> float:
+    """Mean |Pearson correlation| between yielded id order and sorted order.
+
+    ~0 = well shuffled; 1 = unshuffled. ``reader_factory`` builds a fresh
+    reader per run.
+    """
+    correlations = []
+    for _ in range(num_runs):
+        with reader_factory() as reader:
+            ids = np.asarray([getattr(s, id_field) for s in reader], dtype=np.float64)
+        if len(ids) < 2:
+            raise ValueError("Need at least 2 rows to measure shuffle quality")
+        corr = np.corrcoef(np.arange(len(ids)), ids)[0, 1]
+        correlations.append(abs(corr))
+    return float(np.mean(correlations))

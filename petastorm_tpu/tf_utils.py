@@ -1,0 +1,134 @@
+"""TensorFlow adapter: readers -> ``tf.data.Dataset``.
+
+Kept for capability parity with the reference's primary consumer
+(petastorm/tf_utils.py); the first-class consumer here is
+:mod:`petastorm_tpu.jax`. TF is imported lazily so the package works without
+it.
+
+Parity: reference tf_utils.py — dtype map (:27), type sanitization
+``_sanitize_field_tf_types`` (:57, Decimal->str, datetime64->int64 ns,
+uint16/32 promotion), ``make_petastorm_dataset`` (:336 via from_generator),
+``tf_tensors`` (:269 via py_func — TF1 graph mode; here implemented over
+``tf.compat.v1``).
+"""
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def _sanitize_value(value):
+    """Decimal -> str, datetime64 -> ns int64, None -> error upstream."""
+    if isinstance(value, Decimal):
+        return str(value)
+    if isinstance(value, np.datetime64):
+        return value.astype("datetime64[ns]").astype(np.int64)
+    if isinstance(value, np.ndarray):
+        if value.dtype.kind == "M":
+            return value.astype("datetime64[ns]").astype(np.int64)
+        if value.dtype == object and value.size and isinstance(value.flat[0], Decimal):
+            return np.array([str(x) for x in value.flat], dtype=str).reshape(value.shape)
+    return value
+
+
+def _tf_dtype_for(numpy_dtype):
+    tf = _tf()
+    if numpy_dtype in (str, np.str_, bytes, np.bytes_):
+        return tf.string
+    if numpy_dtype is Decimal:
+        return tf.string
+    npdt = np.dtype(numpy_dtype)
+    if npdt.kind == "M":
+        return tf.int64
+    # TF has no uint16/uint32 kernels for many ops; promote like the reference.
+    if npdt == np.uint16:
+        return tf.int32
+    if npdt == np.uint32:
+        return tf.int64
+    return tf.as_dtype(npdt)
+
+
+def _promote(value, numpy_dtype):
+    npdt = None
+    try:
+        npdt = np.dtype(numpy_dtype)
+    except TypeError:
+        return value
+    if npdt == np.uint16:
+        return np.asarray(value).astype(np.int32)
+    if npdt == np.uint32:
+        return np.asarray(value).astype(np.int64)
+    return value
+
+
+def make_petastorm_dataset(reader):
+    """Wrap a reader as ``tf.data.Dataset`` (parity: reference :336).
+
+    Row readers yield one flat record dict per sample; batch readers yield
+    one dict of arrays per row group (re-batch with ``dataset.unbatch()`` /
+    ``batch()``).
+    """
+    tf = _tf()
+    schema = reader.schema
+    if getattr(reader, "ngram", None) is not None:
+        raise NotImplementedError(
+            "NGram TF datasets are not supported; iterate the reader directly")
+
+    names = list(schema.fields.keys())
+    signature = {}
+    for name in names:
+        f = schema.fields[name]
+        shape = tuple(d for d in f.shape)
+        if reader.batched_output:
+            shape = (None,) + shape
+        signature[name] = tf.TensorSpec(
+            shape=[None if d is None else d for d in shape],
+            dtype=_tf_dtype_for(f.numpy_dtype), name=name)
+
+    def generator():
+        if reader.last_row_consumed:
+            reader.reset()
+        for sample in reader:
+            out = {}
+            for name in names:
+                v = _sanitize_value(getattr(sample, name))
+                out[name] = _promote(v, schema.fields[name].numpy_dtype)
+            yield out
+
+    return tf.data.Dataset.from_generator(generator, output_signature=signature)
+
+
+def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int = 0):
+    """Graph-mode tensors via ``tf.compat.v1.py_func`` (parity: reference
+    :269). Requires TF1-style graph execution."""
+    tf = _tf()
+    schema = reader.schema
+    names = list(schema.fields.keys())
+
+    def dequeue():
+        sample = next(reader)
+        return [np.asarray(_promote(_sanitize_value(getattr(sample, n)),
+                                    schema.fields[n].numpy_dtype))
+                for n in names]
+
+    dtypes = [_tf_dtype_for(schema.fields[n].numpy_dtype) for n in names]
+    tensors = tf.compat.v1.py_func(dequeue, [], dtypes)
+    for t, n in zip(tensors, names):
+        f = schema.fields[n]
+        if all(d is not None for d in f.shape):
+            t.set_shape(f.shape)
+    if shuffling_queue_capacity > 0:
+        queue = tf.queue.RandomShuffleQueue(
+            shuffling_queue_capacity, min_after_dequeue,
+            dtypes=dtypes, name="petastorm_tpu_shuffling_queue")
+        enqueue = queue.enqueue(tensors)
+        tf.compat.v1.train.add_queue_runner(
+            tf.compat.v1.train.QueueRunner(queue, [enqueue]))
+        tensors = queue.dequeue()
+    return schema.namedtuple(**dict(zip(names, tensors)))

@@ -1,0 +1,64 @@
+"""``petastorm-tpu-copy-dataset``: copy a dataset with optional column
+narrowing and not-null filtering — Spark-free.
+
+Parity: reference petastorm/tools/copy_dataset.py:34 (a Spark job there).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.predicates import in_lambda
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.unischema import Unischema
+
+
+def copy_dataset(source_url: str, target_url: str, field_regex=None,
+                 not_null_fields=None, rows_per_row_group: int = 1000,
+                 workers_count: int = 4) -> int:
+    """Copy rows from one petastorm store to another; returns rows copied."""
+    predicate = None
+    if not_null_fields:
+        predicate = in_lambda(list(not_null_fields),
+                              lambda row: all(row[f] is not None for f in not_null_fields))
+    copied = 0
+    with make_reader(source_url, schema_fields=field_regex, predicate=predicate,
+                     shuffle_row_groups=False, num_epochs=1,
+                     workers_count=workers_count) as reader:
+        out_schema = Unischema(reader.schema.name + "_copy",
+                               list(reader.schema.fields.values()))
+        with materialize_dataset_local(target_url, out_schema,
+                                       rows_per_row_group=rows_per_row_group) as writer:
+            for sample in reader:
+                writer.write_row(sample._asdict())
+                copied += 1
+    return copied
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("source_url")
+    parser.add_argument("target_url")
+    parser.add_argument("--field-regex", nargs="+",
+                        help="Copy only fields matching these regexes")
+    parser.add_argument("--not-null-fields", nargs="+",
+                        help="Skip rows where any of these fields is null")
+    parser.add_argument("--rows-per-row-group", type=int, default=1000)
+    parser.add_argument("-w", "--workers-count", type=int, default=4)
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    copied = copy_dataset(args.source_url, args.target_url,
+                          field_regex=args.field_regex,
+                          not_null_fields=args.not_null_fields,
+                          rows_per_row_group=args.rows_per_row_group,
+                          workers_count=args.workers_count)
+    print(f"copied {copied} rows to {args.target_url}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,11 +69,27 @@ class ProcessPool:
     """
 
     def __init__(self, workers_count: int, serializer=None,
-                 zmq_copy_buffers: bool = True, results_queue_size: int = 50):
+                 zmq_copy_buffers: bool = True, results_queue_size: int = 50,
+                 transport: str = "auto", ring_capacity: int = 128 << 20):
         self.workers_count = workers_count
         self._serializer = serializer or PickleSerializer()
         self._zmq_copy = zmq_copy_buffers
         self._results_hwm = results_queue_size
+        if transport == "auto":
+            from petastorm_tpu.native import ring_available
+            transport = "shm" if ring_available() else "zmq"
+        if transport not in ("shm", "zmq"):
+            raise ValueError(f"transport must be 'auto', 'shm' or 'zmq', got {transport!r}")
+        self._transport = transport
+        self._ring_capacity = ring_capacity
+        self._rings = []           # consumer-side ShmRing per worker (shm mode)
+        self._ring_poll_idx = 0
+        self._partial = {}         # worker_id -> list of partial chunks
+        # Optional callable applied to deserialized data results INSIDE the
+        # poll. On the shm transport it runs while the zero-copy view is
+        # still valid, so the copying conversion (e.g. Arrow -> numpy)
+        # reads straight from mapped memory with no intermediate copy.
+        self.result_transform = None
         self._context = None
         self._work_socket = None
         self._control_socket = None
@@ -106,10 +122,19 @@ class ProcessPool:
         self._results_socket.set_hwm(self._results_hwm)
         self._results_socket.bind(self._endpoints["results"])
 
+        ring_names = None
+        if self._transport == "shm":
+            from petastorm_tpu.native import ShmRing
+            token = uuid.uuid4().hex[:10]
+            ring_names = [f"/ptring_{token}_{i}" for i in range(self.workers_count)]
+            self._rings = [ShmRing(name, capacity=self._ring_capacity, create=True)
+                           for name in ring_names]
+
         for worker_id in range(self.workers_count):
             p = exec_in_new_process(
                 _worker_bootstrap, worker_id, worker_class, worker_args,
-                type(self._serializer), self._endpoints, os.getpid())
+                type(self._serializer), self._endpoints, os.getpid(),
+                ring_names[worker_id] if ring_names else None)
             self._processes.append(p)
 
         # Ready-handshake: every worker's PUSH is connected before any
@@ -196,6 +221,9 @@ class ProcessPool:
         if self._context is not None:
             self._context.term()
             self._context = None
+        for ring in self._rings:
+            ring.close()
+        self._rings = []
         import shutil
         shutil.rmtree(self._ipc_dir, ignore_errors=True)
 
@@ -211,6 +239,72 @@ class ProcessPool:
 
     # ------------------------------------------------------------ internals
     def _poll_result(self, timeout_ms: int):
+        if self._transport == "shm" and self._rings:
+            return self._poll_result_shm(timeout_ms)
+        return self._poll_result_zmq(timeout_ms)
+
+    def _poll_result_shm(self, timeout_ms: int):
+        """Round-robin over worker rings. Frames: first byte C (pickled
+        control), D (serialized data) or P (partial data chunk; frames
+        accumulate until the terminating D).
+
+        Data frames are deserialized ZERO-COPY from the mapped ring memory;
+        the ring advances on the next poll, by which time the consumer has
+        converted the previous payload (the Reader converts each batch to
+        numpy before requesting another). Holding returned tables across
+        get_results calls is therefore not allowed on the shm transport."""
+        from petastorm_tpu.native import RingClosed
+        deadline = time.time() + timeout_ms / 1000.0
+        while True:
+            progressed = False
+            for _ in range(len(self._rings)):
+                idx = self._ring_poll_idx
+                self._ring_poll_idx = (self._ring_poll_idx + 1) % len(self._rings)
+                ring = self._rings[idx]
+                try:
+                    if not ring.poll(0):
+                        continue
+                    kind, view = ring.read_tagged_view(timeout_ms=0)
+                except RingClosed:
+                    continue
+                progressed = True
+                # The frame is consumed no matter what: a payload that fails
+                # to deserialize/convert must not be re-peeked forever.
+                try:
+                    if kind == ord("C"):
+                        return pickle.loads(view)
+                    if kind == ord("P"):
+                        self._partial.setdefault(idx, []).append(bytes(view))
+                        continue
+                    if self._partial.get(idx):
+                        payload = b"".join(self._partial.pop(idx) + [bytes(view)])
+                        result = self._serializer.deserialize(payload)
+                    else:
+                        # Zero-copy: deserialize straight from mapped memory;
+                        # the transform (if any) copies before we advance.
+                        result = self._serializer.deserialize(view)
+                        if self.result_transform is None:
+                            # No copying transform: take one safe copy so the
+                            # result cannot alias the reused ring memory.
+                            result = self._serializer.deserialize(bytes(view))
+                    if self.result_transform is not None:
+                        result = self.result_transform(result)
+                    return result
+                finally:
+                    try:
+                        view.release()
+                    except BufferError:
+                        # Something still references the mapped region (a bug
+                        # or an in-flight exception); advancing regardless is
+                        # required for progress — the error path owns the risk.
+                        pass
+                    ring.advance()
+            if not progressed:
+                if time.time() >= deadline:
+                    return None
+                time.sleep(0.0001)
+
+    def _poll_result_zmq(self, timeout_ms: int):
         import zmq
         if not self._results_socket.poll(timeout_ms, zmq.POLLIN):
             return None
@@ -220,8 +314,12 @@ class ProcessPool:
             payload = payload if isinstance(payload, bytes) else bytes(memoryview(payload))
             return pickle.loads(payload)
         if isinstance(payload, bytes):
-            return self._serializer.deserialize(payload)
-        return self._serializer.deserialize(memoryview(payload))
+            result = self._serializer.deserialize(payload)
+        else:
+            result = self._serializer.deserialize(memoryview(payload))
+        if self.result_transform is not None:
+            result = self.result_transform(result)
+        return result
 
     def _check_processes_alive(self):
         for i, p in enumerate(self._processes):
@@ -234,7 +332,7 @@ class ProcessPool:
 
 # ------------------------------------------------------------- worker side
 def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
-                      endpoints, parent_pid):
+                      endpoints, parent_pid, ring_name=None):
     """Entry function of a spawned worker process (reference :330)."""
     import zmq
 
@@ -249,11 +347,30 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
 
     serializer = serializer_cls()
 
-    def send_ctrl(obj):
-        results_socket.send_multipart([_KIND_CTRL, pickle.dumps(obj)])
+    ring = None
+    if ring_name is not None:
+        from petastorm_tpu.native import ShmRing
+        ring = ShmRing(ring_name, create=False)
+        max_frame = max(4096, int(ring._lib.pt_ring_capacity(ring._handle)) // 2 - 4096)
 
-    def publish(data):
-        results_socket.send_multipart([_KIND_DATA, serializer.serialize(data)])
+        def send_ctrl(obj):
+            ring.write_tagged(ord("C"), pickle.dumps(obj))
+
+        def publish(data):
+            payload = memoryview(serializer.serialize(data))
+            # Chunk payloads bigger than a quarter of the ring so one giant
+            # row group can never deadlock against its own backpressure;
+            # memoryview slices keep chunking copy-free.
+            while len(payload) > max_frame:
+                ring.write_tagged(ord("P"), payload[:max_frame])
+                payload = payload[max_frame:]
+            ring.write_tagged(ord("D"), payload)
+    else:
+        def send_ctrl(obj):
+            results_socket.send_multipart([_KIND_CTRL, pickle.dumps(obj)])
+
+        def publish(data):
+            results_socket.send_multipart([_KIND_DATA, serializer.serialize(data)])
 
     # Orphan watchdog: exit hard if the parent dies (reference :320-327).
     def _watch_parent():
