@@ -110,7 +110,8 @@ def make_reader(dataset_url,
                 transform_spec=None,
                 storage_options: Optional[dict] = None,
                 filesystem=None,
-                zmq_copy_buffers: bool = True):
+                zmq_copy_buffers: bool = True,
+                resume_state: Optional[dict] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -163,7 +164,8 @@ def make_reader(dataset_url,
                   seed=seed,
                   cache=cache,
                   transform_spec=transform_spec,
-                  storage_options=storage_options)
+                  storage_options=storage_options,
+                  resume_state=resume_state)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -188,7 +190,8 @@ def make_batch_reader(dataset_url_or_urls,
                       transform_spec=None,
                       storage_options: Optional[dict] = None,
                       filesystem=None,
-                      zmq_copy_buffers: bool = True):
+                      zmq_copy_buffers: bool = True,
+                      resume_state: Optional[dict] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -227,7 +230,8 @@ def make_batch_reader(dataset_url_or_urls,
                   seed=seed,
                   cache=cache,
                   transform_spec=transform_spec,
-                  storage_options=storage_options)
+                  storage_options=storage_options,
+                  resume_state=resume_state)
 
 
 class Reader:
@@ -240,7 +244,7 @@ class Reader:
                  is_batched_reader, shuffle_row_groups, shuffle_rows,
                  shuffle_row_drop_partitions, predicate, rowgroup_selector,
                  num_epochs, cur_shard, shard_count, shard_seed, seed, cache,
-                 transform_spec, storage_options):
+                 transform_spec, storage_options, resume_state=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -316,12 +320,25 @@ class Reader:
                                                    schema=self.schema,
                                                    force_copy=True)
 
+        start_epoch, start_offset = 0, 0
+        if resume_state is not None:
+            if shuffle_row_groups and seed is None:
+                raise ValueError(
+                    "Exact resume requires a seed when shuffle_row_groups is on "
+                    "(the epoch permutation must be reproducible)")
+            start_epoch = int(resume_state.get("epoch", 0))
+            start_offset = int(resume_state.get("offset", 0))
+            if start_offset >= len(items):
+                raise ValueError(f"resume offset {start_offset} >= {len(items)} work items "
+                                 "(did the dataset or its filtering change?)")
         self._ventilator = ConcurrentVentilator(
             self._pool.ventilate, items,
             iterations=num_epochs,
             randomize_item_order=shuffle_row_groups,
             random_seed=seed,
-            max_ventilation_queue_size=self._pool.workers_count * (1 + _VENTILATE_EXTRA_ROWGROUPS))
+            max_ventilation_queue_size=self._pool.workers_count * (1 + _VENTILATE_EXTRA_ROWGROUPS),
+            start_epoch=start_epoch,
+            start_offset=start_offset)
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         if is_batched_reader:
@@ -397,6 +414,16 @@ class Reader:
 
     def next(self):
         return self.__next__()
+
+    def state_dict(self) -> dict:
+        """Checkpoint of the read position at row-group granularity: pass it
+        back as ``resume_state=`` to a new reader (same dataset, filters,
+        sharding, seed) to continue the stream. The row group that was
+        mid-delivery is re-read on resume — consumers must tolerate replay of
+        the last partially-consumed group. The reference has no resume at
+        all (its reset() is epoch-end only, reader.py:503)."""
+        s = self._ventilator.state
+        return {"epoch": s["epoch"], "offset": s["offset"]}
 
     def reset(self):
         """Start another pass. Only legal after the current pass finished

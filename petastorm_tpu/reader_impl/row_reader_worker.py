@@ -91,6 +91,16 @@ class RowReaderWorker(WorkerBase):
         self._files = None
         self._rng = np.random.default_rng(
             None if args.get("seed") is None else args["seed"] + worker_id)
+        # Invariant across process() calls; computed once (hot path).
+        schema = args["schema"]
+        view_schema = args["view_schema"]
+        ngram = args.get("ngram")
+        if ngram is not None:
+            self._needed = set(ngram.get_field_names_at_all_timesteps())
+        else:
+            self._needed = set(view_schema.fields.keys())
+        self._decode_schema = schema.create_schema_view(
+            [n for n in sorted(self._needed) if n in schema.fields])
 
     # Lazily build per-process handles (cheap for threads, required for processes).
     def _ensure_open(self):
@@ -103,17 +113,11 @@ class RowReaderWorker(WorkerBase):
 
     def process(self, rowgroup, shuffle_row_drop_partition=(0, 1)):
         self._ensure_open()
-        schema = self.args["schema"]
-        view_schema = self.args["view_schema"]
         ngram = self.args.get("ngram")
         predicate = self.args.get("predicate")
         transform_spec = self.args.get("transform_spec")
-        cache = self.args.get("cache")
-
-        if ngram is not None:
-            needed = set(ngram.get_field_names_at_all_timesteps())
-        else:
-            needed = set(view_schema.fields.keys())
+        view_schema = self.args["view_schema"]
+        needed = self._needed
 
         if predicate is not None:
             rows = self._load_rows_with_predicate(rowgroup, needed, predicate,
@@ -121,9 +125,7 @@ class RowReaderWorker(WorkerBase):
         else:
             rows = self._maybe_cached(rowgroup, needed, shuffle_row_drop_partition)
 
-        decode_schema = schema.create_schema_view(
-            [n for n in sorted(needed) if n in schema.fields])
-        decoded = [decode_row(r, decode_schema) for r in rows]
+        decoded = [decode_row(r, self._decode_schema) for r in rows]
 
         if transform_spec is not None and transform_spec.func is not None:
             decoded = [transform_spec.func(r) for r in decoded]

@@ -73,14 +73,16 @@ class SparkDatasetConverter:
         jax.process_index)."""
         from petastorm_tpu.jax import BatchedDataLoader
         from petastorm_tpu.reader import make_batch_reader
-        try:
-            reader = make_batch_reader(self.cache_dir_url, cur_shard=cur_shard,
-                                       num_epochs=num_epochs, **reader_kwargs)
-        except Exception:
-            if cur_shard != "auto":
-                raise
-            reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
-                                       **reader_kwargs)
+        if cur_shard == "auto":
+            try:
+                import jax
+                jax.process_index()
+            except Exception:  # jax absent or distributed runtime not up
+                logger.warning("cur_shard='auto' but the JAX runtime is "
+                               "unavailable; reading unsharded")
+                cur_shard = None
+        reader = make_batch_reader(self.cache_dir_url, cur_shard=cur_shard,
+                                   num_epochs=num_epochs, **reader_kwargs)
         return BatchedDataLoader(reader, batch_size=batch_size, sharding=sharding)
 
     def make_tf_dataset(self, batch_size: Optional[int] = None,

@@ -213,6 +213,17 @@ class Unischema:
     def namedtuple(self):
         return _NamedtupleCache.get(self._name, list(self._fields.keys()))
 
+    @property
+    def decode_plan(self):
+        """Cached [(name, field, resolved_codec)] list for the row-decode hot
+        loop (avoids per-row codec resolution)."""
+        plan = self.__dict__.get("_decode_plan")
+        if plan is None:
+            plan = [(name, f, f.codec or _default_codec(f))
+                    for name, f in self._fields.items()]
+            self.__dict__["_decode_plan"] = plan
+        return plan
+
     # ------------------------------------------------------------- renderers
     def as_arrow_schema(self):
         """Render the *storage* schema (post-codec-encode) as pyarrow.Schema."""

@@ -16,13 +16,12 @@ def decode_row(row: dict, schema: Unischema) -> dict:
     schema may be a narrowed view). ``None`` cells stay ``None``.
     """
     decoded = {}
-    for name, field in schema.fields.items():
+    for name, field, codec in schema.decode_plan:
         if name not in row:
             continue
         value = row[name]
         if value is None:
             decoded[name] = None
             continue
-        codec = field.codec or _default_codec(field)
         decoded[name] = codec.decode(field, value)
     return decoded

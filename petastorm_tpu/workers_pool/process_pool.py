@@ -279,14 +279,14 @@ class ProcessPool:
                     if self._partial.get(idx):
                         payload = b"".join(self._partial.pop(idx) + [bytes(view)])
                         result = self._serializer.deserialize(payload)
-                    else:
+                    elif self.result_transform is not None:
                         # Zero-copy: deserialize straight from mapped memory;
-                        # the transform (if any) copies before we advance.
+                        # the transform copies before we advance.
                         result = self._serializer.deserialize(view)
-                        if self.result_transform is None:
-                            # No copying transform: take one safe copy so the
-                            # result cannot alias the reused ring memory.
-                            result = self._serializer.deserialize(bytes(view))
+                    else:
+                        # No copying transform: deserialize from one safe
+                        # copy so the result cannot alias the reused ring.
+                        result = self._serializer.deserialize(bytes(view))
                     if self.result_transform is not None:
                         result = self.result_transform(result)
                     return result

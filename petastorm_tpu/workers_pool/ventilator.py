@@ -63,7 +63,13 @@ class ConcurrentVentilator(Ventilator):
                  randomize_item_order: bool = False,
                  random_seed: Optional[int] = None,
                  max_ventilation_queue_size: Optional[int] = None,
-                 ventilation_interval: float = _VENTILATION_INTERVAL_S):
+                 ventilation_interval: float = _VENTILATION_INTERVAL_S,
+                 start_epoch: int = 0,
+                 start_offset: int = 0):
+        """``start_epoch``/``start_offset`` resume ventilation mid-stream:
+        epoch ``start_epoch`` begins at item index ``start_offset`` of its
+        (seeded) order — the checkpoint/resume mechanism (exact when
+        ``random_seed`` is set)."""
         super().__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError(f"iterations must be positive or None, got {iterations}")
@@ -73,13 +79,19 @@ class ConcurrentVentilator(Ventilator):
         self._seed = random_seed
         self._max_inflight = max_ventilation_queue_size or max(1, len(self._items))
         self._interval = ventilation_interval
+        if self._items and not 0 <= start_offset < max(1, len(self._items)):
+            raise ValueError(f"start_offset {start_offset} out of range")
+        self._start_epoch = start_epoch
+        self._start_offset = start_offset
 
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         self._stop_event = threading.Event()
         self._completed_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._epoch = 0
+        self._epoch = start_epoch
+        self._processed_total = 0
+        self._state_lock = threading.Lock()
 
     # ------------------------------------------------------------------ api
     def start(self):
@@ -93,6 +105,21 @@ class ConcurrentVentilator(Ventilator):
         with self._inflight_cv:
             self._inflight = max(0, self._inflight - 1)
             self._inflight_cv.notify_all()
+        with self._state_lock:
+            self._processed_total += 1
+
+    @property
+    def state(self) -> Dict[str, Any]:
+        """Resume point: the (epoch, offset) of the next unprocessed item.
+        Feed back as ``start_epoch``/``start_offset`` (with the same items,
+        seed and shuffle flag) to continue exactly where consumption stopped;
+        in-flight items after the cursor are re-read on resume."""
+        n = max(1, len(self._items))
+        with self._state_lock:
+            consumed = (self._start_epoch * n + self._start_offset
+                        + self._processed_total)
+        return {"epoch": consumed // n, "offset": consumed % n,
+                "seed": self._seed, "randomized": self._randomize}
 
     def completed(self) -> bool:
         # A stopped ventilator will never ventilate again: report completed
@@ -122,6 +149,10 @@ class ConcurrentVentilator(Ventilator):
         # Restart from epoch 0 so a reset ventilator replays the exact same
         # seeded order as a fresh one (multi-host shards stay in lockstep).
         self._epoch = 0
+        self._start_epoch = 0
+        self._start_offset = 0
+        with self._state_lock:
+            self._processed_total = 0
         self.start()
 
     # ------------------------------------------------------------ internals
@@ -137,10 +168,18 @@ class ConcurrentVentilator(Ventilator):
             self._completed_event.set()
             return
         iterations_left = self._iterations_total
+        if iterations_left is not None:
+            iterations_left -= self._start_epoch
+            if iterations_left <= 0:
+                self._completed_event.set()
+                return
+        skip = self._start_offset
         while not self._stop_event.is_set():
             if iterations_left is not None and iterations_left <= 0:
                 break
-            for item in self._epoch_order(self._epoch):
+            epoch_items = self._epoch_order(self._epoch)[skip:]
+            skip = 0
+            for item in epoch_items:
                 with self._inflight_cv:
                     while (self._inflight >= self._max_inflight
                            and not self._stop_event.is_set()):
