@@ -32,6 +32,7 @@ Variable-length (``None``-dim) fields are padded to
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from typing import Dict, Optional
 
@@ -40,6 +41,7 @@ import numpy as np
 from petastorm_tpu.jax.batched_buffer import (BatchedNoopShufflingBuffer,
                                               BatchedRandomShufflingBuffer)
 from petastorm_tpu.jax.dtypes import DEFAULT_POLICY, DTypePolicy, sanitize_batch
+from petastorm_tpu.metrics import PipelineMetrics, trace
 
 logger = logging.getLogger(__name__)
 
@@ -63,11 +65,14 @@ class LoaderBase:
         self._pad_varlen = pad_variable_length_to
         self._keep_host = keep_host_fields
         self._in_iter = False
+        self.metrics = PipelineMetrics()
+        self._last_staged_bytes = 0
 
     # ------------------------------------------------------------ staging
     def _stage(self, host_batch: Dict[str, np.ndarray]) -> dict:
         import jax
         device_cols, host_cols = sanitize_batch(host_batch, self._policy)
+        self._last_staged_bytes = sum(v.nbytes for v in device_cols.values())
         if self._sharding is not None:
             staged = {
                 k: jax.make_array_from_process_local_data(self._sharding, v)
@@ -84,8 +89,21 @@ class LoaderBase:
     def _prefetched(self, host_batches):
         """Keep ``prefetch`` async device transfers in flight."""
         window: deque = deque()
-        for hb in host_batches:
-            window.append(self._stage(hb))
+        it = iter(host_batches)
+        while True:
+            t0 = time.perf_counter()
+            with trace("petastorm_tpu.host_batch"):
+                try:
+                    hb = next(it)
+                except StopIteration:
+                    break
+            t1 = time.perf_counter()
+            with trace("petastorm_tpu.stage"):
+                staged = self._stage(hb)
+            t2 = time.perf_counter()
+            n = len(next(iter(hb.values()))) if hb else 0
+            self.metrics.record_batch(n, self._last_staged_bytes, t1 - t0, t2 - t1)
+            window.append(staged)
             if len(window) > self._prefetch:
                 yield window.popleft()
         while window:

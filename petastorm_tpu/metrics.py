@@ -1,0 +1,85 @@
+"""Structured pipeline metrics + profiler trace annotations.
+
+The reference's observability is three ad-hoc hooks (cProfile-wrapped
+threads, per-pool diagnostics dicts, a TF queue-size node — SURVEY.md §5).
+Here every loader keeps a :class:`PipelineMetrics` and the staging path is
+wrapped in ``jax.profiler`` trace annotations, so input-pipeline time shows
+up by name in TPU profiler traces next to the device steps.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PipelineMetrics:
+    """Thread-safe counters for one loader/reader pipeline."""
+    batches: int = 0
+    samples: int = 0
+    bytes_staged: int = 0
+    host_wait_s: float = 0.0     # waiting on reader/collate (host side)
+    stage_s: float = 0.0         # sanitize + device_put dispatch
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_batch(self, samples: int, nbytes: int, host_wait_s: float,
+                     stage_s: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.samples += samples
+            self.bytes_staged += nbytes
+            self.host_wait_s += host_wait_s
+            self.stage_s += stage_s
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {"batches": self.batches, "samples": self.samples,
+                    "bytes_staged": self.bytes_staged,
+                    "host_wait_s": round(self.host_wait_s, 4),
+                    "stage_s": round(self.stage_s, 4)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.batches = self.samples = self.bytes_staged = 0
+            self.host_wait_s = self.stage_s = 0.0
+
+
+_TRACE_ANNOTATION = None  # resolved once; False = jax unavailable
+
+
+@contextmanager
+def trace(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is importable, no-op
+    otherwise — safe to use in worker processes pinned off the TPU. The
+    import is attempted once (failed imports are not cached by python, and
+    this sits on the per-batch hot path)."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _TRACE_ANNOTATION = TraceAnnotation
+        except ImportError:  # pragma: no cover
+            _TRACE_ANNOTATION = False
+    if _TRACE_ANNOTATION is False:
+        yield
+        return
+    with _TRACE_ANNOTATION(name):
+        yield
+
+
+class StopwatchNS:
+    __slots__ = ("t0",)
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.t0 = time.perf_counter() - self.t0
+        return False
+
+    @property
+    def seconds(self) -> float:
+        return self.t0

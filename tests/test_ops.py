@@ -1,0 +1,39 @@
+"""Device-op tests (pallas kernel in interpret mode on the CPU mesh)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petastorm_tpu.ops.image_ops import normalize_images
+
+
+def test_normalize_xla_path_correctness():
+    imgs = np.random.default_rng(0).integers(0, 255, (2, 8, 8, 3)).astype(np.uint8)
+    out = normalize_images(jnp.asarray(imgs), use_pallas=False)
+    assert out.dtype == jnp.bfloat16
+    expected = (imgs / 255.0 - np.array([0.485, 0.456, 0.406])) / np.array(
+        [0.229, 0.224, 0.225])
+    np.testing.assert_allclose(np.asarray(out, np.float32), expected, atol=1e-2)
+
+
+def test_normalize_pallas_interpret_matches_xla():
+    # 8*224*224*3 flattens to (9408, 128); block picks lcm(3,32)*k rows.
+    imgs = np.random.default_rng(1).integers(0, 255, (8, 224, 224, 3)).astype(np.uint8)
+    x = jnp.asarray(imgs)
+    out_pallas = normalize_images(x, use_pallas=True)   # interpret on CPU
+    out_xla = normalize_images(x, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(out_pallas, np.float32),
+                                  np.asarray(out_xla, np.float32))
+
+
+def test_normalize_pallas_rejects_untileable():
+    imgs = jnp.zeros((1, 5, 5, 3), jnp.uint8)
+    with pytest.raises(ValueError, match="tile"):
+        normalize_images(imgs, use_pallas=True)
+
+
+def test_normalize_custom_mean_std_and_dtype():
+    imgs = np.full((4, 32, 32, 3), 128, np.uint8)
+    out = normalize_images(jnp.asarray(imgs), mean=(0.5, 0.5, 0.5),
+                           std=(0.5, 0.5, 0.5), out_dtype=jnp.float32,
+                           use_pallas=False)
+    np.testing.assert_allclose(np.asarray(out), (128 / 255 - 0.5) / 0.5, atol=1e-6)
