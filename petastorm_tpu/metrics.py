@@ -9,7 +9,6 @@ up by name in TPU profiler traces next to the device steps.
 from __future__ import annotations
 
 import threading
-import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -67,19 +66,3 @@ def trace(name: str):
         return
     with _TRACE_ANNOTATION(name):
         yield
-
-
-class StopwatchNS:
-    __slots__ = ("t0",)
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.t0 = time.perf_counter() - self.t0
-        return False
-
-    @property
-    def seconds(self) -> float:
-        return self.t0

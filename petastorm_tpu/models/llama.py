@@ -31,6 +31,12 @@ class LlamaConfig:
     hidden: int = 14336
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
+    # Mixture-of-experts: every ``moe_every``-th layer uses ``n_experts``
+    # soft-mixture experts (0 = dense MLP everywhere). Expert weights carry a
+    # leading expert axis that param_shardings places on the model axis —
+    # expert parallelism sharing the TP mesh axis (the common ep=tp layout).
+    n_experts: int = 0
+    moe_every: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -54,19 +60,35 @@ def init_params(rng_key, cfg: LlamaConfig):
         "lm_head": mat(next(keys), cfg.dim, cfg.vocab),
     }
     hd = cfg.head_dim
-    for _ in range(cfg.n_layers):
-        params["layers"].append({
+    for li in range(cfg.n_layers):
+        layer = {
             "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
             "wq": mat(next(keys), cfg.dim, cfg.n_heads * hd),
             "wk": mat(next(keys), cfg.dim, cfg.n_kv_heads * hd),
             "wv": mat(next(keys), cfg.dim, cfg.n_kv_heads * hd),
             "wo": mat(next(keys), cfg.n_heads * hd, cfg.dim),
             "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
-            "w1": mat(next(keys), cfg.dim, cfg.hidden),   # gate
-            "w3": mat(next(keys), cfg.dim, cfg.hidden),   # up
-            "w2": mat(next(keys), cfg.hidden, cfg.dim),   # down
-        })
+        }
+        if _is_moe_layer(cfg, li):
+            E = cfg.n_experts
+            k1, k2, k3, k4 = jax.random.split(next(keys), 4)
+            layer["router"] = jax.random.normal(k1, (cfg.dim, E), jnp.float32) * 0.02
+            layer["ew1"] = jax.random.normal(k2, (E, cfg.dim, cfg.hidden),
+                                             jnp.float32) / np.sqrt(cfg.dim)
+            layer["ew3"] = jax.random.normal(k3, (E, cfg.dim, cfg.hidden),
+                                             jnp.float32) / np.sqrt(cfg.dim)
+            layer["ew2"] = jax.random.normal(k4, (E, cfg.hidden, cfg.dim),
+                                             jnp.float32) / np.sqrt(cfg.hidden)
+        else:
+            layer["w1"] = mat(next(keys), cfg.dim, cfg.hidden)   # gate
+            layer["w3"] = mat(next(keys), cfg.dim, cfg.hidden)   # up
+            layer["w2"] = mat(next(keys), cfg.hidden, cfg.dim)   # down
+        params["layers"].append(layer)
     return params
+
+
+def _is_moe_layer(cfg: LlamaConfig, layer_idx: int) -> bool:
+    return cfg.n_experts > 0 and layer_idx % cfg.moe_every == cfg.moe_every - 1
 
 
 def param_shardings(mesh, cfg: LlamaConfig, model_axis: str = "model"):
@@ -76,7 +98,7 @@ def param_shardings(mesh, cfg: LlamaConfig, model_axis: str = "model"):
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    layer = {
+    dense_layer = {
         "attn_norm": ns(),
         "wq": ns(None, model_axis), "wk": ns(None, model_axis),
         "wv": ns(None, model_axis), "wo": ns(model_axis, None),
@@ -84,9 +106,22 @@ def param_shardings(mesh, cfg: LlamaConfig, model_axis: str = "model"):
         "w1": ns(None, model_axis), "w3": ns(None, model_axis),
         "w2": ns(model_axis, None),
     }
+    moe_layer = {
+        "attn_norm": ns(),
+        "wq": ns(None, model_axis), "wk": ns(None, model_axis),
+        "wv": ns(None, model_axis), "wo": ns(model_axis, None),
+        "mlp_norm": ns(),
+        "router": ns(),
+        # Expert parallelism: the leading expert axis is sharded over the
+        # model axis (ep shares the tp mesh axis).
+        "ew1": ns(model_axis, None, None),
+        "ew3": ns(model_axis, None, None),
+        "ew2": ns(model_axis, None, None),
+    }
     return {
         "embed": ns(model_axis, None),     # vocab-sharded embedding
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": [dict(moe_layer) if _is_moe_layer(cfg, li) else dict(dense_layer)
+                   for li in range(cfg.n_layers)],
         "norm_out": ns(),
         "lm_head": ns(None, model_axis),
     }
@@ -110,6 +145,20 @@ def _rope(x, theta):
     cos = cos[None, :, None, :].astype(x.dtype)
     sin = sin[None, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _moe_block(h, layer):
+    """Soft-mixture MoE with dense dispatch: every expert runs on every
+    token and outputs combine by router probability. O(E) FLOPs, but fully
+    GSPMD-shardable on the expert axis with no all-to-all — the ep pattern
+    used for the multi-chip dry run (switch-style sparse dispatch is a
+    later-round optimization)."""
+    probs = jax.nn.softmax(
+        (h.astype(jnp.float32) @ layer["router"]), axis=-1).astype(h.dtype)
+    gate = jax.nn.silu(jnp.einsum("bsd,edh->besh", h, layer["ew1"].astype(h.dtype)))
+    up = jnp.einsum("bsd,edh->besh", h, layer["ew3"].astype(h.dtype))
+    expert_out = jnp.einsum("besh,ehd->besd", gate * up, layer["ew2"].astype(h.dtype))
+    return jnp.einsum("besd,bse->bsd", expert_out, probs)
 
 
 def _dense_causal_attention(q, k, v):
@@ -153,9 +202,12 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
         attn = attn.reshape(b, s, cfg.n_heads * hd)
         x = constrain(x + attn @ layer["wo"].astype(attn.dtype))
         h = _rmsnorm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ layer["w1"].astype(h.dtype))
-        up = h @ layer["w3"].astype(h.dtype)
-        x = constrain(x + (gate * up) @ layer["w2"].astype(h.dtype))
+        if "router" in layer:
+            x = constrain(x + _moe_block(h, layer))
+        else:
+            gate = jax.nn.silu(h @ layer["w1"].astype(h.dtype))
+            up = h @ layer["w3"].astype(h.dtype)
+            x = constrain(x + (gate * up) @ layer["w2"].astype(h.dtype))
     x = _rmsnorm(x, params["norm_out"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 

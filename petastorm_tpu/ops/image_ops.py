@@ -51,6 +51,9 @@ def normalize_images(images, mean: tuple = (0.485, 0.456, 0.406),
     otherwise (numerically identical at float32 accuracy).
     """
     channels = images.shape[-1]
+    if len(mean) < channels or len(std) < channels:
+        raise ValueError(f"images have {channels} channels but mean/std supply "
+                         f"{len(mean)}/{len(std)} values")
     mean_arr = jnp.asarray(mean, jnp.float32)[:channels]
     std_arr = jnp.asarray(std, jnp.float32)[:channels]
     # (x/255 - mean)/std  ==  x * scale + bias

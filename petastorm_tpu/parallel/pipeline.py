@@ -1,0 +1,89 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over a mesh
+axis, built from ``shard_map`` + ``lax.ppermute`` + ``lax.scan``.
+
+Stage parameters are stacked on a leading axis sharded over ``pipe``; the
+input batch is split into ``n_microbatches`` that flow down the device chain,
+one hop per scan step (activations move over ICI between neighbors). The
+schedule runs ``M + S - 1`` steps (the usual GPipe bubble); autodiff through
+``ppermute``/``scan`` yields the reverse schedule automatically, so the same
+wrapped function works inside ``jax.grad`` — no hand-written backward pass.
+
+This covers the 'pp' axis of the multi-chip dry run; it composes with data
+parallelism by adding a ``data`` axis to the mesh (batch dim sharded as
+usual).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn: Callable, params, x, n_microbatches: int,
+                   axis_name: str = "pipe"):
+    """Run inside ``shard_map``: apply ``S`` pipelined stages to ``x``.
+
+    :param stage_fn: ``f(stage_params, microbatch) -> microbatch`` — one
+        pipeline stage (shapes preserved)
+    :param params: pytree whose leaves have a leading local stage axis of
+        size 1 (the shard of the stacked (S, ...) parameters)
+    :param x: full local batch (rows divisible by n_microbatches); identical
+        on every stage (replicated input)
+    :returns: ``stage_fn`` composed S times over x, replicated on all stages
+    """
+    S = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    p_local = jax.tree.map(lambda a: a[0], params)
+
+    M = n_microbatches
+    if x.shape[0] % M:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {M} microbatches")
+    mbs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def step(recv, t):
+        # Stage 0 consumes microbatch t (clamped in the drain phase; those
+        # results are masked out later); other stages consume the neighbor's
+        # activation from the previous step.
+        inp = jnp.where(idx == 0, mbs[jnp.clip(t, 0, M - 1)], recv)
+        out = stage_fn(p_local, inp)
+        send = jax.lax.ppermute(out, axis_name, fwd_perm)
+        emit = jnp.where(idx == S - 1, out, jnp.zeros_like(out))
+        return send, emit
+
+    recv0 = jnp.zeros_like(mbs[0])
+    _, emits = jax.lax.scan(step, recv0, jnp.arange(M + S - 1))
+    # The last stage finishes microbatch m at step m + S - 1.
+    outs = emits[S - 1:]
+    # Only the last stage holds real values; psum replicates them to all
+    # stages (every other contribution is zero).
+    outs = jax.lax.psum(outs, axis_name)
+    return outs.reshape(x.shape)
+
+
+def make_pipeline(mesh, stage_fn: Callable, n_microbatches: int,
+                  pipe_axis: str = "pipe", data_axis: str = None):
+    """Wrap :func:`pipeline_apply` in ``shard_map`` over ``mesh``.
+
+    Returns ``f(stacked_params, x) -> y`` where ``stacked_params`` leaves
+    have shape (S, ...) (sharded over ``pipe_axis``) and ``x`` is the global
+    batch (optionally sharded over ``data_axis`` — each data-parallel group
+    runs its own pipeline on its batch shard).
+    """
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = partial(pipeline_apply, stage_fn, n_microbatches=n_microbatches,
+                 axis_name=pipe_axis)
+    x_spec = P(data_axis) if data_axis else P()
+    return shard_map(fn, mesh=mesh, in_specs=(P(pipe_axis), x_spec),
+                     out_specs=x_spec, check_vma=False)
+
+
+def stack_stage_params(per_stage_params: list):
+    """[pytree per stage] -> pytree with a leading (S, ...) axis."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage_params)

@@ -113,29 +113,34 @@ def _hash_bucket(value, num_buckets: int) -> int:
 class in_pseudorandom_split(PredicateBase):
     """Deterministic train/val/test splitting by hashing an id field.
 
+    Byte-compatible with the reference's bucketing (predicates.py:144-182:
+    ``md5(str(value)) % sys.maxsize`` against ``fraction * (sys.maxsize-1)``
+    bounds), so splits defined by existing petastorm pipelines select the
+    exact same rows here.
+
     :param fraction_list: split fractions summing to <= 1.0
     :param subset_index: which split this predicate selects
     :param predicate_field: the id field hashed for bucketing
     """
 
-    _NUM_BUCKETS = 1 << 20
-
     def __init__(self, fraction_list, subset_index: int, predicate_field: str):
+        import sys
         if subset_index >= len(fraction_list):
             raise ValueError("subset_index out of range")
+        if sum(fraction_list) > 1.0 + 1e-9:
+            raise ValueError(f"fractions sum to {sum(fraction_list)} > 1")
         self._field = predicate_field
-        cumulative = 0.0
-        bounds = []
-        for frac in fraction_list:
-            bounds.append((cumulative, cumulative + frac))
-            cumulative += frac
-        if cumulative > 1.0 + 1e-9:
-            raise ValueError(f"fractions sum to {cumulative} > 1")
-        self._low, self._high = bounds[subset_index]
+        high_borders = [sum(fraction_list[:i + 1]) for i in range(len(fraction_list))]
+        fraction_low = high_borders[subset_index - 1] if subset_index else 0.0
+        self._bucket_low = fraction_low * (sys.maxsize - 1)
+        self._bucket_high = high_borders[subset_index] * (sys.maxsize - 1)
+        self._maxsize = sys.maxsize
 
     def get_fields(self):
         return {self._field}
 
     def do_include(self, values):
-        u = _hash_bucket(values[self._field], self._NUM_BUCKETS) / self._NUM_BUCKETS
-        return self._low <= u < self._high
+        if self._field not in values:
+            raise ValueError(f"Tested values do not have split key {self._field!r}")
+        bucket = _hash_bucket(values[self._field], self._maxsize)
+        return self._bucket_low <= bucket < self._bucket_high

@@ -110,3 +110,14 @@ def test_batch_reader_on_petastorm_dataset(synthetic_dataset):
                            shuffle_row_groups=False, reader_pool_type="dummy") as reader:
         ids = np.concatenate([b.id for b in reader])
     assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_batch_reader_multiple_urls(scalar_dataset):
+    """A list of file URLs reads as one dataset (parity: reference
+    make_batch_reader accepts dataset_url_or_urls)."""
+    base = scalar_dataset.url
+    urls = [f"{base}/a.parquet", f"{base}/b.parquet"]
+    with make_batch_reader(urls, schema_fields=["id"], shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        ids = np.concatenate([b.id for b in reader])
+    assert sorted(ids.tolist()) == list(range(100))
