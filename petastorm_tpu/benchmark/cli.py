@@ -20,7 +20,7 @@ def build_parser():
     parser.add_argument("-m", "--warmup-cycles", type=int, default=200)
     parser.add_argument("-n", "--measure-cycles", type=int, default=1000)
     parser.add_argument("-d", "--read-method", default="python",
-                        choices=["python", "jax"])
+                        choices=["python", "jax", "tf"])
     parser.add_argument("-q", "--shuffling-queue-size", type=int, default=500)
     parser.add_argument("--min-after-dequeue", type=int, default=400)
     parser.add_argument("--json", action="store_true", help="Emit one JSON line")

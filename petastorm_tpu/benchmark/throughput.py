@@ -61,8 +61,12 @@ def reader_throughput(dataset_url: str,
                      workers_count=loaders_count,
                      num_epochs=None,
                      shuffle_row_groups=True) as reader:
-        if read_method == "python":
-            it = iter(reader)
+        if read_method in ("python", "tf"):
+            if read_method == "tf":
+                from petastorm_tpu.tf_utils import make_petastorm_dataset
+                it = iter(make_petastorm_dataset(reader))
+            else:
+                it = iter(reader)
             for _ in range(warmup_cycles):
                 next(it)
             t0 = time.perf_counter()

@@ -1,0 +1,31 @@
+"""Shared Spark-session argparse helpers for Spark-backed CLIs.
+
+Parity: reference petastorm/tools/spark_session_cli.py (``--master`` /
+``--spark-session-config`` arguments + session builder). pyspark imports are
+lazy; the module is importable on TPU pods without a JVM.
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def add_configure_spark_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--master", type=str, default="local[*]",
+                        help="Spark master (default: local[*])")
+    parser.add_argument("--spark-session-config", type=str, nargs="+", default=[],
+                        help="Extra Spark conf entries as key=value pairs")
+
+
+def configure_spark(args) -> "pyspark.sql.SparkSession":  # noqa: F821
+    try:
+        from pyspark.sql import SparkSession
+    except ImportError as e:  # pragma: no cover - pyspark optional
+        raise ImportError("This command requires pyspark") from e
+    builder = SparkSession.builder.master(args.master)
+    for entry in args.spark_session_config:
+        key, _, value = entry.partition("=")
+        if not value:
+            raise ValueError(f"--spark-session-config entries must be key=value, "
+                             f"got {entry!r}")
+        builder = builder.config(key, value)
+    return builder.getOrCreate()

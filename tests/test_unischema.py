@@ -156,3 +156,16 @@ def test_compressed_ndarray_codec_in_schema():
     s = Unischema("S", [UnischemaField("m", np.float64, (2, 2), CompressedNdarrayCodec(), False)])
     enc = dict_to_encoded_row(s, {"m": np.eye(2)})
     assert isinstance(enc["m"], bytes)
+
+
+def test_schema_with_more_than_255_fields():
+    """py>=3.7 namedtuples handle >255 fields natively — the reference
+    carries a shim for this (namedtuple_gt_255_fields.py); we prove the
+    plain path works (strategy parity: many_columns_non_petastorm_dataset
+    fixture, reference conftest.py:113)."""
+    fields = [UnischemaField(f"col_{i:04d}", np.int32, ()) for i in range(300)]
+    s = Unischema("Wide", fields)
+    assert len(s) == 300
+    row = {f.name: i for i, f in enumerate(fields)}
+    t = s.make_namedtuple_from_dict(row)
+    assert t.col_0299 == 299 and len(t._fields) == 300
