@@ -6,6 +6,7 @@ v0.13.1 (see SURVEY.md at the repo root for the layer map this follows).
 
 __version__ = "0.1.0"
 
+from petastorm_tpu.reader import make_reader, make_batch_reader  # noqa: F401
 from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 from petastorm_tpu.errors import (  # noqa: F401

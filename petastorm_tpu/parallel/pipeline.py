@@ -34,8 +34,9 @@ def pipeline_apply(stage_fn: Callable, params, x, n_microbatches: int,
     """
     S = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    leading = {a.shape[0] for a in jax.tree.leaves(params)}
-    if leading != {1}:
+    leaves = jax.tree.leaves(params)
+    leading = {a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1}
+    if leaves and leading != {1}:
         raise ValueError(
             f"Each device must hold exactly one stage: local stage axis is "
             f"{sorted(leading)}, so the stacked stage count does not equal the "

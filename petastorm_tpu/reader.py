@@ -165,7 +165,8 @@ def make_reader(dataset_url,
                   cache=cache,
                   transform_spec=transform_spec,
                   storage_options=storage_options,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  filesystem=filesystem)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -231,7 +232,8 @@ def make_batch_reader(dataset_url_or_urls,
                   cache=cache,
                   transform_spec=transform_spec,
                   storage_options=storage_options,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  filesystem=filesystem)
 
 
 class Reader:
@@ -244,7 +246,8 @@ class Reader:
                  is_batched_reader, shuffle_row_groups, shuffle_rows,
                  shuffle_row_drop_partitions, predicate, rowgroup_selector,
                  num_epochs, cur_shard, shard_count, shard_seed, seed, cache,
-                 transform_spec, storage_options, resume_state=None):
+                 transform_spec, storage_options, resume_state=None,
+                 filesystem=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -298,9 +301,19 @@ class Reader:
                 items.append({"rowgroup": rg,
                               "shuffle_row_drop_partition": (part, shuffle_row_drop_partitions)})
 
+        # A live filesystem handle is only shared with in-process workers;
+        # spawned process workers rebuild from URL + storage_options (live
+        # connections/locks don't survive the boundary — factory semantics,
+        # like the reference's filesystem_factory).
+        worker_fs = filesystem if not isinstance(self._pool, ProcessPool) else None
+        if filesystem is not None and worker_fs is None:
+            warnings.warn("reader_pool_type='process' workers reconnect from the "
+                          "dataset URL; the custom filesystem object is used for "
+                          "planning only. Pass storage_options for credentials.")
         worker_args = {
             "dataset_url_or_urls": dataset_url_or_urls,
             "storage_options": storage_options,
+            "filesystem": worker_fs,
             "schema": stored_schema,
             "view_schema": view_schema,
             "output_schema": self.schema,

@@ -17,6 +17,7 @@ import numpy as np
 import pyarrow as pa
 
 from petastorm_tpu.reader_impl.row_reader_worker import (_ParquetFileLRU,
+                                                         _read_row_group_with_retry,
                                                          select_drop_partition)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
@@ -36,7 +37,8 @@ class BatchReaderWorker(WorkerBase):
         if self._ctx is None:
             from petastorm_tpu.etl.dataset_metadata import DatasetContext
             self._ctx = DatasetContext(self.args["dataset_url_or_urls"],
-                                       storage_options=self.args.get("storage_options"))
+                                       storage_options=self.args.get("storage_options"),
+                                       filesystem=self.args.get("filesystem"))
             self._files = _ParquetFileLRU(self._ctx.filesystem)
         return self._ctx
 
@@ -78,9 +80,7 @@ class BatchReaderWorker(WorkerBase):
         return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
     def _read_table(self, rowgroup, columns) -> pa.Table:
-        pf = self._files.get(rowgroup.path)
-        file_cols = [c for c in sorted(columns) if c in set(pf.schema_arrow.names)]
-        table = pf.read_row_group(rowgroup.row_group, columns=file_cols)
+        table = _read_row_group_with_retry(self._files, rowgroup, columns)
         # Surface hive partition keys as constant columns when requested.
         for key, value in rowgroup.partition_values:
             if key in columns and key not in table.column_names:

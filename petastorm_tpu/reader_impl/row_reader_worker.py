@@ -6,8 +6,9 @@ early exit, codec-decodes each surviving row, runs the worker-side
 TransformSpec, assembles NGram windows when requested, and publishes a list
 of row dicts.
 
-Workers build their own filesystem/dataset handles from the dataset URL (no
-live handles cross the process boundary) and keep a small LRU of open
+Thread/dummy workers may receive the reader's filesystem object; spawned
+process workers always rebuild their own from the dataset URL (no live
+handles cross the process boundary). Each worker keeps a small LRU of open
 ParquetFile objects.
 
 Parity: reference petastorm/py_dict_reader_worker.py — ``PyDictReaderWorker``
@@ -17,6 +18,7 @@ Parity: reference petastorm/py_dict_reader_worker.py — ``PyDictReaderWorker``
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -34,6 +36,14 @@ class _ParquetFileLRU:
         self._capacity = capacity
         self._files = {}
 
+    def evict(self, path: str) -> None:
+        f = self._files.pop(path, None)
+        if f is not None:
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+
     def get(self, path: str) -> pq.ParquetFile:
         if path in self._files:
             self._files[path] = self._files.pop(path)  # refresh recency (LRU)
@@ -48,6 +58,32 @@ class _ParquetFileLRU:
         f = pq.ParquetFile(self._fs.open(path, "rb"))
         self._files[path] = f
         return f
+
+
+_IO_RETRIES = 2
+
+
+def _read_row_group_with_retry(files: "_ParquetFileLRU", rowgroup, columns):
+    """Read a row group, retrying OSErrors a couple of times (transient
+    remote-filesystem failures); the stale handle is evicted and reopened
+    between attempts. Missing-file/permission errors propagate immediately.
+    A permanently corrupt file still fails, ~0.3s later than it otherwise
+    would (Arrow IO errors are not reliably separable from transient ones)."""
+    last = None
+    for attempt in range(_IO_RETRIES + 1):
+        try:
+            pf = files.get(rowgroup.path)
+            file_columns = [c for c in sorted(columns)
+                            if c in set(pf.schema_arrow.names)]
+            return pf.read_row_group(rowgroup.row_group, columns=file_columns)
+        except (FileNotFoundError, PermissionError):
+            raise
+        except OSError as e:
+            last = e
+            files.evict(rowgroup.path)
+            if attempt < _IO_RETRIES:
+                time.sleep(0.1 * (attempt + 1))
+    raise last
 
 
 def _inject_partition_values(table_dict, num_rows, rowgroup, wanted_columns):
@@ -107,7 +143,8 @@ class RowReaderWorker(WorkerBase):
         if self._ctx is None:
             from petastorm_tpu.etl.dataset_metadata import DatasetContext
             self._ctx = DatasetContext(self.args["dataset_url_or_urls"],
-                                       storage_options=self.args.get("storage_options"))
+                                       storage_options=self.args.get("storage_options"),
+                                       filesystem=self.args.get("filesystem"))
             self._files = _ParquetFileLRU(self._ctx.filesystem)
         return self._ctx
 
@@ -165,10 +202,7 @@ class RowReaderWorker(WorkerBase):
 
     def _read_columns(self, rowgroup, columns) -> dict:
         """Read the row group; returns {column: list} incl. partition keys."""
-        pf = self._files.get(rowgroup.path)
-        file_columns = [c for c in sorted(columns)
-                        if c in set(pf.schema_arrow.names)]
-        table = pf.read_row_group(rowgroup.row_group, columns=file_columns)
+        table = _read_row_group_with_retry(self._files, rowgroup, columns)
         data = {name: table.column(name).to_pylist() for name in table.column_names}
         return _inject_partition_values(data, table.num_rows, rowgroup, columns)
 

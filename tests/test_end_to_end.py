@@ -256,3 +256,38 @@ def test_weighted_sampling_schema_mismatch(synthetic_dataset):
     finally:
         for r in (r1, r2):
             r.stop(); r.join()
+
+
+def test_custom_filesystem_reaches_workers_and_transient_io_retries(synthetic_dataset):
+    """A filesystem passed to make_reader is used by workers (not rebuilt
+    from the URL), and transient OSErrors on data-file opens are retried."""
+    import fsspec
+
+    class FlakyFS:
+        def __init__(self, inner):
+            self.inner = inner
+            self.failures_left = 2
+            self.armed = False
+            self.opens = 0
+
+        def open(self, path, mode="rb", **kw):
+            if (self.armed and path.endswith(".parquet") and "r" in mode):
+                self.opens += 1
+                if self.failures_left > 0:
+                    self.failures_left -= 1
+                    raise OSError("simulated transient connection reset")
+            return self.inner.open(path, mode, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    flaky = FlakyFS(fsspec.filesystem("file"))
+    reader = make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         shuffle_row_groups=False, reader_pool_type="dummy",
+                         filesystem=flaky)
+    flaky.armed = True
+    with reader as r:
+        ids = sorted(s.id for s in r)
+    assert ids == list(range(100))
+    assert flaky.failures_left == 0      # retries actually happened
+    assert flaky.opens > 2               # workers used the custom fs
