@@ -36,12 +36,14 @@ def pipeline_apply(stage_fn: Callable, params, x, n_microbatches: int,
     idx = jax.lax.axis_index(axis_name)
     leaves = jax.tree.leaves(params)
     leading = {a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1}
-    if leaves and leading != {1}:
+    if leading and leading != {1}:
         raise ValueError(
             f"Each device must hold exactly one stage: local stage axis is "
             f"{sorted(leading)}, so the stacked stage count does not equal the "
             f"'{axis_name}' mesh axis size ({S}). Stack S == mesh-axis stages.")
-    p_local = jax.tree.map(lambda a: a[0], params)
+    # Scalar leaves (stage-free constants) pass through unstacked.
+    p_local = jax.tree.map(
+        lambda a: a[0] if getattr(a, "ndim", 0) >= 1 else a, params)
 
     M = n_microbatches
     if x.shape[0] % M:

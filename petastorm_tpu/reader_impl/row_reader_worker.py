@@ -75,7 +75,10 @@ def _read_row_group_with_retry(files: "_ParquetFileLRU", rowgroup, columns):
             pf = files.get(rowgroup.path)
             file_columns = [c for c in sorted(columns)
                             if c in set(pf.schema_arrow.names)]
-            return pf.read_row_group(rowgroup.row_group, columns=file_columns)
+            # Workers ARE the parallelism unit: arrow's own thread pool only
+            # adds oversubscription on top of N decode workers.
+            return pf.read_row_group(rowgroup.row_group, columns=file_columns,
+                                     use_threads=False)
         except (FileNotFoundError, PermissionError):
             raise
         except OSError as e:
