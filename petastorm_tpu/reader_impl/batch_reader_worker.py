@@ -13,6 +13,8 @@ Parity: reference petastorm/arrow_reader_worker.py — ``ArrowReaderWorker``
 """
 from __future__ import annotations
 
+from decimal import Decimal
+
 import numpy as np
 import pyarrow as pa
 
@@ -124,6 +126,15 @@ class BatchReaderWorker(WorkerBase):
         return table
 
 
+def _numeric_dtype(field):
+    """The field's numpy dtype, or None for non-numeric declarations
+    (str/bytes/Decimal). Note ``np.float32`` etc. are classes, so a plain
+    ``isinstance(x, type)`` check cannot distinguish them from ``str``."""
+    if field.numpy_dtype in (str, bytes, Decimal, np.str_, np.bytes_, np.object_):
+        return None
+    return np.dtype(field.numpy_dtype)
+
+
 def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False) -> dict:
     """Convert an Arrow table to ``{name: numpy array}``, reassembling
     list-columns into fixed-shape matrices per the schema's declared shapes
@@ -136,16 +147,44 @@ def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False)
     for name in table.column_names:
         col = table.column(name)
         field = schema.fields.get(name)
-        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
-            rows = col.to_pylist()
-            value_dtype = None
-            if field is not None and not isinstance(field.numpy_dtype, type):
-                value_dtype = np.dtype(field.numpy_dtype)
-            arrays = [np.asarray(r, dtype=value_dtype) for r in rows]
+        combined = None
+        if pa.types.is_fixed_size_list(col.type):
+            combined = col.combine_chunks()
+        if combined is not None and combined.null_count == 0 \
+                and combined.values.null_count == 0:
+            # Vectorized: the flat values buffer reshapes straight into
+            # (n, list_size, ...) — no per-row python loop. (.values keeps
+            # null-row slots, but with zero nulls it equals the flat data.)
+            size = col.type.list_size
+            flat = combined.values.to_numpy(zero_copy_only=False)
+            if field is not None and _numeric_dtype(field):
+                flat = flat.astype(_numeric_dtype(field), copy=False)
+            arr = flat.reshape(len(col), size)
             if field is not None and field.shape and all(d is not None for d in field.shape):
-                stacked = np.vstack([a.reshape(-1) for a in arrays]) if arrays \
-                    else np.empty((0,), dtype=value_dtype)
-                out[name] = stacked.reshape((len(arrays),) + tuple(field.shape))
+                arr = arr.reshape((len(col),) + tuple(field.shape))
+            if force_copy and arr.base is not None:
+                arr = arr.copy()
+            out[name] = arr
+        elif (pa.types.is_list(col.type) or pa.types.is_large_list(col.type)
+              or combined is not None):
+            # Variable lists, or fixed-size lists containing nulls (the
+            # per-row path tolerates None rows/elements).
+            rows = col.to_pylist()
+            value_dtype = _numeric_dtype(field) if field is not None else None
+            arrays = [None if r is None else np.asarray(r, dtype=value_dtype)
+                      for r in rows]
+            if field is not None and field.shape and all(d is not None for d in field.shape):
+                shape = tuple(field.shape)
+                fill_dtype = value_dtype or (arrays and next(
+                    (a.dtype for a in arrays if a is not None), np.float64)) or np.float64
+                # Null rows become NaN (float) / zero (int) blocks of the
+                # declared shape, keeping the stacked batch rectangular.
+                fill = np.full(shape, np.nan if np.dtype(fill_dtype).kind == "f"
+                               else 0, dtype=fill_dtype)
+                stacked = np.stack([fill if a is None else a.reshape(shape)
+                                    for a in arrays]) if arrays \
+                    else np.empty((0,) + shape, dtype=fill_dtype)
+                out[name] = stacked
             else:
                 obj = np.empty(len(arrays), dtype=object)
                 for i, a in enumerate(arrays):

@@ -293,7 +293,13 @@ class Unischema:
         fields = []
         for name in arrow_schema.names:
             pa_field = arrow_schema.field(name)
-            if isinstance(pa_field.type, pa.lib.ListType):
+            if isinstance(pa_field.type, pa.lib.FixedSizeListType):
+                np_dtype = _numpy_from_arrow_type(pa_field.type.value_type, name, omit_unsupported_fields)
+                if np_dtype is None:
+                    continue
+                fields.append(UnischemaField(name, np_dtype, (pa_field.type.list_size,),
+                                             None, pa_field.nullable))
+            elif isinstance(pa_field.type, pa.lib.ListType):
                 np_dtype = _numpy_from_arrow_type(pa_field.type.value_type, name, omit_unsupported_fields)
                 if np_dtype is None:
                     continue

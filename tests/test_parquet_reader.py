@@ -121,3 +121,26 @@ def test_batch_reader_multiple_urls(scalar_dataset):
                            reader_pool_type="dummy") as reader:
         ids = np.concatenate([b.id for b in reader])
     assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_fixed_size_list_column(tmp_path):
+    """fixed_size_list<float32> columns infer shape (N,) and reassemble
+    vectorized into (batch, N) float arrays (the Spark-ML-vector layout)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(40, 8)).astype(np.float32)
+    table = pa.table({
+        "vec": pa.FixedSizeListArray.from_arrays(pa.array(feats.reshape(-1)), 8),
+        "id": np.arange(40),
+    })
+    path = tmp_path / "fsl"
+    path.mkdir()
+    pq.write_table(table, f"{path}/x.parquet", row_group_size=10)
+    with make_batch_reader(f"file://{path}", shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        assert reader.schema.fields["vec"].shape == (8,)
+        b = next(reader)
+    assert b.vec.shape == (10, 8)
+    assert b.vec.dtype == np.float32
+    np.testing.assert_allclose(b.vec, feats[:10])
