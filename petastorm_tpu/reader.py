@@ -351,7 +351,11 @@ class Reader:
             random_seed=seed,
             max_ventilation_queue_size=self._pool.workers_count * (1 + _VENTILATE_EXTRA_ROWGROUPS),
             start_epoch=start_epoch,
-            start_offset=start_offset)
+            start_offset=start_offset,
+            # Workers key intra-row-group shuffle RNG by (seed, epoch,
+            # position) so a resumed run replays the same row order inside
+            # each group as an uninterrupted one.
+            item_context_key="shuffle_context")
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         if is_batched_reader:

@@ -20,6 +20,7 @@ import pyarrow as pa
 
 from petastorm_tpu.reader_impl.row_reader_worker import (_ParquetFileLRU,
                                                          _read_row_group_with_retry,
+                                                         item_shuffle_rng,
                                                          select_drop_partition)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
@@ -44,7 +45,8 @@ class BatchReaderWorker(WorkerBase):
             self._files = _ParquetFileLRU(self._ctx.filesystem)
         return self._ctx
 
-    def process(self, rowgroup, shuffle_row_drop_partition=(0, 1)):
+    def process(self, rowgroup, shuffle_row_drop_partition=(0, 1),
+                shuffle_context=None):
         self._ensure_open()
         view_schema = self.args["view_schema"]
         predicate = self.args.get("predicate")
@@ -58,7 +60,9 @@ class BatchReaderWorker(WorkerBase):
             needed_with_pred = needed
 
         table = self._load_table(rowgroup, needed_with_pred, predicate,
-                                 shuffle_row_drop_partition, cache)
+                                 shuffle_row_drop_partition, cache,
+                                 rng=item_shuffle_rng(self.args.get("seed"),
+                                                      shuffle_context, self._rng))
         if table is None or table.num_rows == 0:
             return
 
@@ -99,7 +103,7 @@ class BatchReaderWorker(WorkerBase):
         key = self._cache_key(rowgroup, columns)
         return cache.get(key, lambda: self._read_table(rowgroup, columns))
 
-    def _load_table(self, rowgroup, needed, predicate, drop_part, cache=None):
+    def _load_table(self, rowgroup, needed, predicate, drop_part, cache, rng):
         part_index, num_parts = drop_part
         if predicate is not None:
             pred_fields = sorted(predicate.get_fields())
@@ -120,7 +124,7 @@ class BatchReaderWorker(WorkerBase):
             table = self._maybe_cached_table(rowgroup, needed, cache)
 
         indices = select_drop_partition(table.num_rows, part_index, num_parts,
-                                        self.args.get("shuffle_rows", False), self._rng)
+                                        self.args.get("shuffle_rows", False), rng)
         if num_parts > 1 or self.args.get("shuffle_rows", False):
             table = table.take(pa.array(indices))
         return table
@@ -149,9 +153,15 @@ def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False)
         field = schema.fields.get(name)
         combined = None
         if pa.types.is_fixed_size_list(col.type):
-            combined = col.combine_chunks()
+            # chunk(0) for the single-chunk case: combine_chunks would copy a
+            # sliced chunk to compact it; the raw chunk is zero-copy (its
+            # slice offset, if any, routes to the per-row path below).
+            combined = (col.chunk(0) if col.num_chunks == 1
+                        else col.combine_chunks())
         if combined is not None and combined.null_count == 0 \
-                and combined.values.null_count == 0:
+                and combined.values.null_count == 0 and combined.offset == 0:
+            # (.values ignores a non-zero slice offset, which would shift
+            # every row; sliced arrays take the per-row path below.)
             # Vectorized: the flat values buffer reshapes straight into
             # (n, list_size, ...) — no per-row python loop. (.values keeps
             # null-row slots, but with zero nulls it equals the flat data.)

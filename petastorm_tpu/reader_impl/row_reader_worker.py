@@ -98,6 +98,18 @@ def _inject_partition_values(table_dict, num_rows, rowgroup, wanted_columns):
     return table_dict
 
 
+def item_shuffle_rng(seed, shuffle_context, fallback_rng):
+    """RNG for intra-row-group shuffling. With a seed and a ventilator
+    ``(epoch, position)`` context, the stream is keyed by position so a
+    resumed run shuffles each row group exactly like an uninterrupted one
+    (the per-worker fallback stream advances with worker scheduling and is
+    only run-deterministic, not resume-deterministic)."""
+    if shuffle_context is not None and seed is not None:
+        epoch, pos = shuffle_context
+        return np.random.default_rng((seed, epoch, pos))
+    return fallback_rng
+
+
 def select_drop_partition(num_rows: int, partition_index: int, num_partitions: int,
                           shuffle: bool, rng: Optional[np.random.Generator]):
     """Row indices of one of ``num_partitions`` contiguous slices of a row
@@ -151,19 +163,22 @@ class RowReaderWorker(WorkerBase):
             self._files = _ParquetFileLRU(self._ctx.filesystem)
         return self._ctx
 
-    def process(self, rowgroup, shuffle_row_drop_partition=(0, 1)):
+    def process(self, rowgroup, shuffle_row_drop_partition=(0, 1),
+                shuffle_context=None):
         self._ensure_open()
         ngram = self.args.get("ngram")
         predicate = self.args.get("predicate")
         transform_spec = self.args.get("transform_spec")
         view_schema = self.args["view_schema"]
         needed = self._needed
+        rng = item_shuffle_rng(self.args.get("seed"), shuffle_context, self._rng)
 
         if predicate is not None:
             rows = self._load_rows_with_predicate(rowgroup, needed, predicate,
-                                                  shuffle_row_drop_partition)
+                                                  shuffle_row_drop_partition, rng)
         else:
-            rows = self._maybe_cached(rowgroup, needed, shuffle_row_drop_partition)
+            rows = self._maybe_cached(rowgroup, needed,
+                                      shuffle_row_drop_partition, rng)
 
         decoded = [decode_row(r, self._decode_schema) for r in rows]
 
@@ -186,7 +201,7 @@ class RowReaderWorker(WorkerBase):
         h = hashlib.md5(url.encode()).hexdigest()
         return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
-    def _maybe_cached(self, rowgroup, needed, drop_part):
+    def _maybe_cached(self, rowgroup, needed, drop_part, rng):
         # Cache the RAW columns only — shuffling and drop-partition slicing
         # happen after retrieval, so a cache hit never freezes an epoch's
         # shuffle order or leaks one reader's shuffle into another's.
@@ -200,7 +215,7 @@ class RowReaderWorker(WorkerBase):
         num_rows = len(next(iter(data.values()))) if data else 0
         part_index, num_parts = drop_part
         indices = select_drop_partition(num_rows, part_index, num_parts,
-                                        self.args.get("shuffle_rows", False), self._rng)
+                                        self.args.get("shuffle_rows", False), rng)
         return self._columns_to_rows(data, indices)
 
     def _read_columns(self, rowgroup, columns) -> dict:
@@ -214,7 +229,8 @@ class RowReaderWorker(WorkerBase):
         names = list(data.keys())
         return [{n: data[n][i] for n in names} for i in indices]
 
-    def _load_rows_with_predicate(self, rowgroup, needed, predicate, drop_part) -> List[dict]:
+    def _load_rows_with_predicate(self, rowgroup, needed, predicate, drop_part,
+                                  rng) -> List[dict]:
         """Load predicate columns first; early-exit if nothing matches
         (parity: reference :197)."""
         schema = self.args["schema"]
@@ -240,7 +256,7 @@ class RowReaderWorker(WorkerBase):
 
         part_index, num_parts = drop_part
         indices = select_drop_partition(num_rows, part_index, num_parts,
-                                        self.args.get("shuffle_rows", False), self._rng)
+                                        self.args.get("shuffle_rows", False), rng)
         indices = [i for i in indices if mask[i]]
 
         other_fields = needed - predicate_fields

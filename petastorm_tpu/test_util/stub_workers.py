@@ -54,6 +54,14 @@ class WorkerIdWorker(WorkerBase):
         self.publish_func((self.worker_id, value))
 
 
+class BlobWorker(WorkerBase):
+    """Publishes ``args['size']`` bytes per item (fills transport buffers —
+    used to test shutdown while producers are blocked on backpressure)."""
+
+    def process(self, value):
+        self.publish_func(bytes(self.args["size"]))
+
+
 class ArrowTableWorker(WorkerBase):
     """Publishes a pyarrow Table of n rows (tests the Arrow IPC serializer)."""
 

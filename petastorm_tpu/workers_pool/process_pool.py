@@ -198,6 +198,15 @@ class ProcessPool:
                 self._control_socket.send(_CONTROL_FINISH)
             except Exception:  # noqa: BLE001 - socket may already be dead
                 pass
+        # Unblock workers stuck in a blocking ring write against a full ring
+        # (nobody will drain it anymore): the closed flag is shared memory, so
+        # setting it from this side makes the worker's write raise RingClosed
+        # immediately instead of stalling join() into its SIGKILL deadline.
+        for ring in self._rings:
+            try:
+                ring.close_producer()
+            except Exception:  # noqa: BLE001 - ring may already be closed
+                pass
         self._stopped = True
 
     def join(self):

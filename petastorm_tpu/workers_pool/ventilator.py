@@ -65,11 +65,19 @@ class ConcurrentVentilator(Ventilator):
                  max_ventilation_queue_size: Optional[int] = None,
                  ventilation_interval: float = _VENTILATION_INTERVAL_S,
                  start_epoch: int = 0,
-                 start_offset: int = 0):
+                 start_offset: int = 0,
+                 item_context_key: Optional[str] = None):
         """``start_epoch``/``start_offset`` resume ventilation mid-stream:
         epoch ``start_epoch`` begins at item index ``start_offset`` of its
         (seeded) order — the checkpoint/resume mechanism (exact when
-        ``random_seed`` is set)."""
+        ``random_seed`` is set).
+
+        ``item_context_key``: when set, each ventilated item additionally
+        carries ``{item_context_key: (epoch, position)}`` — its epoch and
+        position within that epoch's (seeded) order. Workers can key
+        per-item RNG off it so results are position-deterministic: a resumed
+        run reproduces the exact same per-item randomness as an
+        uninterrupted one."""
         super().__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError(f"iterations must be positive or None, got {iterations}")
@@ -83,6 +91,7 @@ class ConcurrentVentilator(Ventilator):
             raise ValueError(f"start_offset {start_offset} out of range")
         self._start_epoch = start_epoch
         self._start_offset = start_offset
+        self._context_key = item_context_key
 
         self._inflight = 0
         self._inflight_cv = threading.Condition()
@@ -178,8 +187,8 @@ class ConcurrentVentilator(Ventilator):
             if iterations_left is not None and iterations_left <= 0:
                 break
             epoch_items = self._epoch_order(self._epoch)[skip:]
-            skip = 0
-            for item in epoch_items:
+            epoch_offset, skip = skip, 0
+            for pos, item in enumerate(epoch_items, start=epoch_offset):
                 with self._inflight_cv:
                     while (self._inflight >= self._max_inflight
                            and not self._stop_event.is_set()):
@@ -187,7 +196,11 @@ class ConcurrentVentilator(Ventilator):
                     if self._stop_event.is_set():
                         return
                     self._inflight += 1
-                self._ventilate_fn(**item)
+                if self._context_key is not None:
+                    self._ventilate_fn(**item,
+                                       **{self._context_key: (self._epoch, pos)})
+                else:
+                    self._ventilate_fn(**item)
             self._epoch += 1
             if iterations_left is not None:
                 iterations_left -= 1

@@ -144,3 +144,18 @@ def test_fixed_size_list_column(tmp_path):
     assert b.vec.shape == (10, 8)
     assert b.vec.dtype == np.float32
     np.testing.assert_allclose(b.vec, feats[:10])
+
+
+def test_fixed_size_list_sliced_array_not_shifted():
+    """A sliced FixedSizeListArray must not take the flat-values fast path:
+    ``.values`` ignores the slice offset, which would shift every row."""
+    import pyarrow as pa
+    from petastorm_tpu.reader_impl.batch_reader_worker import arrow_table_to_numpy_dict
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    feats = np.arange(24, dtype=np.float32).reshape(6, 4)
+    fsl = pa.FixedSizeListArray.from_arrays(pa.array(feats.reshape(-1)), 4)
+    table = pa.table({"vec": fsl}).slice(2, 3)
+    schema = Unischema("S", [UnischemaField("vec", np.float32, (4,), None, False)])
+    out = arrow_table_to_numpy_dict(table, schema)
+    np.testing.assert_allclose(out["vec"], feats[2:5])

@@ -70,6 +70,20 @@ def test_reader_resume_continues_stream(synthetic_dataset):
     assert rest_ids == full_order[len(full_order) - len(rest_ids):]
 
 
+def test_resume_exact_intra_group_row_order(synthetic_dataset):
+    """With shuffle_rows + seed, the intra-row-group shuffle is keyed by the
+    item's (epoch, position), so a resumed run replays the exact row order of
+    the uninterrupted run — not just the same row membership."""
+    kwargs = dict(schema_fields=["id"], seed=7, shuffle_row_groups=True,
+                  shuffle_rows=True, reader_pool_type="dummy", num_epochs=1)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        full = [s.id for s in reader]
+    with make_reader(synthetic_dataset.url, **kwargs,
+                     resume_state={"epoch": 0, "offset": 3}) as reader:
+        rest = [s.id for s in reader]
+    assert rest == full[len(full) - len(rest):]
+
+
 def test_reader_resume_across_epochs(synthetic_dataset):
     with make_reader(synthetic_dataset.url, schema_fields=["id"],
                      shuffle_row_groups=False, reader_pool_type="dummy",

@@ -122,6 +122,28 @@ def test_thread_pool_work_distribution():
 
 
 @pytest.mark.process_pool
+def test_process_pool_stop_with_full_ring_is_fast():
+    """Early shutdown while workers are blocked writing into a full shm ring:
+    stop() closes the rings so blocked writers fail out immediately instead of
+    stalling join() into its 30s SIGKILL deadline."""
+    import time
+    from petastorm_tpu.native import ring_available
+    from petastorm_tpu.test_util.stub_workers import BlobWorker
+    if not ring_available():
+        pytest.skip("C++ shm ring not available")
+    pool = ProcessPool(2, transport="shm", ring_capacity=1 << 20)
+    pool.start(BlobWorker, {"size": 300 << 10})
+    for i in range(40):
+        pool.ventilate(value=i)
+    pool.get_results()          # at least one item flowed
+    time.sleep(1.0)             # let both workers block on their full rings
+    t0 = time.time()
+    pool.stop()
+    pool.join()
+    assert time.time() - t0 < 20
+
+
+@pytest.mark.process_pool
 def test_process_pool_arrow_serializer():
     import pyarrow as pa
     from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
