@@ -12,28 +12,10 @@ import time
 
 import numpy as np
 
-from petastorm_tpu import Unischema, UnischemaField
-from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
-from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.benchmark.imagenet_bench import (ImagenetSchema,  # noqa: F401
+                                                    write_synthetic_imagenet)
 from petastorm_tpu.jax import DataLoader, DTypePolicy
 from petastorm_tpu.reader import make_reader
-
-ImagenetSchema = Unischema("ImagenetSchema", [
-    UnischemaField("image", np.uint8, (224, 224, 3), CompressedImageCodec("jpeg", 85), False),
-    UnischemaField("label", np.int32, (), ScalarCodec(np.int32), False),
-])
-
-
-def write_synthetic_imagenet(url: str, rows: int, classes: int = 100, seed: int = 0):
-    rng = np.random.default_rng(seed)
-    protos = rng.integers(60, 195, (classes, 8, 8, 3)).astype(np.uint8)
-    with materialize_dataset_local(url, ImagenetSchema, rows_per_row_group=64) as w:
-        for i in range(rows):
-            label = int(rng.integers(0, classes))
-            base = np.kron(protos[label], np.ones((28, 28, 1), np.uint8))
-            noise = rng.integers(0, 60, (224, 224, 3)).astype(np.uint8)
-            w.write_row({"image": np.clip(base + noise, 0, 255).astype(np.uint8),
-                         "label": np.int32(label)})
 
 
 def train(url: str, steps: int = 30, per_device_batch: int = 8, classes: int = 100):

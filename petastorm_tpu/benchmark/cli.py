@@ -23,6 +23,13 @@ def build_parser():
                         choices=["python", "jax", "tf"])
     parser.add_argument("-q", "--shuffling-queue-size", type=int, default=500)
     parser.add_argument("--min-after-dequeue", type=int, default=400)
+    parser.add_argument("--device-step-ms", type=float, default=None,
+                        help="With -d jax: overlap batches against a calibrated "
+                             "on-device step of this duration and report honest "
+                             "input-stall%% (approaches 0 when the step dominates)")
+    parser.add_argument("--spawn-new-process", action="store_true",
+                        help="Re-run the measurement in a fresh interpreter so "
+                             "RSS is not polluted by this process's history")
     parser.add_argument("--json", action="store_true", help="Emit one JSON line")
     parser.add_argument("-v", action="store_true", help="INFO logging")
     parser.add_argument("-vv", action="store_true", help="DEBUG logging")
@@ -36,6 +43,18 @@ def main(argv=None):
     elif args.v:
         logging.basicConfig(level=logging.INFO)
 
+    if args.spawn_new_process:
+        # Fresh-interpreter respawn for clean RSS numbers (methodology
+        # parity: reference benchmark/throughput.py:144-149).
+        import subprocess
+        argv = list(sys.argv[1:] if argv is None else argv)
+        # The flag may appear as any unambiguous argparse prefix
+        # (--spawn-new, --sp, ...) — match by prefix, not literal.
+        argv = [a for a in argv
+                if not (a.startswith("--sp") and "--spawn-new-process".startswith(a))]
+        return subprocess.call(
+            [sys.executable, "-m", "petastorm_tpu.benchmark.cli", *argv])
+
     from petastorm_tpu.benchmark.throughput import reader_throughput
     result = reader_throughput(
         args.dataset_url, field_regex=args.field_regex,
@@ -43,7 +62,8 @@ def main(argv=None):
         pool_type=args.pool_type, loaders_count=args.workers_count,
         shuffling_queue_size=args.shuffling_queue_size,
         min_after_dequeue=args.min_after_dequeue,
-        read_method=args.read_method)
+        read_method=args.read_method,
+        device_step_ms=args.device_step_ms)
     if args.json:
         print(json.dumps({"samples_per_second": result.samples_per_second,
                           "memory_rss_mb": result.memory_rss_mb,

@@ -22,10 +22,15 @@ HelloWorldSchema = Unischema("HelloWorldSchema", [
 
 
 def generate_hello_world_dataset(output_url: str = "file:///tmp/hello_world_dataset",
-                                 rows_count: int = 10, seed: int = 0) -> str:
+                                 rows_count: int = 10, seed: int = 0,
+                                 rows_per_row_group: int = 1) -> str:
+    """Default args reproduce the reference's 10-row 1-row-per-group tutorial
+    store exactly; pass ``rows_count=10_000, rows_per_row_group=100`` for a
+    steady-state store whose throughput is I/O- rather than per-row-overhead-
+    bound (multiple row groups, realistic group sizes)."""
     rng = np.random.default_rng(seed)
     with materialize_dataset_local(output_url, HelloWorldSchema,
-                                   rows_per_row_group=1) as writer:
+                                   rows_per_row_group=rows_per_row_group) as writer:
         for i in range(rows_count):
             writer.write_row({
                 "id": np.int32(i),

@@ -1,0 +1,112 @@
+"""ImageNet-style benchmark: jpeg-decode-bound reader feeding a real
+ResNet-50 train step on the local device(s).
+
+This is the BASELINE.md target workload — **samples/sec/chip** and
+**input-stall % of step time** — the numbers the reference framework never
+published for any accelerator (BASELINE.md:26-28). The store is synthetic
+but class-separable (loss goes down), with real jpeg encode/decode through
+:class:`petastorm_tpu.codecs.CompressedImageCodec`, so the host-side work
+matches a real ImageNet ingest: parquet row-group read -> jpeg decode ->
+batch assembly -> HBM staging.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema("ImagenetSchema", [
+    UnischemaField("image", np.uint8, (224, 224, 3),
+                   CompressedImageCodec("jpeg", 85), False),
+    UnischemaField("label", np.int32, (), ScalarCodec(np.int32), False),
+])
+
+
+def write_synthetic_imagenet(url: str, rows: int, classes: int = 100,
+                             seed: int = 0, rows_per_row_group: int = 64):
+    """Class-separable synthetic images: a per-class 8x8 proto upsampled to
+    224x224 plus uniform noise — compresses like a photo, trains like a toy."""
+    rng = np.random.default_rng(seed)
+    protos = rng.integers(60, 195, (classes, 8, 8, 3)).astype(np.uint8)
+    with materialize_dataset_local(url, ImagenetSchema,
+                                   rows_per_row_group=rows_per_row_group) as w:
+        for _ in range(rows):
+            label = int(rng.integers(0, classes))
+            base = np.kron(protos[label], np.ones((28, 28, 1), np.uint8))
+            noise = rng.integers(0, 60, (224, 224, 3)).astype(np.uint8)
+            w.write_row({"image": np.clip(base + noise, 0, 255).astype(np.uint8),
+                         "label": np.int32(label)})
+
+
+def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
+                       workers_count: int = 4, pool_type: str = "thread",
+                       classes: int = 100, prefetch: int = 2) -> dict:
+    """One DP training run over all local devices; returns
+    ``{samples_per_sec, samples_per_sec_per_chip, input_stall_pct, ...}``
+    measured against the real jitted ResNet-50 step (wait-vs-compute split,
+    same methodology as :func:`throughput.training_input_stall`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.jax import DataLoader, DTypePolicy
+    from petastorm_tpu.models import resnet
+    from petastorm_tpu.reader import make_reader
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices).reshape(len(devices)), ("data",))
+    batch_sharding = NamedSharding(mesh, P("data"))
+    replicated = NamedSharding(mesh, P())
+    batch_size = per_device_batch * len(devices)
+
+    params = jax.device_put(resnet.init_params(jax.random.PRNGKey(0), classes),
+                            replicated)
+    velocity = jax.device_put(jax.tree.map(lambda p: p * 0, params), replicated)
+    raw_step = resnet.make_train_step(learning_rate=0.05)
+
+    def preprocess_and_step(params, velocity, batch):
+        images = batch["image"].astype(jnp.float32) / 255.0
+        return raw_step(params, velocity,
+                        {"image": images, "label": batch["label"]})
+
+    step = jax.jit(preprocess_and_step, donate_argnums=(0, 1))
+
+    with make_reader(url, num_epochs=None, shuffle_row_groups=True, seed=0,
+                     reader_pool_type=pool_type,
+                     workers_count=workers_count) as reader:
+        loader = DataLoader(reader, batch_size=batch_size,
+                            sharding=batch_sharding, prefetch=prefetch,
+                            dtype_policy=DTypePolicy())
+        it = iter(loader)
+        batch = next(it)  # first step compiles
+        params, velocity, loss, acc = step(params, velocity, batch)
+        jax.block_until_ready(loss)
+
+        wait_s = compute_s = 0.0
+        losses = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            batch = next(it)
+            t1 = time.perf_counter()
+            params, velocity, loss, acc = step(params, velocity, batch)
+            jax.block_until_ready(loss)
+            t2 = time.perf_counter()
+            wait_s += t1 - t0
+            compute_s += t2 - t1
+            losses.append(float(loss))
+
+    total = wait_s + compute_s
+    sps = steps * batch_size / total
+    return {
+        "samples_per_sec": sps,
+        "samples_per_sec_per_chip": sps / len(devices),
+        "input_stall_pct": 100.0 * wait_s / total,
+        "devices": len(devices),
+        "global_batch": batch_size,
+        "loss_first": losses[0],
+        "loss_last": losses[-1],
+    }

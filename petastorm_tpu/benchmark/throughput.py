@@ -41,12 +41,15 @@ def reader_throughput(dataset_url: str,
                       shuffling_queue_size: int = 500,
                       min_after_dequeue: int = 400,
                       read_method: str = "python",
-                      spawn_new_process: bool = False) -> BenchmarkResult:
+                      device_step_ms: Optional[float] = None) -> BenchmarkResult:
     """Measure samples/sec of ``make_reader`` on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows;
     ``read_method='jax'`` pulls device-staged batches through
-    :class:`petastorm_tpu.jax.DataLoader` and reports input-stall%.
+    :class:`petastorm_tpu.jax.DataLoader`. Input-stall% is only reported
+    when ``device_step_ms`` sets a (calibrated, on-device) synthetic step to
+    overlap against — with no compute between batches the loader waits by
+    construction and a stall number would be meaningless.
     """
     import psutil
 
@@ -76,6 +79,8 @@ def reader_throughput(dataset_url: str,
             samples = measure_cycles
             stall = None
         elif read_method == "jax":
+            import jax
+
             from petastorm_tpu.jax import DataLoader
             batch_size = 16
             loader = DataLoader(reader, batch_size=batch_size,
@@ -84,18 +89,23 @@ def reader_throughput(dataset_url: str,
             it = iter(loader)
             for _ in range(max(1, warmup_cycles // batch_size)):
                 next(it)
-            import jax
-            t0 = time.perf_counter()
-            wait_time = 0.0
             steps = max(1, measure_cycles // batch_size)
-            for _ in range(steps):
-                w0 = time.perf_counter()
-                batch = next(it)
-                jax.block_until_ready(batch)
-                wait_time += time.perf_counter() - w0
-            dt = time.perf_counter() - t0
+            if device_step_ms is not None:
+                device_step = make_synthetic_device_step(device_step_ms)
+                measured = training_input_stall(loader, lambda b: device_step(),
+                                                steps=steps, it=it)
+                # Wall time of the measured steps only — the warm-up batch
+                # excluded from wait/compute must not dilute samples/sec.
+                dt = measured["wait_s"] + measured["compute_s"]
+                steps = measured["steps"]
+                stall = measured["input_stall_percent"]
+            else:
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    jax.block_until_ready(next(it))
+                dt = time.perf_counter() - t0
+                stall = None
             samples = steps * batch_size
-            stall = 100.0 * wait_time / dt
         else:
             raise ValueError(f"Unknown read_method {read_method!r}")
 
@@ -106,12 +116,43 @@ def reader_throughput(dataset_url: str,
         input_stall_percent=stall)
 
 
-def training_input_stall(loader, device_step_fn, steps: int = 50) -> dict:
+def make_synthetic_device_step(target_ms: float):
+    """A jitted on-device compute kernel calibrated to run ~``target_ms``
+    per call — stands in for a real model step when measuring how well the
+    input pipeline overlaps with device compute."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = jnp.ones((512, 512), jnp.float32)
+
+    @jax.jit
+    def chunk(x):
+        def body(_, x):
+            return x @ x * (1.0 / 512.0)
+        return lax.fori_loop(0, 8, body, x)
+
+    jax.block_until_ready(chunk(x))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(chunk(x))
+    per_chunk = time.perf_counter() - t0
+    n = max(1, round(target_ms / 1000.0 / per_chunk))
+
+    def step():
+        y = x
+        for _ in range(n):
+            y = chunk(y)
+        return y
+
+    return step
+
+
+def training_input_stall(loader, device_step_fn, steps: int = 50, it=None) -> dict:
     """Measure input stall against a real device step: for each iteration,
     time waiting on ``next(loader)`` vs running ``device_step_fn(batch)``."""
     import jax
-    it = iter(loader)
-    wait, compute = 0.0, 0.0
+    it = iter(loader) if it is None else it
+    wait, compute, done = 0.0, 0.0, 0
     first = next(it)  # exclude loader spin-up
     device_step_fn(first)
     for _ in range(steps):
@@ -126,6 +167,7 @@ def training_input_stall(loader, device_step_fn, steps: int = 50) -> dict:
         t2 = time.perf_counter()
         wait += t1 - t0
         compute += t2 - t1
+        done += 1
     total = wait + compute
     return {"input_stall_percent": 100.0 * wait / total if total else 0.0,
-            "wait_s": wait, "compute_s": compute}
+            "wait_s": wait, "compute_s": compute, "steps": done}

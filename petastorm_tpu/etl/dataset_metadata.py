@@ -248,12 +248,16 @@ def infer_or_load_unischema(ctx: DatasetContext) -> Unischema:
 
 # -------------------------------------------------------------------- write
 def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
-                           extra_kv: Optional[Dict[bytes, bytes]] = None) -> None:
+                           extra_kv: Optional[Dict[bytes, bytes]] = None) -> dict:
     """(Re)write ``_common_metadata`` with schema + row-group index.
 
     Scans data-file footers to build the row-groups-per-file map, so it also
     serves as the 'regenerate metadata' operation for stores written by other
     writers (reference etl/petastorm_generate_metadata.py:47).
+
+    Returns store statistics harvested from the same footer pass —
+    ``{"total_rows", "file_sizes", "num_files"}`` — so callers that need
+    them (e.g. the Spark converter's dataset_size) don't re-read N footers.
     """
     ctx = ctx_or_url if isinstance(ctx_or_url, DatasetContext) else DatasetContext(ctx_or_url)
     if ctx.is_multi_path:
@@ -264,11 +268,15 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
         raise MetadataGenerationError(f"No parquet data files under {ctx.root_path}")
 
     def _count(path):
+        size = ctx.filesystem.info(path)["size"]
         with ctx.filesystem.open(path, "rb") as f:
-            return os.path.relpath(path, ctx.root_path), pq.ParquetFile(f).metadata.num_row_groups
+            md = pq.ParquetFile(f).metadata
+        return (os.path.relpath(path, ctx.root_path),
+                md.num_row_groups, md.num_rows, size)
 
     with ThreadPoolExecutor(max_workers=10) as pool:
-        per_file = dict(pool.map(_count, files))
+        stats = list(pool.map(_count, files))
+    per_file = {rel: n_groups for rel, n_groups, _, _ in stats}
 
     kv: Dict[bytes, bytes] = dict(ctx.key_value_metadata())
     kv[TPU_ROW_GROUPS_PER_FILE_KEY] = json.dumps(per_file, sort_keys=True).encode("utf-8")
@@ -286,6 +294,9 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
     # Invalidate caches so subsequent reads see fresh metadata.
     ctx._kv_metadata = None
     ctx._file_paths = None
+    return {"total_rows": sum(rows for _, _, rows, _ in stats),
+            "file_sizes": [size for _, _, _, size in stats],
+            "num_files": len(files)}
 
 
 @contextmanager

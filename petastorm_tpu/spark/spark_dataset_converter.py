@@ -90,7 +90,7 @@ class SparkDatasetConverter:
         from petastorm_tpu.reader import make_batch_reader
         from petastorm_tpu.tf_utils import make_petastorm_dataset
         reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
-                                   **reader_kwargs)
+                                   **_apply_env_rank_defaults(reader_kwargs))
         dataset = make_petastorm_dataset(reader)
         if batch_size is not None:
             dataset = dataset.unbatch().batch(batch_size)
@@ -101,7 +101,7 @@ class SparkDatasetConverter:
         from petastorm_tpu.pytorch import BatchedDataLoader
         from petastorm_tpu.reader import make_batch_reader
         reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
-                                   **reader_kwargs)
+                                   **_apply_env_rank_defaults(reader_kwargs))
         return _ContextManagedAdapter(
             BatchedDataLoader(reader, batch_size=batch_size), reader)
 
@@ -163,15 +163,55 @@ def _convert_precision_and_vectors(df, dtype: Optional[str]):
     return converted
 
 
-def _check_parquet_file_sizes(cache_dir_url: str):
+def _env_rank_discovery():
+    """(rank, size) from the launcher environment, or None.
+
+    The reference resolves default shards from Horovod (reference :124-161);
+    outside a JAX runtime the same torch/TF consumers are typically launched
+    by horovodrun or mpirun, so honor those env conventions."""
+    for rank_key, size_key in (("HOROVOD_RANK", "HOROVOD_SIZE"),
+                               ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+                               ("PMI_RANK", "PMI_SIZE")):
+        rank, size = os.environ.get(rank_key), os.environ.get(size_key)
+        if rank is not None and size is not None:
+            return int(rank), int(size)
+    return None
+
+
+def _apply_env_rank_defaults(reader_kwargs: dict) -> dict:
+    """Default cur_shard/shard_count from the launcher env when the caller
+    didn't choose sharding explicitly."""
+    if "cur_shard" in reader_kwargs or "shard_count" in reader_kwargs:
+        return reader_kwargs
+    discovered = _env_rank_discovery()
+    if discovered is not None and discovered[1] > 1:
+        rank, size = discovered
+        logger.info("Sharding reader %d/%d from launcher environment", rank, size)
+        return dict(reader_kwargs, cur_shard=rank, shard_count=size)
+    return reader_kwargs
+
+
+def _wait_files_available(fs, paths, timeout_s: float = 30.0,
+                          poll_interval_s: float = 0.25):
+    """Block until every path is visible on ``fs`` — object stores with
+    eventual list-after-write consistency (S3) may not show freshly written
+    files immediately (parity: reference :613-639)."""
+    import time
+    deadline = time.time() + timeout_s
+    remaining = list(paths)
+    while remaining:
+        remaining = [p for p in remaining if not fs.exists(p)]
+        if not remaining:
+            return
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"Timed out after {timeout_s}s waiting for materialized files "
+                f"to become visible: {remaining[:3]}{'...' if len(remaining) > 3 else ''}")
+        time.sleep(poll_interval_s)
+
+
+def _check_parquet_file_sizes(sizes):
     """Warn when the materialized files are tiny (parity: reference :642)."""
-    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
-    fs, path = get_filesystem_and_path_or_paths(cache_dir_url)
-    try:
-        sizes = [fs.info(f)["size"] for f in fs.find(path)
-                 if f.endswith(".parquet")]
-    except Exception:  # noqa: BLE001
-        return
     if sizes and sorted(sizes)[len(sizes) // 2] < 50 * (1 << 20):
         warnings.warn(
             "The median materialized Parquet file is smaller than 50 MB; "
@@ -209,14 +249,33 @@ def make_spark_converter(df, parent_cache_dir_url: Optional[str] = None,
         writer = writer.option("compression", compression_codec)
     writer.parquet(cache_dir_url)
 
-    from petastorm_tpu.etl.dataset_metadata import write_dataset_metadata
-    write_dataset_metadata(cache_dir_url, None)
-    _check_parquet_file_sizes(cache_dir_url)
+    # Register for exit cleanup immediately: even if post-write bookkeeping
+    # below fails, the materialized files must not be orphaned.
+    _dirs_to_delete.add(cache_dir_url)
 
-    dataset_size = df.count()
+    if cache_dir_url.split("://", 1)[0] in ("s3", "s3a", "s3n", "gs"):
+        # Eventual list-after-write consistency: block until the commit
+        # marker is visible before trusting a directory listing.
+        from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+        _fs, _path = get_filesystem_and_path_or_paths(cache_dir_url)
+        _wait_files_available(_fs, [_path.rstrip("/") + "/_SUCCESS"])
+
+    from petastorm_tpu.etl.dataset_metadata import write_dataset_metadata
+    try:
+        # One threaded footer pass: row-group index + total rows + sizes.
+        # dataset_size from footers — re-running ``df.count()`` would
+        # execute the whole Spark query a second time.
+        stats = write_dataset_metadata(cache_dir_url, None)
+        dataset_size = stats["total_rows"]
+        _check_parquet_file_sizes(stats["file_sizes"])
+    except Exception as e:  # noqa: BLE001 - store is still readable via footers
+        logger.warning("Could not index the materialized store (%s); readers "
+                       "will footer-scan and dataset_size falls back to a "
+                       "Spark count", e)
+        dataset_size = df.count()
+
     converter = SparkDatasetConverter(cache_dir_url, dataset_size,
                                       parent_cache_dir_url)
     with _cache_lock:
         _converter_cache[key] = converter
-    _dirs_to_delete.add(cache_dir_url)
     return converter

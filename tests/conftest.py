@@ -41,6 +41,22 @@ def synthetic_dataset(tmp_path_factory):
                                          "path": f"{path}/ds"})
 
 
+@pytest.fixture()
+def spark_session():
+    """A SparkSession for converter tests: the real pyspark when importable,
+    the vendored :mod:`petastorm_tpu.test_util.minispark` local-mode engine
+    otherwise (this image has no JVM). Either way the converter runs its real
+    code paths — materialize, plan-hash cache, vector/precision conversion."""
+    from petastorm_tpu.test_util import minispark
+    minispark.install()
+    from pyspark.sql import SparkSession
+    spark = SparkSession.builder.master("local[2]") \
+        .appName("petastorm-tpu-tests").getOrCreate()
+    yield spark
+    spark.stop()
+    minispark.uninstall()
+
+
 @pytest.fixture(scope="session")
 def scalar_dataset(tmp_path_factory):
     """Session-scoped plain (non-petastorm) Parquet store — parity with
