@@ -67,18 +67,55 @@ def _promote(value, numpy_dtype):
     return value
 
 
+def _ngram_views(reader):
+    """Per-offset schema views of an NGram reader, in offset order."""
+    ngram = reader.ngram
+    return {off: ngram.get_schema_at_timestep(reader.schema, off)
+            for off in sorted(ngram.fields)}
+
+
+def _make_ngram_dataset(reader):
+    """NGram readout as ``tf.data.Dataset`` of ``{offset: namedtuple}``
+    structures (parity: reference tf_utils.py:140-199,408-437 — which
+    flattens/unflattens through TF1 plumbing; tf.data's structure support
+    handles the nested form directly)."""
+    tf = _tf()
+    views = _ngram_views(reader)
+    signature = {}
+    for off, view in views.items():
+        specs = {}
+        for name, f in view.fields.items():
+            specs[name] = tf.TensorSpec(
+                shape=[None if d is None else d for d in f.shape],
+                dtype=_tf_dtype_for(f.numpy_dtype))
+        signature[off] = view.namedtuple(**specs)
+
+    def generator():
+        if reader.last_row_consumed:
+            reader.reset()
+        for sample in reader:
+            out = {}
+            for off, view in views.items():
+                out[off] = view.namedtuple(**{
+                    name: _promote(_sanitize_value(getattr(sample[off], name)),
+                                   f.numpy_dtype)
+                    for name, f in view.fields.items()})
+            yield out
+
+    return tf.data.Dataset.from_generator(generator, output_signature=signature)
+
+
 def make_petastorm_dataset(reader):
     """Wrap a reader as ``tf.data.Dataset`` (parity: reference :336).
 
     Row readers yield one flat record dict per sample; batch readers yield
     one dict of arrays per row group (re-batch with ``dataset.unbatch()`` /
-    ``batch()``).
+    ``batch()``); NGram readers yield ``{offset: namedtuple}`` windows.
     """
     tf = _tf()
     schema = reader.schema
     if getattr(reader, "ngram", None) is not None:
-        raise NotImplementedError(
-            "NGram TF datasets are not supported; iterate the reader directly")
+        return _make_ngram_dataset(reader)
 
     names = list(schema.fields.keys())
     signature = {}
@@ -106,21 +143,35 @@ def make_petastorm_dataset(reader):
 
 def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int = 0):
     """Graph-mode tensors via ``tf.compat.v1.py_func`` (parity: reference
-    :269). Requires TF1-style graph execution."""
+    :269; ngram readout :408-437). Requires TF1-style graph execution.
+
+    Plain readers return one schema namedtuple of tensors; NGram readers
+    return ``{offset: namedtuple}``."""
     tf = _tf()
     schema = reader.schema
-    names = list(schema.fields.keys())
+    if getattr(reader, "ngram", None) is not None:
+        views = _ngram_views(reader)
+        flat = [(off, name, f) for off, view in views.items()
+                for name, f in view.fields.items()]
 
-    def dequeue():
-        sample = next(reader)
-        return [np.asarray(_promote(_sanitize_value(getattr(sample, n)),
-                                    schema.fields[n].numpy_dtype))
-                for n in names]
+        def dequeue():
+            sample = next(reader)
+            return [np.asarray(_promote(_sanitize_value(getattr(sample[off], name)),
+                                        f.numpy_dtype))
+                    for off, name, f in flat]
+    else:
+        names = list(schema.fields.keys())
+        flat = [(None, n, schema.fields[n]) for n in names]
 
-    dtypes = [_tf_dtype_for(schema.fields[n].numpy_dtype) for n in names]
+        def dequeue():
+            sample = next(reader)
+            return [np.asarray(_promote(_sanitize_value(getattr(sample, n)),
+                                        schema.fields[n].numpy_dtype))
+                    for n in names]
+
+    dtypes = [_tf_dtype_for(f.numpy_dtype) for _, _, f in flat]
     tensors = tf.compat.v1.py_func(dequeue, [], dtypes)
-    for t, n in zip(tensors, names):
-        f = schema.fields[n]
+    for t, (_, _, f) in zip(tensors, flat):
         if all(d is not None for d in f.shape):
             t.set_shape(f.shape)
     if shuffling_queue_capacity > 0:
@@ -131,4 +182,13 @@ def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int
         tf.compat.v1.train.add_queue_runner(
             tf.compat.v1.train.QueueRunner(queue, [enqueue]))
         tensors = queue.dequeue()
-    return schema.namedtuple(**dict(zip(names, tensors)))
+        for t, (_, _, f) in zip(tensors, flat):
+            if all(d is not None for d in f.shape):
+                t.set_shape(f.shape)
+    if getattr(reader, "ngram", None) is not None:
+        by_offset = {}
+        for t, (off, name, _) in zip(tensors, flat):
+            by_offset.setdefault(off, {})[name] = t
+        return {off: views[off].namedtuple(**cols)
+                for off, cols in by_offset.items()}
+    return schema.namedtuple(**{name: t for t, (_, name, _) in zip(tensors, flat)})

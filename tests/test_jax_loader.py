@@ -113,6 +113,25 @@ def test_batched_loader_shuffled(scalar_dataset):
     assert not np.array_equal(ids, np.arange(100))
 
 
+def test_batched_loader_warns_on_dropped_fields(scalar_dataset):
+    """Non-batchable columns are dropped loudly, naming the field."""
+    with make_batch_reader(scalar_dataset.url, schema_fields=["id", "string_col"],
+                           shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        with pytest.warns(UserWarning, match="string_col"):
+            batches = list(BatchedDataLoader(reader, batch_size=25))
+    assert batches and all("string_col" not in b for b in batches)
+    assert all("id" in b for b in batches)
+
+
+def test_inmem_loader_warns_on_dropped_fields(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, schema_fields=["id", "string_col"],
+                           shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        with pytest.warns(UserWarning, match="string_col"):
+            loader = InMemBatchedDataLoader(reader, batch_size=25, num_epochs=1)
+    batch = next(iter(loader))
+    assert "string_col" not in batch and "id" in batch
+
+
 def test_dtype_policy_applied(scalar_dataset):
     policy = DTypePolicy(float64_to_float32=True)
     with make_batch_reader(scalar_dataset.url, schema_fields=["float_col"],

@@ -87,6 +87,46 @@ def test_ngram_regex_fields(seq_dataset):
     assert set(w[0]._fields) == {"ts", "value"}
 
 
+def test_ngram_tf_dataset(seq_dataset):
+    """NGram windows flow through make_petastorm_dataset as
+    {offset: namedtuple} structures (reference tf_utils.py:140-199)."""
+    pytest.importorskip("tensorflow")
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    ngram = NGram({0: ["ts", "value"], 1: ["ts", "label"]},
+                  delta_threshold=1, timestamp_field="ts")
+    with make_reader(seq_dataset, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        dataset = make_petastorm_dataset(reader)
+        windows = list(dataset)
+    assert len(windows) == 18
+    for w in windows:
+        assert set(w.keys()) == {0, 1}
+        assert int(w[1].ts.numpy()) - int(w[0].ts.numpy()) == 1
+        assert w[0].value.shape == (2,)
+        assert not hasattr(w[0], "label")  # offset-0 view has no label field
+        assert hasattr(w[1], "label")
+
+
+def test_ngram_tf_tensors(seq_dataset):
+    """Graph-mode ngram readout (reference tf_utils.py:408-437)."""
+    tf = pytest.importorskip("tensorflow")
+    ngram = NGram({0: ["ts", "value"], 1: ["ts"]},
+                  delta_threshold=1, timestamp_field="ts")
+    from petastorm_tpu.tf_utils import tf_tensors
+    with make_reader(seq_dataset, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        graph = tf.Graph()
+        with graph.as_default():
+            sample = tf_tensors(reader)
+            assert set(sample.keys()) == {0, 1}
+            with tf.compat.v1.Session(graph=graph) as sess:
+                first = sess.run(sample)
+                second = sess.run(sample)
+    assert second[0].ts - first[0].ts == 1
+    assert first[1].ts - first[0].ts == 1
+    assert first[0].value.shape == (2,)
+
+
 def test_ngram_validation():
     with pytest.raises(ValueError, match="consecutive"):
         NGram({0: ["a"], 2: ["a"]}, delta_threshold=1, timestamp_field="a")
