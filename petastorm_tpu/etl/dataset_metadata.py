@@ -131,7 +131,9 @@ class DatasetContext:
                 return None
             with self.filesystem.open(p, "rb") as f:
                 return pq.read_schema(f)
-        except (OSError, IOError):
+        except (OSError, IOError, ValueError):
+            # ValueError covers pyarrow's ArrowInvalid: a corrupt sidecar
+            # must not take planning down with it.
             return None
 
     def key_value_metadata(self) -> Dict[bytes, bytes]:
@@ -160,12 +162,89 @@ class DatasetContext:
 
 
 # --------------------------------------------------------------------- read
+def _expand_row_groups(ctx: DatasetContext, path_counts) -> List[RowGroupRef]:
+    """[(file path, row-group count)] (ordered) -> flat RowGroupRef list."""
+    out: List[RowGroupRef] = []
+    for path, count in path_counts:
+        pv = ctx.partition_values_for(path)
+        out.extend(RowGroupRef(path, i, pv) for i in range(count))
+    return out
+
+
+def _row_groups_from_summary_metadata(ctx: DatasetContext,
+                                      files: List[str]) -> Optional[List[RowGroupRef]]:
+    """Row groups split out of a summary ``_metadata`` file, zero footer
+    reads (parity: reference etl/dataset_metadata.py:296-338). Returns None
+    when there is no usable summary (absent, row-group-free, or stale)."""
+    if ctx.is_multi_path:
+        return None
+    p = posixpath.join(ctx.root_path, "_metadata")
+    try:
+        if not ctx.filesystem.exists(p):
+            return None
+        with ctx.filesystem.open(p, "rb") as f:
+            md = pq.read_metadata(f)
+    except (OSError, IOError, ValueError):
+        # ValueError covers pyarrow.lib.ArrowInvalid: a corrupt/truncated
+        # summary must degrade to the footer scan, not fail planning.
+        return None
+    if md.num_row_groups == 0:
+        return None  # schema-only sidecar, not a summary
+    per_file: Dict[str, int] = {}
+    for i in range(md.num_row_groups):
+        file_path = md.row_group(i).column(0).file_path
+        if not file_path:
+            return None  # malformed summary: row group without a file path
+        per_file[file_path] = per_file.get(file_path, 0) + 1
+    by_rel = {os.path.relpath(f, ctx.root_path): f for f in files}
+    if set(per_file) != set(by_rel):
+        logger.warning("Summary _metadata is stale (%d summarized files, %d on "
+                       "disk); falling back", len(per_file), len(by_rel))
+        return None
+    return _expand_row_groups(
+        ctx, [(by_rel[rel], per_file[rel]) for rel in sorted(per_file)])
+
+
+def _multi_path_parent_index(ctx: DatasetContext,
+                             files: List[str]) -> Optional[Dict[str, int]]:
+    """For a multi-URL view whose files all live in one directory, reuse that
+    directory's ``_common_metadata`` row-group index instead of footer
+    scanning each listed file."""
+    parents = {posixpath.dirname(f) for f in files}
+    if len(parents) != 1:
+        return None
+    parent = parents.pop()
+    sidecar = posixpath.join(parent, "_common_metadata")
+    try:
+        if not ctx.filesystem.exists(sidecar):
+            return None
+        with ctx.filesystem.open(sidecar, "rb") as f:
+            kv = pq.read_schema(f).metadata or {}
+    except (OSError, IOError, ValueError):
+        return None
+    for key in (TPU_ROW_GROUPS_PER_FILE_KEY, LEGACY_ROW_GROUPS_PER_FILE_KEY):
+        if key in kv:
+            index = json.loads(kv[key].decode("utf-8"))
+            break
+    else:
+        return None
+    per_file = {}
+    for f in files:
+        rel = posixpath.basename(f)
+        if rel not in index:
+            return None  # listed file not indexed; scan footers instead
+        per_file[f] = index[rel]
+    return per_file
+
+
 def load_row_groups(ctx: DatasetContext) -> List[RowGroupRef]:
     """Enumerate every row group of the dataset as :class:`RowGroupRef`.
 
     Strategy (reference etl/dataset_metadata.py:244):
-    1. row-groups-per-file map from metadata (ours, then legacy key);
-    2. footer scan of every data file through a thread pool.
+    1. row-groups-per-file map from metadata (ours, then legacy key) —
+       for multi-URL views, the shared parent directory's index;
+    2. row-group split of a summary ``_metadata`` file (reference :296);
+    3. footer scan of every data file through a thread pool.
     """
     kv = ctx.key_value_metadata()
     per_file: Optional[Dict[str, int]] = None
@@ -175,7 +254,6 @@ def load_row_groups(ctx: DatasetContext) -> List[RowGroupRef]:
             break
 
     files = ctx.file_paths()
-    row_groups: List[RowGroupRef] = []
     if per_file is not None and not ctx.is_multi_path:
         root = ctx.root_path
         by_rel = {os.path.relpath(f, root): f for f in files}
@@ -188,12 +266,17 @@ def load_row_groups(ctx: DatasetContext) -> List[RowGroupRef]:
                 "metadata?); falling back to footer scan", len(missing), len(unindexed))
             per_file = None
         else:
-            for rel in sorted(per_file):
-                path = by_rel[rel]
-                pv = ctx.partition_values_for(path)
-                for i in range(per_file[rel]):
-                    row_groups.append(RowGroupRef(path, i, pv))
-            return row_groups
+            return _expand_row_groups(
+                ctx, [(by_rel[rel], per_file[rel]) for rel in sorted(per_file)])
+
+    if ctx.is_multi_path:
+        by_file = _multi_path_parent_index(ctx, files)
+        if by_file is not None:
+            return _expand_row_groups(ctx, [(f, by_file[f]) for f in files])
+
+    summary = _row_groups_from_summary_metadata(ctx, files)
+    if summary is not None:
+        return summary
 
     # Footer-scan fallback (reference :340).
     def _count(path):
@@ -202,11 +285,7 @@ def load_row_groups(ctx: DatasetContext) -> List[RowGroupRef]:
 
     with ThreadPoolExecutor(max_workers=10) as pool:
         counts = dict(pool.map(_count, files))
-    for path in files:
-        pv = ctx.partition_values_for(path)
-        for i in range(counts[path]):
-            row_groups.append(RowGroupRef(path, i, pv))
-    return row_groups
+    return _expand_row_groups(ctx, [(f, counts[f]) for f in files])
 
 
 def get_schema(ctx: DatasetContext) -> Unischema:
@@ -248,7 +327,8 @@ def infer_or_load_unischema(ctx: DatasetContext) -> Unischema:
 
 # -------------------------------------------------------------------- write
 def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
-                           extra_kv: Optional[Dict[bytes, bytes]] = None) -> dict:
+                           extra_kv: Optional[Dict[bytes, bytes]] = None,
+                           file_stats=None) -> dict:
     """(Re)write ``_common_metadata`` with schema + row-group index.
 
     Scans data-file footers to build the row-groups-per-file map, so it also
@@ -258,6 +338,8 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
     Returns store statistics harvested from the same footer pass —
     ``{"total_rows", "file_sizes", "num_files"}`` — so callers that need
     them (e.g. the Spark converter's dataset_size) don't re-read N footers.
+    ``file_stats`` (``[(path, num_row_groups, num_rows, size)]``, e.g. from
+    :func:`write_summary_metadata`) skips this function's own footer pass.
     """
     ctx = ctx_or_url if isinstance(ctx_or_url, DatasetContext) else DatasetContext(ctx_or_url)
     if ctx.is_multi_path:
@@ -267,15 +349,19 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
     if not files:
         raise MetadataGenerationError(f"No parquet data files under {ctx.root_path}")
 
-    def _count(path):
-        size = ctx.filesystem.info(path)["size"]
-        with ctx.filesystem.open(path, "rb") as f:
-            md = pq.ParquetFile(f).metadata
-        return (os.path.relpath(path, ctx.root_path),
-                md.num_row_groups, md.num_rows, size)
+    if file_stats is not None:
+        stats = [(os.path.relpath(path, ctx.root_path), n_groups, n_rows, size)
+                 for path, n_groups, n_rows, size in file_stats]
+    else:
+        def _count(path):
+            size = ctx.filesystem.info(path)["size"]
+            with ctx.filesystem.open(path, "rb") as f:
+                md = pq.ParquetFile(f).metadata
+            return (os.path.relpath(path, ctx.root_path),
+                    md.num_row_groups, md.num_rows, size)
 
-    with ThreadPoolExecutor(max_workers=10) as pool:
-        stats = list(pool.map(_count, files))
+        with ThreadPoolExecutor(max_workers=10) as pool:
+            stats = list(pool.map(_count, files))
     per_file = {rel: n_groups for rel, n_groups, _, _ in stats}
 
     kv: Dict[bytes, bytes] = dict(ctx.key_value_metadata())
@@ -297,6 +383,80 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
     return {"total_rows": sum(rows for _, _, rows, _ in stats),
             "file_sizes": [size for _, _, _, size in stats],
             "num_files": len(files)}
+
+
+def write_summary_metadata(ctx_or_url) -> list:
+    """Write a summary ``_metadata`` sidecar aggregating every data file's
+    row groups (``file_path``-tagged), so any Parquet planner — this package
+    or other tools — can split row groups with zero footer reads. Parity:
+    the reference generates this through the JVM summary committer
+    (etl/petastorm_generate_metadata.py:93-98); here it is built directly
+    from the footers with pyarrow.
+
+    Returns the harvested ``[(path, num_row_groups, num_rows, size)]`` so
+    callers that also (re)write ``_common_metadata`` can skip a second
+    footer pass."""
+    ctx = ctx_or_url if isinstance(ctx_or_url, DatasetContext) else DatasetContext(ctx_or_url)
+    if ctx.is_multi_path:
+        raise MetadataGenerationError("Cannot write summary metadata for a multi-URL view")
+    files = ctx.file_paths()
+    if not files:
+        raise MetadataGenerationError(f"No parquet data files under {ctx.root_path}")
+
+    # The old _metadata may be the only holder of schema key-values (legacy
+    # stores keep their pickled unischema there). Overwriting it with merged
+    # footer metadata must not destroy them: rescue such keys into
+    # _common_metadata first.
+    sidecar_path = posixpath.join(ctx.root_path, "_metadata")
+    common_path = posixpath.join(ctx.root_path, "_common_metadata")
+    try:
+        old_kv = {}
+        if ctx.filesystem.exists(sidecar_path):
+            with ctx.filesystem.open(sidecar_path, "rb") as f:
+                old_kv = pq.read_schema(f).metadata or {}
+        interesting = {k: v for k, v in old_kv.items()
+                       if k in (TPU_UNISCHEMA_KEY, TPU_ROW_GROUPS_PER_FILE_KEY,
+                                LEGACY_UNISCHEMA_KEY,
+                                LEGACY_ROW_GROUPS_PER_FILE_KEY)}
+        if interesting:
+            common_kv = {}
+            if ctx.filesystem.exists(common_path):
+                with ctx.filesystem.open(common_path, "rb") as f:
+                    common_schema = pq.read_schema(f)
+                common_kv = dict(common_schema.metadata or {})
+            else:
+                with ctx.filesystem.open(files[0], "rb") as f:
+                    common_schema = pq.ParquetFile(f).schema_arrow
+            rescued = {k: v for k, v in interesting.items() if k not in common_kv}
+            if rescued:
+                common_kv.update(rescued)
+                with ctx.filesystem.open(common_path, "wb") as f:
+                    pq.write_metadata(common_schema.with_metadata(common_kv), f)
+    except (OSError, IOError, ValueError):
+        logger.warning("Could not inspect existing _metadata key-values before "
+                       "summarizing; proceeding", exc_info=True)
+
+    def _read_md(path):
+        size = ctx.filesystem.info(path)["size"]
+        with ctx.filesystem.open(path, "rb") as f:
+            md = pq.ParquetFile(f).metadata
+        md.set_file_path(os.path.relpath(path, ctx.root_path))
+        return path, md, size
+
+    with ThreadPoolExecutor(max_workers=10) as pool:
+        collected = list(pool.map(_read_md, files))
+    # Harvest the per-file numbers BEFORE merging: append_row_groups mutates
+    # the first FileMetaData in place.
+    stats = [(path, md.num_row_groups, md.num_rows, size)
+             for path, md, size in collected]
+    merged = collected[0][1]
+    for _, md, _ in collected[1:]:
+        merged.append_row_groups(md)
+    with ctx.filesystem.open(sidecar_path, "wb") as f:
+        merged.write_metadata_file(f)
+    ctx._kv_metadata = None
+    ctx._file_paths = None
+    return stats
 
 
 @contextmanager

@@ -14,10 +14,12 @@ import sys
 from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
                                                 infer_or_load_unischema,
                                                 load_row_groups,
-                                                write_dataset_metadata)
+                                                write_dataset_metadata,
+                                                write_summary_metadata)
 
 
-def generate_metadata(dataset_url: str, use_inferred_schema: bool = False) -> int:
+def generate_metadata(dataset_url: str, use_inferred_schema: bool = False,
+                      use_summary_metadata: bool = False) -> int:
     """Returns the number of row groups indexed."""
     ctx = DatasetContext(dataset_url)
     if use_inferred_schema:
@@ -26,7 +28,13 @@ def generate_metadata(dataset_url: str, use_inferred_schema: bool = False) -> in
                                              omit_unsupported_fields=True)
     else:
         schema = infer_or_load_unischema(ctx)
-    write_dataset_metadata(ctx, schema)
+    if use_summary_metadata:
+        # Summary first: its footer pass feeds write_dataset_metadata so
+        # every data file is opened exactly once.
+        stats = write_summary_metadata(ctx)
+        write_dataset_metadata(ctx, schema, file_stats=stats)
+    else:
+        write_dataset_metadata(ctx, schema)
     return len(load_row_groups(ctx))
 
 
@@ -35,12 +43,17 @@ def build_parser():
     parser.add_argument("dataset_url")
     parser.add_argument("--use-inferred-schema", action="store_true",
                         help="Ignore any stored unischema; infer from Arrow")
+    parser.add_argument("--use-summary-metadata", action="store_true",
+                        help="Also write a summary _metadata file (row groups "
+                             "of every data file, file_path-tagged) readable "
+                             "by any Parquet planner")
     return parser
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    n = generate_metadata(args.dataset_url, args.use_inferred_schema)
+    n = generate_metadata(args.dataset_url, args.use_inferred_schema,
+                          args.use_summary_metadata)
     print(f"metadata written; {n} row groups indexed")
     return 0
 

@@ -182,6 +182,8 @@ def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int
         tf.compat.v1.train.add_queue_runner(
             tf.compat.v1.train.QueueRunner(queue, [enqueue]))
         tensors = queue.dequeue()
+        if not isinstance(tensors, (list, tuple)):
+            tensors = [tensors]  # single-component dequeue returns a bare Tensor
         for t, (_, _, f) in zip(tensors, flat):
             if all(d is not None for d in f.shape):
                 t.set_shape(f.shape)

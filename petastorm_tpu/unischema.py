@@ -107,6 +107,14 @@ def _dtype_name(numpy_dtype) -> str:
     return np.dtype(numpy_dtype).name
 
 
+def _rebuild_view_row(parent_name, field_names, values):
+    """Pickle reducer target: rebuild a schema-view row in the receiving
+    process through the cache (the dynamically created namedtuple classes
+    are not module attributes, so default pickle-by-name cannot find them —
+    e.g. NGram workers ship ``{offset: namedtuple}`` across a process pool)."""
+    return _NamedtupleCache.get(parent_name, field_names)(*values)
+
+
 class _NamedtupleCache:
     """Process-wide cache of namedtuple types keyed by (schema name, fields).
 
@@ -120,7 +128,11 @@ class _NamedtupleCache:
     def get(cls, parent_name: str, field_names: Sequence[str]):
         key = (parent_name, tuple(field_names))
         if key not in cls._cache:
-            cls._cache[key] = namedtuple(parent_name + "_view", field_names)
+            import copyreg
+            nt = namedtuple(parent_name + "_view", field_names)
+            copyreg.pickle(nt, lambda row, _p=parent_name, _f=key[1]:
+                           (_rebuild_view_row, (_p, _f, tuple(row))))
+            cls._cache[key] = nt
         return cls._cache[key]
 
 

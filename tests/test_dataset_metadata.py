@@ -74,6 +74,120 @@ def test_load_row_groups_footer_scan_fallback(tmp_path):
     assert len(rgs) == 3
 
 
+class _CountingFs:
+    """fsspec-filesystem proxy counting opens of data-file footers."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.data_file_opens = 0
+
+    def open(self, path, *args, **kwargs):
+        if not os.path.basename(path).startswith("_"):
+            self.data_file_opens += 1
+        return self._inner.open(path, *args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _counting_ctx(url):
+    ctx = DatasetContext(url)
+    ctx.filesystem = _CountingFs(ctx.filesystem)
+    return ctx
+
+
+def test_summary_metadata_plans_with_zero_footer_reads(tmp_path):
+    """A store with only a summary _metadata (no kv index) plans every row
+    group without opening a single data file (reference
+    etl/dataset_metadata.py:296-338)."""
+    from petastorm_tpu.etl.dataset_metadata import write_summary_metadata
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=60, rows_per_row_group=10, rows_per_file=20)
+    write_summary_metadata(url)
+    # Strip the kv index so only the summary can satisfy planning.
+    os.remove(f"{tmp_path}/ds/_common_metadata")
+    ctx = _counting_ctx(url)
+    rgs = load_row_groups(ctx)
+    assert len(rgs) == 6
+    assert ctx.filesystem.data_file_opens == 0
+    # and the refs are real: read one row group back
+    with pq.ParquetFile(rgs[0].path) as f:
+        assert f.read_row_group(rgs[0].row_group).num_rows == 10
+
+
+def test_summary_metadata_stale_falls_back(tmp_path):
+    from petastorm_tpu.etl.dataset_metadata import write_summary_metadata
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=40, rows_per_row_group=10, rows_per_file=20)
+    write_summary_metadata(url)
+    os.remove(f"{tmp_path}/ds/_common_metadata")
+    # Appending a file without regenerating makes the summary stale.
+    extra_src = glob.glob(f"{tmp_path}/ds/*.parquet")[0]
+    import shutil
+    shutil.copy(extra_src, f"{tmp_path}/ds/zzz-appended.parquet")
+    ctx = _counting_ctx(url)
+    rgs = load_row_groups(ctx)
+    assert len(rgs) == 6  # 4 + 2 appended, via footer scan
+    assert ctx.filesystem.data_file_opens > 0
+
+
+def test_multi_url_uses_parent_index_zero_footer_reads(tmp_path):
+    """A list-of-files view over an indexed directory plans from the parent's
+    _common_metadata instead of scanning each file's footer."""
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=60, rows_per_row_group=10, rows_per_file=20)
+    files = sorted(glob.glob(f"{tmp_path}/ds/*.parquet"))
+    urls = [f"file://{f}" for f in files[:2]]
+    ctx = _counting_ctx(urls)
+    rgs = load_row_groups(ctx)
+    assert len(rgs) == 4  # 2 files x 2 row groups
+    assert ctx.filesystem.data_file_opens == 0
+    assert {rg.path for rg in rgs} == set(files[:2])
+
+
+def test_summary_write_rescues_legacy_kv_from_metadata(tmp_path):
+    """Legacy stores keep their unischema key in _metadata; summarizing must
+    rescue it into _common_metadata, not destroy it."""
+    from petastorm_tpu.etl.dataset_metadata import write_summary_metadata
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=20, rows_per_row_group=10, rows_per_file=20)
+    # Simulate a legacy layout: kv lives ONLY in _metadata.
+    schema_with_kv = pq.read_schema(f"{tmp_path}/ds/_common_metadata")
+    pq.write_metadata(schema_with_kv, f"{tmp_path}/ds/_metadata")
+    os.remove(f"{tmp_path}/ds/_common_metadata")
+    assert get_schema(DatasetContext(url)) is not None  # readable before
+    write_summary_metadata(url)
+    # _metadata is now a row-group summary...
+    assert pq.read_metadata(f"{tmp_path}/ds/_metadata").num_row_groups == 2
+    # ...and the schema keys were rescued into _common_metadata.
+    assert get_schema(DatasetContext(url)) == SCHEMA
+    corrupt_free_rgs = load_row_groups(DatasetContext(url))
+    assert len(corrupt_free_rgs) == 2
+
+
+def test_corrupt_summary_metadata_falls_back(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=20, rows_per_row_group=10, rows_per_file=20)
+    os.remove(f"{tmp_path}/ds/_common_metadata")
+    with open(f"{tmp_path}/ds/_metadata", "wb") as f:
+        f.write(b"PAR1 this is not a parquet footer")
+    ctx = _counting_ctx(url)
+    rgs = load_row_groups(ctx)
+    assert len(rgs) == 2               # footer scan saved the day
+    assert ctx.filesystem.data_file_opens > 0
+
+
+def test_generate_metadata_cli_summary_flag(tmp_path):
+    from petastorm_tpu.etl.generate_metadata import main as gen_main
+    url = f"file://{tmp_path}/ds"
+    _write(url, n=40, rows_per_row_group=10, rows_per_file=20)
+    assert gen_main([url, "--use-summary-metadata"]) == 0
+    assert os.path.exists(f"{tmp_path}/ds/_metadata")
+    md = pq.read_metadata(f"{tmp_path}/ds/_metadata")
+    assert md.num_row_groups == 4
+    assert md.row_group(0).column(0).file_path
+
+
 def test_row_group_content_readable(tmp_path):
     url = f"file://{tmp_path}/ds"
     _write(url, n=25, rows_per_row_group=10, rows_per_file=25)

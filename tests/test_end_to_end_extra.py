@@ -1,0 +1,233 @@
+"""Reader depth tests: moved stores, shuffle quality, pool x feature
+combinations (strategy parity: reference test_end_to_end.py — moved dataset
+:306, shuffle-drop correlation :364, selector e2e :688-788)."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from dataset_utils import create_test_dataset
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.test_util.shuffling_analysis import compute_correlation_distance
+
+
+def test_moved_dataset_reads_end_to_end(tmp_path):
+    """Relocating a store invalidates nothing: absolute paths never leak
+    into metadata (reference test_end_to_end.py:306)."""
+    create_test_dataset(f"file://{tmp_path}/orig", num_rows=30)
+    shutil.move(f"{tmp_path}/orig", f"{tmp_path}/relocated")
+    with make_reader(f"file://{tmp_path}/relocated", schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        ids = sorted(s.id for s in reader)
+    assert ids == list(range(30))
+
+
+def test_moved_scalar_dataset_batch_reader(scalar_dataset, tmp_path):
+    src = scalar_dataset.url[len("file://"):]
+    shutil.copytree(src, f"{tmp_path}/copied")
+    with make_batch_reader(f"file://{tmp_path}/copied", schema_fields=["id"],
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        ids = sorted(int(i) for b in reader for i in b.id)
+    assert ids == list(range(100))
+
+
+# --------------------------------------------------------- shuffle quality
+def test_shuffle_quality_improves_with_drop_partitions(synthetic_dataset):
+    """The reference's headline shuffling result: row-group shuffle alone
+    leaves order highly correlated; adding shuffle_row_drop_partitions
+    decorrelates further (reference test_end_to_end.py:364)."""
+    def factory(**kw):
+        return lambda: make_reader(synthetic_dataset.url, schema_fields=["id"],
+                                   reader_pool_type="dummy", num_epochs=1, **kw)
+
+    corr_none = compute_correlation_distance(
+        factory(shuffle_row_groups=False))
+    corr_groups = compute_correlation_distance(
+        factory(shuffle_row_groups=True, seed=3))
+    corr_drop = compute_correlation_distance(
+        factory(shuffle_row_groups=True, seed=3, shuffle_row_drop_partitions=5))
+    assert corr_none > 0.97          # unshuffled ~= identity
+    assert corr_groups < corr_none
+    assert corr_drop < 0.5           # strongly decorrelated
+
+
+def test_shuffle_rows_decorrelates_within_groups(synthetic_dataset):
+    corr = compute_correlation_distance(
+        lambda: make_reader(synthetic_dataset.url, schema_fields=["id"],
+                            reader_pool_type="dummy", num_epochs=1,
+                            shuffle_row_groups=True, shuffle_rows=True, seed=3))
+    assert corr < 0.35
+
+
+def test_epoch_orders_differ_but_cover(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"], seed=1,
+                     shuffle_row_groups=True, reader_pool_type="dummy",
+                     num_epochs=2) as reader:
+        ids = [s.id for s in reader]
+    assert len(ids) == 200
+    first, second = ids[:100], ids[100:]
+    assert sorted(first) == sorted(second) == list(range(100))
+    assert first != second  # per-epoch reshuffle
+
+
+# ------------------------------------------------- pool x feature combos
+@pytest.mark.parametrize("pool", ["thread", pytest.param(
+    "process", marks=pytest.mark.process_pool)])
+def test_ngram_through_real_pools(tmp_path, pool):
+    """NGram assembly inside worker processes/threads, not just dummy pool."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("Seq", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("v", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    url = f"file://{tmp_path}/seq"
+    with materialize_dataset_local(url, schema, rows_per_row_group=10) as w:
+        for i in range(40):
+            w.write_row({"ts": i, "v": np.int32(i * 10)})
+    ngram = NGram({0: ["ts", "v"], 1: ["ts", "v"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type=pool, workers_count=2,
+                     num_epochs=1) as reader:
+        windows = list(reader)
+    # 4 row groups x 9 intra-group pairs (windows never span groups)
+    assert len(windows) == 36
+    for w in windows:
+        assert w[1].ts - w[0].ts == 1
+        assert w[0].v == w[0].ts * 10
+
+
+@pytest.mark.process_pool
+def test_predicate_through_process_pool(synthetic_dataset):
+    # in_set (not a lambda): worker processes must not need the test module.
+    from petastorm_tpu.predicates import in_set
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "id2"],
+                     predicate=in_set({3}, "id2"),
+                     reader_pool_type="process", workers_count=2,
+                     num_epochs=1) as reader:
+        rows = list(reader)
+    assert len(rows) == 10
+    assert all(r.id2 == 3 for r in rows)
+    assert sorted(r.id for r in rows) == [3, 13, 23, 33, 43, 53, 63, 73, 83, 93]
+
+
+@pytest.mark.process_pool
+def test_resume_through_process_pool(synthetic_dataset):
+    kwargs = dict(schema_fields=["id"], seed=9, shuffle_row_groups=True,
+                  reader_pool_type="process", workers_count=2, num_epochs=1)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        it = iter(reader)
+        first = [next(it).id for _ in range(30)]
+        state = reader.state_dict()
+    with make_reader(synthetic_dataset.url, **kwargs,
+                     resume_state=state) as reader:
+        rest = [s.id for s in reader]
+    assert set(first) | set(rest) == set(range(100))
+
+
+def test_transform_spec_through_thread_pool(synthetic_dataset):
+    from petastorm_tpu.transform import TransformSpec
+
+    def double_id(row):
+        row["id"] = row["id"] * 2
+        return row
+
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     transform_spec=TransformSpec(double_id),
+                     shuffle_row_groups=False, reader_pool_type="thread",
+                     workers_count=2, num_epochs=1) as reader:
+        ids = sorted(s.id for s in reader)
+    assert ids == [2 * i for i in range(100)]
+
+
+def test_pseudorandom_split_end_to_end(synthetic_dataset):
+    """Split predicates partition the id space disjointly and completely
+    through a real read (byte-compatible with the reference split)."""
+    def read_split(lo, hi):
+        pred = in_pseudorandom_split([0.3, 0.7], 0 if (lo, hi) == (0, 3) else 1, "id")
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         predicate=pred, shuffle_row_groups=False,
+                         reader_pool_type="dummy", num_epochs=1) as reader:
+            return {s.id for s in reader}
+
+    a = read_split(0, 3)
+    b = read_split(3, 10)
+    assert a and b
+    assert a.isdisjoint(b)
+    assert a | b == set(range(100))
+    assert 15 <= len(a) <= 45  # ~30 +- sampling noise
+
+
+def test_shard_seed_changes_assignment(synthetic_dataset):
+    def shard_ids(shard_seed):
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         cur_shard=0, shard_count=2, shard_seed=shard_seed,
+                         shuffle_row_groups=False, reader_pool_type="dummy",
+                         num_epochs=1) as reader:
+            return sorted(s.id for s in reader)
+
+    assert shard_ids(1) == shard_ids(1)      # deterministic
+    assert shard_ids(1) != shard_ids(2)      # seed changes the pre-shuffle
+
+
+def test_disk_cache_second_read_hits(tmp_path, synthetic_dataset):
+    kwargs = dict(schema_fields=["id", "matrix"],
+                  cache_type="local-disk",
+                  cache_location=str(tmp_path / "cache"),
+                  cache_size_limit=1 << 30,
+                  cache_row_size_estimate=10_000,
+                  shuffle_row_groups=False, reader_pool_type="dummy",
+                  num_epochs=1)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        ids1 = sorted(s.id for s in reader)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        ids2 = sorted(s.id for s in reader)
+    assert ids1 == ids2 == list(range(100))
+
+
+def test_batch_reader_predicate_and_transform(scalar_dataset):
+    from petastorm_tpu.transform import TransformSpec
+
+    def add_double(df):
+        df["doubled"] = df["int_col"] * 2
+        return df
+
+    import pyarrow as pa
+    spec = TransformSpec(add_double,
+                         edit_fields=[("doubled", np.int32, (), False)])
+    with make_batch_reader(scalar_dataset.url,
+                           transform_spec=spec,
+                           predicate=in_lambda(["id"], lambda row: row["id"] < 50),
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        seen = 0
+        for b in reader:
+            np.testing.assert_array_equal(b.doubled, b.int_col * 2)
+            assert (b.id < 50).all()
+            seen += len(b.id)
+    assert seen == 50
+
+
+def test_reader_diagnostics_shapes(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="thread",
+                     num_epochs=1) as reader:
+        _ = [s.id for s in reader]
+        assert isinstance(reader.diagnostics, dict)
+
+
+@pytest.mark.process_pool
+def test_process_pool_diagnostics_counts(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="process",
+                     workers_count=2, num_epochs=1) as reader:
+        _ = [s.id for s in reader]
+        diag = reader.diagnostics
+    assert diag["items_ventilated"] >= 10
+    assert diag["items_processed"] == diag["items_ventilated"]

@@ -1,0 +1,113 @@
+"""Small-surface depth tests: ngram properties, schema views, codecs,
+weighted sampling edges."""
+import numpy as np
+import pytest
+
+from dataset_utils import TestSchema
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.unischema import (Unischema, UnischemaField,
+                                     match_unischema_fields)
+
+
+def test_ngram_field_names_at_all_timesteps_include_timestamp():
+    ng = NGram({0: ["id"], 1: ["id2"]}, delta_threshold=1, timestamp_field="id")
+    assert "id" in ng.get_field_names_at_all_timesteps()
+    assert set(ng.get_field_names_at_all_timesteps()) == {"id", "id2"}
+
+
+def test_ngram_schema_at_missing_timestep_empty():
+    ng = NGram({0: ["id"]}, delta_threshold=1, timestamp_field="id")
+    view = ng.get_schema_at_timestep(TestSchema, 5)
+    assert view.fields == {}
+
+
+def test_ngram_form_ngram_respects_delta():
+    ng = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=2, timestamp_field="ts")
+    schema = Unischema("S", [UnischemaField("ts", np.int64, (), None, False)])
+    data = [{"ts": 0}, {"ts": 2}, {"ts": 10}, {"ts": 11}]
+    windows = ng.form_ngram(data, schema)
+    assert [(w[0].ts, w[1].ts) for w in windows] == [(0, 2), (10, 11)]
+
+
+def test_ngram_non_overlap_consumes_rows():
+    ng = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=5, timestamp_field="ts",
+               timestamp_overlap=False)
+    schema = Unischema("S", [UnischemaField("ts", np.int64, (), None, False)])
+    windows = ng.form_ngram([{"ts": i} for i in range(6)], schema)
+    assert [(w[0].ts, w[1].ts) for w in windows] == [(0, 1), (2, 3), (4, 5)]
+
+
+def test_schema_view_preserves_codecs():
+    view = TestSchema.create_schema_view(["image_png", "id"])
+    assert view.fields["image_png"].codec is TestSchema.fields["image_png"].codec
+    assert set(view.fields) == {"id", "image_png"}
+
+
+def test_match_unischema_fields_multiple_patterns():
+    matched = match_unischema_fields(TestSchema, ["id.*", "matrix$"])
+    names = {f.name for f in matched}
+    assert names == {"id", "id2", "matrix"}
+
+
+def test_schema_json_roundtrip_equality():
+    doc = TestSchema.to_dict()
+    back = Unischema.from_dict(doc)
+    assert back == TestSchema
+    assert list(back.fields) == list(TestSchema.fields)
+
+
+def test_namedtuple_pickles_across_view_of_view():
+    """Views-of-views produce dynamically named namedtuple classes; instances
+    must pickle (the NGram process-pool transport relies on it)."""
+    import pickle
+    view = TestSchema.create_schema_view(["id", "id2"])
+    view2 = view.create_schema_view(["id"])
+    row = view2.make_namedtuple(id=7)
+    clone = pickle.loads(pickle.dumps(row))
+    assert clone.id == 7
+    assert type(clone) is type(row)  # same cached class in-process
+
+
+def test_weighted_sampling_ratio_rough(synthetic_dataset):
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     num_epochs=None, shuffle_row_groups=False,
+                     reader_pool_type="dummy")
+    r2 = make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     num_epochs=None, shuffle_row_groups=False,
+                     reader_pool_type="dummy")
+    with WeightedSamplingReader([r1, r2], [0.9, 0.1]) as mixed:
+        it = iter(mixed)
+        draws = [next(it) for _ in range(300)]
+    assert len(draws) == 300  # both upstreams infinite; mix just flows
+
+
+def test_codec_compressed_image_quality_param():
+    from petastorm_tpu.codecs import CompressedImageCodec
+    field = UnischemaField("img", np.uint8, (16, 16, 3),
+                          CompressedImageCodec("jpeg", 55), False)
+    rng = np.random.default_rng(0)
+    img = np.full((16, 16, 3), 128, np.uint8) + rng.integers(0, 8, (16, 16, 3)).astype(np.uint8)
+    encoded = field.codec.encode(field, img)
+    decoded = field.codec.decode(field, encoded)
+    assert decoded.shape == img.shape
+    assert np.abs(decoded.astype(int) - img.astype(int)).mean() < 12
+
+
+def test_transform_spec_callable_only():
+    from petastorm_tpu.transform import TransformSpec
+    spec = TransformSpec(lambda row: row)
+    assert spec.func is not None
+    assert spec.edit_fields == [] or spec.edit_fields is not None
+
+
+def test_dummy_pool_results_order_matches_ventilation():
+    from petastorm_tpu.test_util.stub_workers import IdentityWorker
+    from petastorm_tpu.workers_pool.dummy_pool import DummyPool
+    pool = DummyPool()
+    pool.start(IdentityWorker)
+    for i in range(10):
+        pool.ventilate(value=i)
+    got = [pool.get_results() for _ in range(10)]
+    assert got == list(range(10))

@@ -1,0 +1,213 @@
+"""Reader edge-case depth: typed field round trips, predicate combinators,
+partitioned stores, adapter corners (strategy parity: reference
+test_end_to_end.py's long tail)."""
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from dataset_utils import TestSchema, create_test_dataset, make_test_row
+from petastorm_tpu.predicates import (in_intersection, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+from petastorm_tpu.reader import make_reader
+
+
+@pytest.fixture(scope="module")
+def ds(tmp_path_factory):
+    path = tmp_path_factory.mktemp("edges")
+    url = f"file://{path}/ds"
+    rows = create_test_dataset(url, num_rows=60, rows_per_row_group=10)
+    return type("DS", (), {"url": url, "rows": rows})
+
+
+def _by_id(reader):
+    return {s.id: s for s in reader}
+
+
+# ------------------------------------------------------- field round trips
+def test_nullable_field_yields_none(ds):
+    with make_reader(ds.url, schema_fields=["id", "nullable_int"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = _by_id(r)
+    for i in range(60):
+        expected = np.int32(i * 2) if i % 3 == 0 else None
+        assert rows[i].nullable_int == expected
+
+
+def test_decimal_round_trip(ds):
+    with make_reader(ds.url, schema_fields=["id", "decimal_col"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = _by_id(r)
+    assert rows[7].decimal_col == Decimal(7) / Decimal(10)
+    assert isinstance(rows[7].decimal_col, Decimal)
+
+
+def test_varlen_ndarray_round_trip(ds):
+    with make_reader(ds.url, schema_fields=["id", "varlen"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = _by_id(r)
+    for i in (0, 3, 9, 59):
+        np.testing.assert_array_equal(rows[i].varlen,
+                                      np.arange(i % 5 + 1, dtype=np.int32))
+
+
+def test_png_image_exact_round_trip(ds):
+    with make_reader(ds.url, schema_fields=["id", "image_png"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = _by_id(r)
+    np.testing.assert_array_equal(rows[5].image_png, ds.rows[5]["image_png"])
+    assert rows[5].image_png.dtype == np.uint8
+
+
+def test_compressed_uint16_matrix_round_trip(ds):
+    with make_reader(ds.url, schema_fields=["id", "matrix_uint16"],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = _by_id(r)
+    np.testing.assert_array_equal(rows[11].matrix_uint16,
+                                  ds.rows[11]["matrix_uint16"])
+    assert rows[11].matrix_uint16.dtype == np.uint16
+
+
+# ------------------------------------------------------ lifecycle corners
+def test_infinite_epochs_break_early_clean_close(ds):
+    with make_reader(ds.url, schema_fields=["id"], num_epochs=None,
+                     shuffle_row_groups=False, reader_pool_type="thread",
+                     workers_count=2) as reader:
+        it = iter(reader)
+        got = [next(it).id for _ in range(150)]
+    assert len(got) == 150  # more than one epoch; close() did not hang
+
+
+def test_invalid_pool_type_raises(ds):
+    with pytest.raises(ValueError, match="pool"):
+        make_reader(ds.url, reader_pool_type="fork-bomb")
+
+
+def test_invalid_cache_type_raises(ds):
+    with pytest.raises(ValueError, match="cache_type"):
+        make_reader(ds.url, cache_type="redis")
+
+
+# -------------------------------------------------- predicate combinators
+def test_in_negate_end_to_end(ds):
+    with make_reader(ds.url, schema_fields=["id", "id2"],
+                     predicate=in_negate(in_set({3}, "id2")),
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        ids2 = {s.id2 for s in r}
+    assert 3 not in ids2
+    assert ids2 == set(range(10)) - {3}
+
+
+def test_in_reduce_all_end_to_end(ds):
+    pred = in_reduce([in_set(set(range(5)), "id2"),
+                      in_negate(in_set({2}, "id2"))], all)
+    with make_reader(ds.url, schema_fields=["id2"], predicate=pred,
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        ids2 = {s.id2 for s in r}
+    assert ids2 == {0, 1, 3, 4}
+
+
+def test_in_reduce_any_end_to_end(ds):
+    pred = in_reduce([in_set({1}, "id2"), in_set({8}, "id2")], any)
+    with make_reader(ds.url, schema_fields=["id2"], predicate=pred,
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        ids2 = {s.id2 for s in r}
+    assert ids2 == {1, 8}
+
+
+def test_in_intersection_end_to_end(ds):
+    """in_intersection matches rows whose *iterable* field overlaps the set:
+    varlen = arange(i%5+1) contains 3 iff i%5 >= 3."""
+    with make_reader(ds.url, schema_fields=["id", "varlen"],
+                     predicate=in_intersection({3}, "varlen"),
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        ids = {s.id for s in r}
+    assert ids == {i for i in range(60) if i % 5 >= 3}
+
+
+def test_pseudorandom_split_ratios_stable_across_runs(ds):
+    def split_ids(idx):
+        with make_reader(ds.url, schema_fields=["id"],
+                         predicate=in_pseudorandom_split([0.5, 0.5], idx, "id"),
+                         shuffle_row_groups=False,
+                         reader_pool_type="dummy") as r:
+            return {s.id for s in r}
+    assert split_ids(0) == split_ids(0)  # hash-stable
+    assert split_ids(0) | split_ids(1) == set(range(60))
+
+
+# ----------------------------------------------------- partitioned stores
+@pytest.fixture(scope="module")
+def partitioned_ds(tmp_path_factory):
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("P", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("split", str, (), ScalarCodec(str), False),
+    ])
+    path = tmp_path_factory.mktemp("hive")
+    url = f"file://{path}/ds"
+    with materialize_dataset_local(url, schema, rows_per_row_group=5,
+                                   partition_by=["split"]) as w:
+        for i in range(30):
+            w.write_row({"id": i, "split": "train" if i % 3 else "test"})
+    return url
+
+
+def test_partition_column_read_back(partitioned_ds):
+    with make_reader(partitioned_ds, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as r:
+        rows = list(r)
+    assert len(rows) == 30
+    for s in rows:
+        assert s.split == ("train" if s.id % 3 else "test")
+
+
+def test_partition_predicate_prunes_row_groups(partitioned_ds):
+    """A predicate on only the partition key prunes whole row groups at
+    planning time (reference reader.py:620)."""
+    with make_reader(partitioned_ds, predicate=in_set({"test"}, "split"),
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = list(r)
+        # pruning happened at the planner: only the 'test' partition's row
+        # groups were ever queued for ventilation
+        ventilated_items = len(r._ventilator._items)
+    assert sorted(s.id for s in rows) == [i for i in range(30) if i % 3 == 0]
+    assert ventilated_items == 2  # 10 test rows / 5-row groups
+
+
+# ----------------------------------------------------------- TF graph mode
+def test_tf_tensors_with_shuffle_queue(ds):
+    tf = pytest.importorskip("tensorflow")
+    from petastorm_tpu.tf_utils import tf_tensors
+    with make_reader(ds.url, schema_fields=["id"], shuffle_row_groups=False,
+                     num_epochs=None, reader_pool_type="dummy") as reader:
+        graph = tf.Graph()
+        with graph.as_default():
+            sample = tf_tensors(reader, shuffling_queue_capacity=20,
+                                min_after_dequeue=5)
+            with tf.compat.v1.Session(graph=graph) as sess:
+                coord = tf.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(sess, coord)
+                got = [int(sess.run(sample.id)) for _ in range(30)]
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5)
+    assert got != sorted(got)          # queue shuffled
+    assert set(got) <= set(range(60))
+
+
+def test_torch_inmem_loader(ds):
+    import torch
+    from petastorm_tpu.pytorch import InMemBatchedDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from dataset_utils import create_test_scalar_dataset  # noqa: F401
+    with make_reader(ds.url, schema_fields=["id"], shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        loader = InMemBatchedDataLoader(reader, batch_size=20, num_epochs=2,
+                                        seed=0)
+        batches = list(loader)
+    assert len(batches) == 6  # 60 rows x 2 epochs / 20
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    seen = sorted(int(i) for b in batches[:3] for i in b["id"])
+    assert seen == list(range(60))
