@@ -162,12 +162,8 @@ def _moe_block(h, layer):
 
 
 def _dense_causal_attention(q, k, v):
-    b, s, h, d = q.shape
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    w = jax.nn.softmax(scores, -1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    from petastorm_tpu.parallel.attention import dense_attention
+    return dense_attention(q, k, v, causal=True)
 
 
 def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,

@@ -1,0 +1,24 @@
+"""Shared plain softmax attention — the single-device kernel used by the
+Llama model (no SP) and as the per-head-shard local step of Ulysses
+sequence parallelism. One copy so numerics tweaks (score dtype, mask
+handling) never diverge between consumers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Softmax attention on full tensors; q/k/v are (b, seq, heads, dim).
+
+    Scores accumulate in float32 regardless of input dtype; the causal mask
+    is position-based so it also holds for lq != lk."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)

@@ -1,0 +1,83 @@
+"""Ulysses-style all-to-all sequence parallelism: exact attention over
+sequence-sharded Q/K/V by trading the sequence sharding for a head sharding.
+
+Two ``lax.all_to_all`` collectives bracket a plain local attention:
+
+1. **seq -> head**: every device sends its sequence block of ``heads/P``
+   head groups to each peer; afterwards each device holds the FULL sequence
+   for its ``heads/P`` subset, so ordinary (flash/dense) attention runs
+   locally with no inner loop.
+2. **head -> seq**: the inverse all-to-all restores the original
+   ``(batch, seq/P, heads, head_dim)`` layout.
+
+Versus :mod:`ring_attention` (P ``ppermute`` steps, O(block²) memory,
+perfectly causal-efficient): Ulysses is two collectives total — better when
+the interconnect favors fewer, larger transfers and ``heads >= P`` — but it
+materializes the full (seq x seq) score matrix for each of its
+``heads/P`` local heads, so peak score memory is O(seq² x heads_per_device):
+more sequence shards shrink it, more local heads grow it. Both are exact;
+pick per workload (DeepSpeed-Ulysses, Jacobs et al., arXiv:2309.14509; see
+PAPERS.md — pattern reference only).
+
+Composes with tensor parallelism exactly like ring attention: shard heads on
+the model axis first, then the LOCAL head count must divide the seq axis.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+
+from petastorm_tpu.parallel.attention import dense_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Exact (optionally causal) attention across a sequence-sharded axis
+    via two all-to-alls. Must run inside ``shard_map``.
+
+    Local shapes: q/k/v are ``(batch_shard, seq_block, heads, head_dim)``;
+    ``heads`` must be divisible by the ``axis_name`` axis size.
+    """
+    p = jax.lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % p:
+        raise ValueError(
+            f"Ulysses sequence parallelism needs heads ({h}) divisible by "
+            f"the '{axis_name}' axis size ({p}); shard heads on the model "
+            f"axis first or use ring attention")
+
+    def seq_to_head(x):
+        # (b, l, h, d) -> (b, l*p, h/p, d): split heads across peers,
+        # concatenate their sequence blocks (device order == global order).
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head_to_seq(x):
+        # (b, l*p, h/p, d) -> (b, l, h, d): the inverse exchange.
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    out = dense_attention(seq_to_head(q), seq_to_head(k), seq_to_head(v),
+                          causal=causal)
+    return head_to_seq(out).astype(q.dtype)
+
+
+def make_ulysses_attention(mesh, seq_axis: str = "seq",
+                           data_axis: str = "data",
+                           head_axis: Optional[str] = None,
+                           causal: bool = True):
+    """Build a ``shard_map``-wrapped Ulysses attention over ``mesh``.
+
+    Drop-in interchangeable with :func:`make_ring_attention` — same
+    ``(batch, seq, heads, head_dim)`` layout, batch on ``data_axis``, seq on
+    ``seq_axis``, heads optionally on ``head_axis`` (tensor parallelism
+    composes: each model shard exchanges only its own heads).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(data_axis, seq_axis, head_axis, None)
+    fn = partial(ulysses_attention, axis_name=seq_axis, causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

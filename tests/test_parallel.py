@@ -49,6 +49,88 @@ def test_ring_attention_bf16():
                                np.asarray(ref), atol=0.1)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq_shards", [2, 4])
+def test_ulysses_attention_matches_dense(causal, seq_shards):
+    from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
+    mesh = make_mesh((8 // seq_shards, seq_shards), ("data", "seq"))
+    b, s, h, d = 8 // seq_shards * 2, seq_shards * 16, 4, 8
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+               for _ in range(3))
+    ulysses = jax.jit(make_ulysses_attention(mesh, causal=causal))
+    np.testing.assert_allclose(np.asarray(ulysses(q, k, v)),
+                               np.asarray(_dense_attn(q, k, v, causal)),
+                               atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    """The two sequence-parallel strategies are interchangeable."""
+    from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    rng = np.random.default_rng(2)
+    q, k, v = (jnp.asarray(rng.normal(size=(4, 64, 8, 16)), jnp.float32)
+               for _ in range(3))
+    ring = jax.jit(make_ring_attention(mesh, causal=True))
+    ulysses = jax.jit(make_ulysses_attention(mesh, causal=True))
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)),
+                               np.asarray(ulysses(q, k, v)), atol=2e-5)
+
+
+def test_ulysses_composes_with_tp():
+    """Heads sharded on the model axis: each TP shard exchanges its own
+    heads; local heads (8/2=4) still divide the seq axis (2)."""
+    from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
+    mesh = make_mesh((2, 2, 2), ("data", "seq", "model"))
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(4, 32, 8, 16)), jnp.float32)
+               for _ in range(3))
+    ulysses = jax.jit(make_ulysses_attention(mesh, head_axis="model",
+                                             causal=True))
+    np.testing.assert_allclose(np.asarray(ulysses(q, k, v)),
+                               np.asarray(_dense_attn(q, k, v, True)),
+                               atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.normal(size=(4, 32, 6, 8)), jnp.float32)
+               for _ in range(3))  # 6 heads % 4 shards != 0
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(make_ulysses_attention(mesh))(q, k, v)
+
+
+def test_llama_train_step_with_ulysses():
+    """Llama's train step accepts either sequence-parallel attention; one
+    step with Ulysses produces the same loss as ring (exact attention)."""
+    from petastorm_tpu.models import llama
+    from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
+    mesh = make_mesh((2, 2, 2), ("data", "seq", "model"))
+    cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=8,
+                            n_kv_heads=8, hidden=64)
+    act_spec = NamedSharding(mesh, P("data", "seq", None))
+    tokens = jnp.asarray(np.random.default_rng(5).integers(0, 64, (4, 65)),
+                         jnp.int32)
+    losses = {}
+    for name, maker in (("ring", make_ring_attention),
+                        ("ulysses", make_ulysses_attention)):
+        attn = maker(mesh, seq_axis="seq", data_axis="data",
+                     head_axis="model", causal=True)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        params = jax.device_put(params, llama.param_shardings(mesh, cfg))
+        init_opt, train_step = llama.make_train_step(cfg, attn_fn=attn,
+                                                     activation_spec=act_spec)
+        opt_state = init_opt(params)
+        batch = {"tokens": jax.device_put(
+            tokens, NamedSharding(mesh, P("data", None)))}
+        _, _, loss = jax.jit(train_step)(params, opt_state, batch)
+        losses[name] = float(loss)
+    assert np.isfinite(losses["ring"]) and np.isfinite(losses["ulysses"])
+    np.testing.assert_allclose(losses["ring"], losses["ulysses"], rtol=1e-4)
+
+
 def test_make_mesh_helpers():
     mesh = make_mesh((2, -1), ("data", "model"))
     assert mesh.shape == {"data": 2, "model": 4}
