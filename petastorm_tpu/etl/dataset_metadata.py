@@ -483,7 +483,19 @@ def materialize_dataset(spark, dataset_url: str, schema: Unischema,
                             use_summary_metadata=use_summary_metadata)
     try:
         yield
-        write_dataset_metadata(dataset_url, schema)
+        ctx = DatasetContext(
+            dataset_url,
+            filesystem=filesystem_factory() if filesystem_factory else None)
+        if use_summary_metadata:
+            # The reference relies on the JVM ParquetOutputCommitter for the
+            # summary file (petastorm_generate_metadata.py:93-98); build it
+            # directly from the footers instead — works on any committer,
+            # and the shared ctx + forwarded stats mean one directory
+            # listing and one footer read per data file.
+            stats = write_summary_metadata(ctx)
+            write_dataset_metadata(ctx, schema, file_stats=stats)
+        else:
+            write_dataset_metadata(ctx, schema)
     finally:
         _spark_restore_parquet_conf(spark, spark_config)
 

@@ -381,6 +381,35 @@ class _ClassProperty:
         return self._fget(owner)
 
 
+class _HadoopConfiguration:
+    """Dict-backed stand-in for the JVM hadoopConfiguration handle the
+    Spark materialize path tweaks (parquet.block.size etc.)."""
+
+    def __init__(self):
+        self._conf = {}
+
+    def get(self, key):
+        return self._conf.get(key)
+
+    def set(self, key, value):
+        self._conf[key] = value
+
+    def setInt(self, key, value):
+        self._conf[key] = str(int(value))
+
+    def setBoolean(self, key, value):
+        self._conf[key] = "true" if value else "false"
+
+    def unset(self, key):
+        self._conf.pop(key, None)
+
+
+class _SparkContext:
+    def __init__(self):
+        conf = _HadoopConfiguration()
+        self._jsc = _types_mod.SimpleNamespace(hadoopConfiguration=lambda: conf)
+
+
 class SparkSession:
     _active: Optional["SparkSession"] = None
 
@@ -407,6 +436,7 @@ class SparkSession:
 
     def __init__(self):
         self.conf = _RuntimeConf()
+        self.sparkContext = _SparkContext()
 
     # ``builder`` behaves like a property on the class in pyspark.
     builder = _ClassProperty(lambda cls: cls.Builder())

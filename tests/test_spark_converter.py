@@ -286,6 +286,59 @@ def test_explicit_shard_overrides_env(spark_session, cache_url, monkeypatch):
     conv.delete()
 
 
+def _materialize_schema():
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    return Unischema("MS", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("x", np.float64, (), ScalarCodec(np.float64), False),
+    ])
+
+
+def test_materialize_dataset_spark_path(spark_session, tmp_path):
+    """The Spark-flavored materialize ctx manager (reference
+    etl/dataset_metadata.py:52): conf setup, user write job, metadata."""
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from pyspark.sql.types import (DoubleType, LongType, StructField,
+                                   StructType)
+    url = f"file://{tmp_path}/spark_store"
+    sschema = StructType([StructField("id", LongType(), False),
+                          StructField("x", DoubleType(), False)])
+    with materialize_dataset(spark_session, url, _materialize_schema(),
+                             row_group_size_mb=1):
+        df = spark_session.createDataFrame(
+            [(i, i * 0.5) for i in range(30)], sschema)
+        df.write.parquet(url)
+    from petastorm_tpu.reader import make_reader
+    with make_reader(url, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as r:
+        rows = sorted((s.id, s.x) for s in r)
+    assert rows == [(i, i * 0.5) for i in range(30)]
+    # hadoop conf was restored after the ctx manager
+    hadoop = spark_session.sparkContext._jsc.hadoopConfiguration()
+    assert hadoop.get("parquet.block.size") is None
+
+
+def test_materialize_dataset_summary_metadata(spark_session, tmp_path):
+    """use_summary_metadata=True produces a real row-group summary _metadata
+    without any JVM committer."""
+    import pyarrow.parquet as pq
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from pyspark.sql.types import LongType, StructField, StructType
+    url = f"file://{tmp_path}/spark_sum"
+    with materialize_dataset(spark_session, url, _materialize_schema(),
+                             use_summary_metadata=True):
+        from pyspark.sql.types import DoubleType
+        df = spark_session.createDataFrame(
+            [(i, float(i)) for i in range(20)],
+            StructType([StructField("id", LongType(), False),
+                        StructField("x", DoubleType(), False)]))
+        df.write.parquet(url)
+    md = pq.read_metadata(f"{tmp_path}/spark_sum/_metadata")
+    assert md.num_row_groups >= 2
+    assert md.row_group(0).column(0).file_path
+
+
 class _FlakyFs:
     """Mock fs: each path invisible for its first N exists() calls."""
 
