@@ -377,9 +377,8 @@ def write_dataset_metadata(ctx_or_url, schema: Optional[Unischema],
     sidecar = posixpath.join(ctx.root_path, "_common_metadata")
     with ctx.filesystem.open(sidecar, "wb") as f:
         pq.write_metadata(arrow_schema, f)
-    # Invalidate caches so subsequent reads see fresh metadata.
+    # Sidecars are excluded from file_paths(); only the kv view changed.
     ctx._kv_metadata = None
-    ctx._file_paths = None
     return {"total_rows": sum(rows for _, _, rows, _ in stats),
             "file_sizes": [size for _, _, _, size in stats],
             "num_files": len(files)}
@@ -454,8 +453,9 @@ def write_summary_metadata(ctx_or_url) -> list:
         merged.append_row_groups(md)
     with ctx.filesystem.open(sidecar_path, "wb") as f:
         merged.write_metadata_file(f)
+    # Sidecars are excluded from file_paths(), so that cache stays valid;
+    # only the kv view changed.
     ctx._kv_metadata = None
-    ctx._file_paths = None
     return stats
 
 
