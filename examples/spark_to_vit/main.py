@@ -72,18 +72,20 @@ def train(url: str, steps: int, batch_size: int, classes: int, image: int):
         params = jax.tree.map(lambda p, g: p - 0.05 * g, params, grads)
         return params, loss, acc
 
-    loader = get_loader(url, batch_size, image)
-    it = iter(loader)
-    losses = []
-    t0 = time.time()
-    for i in range(steps):
-        params, loss, acc = step(params, next(it))
-        losses.append(float(loss))
-        if (i + 1) % 10 == 0:
-            print(f"step {i+1}: loss={np.mean(losses[-10:]):.4f} acc={float(acc):.3f}")
+    with get_loader(url, batch_size, image) as loader:
+        it = iter(loader)
+        losses = []
+        t0 = time.time()
+        for i in range(steps):
+            params, loss, acc = step(params, next(it))
+            losses.append(float(loss))
+            if (i + 1) % 10 == 0:
+                print(f"step {i+1}: loss={np.mean(losses[-10:]):.4f} "
+                      f"acc={float(acc):.3f}")
     print(f"{steps * batch_size / (time.time() - t0):.0f} samples/sec; "
           f"final loss {losses[-1]:.4f} (random={np.log(10):.2f})")
     assert losses[-1] < losses[0]
+    return losses
 
 
 def main():

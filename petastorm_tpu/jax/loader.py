@@ -68,19 +68,67 @@ class LoaderBase:
         self.metrics = PipelineMetrics()
         self._last_staged_bytes = 0
         self._skipped_warned: set = set()
+        self._object_column_mode: Dict[str, str] = {}
 
     def _batchable_columns(self, group) -> Dict[str, np.ndarray]:
-        """Split a reader row-group namedtuple into device-batchable columns,
-        warning (once per column) about the object-dtype ones dropped."""
+        """Split a reader row-group namedtuple into device-batchable columns.
+
+        Object-dtype columns holding uniform numeric rows (the
+        Spark-ML-vector-as-array layout — parity with the reference's vstack,
+        arrow_reader_worker.py:72-75) densify into a (rows, len) matrix;
+        genuinely ragged/string columns are dropped with a warning. The
+        choice — including the exact row shape and dtype — is locked in by
+        the FIRST group carrying the column and enforced for the whole
+        stream, so a column's representation can never flip between row
+        groups mid-training: any later deviation (ragged, null rows,
+        different length or dtype) raises a ValueError naming the column.
+        First-group-wins means a column that is only *sometimes* densifiable
+        either drops or raises depending on (shuffled) arrival order —
+        declare the field's shape to make it unambiguous."""
         cols, skipped = {}, []
         for name in group._fields:
             arr = getattr(group, name)
-            if arr.dtype == object:
-                skipped.append(name)  # ragged/str columns are not batchable
+            if arr.dtype != object:
+                cols[name] = arr
                 continue
-            cols[name] = arr
+            mode = self._object_column_mode.get(name)
+            if mode is None:
+                dense = self._try_densify(arr)
+                mode = ("drop" if dense is None
+                        else ("dense", dense.shape[1:], dense.dtype))
+                self._object_column_mode[name] = mode
+                if mode != "drop":
+                    cols[name] = dense
+                    continue
+            elif mode != "drop":
+                _, row_shape, dtype = mode
+                dense = self._try_densify(arr)
+                if (dense is None or dense.shape[1:] != row_shape
+                        or dense.dtype != dtype):
+                    got = ("null/ragged/non-numeric rows" if dense is None
+                           else f"rows of shape {dense.shape[1:]} {dense.dtype}")
+                    raise ValueError(
+                        f"Column {name!r} densified as shape {row_shape} "
+                        f"{dtype} earlier in the stream but this row group "
+                        f"has {got}; declare the field's shape (or exclude "
+                        f"the column) for consistent batches")
+                cols[name] = dense
+                continue
+            skipped.append(name)  # ragged/str columns are not batchable
         self._warn_skipped_fields(skipped)
         return cols
+
+    @staticmethod
+    def _try_densify(obj_column) -> Optional[np.ndarray]:
+        """(rows,) object array of equal-shape numeric arrays -> stacked
+        matrix; None when rows are missing, ragged, or non-numeric."""
+        try:
+            if any(v is None for v in obj_column):
+                return None
+            dense = np.stack([np.asarray(v) for v in obj_column])
+        except ValueError:
+            return None
+        return dense if dense.dtype.kind in "biufc" else None
 
     def _warn_skipped_fields(self, names):
         """One warning per newly dropped column — silent data loss is worse
@@ -166,6 +214,21 @@ class LoaderBase:
 
     def _host_batches(self):
         raise NotImplementedError
+
+    def close(self):
+        """Stop and join the underlying reader (no-op for loaders that
+        already drained it). ``with loader: ...`` does this on exit."""
+        reader = getattr(self, "_reader", None)
+        if reader is not None:
+            reader.stop()
+            reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 def _pad_to(arr_list, target_len):

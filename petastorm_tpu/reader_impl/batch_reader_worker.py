@@ -196,6 +196,11 @@ def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False)
                     else np.empty((0,) + shape, dtype=fill_dtype)
                 out[name] = stacked
             else:
+                # Undeclared-shape lists stay per-row object arrays here:
+                # workers see one row group at a time, so a data-dependent
+                # densify decision would flip between groups. The loaders
+                # densify uniform columns with a per-stream sticky decision
+                # (LoaderBase._batchable_columns).
                 obj = np.empty(len(arrays), dtype=object)
                 for i, a in enumerate(arrays):
                     obj[i] = a

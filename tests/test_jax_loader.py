@@ -113,6 +113,78 @@ def test_batched_loader_shuffled(scalar_dataset):
     assert not np.array_equal(ids, np.arange(100))
 
 
+def test_batched_loader_densifies_uniform_vector_column(scalar_dataset):
+    """Undeclared-shape list columns with uniform numeric rows densify into
+    (batch, len) matrices — the converter's ML-vector layout (reference
+    arrow_reader_worker.py:72-75) — instead of being dropped."""
+    with make_batch_reader(scalar_dataset.url,
+                           schema_fields=["id", "vector_col"],
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        batches = list(BatchedDataLoader(reader, batch_size=20))
+    assert all("vector_col" in b for b in batches)
+    assert all(np.asarray(b["vector_col"]).shape == (20, 4) for b in batches)
+
+
+def test_loader_sticky_densify_raises_on_ragged_after_dense():
+    """A column that went dense must not silently flip representation when a
+    later group is ragged — the loader raises, naming the column."""
+    from petastorm_tpu.jax.loader import LoaderBase
+    import collections
+    NT = collections.namedtuple("G", ["x"])
+
+    def obj_col(rows):
+        a = np.empty(len(rows), dtype=object)
+        for i, r in enumerate(rows):
+            a[i] = np.asarray(r)
+        return NT(a)
+
+    loader = LoaderBase(batch_size=2)
+    first = loader._batchable_columns(obj_col([[1.0, 2.0], [3.0, 4.0]]))
+    assert first["x"].shape == (2, 2)
+    with pytest.raises(ValueError, match="'x'.*ragged"):
+        loader._batchable_columns(obj_col([[1.0], [1.0, 2.0, 3.0]]))
+
+
+def test_loader_sticky_densify_raises_on_width_change():
+    """Uniform-but-different-width groups must raise with the column name,
+    not crash opaquely in the shuffling buffer's concatenate."""
+    from petastorm_tpu.jax.loader import LoaderBase
+    import collections
+    NT = collections.namedtuple("G", ["x"])
+
+    def obj_col(rows):
+        a = np.empty(len(rows), dtype=object)
+        for i, r in enumerate(rows):
+            a[i] = np.asarray(r)
+        return NT(a)
+
+    loader = LoaderBase(batch_size=2)
+    assert loader._batchable_columns(
+        obj_col([[1.0, 2.0], [3.0, 4.0]]))["x"].shape == (2, 2)
+    with pytest.raises(ValueError, match=r"'x'.*shape \(3,\)"):
+        loader._batchable_columns(obj_col([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]))
+
+
+def test_loader_sticky_drop_is_consistent():
+    """A column first seen ragged is dropped for the whole stream, even if a
+    later group happens to be uniform."""
+    from petastorm_tpu.jax.loader import LoaderBase
+    import collections
+    NT = collections.namedtuple("G", ["x"])
+
+    def obj_col(rows):
+        a = np.empty(len(rows), dtype=object)
+        for i, r in enumerate(rows):
+            a[i] = np.asarray(r)
+        return NT(a)
+
+    loader = LoaderBase(batch_size=2)
+    with pytest.warns(UserWarning, match="'x'"):
+        assert loader._batchable_columns(obj_col([[1.0], [1.0, 2.0]])) == {}
+    assert loader._batchable_columns(obj_col([[1.0, 2.0], [3.0, 4.0]])) == {}
+
+
 def test_batched_loader_warns_on_dropped_fields(scalar_dataset):
     """Non-batchable columns are dropped loudly, naming the field."""
     with make_batch_reader(scalar_dataset.url, schema_fields=["id", "string_col"],
