@@ -1,0 +1,158 @@
+"""Device-resident dataset cache: the TPU-native endpoint of the in-memory
+loading family.
+
+:class:`InMemBatchedDataLoader` (parity with the reference's torch loader,
+pytorch.py:437) keeps the dataset in HOST memory and pays a host→device
+transfer per batch. For datasets that fit in HBM, that transfer is pure
+waste: :class:`DeviceCachedDataset` loads every row onto the device(s)
+ONCE, then serves per-epoch shuffled batches as jitted on-device gathers —
+after the load, the input pipeline costs one ``take`` kernel per step and
+zero PCIe/DCN traffic. The permutation itself is computed on device with
+``jax.random`` (stateless, seeded), so epochs are reproducible and the
+whole batch derivation lives under ``jit``.
+
+Sharded layout: pass ``sharding`` (a ``NamedSharding`` whose first dim is
+the batch axis) and the cache is laid out sharded; the gather of a global
+permutation then rides XLA collectives over ICI. Leave it ``None`` for the
+single-device/replicated case where gathers are purely local.
+
+No reference counterpart — the reference cannot address accelerator memory
+at all (its in-mem loader is host-only).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from petastorm_tpu.jax.dtypes import DEFAULT_POLICY, DTypePolicy
+from petastorm_tpu.jax.loader import InMemBatchedDataLoader
+
+
+class DeviceCachedDataset:
+    """Load all rows of ``reader`` into device memory; iterate epochs of
+    shuffled fixed-size batches without touching the host again.
+
+    :param reader: a ``make_reader`` or ``make_batch_reader`` reader
+        (consumed fully during construction)
+    :param sharding: optional ``jax.sharding.Sharding`` for the cached
+        columns (batch dim first); ``None`` puts them on the default device
+    :param dtype_policy: dtype sanitization applied before upload
+    """
+
+    def __init__(self, reader, sharding=None,
+                 dtype_policy: DTypePolicy = DEFAULT_POLICY):
+        import jax
+
+        # Reuse the in-mem loader's one-pass columnar load + sanitization
+        # (num_epochs=1 just to materialize `_data`; we never iterate it).
+        staging = InMemBatchedDataLoader(reader, batch_size=1, num_epochs=1,
+                                         shuffle=False,
+                                         dtype_policy=dtype_policy)
+        host = staging._data
+        from petastorm_tpu.jax.dtypes import sanitize_batch
+        device_cols, host_cols = sanitize_batch(host, dtype_policy)
+        if host_cols:
+            import warnings
+            warnings.warn(f"Columns {sorted(host_cols)} are not device-"
+                          "representable and stay on the host; they are not "
+                          "served by DeviceCachedDataset batches.")
+        if not device_cols:
+            raise ValueError(
+                f"No device-representable columns remain after sanitization "
+                f"(host-only: {sorted(host_cols)}); adjust the DTypePolicy or "
+                f"the schema_fields selection")
+        self.num_rows = len(next(iter(device_cols.values())))
+        if sharding is not None:
+            # The sharded dim must divide the shard count; pad rows up to the
+            # next multiple. Permutations only ever index [0, num_rows), so
+            # the padding is dead weight in HBM, never served.
+            padded = self._padded_rows(self.num_rows, sharding,
+                                       next(iter(device_cols.values())).shape)
+            if padded != self.num_rows:
+                device_cols = {
+                    k: np.concatenate(
+                        [v, np.zeros((padded - self.num_rows,) + v.shape[1:],
+                                     v.dtype)])
+                    for k, v in device_cols.items()}
+            # make_array_from_callback, not device_put: every process holds
+            # the full host copy, and the callback hands each ADDRESSABLE
+            # shard its slice — so a global sharding spanning non-addressable
+            # pod devices still constructs (same multi-host reasoning as
+            # LoaderBase._stage's make_array_from_process_local_data).
+            self._data = {
+                k: jax.make_array_from_callback(
+                    v.shape, sharding,
+                    lambda idx, _v=v: _v[idx])
+                for k, v in device_cols.items()}
+        else:
+            self._data = {k: jax.device_put(v) for k, v in device_cols.items()}
+        self._sharding = sharding
+        self._gather_cache: Dict[int, tuple] = {}
+
+    @staticmethod
+    def _padded_rows(n, sharding, col_shape) -> int:
+        for pad in range(len(sharding.device_set)):
+            try:
+                sharding.shard_shape((n + pad,) + tuple(col_shape[1:]))
+                return n + pad
+            except ValueError:
+                continue
+        raise ValueError(f"Could not lay out {n} rows under {sharding}")
+
+    def _jitted(self, batch_size: int):
+        """Permutation + gather kernels, compiled once per batch size and
+        reused across every batches() call (shapes never change)."""
+        if batch_size not in self._gather_cache:
+            import jax
+            import jax.numpy as jnp
+            n = self.num_rows
+
+            @jax.jit
+            def epoch_perm(key):
+                return jax.random.permutation(key, n)
+
+            @jax.jit
+            def gather(perm, start):
+                idx = jax.lax.dynamic_slice_in_dim(perm, start, batch_size)
+                return {k: jnp.take(v, idx, axis=0)
+                        for k, v in self._data.items()}
+
+            self._gather_cache[batch_size] = (epoch_perm, gather)
+        return self._gather_cache[batch_size]
+
+    @property
+    def columns(self):
+        return sorted(self._data)
+
+    def nbytes(self) -> int:
+        return sum(v.nbytes for v in self._data.values())
+
+    def batches(self, batch_size: int, num_epochs: int = 1, shuffle: bool = True,
+                seed: int = 0, drop_last: bool = True):
+        """Yield ``{name: jax.Array}`` batches, reshuffled each epoch on
+        device. With ``drop_last`` the tail partial batch is skipped (static
+        shapes for jit consumers)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = self.num_rows
+        steps = n // batch_size if drop_last else -(-n // batch_size)
+        if steps == 0:
+            raise ValueError(f"batch_size {batch_size} exceeds dataset rows {n}")
+
+        epoch_perm, gather = self._jitted(batch_size)
+        base = jax.random.PRNGKey(seed)
+        for epoch in range(num_epochs):
+            if shuffle:
+                perm = epoch_perm(jax.random.fold_in(base, epoch))
+            else:
+                perm = jnp.arange(n)
+            for step in range(steps):
+                start = step * batch_size
+                if start + batch_size <= n:
+                    yield gather(perm, start)
+                else:  # drop_last=False ragged tail: plain (unjitted) take
+                    idx = perm[start:]
+                    yield {k: jnp.take(v, idx, axis=0)
+                           for k, v in self._data.items()}

@@ -40,7 +40,7 @@ from petastorm_tpu.reader_impl.batch_reader_worker import (BatchReaderWorker,
 from petastorm_tpu.reader_impl.row_reader_worker import RowReaderWorker
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
-from petastorm_tpu.workers_pool import EmptyResultError
+from petastorm_tpu.workers_pool import EmptyResultError, ITEM_CONTEXT_KWARG
 from petastorm_tpu.workers_pool.dummy_pool import DummyPool
 from petastorm_tpu.workers_pool.process_pool import ProcessPool
 from petastorm_tpu.workers_pool.thread_pool import ThreadPool
@@ -354,8 +354,9 @@ class Reader:
             start_offset=start_offset,
             # Workers key intra-row-group shuffle RNG by (seed, epoch,
             # position) so a resumed run replays the same row order inside
-            # each group as an uninterrupted one.
-            item_context_key="shuffle_context")
+            # each group as an uninterrupted one; pools echo the same context
+            # in processed markers for the exact-resume watermark.
+            item_context_key=ITEM_CONTEXT_KWARG)
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         if is_batched_reader:
@@ -435,10 +436,12 @@ class Reader:
     def state_dict(self) -> dict:
         """Checkpoint of the read position at row-group granularity: pass it
         back as ``resume_state=`` to a new reader (same dataset, filters,
-        sharding, seed) to continue the stream. The row group that was
-        mid-delivery is re-read on resume — consumers must tolerate replay of
-        the last partially-consumed group. The reference has no resume at
-        all (its reset() is epoch-end only, reader.py:503)."""
+        sharding, seed) to continue the stream. The cursor is a watermark
+        over confirmed-consumed work items, exact even when multi-worker
+        pools complete row groups out of ventilation order: groups at or
+        after the cursor that were partially delivered are re-read on
+        resume — bounded duplication, never loss. The reference has no
+        resume at all (its reset() is epoch-end only, reader.py:503)."""
         s = self._ventilator.state
         return {"epoch": s["epoch"], "offset": s["offset"]}
 

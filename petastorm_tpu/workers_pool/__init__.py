@@ -13,9 +13,23 @@ class TimeoutWaitingForResultError(RuntimeError):
     """Timed out waiting for a worker result."""
 
 
+# Work-item kwarg under which the ventilator attaches its (epoch, position)
+# context; pools echo it in the processed marker (one shared name so the
+# three pools and the ventilator/reader can never drift apart).
+ITEM_CONTEXT_KWARG = "shuffle_context"
+
+
 class VentilatedItemProcessedMessage:
     """Worker -> pool signal: one ventilated item fully processed (used for
-    ventilator backpressure accounting)."""
+    ventilator backpressure accounting).
+
+    ``item_context`` echoes the ventilator's ``(epoch, position)`` for the
+    item when the work kwargs carried one (the reader's ``shuffle_context``);
+    the ventilator uses it to advance an exact resume watermark even when
+    multi-worker pools complete items out of ventilation order."""
+
+    def __init__(self, item_context=None):
+        self.item_context = item_context
 
 
 class WorkerFailure:

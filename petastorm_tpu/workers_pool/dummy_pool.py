@@ -12,6 +12,7 @@ from __future__ import annotations
 from collections import deque
 
 from petastorm_tpu.workers_pool import (EmptyResultError,
+                                        ITEM_CONTEXT_KWARG,
                                         VentilatedItemProcessedMessage)
 
 
@@ -45,13 +46,14 @@ class DummyPool:
                 result = self._results.popleft()
                 if isinstance(result, VentilatedItemProcessedMessage):
                     if self._ventilator:
-                        self._ventilator.processed_item()
+                        self._ventilator.processed_item(result.item_context)
                     continue
                 return result
             if self._pending:
                 args, kwargs = self._pending.popleft()
                 self._worker.process(*args, **kwargs)
-                self._results.append(VentilatedItemProcessedMessage())
+                self._results.append(VentilatedItemProcessedMessage(
+                    kwargs.get(ITEM_CONTEXT_KWARG)))
                 continue
             if self._ventilator is None or self._ventilator.completed():
                 raise EmptyResultError()

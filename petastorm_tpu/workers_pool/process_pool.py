@@ -40,6 +40,7 @@ from traceback import format_exc
 
 from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
 from petastorm_tpu.workers_pool import (EmptyResultError,
+                                        ITEM_CONTEXT_KWARG,
                                         TimeoutWaitingForResultError,
                                         VentilatedItemProcessedMessage,
                                         WorkerFailure)
@@ -180,7 +181,7 @@ class ProcessPool:
             if isinstance(msg, VentilatedItemProcessedMessage):
                 self._processed += 1
                 if self._ventilator:
-                    self._ventilator.processed_item()
+                    self._ventilator.processed_item(msg.item_context)
                 continue
             if isinstance(msg, WorkerFailure):
                 logger.error("Worker failed:\n%s", msg.traceback_str)
@@ -410,7 +411,8 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
                 args, kwargs = work_socket.recv_pyobj()
                 try:
                     worker.process(*args, **kwargs)
-                    send_ctrl(VentilatedItemProcessedMessage())
+                    send_ctrl(VentilatedItemProcessedMessage(
+                        kwargs.get(ITEM_CONTEXT_KWARG)))
                 except Exception as e:  # noqa: BLE001 - ship to parent
                     sys.stderr.write(f"Worker {worker_id} exception:\n{format_exc()}\n")
                     try:

@@ -30,6 +30,7 @@ from traceback import format_exc
 from typing import Optional
 
 from petastorm_tpu.workers_pool import (EmptyResultError,
+                                        ITEM_CONTEXT_KWARG,
                                         VentilatedItemProcessedMessage,
                                         WorkerFailure)
 
@@ -64,7 +65,8 @@ class _WorkerThread(threading.Thread):
                 continue
             try:
                 self._worker_impl.process(*args, **kwargs)
-                self._put(VentilatedItemProcessedMessage())
+                self._put(VentilatedItemProcessedMessage(
+                    kwargs.get(ITEM_CONTEXT_KWARG)))
             except WorkerTerminationRequested:
                 break
             except Exception as e:  # noqa: BLE001 - propagate to consumer
@@ -183,7 +185,7 @@ class ThreadPool:
             if isinstance(result, VentilatedItemProcessedMessage):
                 self._processed[wid] += 1
                 if self._ventilator:
-                    self._ventilator.processed_item()
+                    self._ventilator.processed_item(result.item_context)
                 self._next_read = (self._next_read + 1) % self.workers_count
                 continue
             if isinstance(result, WorkerFailure):

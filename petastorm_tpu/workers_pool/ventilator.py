@@ -30,8 +30,9 @@ class Ventilator:
     def start(self):
         raise NotImplementedError
 
-    def processed_item(self):
-        """Consumer reports one item completed (backpressure credit)."""
+    def processed_item(self, item_context=None):
+        """Consumer reports one item completed (backpressure credit);
+        ``item_context`` optionally carries the item's (epoch, position)."""
 
     def completed(self) -> bool:
         """True when every item of every iteration has been ventilated."""
@@ -100,6 +101,14 @@ class ConcurrentVentilator(Ventilator):
         self._thread: Optional[threading.Thread] = None
         self._epoch = start_epoch
         self._processed_total = 0
+        # Exact resume watermark (linear index = epoch * n + position): the
+        # first item whose completion has NOT been confirmed. Advanced only
+        # over a contiguous prefix, so out-of-order completions from
+        # multi-worker pools can never skip a still-in-flight item.
+        n = max(1, len(self._items))
+        self._watermark = start_epoch * n + start_offset
+        self._completed_positions = set()
+        self._context_tracking = False
         self._state_lock = threading.Lock()
 
     # ------------------------------------------------------------------ api
@@ -110,24 +119,42 @@ class ConcurrentVentilator(Ventilator):
                                         name="ventilator", daemon=True)
         self._thread.start()
 
-    def processed_item(self):
+    def processed_item(self, item_context=None):
+        """Consumer reports one item completed. With ``item_context`` (the
+        ``(epoch, position)`` this ventilator attached to the item), the
+        resume watermark advances exactly; without it, completion order is
+        assumed to match ventilation order (single-worker pools)."""
         with self._inflight_cv:
             self._inflight = max(0, self._inflight - 1)
             self._inflight_cv.notify_all()
+        n = max(1, len(self._items))
         with self._state_lock:
             self._processed_total += 1
+            if item_context is not None:
+                self._context_tracking = True
+                epoch, pos = item_context
+                self._completed_positions.add(epoch * n + pos)
+                while self._watermark in self._completed_positions:
+                    self._completed_positions.remove(self._watermark)
+                    self._watermark += 1
 
     @property
     def state(self) -> Dict[str, Any]:
-        """Resume point: the (epoch, offset) of the next unprocessed item.
-        Feed back as ``start_epoch``/``start_offset`` (with the same items,
-        seed and shuffle flag) to continue exactly where consumption stopped;
-        in-flight items after the cursor are re-read on resume."""
+        """Resume point: the (epoch, offset) of the earliest item whose
+        completion is unconfirmed. Feed back as ``start_epoch``/
+        ``start_offset`` (with the same items, seed and shuffle flag) to
+        continue exactly where consumption stopped; items at or after the
+        cursor that were already delivered are re-read on resume (bounded
+        duplication, never loss — exact even when multi-worker pools
+        complete items out of ventilation order)."""
         n = max(1, len(self._items))
         with self._state_lock:
-            consumed = (self._start_epoch * n + self._start_offset
-                        + self._processed_total)
-        return {"epoch": consumed // n, "offset": consumed % n,
+            if self._context_tracking:
+                linear = self._watermark
+            else:
+                linear = (self._start_epoch * n + self._start_offset
+                          + self._processed_total)
+        return {"epoch": linear // n, "offset": linear % n,
                 "seed": self._seed, "randomized": self._randomize}
 
     def completed(self) -> bool:
@@ -162,6 +189,8 @@ class ConcurrentVentilator(Ventilator):
         self._start_offset = 0
         with self._state_lock:
             self._processed_total = 0
+            self._watermark = 0
+            self._completed_positions.clear()
         self.start()
 
     # ------------------------------------------------------------ internals

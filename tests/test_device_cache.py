@@ -1,0 +1,86 @@
+"""DeviceCachedDataset tests: on-device epochs over the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.jax import DeviceCachedDataset
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+
+@pytest.fixture(scope="module")
+def cache(request):
+    synthetic = request.getfixturevalue("synthetic_dataset")
+    with make_reader(synthetic.url, schema_fields=["id", "matrix"],
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=1) as reader:
+        return DeviceCachedDataset(reader)
+
+
+def test_all_rows_served_each_epoch(cache):
+    assert cache.num_rows == 100
+    for epoch_batches in (list(cache.batches(20, num_epochs=1, seed=1)),
+                          list(cache.batches(20, num_epochs=1, seed=2))):
+        ids = np.concatenate([np.asarray(b["id"]) for b in epoch_batches])
+        assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_batches_live_on_device(cache):
+    batch = next(cache.batches(10))
+    assert isinstance(batch["id"], jax.Array)
+    assert batch["matrix"].shape == (10, 32, 16, 3)
+
+
+def test_seeded_determinism_and_epoch_reshuffle(cache):
+    a = [np.asarray(b["id"]) for b in cache.batches(25, num_epochs=2, seed=7)]
+    b = [np.asarray(x["id"]) for x in cache.batches(25, num_epochs=2, seed=7)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    epoch1 = np.concatenate(a[:4])
+    epoch2 = np.concatenate(a[4:])
+    assert sorted(epoch1.tolist()) == sorted(epoch2.tolist())
+    assert not np.array_equal(epoch1, epoch2)
+
+
+def test_no_shuffle_is_sequential(cache):
+    ids = np.concatenate([np.asarray(b["id"])
+                          for b in cache.batches(50, shuffle=False)])
+    np.testing.assert_array_equal(ids, np.arange(100))
+
+
+def test_drop_last_false_ragged_tail(cache):
+    batches = list(cache.batches(30, drop_last=False, shuffle=False))
+    assert [len(b["id"]) for b in batches] == [30, 30, 30, 10]
+
+
+def test_batch_too_large_raises(cache):
+    with pytest.raises(ValueError, match="exceeds"):
+        next(cache.batches(101))
+
+
+def test_sharded_cache_layout(synthetic_dataset):
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=1) as reader:
+        cache = DeviceCachedDataset(reader, sharding=sharding)
+    assert len(cache._data["id"].sharding.device_set) == 8
+    batch = next(cache.batches(16, seed=0))
+    assert sorted(np.asarray(batch["id"]).tolist()) == \
+        sorted(set(np.asarray(batch["id"]).tolist()))  # 16 distinct rows
+    total = sum(int(jnp.sum(b["id"])) for b in cache.batches(25, seed=3))
+    assert total == sum(range(100))
+
+
+def test_from_batch_reader(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url,
+                           schema_fields=["id", "int_col", "string_col"],
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        with pytest.warns(UserWarning, match="string_col"):
+            cache = DeviceCachedDataset(reader)
+    assert "string_col" not in cache.columns
+    assert cache.num_rows == 100
+    assert cache.nbytes() > 0

@@ -33,6 +33,27 @@ def test_ventilator_resume_mid_epoch():
     assert got2 == full_order[4:]
 
 
+def test_ventilator_watermark_out_of_order_completions():
+    """Multi-worker pools complete items out of ventilation order; the
+    resume cursor must stop at the earliest unconfirmed item, never skip
+    a still-in-flight one (the row-loss bug this guards against)."""
+    v = ConcurrentVentilator(lambda **kw: None, [{"i": i} for i in range(8)],
+                             iterations=3, max_ventilation_queue_size=1000)
+    v.processed_item((0, 1))   # a fast worker finished item 1 first
+    v.processed_item((0, 3))
+    assert v.state["epoch"] == 0 and v.state["offset"] == 0  # 0 still out
+    v.processed_item((0, 0))   # slow worker delivers item 0 -> prefix 0..1
+    assert v.state["offset"] == 2  # item 2 is the earliest unconfirmed
+    v.processed_item((0, 2))   # fills the gap -> prefix 0..3
+    assert v.state["offset"] == 4
+    for p in (4, 5, 6, 7):
+        v.processed_item((0, p))
+    assert v.state == {"epoch": 1, "offset": 0, "seed": None,
+                       "randomized": False}
+    v.processed_item((1, 1))   # next epoch, out of order again
+    assert v.state["epoch"] == 1 and v.state["offset"] == 0
+
+
 def test_ventilator_state_tracks_processed():
     v = ConcurrentVentilator(lambda **kw: None, [{"i": i} for i in range(8)],
                              iterations=3, max_ventilation_queue_size=1000)
