@@ -21,11 +21,13 @@ at all (its in-mem loader is host-only).
 """
 from __future__ import annotations
 
+import warnings
 from typing import Dict, Optional
 
 import numpy as np
 
-from petastorm_tpu.jax.dtypes import DEFAULT_POLICY, DTypePolicy
+from petastorm_tpu.jax.dtypes import (DEFAULT_POLICY, DTypePolicy,
+                                      sanitize_batch)
 from petastorm_tpu.jax.loader import InMemBatchedDataLoader
 
 
@@ -50,10 +52,10 @@ class DeviceCachedDataset:
                                          shuffle=False,
                                          dtype_policy=dtype_policy)
         host = staging._data
-        from petastorm_tpu.jax.dtypes import sanitize_batch
+        del staging
         device_cols, host_cols = sanitize_batch(host, dtype_policy)
+        del host
         if host_cols:
-            import warnings
             warnings.warn(f"Columns {sorted(host_cols)} are not device-"
                           "representable and stay on the host; they are not "
                           "served by DeviceCachedDataset batches.")
@@ -63,30 +65,34 @@ class DeviceCachedDataset:
                 f"(host-only: {sorted(host_cols)}); adjust the DTypePolicy or "
                 f"the schema_fields selection")
         self.num_rows = len(next(iter(device_cols.values())))
+        padded = self.num_rows
         if sharding is not None:
             # The sharded dim must divide the shard count; pad rows up to the
             # next multiple. Permutations only ever index [0, num_rows), so
             # the padding is dead weight in HBM, never served.
             padded = self._padded_rows(self.num_rows, sharding,
                                        next(iter(device_cols.values())).shape)
+        # Upload column by column, releasing each host copy before the next
+        # one pads/uploads — peak host memory stays ~1x the dataset instead
+        # of holding raw + sanitized + padded copies simultaneously.
+        self._data = {}
+        for k in list(device_cols):
+            v = device_cols.pop(k)
             if padded != self.num_rows:
-                device_cols = {
-                    k: np.concatenate(
-                        [v, np.zeros((padded - self.num_rows,) + v.shape[1:],
-                                     v.dtype)])
-                    for k, v in device_cols.items()}
-            # make_array_from_callback, not device_put: every process holds
-            # the full host copy, and the callback hands each ADDRESSABLE
-            # shard its slice — so a global sharding spanning non-addressable
-            # pod devices still constructs (same multi-host reasoning as
-            # LoaderBase._stage's make_array_from_process_local_data).
-            self._data = {
-                k: jax.make_array_from_callback(
-                    v.shape, sharding,
-                    lambda idx, _v=v: _v[idx])
-                for k, v in device_cols.items()}
-        else:
-            self._data = {k: jax.device_put(v) for k, v in device_cols.items()}
+                v = np.concatenate(
+                    [v, np.zeros((padded - self.num_rows,) + v.shape[1:],
+                                 v.dtype)])
+            if sharding is not None:
+                # make_array_from_callback, not device_put: every process
+                # holds the full host copy, and the callback hands each
+                # ADDRESSABLE shard its slice — so a global sharding spanning
+                # non-addressable pod devices still constructs (same
+                # multi-host reasoning as LoaderBase._stage's
+                # make_array_from_process_local_data).
+                self._data[k] = jax.make_array_from_callback(
+                    v.shape, sharding, lambda idx, _v=v: _v[idx])
+            else:
+                self._data[k] = jax.device_put(v)
         self._sharding = sharding
         self._gather_cache: Dict[int, tuple] = {}
 

@@ -66,6 +66,14 @@ class FloatType(DataType):
     pass
 
 
+class ByteType(DataType):
+    pass
+
+
+class ShortType(DataType):
+    pass
+
+
 class IntegerType(DataType):
     pass
 
@@ -84,6 +92,19 @@ class BooleanType(DataType):
 
 class BinaryType(DataType):
     pass
+
+
+class TimestampType(DataType):
+    pass
+
+
+class DecimalType(DataType):
+    def __init__(self, precision: int = 10, scale: int = 0):
+        self.precision = precision
+        self.scale = scale
+
+    def simpleString(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
 
 
 class ArrayType(DataType):
@@ -494,9 +515,9 @@ def _build_modules():
     ml_functions = _types_mod.ModuleType("pyspark.ml.functions")
     ml_linalg = _types_mod.ModuleType("pyspark.ml.linalg")
 
-    for t in (DataType, DoubleType, FloatType, IntegerType, LongType,
-              StringType, BooleanType, BinaryType, ArrayType, StructField,
-              StructType):
+    for t in (DataType, DoubleType, FloatType, ByteType, ShortType,
+              IntegerType, LongType, StringType, BooleanType, BinaryType,
+              TimestampType, DecimalType, ArrayType, StructField, StructType):
         setattr(sql_types, t.__name__, t)
     sql_functions.col = col
     sql.SparkSession = SparkSession

@@ -339,6 +339,22 @@ def test_materialize_dataset_summary_metadata(spark_session, tmp_path):
     assert md.row_group(0).column(0).file_path
 
 
+def test_unischema_as_spark_schema_render(spark_session):
+    """Unischema -> Spark StructType rendering (reference unischema.py:264)
+    with per-codec storage types — first actual execution of this path."""
+    from dataset_utils import TestSchema
+    struct = TestSchema.as_spark_schema()
+    by_name = {f.name: f.dataType.typeName() for f in struct.fields}
+    assert by_name["id"] == "long"
+    assert by_name["id2"] == "integer"
+    assert by_name["partition_key"] == "string"
+    assert by_name["image_png"] == "binary"        # compressed image bytes
+    assert by_name["matrix"] == "binary"           # ndarray bytes
+    assert by_name["decimal_col"].startswith("decimal")
+    nullables = {f.name: f.nullable for f in struct.fields}
+    assert nullables["nullable_int"] and not nullables["id"]
+
+
 class _FlakyFs:
     """Mock fs: each path invisible for its first N exists() calls."""
 
