@@ -93,30 +93,58 @@ class LoaderBase:
                 continue
             mode = self._object_column_mode.get(name)
             if mode is None:
-                dense = self._try_densify(arr)
-                mode = ("drop" if dense is None
-                        else ("dense", dense.shape[1:], dense.dtype))
+                mode, converted = self._decide_object_mode(arr)
                 self._object_column_mode[name] = mode
                 if mode != "drop":
-                    cols[name] = dense
+                    cols[name] = converted
                     continue
             elif mode != "drop":
-                _, row_shape, dtype = mode
-                dense = self._try_densify(arr)
-                if (dense is None or dense.shape[1:] != row_shape
-                        or dense.dtype != dtype):
-                    got = ("null/ragged/non-numeric rows" if dense is None
-                           else f"rows of shape {dense.shape[1:]} {dense.dtype}")
+                kind, row_shape, dtype = mode
+                converted = (self._try_sanitize(arr) if kind == "sanitize"
+                             else self._try_densify(arr))
+                if (converted is None and kind == "sanitize"
+                        and np.dtype(dtype).kind == "f"
+                        and all(v is None for v in arr)):
+                    # An entirely-null group of a column already locked to a
+                    # float policy conversion: nan-fill instead of raising
+                    # (partially-null groups nan-fill inside sanitize_array).
+                    converted = np.full((len(arr),) + row_shape, np.nan, dtype)
+                if (converted is None or converted.shape[1:] != row_shape
+                        or converted.dtype != dtype):
+                    got = ("null/ragged/non-numeric rows" if converted is None
+                           else f"rows of shape {converted.shape[1:]} "
+                                f"{converted.dtype}")
                     raise ValueError(
-                        f"Column {name!r} densified as shape {row_shape} "
+                        f"Column {name!r} batched as shape {row_shape} "
                         f"{dtype} earlier in the stream but this row group "
                         f"has {got}; declare the field's shape (or exclude "
                         f"the column) for consistent batches")
-                cols[name] = dense
+                cols[name] = converted
                 continue
             skipped.append(name)  # ragged/str columns are not batchable
         self._warn_skipped_fields(skipped)
         return cols
+
+    def _decide_object_mode(self, arr):
+        """First sight of an object column: policy conversion (Decimal ->
+        float per DTypePolicy, etc.), then uniform-row densify, else drop."""
+        converted = self._try_sanitize(arr)
+        if converted is not None:
+            return ("sanitize", converted.shape[1:], converted.dtype), converted
+        dense = self._try_densify(arr)
+        if dense is not None:
+            return ("dense", dense.shape[1:], dense.dtype), dense
+        return "drop", None
+
+    def _try_sanitize(self, obj_column) -> Optional[np.ndarray]:
+        from petastorm_tpu.jax.dtypes import sanitize_array
+        try:
+            out = sanitize_array(obj_column, self._policy)
+        except (TypeError, ValueError, ArithmeticError):
+            # Mixed/unconvertible values: fall through to densify/drop (the
+            # Optional contract) instead of escaping as a raw exception.
+            return None
+        return out if out is not None and out.dtype != object else None
 
     @staticmethod
     def _try_densify(obj_column) -> Optional[np.ndarray]:
