@@ -118,7 +118,9 @@ def get_filesystem_and_path_or_paths(
     def _strip(url, parsed_url):
         if hasattr(fs, "_strip_protocol"):
             return fs._strip_protocol(url)
-        if parsed_url.scheme in ("file", "") or not parsed_url.netloc:
+        # hdfs netlocs are nameservice/namenode addresses, never part of the
+        # filesystem path.
+        if parsed_url.scheme in ("file", "", "hdfs") or not parsed_url.netloc:
             return parsed_url.path
         # Object stores address by bucket: keep the netloc in the path.
         return parsed_url.netloc + parsed_url.path

@@ -40,7 +40,8 @@ import numpy as np
 
 from petastorm_tpu.jax.batched_buffer import (BatchedNoopShufflingBuffer,
                                               BatchedRandomShufflingBuffer)
-from petastorm_tpu.jax.dtypes import DEFAULT_POLICY, DTypePolicy, sanitize_batch
+from petastorm_tpu.jax.dtypes import (DEFAULT_POLICY, DTypePolicy,
+                                      sanitize_array, sanitize_batch)
 from petastorm_tpu.metrics import PipelineMetrics, trace
 
 logger = logging.getLogger(__name__)
@@ -68,7 +69,8 @@ class LoaderBase:
         self.metrics = PipelineMetrics()
         self._last_staged_bytes = 0
         self._skipped_warned: set = set()
-        self._object_column_mode: Dict[str, str] = {}
+        # Per-column sticky conversion: "drop" or (kind, row_shape, dtype).
+        self._object_column_mode: Dict[str, object] = {}
 
     def _batchable_columns(self, group) -> Dict[str, np.ndarray]:
         """Split a reader row-group namedtuple into device-batchable columns.
@@ -83,8 +85,10 @@ class LoaderBase:
         groups mid-training: any later deviation (ragged, null rows,
         different length or dtype) raises a ValueError naming the column.
         First-group-wins means a column that is only *sometimes* densifiable
-        either drops or raises depending on (shuffled) arrival order —
-        declare the field's shape to make it unambiguous."""
+        either drops or raises depending on (shuffled) arrival order, and an
+        entirely-null FIRST group locks a convertible column to "drop"
+        (there is nothing to infer a layout from) — declare the field's
+        shape to make such columns unambiguous."""
         cols, skipped = {}, []
         for name in group._fields:
             arr = getattr(group, name)
@@ -102,12 +106,12 @@ class LoaderBase:
                 kind, row_shape, dtype = mode
                 converted = (self._try_sanitize(arr) if kind == "sanitize"
                              else self._try_densify(arr))
-                if (converted is None and kind == "sanitize"
-                        and np.dtype(dtype).kind == "f"
+                if (converted is None and np.dtype(dtype).kind == "f"
                         and all(v is None for v in arr)):
                     # An entirely-null group of a column already locked to a
-                    # float policy conversion: nan-fill instead of raising
-                    # (partially-null groups nan-fill inside sanitize_array).
+                    # float layout: the shape and dtype are known, so
+                    # nan-fill instead of raising — for both the policy
+                    # ('sanitize') and vector ('dense') kinds.
                     converted = np.full((len(arr),) + row_shape, np.nan, dtype)
                 if (converted is None or converted.shape[1:] != row_shape
                         or converted.dtype != dtype):
@@ -137,7 +141,6 @@ class LoaderBase:
         return "drop", None
 
     def _try_sanitize(self, obj_column) -> Optional[np.ndarray]:
-        from petastorm_tpu.jax.dtypes import sanitize_array
         try:
             out = sanitize_array(obj_column, self._policy)
         except (TypeError, ValueError, ArithmeticError):

@@ -96,3 +96,85 @@ def test_connect_all_dead_raises():
     _MockConnector.fs_by_host = {}
     with pytest.raises(HdfsConnectError, match="any namenode"):
         _MockConnector.connect_to_either_namenode(["host1:8020", "host2:8020"])
+
+
+def test_hadoop_xml_discovery(tmp_path, monkeypatch):
+    """Namenodes resolve from core-site/hdfs-site XML on disk (reference
+    test_hdfs_namenode.py MockHadoopConfiguration — here, real XML parse)."""
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    (conf / "core-site.xml").write_text("""<?xml version="1.0"?>
+<configuration>
+  <property><name>fs.defaultFS</name><value>hdfs://ns1</value></property>
+</configuration>""")
+    (conf / "hdfs-site.xml").write_text("""<?xml version="1.0"?>
+<configuration>
+  <property><name>dfs.nameservices</name><value>ns1</value></property>
+  <property><name>dfs.ha.namenodes.ns1</name><value>a,b</value></property>
+  <property><name>dfs.namenode.rpc-address.ns1.a</name><value>ha:1</value></property>
+  <property><name>dfs.namenode.rpc-address.ns1.b</name><value>hb:2</value></property>
+</configuration>""")
+    monkeypatch.setenv("HADOOP_CONF_DIR", str(conf))
+    r = HdfsNamenodeResolver()
+    svc, nns = r.resolve_default_hdfs_service()
+    assert svc == "ns1" and nns == ["ha:1", "hb:2"]
+
+
+def test_every_proxied_method_fails_over():
+    """The failover decorator wraps arbitrary filesystem methods, not just
+    ls (reference test_hdfs_namenode.py:490+ per-method interception)."""
+
+    class _RichFs(_MockFs):
+        def open(self, path, mode="rb"):
+            self.calls += 1
+            if self.failures > 0:
+                self.failures -= 1
+                raise IOError(f"{self.name} down")
+            return f"handle-from-{self.name}"
+
+        def info(self, path):
+            self.calls += 1
+            if self.failures > 0:
+                self.failures -= 1
+                raise IOError(f"{self.name} down")
+            return {"name": path, "via": self.name}
+
+    _MockConnector.fs_by_host = {"host1:8020": _RichFs("host1", failures=1),
+                                 "host2:8020": _RichFs("host2")}
+    client = HAHdfsClient(_MockConnector, ["host1:8020", "host2:8020"])
+    assert client.open("/f") == "handle-from-host2"
+    # After failover the client sticks with the healthy namenode.
+    assert client.info("/f")["via"] == "host2"
+    assert client.ls("/d") == ["/d/ok-from-host2"]
+
+
+def test_failover_recovers_transient_blip():
+    """A single transient failure on the active namenode retries without
+    exhausting the budget."""
+    _MockConnector.fs_by_host = {"host1:8020": _MockFs("host1", failures=1),
+                                 "host2:8020": _MockFs("host2", failures=0)}
+    client = HAHdfsClient(_MockConnector, ["host1:8020", "host2:8020"])
+    assert client.ls("/x") == ["/x/ok-from-host2"]
+    assert client.ls("/y") == ["/y/ok-from-host2"]  # no further failover
+
+
+def test_fs_utils_hdfs_url_resolves_nameservice(monkeypatch):
+    """An hdfs://nameservice URL routes through HA namenode resolution and
+    connect_to_either_namenode (reference fs_utils.py scheme dispatch)."""
+    from petastorm_tpu import hdfs
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    seen = {}
+
+    @classmethod
+    def fake_connect(cls, namenodes, user=None, storage_options=None):
+        seen["namenodes"] = list(namenodes)
+        return _MockFs("resolved")
+
+    monkeypatch.setattr(hdfs.namenode.HdfsConnector,
+                        "connect_to_either_namenode", fake_connect)
+    fs, path = get_filesystem_and_path_or_paths(
+        "hdfs://nameservice1/data/ds",
+        hadoop_configuration=HADOOP_CONFIG)
+    assert seen["namenodes"] == ["host1:8020", "host2:8020"]
+    assert path == "/data/ds"
+    assert fs.ls("/data/ds") == ["/data/ds/ok-from-resolved"]
