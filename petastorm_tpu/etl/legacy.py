@@ -64,6 +64,19 @@ class _LegacyScalarCodec(_codecs.ScalarCodec):
         self.storage_dtype = np_dtype
 
 
+class _LegacyCompressedImageCodec(_codecs.CompressedImageCodec):
+    """Reference pickles store the cv2 extension (``'.png'``/``'.jpg'``)
+    under ``_image_codec``; normalize to this package's attribute layout so
+    encode/to_dict work after migration (decode alone never noticed —
+    ``cv2.imdecode`` sniffs the container format)."""
+
+    def __setstate__(self, state):
+        ext = state.get("_image_codec") or state.get("image_codec") or ".png"
+        codec = "jpeg" if "jp" in str(ext) else "png"
+        _codecs.CompressedImageCodec.__init__(
+            self, codec, int(state.get("_quality", state.get("quality", 80))))
+
+
 class _LegacyUnischema(Unischema):
     """Unischema reconstructed from a reference pickle's instance dict."""
 
@@ -96,11 +109,11 @@ _ALLOWED = {
     ("petastorm.codecs", "ScalarCodec"): _LegacyScalarCodec,
     ("petastorm.codecs", "NdarrayCodec"): _codecs.NdarrayCodec,
     ("petastorm.codecs", "CompressedNdarrayCodec"): _codecs.CompressedNdarrayCodec,
-    ("petastorm.codecs", "CompressedImageCodec"): _codecs.CompressedImageCodec,
+    ("petastorm.codecs", "CompressedImageCodec"): _LegacyCompressedImageCodec,
     ("dataset_toolkit.codecs", "ScalarCodec"): _LegacyScalarCodec,
     ("dataset_toolkit.codecs", "NdarrayCodec"): _codecs.NdarrayCodec,
     ("dataset_toolkit.codecs", "CompressedNdarrayCodec"): _codecs.CompressedNdarrayCodec,
-    ("dataset_toolkit.codecs", "CompressedImageCodec"): _codecs.CompressedImageCodec,
+    ("dataset_toolkit.codecs", "CompressedImageCodec"): _LegacyCompressedImageCodec,
     ("collections", "OrderedDict"): OrderedDict,
     ("collections", "defaultdict"): defaultdict,
     ("builtins", "str"): str,

@@ -58,7 +58,10 @@ class FilesystemResolver:
 
         if filesystem is not None:
             self._filesystem = filesystem
-            self._path = self._parsed.path if self._parsed.scheme in ("file", "") \
+            # hdfs netlocs are namenode/nameservice addresses, not path
+            # components (same rule as get_filesystem_and_path_or_paths).
+            self._path = self._parsed.path \
+                if self._parsed.scheme in ("file", "", "hdfs") \
                 else (self._parsed.netloc + self._parsed.path)
             return
 

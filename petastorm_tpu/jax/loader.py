@@ -106,13 +106,13 @@ class LoaderBase:
                 kind, row_shape, dtype = mode
                 converted = (self._try_sanitize(arr) if kind == "sanitize"
                              else self._try_densify(arr))
-                if (converted is None and np.dtype(dtype).kind == "f"
-                        and all(v is None for v in arr)):
-                    # An entirely-null group of a column already locked to a
-                    # float layout: the shape and dtype are known, so
-                    # nan-fill instead of raising — for both the policy
-                    # ('sanitize') and vector ('dense') kinds.
-                    converted = np.full((len(arr),) + row_shape, np.nan, dtype)
+                if converted is None and np.dtype(dtype).kind == "f":
+                    # Null rows in a column already locked to a float layout:
+                    # the shape and dtype are known, so nan-fill the null
+                    # rows instead of raising — partial or entirely null,
+                    # for both the policy and vector kinds.
+                    converted = self._densify_with_nan_fill(arr, row_shape,
+                                                            np.dtype(dtype))
                 if (converted is None or converted.shape[1:] != row_shape
                         or converted.dtype != dtype):
                     got = ("null/ragged/non-numeric rows" if converted is None
@@ -148,6 +148,26 @@ class LoaderBase:
             # Optional contract) instead of escaping as a raw exception.
             return None
         return out if out is not None and out.dtype != object else None
+
+    @staticmethod
+    def _densify_with_nan_fill(obj_column, row_shape, dtype) -> Optional[np.ndarray]:
+        """Stack a float-locked column whose group contains null rows,
+        nan-filling them; None when any non-null row deviates from the
+        locked layout."""
+        fill = np.full(row_shape, np.nan, dtype)
+        rows = []
+        for v in obj_column:
+            if v is None:
+                rows.append(fill)
+                continue
+            try:
+                a = np.asarray(v, dtype=dtype)
+            except (TypeError, ValueError):
+                return None
+            if a.shape != tuple(row_shape):
+                return None
+            rows.append(a)
+        return np.stack(rows) if rows else None
 
     @staticmethod
     def _try_densify(obj_column) -> Optional[np.ndarray]:

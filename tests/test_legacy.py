@@ -168,13 +168,72 @@ def test_make_reader_end_to_end_on_legacy_store():
     assert len({x.id for x in samples}) == len(samples)
 
 
+_LEGACY_VERSIONS = (sorted(os.listdir(REFERENCE_LEGACY_DIR))
+                    if os.path.isdir(REFERENCE_LEGACY_DIR) else [])
+
+
+@pytest.mark.skipif(not _LEGACY_VERSIONS,
+                    reason="reference legacy stores not available")
+@pytest.mark.parametrize("ver", _LEGACY_VERSIONS or ["absent"])
+def test_make_reader_each_legacy_version_full_read(ver):
+    """Every checked-in petastorm store (auto-discovered, 0.4.0-0.7.6 today)
+    decodes fully — pickled schemas, legacy row-group index keys, codec
+    payloads (reference test_reading_legacy_datasets.py)."""
+    from petastorm_tpu.reader import make_reader
+    url = f"file://{REFERENCE_LEGACY_DIR}/{ver}"
+    with make_reader(url, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as r:
+        samples = list(r)
+    assert samples, ver
+    assert len({s.id for s in samples}) == len(samples), ver
+
+
 @pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
                     reason="reference legacy stores not available")
-def test_make_reader_all_legacy_versions_first_row():
+def test_legacy_store_through_thread_pool_with_predicate():
+    from petastorm_tpu.predicates import in_lambda
     from petastorm_tpu.reader import make_reader
-    for ver in sorted(os.listdir(REFERENCE_LEGACY_DIR)):
-        url = f"file://{REFERENCE_LEGACY_DIR}/{ver}"
-        with make_reader(url, shuffle_row_groups=False,
-                         reader_pool_type="dummy") as r:
-            s = next(iter(r))
-        assert s.id is not None, ver
+    url = f"file://{REFERENCE_LEGACY_DIR}/0.7.6"
+    with make_reader(url, schema_fields=["id"],
+                     predicate=in_lambda(["id"], lambda row: row["id"] % 2 == 0),
+                     shuffle_row_groups=False, reader_pool_type="thread",
+                     workers_count=2) as r:
+        ids = sorted(s.id for s in r)
+    assert ids and all(i % 2 == 0 for i in ids)
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
+                    reason="reference legacy stores not available")
+def test_legacy_store_batch_reader_scalars():
+    """make_batch_reader over a legacy petastorm store reads raw columns
+    (no codec decode) — the cross-tool escape hatch."""
+    from petastorm_tpu.reader import make_batch_reader
+    url = f"file://{REFERENCE_LEGACY_DIR}/0.7.6"
+    with make_batch_reader(url, schema_fields=["id"],
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        ids = sorted(int(i) for b in r for i in b.id)
+    assert len(ids) == len(set(ids)) and ids
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
+                    reason="reference legacy stores not available")
+def test_legacy_store_regenerated_metadata_roundtrip(tmp_path):
+    """Copy a legacy store, regenerate metadata with our CLI (JSON keys
+    replace the pickle), and read it back — the migration path."""
+    import shutil
+    from petastorm_tpu.etl.generate_metadata import main as gen_main
+    from petastorm_tpu.reader import make_reader
+    src = f"{REFERENCE_LEGACY_DIR}/0.7.6"
+    dst = tmp_path / "migrated"
+    shutil.copytree(src, dst)
+    assert gen_main([f"file://{dst}"]) == 0
+    from petastorm_tpu.etl.dataset_metadata import (TPU_UNISCHEMA_KEY,
+                                                    DatasetContext)
+    ctx = DatasetContext(f"file://{dst}")
+    assert TPU_UNISCHEMA_KEY in ctx.key_value_metadata()
+    with make_reader(f"file://{dst}", shuffle_row_groups=False,
+                     reader_pool_type="dummy") as r:
+        samples = list(r)
+    assert len(samples) >= 10
+    assert samples[0].image_png.shape == (32, 16, 3)
