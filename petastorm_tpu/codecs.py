@@ -87,10 +87,17 @@ def _check_shape_compliance(unischema_field, value: np.ndarray):
 
 def _check_dtype_compliance(unischema_field, value: np.ndarray):
     declared = np.dtype(unischema_field.numpy_dtype)
-    if value.dtype != declared:
-        raise SchemaError(
-            f"Field {unischema_field.name!r}: dtype mismatch, declared {declared} "
-            f"but value has dtype {value.dtype}")
+    if value.dtype == declared:
+        return
+    # Zero-width flexible dtypes (|S0 / <U0 — reference petastorm writes
+    # such schemas) declare "bytes/str of any width": compare by kind.
+    # Explicit widths (|S4) stay exact.
+    if (declared.kind in ("S", "U") and declared.itemsize == 0
+            and value.dtype.kind == declared.kind):
+        return
+    raise SchemaError(
+        f"Field {unischema_field.name!r}: dtype mismatch, declared {declared} "
+        f"but value has dtype {value.dtype}")
 
 
 class ScalarCodec(DataframeColumnCodec):

@@ -71,8 +71,22 @@ class _LegacyCompressedImageCodec(_codecs.CompressedImageCodec):
     ``cv2.imdecode`` sniffs the container format)."""
 
     def __setstate__(self, state):
-        ext = state.get("_image_codec") or state.get("image_codec") or ".png"
-        codec = "jpeg" if "jp" in str(ext) else "png"
+        ext = str(state.get("_image_codec") or state.get("image_codec")
+                  or ".png").lstrip(".").lower()
+        if ext in ("jpg", "jpeg"):
+            codec = "jpeg"
+        elif ext == "png":
+            codec = "png"
+        else:
+            # Exotic formats (jp2/bmp/tiff/webp...): decoding still works —
+            # cv2.imdecode sniffs the container — but re-encoding will use
+            # LOSSLESS png, never a silently lossy substitute. Say so.
+            import warnings
+            warnings.warn(
+                f"Legacy image codec {ext!r} is not supported for "
+                f"re-encoding; reads are unaffected, re-encodes will store "
+                f"lossless png instead")
+            codec = "png"
         _codecs.CompressedImageCodec.__init__(
             self, codec, int(state.get("_quality", state.get("quality", 80))))
 
@@ -217,10 +231,15 @@ def depickle_legacy_unischema(pickled: bytes) -> Unischema:
 
 
 def _plain_codec(codec):
+    """Normalize depickled shim codecs to canonical classes so legacy
+    schemas compare equal (type identity) to freshly-declared or migrated
+    ones — WeightedSamplingReader etc. rely on schema equality."""
     if codec is None:
         return None
     if isinstance(codec, _LegacyScalarCodec):
         return _codecs.ScalarCodec(codec.storage_dtype)
+    if isinstance(codec, _LegacyCompressedImageCodec):
+        return _codecs.CompressedImageCodec(codec.image_codec, codec.quality)
     return codec
 
 

@@ -82,8 +82,9 @@ class LoaderBase:
         choice — including the exact row shape and dtype — is locked in by
         the FIRST group carrying the column and enforced for the whole
         stream, so a column's representation can never flip between row
-        groups mid-training: any later deviation (ragged, null rows,
-        different length or dtype) raises a ValueError naming the column.
+        groups mid-training: null rows of a float-locked column nan-fill in
+        place; any other deviation (ragged, different length or dtype, or
+        nulls in a non-float column) raises a ValueError naming the column.
         First-group-wins means a column that is only *sometimes* densifiable
         either drops or raises depending on (shuffled) arrival order, and an
         entirely-null FIRST group locks a convertible column to "drop"

@@ -218,6 +218,32 @@ def test_legacy_store_batch_reader_scalars():
 
 @pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
                     reason="reference legacy stores not available")
+def test_copy_dataset_migrates_legacy_store(tmp_path):
+    """petastorm-tpu-copy-dataset re-materializes a legacy petastorm store
+    into this package's JSON-metadata format — the full-copy migration
+    path (vs regenerate-metadata, which rewrites metadata in place)."""
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+    src = f"file://{REFERENCE_LEGACY_DIR}/0.7.6"
+    dst = f"file://{tmp_path}/migrated"
+    copied = copy_dataset(src, dst, rows_per_row_group=5, workers_count=1)
+    assert copied >= 10
+    from petastorm_tpu.etl.dataset_metadata import (TPU_UNISCHEMA_KEY,
+                                                    DatasetContext)
+    assert TPU_UNISCHEMA_KEY in DatasetContext(dst).key_value_metadata()
+    with make_reader(src, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as r:
+        original = {s.id: s for s in r}
+    with make_reader(dst, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as r:
+        for s in r:
+            np.testing.assert_array_equal(s.image_png,
+                                          original[s.id].image_png)
+            np.testing.assert_array_equal(s.matrix, original[s.id].matrix)
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_LEGACY_DIR),
+                    reason="reference legacy stores not available")
 def test_legacy_store_regenerated_metadata_roundtrip(tmp_path):
     """Copy a legacy store, regenerate metadata with our CLI (JSON keys
     replace the pickle), and read it back — the migration path."""
