@@ -1,0 +1,19 @@
+"""Importable child-process functions for exec_in_new_process tests (the
+spawned interpreter cannot import the tests/ directory)."""
+import os
+
+
+def write_marker(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def report_canary(path):
+    import petastorm_tpu
+    with open(path, "w") as f:
+        f.write(getattr(petastorm_tpu, "_spawn_test_canary", "absent"))
+
+
+def report_jax_platform_env(path):
+    with open(path, "w") as f:
+        f.write(os.environ.get("JAX_PLATFORMS", "unset"))
