@@ -1,0 +1,75 @@
+"""Final edge-case sweep: ngram construction rules, schema renders, reader
+argument validation, ventilator corners."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TS_SCHEMA = Unischema("S", [UnischemaField("ts", np.int64, (), None, False),
+                            UnischemaField("v", np.int32, (), None, False)])
+
+
+def test_ngram_offsets_must_be_consecutive():
+    with pytest.raises(ValueError, match="consecutive"):
+        NGram({0: ["ts"], 2: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+
+
+def test_ngram_single_offset_degenerates_to_rows():
+    ng = NGram({0: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    assert ng.length == 1
+    windows = ng.form_ngram([{"ts": i} for i in range(4)], TS_SCHEMA)
+    assert [w[0].ts for w in windows] == [0, 1, 2, 3]
+
+
+def test_ngram_empty_data_yields_nothing():
+    ng = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    assert ng.form_ngram([], TS_SCHEMA) == []
+
+
+def test_ngram_window_longer_than_data_yields_nothing():
+    ng = NGram({i: ["ts"] for i in range(5)}, delta_threshold=1,
+               timestamp_field="ts")
+    assert ng.form_ngram([{"ts": 0}, {"ts": 1}], TS_SCHEMA) == []
+
+
+def test_shape_dtype_structs_render():
+    structs = TS_SCHEMA.as_shape_dtype_structs(batch_size=8)
+    assert structs["ts"].shape == (8,) and str(structs["ts"].dtype) == "int64"
+    unbatched = TS_SCHEMA.as_shape_dtype_structs()
+    assert unbatched["v"].shape == ()
+
+
+def test_make_reader_missing_store_raises_metadata_error():
+    from petastorm_tpu.errors import MetadataError
+    with pytest.raises(MetadataError, match="missing petastorm metadata"):
+        make_reader("file:///definitely_not_a_dataset_xyz")
+
+
+def test_shard_count_required_with_cur_shard(synthetic_dataset):
+    with pytest.raises(ValueError, match="shard_count"):
+        make_reader(synthetic_dataset.url, cur_shard=1, shard_count=None,
+                    reader_pool_type="dummy")
+
+
+def test_ventilator_empty_items_completes():
+    import time
+    from petastorm_tpu.workers_pool.ventilator import ConcurrentVentilator
+    v = ConcurrentVentilator(lambda **kw: None, [])
+    v.start()
+    deadline = time.time() + 5
+    while not v.completed() and time.time() < deadline:
+        time.sleep(0.01)
+    assert v.completed()
+    v.stop()
+
+
+def test_schema_view_unknown_field_raises():
+    with pytest.raises(ValueError):
+        TS_SCHEMA.create_schema_view(["nope"])
+
+
+def test_unischema_repr_lists_fields():
+    text = repr(TS_SCHEMA) if "ts" in repr(TS_SCHEMA) else str(TS_SCHEMA)
+    assert "ts" in text and "v" in text
