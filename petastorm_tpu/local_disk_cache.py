@@ -56,14 +56,12 @@ class LocalDiskCache(CacheBase):
         self._path = path
         self._cleanup_on_exit = cleanup
         self._size_limit = size_limit_bytes
-        os.makedirs(path, exist_ok=True)
         self._db_path = os.path.join(path, "cache.sqlite3")
         self._local = threading.local()
         self._all_conns = []
         self._conns_lock = threading.Lock()
         self._generation = 0
-        with self._conn() as conn:
-            conn.executescript(_SCHEMA)
+        self._conn()
 
     def _conn(self) -> sqlite3.Connection:
         # A cleanup() bumps the generation; threads holding a connection from
@@ -73,12 +71,18 @@ class LocalDiskCache(CacheBase):
             self._local.generation = self._generation
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self._db_path, timeout=60.0,
-                                   check_same_thread=False)
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = conn
+            # Connection creation holds the same lock as cleanup(), so a
+            # concurrent rmtree can never interleave with makedirs/connect;
+            # a cleanup() that removed the directory is recreated here (with
+            # the schema) and the cache stays usable.
             with self._conns_lock:
+                os.makedirs(self._path, exist_ok=True)
+                conn = sqlite3.connect(self._db_path, timeout=60.0,
+                                       check_same_thread=False)
+                conn.execute("PRAGMA journal_mode=WAL")
+                conn.execute("PRAGMA synchronous=NORMAL")
+                conn.executescript(_SCHEMA)
+                self._local.conn = conn
                 self._all_conns.append(conn)
         return conn
 
@@ -125,7 +129,7 @@ class LocalDiskCache(CacheBase):
                     pass
             self._all_conns.clear()
             self._generation += 1
+            if self._cleanup_on_exit:
+                import shutil
+                shutil.rmtree(self._path, ignore_errors=True)
         self._local.conn = None
-        if self._cleanup_on_exit:
-            import shutil
-            shutil.rmtree(self._path, ignore_errors=True)

@@ -1,0 +1,176 @@
+"""NGram depth tests: pool flavors, shuffling, length-1 windows, epochs,
+drop partitions, mixing (strategy parity: reference
+tests/test_ngram_end_to_end.py:203-604, test_weighted_sampling_reader.py:125)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+SeqSchema = Unischema("SeqSchema", [
+    UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField("value", np.float32, (2,), NdarrayCodec(), False),
+    UnischemaField("label", np.int32, (), ScalarCodec(np.int32), False),
+])
+
+
+def _write_seq(url, timestamps, rows_per_row_group=None):
+    rng = np.random.default_rng(0)
+    rows = [{"ts": int(t), "value": rng.normal(size=2).astype(np.float32),
+             "label": np.int32(i)} for i, t in enumerate(timestamps)]
+    rpg = rows_per_row_group or len(rows)
+    with materialize_dataset_local(url, SeqSchema, rows_per_row_group=rpg) as w:
+        w.write_rows(rows)
+
+
+@pytest.fixture(scope="module")
+def dense_seq(tmp_path_factory):
+    """30 consecutive timestamps across 3 row groups of 10."""
+    url = f"file://{tmp_path_factory.mktemp('dense')}/ds"
+    _write_seq(url, range(30), rows_per_row_group=10)
+    return url
+
+
+@pytest.fixture(scope="module")
+def sparse_seq(tmp_path_factory):
+    """Timestamps spaced 5 apart: 0, 5, 10, ..., 45 (one row group)."""
+    url = f"file://{tmp_path_factory.mktemp('sparse')}/ds"
+    _write_seq(url, range(0, 50, 5))
+    return url
+
+
+@pytest.mark.parametrize("pool", ["dummy", "thread"])
+def test_window_counts_across_pools(dense_seq, pool):
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type=pool, workers_count=2) as reader:
+        windows = list(reader)
+    # 3 groups x (10 rows -> 9 adjacent pairs); windows never cross groups.
+    assert len(windows) == 27
+    starts = sorted(w[0].ts for w in windows)
+    assert starts == [t for t in range(30) if t % 10 != 9]
+
+
+def test_window_count_process_pool(dense_seq):
+    ngram = NGram({0: ["ts", "value"], 1: ["ts", "label"]},
+                  delta_threshold=1, timestamp_field="ts")
+    with make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="process", workers_count=2) as reader:
+        windows = list(reader)
+    assert len(windows) == 27
+    assert all(w[1].ts - w[0].ts == 1 for w in windows)
+
+
+def test_length_one_ngram_equals_plain_rows(dense_seq):
+    """A 1-gram is just a per-row readout (reference
+    test_ngram_end_to_end.py:492)."""
+    ngram = NGram({0: ["ts", "label"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    assert len(windows) == 30
+    assert sorted(w[0].ts for w in windows) == list(range(30))
+
+
+def test_shuffled_row_groups_preserve_window_set(dense_seq):
+    """Shuffling row groups changes order, never window membership
+    (reference test_ngram_end_to_end.py:264)."""
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+
+    def starts(shuffle, seed=None):
+        with make_reader(dense_seq, schema_fields=ngram,
+                         shuffle_row_groups=shuffle, seed=seed,
+                         reader_pool_type="dummy") as reader:
+            return [w[0].ts for w in reader]
+
+    plain = starts(False)
+    shuffled = starts(True, seed=7)
+    assert sorted(plain) == sorted(shuffled)
+
+
+def test_sparse_timestamps_thresholds(sparse_seq):
+    """delta_threshold below the spacing yields zero windows; at the spacing
+    it yields all of them (reference test_ngram_end_to_end.py:425)."""
+    tight = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(sparse_seq, schema_fields=tight, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        assert list(reader) == []
+
+    wide = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=5, timestamp_field="ts")
+    with make_reader(sparse_seq, schema_fields=wide, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    assert len(windows) == 9
+    assert all(w[1].ts - w[0].ts == 5 for w in windows)
+
+
+def test_ngram_multiple_epochs(dense_seq):
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=2) as reader:
+        windows = list(reader)
+    assert len(windows) == 54
+
+
+def test_ngram_shuffle_drop_reduces_windows(dense_seq):
+    """shuffle_row_drop_partitions splits each group, so strictly fewer
+    in-group adjacencies survive (reference test_ngram_end_to_end.py:528)."""
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     shuffle_row_drop_partitions=2,
+                     reader_pool_type="dummy") as reader:
+        dropped = len(list(reader))
+    assert 0 < dropped < 27
+
+
+def test_ngram_schema_views_per_timestep(dense_seq):
+    ngram = NGram({0: ["ts", "value"], 1: ["ts"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    ngram.resolve_regex_field_names(SeqSchema)
+    view0 = ngram.get_schema_at_timestep(SeqSchema, 0)
+    view1 = ngram.get_schema_at_timestep(SeqSchema, 1)
+    assert set(view0.fields) == {"ts", "value"}
+    assert set(view1.fields) == {"ts"}
+
+
+def test_ngram_mix_through_weighted_sampling(dense_seq):
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    r1 = make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=None)
+    r2 = make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=None)
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=3) as mixed:
+        for _ in range(10):
+            w = next(mixed)
+            assert w[1].ts - w[0].ts == 1
+
+
+def test_ngram_and_plain_reader_mix_rejected(dense_seq):
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    r1 = make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy")
+    r2 = make_reader(dense_seq, schema_fields=["ts"], shuffle_row_groups=False,
+                     reader_pool_type="dummy")
+    try:
+        with pytest.raises(ValueError):
+            WeightedSamplingReader([r1, r2], [0.5, 0.5])
+    finally:
+        for r in (r1, r2):
+            r.stop()
+            r.join()
+
+
+def test_ngram_fields_dict_key_order_irrelevant(dense_seq):
+    """Offsets given out of order resolve to the same windows."""
+    ngram = NGram({1: ["label"], 0: ["ts", "value"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    with make_reader(dense_seq, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        w = next(reader)
+    assert set(w.keys()) == {0, 1}
+    assert set(w[0]._fields) == {"ts", "value"}
+    assert set(w[1]._fields) == {"label"}

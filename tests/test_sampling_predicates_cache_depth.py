@@ -1,0 +1,202 @@
+"""Depth tests for WeightedSamplingReader, predicates, and the disk cache
+(strategy parity: reference tests/test_weighted_sampling_reader.py,
+test_predicates.py, test_disk_cache.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.local_disk_cache import LocalDiskCache
+from petastorm_tpu.predicates import (in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+MockSchema = Unischema("MockSchema", [
+    UnischemaField("tag", np.int32, (), None, False),
+])
+
+
+def _mock(tag, num_rows=None):
+    return ReaderMock(MockSchema, data_generator=lambda s: {"tag": np.int32(tag)},
+                      num_rows=num_rows)
+
+
+# ---------------------------------------------------------------- sampling --
+
+def test_degenerate_probability_selects_single_reader():
+    with WeightedSamplingReader([_mock(1), _mock(2)], [1.0, 0.0], seed=0) as mx:
+        assert all(next(mx).tag == 1 for _ in range(50))
+
+
+def test_unnormalized_probabilities_accepted():
+    with WeightedSamplingReader([_mock(1), _mock(2)], [30, 10], seed=0) as mx:
+        tags = [int(next(mx).tag) for _ in range(400)]
+    frac = tags.count(1) / len(tags)
+    assert 0.6 < frac < 0.9  # expected 0.75
+
+
+def test_mixing_ratio_tracks_probabilities():
+    with WeightedSamplingReader([_mock(1), _mock(2), _mock(3)],
+                                [0.2, 0.3, 0.5], seed=11) as mx:
+        tags = [int(next(mx).tag) for _ in range(1000)]
+    for tag, p in ((1, 0.2), (2, 0.3), (3, 0.5)):
+        assert abs(tags.count(tag) / 1000 - p) < 0.08
+
+
+def test_bad_arguments_rejected():
+    with pytest.raises(ValueError):
+        WeightedSamplingReader([], [])
+    with pytest.raises(ValueError):
+        WeightedSamplingReader([_mock(1)], [0.5, 0.5])
+    with pytest.raises(ValueError):
+        WeightedSamplingReader([_mock(1), _mock(2)], [0.0, 0.0])
+
+
+def test_mixed_stream_exhaustion_and_reset():
+    r1, r2 = _mock(1, num_rows=5), _mock(2, num_rows=5)
+    mx = WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0)
+    seen = 0
+    with pytest.raises(StopIteration):
+        while True:
+            next(mx)
+            seen += 1
+    assert seen >= 5  # at least one member drained fully
+    assert mx.last_row_consumed
+    mx.reset()
+    assert not mx.last_row_consumed
+    assert int(next(mx).tag) in (1, 2)
+
+
+def test_mixed_reader_through_jax_loader(synthetic_dataset):
+    """A mixed stream feeds the DataLoader like any reader (reference
+    test_weighted_sampling_reader.py:203 does the same through torch)."""
+    from petastorm_tpu.jax.loader import DataLoader
+    r1 = make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy")
+    r2 = make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy")
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0) as mixed:
+        loader = DataLoader(mixed, batch_size=8)
+        batch = next(iter(loader))
+    assert batch["id"].shape == (8,)
+
+
+# -------------------------------------------------------------- predicates --
+
+def test_predicate_on_string_column(synthetic_dataset):
+    pred = in_set({"p_1"}, "partition_key")
+    with make_reader(synthetic_dataset.url, predicate=pred,
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = list(r)
+    assert rows and all(row.partition_key == "p_1" for row in rows)
+    assert {row.id % 4 for row in rows} == {1}
+
+
+def test_pseudorandom_split_on_integer_field():
+    """Integer-valued fields hash-bucket just like strings (reference
+    test_predicates.py:123)."""
+    values = list(range(1000))
+    split = in_pseudorandom_split([0.3, 0.7], 0, "num")
+    included = [v for v in values if split.do_include({"num": v})]
+    assert 0.2 < len(included) / 1000 < 0.4
+    # Deterministic: same values always land in the same subset.
+    again = [v for v in values if split.do_include({"num": v})]
+    assert included == again
+
+
+def test_pseudorandom_split_subsets_partition_values():
+    values = [f"k{i}" for i in range(500)]
+    splits = [in_pseudorandom_split([0.5, 0.5], i, "k") for i in range(2)]
+    s0 = {v for v in values if splits[0].do_include({"k": v})}
+    s1 = {v for v in values if splits[1].do_include({"k": v})}
+    assert s0 | s1 == set(values)
+    assert not (s0 & s1)
+
+
+def test_nested_predicate_composition(synthetic_dataset):
+    """in_reduce(any) over in_set + negated lambda, end to end."""
+    pred = in_reduce([in_set({0, 1}, "id2"),
+                      in_negate(in_lambda(["id"], lambda v: v["id"] < 95))], any)
+    with make_reader(synthetic_dataset.url, predicate=pred,
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        ids = sorted(row.id for row in r)
+    expected = sorted({i for i in range(100) if i % 10 in (0, 1) or i >= 95})
+    assert ids == expected
+
+
+def test_predicate_unknown_field_raises(synthetic_dataset):
+    pred = in_set({1}, "no_such_field")
+    with pytest.raises(Exception):
+        with make_reader(synthetic_dataset.url, predicate=pred,
+                         reader_pool_type="dummy") as r:
+            list(r)
+
+
+def test_batch_reader_predicate_on_scalar_store(scalar_dataset):
+    from petastorm_tpu.reader import make_batch_reader
+    pred = in_lambda(["id"], lambda v: v["id"] % 2 == 0)
+    with make_batch_reader(scalar_dataset.url, predicate=pred,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        ids = [i for batch in r for i in batch.id.tolist()]
+    assert ids and all(i % 2 == 0 for i in ids)
+
+
+# -------------------------------------------------------------- disk cache --
+
+def test_cache_stores_arbitrary_values(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "c"), 10 * 2 ** 20)
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = cache.get("k", lambda: {"a": arr, "b": [1, "x"]})
+    np.testing.assert_array_equal(out["a"], arr)
+    # Hit path returns the stored copy, never calls fill.
+    out2 = cache.get("k", lambda: pytest.fail("fill called on hit"))
+    np.testing.assert_array_equal(out2["a"], arr)
+
+
+def test_cache_capacity_check_respects_expected_row_size(tmp_path):
+    with pytest.raises(ValueError):
+        LocalDiskCache(str(tmp_path / "c"), size_limit_bytes=1000,
+                       expected_row_size_bytes=100)
+    # No expected size -> no check.
+    LocalDiskCache(str(tmp_path / "c2"), size_limit_bytes=1000)
+
+
+def test_cache_eviction_keeps_total_under_limit(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "c"), size_limit_bytes=50_000)
+    blob = np.zeros(2000, dtype=np.uint8)
+    for i in range(100):
+        cache.get(f"k{i}", lambda: blob)
+    alive = sum(1 for i in range(100)
+                if cache.get(f"k{i}", lambda: "MISS") is not blob
+                and not isinstance(cache.get(f"k{i}", lambda: "MISS2"), str))
+    assert alive * 2000 <= 50_000 + 2000
+
+
+def test_cleanup_idempotent(tmp_path):
+    path = str(tmp_path / "c")
+    cache = LocalDiskCache(path, 10 * 2 ** 20, cleanup=True)
+    cache.get("k", lambda: 1)
+    cache.cleanup()
+    cache.cleanup()  # second call is a no-op, not an error
+    import os
+    assert not os.path.exists(path)
+
+
+def test_cleanup_false_keeps_directory(tmp_path):
+    path = str(tmp_path / "c")
+    cache = LocalDiskCache(path, 10 * 2 ** 20, cleanup=False)
+    cache.get("k", lambda: 1)
+    cache.cleanup()
+    import os
+    assert os.path.exists(path)
+
+
+def test_cache_usable_after_cleanup(tmp_path):
+    """A generation bump after cleanup() reconnects transparently."""
+    path = str(tmp_path / "c")
+    cache = LocalDiskCache(path, 10 * 2 ** 20, cleanup=True)
+    cache.get("k", lambda: "v1")
+    cache.cleanup()
+    assert cache.get("k", lambda: "v2") == "v2"
