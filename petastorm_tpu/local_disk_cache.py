@@ -68,12 +68,13 @@ class LocalDiskCache(CacheBase):
         # an older generation (closed under them) transparently reconnect.
         if getattr(self._local, "generation", -1) != self._generation:
             self._local.conn = None
-            self._local.generation = self._generation
         conn = getattr(self._local, "conn", None)
         if conn is None:
             # Connection creation holds the same lock as cleanup(), so a
-            # concurrent rmtree can never interleave with makedirs/connect;
-            # a cleanup() that removed the directory is recreated here (with
+            # concurrent rmtree can never interleave with makedirs/connect —
+            # and the generation stamp is taken under the lock so a cleanup()
+            # racing this call can't leave the fresh connection tagged stale.
+            # A cleanup() that removed the directory is recreated here (with
             # the schema) and the cache stays usable.
             with self._conns_lock:
                 os.makedirs(self._path, exist_ok=True)
@@ -83,6 +84,7 @@ class LocalDiskCache(CacheBase):
                 conn.execute("PRAGMA synchronous=NORMAL")
                 conn.executescript(_SCHEMA)
                 self._local.conn = conn
+                self._local.generation = self._generation
                 self._all_conns.append(conn)
         return conn
 

@@ -37,6 +37,18 @@ def _sanitize_value(value):
     return value
 
 
+def _densify(value):
+    """Stack an object array of uniform per-row vectors into one matrix
+    (parity: reference arrow_reader_worker.py:72-75 vstacks list columns).
+    Ragged columns stay as-is and surface TF's conversion error."""
+    if isinstance(value, np.ndarray) and value.dtype == object and value.size:
+        try:
+            return np.stack([np.asarray(v) for v in value])
+        except (ValueError, TypeError):
+            return value
+    return value
+
+
 def _tf_dtype_for(numpy_dtype):
     tf = _tf()
     if numpy_dtype in (str, np.str_, bytes, np.bytes_):
@@ -134,7 +146,12 @@ def make_petastorm_dataset(reader):
         for sample in reader:
             out = {}
             for name in names:
-                v = _sanitize_value(getattr(sample, name))
+                v = getattr(sample, name)
+                if reader.batched_output:
+                    v = _densify(v)
+                # Sanitize AFTER densify: a stacked datetime64/Decimal matrix
+                # still needs its int64/string conversion.
+                v = _sanitize_value(v)
                 out[name] = _promote(v, schema.fields[name].numpy_dtype)
             yield out
 
@@ -165,15 +182,27 @@ def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int
 
         def dequeue():
             sample = next(reader)
-            return [np.asarray(_promote(_sanitize_value(getattr(sample, n)),
+            values = ((_densify(getattr(sample, n)) for n in names)
+                      if reader.batched_output else
+                      (getattr(sample, n) for n in names))
+            return [np.asarray(_promote(_sanitize_value(v),
                                         schema.fields[n].numpy_dtype))
-                    for n in names]
+                    for n, v in zip(names, values)]
+
+    def _static_shape(f):
+        """Per-sample shape; batch readers prepend an unknown batch dim."""
+        if any(d is None for d in f.shape):
+            return None
+        if reader.batched_output:
+            return [None] + list(f.shape)
+        return list(f.shape)
 
     dtypes = [_tf_dtype_for(f.numpy_dtype) for _, _, f in flat]
     tensors = tf.compat.v1.py_func(dequeue, [], dtypes)
     for t, (_, _, f) in zip(tensors, flat):
-        if all(d is not None for d in f.shape):
-            t.set_shape(f.shape)
+        shape = _static_shape(f)
+        if shape is not None:
+            t.set_shape(shape)
     if shuffling_queue_capacity > 0:
         queue = tf.queue.RandomShuffleQueue(
             shuffling_queue_capacity, min_after_dequeue,
@@ -185,8 +214,9 @@ def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int
         if not isinstance(tensors, (list, tuple)):
             tensors = [tensors]  # single-component dequeue returns a bare Tensor
         for t, (_, _, f) in zip(tensors, flat):
-            if all(d is not None for d in f.shape):
-                t.set_shape(f.shape)
+            shape = _static_shape(f)
+            if shape is not None:
+                t.set_shape(shape)
     if getattr(reader, "ngram", None) is not None:
         by_offset = {}
         for t, (off, name, _) in zip(tensors, flat):

@@ -168,10 +168,11 @@ def test_cache_eviction_keeps_total_under_limit(tmp_path):
     blob = np.zeros(2000, dtype=np.uint8)
     for i in range(100):
         cache.get(f"k{i}", lambda: blob)
-    alive = sum(1 for i in range(100)
-                if cache.get(f"k{i}", lambda: "MISS") is not blob
-                and not isinstance(cache.get(f"k{i}", lambda: "MISS2"), str))
-    assert alive * 2000 <= 50_000 + 2000
+    assert cache.size_bytes() <= 50_000
+    assert 0 < len(cache) < 100  # evicted some, kept some
+    # The most recently stored key survived eviction (LRS policy).
+    hit = cache.get("k99", lambda: pytest.fail("newest key was evicted"))
+    np.testing.assert_array_equal(hit, blob)
 
 
 def test_cleanup_idempotent(tmp_path):

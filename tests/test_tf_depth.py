@@ -1,0 +1,144 @@
+"""TF adapter depth tests: sanitization, shuffling queue, batch-reader
+datasets, graph-mode tensors, autograph tracing (strategy parity: reference
+tests/test_tf_utils.py, test_tf_dataset.py, test_tf_autograph.py)."""
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.tf_utils import (_sanitize_value, _tf_dtype_for,
+                                    make_petastorm_dataset, tf_tensors)
+
+
+def test_sanitize_decimal_scalar_and_array():
+    assert _sanitize_value(Decimal("1.25")) == "1.25"
+    arr = np.array([Decimal("0.5"), Decimal("2")], dtype=object)
+    out = _sanitize_value(arr)
+    assert out.tolist() == ["0.5", "2"]
+
+
+def test_sanitize_datetime64_to_ns_int64():
+    v = np.datetime64("2024-01-02T03:04:05")
+    out = _sanitize_value(v)
+    assert out.dtype == np.int64 if isinstance(out, np.ndarray) else isinstance(out, np.int64)
+    arr = np.array(["2024-01-01", "2024-01-02"], dtype="datetime64[D]")
+    out = _sanitize_value(arr)
+    assert out.dtype == np.int64
+    assert out[1] - out[0] == 24 * 3600 * 10 ** 9
+
+
+def test_tf_dtype_mapping():
+    assert _tf_dtype_for(str) == tf.string
+    assert _tf_dtype_for(Decimal) == tf.string
+    assert _tf_dtype_for(np.uint16) == tf.int32
+    assert _tf_dtype_for(np.uint32) == tf.int64
+    assert _tf_dtype_for(np.dtype("datetime64[ns]")) == tf.int64
+    assert _tf_dtype_for(np.float32) == tf.float32
+    assert _tf_dtype_for(np.uint8) == tf.uint8
+
+
+def test_dataset_full_schema_types(synthetic_dataset):
+    """Every field of the rich schema (images, decimals, nullables dropped
+    upstream) arrives with its declared dtype and shape."""
+    with make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                     schema_fields=["id", "image_png", "matrix_uint16",
+                                    "decimal_col", "partition_key"],
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader)
+        sample = next(iter(ds))
+    assert sample["id"].dtype == tf.int64
+    assert sample["image_png"].dtype == tf.uint8
+    assert sample["image_png"].shape == (32, 16, 3)
+    assert sample["matrix_uint16"].dtype == tf.int32
+    assert sample["decimal_col"].dtype == tf.string
+    assert sample["partition_key"].dtype == tf.string
+
+
+def test_dataset_over_batch_reader_unbatch_rebatch(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader).unbatch().batch(25, drop_remainder=True)
+        ids = [int(i) for b in ds for i in b["id"].numpy()]
+    assert sorted(ids) == list(range(100))
+
+
+def test_dataset_reinitializes_after_exhaustion(synthetic_dataset):
+    """A second epoch over the same tf.data pipeline resets the reader
+    (the generator checks last_row_consumed)."""
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader)
+        first = [int(s["id"].numpy()) for s in ds]
+        second = [int(s["id"].numpy()) for s in ds]
+    assert sorted(first) == list(range(100))
+    assert sorted(second) == list(range(100))
+
+
+def test_tf_tensors_shuffling_queue(synthetic_dataset):
+    """The RandomShuffleQueue path decorrelates row order (reference
+    tf_utils.py:201-219)."""
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=None) as reader:
+        graph = tf.Graph()
+        with graph.as_default():
+            sample = tf_tensors(reader, shuffling_queue_capacity=40,
+                                min_after_dequeue=20)
+            with tf.compat.v1.Session(graph=graph) as sess:
+                coord = tf.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(sess=sess,
+                                                                 coord=coord)
+                ids = [int(sess.run(sample.id)) for _ in range(60)]
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5)
+    assert ids != sorted(ids)
+    assert len(set(ids)) > 30
+
+
+def test_tf_tensors_static_shape_known(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["matrix"],
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=1) as reader:
+        graph = tf.Graph()
+        with graph.as_default():
+            sample = tf_tensors(reader)
+            assert sample.matrix.shape.as_list() == [32, 16, 3]
+            with tf.compat.v1.Session(graph=graph) as sess:
+                value = sess.run(sample.matrix)
+    assert value.shape == (32, 16, 3)
+
+
+def test_autograph_traces_over_dataset(scalar_dataset):
+    """A tf.function consuming the dataset traces without falling back to
+    eager (reference test_tf_autograph.py)."""
+    with make_batch_reader(scalar_dataset.url, shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader).unbatch().batch(10)
+
+        @tf.function
+        def total_ids(dataset):
+            acc = tf.constant(0, tf.int64)
+            for batch in dataset:
+                acc += tf.reduce_sum(batch["id"])
+            return acc
+
+        total = int(total_ids(ds).numpy())
+    assert total == sum(range(100))
+
+
+def test_dataset_map_pipeline_with_image_augmentation(synthetic_dataset):
+    """tf.data transformations compose over the generator dataset."""
+    with make_reader(synthetic_dataset.url, schema_fields=["image_png"],
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=1) as reader:
+        ds = (make_petastorm_dataset(reader)
+              .map(lambda s: tf.cast(s["image_png"], tf.float32) / 255.0)
+              .batch(8, drop_remainder=True))
+        batch = next(iter(ds))
+    assert batch.shape == (8, 32, 16, 3)
+    assert batch.dtype == tf.float32
+    assert float(tf.reduce_max(batch)) <= 1.0
