@@ -358,8 +358,10 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
     serializer = serializer_cls()
 
     ring = None
+    _RING_CLOSED_ERRORS: tuple = ()
     if ring_name is not None:
-        from petastorm_tpu.native import ShmRing
+        from petastorm_tpu.native import RingClosed, ShmRing
+        _RING_CLOSED_ERRORS = (RingClosed,)
         ring = ShmRing(ring_name, create=False)
         max_frame = max(4096, int(ring._lib.pt_ring_capacity(ring._handle)) // 2 - 4096)
 
@@ -413,6 +415,10 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
                     worker.process(*args, **kwargs)
                     send_ctrl(VentilatedItemProcessedMessage(
                         kwargs.get(ITEM_CONTEXT_KWARG)))
+                except _RING_CLOSED_ERRORS:
+                    # The consumer stopped and closed our ring mid-publish
+                    # (early reader shutdown): a clean exit, not a failure.
+                    break
                 except Exception as e:  # noqa: BLE001 - ship to parent
                     sys.stderr.write(f"Worker {worker_id} exception:\n{format_exc()}\n")
                     try:
