@@ -1,0 +1,223 @@
+"""HDFS depth tests: config discovery permutations, resolver edges,
+per-error failover classification, multi-namenode exhaustion accounting
+(strategy parity: reference hdfs/tests/test_hdfs_namenode.py:42-444)."""
+import os
+
+import pytest
+
+from petastorm_tpu.hdfs.namenode import (HAHdfsClient, HadoopConfiguration,
+                                         HdfsConnectError, HdfsConnector,
+                                         HdfsNamenodeResolver,
+                                         MAX_NAMENODE_FAILOVER_ATTEMPTS,
+                                         _read_hadoop_xml)
+
+CONFIG = {
+    "fs.defaultFS": "hdfs://ns1",
+    "dfs.nameservices": "ns1,ns2",
+    "dfs.ha.namenodes.ns1": "nn1,nn2",
+    "dfs.namenode.rpc-address.ns1.nn1": "a:8020",
+    "dfs.namenode.rpc-address.ns1.nn2": "b:8020",
+    "dfs.ha.namenodes.ns2": "nnA",
+    "dfs.namenode.rpc-address.ns2.nnA": "c:9000",
+}
+
+
+# -------------------------------------------------------------- resolver ---
+
+def test_multiple_nameservices_resolve_independently():
+    r = HdfsNamenodeResolver(CONFIG)
+    assert r.resolve_hdfs_name_service("ns1") == ["a:8020", "b:8020"]
+    assert r.resolve_hdfs_name_service("ns2") == ["c:9000"]
+
+
+def test_nameservice_list_with_whitespace():
+    cfg = dict(CONFIG, **{"dfs.nameservices": " ns1 , ns2 "})
+    r = HdfsNamenodeResolver(cfg)
+    assert r.resolve_hdfs_name_service("ns1") == ["a:8020", "b:8020"]
+
+
+def test_declared_nameservice_without_namenodes_raises():
+    cfg = dict(CONFIG)
+    cfg["dfs.ha.namenodes.ns1"] = ""
+    with pytest.raises(HdfsConnectError, match="missing/empty"):
+        HdfsNamenodeResolver(cfg).resolve_hdfs_name_service("ns1")
+
+
+def test_default_fs_with_trailing_slash():
+    cfg = dict(CONFIG, **{"fs.defaultFS": "hdfs://ns1/"})
+    svc, nns = HdfsNamenodeResolver(cfg).resolve_default_hdfs_service()
+    assert svc == "ns1" and nns == ["a:8020", "b:8020"]
+
+
+def test_default_fs_direct_hostport():
+    cfg = {"fs.defaultFS": "hdfs://myhost:9000"}
+    svc, nns = HdfsNamenodeResolver(cfg).resolve_default_hdfs_service()
+    assert svc == "myhost:9000" and nns == ["myhost:9000"]
+
+
+def test_empty_config_resolves_nothing():
+    r = HdfsNamenodeResolver({})
+    assert r.resolve_hdfs_name_service("anything") is None
+    with pytest.raises(HdfsConnectError):
+        r.resolve_default_hdfs_service()
+
+
+def test_hadoop_xml_parser_ignores_nameless_properties(tmp_path):
+    xml = tmp_path / "core-site.xml"
+    xml.write_text("""<configuration>
+        <property><name>k1</name><value>v1</value></property>
+        <property><value>orphan</value></property>
+        <property><name>empty</name><value></value></property>
+    </configuration>""")
+    props = _read_hadoop_xml(str(xml))
+    assert props["k1"] == "v1"
+    assert props["empty"] == ""
+    assert len(props) == 2
+
+
+def test_discovery_prefers_hadoop_conf_dir(tmp_path, monkeypatch):
+    """HADOOP_CONF_DIR wins over HADOOP_HOME/etc/hadoop (reference
+    namenode.py:45 env-var family)."""
+    conf_dir = tmp_path / "conf"
+    conf_dir.mkdir()
+    (conf_dir / "core-site.xml").write_text(
+        "<configuration><property><name>fs.defaultFS</name>"
+        "<value>hdfs://fromconfdir:8020</value></property></configuration>")
+    home = tmp_path / "home" / "etc" / "hadoop"
+    home.mkdir(parents=True)
+    (home / "core-site.xml").write_text(
+        "<configuration><property><name>fs.defaultFS</name>"
+        "<value>hdfs://fromhome:8020</value></property></configuration>")
+    monkeypatch.setenv("HADOOP_CONF_DIR", str(conf_dir))
+    monkeypatch.setenv("HADOOP_HOME", str(tmp_path / "home"))
+    r = HdfsNamenodeResolver()
+    assert r.resolve_default_hdfs_service()[0] == "fromconfdir:8020"
+
+
+def test_discovery_merges_core_and_hdfs_site(tmp_path, monkeypatch):
+    conf_dir = tmp_path / "conf"
+    conf_dir.mkdir()
+    (conf_dir / "core-site.xml").write_text(
+        "<configuration><property><name>fs.defaultFS</name>"
+        "<value>hdfs://ns1</value></property></configuration>")
+    (conf_dir / "hdfs-site.xml").write_text(
+        "<configuration>"
+        "<property><name>dfs.nameservices</name><value>ns1</value></property>"
+        "<property><name>dfs.ha.namenodes.ns1</name><value>n1</value></property>"
+        "<property><name>dfs.namenode.rpc-address.ns1.n1</name>"
+        "<value>merged:8020</value></property></configuration>")
+    monkeypatch.setenv("HADOOP_CONF_DIR", str(conf_dir))
+    r = HdfsNamenodeResolver()
+    assert r.resolve_default_hdfs_service()[1] == ["merged:8020"]
+
+
+# -------------------------------------------------------------- failover ---
+
+class _Fs:
+    def __init__(self, name, error=None, fail_times=0):
+        self.name = name
+        self.error = error
+        self.fail_times = fail_times
+        self.calls = {"ls": 0, "exists": 0, "info": 0}
+
+    def _maybe_fail(self):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise (self.error or IOError)(f"{self.name} unavailable")
+
+    def ls(self, path):
+        self.calls["ls"] += 1
+        self._maybe_fail()
+        return [f"{path}@{self.name}"]
+
+    def exists(self, path):
+        self.calls["exists"] += 1
+        self._maybe_fail()
+        return True
+
+    def info(self, path):
+        self.calls["info"] += 1
+        self._maybe_fail()
+        return {"name": path, "server": self.name}
+
+
+class _Connector(HdfsConnector):
+    fs_by_host = {}
+
+    @classmethod
+    def hdfs_connect_namenode(cls, netloc, user=None, **kwargs):
+        fs = cls.fs_by_host.get(netloc)
+        if fs is None:
+            raise IOError(f"no route to {netloc}")
+        return fs
+
+
+def _client(hosts):
+    return HAHdfsClient(_Connector, hosts)
+
+
+def test_definite_answers_do_not_fail_over():
+    """FileNotFoundError is a healthy namenode's answer, not an outage
+    (reference treats only connection errors as failover-worthy)."""
+    fs = _Fs("h1", error=FileNotFoundError, fail_times=1)
+    _Connector.fs_by_host = {"h1:8020": fs, "h2:8020": _Fs("h2")}
+    client = _client(["h1:8020", "h2:8020"])
+    with pytest.raises(FileNotFoundError):
+        client.ls("/missing")
+    assert _Connector.fs_by_host["h2:8020"].calls["ls"] == 0
+
+
+def test_permission_error_propagates():
+    fs = _Fs("h1", error=PermissionError, fail_times=1)
+    _Connector.fs_by_host = {"h1:8020": fs}
+    client = _client(["h1:8020"])
+    with pytest.raises(PermissionError):
+        client.exists("/secret")
+
+
+def test_failover_counts_attempts_not_methods():
+    """Each call gets its own failover budget; a previous call's failovers
+    don't exhaust the next call's."""
+    h1 = _Fs("h1", fail_times=1)
+    h2 = _Fs("h2", fail_times=1)
+    _Connector.fs_by_host = {"h1:8020": h1, "h2:8020": h2}
+    client = _client(["h1:8020", "h2:8020"])
+    # First call: h1 fails once -> failover to h2 (fails once) -> back to h1.
+    assert client.ls("/a") == ["/a@h1"]
+    # Second call starts fresh on the current namenode and succeeds at once.
+    assert client.ls("/b")
+    total_attempts = h1.calls["ls"] + h2.calls["ls"]
+    assert total_attempts <= 2 * (MAX_NAMENODE_FAILOVER_ATTEMPTS + 1)
+
+
+def test_all_proxied_methods_share_failover(tmp_path):
+    h1 = _Fs("h1", fail_times=1)
+    h2 = _Fs("h2")
+    _Connector.fs_by_host = {"h1:8020": h1, "h2:8020": h2}
+    client = _client(["h1:8020", "h2:8020"])
+    assert client.info("/p")["server"] == "h2"  # failed over on info()
+    assert client.exists("/p")  # stays on h2
+    assert h2.calls["exists"] == 1
+
+
+def test_non_proxied_attribute_reaches_raw_fs():
+    fs = _Fs("h1")
+    _Connector.fs_by_host = {"h1:8020": fs}
+    client = _client(["h1:8020"])
+    assert client.name == "h1"  # plain attribute, no failover wrapper
+
+
+def test_connect_round_robin_order_preserved():
+    """connect_to_either_namenode starts the HA client at the first healthy
+    namenode but keeps the full rotation."""
+    _Connector.fs_by_host = {"h2:8020": _Fs("h2")}
+    client = HdfsConnector.connect_to_either_namenode.__func__(
+        _Connector, ["h1:8020", "h2:8020"])
+    assert client.ls("/x") == ["/x@h2"]
+
+
+def test_connect_all_namenodes_dead_lists_errors():
+    _Connector.fs_by_host = {}
+    with pytest.raises(HdfsConnectError, match="h1:8020"):
+        HdfsConnector.connect_to_either_namenode.__func__(
+            _Connector, ["h1:8020", "h2:8020"])
