@@ -135,7 +135,10 @@ class ScalarCodec(DataframeColumnCodec):
 
     def decode(self, unischema_field, encoded):
         dt = unischema_field.numpy_dtype
-        if dt in (str, np.str_, bytes, np.bytes_, Decimal):
+        if dt in (bytes, np.bytes_):
+            # Zero-copy readers hand binary cells in as memoryviews.
+            return bytes(encoded) if isinstance(encoded, memoryview) else encoded
+        if dt in (str, np.str_, Decimal):
             return encoded
         npdt = np.dtype(dt)
         if npdt.kind == "M":

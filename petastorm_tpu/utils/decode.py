@@ -6,7 +6,23 @@ from __future__ import annotations
 
 import numpy as np
 
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
 from petastorm_tpu.unischema import Unischema, _default_codec
+
+# The built-in codecs accept (and never leak) memoryview cells from the
+# zero-copy read path. Exact types only: a subclass overriding decode() may
+# assume the public bytes contract, so it gets bytes.
+_MEMORYVIEW_SAFE_CODECS = (ScalarCodec, NdarrayCodec, CompressedNdarrayCodec,
+                           CompressedImageCodec)
+
+
+def codec_safe_value(codec, value):
+    """Normalize a zero-copy memoryview cell to bytes for codecs outside the
+    memoryview-safe built-ins (user codecs see the documented bytes type)."""
+    if isinstance(value, memoryview) and type(codec) not in _MEMORYVIEW_SAFE_CODECS:
+        return bytes(value)
+    return value
 
 
 def decode_row(row: dict, schema: Unischema) -> dict:
@@ -23,5 +39,5 @@ def decode_row(row: dict, schema: Unischema) -> dict:
         if value is None:
             decoded[name] = None
             continue
-        decoded[name] = codec.decode(field, value)
+        decoded[name] = codec.decode(field, codec_safe_value(codec, value))
     return decoded
