@@ -202,8 +202,13 @@ _NPY_HEADER_CACHE: dict = {}
 
 def _fast_npy_decode(encoded):
     """Decode ``.npy`` bytes ~10x faster than np.load for repeated headers.
-    Returns None when the payload needs the generic loader."""
+    Accepts bytes or memoryview. Returns None when the payload needs the
+    generic loader."""
     import ast
+    if isinstance(encoded, memoryview) and encoded.format != "B":
+        # Arrow-buffer memoryviews are signed ('b'); cast so slice-vs-bytes
+        # comparisons below use unsigned byte semantics.
+        encoded = encoded.cast("B")
     if len(encoded) < 10 or encoded[:6] != b"\x93NUMPY":
         return None
     major = encoded[6]

@@ -270,19 +270,23 @@ class RowReaderWorker(WorkerBase):
         """Column-major decode, then row assembly — one tight loop per field
         instead of a per-row schema walk (the row-path analog of the batch
         worker's vectorized conversion)."""
-        from petastorm_tpu.utils.decode import _MEMORYVIEW_SAFE_CODECS
+        from petastorm_tpu.utils.decode import is_memoryview_safe
         cols = {}
         for name, field, codec in self._decode_schema.decode_plan:
             src = data.get(name)
             if src is None:
                 continue
             dec = codec.decode
-            if type(codec) not in _MEMORYVIEW_SAFE_CODECS:
+            if is_memoryview_safe(codec):
+                cols[name] = [None if src[i] is None else dec(field, src[i])
+                              for i in indices]
+            else:
                 # User codecs see the documented bytes contract, never the
-                # zero-copy memoryviews.
-                src = [bytes(v) if isinstance(v, memoryview) else v for v in src]
-            cols[name] = [None if src[i] is None else dec(field, src[i])
-                          for i in indices]
+                # zero-copy memoryviews; normalize only the selected rows.
+                cols[name] = [
+                    None if (v := src[i]) is None
+                    else dec(field, bytes(v) if isinstance(v, memoryview) else v)
+                    for i in indices]
         names = list(cols.keys())
         return [{n: cols[n][j] for n in names} for j in range(len(indices))]
 

@@ -17,10 +17,16 @@ _MEMORYVIEW_SAFE_CODECS = (ScalarCodec, NdarrayCodec, CompressedNdarrayCodec,
                            CompressedImageCodec)
 
 
+def is_memoryview_safe(codec) -> bool:
+    """True when ``codec`` is a built-in that accepts zero-copy memoryview
+    cells (exact type: subclasses may assume the public bytes contract)."""
+    return type(codec) in _MEMORYVIEW_SAFE_CODECS
+
+
 def codec_safe_value(codec, value):
     """Normalize a zero-copy memoryview cell to bytes for codecs outside the
     memoryview-safe built-ins (user codecs see the documented bytes type)."""
-    if isinstance(value, memoryview) and type(codec) not in _MEMORYVIEW_SAFE_CODECS:
+    if isinstance(value, memoryview) and not is_memoryview_safe(codec):
         return bytes(value)
     return value
 
