@@ -69,6 +69,16 @@ class BatchReaderWorker(WorkerBase):
         if transform_spec is not None and transform_spec.func is not None:
             df = table.to_pandas()
             df = transform_spec.func(df)
+            # Arrow has no multi-dim cell type: ravel tensor cells into flat
+            # lists here; the output conversion reshapes them back via the
+            # schema's declared shape (arrow_table_to_numpy_dict — parity
+            # with reference arrow_reader_worker.py:72-75).
+            for col in df.columns:
+                vals = df[col].values
+                probe = next((v for v in vals if isinstance(v, np.ndarray)), None)
+                if probe is not None and probe.ndim > 1:
+                    df[col] = [v.ravel() if isinstance(v, np.ndarray) else v
+                               for v in vals]
             table = pa.Table.from_pandas(df, preserve_index=False)
 
         # Narrow to the output view (post-transform schema).

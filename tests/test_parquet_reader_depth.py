@@ -1,0 +1,153 @@
+"""make_batch_reader depth: single files, asymmetric pieces, invalid
+columns, tensor-returning transforms, caching with shuffle, wide stores
+(strategy parity: reference tests/test_parquet_reader.py:78-627)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _write_plain(path, start, n, row_group_size=50):
+    pq.write_table(pa.table({
+        "id": np.arange(start, start + n, dtype=np.int64),
+        "v": np.arange(start, start + n, dtype=np.float64) * 2.0,
+    }), path, row_group_size=row_group_size)
+
+
+def test_read_single_file_url(tmp_path):
+    """A URL pointing at one .parquet file (not a directory) reads fine
+    (reference test_parquet_reader.py:78)."""
+    _write_plain(f"{tmp_path}/solo.parquet", 0, 30)
+    with make_batch_reader(f"file://{tmp_path}/solo.parquet",
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as r:
+        ids = [i for b in r for i in b.id.tolist()]
+    assert ids == list(range(30))
+
+
+def test_asymmetric_pieces(tmp_path):
+    """Files with different row counts and row-group sizes all surface
+    (reference :121)."""
+    _write_plain(f"{tmp_path}/a.parquet", 0, 17, row_group_size=5)
+    _write_plain(f"{tmp_path}/b.parquet", 17, 83, row_group_size=40)
+    with make_batch_reader(f"file://{tmp_path}", reader_pool_type="dummy",
+                           shuffle_row_groups=False) as r:
+        ids = sorted(i for b in r for i in b.id.tolist())
+    assert ids == list(range(100))
+
+
+def test_invalid_column_name_raises(scalar_dataset):
+    with pytest.raises(Exception) as ei:
+        make_batch_reader(scalar_dataset.url, schema_fields=["no_such_col"],
+                          reader_pool_type="dummy")
+    assert "no_such_col" in str(ei.value) or "matched no fields" in str(ei.value)
+
+
+def test_mixed_valid_invalid_column_names_raise(scalar_dataset):
+    with pytest.raises(Exception):
+        make_batch_reader(scalar_dataset.url,
+                          schema_fields=["id", "no_such_col"],
+                          reader_pool_type="dummy")
+
+
+def test_transform_returning_tensor_column(scalar_dataset):
+    """A TransformSpec producing a fixed-shape tensor column flows through
+    with edited schema (reference :171)."""
+    def add_tensor(df):
+        df["feat"] = [np.full((2, 3), i, np.float32) for i in df["id"]]
+        return df[["id", "feat"]]
+
+    spec = TransformSpec(
+        add_tensor,
+        edit_fields=[UnischemaField("feat", np.float32, (2, 3), None, False)],
+        selected_fields=["id", "feat"])
+    with make_batch_reader(scalar_dataset.url, transform_spec=spec,
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as r:
+        batch = next(iter(r))
+    assert set(batch._fields) == {"id", "feat"}
+    assert batch.feat[0].shape == (2, 3)
+    assert float(batch.feat[3][0, 0]) == float(batch.id[3])
+
+
+def test_shuffle_rows_with_cache_varies_across_epochs(tmp_path):
+    """Row-level shuffling stays epoch-varying when groups come from the
+    disk cache — the cache stores raw groups, not shuffled output
+    (reference :275)."""
+    _write_plain(f"{tmp_path}/d.parquet", 0, 100, row_group_size=100)
+    orders = []
+    with make_batch_reader(f"file://{tmp_path}", reader_pool_type="dummy",
+                           shuffle_row_groups=True, shuffle_rows=True,
+                           num_epochs=3, cache_type="local-disk",
+                           cache_location=f"{tmp_path}/cache",
+                           cache_size_limit=20 * 2 ** 20) as r:
+        epoch = []
+        for b in r:
+            epoch.extend(b.id.tolist())
+            if len(epoch) == 100:
+                orders.append(epoch)
+                epoch = []
+    assert len(orders) == 3
+    assert all(sorted(o) == list(range(100)) for o in orders)
+    assert orders[0] != orders[1] or orders[1] != orders[2]
+
+
+def test_wide_store_column_subset(tmp_path):
+    """Reading 3 of 300 columns touches only those (reference :99)."""
+    table = pa.table({f"col_{i}": np.arange(20, dtype=np.int32)
+                      for i in range(300)})
+    pq.write_table(table, f"{tmp_path}/wide.parquet", row_group_size=10)
+    with make_batch_reader(f"file://{tmp_path}",
+                           schema_fields=["col_1", "col_17", "col_299"],
+                           reader_pool_type="dummy") as r:
+        batch = next(iter(r))
+    assert set(batch._fields) == {"col_1", "col_17", "col_299"}
+
+
+def test_results_queue_size_propagates(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type="thread",
+                           workers_count=2, results_queue_size=7,
+                           shuffle_row_groups=False) as r:
+        next(iter(r))
+        diag = r.diagnostics
+    assert diag  # bounded queue wired without error
+
+
+def test_seeded_batch_shuffle_reproducible(scalar_dataset):
+    def run(seed):
+        with make_batch_reader(scalar_dataset.url, shuffle_row_groups=True,
+                               shuffle_rows=True, seed=seed,
+                               reader_pool_type="dummy") as r:
+            return [i for b in r for i in b.id.tolist()]
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)
+
+
+def test_transform_tensor_column_with_null_rows(scalar_dataset):
+    """Nullable tensor cells survive the transform boundary: None rows come
+    back as NaN-filled blocks of the declared shape."""
+    def add_opt_tensor(df):
+        df["feat"] = [None if i % 3 == 0 else np.full((2, 2), i, np.float32)
+                      for i in df["id"]]
+        return df[["id", "feat"]]
+
+    spec = TransformSpec(
+        add_opt_tensor,
+        edit_fields=[UnischemaField("feat", np.float32, (2, 2), None, True)],
+        selected_fields=["id", "feat"])
+    with make_batch_reader(scalar_dataset.url, transform_spec=spec,
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as r:
+        batch = next(iter(r))
+    assert batch.feat.shape[1:] == (2, 2)
+    for i, row_id in enumerate(batch.id.tolist()):
+        if row_id % 3 == 0:
+            assert np.isnan(batch.feat[i]).all()
+        else:
+            assert float(batch.feat[i][0, 0]) == float(row_id)
