@@ -192,11 +192,17 @@ def make_batch_reader(dataset_url_or_urls,
                       storage_options: Optional[dict] = None,
                       filesystem=None,
                       zmq_copy_buffers: bool = True,
+                      convert_early_to_numpy: bool = False,
                       resume_state: Optional[dict] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
     ``schema_fields`` is a list of column names or name regexes.
+    ``convert_early_to_numpy`` moves the Arrow->numpy conversion into the
+    workers (parity: reference reader.py:227, arrow_reader_worker.py:279) —
+    useful when worker parallelism should absorb the conversion cost; the
+    default converts at the consumer (zero-copy from shared memory on the
+    process pool's shm transport).
     Parity: reference reader.py:209.
     """
     ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
@@ -209,9 +215,15 @@ def make_batch_reader(dataset_url_or_urls,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings)
 
-    from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+    if convert_early_to_numpy:
+        # Workers publish numpy dicts, which Arrow IPC cannot carry.
+        from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+        serializer = PickleSerializer()
+    else:
+        from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+        serializer = ArrowTableSerializer()
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      ArrowTableSerializer(), shuffle_rows, seed, zmq_copy_buffers)
+                      serializer, shuffle_rows, seed, zmq_copy_buffers)
 
     return Reader(ctx, schema,
                   dataset_url_or_urls=dataset_url_or_urls,
@@ -233,7 +245,8 @@ def make_batch_reader(dataset_url_or_urls,
                   transform_spec=transform_spec,
                   storage_options=storage_options,
                   resume_state=resume_state,
-                  filesystem=filesystem)
+                  filesystem=filesystem,
+                  convert_early_to_numpy=convert_early_to_numpy)
 
 
 class Reader:
@@ -247,7 +260,7 @@ class Reader:
                  shuffle_row_drop_partitions, predicate, rowgroup_selector,
                  num_epochs, cur_shard, shard_count, shard_seed, seed, cache,
                  transform_spec, storage_options, resume_state=None,
-                 filesystem=None):
+                 filesystem=None, convert_early_to_numpy=False):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -323,9 +336,11 @@ class Reader:
             "cache": cache,
             "shuffle_rows": shuffle_rows,
             "seed": seed,
+            "convert_early_to_numpy": convert_early_to_numpy,
         }
 
-        if is_batched_reader and hasattr(self._pool, "result_transform"):
+        if is_batched_reader and not convert_early_to_numpy \
+                and hasattr(self._pool, "result_transform"):
             # Process pool: convert Arrow -> numpy inside the poll, while the
             # shm transport's zero-copy view is still valid.
             from functools import partial as _partial
@@ -507,6 +522,10 @@ class _BatchResultsReader:
 
     def read_next(self):
         result = self._pool.get_results()
-        if not isinstance(result, dict):  # thread/dummy pools publish Tables
+        if not isinstance(result, dict):
+            # Payload shape depends on convert_early_to_numpy, not pool type:
+            # workers publish Tables by default (converted here) and numpy
+            # dicts when converting early (incl. the process pool's shm
+            # result_transform path).
             result = arrow_table_to_numpy_dict(result, self._schema)
         return self._schema.make_namedtuple_from_dict(result)

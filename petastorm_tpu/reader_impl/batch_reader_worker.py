@@ -85,7 +85,13 @@ class BatchReaderWorker(WorkerBase):
         out_schema = self.args.get("output_schema", view_schema)
         keep = [n for n in table.column_names if n in out_schema.fields]
         table = table.select(keep)
-        self.publish_func(table)
+        if self.args.get("convert_early_to_numpy"):
+            # Worker-side conversion (parity: reference
+            # arrow_reader_worker.py:279): worker parallelism absorbs the
+            # Arrow->numpy cost; payloads cross pools as numpy dicts.
+            self.publish_func(arrow_table_to_numpy_dict(table, out_schema))
+        else:
+            self.publish_func(table)
 
     # ------------------------------------------------------------ internals
     def _cache_key(self, rowgroup, columns) -> str:

@@ -6,8 +6,13 @@ import pickle
 
 
 class PickleSerializer:
+    # pickle.loads copies everything out of its input (in-band buffers), so
+    # deserialized objects never alias the source — transports may hand in a
+    # transient memoryview without a defensive copy.
+    aliases_input = False
+
     def serialize(self, rows) -> bytes:
         return pickle.dumps(rows, protocol=pickle.HIGHEST_PROTOCOL)
 
-    def deserialize(self, serialized: bytes):
+    def deserialize(self, serialized) -> object:
         return pickle.loads(serialized)

@@ -293,6 +293,10 @@ class ProcessPool:
                         # Zero-copy: deserialize straight from mapped memory;
                         # the transform copies before we advance.
                         result = self._serializer.deserialize(view)
+                    elif not getattr(self._serializer, "aliases_input", True):
+                        # Deserialization copies (e.g. pickle): safe to read
+                        # straight from the mapped ring, no defensive copy.
+                        result = self._serializer.deserialize(view)
                     else:
                         # No copying transform: deserialize from one safe
                         # copy so the result cannot alias the reused ring.

@@ -151,3 +151,33 @@ def test_transform_tensor_column_with_null_rows(scalar_dataset):
             assert np.isnan(batch.feat[i]).all()
         else:
             assert float(batch.feat[i][0, 0]) == float(row_id)
+
+
+@pytest.mark.parametrize("pool", ["dummy", "thread", "process"])
+def test_convert_early_to_numpy(scalar_dataset, pool):
+    """Worker-side numpy conversion yields identical batches to the default
+    consumer-side conversion (reference test_parquet_reader.py:493)."""
+    def read(convert_early):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type=pool,
+                               workers_count=2, shuffle_row_groups=False,
+                               convert_early_to_numpy=convert_early) as r:
+            return sorted((i for b in r for i in b.id.tolist()))
+
+    assert read(True) == read(False) == list(range(100))
+
+
+def test_convert_early_with_transform(scalar_dataset):
+    def double(df):
+        df["v2"] = df["int_col"] * 2
+        return df[["id", "v2"]]
+
+    spec = TransformSpec(
+        double,
+        edit_fields=[UnischemaField("v2", np.int64, (), None, False)],
+        selected_fields=["id", "v2"])
+    with make_batch_reader(scalar_dataset.url, transform_spec=spec,
+                           reader_pool_type="dummy", shuffle_row_groups=False,
+                           convert_early_to_numpy=True) as r:
+        batch = next(iter(r))
+    assert isinstance(batch.v2, np.ndarray)
+    np.testing.assert_array_equal(batch.v2, 2 * scalar_dataset.data["int_col"][:10])
