@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import logging
 import time
-from collections import deque
 from typing import Dict, Optional
 
 import numpy as np
@@ -214,27 +213,83 @@ class LoaderBase:
         return staged
 
     def _prefetched(self, host_batches):
-        """Keep ``prefetch`` async device transfers in flight."""
-        window: deque = deque()
-        it = iter(host_batches)
-        while True:
-            t0 = time.perf_counter()
-            with trace("petastorm_tpu.host_batch"):
+        """Keep ``prefetch`` staged batches in flight, assembled on a
+        background thread.
+
+        ``jax.device_put`` dispatches asynchronously, but host-side batch
+        assembly (collating rows off the reader queue, ``np.stack``,
+        sanitization) is real CPU work — done on the consumer thread it lands
+        between device steps and shows up 1:1 as input stall. The staging
+        thread does collate+dispatch while the consumer blocks in the device
+        step (GIL released in ``block_until_ready``), so a batch is already
+        in HBM when the consumer asks for it."""
+        import queue as queue_mod
+        import threading
+
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+        _END, _ERR = object(), object()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
                 try:
-                    hb = next(it)
-                except StopIteration:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def _produce():
+            try:
+                it = iter(host_batches)
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    with trace("petastorm_tpu.host_batch"):
+                        try:
+                            hb = next(it)
+                        except StopIteration:
+                            break
+                    t1 = time.perf_counter()
+                    with trace("petastorm_tpu.stage"):
+                        staged = self._stage(hb)
+                    t2 = time.perf_counter()
+                    n = len(next(iter(hb.values()))) if hb else 0
+                    self.metrics.record_batch(n, self._last_staged_bytes,
+                                              t1 - t0, t2 - t1)
+                    if not _put((None, staged)):
+                        return
+            except BaseException as e:  # noqa: BLE001 - re-raised on consumer
+                _put((_ERR, e))
+            finally:
+                _put((_END, None))
+                # Exhausted generators close cleanly; an abandoned one (early
+                # consumer exit) closes here, on the thread that was running
+                # it, so reader teardown doesn't race the consumer.
+                if hasattr(host_batches, "close"):
+                    host_batches.close()
+
+        thread = threading.Thread(target=_produce, daemon=True,
+                                  name="petastorm-tpu-stage")
+        thread.start()
+        try:
+            while True:
+                kind, item = q.get()
+                if kind is _END:
                     break
-            t1 = time.perf_counter()
-            with trace("petastorm_tpu.stage"):
-                staged = self._stage(hb)
-            t2 = time.perf_counter()
-            n = len(next(iter(hb.values()))) if hb else 0
-            self.metrics.record_batch(n, self._last_staged_bytes, t1 - t0, t2 - t1)
-            window.append(staged)
-            if len(window) > self._prefetch:
-                yield window.popleft()
-        while window:
-            yield window.popleft()
+                if kind is _ERR:
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # _put polls `stop` every 50ms, so the producer exits on its own
+            # after at most one in-flight collate+stage. Bound the wait: if
+            # the reader is wedged mid-next() the daemon thread is abandoned
+            # rather than hanging the consumer's break/Ctrl-C.
+            thread.join(5.0)
+            if thread.is_alive():
+                logger.warning(
+                    "Staging thread still busy after stop (reader stalled "
+                    "mid-batch?); abandoning it as a daemon.")
 
     def _finalize_tail(self, cols: Dict[str, np.ndarray], count: int):
         """Handle the ragged last batch: drop, pad+mask, or emit as-is."""

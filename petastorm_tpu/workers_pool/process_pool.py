@@ -289,13 +289,13 @@ class ProcessPool:
                     if self._partial.get(idx):
                         payload = b"".join(self._partial.pop(idx) + [bytes(view)])
                         result = self._serializer.deserialize(payload)
-                    elif self.result_transform is not None:
-                        # Zero-copy: deserialize straight from mapped memory;
-                        # the transform copies before we advance.
-                        result = self._serializer.deserialize(view)
-                    elif not getattr(self._serializer, "aliases_input", True):
-                        # Deserialization copies (e.g. pickle): safe to read
-                        # straight from the mapped ring, no defensive copy.
+                    elif (self.result_transform is not None
+                          or not getattr(self._serializer, "aliases_input",
+                                         True)):
+                        # Zero-copy: deserialize straight from mapped memory.
+                        # Safe either because the transform copies before we
+                        # advance, or because deserialization itself copies
+                        # (e.g. pickle) and cannot alias the reused ring.
                         result = self._serializer.deserialize(view)
                     else:
                         # No copying transform: deserialize from one safe
