@@ -15,6 +15,10 @@ Three configs:
    feeding a real jitted ResNet-50 train step on the local chip(s); extra
    keys ``imagenet_samples_per_sec`` (per chip) and
    ``imagenet_input_stall_pct`` measured wait-vs-compute against that step.
+4. **scalar_batched** — the columnar path (``make_batch_reader`` ->
+   ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
+   key ``scalar_batched_samples_per_sec`` (the reference only ever made a
+   qualitative "significantly higher throughput" claim here, README.rst:242).
 """
 import json
 import os
@@ -77,6 +81,14 @@ def main():
     steady = reader_throughput(url_10k, warmup_cycles=200, measure_cycles=2000,
                                pool_type="thread", loaders_count=3)
 
+    # ---- scalar columnar path: make_batch_reader -> BatchedDataLoader --
+    from petastorm_tpu.benchmark.scalar_bench import (batched_loader_throughput,
+                                                      generate_scalar_dataset)
+    url_scalar = f"file://{data_dir}/scalar_100k"
+    if not os.path.exists(f"{data_dir}/scalar_100k/part0.parquet"):
+        generate_scalar_dataset(url_scalar)
+    scalar_sps = batched_loader_throughput(url_scalar)
+
     # ---- 3. imagenet: decode-bound reader vs real ResNet-50 step -------
     out = {
         "metric": "hello_world reader throughput",
@@ -84,6 +96,7 @@ def main():
         "unit": "samples/sec",
         "vs_baseline": round(best / BASELINE_SAMPLES_PER_SEC, 3),
         "hello_world_10k_samples_per_sec": round(steady.samples_per_second, 2),
+        "scalar_batched_samples_per_sec": round(scalar_sps, 2),
     }
     try:
         if not _probe_accelerator():

@@ -214,3 +214,20 @@ def test_user_codec_receives_bytes_not_memoryview(tmp_path):
                          reader_pool_type=pool, workers_count=2) as reader:
             rows = sorted(reader, key=lambda r: r.id)
         assert [r.blob for r in rows] == [bytes([i, i]) for i in range(20)]
+
+
+def test_scalar_bench_generate_and_measure(tmp_path):
+    """The scalar columnar bench runs end to end on a tiny store and the
+    generated store is plain Parquet (no petastorm sidecars)."""
+    import os
+
+    from petastorm_tpu.benchmark.scalar_bench import (batched_loader_throughput,
+                                                      generate_scalar_dataset)
+    url = f"file://{tmp_path}/scalar"
+    generate_scalar_dataset(url, rows=2000, float_cols=3, int_cols=2,
+                            row_group_size=256)
+    assert os.path.exists(f"{tmp_path}/scalar/part0.parquet")
+    assert not os.path.exists(f"{tmp_path}/scalar/_common_metadata")
+    sps = batched_loader_throughput(url, batch_size=128, workers_count=2,
+                                    warmup_batches=2, measure_batches=10)
+    assert sps > 0
