@@ -1,0 +1,37 @@
+"""Spark helpers for petastorm-format datasets.
+
+Parity: reference petastorm/spark_utils.py — ``dataset_as_rdd`` (:23)
+returns a Spark RDD of decoded, schema-namedtuple rows for a petastorm
+store. Here the FS/metadata side is the TPU stack's own (fsspec resolution,
+JSON-or-legacy schema loading); Spark is only used to read the parquet and
+distribute the decode, so the helper runs unchanged against real pyspark or
+the local test double (:mod:`petastorm_tpu.test_util.minispark`).
+"""
+from __future__ import annotations
+
+from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+from petastorm_tpu.utils.decode import decode_row
+
+
+def dataset_as_rdd(dataset_url: str, spark_session, schema_fields=None,
+                   storage_options=None):
+    """An RDD of decoded namedtuple records from a petastorm dataset.
+
+    :param dataset_url: url of the petastorm store (``file://``, ``hdfs://``,
+        any fsspec scheme).
+    :param spark_session: a SparkSession (or the minispark test double).
+    :param schema_fields: subset of fields to read — UnischemaField
+        instances, exact names, or regex patterns (anything
+        ``Unischema.create_schema_view`` accepts); None reads all fields.
+    :param storage_options: optional fsspec options for resolving the url.
+    """
+    schema = get_schema_from_dataset_url(dataset_url,
+                                         storage_options=storage_options)
+    dataset_df = spark_session.read.parquet(dataset_url)
+    if schema_fields is not None:
+        schema = schema.create_schema_view(schema_fields)
+        dataset_df = dataset_df.select(*schema.fields.keys())
+
+    return (dataset_df.rdd
+            .map(lambda row: decode_row(row.asDict(), schema))
+            .map(lambda record: schema.make_namedtuple(**record)))

@@ -304,6 +304,43 @@ class DataFrameWriter:
             pass
 
 
+class _DataFrameReader:
+    """``spark.read.parquet(url)`` over pyarrow — returns a DataFrame whose
+    rows are python values (binary cells as bytes), like pyspark's."""
+
+    def parquet(self, url: str) -> "DataFrame":
+        import pyarrow.parquet as pq
+
+        from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+        fs, path = get_filesystem_and_path_or_paths(url)
+        table = pq.read_table(path, filesystem=fs)
+        fields = [StructField(name, _from_arrow(table.schema.field(name).type))
+                  for name in table.column_names]
+        rows = [dict(zip(table.column_names, vals))
+                for vals in zip(*(col.to_pylist() for col in table.columns))] \
+            if table.num_columns else []
+        return DataFrame(rows, StructType(fields), f"Relation [{url}]")
+
+
+def _from_arrow(t) -> DataType:
+    import pyarrow as pa
+    if pa.types.is_float64(t):
+        return DoubleType()
+    if pa.types.is_float32(t):
+        return FloatType()
+    if pa.types.is_int32(t):
+        return IntegerType()
+    if pa.types.is_integer(t):
+        return LongType()
+    if pa.types.is_boolean(t):
+        return BooleanType()
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return BinaryType()
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return ArrayType(_from_arrow(t.value_type))
+    return StringType()
+
+
 def _to_arrow(t: DataType):
     import pyarrow as pa
     mapping = {
@@ -370,6 +407,10 @@ class DataFrame:
         return [Row(**r) for r in self._rows]
 
     @property
+    def rdd(self) -> "RDD":
+        return RDD([Row(**r) for r in self._rows])
+
+    @property
     def write(self) -> DataFrameWriter:
         return DataFrameWriter(self)
 
@@ -380,6 +421,37 @@ class Row(dict):
             return self[item]
         except KeyError as e:
             raise AttributeError(item) from e
+
+    def asDict(self):
+        return dict(self)
+
+
+class RDD:
+    """Eager local stand-in for pyspark RDD: enough surface (map/collect/
+    count/take/first) for petastorm-style dataset_as_rdd pipelines."""
+
+    def __init__(self, items: List):
+        self._items = list(items)
+
+    def map(self, fn) -> "RDD":
+        return RDD([fn(x) for x in self._items])
+
+    def filter(self, fn) -> "RDD":
+        return RDD([x for x in self._items if fn(x)])
+
+    def collect(self) -> List:
+        return list(self._items)
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def take(self, n: int) -> List:
+        return self._items[:n]
+
+    def first(self):
+        if not self._items:
+            raise ValueError("RDD is empty")
+        return self._items[0]
 
 
 # -------------------------------------------------------------- SparkSession
@@ -461,6 +533,10 @@ class SparkSession:
 
     # ``builder`` behaves like a property on the class in pyspark.
     builder = _ClassProperty(lambda cls: cls.Builder())
+
+    @property
+    def read(self) -> "_DataFrameReader":
+        return _DataFrameReader()
 
     def createDataFrame(self, data, schema) -> DataFrame:
         if isinstance(schema, (list, tuple)) and all(isinstance(s, str) for s in schema):
