@@ -105,8 +105,9 @@ def main():
             import jax
             jax.config.update("jax_platforms", "cpu")
             out["imagenet_platform"] = "cpu-fallback"
-            url_tiny = f"file://{data_dir}/imagenet_tiny"
-            _ensure(url_tiny, lambda: write_synthetic_imagenet(url_tiny, rows=256))
+            url_tiny = f"file://{data_dir}/imagenet_tiny64"
+            _ensure(url_tiny, lambda: write_synthetic_imagenet(
+                url_tiny, rows=256, image_size=64))
             imagenet = run_imagenet_bench(url_tiny, steps=3, per_device_batch=2,
                                           workers_count=2, pool_type="thread")
         else:

@@ -231,3 +231,18 @@ def test_scalar_bench_generate_and_measure(tmp_path):
     sps = batched_loader_throughput(url, batch_size=128, workers_count=2,
                                     warmup_batches=2, measure_batches=10)
     assert sps > 0
+
+
+def test_imagenet_bench_runs_on_cpu(tmp_path):
+    """run_imagenet_bench (the BENCH artifact's target workload) executes
+    end to end on CPU with a small image size and reports stall+throughput."""
+    from petastorm_tpu.benchmark.imagenet_bench import (run_imagenet_bench,
+                                                        write_synthetic_imagenet)
+    url = f"file://{tmp_path}/imgnet48"
+    write_synthetic_imagenet(url, rows=64, classes=4, rows_per_row_group=32,
+                             image_size=48)
+    r = run_imagenet_bench(url, steps=3, per_device_batch=2, workers_count=2,
+                           pool_type="thread")
+    assert r["samples_per_sec"] > 0
+    assert 0.0 <= r["input_stall_pct"] <= 100.0
+    assert r["global_batch"] == 2 * r["devices"]
