@@ -1,0 +1,7 @@
+"""Device-side ops for the input-pipeline tail (normalize, augment)."""
+from petastorm_tpu.ops.augment import (cutout, mixup, random_crop,
+                                       random_flip_horizontal)
+from petastorm_tpu.ops.image_ops import normalize_images
+
+__all__ = ["normalize_images", "random_flip_horizontal", "random_crop",
+           "cutout", "mixup"]

@@ -1,0 +1,92 @@
+"""Device-side batch augmentation: jit-friendly, static-shape, VPU-vectorized.
+
+The reference runs augmentation on the host inside workers (TransformSpec,
+reference transform.py:27) — fine when the trainer box has spare cores, but
+on TPU VMs the host CPU is the scarce resource feeding the chip. These ops
+run *after* staging, inside the jitted train step, so the host ships compact
+uint8 batches and the accelerator does the per-sample randomness:
+
+* every op takes a PRNG ``key`` and is deterministic given (key, batch) —
+  replays and multi-host lockstep need no host-side RNG state;
+* shapes are static (XLA requirement): crops slice fixed-size windows at
+  traced offsets via ``dynamic_slice``, never data-dependent shapes;
+* randomness is per-sample via one ``jax.vmap`` over split keys.
+
+Typical composition inside a train step::
+
+    key, k1, k2, k3 = jax.random.split(step_key, 4)
+    x = random_flip_horizontal(k1, batch["image"])
+    x = random_crop(k2, x, padding=4)
+    x = normalize_images(x)            # ops.image_ops (uint8 -> bf16)
+    x, y = mixup(k3, x, labels, alpha=0.2)
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def random_flip_horizontal(key, images, p: float = 0.5):
+    """Per-sample horizontal flip of an (B, H, W, C) batch."""
+    flips = jax.random.bernoulli(key, p, (images.shape[0],))
+    return jnp.where(flips[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+@partial(jax.jit, static_argnames=("padding", "mode"))
+def random_crop(key, images, padding: int = 4, mode: str = "constant"):
+    """Pad-and-crop augmentation (the CIFAR/ImageNet-resnet recipe): pad
+    H and W by ``padding``, then crop back to the original size at a
+    per-sample random offset. Output shape == input shape (static)."""
+    b, h, w, c = images.shape
+    padded = jnp.pad(images, ((0, 0), (padding, padding), (padding, padding),
+                              (0, 0)), mode=mode)
+    keys = jax.random.split(key, b)
+
+    def crop_one(k, img):
+        oy, ox = jax.random.randint(k, (2,), 0, 2 * padding + 1)
+        return jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
+
+    return jax.vmap(crop_one)(keys, padded)
+
+
+@partial(jax.jit, static_argnames=("size",))
+def cutout(key, images, size: int = 8, fill=0):
+    """Zero (or ``fill``) one random ``size x size`` square per sample.
+    Mask built from iota comparisons — no scatter, pure VPU."""
+    b, h, w, _ = images.shape
+    keys = jax.random.split(key, b)
+    ys = jnp.arange(h)[:, None]
+    xs = jnp.arange(w)[None, :]
+
+    def mask_one(k, img):
+        cy = jax.random.randint(k, (), 0, h)
+        cx = jax.random.randint(jax.random.fold_in(k, 1), (), 0, w)
+        inside = ((ys >= cy - size // 2) & (ys < cy + (size + 1) // 2) &
+                  (xs >= cx - size // 2) & (xs < cx + (size + 1) // 2))
+        return jnp.where(inside[:, :, None], jnp.asarray(fill, img.dtype), img)
+
+    return jax.vmap(mask_one)(keys, images)
+
+
+@partial(jax.jit, static_argnames=("alpha", "num_classes"))
+def mixup(key, images, labels, alpha: float = 0.2, num_classes: int = 0):
+    """Batch mixup (Zhang et al. 2017): convex-combine each sample with a
+    rolled partner. ``images`` must be float; integer ``labels`` are
+    one-hot-encoded (``num_classes`` required) so they can mix too.
+    Returns ``(mixed_images, mixed_soft_labels)``."""
+    if not jnp.issubdtype(images.dtype, jnp.floating):
+        raise ValueError("mixup needs float images (normalize first)")
+    if jnp.issubdtype(labels.dtype, jnp.integer):
+        if not num_classes:
+            raise ValueError("num_classes is required for integer labels")
+        labels = jax.nn.one_hot(labels, num_classes, dtype=images.dtype)
+    lam = jax.random.beta(key, alpha, alpha, (images.shape[0],))
+    lam = jnp.maximum(lam, 1.0 - lam)  # stay closer to the original sample
+    li = lam[:, None, None, None].astype(images.dtype)
+    ll = lam[:, None].astype(labels.dtype)
+    partner = jnp.roll(images, 1, axis=0)
+    partner_labels = jnp.roll(labels, 1, axis=0)
+    return (li * images + (1 - li) * partner,
+            ll * labels + (1 - ll) * partner_labels)

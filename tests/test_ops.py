@@ -37,3 +37,73 @@ def test_normalize_custom_mean_std_and_dtype():
                            std=(0.5, 0.5, 0.5), out_dtype=jnp.float32,
                            use_pallas=False)
     np.testing.assert_allclose(np.asarray(out), (128 / 255 - 0.5) / 0.5, atol=1e-6)
+
+
+# ------------------------------------------------------------ augmentation ---
+
+def test_random_flip_horizontal_flips_some():
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import random_flip_horizontal
+    imgs = jnp.arange(4 * 2 * 3 * 1, dtype=jnp.float32).reshape(4, 2, 3, 1)
+    out = random_flip_horizontal(jax.random.PRNGKey(0), imgs)
+    flipped = imgs[:, :, ::-1, :]
+    per_sample_flipped = [bool(jnp.all(out[i] == flipped[i])) for i in range(4)]
+    per_sample_same = [bool(jnp.all(out[i] == imgs[i])) for i in range(4)]
+    assert all(f or s for f, s in zip(per_sample_flipped, per_sample_same))
+    # p=1 / p=0 are deterministic
+    assert bool(jnp.all(random_flip_horizontal(jax.random.PRNGKey(1), imgs, p=1.0)
+                        == flipped))
+    assert bool(jnp.all(random_flip_horizontal(jax.random.PRNGKey(1), imgs, p=0.0)
+                        == imgs))
+
+
+def test_random_crop_shape_and_content():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from petastorm_tpu.ops import random_crop
+    imgs = jnp.ones((3, 8, 8, 2), jnp.uint8) * 7
+    out = random_crop(jax.random.PRNGKey(0), imgs, padding=2)
+    assert out.shape == imgs.shape and out.dtype == imgs.dtype
+    vals = np.unique(np.asarray(out))
+    assert set(vals.tolist()) <= {0, 7}  # original content or zero padding
+    # determinism
+    again = random_crop(jax.random.PRNGKey(0), imgs, padding=2)
+    assert bool(jnp.all(out == again))
+
+
+def test_cutout_masks_expected_area():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from petastorm_tpu.ops import cutout
+    imgs = jnp.ones((2, 16, 16, 3), jnp.float32)
+    out = np.asarray(cutout(jax.random.PRNGKey(3), imgs, size=4))
+    zeros_per_sample = (out == 0).all(axis=-1).sum(axis=(1, 2))
+    assert (zeros_per_sample > 0).all()
+    assert (zeros_per_sample <= 16).all()  # at most size^2 (clipped at edges)
+
+
+def test_mixup_mixes_images_and_labels():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from petastorm_tpu.ops import mixup
+    imgs = jnp.stack([jnp.zeros((4, 4, 1)), jnp.ones((4, 4, 1))]).astype(jnp.float32)
+    labels = jnp.asarray([0, 1], jnp.int32)
+    mixed, soft = mixup(jax.random.PRNGKey(0), imgs, labels, alpha=0.4,
+                        num_classes=2)
+    assert mixed.shape == imgs.shape and soft.shape == (2, 2)
+    np.testing.assert_allclose(np.asarray(soft).sum(axis=1), 1.0, rtol=1e-5)
+    # lam >= 0.5 keeps each sample dominated by its own content
+    assert float(mixed[0].mean()) <= 0.5 and float(mixed[1].mean()) >= 0.5
+    import pytest
+    with pytest.raises(ValueError):
+        mixup(jax.random.PRNGKey(0), imgs, labels)  # int labels, no num_classes
+    with pytest.raises(ValueError):
+        mixup(jax.random.PRNGKey(0), imgs.astype(jnp.uint8), labels, num_classes=2)
