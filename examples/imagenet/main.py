@@ -18,7 +18,8 @@ from petastorm_tpu.jax import DataLoader, DTypePolicy
 from petastorm_tpu.reader import make_reader
 
 
-def train(url: str, steps: int = 30, per_device_batch: int = 8, classes: int = 100):
+def train(url: str, steps: int = 30, per_device_batch: int = 8,
+          classes: int = 100, learning_rate: float = 0.05):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -34,14 +35,22 @@ def train(url: str, steps: int = 30, per_device_batch: int = 8, classes: int = 1
     params = jax.device_put(resnet.init_params(jax.random.PRNGKey(0), classes),
                             replicated)
     velocity = jax.device_put(jax.tree.map(lambda p: p * 0, params), replicated)
-    raw_step = resnet.make_train_step(learning_rate=0.05)
+    raw_step = resnet.make_train_step(learning_rate=learning_rate)
 
-    def preprocess_and_step(params, velocity, batch):
-        images = batch["image"].astype(jnp.float32) / 255.0
+    def preprocess_and_step(params, velocity, batch, key):
+        # Device-side augmentation: the host ships compact uint8 batches,
+        # flips/crops happen on-chip (petastorm_tpu.ops), keyed per step so
+        # replays are deterministic.
+        from petastorm_tpu.ops import random_crop, random_flip_horizontal
+        k1, k2 = jax.random.split(key)
+        images = random_flip_horizontal(k1, batch["image"])
+        images = random_crop(k2, images, padding=4)
+        images = images.astype(jnp.float32) / 255.0
         return raw_step(params, velocity,
                         {"image": images, "label": batch["label"]})
 
     step = jax.jit(preprocess_and_step, donate_argnums=(0, 1))
+    step_key = jax.random.PRNGKey(42)
 
     with make_reader(url, num_epochs=None, shuffle_row_groups=True, seed=0,
                      workers_count=4) as reader:
@@ -51,7 +60,7 @@ def train(url: str, steps: int = 30, per_device_batch: int = 8, classes: int = 1
         it = iter(loader)
         # Warm up: first step compiles.
         batch = next(it)
-        params, velocity, loss, acc = step(params, velocity, batch)
+        params, velocity, loss, acc = step(params, velocity, batch, step_key)
         jax.block_until_ready(loss)
 
         wait_s = compute_s = 0.0
@@ -60,7 +69,8 @@ def train(url: str, steps: int = 30, per_device_batch: int = 8, classes: int = 1
             t0 = time.perf_counter()
             batch = next(it)
             t1 = time.perf_counter()
-            params, velocity, loss, acc = step(params, velocity, batch)
+            params, velocity, loss, acc = step(
+                params, velocity, batch, jax.random.fold_in(step_key, i))
             jax.block_until_ready(loss)
             t2 = time.perf_counter()
             wait_s += t1 - t0

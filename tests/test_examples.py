@@ -122,5 +122,6 @@ def test_imagenet_example_runs(tmp_path):
     url = f"file://{tmp_path}/imgnet"
     write_synthetic_imagenet(url, rows=128, classes=2, rows_per_row_group=32,
                              image_size=48)
-    stall, sps = ex.train(url, steps=10, per_device_batch=4, classes=2)
+    stall, sps = ex.train(url, steps=10, per_device_batch=4, classes=2,
+                          learning_rate=0.005)
     assert sps > 0
