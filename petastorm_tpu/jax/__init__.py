@@ -1,4 +1,5 @@
 """JAX consumer layer: device-staged data loading for TPU training."""
+from petastorm_tpu.jax.checkpoint import CheckpointManager  # noqa: F401
 from petastorm_tpu.jax.device_cache import DeviceCachedDataset  # noqa: F401
 from petastorm_tpu.jax.dtypes import DTypePolicy, DEFAULT_POLICY  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader, BatchedDataLoader,  # noqa: F401

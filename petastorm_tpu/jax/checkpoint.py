@@ -1,0 +1,143 @@
+"""Joint checkpointing of training state and input-pipeline position.
+
+The reference has no input checkpointing at all (its ``reset()`` is
+epoch-end-only, reference reader.py:503); this module pairs
+``Reader.state_dict()`` with `orbax <https://github.com/google/orbax>`_ so a
+training job saves model/optimizer pytrees and the reader cursor in ONE
+step directory and resumes both mid-epoch::
+
+    mgr = CheckpointManager("/ckpt", max_to_keep=3)
+    mgr.save(step, {"params": params, "opt": opt_state}, reader=reader)
+    ...
+    restored, input_state = mgr.restore(abstract={"params": params0, "opt": opt0})
+    reader = make_reader(url, seed=SEED, resume_state=input_state, ...)
+
+Multi-host: the train-state pytree is saved by orbax's own multi-host
+protocol (every process participates); the reader cursor is **per host**
+(each host reads a disjoint row-group shard), so it is stored keyed by
+``jax.process_index()`` and ``restore`` hands each process back its own
+cursor. Restoring on a different host count raises — the shard layout
+would not line up.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+_INPUT_STATE_FILE = "input_state.json"
+
+
+def _process_info():
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+class CheckpointManager:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager`` that adds an
+    input-state sidecar. All orbax behaviors (retention, async, atomicity of
+    the pytree write) are inherited; the sidecar is written after the pytree
+    commit, so a torn save is at worst a checkpoint whose input cursor is
+    missing — ``restore`` then returns ``None`` input state rather than a
+    wrong one."""
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None,
+                 **orbax_kwargs):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(str(directory))
+        os.makedirs(self._dir, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                               **orbax_kwargs)
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, train_state: Any, reader=None,
+             loader=None, extra_input_state: Optional[dict] = None) -> bool:
+        """Save ``train_state`` (any pytree) plus the input cursor.
+
+        ``reader`` may be a Reader (its ``state_dict()`` is taken) or a dict
+        already produced by ``state_dict()``. ``loader`` is accepted for
+        symmetry: loaders expose their underlying reader via ``_reader``.
+        """
+        import orbax.checkpoint as ocp
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(train_state))
+        self._mgr.wait_until_finished()
+        state = self._resolve_input_state(reader, loader)
+        if state is not None or extra_input_state is not None:
+            idx, count = _process_info()
+            payload = {"process_count": count,
+                       "readers": {str(idx): state} if state is not None else {},
+                       "extra": extra_input_state or {}}
+            path = self._input_state_path(step)
+            merged = payload
+            if os.path.exists(path):  # other processes' cursors
+                with open(path) as f:
+                    prior = json.load(f)
+                if prior.get("process_count") == count:
+                    prior["readers"].update(payload["readers"])
+                    prior["extra"].update(payload["extra"])
+                    merged = prior
+            tmp = f"{path}.tmp.{idx}"
+            with open(tmp, "w") as f:
+                json.dump(merged, f)
+            os.replace(tmp, path)
+        return saved
+
+    # --------------------------------------------------------------- restore
+    def restore(self, step: Optional[int] = None, abstract: Any = None):
+        """Returns ``(train_state, input_state)`` for ``step`` (default:
+        latest). ``abstract`` is the target pytree structure (concrete
+        arrays or ShapeDtypeStructs), as orbax StandardRestore expects.
+        ``input_state`` is this process's reader cursor dict (pass as
+        ``resume_state=``), or None if the checkpoint has no input sidecar.
+        """
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        args = ocp.args.StandardRestore(abstract) if abstract is not None else None
+        train_state = self._mgr.restore(step, args=args)
+        input_state = None
+        path = self._input_state_path(step)
+        if os.path.exists(path):
+            with open(path) as f:
+                payload = json.load(f)
+            idx, count = _process_info()
+            if payload.get("process_count") != count:
+                raise ValueError(
+                    f"checkpoint was saved with {payload.get('process_count')} "
+                    f"processes but this job has {count}; the per-host shard "
+                    "cursors do not transfer")
+            input_state = payload["readers"].get(str(idx))
+        return train_state, input_state
+
+    # ------------------------------------------------------------------ misc
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _input_state_path(self, step: int) -> str:
+        return os.path.join(self._dir, str(step), _INPUT_STATE_FILE)
+
+    @staticmethod
+    def _resolve_input_state(reader, loader) -> Optional[dict]:
+        if reader is None and loader is not None:
+            reader = getattr(loader, "_reader", None)
+        if reader is None:
+            return None
+        if isinstance(reader, dict):
+            return reader
+        return reader.state_dict()

@@ -132,3 +132,77 @@ def test_resume_offset_out_of_range(synthetic_dataset):
     with pytest.raises(ValueError, match="offset"):
         make_reader(synthetic_dataset.url, shuffle_row_groups=False,
                     resume_state={"epoch": 0, "offset": 999})
+
+
+# --------------------------------------------------- orbax joint checkpoint ---
+
+def test_checkpoint_manager_saves_train_and_input_state(tmp_path,
+                                                        synthetic_dataset):
+    """Model pytree and reader cursor round-trip through one orbax step dir;
+    the restored cursor resumes the stream where the saved reader stopped."""
+    import jax.numpy as jnp
+
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    state = {"w": jnp.arange(8.0), "step": jnp.asarray(7)}
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=True, seed=11, num_epochs=2) as r:
+        consumed = [next(r).id for _ in range(25)]
+        with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+            mgr.save(3, state, reader=r)
+        rest = [row.id for row in r]
+
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        restored, input_state = mgr.restore(abstract=state)
+    assert float(restored["w"].sum()) == float(state["w"].sum())
+    assert input_state is not None and "offset" in input_state
+
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=True, seed=11, num_epochs=2,
+                     resume_state=input_state) as r2:
+        resumed = [row.id for row in r2]
+    # Watermark resume may re-deliver the in-flight group but never lose
+    # rows: the uninterrupted tail must be a suffix of the resumed stream.
+    assert resumed[-len(rest):] == rest if rest else resumed == []
+    assert len(resumed) >= len(rest)
+
+
+def test_checkpoint_manager_retention_and_latest(tmp_path):
+    import jax.numpy as jnp
+
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    state = {"x": jnp.zeros(2)}
+    with CheckpointManager(str(tmp_path / "c"), max_to_keep=2) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(s, state, reader={"epoch": 0, "offset": s})
+        assert mgr.latest_step() == 3
+        assert len(mgr.all_steps()) == 2  # retention dropped step 1
+        _, inp = mgr.restore(abstract=state)
+        assert inp == {"epoch": 0, "offset": 3}
+
+
+def test_checkpoint_manager_no_reader_means_none_input(tmp_path):
+    import jax.numpy as jnp
+
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    state = {"x": jnp.zeros(2)}
+    with CheckpointManager(str(tmp_path / "c2")) as mgr:
+        mgr.save(1, state)
+        _, inp = mgr.restore(abstract=state)
+    assert inp is None
+
+
+def test_checkpoint_manager_rejects_host_count_mismatch(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    state = {"x": jnp.zeros(2)}
+    with CheckpointManager(str(tmp_path / "c3")) as mgr:
+        mgr.save(1, state, reader={"epoch": 0, "offset": 1})
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        with pytest.raises(ValueError, match="4"):
+            mgr.restore(abstract=state)
