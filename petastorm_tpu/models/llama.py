@@ -91,40 +91,70 @@ def _is_moe_layer(cfg: LlamaConfig, layer_idx: int) -> bool:
     return cfg.n_experts > 0 and layer_idx % cfg.moe_every == cfg.moe_every - 1
 
 
+def _param_pspec_tuples(cfg: LlamaConfig, model_axis):
+    """PartitionSpec entry tuples per parameter (Megatron TP layout when
+    ``model_axis`` is an axis name; all-replicated when None). Empty tuple =
+    fully replicated (norm scales, router)."""
+    m = model_axis
+    dense_layer = {
+        "attn_norm": (),
+        "wq": (None, m), "wk": (None, m),
+        "wv": (None, m), "wo": (m, None),
+        "mlp_norm": (),
+        "w1": (None, m), "w3": (None, m),
+        "w2": (m, None),
+    }
+    moe_layer = {
+        "attn_norm": (),
+        "wq": (None, m), "wk": (None, m),
+        "wv": (None, m), "wo": (m, None),
+        "mlp_norm": (),
+        "router": (),
+        # Expert parallelism: the leading expert axis is sharded over the
+        # model axis (ep shares the tp mesh axis).
+        "ew1": (m, None, None),
+        "ew3": (m, None, None),
+        "ew2": (m, None, None),
+    }
+    return {
+        "embed": (m, None),     # vocab-sharded embedding
+        "layers": [dict(moe_layer) if _is_moe_layer(cfg, li) else dict(dense_layer)
+                   for li in range(cfg.n_layers)],
+        "norm_out": (),
+        "lm_head": (None, m),
+    }
+
+
 def param_shardings(mesh, cfg: LlamaConfig, model_axis: str = "model"):
     """Megatron TP layout as a NamedSharding pytree matching init_params."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def ns(*spec):
-        return NamedSharding(mesh, P(*spec))
+    return jax.tree.map(lambda spec: NamedSharding(mesh, P(*spec)),
+                        _param_pspec_tuples(cfg, model_axis),
+                        is_leaf=lambda x: isinstance(x, tuple))
 
-    dense_layer = {
-        "attn_norm": ns(),
-        "wq": ns(None, model_axis), "wk": ns(None, model_axis),
-        "wv": ns(None, model_axis), "wo": ns(model_axis, None),
-        "mlp_norm": ns(),
-        "w1": ns(None, model_axis), "w3": ns(None, model_axis),
-        "w2": ns(model_axis, None),
-    }
-    moe_layer = {
-        "attn_norm": ns(),
-        "wq": ns(None, model_axis), "wk": ns(None, model_axis),
-        "wv": ns(None, model_axis), "wo": ns(model_axis, None),
-        "mlp_norm": ns(),
-        "router": ns(),
-        # Expert parallelism: the leading expert axis is sharded over the
-        # model axis (ep shares the tp mesh axis).
-        "ew1": ns(model_axis, None, None),
-        "ew3": ns(model_axis, None, None),
-        "ew2": ns(model_axis, None, None),
-    }
-    return {
-        "embed": ns(model_axis, None),     # vocab-sharded embedding
-        "layers": [dict(moe_layer) if _is_moe_layer(cfg, li) else dict(dense_layer)
-                   for li in range(cfg.n_layers)],
-        "norm_out": ns(),
-        "lm_head": ns(None, model_axis),
-    }
+
+def param_shardings_fsdp(mesh, cfg: LlamaConfig, data_axis: str = "data",
+                         model_axis: Optional[str] = "model"):
+    """ZeRO-3/FSDP layout: each matrix additionally sharded over the DATA
+    axis on its first TP-free dimension, so parameter (and, by propagation,
+    optimizer-state) memory scales down with the dp size; XLA/GSPMD inserts
+    the all-gathers for use and reduce-scatters for grads. Composes with
+    Megatron TP (``model_axis``) or runs pure-FSDP (``model_axis=None``).
+    Rank<2 leaves (norm scales, router biases) stay replicated — gathering
+    them would cost more than the bytes saved."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def add_data(spec: tuple):
+        specs = list(spec)
+        for i, s in enumerate(specs):
+            if s is None:
+                specs[i] = data_axis
+                break
+        return NamedSharding(mesh, P(*specs))
+
+    return jax.tree.map(add_data, _param_pspec_tuples(cfg, model_axis),
+                        is_leaf=lambda x: isinstance(x, tuple))
 
 
 def _rmsnorm(x, scale, eps):
