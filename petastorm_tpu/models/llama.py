@@ -37,6 +37,13 @@ class LlamaConfig:
     # expert parallelism sharing the TP mesh axis (the common ep=tp layout).
     n_experts: int = 0
     moe_every: int = 2
+    # "soft": dense soft-mixture (every expert on every token, no routing
+    # collectives). "switch": GShard/Switch sparse dispatch with top-k
+    # routing and per-expert capacity — with an expert sharding constraint
+    # GSPMD lowers it to all-to-alls (petastorm_tpu.parallel.moe).
+    moe_dispatch: str = "soft"
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -197,7 +204,8 @@ def _dense_causal_attention(q, k, v):
 
 
 def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
-          activation_spec=None, compute_dtype=jnp.bfloat16):
+          activation_spec=None, compute_dtype=jnp.bfloat16,
+          expert_spec=None, with_aux=False):
     """tokens: (batch, seq) int32 -> logits (batch, seq, vocab).
 
     :param attn_fn: attention callable ``(q, k, v) -> out`` on
@@ -207,10 +215,15 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
     :param activation_spec: optional ``PartitionSpec`` for (b, s, d)
         activations; applied with ``with_sharding_constraint`` so GSPMD keeps
         the intended layout between layers.
+    :param expert_spec: sharding for (E, C, d) switch-MoE expert buffers
+        (``moe_dispatch="switch"``); on the expert mesh axis it makes GSPMD
+        lower dispatch/combine to all-to-alls.
+    :param with_aux: also return the summed MoE load-balancing loss.
     """
     constrain = (lambda x: x) if activation_spec is None else \
         (lambda x: jax.lax.with_sharding_constraint(x, activation_spec))
     hd = cfg.head_dim
+    aux = jnp.zeros((), jnp.float32)
     x = params["embed"].astype(compute_dtype)[tokens]
     x = constrain(x)
     rep = cfg.n_heads // cfg.n_kv_heads
@@ -229,28 +242,42 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
         x = constrain(x + attn @ layer["wo"].astype(attn.dtype))
         h = _rmsnorm(x, layer["mlp_norm"], cfg.norm_eps)
         if "router" in layer:
-            x = constrain(x + _moe_block(h, layer))
+            if cfg.moe_dispatch == "switch":
+                from petastorm_tpu.parallel.moe import switch_moe_block
+                moe_out, layer_aux = switch_moe_block(
+                    h, layer["router"], layer["ew1"], layer["ew3"],
+                    layer["ew2"], top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    expert_spec=expert_spec)
+                aux = aux + layer_aux
+                x = constrain(x + moe_out)
+            else:
+                x = constrain(x + _moe_block(h, layer))
         else:
             gate = jax.nn.silu(h @ layer["w1"].astype(h.dtype))
             up = h @ layer["w3"].astype(h.dtype)
             x = constrain(x + (gate * up) @ layer["w2"].astype(h.dtype))
     x = _rmsnorm(x, params["norm_out"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return (logits, aux) if with_aux else logits
 
 
-def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None):
-    """Next-token cross entropy. batch: {'tokens': (b, s) int32}."""
+def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
+            expert_spec=None, aux_weight: float = 1e-2):
+    """Next-token cross entropy (+ MoE load-balancing aux for switch
+    dispatch). batch: {'tokens': (b, s) int32}."""
     tokens = batch["tokens"]
-    logits = apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
-                   activation_spec=activation_spec)
+    logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
+                        activation_spec=activation_spec,
+                        expert_spec=expert_spec, with_aux=True)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
-    return nll
+    return nll + aux_weight * aux
 
 
 def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
-                    attn_fn=None, activation_spec=None):
+                    attn_fn=None, activation_spec=None, expert_spec=None):
     """AdamW train step via optax; jit with sharded params for TP/DP/SP."""
     import optax
     tx = optax.adamw(learning_rate, weight_decay=0.1)
@@ -261,7 +288,8 @@ def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
             partial(loss_fn, cfg=cfg, attn_fn=attn_fn,
-                    activation_spec=activation_spec))(params, batch)
+                    activation_spec=activation_spec,
+                    expert_spec=expert_spec))(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

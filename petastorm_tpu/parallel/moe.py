@@ -1,0 +1,108 @@
+"""Switch/GShard-style sparse Mixture-of-Experts with capacity-based
+dispatch — the all-to-all expert-parallel pattern, expressed the TPU way.
+
+Instead of hand-written collectives, routing is encoded as dense
+dispatch/combine einsums over a ``(tokens, experts, capacity)`` mask
+(GShard's formulation): when the ``(e, c, d)`` expert buffers carry a
+sharding constraint on the expert mesh axis while tokens are sharded on the
+data axis, **GSPMD partitions the dispatch einsum into the all-to-all** that
+moves each token to its expert's shard and the combine einsum into the
+return trip. Static shapes throughout (XLA requirement): each expert
+processes exactly ``capacity`` token slots; overflow tokens are dropped
+(their residual stream passes through unchanged), underflow slots are
+zero-padded.
+
+Load balancing: :func:`switch_aux_loss` is the Switch-Transformer auxiliary
+loss ``E * sum_e f_e * p_e`` (fraction of tokens routed to e times mean
+router probability of e), minimized at the uniform distribution.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def switch_route(router_logits, top_k: int, capacity: int):
+    """Top-k routing with per-expert capacity.
+
+    :param router_logits: (n, E) float32.
+    :returns: ``(dispatch, combine, aux)`` where dispatch is (n, E, C) in
+        {0,1} (token n occupies slot c of expert e), combine is (n, E, C)
+        with the router weight in the occupied slots, and ``aux`` is the
+        load-balancing loss.
+    """
+    n, num_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)           # (n, E)
+    aux = switch_aux_loss(probs, top_k)
+
+    dispatch = jnp.zeros((n, num_experts, capacity), probs.dtype)
+    combine = jnp.zeros((n, num_experts, capacity), probs.dtype)
+    remaining = probs
+    # Slots already taken per expert by higher-priority k-rounds.
+    used = jnp.zeros((num_experts,), jnp.int32)
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)               # (n,)
+        onehot = jax.nn.one_hot(choice, num_experts, dtype=probs.dtype)
+        # Position of each token within its chosen expert this round,
+        # offset by slots used in earlier rounds.
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot       # (n, E)
+        pos = pos.sum(-1).astype(jnp.int32) + used[choice]    # (n,)
+        keep = pos < capacity
+        slot = jax.nn.one_hot(jnp.where(keep, pos, 0), capacity,
+                              dtype=probs.dtype)              # (n, C)
+        mask = onehot * keep[:, None].astype(probs.dtype)     # (n, E)
+        dispatch = dispatch + mask[:, :, None] * slot[:, None, :]
+        gate = (probs * onehot).sum(-1)                       # (n,)
+        combine = combine + (mask * gate[:, None])[:, :, None] * slot[:, None, :]
+        used = used + mask.sum(0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    return dispatch, combine, aux
+
+
+def switch_aux_loss(router_probs, top_k: int = 1):
+    """``E * sum_e f_e * p_e`` (Switch Transformer eq. 4)."""
+    num_experts = router_probs.shape[-1]
+    # f_e: fraction of tokens whose (round-1) argmax is e.
+    choice = jnp.argmax(router_probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(choice, num_experts, dtype=router_probs.dtype),
+                 axis=0)
+    p = jnp.mean(router_probs, axis=0)
+    del top_k
+    return num_experts * jnp.sum(f * p)
+
+
+def switch_moe_block(h, router_w, ew1, ew3, ew2, *, top_k: int = 1,
+                     capacity_factor: float = 1.25,
+                     expert_spec: Optional[object] = None):
+    """Sparse SwiGLU MoE over (b, s, d) activations.
+
+    :param expert_spec: optional sharding (NamedSharding or PartitionSpec)
+        for the (E, C, d) expert buffers; constraining them on the expert
+        mesh axis makes GSPMD lower dispatch/combine to all-to-alls.
+    :returns: ``(out, aux_loss)``; dropped (over-capacity) tokens contribute
+        zero here, so the caller's residual connection passes them through.
+    """
+    b, s, d = h.shape
+    num_experts = router_w.shape[-1]
+    n = b * s
+    x = h.reshape(n, d)
+    capacity = max(1, int(capacity_factor * top_k * n / num_experts))
+
+    logits = x.astype(jnp.float32) @ router_w                 # (n, E)
+    dispatch, combine, aux = switch_route(logits, top_k, capacity)
+    dispatch = dispatch.astype(h.dtype)
+    combine = combine.astype(h.dtype)
+
+    constrain = (lambda t: t) if expert_spec is None else \
+        (lambda t: jax.lax.with_sharding_constraint(t, expert_spec))
+
+    expert_in = constrain(jnp.einsum("nec,nd->ecd", dispatch, x))
+    gate = jax.nn.silu(jnp.einsum("ecd,edh->ech", expert_in,
+                                  ew1.astype(h.dtype)))
+    up = jnp.einsum("ecd,edh->ech", expert_in, ew3.astype(h.dtype))
+    expert_out = constrain(jnp.einsum("ech,ehd->ecd", gate * up,
+                                      ew2.astype(h.dtype)))
+    out = jnp.einsum("ecd,nec->nd", expert_out, combine)
+    return out.reshape(b, s, d), aux

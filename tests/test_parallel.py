@@ -290,3 +290,111 @@ def test_llama_fsdp_actually_shards_matrices():
     wq = placed["layers"][0]["wq"]
     shard_elems = wq.addressable_shards[0].data.size
     assert shard_elems * 8 == wq.size
+
+
+# ------------------------------------------------------------- switch MoE ---
+
+def test_switch_route_invariants():
+    """Every kept token occupies exactly one slot; no expert exceeds
+    capacity; gate weights are the router probabilities."""
+    from petastorm_tpu.parallel import moe
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(40, 4)), jnp.float32)
+    dispatch, combine, aux = moe.switch_route(logits, top_k=1, capacity=8)
+    assert dispatch.shape == (40, 4, 8)
+    per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+    assert set(per_token.tolist()) <= {0.0, 1.0}
+    per_slot = np.asarray(dispatch.sum(axis=0))
+    assert (per_slot <= 1.0 + 1e-6).all()  # one token per slot
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    got = np.asarray(combine.sum(axis=(1, 2)))
+    want = probs.max(-1) * per_token  # kept tokens carry their router prob
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_switch_route_capacity_drops_overflow():
+    from petastorm_tpu.parallel import moe
+    # all 10 tokens prefer expert 0; capacity 3 keeps exactly 3
+    logits = jnp.tile(jnp.asarray([[5.0, 0.0]], jnp.float32), (10, 1))
+    dispatch, _, _ = moe.switch_route(logits, top_k=1, capacity=3)
+    assert float(dispatch[:, 0].sum()) == 3.0
+    assert float(dispatch[:, 1].sum()) == 0.0
+
+
+def test_switch_route_top2_uses_second_expert():
+    from petastorm_tpu.parallel import moe
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    d1, _, _ = moe.switch_route(logits, top_k=1, capacity=16)
+    d2, _, _ = moe.switch_route(logits, top_k=2, capacity=16)
+    assert float(d2.sum()) == pytest.approx(2 * float(d1.sum()))
+
+
+def test_switch_moe_block_matches_manual_dense_compute():
+    """With capacity >= tokens and top_k=E, the sparse block must equal the
+    soft-mixture computed densely with the same router probabilities
+    normalized per chosen expert — check via top_k=1 against a manual
+    single-expert evaluation."""
+    from petastorm_tpu.parallel import moe
+    rng = np.random.default_rng(2)
+    b, s, d, hid, E = 2, 6, 8, 16, 2
+    h = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(d, E)), jnp.float32)
+    ew1 = jnp.asarray(rng.normal(size=(E, d, hid)) / np.sqrt(d), jnp.float32)
+    ew3 = jnp.asarray(rng.normal(size=(E, d, hid)) / np.sqrt(d), jnp.float32)
+    ew2 = jnp.asarray(rng.normal(size=(E, hid, d)) / np.sqrt(hid), jnp.float32)
+    out, aux = moe.switch_moe_block(h, router, ew1, ew3, ew2, top_k=1,
+                                    capacity_factor=10.0)  # nothing dropped
+    x = h.reshape(-1, d)
+    probs = jax.nn.softmax(x @ router, -1)
+    choice = np.asarray(jnp.argmax(probs, -1))
+    manual = np.zeros((b * s, d), np.float32)
+    for i in range(b * s):
+        e = int(choice[i])
+        gate = np.asarray(jax.nn.silu(x[i] @ ew1[e]))
+        up = np.asarray(x[i] @ ew3[e])
+        manual[i] = (gate * up) @ np.asarray(ew2[e]) * float(probs[i, e])
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, d), manual,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_llama_switch_moe_trains_sharded():
+    """A switch-MoE Llama train step runs under dp x model mesh with the
+    expert buffers constrained to the model axis; loss is finite and the
+    aux term contributes."""
+    from petastorm_tpu.models import llama
+    cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=4, hidden=64, n_experts=4,
+                            moe_every=2, moe_dispatch="switch",
+                            moe_top_k=2, moe_capacity_factor=2.0)
+    mesh = make_mesh((2, 4), ("data", "model"))
+    params = jax.device_put(llama.init_params(jax.random.PRNGKey(0), cfg),
+                            llama.param_shardings(mesh, cfg))
+    act = NamedSharding(mesh, P("data", None, None))
+    expert_spec = NamedSharding(mesh, P("model", None, None))
+    init_opt, train_step = llama.make_train_step(
+        cfg, attn_fn=None, activation_spec=act, expert_spec=expert_spec)
+    opt_state = init_opt(params)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab, (4, 17)),
+                         jnp.int32)
+    batch = {"tokens": jax.device_put(tokens,
+                                      NamedSharding(mesh, P("data", None)))}
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    params, opt_state, loss = step(params, opt_state, batch)
+    params, opt_state, loss2 = step(params, opt_state, batch)
+    assert np.isfinite(float(loss)) and np.isfinite(float(loss2))
+    assert float(loss2) < float(loss)  # it optimizes
+
+
+def test_llama_switch_vs_soft_dispatch_both_supported():
+    from petastorm_tpu.models import llama
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 9)), jnp.int32)
+    for dispatch in ("soft", "switch"):
+        cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                                n_kv_heads=4, hidden=64, n_experts=2,
+                                moe_every=2, moe_dispatch=dispatch)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        loss = float(llama.loss_fn(params, {"tokens": tokens}, cfg=cfg))
+        assert np.isfinite(loss)
