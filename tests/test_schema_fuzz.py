@@ -85,3 +85,43 @@ def test_random_schema_roundtrip(tmp_path, case_seed):
         got = got_rows[i]
         for fname, field in schema.fields.items():
             _assert_value_equal(getattr(got, fname), want[fname], field)
+
+
+@pytest.mark.parametrize("case_seed", range(3))
+def test_random_scalar_schema_batch_roundtrip(tmp_path, case_seed):
+    """Columnar path fuzz: scalar-only random schemas through
+    make_batch_reader; values must round-trip per row id."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    rng = np.random.default_rng(2000 + case_seed)
+    fields = [UnischemaField("row_id", np.int64, (), ScalarCodec(np.int64),
+                             False)]
+    for i in range(int(rng.integers(2, 6))):
+        dtype = rng.choice([np.int32, np.int64, np.float32, np.float64])
+        fields.append(UnischemaField(f"s{i}", dtype, (), ScalarCodec(dtype),
+                                     False))
+    schema = Unischema(f"BatchFuzz{case_seed}", fields)
+    rows = [random_row_for_schema(schema, rng) for _ in range(31)]
+    for i, row in enumerate(rows):
+        row["row_id"] = np.int64(i)
+    url = f"file://{tmp_path}/bfuzz{case_seed}"
+    with materialize_dataset_local(url, schema, rows_per_row_group=8) as w:
+        for row in rows:
+            w.write_row(row)
+
+    got = {}
+    with make_batch_reader(url, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1) as reader:
+        for batch in reader:
+            ids = np.asarray(batch.row_id)
+            for f in schema.fields:
+                col = np.asarray(getattr(batch, f))
+                for rid, v in zip(ids, col):
+                    got.setdefault(int(rid), {})[f] = v
+    assert len(got) == len(rows)
+    for i, want in enumerate(rows):
+        for f, field in schema.fields.items():
+            if np.dtype(field.numpy_dtype).kind == "f":
+                assert got[i][f] == pytest.approx(want[f]), f
+            else:
+                assert got[i][f] == want[f], f
