@@ -41,7 +41,8 @@ def reader_throughput(dataset_url: str,
                       shuffling_queue_size: int = 500,
                       min_after_dequeue: int = 400,
                       read_method: str = "python",
-                      device_step_ms: Optional[float] = None) -> BenchmarkResult:
+                      device_step_ms: Optional[float] = None,
+                      reader_extra_kwargs: Optional[dict] = None) -> BenchmarkResult:
     """Measure samples/sec of ``make_reader`` on ``dataset_url``.
 
     ``read_method='python'`` iterates raw reader rows;
@@ -63,7 +64,8 @@ def reader_throughput(dataset_url: str,
                      reader_pool_type=pool_type,
                      workers_count=loaders_count,
                      num_epochs=None,
-                     shuffle_row_groups=True) as reader:
+                     shuffle_row_groups=True,
+                     **(reader_extra_kwargs or {})) as reader:
         if read_method in ("python", "tf"):
             if read_method == "tf":
                 from petastorm_tpu.tf_utils import make_petastorm_dataset

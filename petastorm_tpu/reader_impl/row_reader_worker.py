@@ -93,7 +93,11 @@ def _read_row_group_with_retry(files: "_ParquetFileLRU", rowgroup, columns):
             file_columns = [c for c in sorted(columns) if c in names]
             # Workers ARE the parallelism unit: arrow's own thread pool only
             # adds oversubscription on top of N decode workers.
-            return pf.read_row_group(rowgroup.row_group, columns=file_columns,
+            ids = rowgroup.row_group
+            if isinstance(ids, tuple):  # coalesced work item: one IO call
+                return pf.read_row_groups(list(ids), columns=file_columns,
+                                          use_threads=False)
+            return pf.read_row_group(ids, columns=file_columns,
                                      use_threads=False)
         except (FileNotFoundError, PermissionError):
             raise
