@@ -25,9 +25,6 @@ import json
 import os
 from typing import Any, Optional
 
-_INPUT_STATE_FILE = "input_state.json"
-
-
 def _process_info():
     import jax
     return jax.process_index(), jax.process_count()
@@ -63,23 +60,17 @@ class CheckpointManager:
         saved = self._mgr.save(step, args=ocp.args.StandardSave(train_state))
         self._mgr.wait_until_finished()
         state = self._resolve_input_state(reader, loader)
-        if state is not None or extra_input_state is not None:
+        if saved and (state is not None or extra_input_state is not None):
+            # One sidecar file PER PROCESS — no read-modify-write on a
+            # shared file, so concurrent multi-host saves cannot drop each
+            # other's cursors.
             idx, count = _process_info()
-            payload = {"process_count": count,
-                       "readers": {str(idx): state} if state is not None else {},
+            payload = {"process_count": count, "state": state,
                        "extra": extra_input_state or {}}
-            path = self._input_state_path(step)
-            merged = payload
-            if os.path.exists(path):  # other processes' cursors
-                with open(path) as f:
-                    prior = json.load(f)
-                if prior.get("process_count") == count:
-                    prior["readers"].update(payload["readers"])
-                    prior["extra"].update(payload["extra"])
-                    merged = prior
-            tmp = f"{path}.tmp.{idx}"
+            path = self._input_state_path(step, idx)
+            tmp = f"{path}.tmp"
             with open(tmp, "w") as f:
-                json.dump(merged, f)
+                json.dump(payload, f)
             os.replace(tmp, path)
         return saved
 
@@ -99,17 +90,22 @@ class CheckpointManager:
         args = ocp.args.StandardRestore(abstract) if abstract is not None else None
         train_state = self._mgr.restore(step, args=args)
         input_state = None
-        path = self._input_state_path(step)
-        if os.path.exists(path):
-            with open(path) as f:
+        idx, count = _process_info()
+        own_path = self._input_state_path(step, idx)
+        # Validate host count against any present sidecar (own, else process
+        # 0's — catches e.g. saved-by-1/restored-by-4 on every process).
+        check_path = own_path if os.path.exists(own_path) \
+            else self._input_state_path(step, 0)
+        if os.path.exists(check_path):
+            with open(check_path) as f:
                 payload = json.load(f)
-            idx, count = _process_info()
             if payload.get("process_count") != count:
                 raise ValueError(
                     f"checkpoint was saved with {payload.get('process_count')} "
                     f"processes but this job has {count}; the per-host shard "
                     "cursors do not transfer")
-            input_state = payload["readers"].get(str(idx))
+            if check_path == own_path:
+                input_state = payload.get("state")
         return train_state, input_state
 
     # ------------------------------------------------------------------ misc
@@ -129,8 +125,9 @@ class CheckpointManager:
         self.close()
         return False
 
-    def _input_state_path(self, step: int) -> str:
-        return os.path.join(self._dir, str(step), _INPUT_STATE_FILE)
+    def _input_state_path(self, step: int, process_index: int) -> str:
+        return os.path.join(self._dir, str(step),
+                            f"input_state.{process_index}.json")
 
     @staticmethod
     def _resolve_input_state(reader, loader) -> Optional[dict]:

@@ -393,11 +393,20 @@ class Reader:
                 raise ValueError(
                     "Exact resume requires a seed when shuffle_row_groups is on "
                     "(the epoch permutation must be reproducible)")
+            saved_items = resume_state.get("items")
+            if saved_items is not None and int(saved_items) != len(items):
+                raise ValueError(
+                    f"resume_state was saved over {saved_items} work items but "
+                    f"this reader plans {len(items)} — the offset would point "
+                    "at different data. Resume with the same dataset, filters, "
+                    "sharding, shuffle_row_drop_partitions and "
+                    "rowgroup_coalescing as the saved run.")
             start_epoch = int(resume_state.get("epoch", 0))
             start_offset = int(resume_state.get("offset", 0))
             if start_offset >= len(items):
                 raise ValueError(f"resume offset {start_offset} >= {len(items)} work items "
                                  "(did the dataset or its filtering change?)")
+        self._num_items = len(items)
         self._ventilator = ConcurrentVentilator(
             self._pool.ventilate, items,
             iterations=num_epochs,
@@ -497,7 +506,11 @@ class Reader:
         resume — bounded duplication, never loss. The reference has no
         resume at all (its reset() is epoch-end only, reader.py:503)."""
         s = self._ventilator.state
-        return {"epoch": s["epoch"], "offset": s["offset"]}
+        return {"epoch": s["epoch"], "offset": s["offset"],
+                # Work-item count: lets resume reject a plan whose offsets
+                # mean different data (changed filters, sharding,
+                # shuffle_row_drop_partitions, or rowgroup_coalescing).
+                "items": self._num_items}
 
     def reset(self):
         """Start another pass. Only legal after the current pass finished

@@ -206,3 +206,61 @@ def test_checkpoint_manager_rejects_host_count_mismatch(tmp_path, monkeypatch):
         monkeypatch.setattr(jax, "process_count", lambda: 4)
         with pytest.raises(ValueError, match="4"):
             mgr.restore(abstract=state)
+
+
+def test_resume_rejects_changed_item_count(synthetic_dataset):
+    """state_dict embeds the work-item count; resuming under a plan with a
+    different item count (e.g. different rowgroup_coalescing) is rejected
+    instead of silently repositioning the stream."""
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=False, num_epochs=2) as r:
+        next(r)
+        state = r.state_dict()
+    assert state["items"] == 10
+    with pytest.raises(ValueError, match="work items"):
+        make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                    shuffle_row_groups=False, num_epochs=2,
+                    rowgroup_coalescing=4, resume_state=state)
+
+
+def test_checkpoint_sidecar_is_per_process(tmp_path, monkeypatch):
+    """Each process writes its own sidecar file (no shared read-modify-write)
+    and restore hands back only this process's cursor."""
+    import jax.numpy as jnp
+
+    import petastorm_tpu.jax.checkpoint as ckpt_mod
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    state = {"x": jnp.zeros(2)}
+    with CheckpointManager(str(tmp_path / "c4")) as mgr:
+        monkeypatch.setattr(ckpt_mod, "_process_info", lambda: (0, 2))
+        mgr.save(1, state, reader={"epoch": 0, "offset": 3})
+        # simulate host 1 writing its own cursor concurrently
+        import json as json_mod
+        p1 = tmp_path / "c4" / "1" / "input_state.1.json"
+        p1.write_text(json_mod.dumps({"process_count": 2,
+                                      "state": {"epoch": 0, "offset": 7},
+                                      "extra": {}}))
+        monkeypatch.setattr(ckpt_mod, "_process_info", lambda: (1, 2))
+        _, inp1 = mgr.restore(abstract=state)
+        assert inp1 == {"epoch": 0, "offset": 7}
+        monkeypatch.setattr(ckpt_mod, "_process_info", lambda: (0, 2))
+        _, inp0 = mgr.restore(abstract=state)
+        assert inp0 == {"epoch": 0, "offset": 3}
+
+
+def test_checkpoint_host_count_mismatch_detected_without_own_file(tmp_path,
+                                                                  monkeypatch):
+    """A process with no sidecar of its own still detects a host-count
+    change via process 0's file — and never inherits its cursor."""
+    import jax.numpy as jnp
+
+    import petastorm_tpu.jax.checkpoint as ckpt_mod
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    state = {"x": jnp.zeros(2)}
+    with CheckpointManager(str(tmp_path / "c5")) as mgr:
+        mgr.save(1, state, reader={"epoch": 0, "offset": 2})  # 1 process
+        monkeypatch.setattr(ckpt_mod, "_process_info", lambda: (3, 4))
+        with pytest.raises(ValueError, match="4"):
+            mgr.restore(abstract=state)
