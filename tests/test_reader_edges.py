@@ -264,3 +264,14 @@ def test_rowgroup_coalescing_coalescer_unit():
     out1 = _coalesce_row_groups(refs, 10)
     assert [(o.path, o.row_group) for o in out1] == [
         ("a", (0, 1, 2)), ("b", 0), ("a", 3)]
+
+
+def test_rowgroup_coalescing_through_process_pool(synthetic_dataset):
+    """Coalesced (larger) payloads stream intact through the shm-ring
+    process pool, exercising the chunked-frame path for big items."""
+    from petastorm_tpu.reader import make_reader
+    with make_reader(synthetic_dataset.url, reader_pool_type="process",
+                     workers_count=2, shuffle_row_groups=False, num_epochs=1,
+                     rowgroup_coalescing=2) as r:
+        ids = sorted(row.id for row in r)
+    assert ids == sorted(row["id"] for row in synthetic_dataset.rows)
