@@ -63,6 +63,19 @@ class WeightedSamplingReader:
                 r.reset()
         self.last_row_consumed = False
 
+    def state_dict(self) -> dict:
+        """Composite checkpoint: each member reader's cursor (in order).
+        The mixing RNG is not captured — a resumed mix re-draws reader
+        picks, but every member stream continues from its own watermark
+        (no row loss, bounded duplication, same as Reader.state_dict)."""
+        return {"readers": [r.state_dict() for r in self._readers]}
+
+    @staticmethod
+    def resume_states(state: dict) -> List[dict]:
+        """Split a :meth:`state_dict` back into per-member ``resume_state``
+        dicts (pass each to the matching ``make_reader`` call)."""
+        return list(state["readers"])
+
     def stop(self):
         for r in self._readers:
             r.stop()

@@ -174,3 +174,39 @@ def test_ngram_fields_dict_key_order_irrelevant(dense_seq):
     assert set(w.keys()) == {0, 1}
     assert set(w[0]._fields) == {"ts", "value"}
     assert set(w[1]._fields) == {"label"}
+
+
+def test_ngram_windows_span_coalesced_groups(tmp_path):
+    """With rowgroup_coalescing, NGram windows may cross the ORIGINAL group
+    boundaries inside one coalesced work item (documented semantics: more
+    windows, same per-item assembly)."""
+    import numpy as np
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema("T", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("v", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    url = f"file://{tmp_path}/ts"
+    with materialize_dataset_local(url, schema, rows_per_row_group=4) as w:
+        for i in range(16):  # one file, 4 groups of 4
+            w.write_row({"ts": i, "v": np.int32(i * 10)})
+
+    ngram = NGram({0: ["ts", "v"], 1: ["v"]}, delta_threshold=1,
+                  timestamp_field="ts")
+
+    def count_windows(coalescing):
+        with make_reader(url, schema_fields=ngram, reader_pool_type="dummy",
+                         shuffle_row_groups=False, num_epochs=1,
+                         rowgroup_coalescing=coalescing) as r:
+            return sum(1 for _ in r)
+
+    per_group = count_windows(1)      # 3 windows per 4-row group x 4 groups
+    coalesced = count_windows(4)      # 15 windows over the merged 16 rows
+    assert per_group == 12
+    assert coalesced == 15

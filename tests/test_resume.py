@@ -264,3 +264,28 @@ def test_checkpoint_host_count_mismatch_detected_without_own_file(tmp_path,
         monkeypatch.setattr(ckpt_mod, "_process_info", lambda: (3, 4))
         with pytest.raises(ValueError, match="4"):
             mgr.restore(abstract=state)
+
+
+def test_weighted_sampling_reader_composite_state(synthetic_dataset):
+    """WeightedSamplingReader.state_dict captures each member's cursor and
+    resume_states splits them back for per-member resume."""
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    r1 = make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=False, num_epochs=2)
+    r2 = make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=False, num_epochs=2)
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0) as mix:
+        for _ in range(30):
+            next(mix)
+        state = mix.state_dict()
+    parts = WeightedSamplingReader.resume_states(state)
+    assert len(parts) == 2
+    for part in parts:
+        assert {"epoch", "offset", "items"} <= set(part)
+    # each part is a valid resume_state for a fresh member reader
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=False, num_epochs=2,
+                     resume_state=parts[0]) as resumed:
+        rows = list(resumed)
+    assert rows  # continues, not from scratch past the end
