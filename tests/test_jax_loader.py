@@ -259,3 +259,49 @@ def test_loader_reiteration_resets_reader(synthetic_dataset):
         first = list(loader)
         second = list(loader)  # triggers reader.reset()
     assert len(first) == len(second) == 2
+
+
+# --------------------------------------------------- staging-thread hygiene ---
+
+def test_staging_thread_no_leak_across_epochs(synthetic_dataset):
+    """Every __iter__ spawns one staging thread; full and broken iterations
+    must both leave no live petastorm staging threads behind."""
+    import threading
+
+    from petastorm_tpu.reader import make_reader
+
+    def staging_threads():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("petastorm-tpu-stage") and t.is_alive()]
+
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     schema_fields=["id"], shuffle_row_groups=False,
+                     num_epochs=None) as r:
+        loader = DataLoader(r, batch_size=10)
+        it = iter(loader)
+        for _ in range(3):
+            next(it)
+        it.close()  # abandon mid-iteration (generator close path)
+        assert staging_threads() == []
+        # re-iteration after an early close works (fresh staging thread)
+        it2 = iter(loader)
+        batch = next(it2)
+        assert len(next(iter(batch.values()))) == 10
+        it2.close()
+        loader.close()
+    assert staging_threads() == []
+
+
+def test_inmem_loader_epochs_no_thread_leak(synthetic_dataset):
+    import threading
+
+    from petastorm_tpu.reader import make_reader
+
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     shuffle_row_groups=False, num_epochs=1) as r:
+        loader = InMemBatchedDataLoader(r, batch_size=20, num_epochs=3, seed=1)
+    n = sum(1 for _ in loader)
+    assert n == 15  # 100 rows -> 5 batches x 3 epochs
+    leftover = [t for t in threading.enumerate()
+                if t.name.startswith("petastorm-tpu-stage") and t.is_alive()]
+    assert leftover == []
