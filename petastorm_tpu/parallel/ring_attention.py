@@ -65,12 +65,29 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         kv_index = (my_index - step_idx) % axis_size
         k_pos = kv_index * lk + jnp.arange(lk)
         if causal:
-            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
-        else:
-            bias = jnp.zeros((lq, lk), jnp.float32)
-        bias = bias[None, None]                          # (1, 1, lq, lk)
+            def compute(_):
+                bias = jnp.where(q_pos[:, None] >= k_pos[None, :],
+                                 0.0, -jnp.inf)[None, None]
+                return _block_attention(q, k_blk, v_blk, bias)
 
-        o_blk, m_blk, l_blk = _block_attention(q, k_blk, v_blk, bias)
+            def skip(_):
+                return (jnp.zeros((b, lq, h, d), jnp.float32),
+                        jnp.full((b, h, lq), -jnp.inf, jnp.float32),
+                        jnp.zeros((b, h, lq), jnp.float32))
+
+            # Block-level causal skip: when the whole K/V block is in the
+            # future of every local q row, skip the matmuls entirely (the
+            # -inf/0 stats merge to a no-op below). Per-device divergent
+            # control flow is legal here — no collectives inside the
+            # branches (ppermute stays outside) — and it halves the causal
+            # ring's FLOPs on average: device i computes i+1 of the
+            # axis_size steps.
+            fully_masked = kv_index * lk > my_index * lq + (lq - 1)
+            o_blk, m_blk, l_blk = jax.lax.cond(fully_masked, skip, compute,
+                                               None)
+        else:
+            bias = jnp.zeros((1, 1, lq, lk), jnp.float32)
+            o_blk, m_blk, l_blk = _block_attention(q, k_blk, v_blk, bias)
         # Online-softmax merge of the running and new block statistics.
         m_new = jnp.maximum(m_acc, m_blk)
         # Guard fully-masked blocks: exp(-inf - -inf) -> use finite fallback.
