@@ -227,6 +227,11 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
     x = params["embed"].astype(compute_dtype)[tokens]
     x = constrain(x)
     rep = cfg.n_heads // cfg.n_kv_heads
+    # Built-in attentions (dense/ring/ulysses) handle grouped-query K/V
+    # natively — K/V stay at n_kv_heads width (rep x less ring/all-to-all
+    # traffic). Only user-supplied attentions without the flag get the
+    # repeated layout for backward compatibility.
+    gqa_native = attn_fn is None or getattr(attn_fn, "supports_gqa", False)
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["attn_norm"], cfg.norm_eps)
         b, s, _ = h.shape
@@ -234,9 +239,9 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
         k = (h @ layer["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
         v = (h @ layer["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
         q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-        # Grouped-query: expand kv heads to full head count.
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        if not gqa_native and rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         attn = (attn_fn or _dense_causal_attention)(q, k, v)
         attn = attn.reshape(b, s, cfg.n_heads * hd)
         x = constrain(x + attn @ layer["wo"].astype(attn.dtype))

@@ -9,16 +9,30 @@ import jax.numpy as jnp
 
 
 def dense_attention(q, k, v, causal: bool = False):
-    """Softmax attention on full tensors; q/k/v are (b, seq, heads, dim).
+    """Softmax attention on full tensors; q is (b, seq, heads, dim) and
+    k/v are (b, seq, kv_heads, dim) with ``heads % kv_heads == 0`` —
+    grouped-query attention runs natively (each K/V head serves
+    ``heads/kv_heads`` query heads via einsum broadcasting, no repeat).
 
     Scores accumulate in float32 regardless of input dtype; the causal mask
     is position-based so it also holds for lq != lk."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    b, lq, h, d = q.shape
+    kv_h = k.shape[2]
+    if h == kv_h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    else:
+        if h % kv_h:
+            raise ValueError(f"heads ({h}) must be a multiple of kv_heads ({kv_h})")
+        qg = q.reshape(b, lq, kv_h, h // kv_h, d)
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(jnp.float32)
+        scores = scores.reshape(b, h, lq, k.shape[1])
     scores = scores / jnp.sqrt(jnp.float32(d))
     if causal:
-        lq, lk = q.shape[1], k.shape[1]
+        lk = k.shape[1]
         mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    if h == kv_h:
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    wg = w.reshape(b, kv_h, h // kv_h, lq, k.shape[1])
+    return jnp.einsum("bgrqk,bkgd->bqgrd", wg, v).reshape(b, lq, h, d)

@@ -30,10 +30,18 @@ import jax.numpy as jnp
 def _block_attention(q, k, v, bias):
     """One (q-block, kv-block) pair -> (unnormalized out, row max, row sumexp).
 
-    q: (b, lq, h, d); k/v: (b, lk, h, d); bias: broadcastable to (b, h, lq, lk).
+    q: (b, lq, h, d); k/v: (b, lk, kv_h, d) with ``h % kv_h == 0`` (GQA runs
+    natively — K/V blocks rotate at kv_h width, ``h/kv_h``x less ring
+    traffic than repeating); bias broadcastable to (b, h, lq, lk).
     """
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    b, lq, h, d = q.shape
+    lk, kv_h = k.shape[1], k.shape[2]
+    if h == kv_h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    else:
+        qg = q.reshape(b, lq, kv_h, h // kv_h, d)
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(jnp.float32)
+        scores = scores.reshape(b, h, lq, lk)
     scores = scores / jnp.sqrt(jnp.float32(d)) + bias
     m = jnp.max(scores, axis=-1)                        # (b, h, lq)
     # A fully-masked block has m = -inf; subtracting it from -inf scores
@@ -41,8 +49,12 @@ def _block_attention(q, k, v, bias):
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
     p = jnp.exp(scores - m_safe[..., None])             # (b, h, lq, lk)
     l = jnp.sum(p, axis=-1)                             # (b, h, lq)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
-    return o, m, l
+    if h == kv_h:
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    else:
+        pg = p.astype(v.dtype).reshape(b, kv_h, h // kv_h, lq, lk)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", pg, v).reshape(b, lq, h, d)
+    return o.astype(jnp.float32), m, l
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
@@ -55,6 +67,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     my_index = jax.lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    if h % k.shape[2]:
+        raise ValueError(f"heads ({h}) must be a multiple of kv_heads "
+                         f"({k.shape[2]})")
 
     # Global positions of the local q rows.
     q_pos = my_index * lq + jnp.arange(lq)
@@ -127,5 +142,13 @@ def make_ring_attention(mesh, seq_axis: str = "seq", data_axis: str = "data",
 
     spec = P(data_axis, seq_axis, head_axis, None)
     fn = partial(ring_attention, axis_name=seq_axis, causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+
+    def attn(q, k, v):
+        return mapped(q, k, v)
+
+    # K/V may arrive at kv_heads < heads; the ring rotates them at native
+    # width (model code can skip the repeat -> heads/kv_heads x less ICI).
+    attn.supports_gqa = True
+    return attn

@@ -40,12 +40,12 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
     ``heads`` must be divisible by the ``axis_name`` axis size.
     """
     p = jax.lax.axis_size(axis_name)
-    h = q.shape[2]
-    if h % p:
+    h, kv_h = q.shape[2], k.shape[2]
+    if h % p or kv_h % p:
         raise ValueError(
-            f"Ulysses sequence parallelism needs heads ({h}) divisible by "
-            f"the '{axis_name}' axis size ({p}); shard heads on the model "
-            f"axis first or use ring attention")
+            f"Ulysses sequence parallelism needs heads ({h}) and kv_heads "
+            f"({kv_h}) divisible by the '{axis_name}' axis size ({p}); "
+            f"shard heads on the model axis first or use ring attention")
 
     def seq_to_head(x):
         # (b, l, h, d) -> (b, l*p, h/p, d): split heads across peers,
@@ -79,5 +79,13 @@ def make_ulysses_attention(mesh, seq_axis: str = "seq",
 
     spec = P(data_axis, seq_axis, head_axis, None)
     fn = partial(ulysses_attention, axis_name=seq_axis, causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+
+    def attn(q, k, v):
+        return mapped(q, k, v)
+
+    # K/V exchange at native kv_heads width (GQA); the local dense step
+    # groups query heads over them, heads/kv_heads x less all-to-all bytes.
+    attn.supports_gqa = True
+    return attn

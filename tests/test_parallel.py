@@ -398,3 +398,72 @@ def test_llama_switch_vs_soft_dispatch_both_supported():
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         loss = float(llama.loss_fn(params, {"tokens": tokens}, cfg=cfg))
         assert np.isfinite(loss)
+
+
+# ----------------------------------------------------------- GQA-native SP ---
+
+def _repeat_ref(q, k, v, causal):
+    rep = q.shape[2] // k.shape[2]
+    return _dense_attn(q, jnp.repeat(k, rep, axis=2),
+                       jnp.repeat(v, rep, axis=2), causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dense_attention_gqa_matches_repeat(causal):
+    from petastorm_tpu.parallel.attention import dense_attention
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(2, 16, 8, 4)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 16, 2, 4)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 16, 2, 4)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(dense_attention(q, k, v, causal=causal)),
+                               np.asarray(_repeat_ref(q, k, v, causal)),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq_shards", [2, 4])
+def test_ring_attention_gqa_matches_dense(causal, seq_shards):
+    """K/V ring at native kv_heads width is exact (and moves kv_heads/heads
+    of the bytes the repeated layout would)."""
+    mesh = make_mesh((8 // seq_shards, seq_shards), ("data", "seq"))
+    rng = np.random.default_rng(8)
+    b = 8 // seq_shards
+    q = jnp.asarray(rng.normal(size=(b, seq_shards * 8, 8, 4)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, seq_shards * 8, 4, 4)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, seq_shards * 8, 4, 4)), jnp.float32)
+    ring = jax.jit(make_ring_attention(mesh, causal=causal))
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)),
+                               np.asarray(_repeat_ref(q, k, v, causal)),
+                               atol=2e-5)
+
+
+def test_ulysses_attention_gqa_matches_dense():
+    from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
+    mesh = make_mesh((4, 2), ("data", "seq"))
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(4, 32, 8, 4)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(4, 32, 2, 4)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(4, 32, 2, 4)), jnp.float32)
+    ulysses = jax.jit(make_ulysses_attention(mesh, causal=True))
+    np.testing.assert_allclose(np.asarray(ulysses(q, k, v)),
+                               np.asarray(_repeat_ref(q, k, v, True)),
+                               atol=2e-5)
+
+
+def test_llama_gqa_loss_unchanged_by_native_path():
+    """The GQA-native path (no K/V repeat) is numerically identical to the
+    repeated layout on the default dense attention."""
+    from petastorm_tpu.models import llama
+    cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=2, n_heads=8,
+                            n_kv_heads=2, hidden=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(np.random.default_rng(10).integers(0, 64, (2, 17)),
+                         jnp.int32)
+    native = float(llama.loss_fn(params, {"tokens": tokens}, cfg=cfg))
+
+    def repeat_attn(q, k, v):  # no supports_gqa attr -> repeated layout
+        return _dense_attn(q, k, v, True)
+
+    repeated = float(llama.loss_fn(params, {"tokens": tokens}, cfg=cfg,
+                                   attn_fn=repeat_attn))
+    assert native == pytest.approx(repeated, rel=1e-5)
