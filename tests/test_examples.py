@@ -125,3 +125,14 @@ def test_imagenet_example_runs(tmp_path):
     stall, sps = ex.train(url, steps=10, per_device_batch=4, classes=2,
                           learning_rate=0.005)
     assert sps > 0
+
+
+def test_long_context_example_trains_on_mesh(tmp_path):
+    """NGram windows -> dp2 x sp4 mesh -> GQA ring attention: loss
+    decreases over a few dozen steps on the virtual 8-device mesh."""
+    ex = _load_example("long_context")
+    url = f"file://{tmp_path}/lctx"
+    ex.write_token_stream(url, n_chunks=2048, vocab=256)
+    losses = ex.train(url, steps=25, per_shard_batch=2, window=4,
+                      vocab=256, dp=2, sp=4)
+    assert losses[-1] < losses[0]
