@@ -56,6 +56,10 @@ def train(url: str, steps: int = 30, per_shard_batch: int = 2,
     from petastorm_tpu.models import llama
     from petastorm_tpu.parallel.ring_attention import make_ring_attention
 
+    assert len(jax.devices()) >= dp * sp, (
+        f"need {dp * sp} devices for a dp{dp} x sp{sp} mesh, have "
+        f"{len(jax.devices())} — run with XLA_FLAGS="
+        f"--xla_force_host_platform_device_count={dp * sp} (or shrink dp/sp)")
     devices = np.array(jax.devices()[:dp * sp]).reshape(dp, sp)
     mesh = Mesh(devices, ("data", "seq"))
     # Tokens shard on data only; the activation constraint below places the
