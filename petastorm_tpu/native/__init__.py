@@ -29,18 +29,26 @@ def _cache_dir() -> str:
     return d
 
 
-def _build_library() -> str:
-    """Compile ringbuf.cpp (cached by source mtime+size)."""
-    src_stat = os.stat(_SRC)
+def build_native_library(src: str, name: str, ldflags=()) -> str:
+    """Compile a C++ source to a shared library with g++ (no network, no
+    pip), cached under :func:`_cache_dir` keyed by source mtime+size.
+    Returns the library path. Shared by every native component."""
+    src_stat = os.stat(src)
     tag = f"{src_stat.st_mtime_ns}_{src_stat.st_size}"
-    out = os.path.join(_cache_dir(), f"libptring_{tag}.so")
+    out = os.path.join(_cache_dir(), f"lib{name}_{tag}.so")
     if os.path.exists(out):
         return out
     tmp = out + f".build{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp, "-lrt"]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp,
+           *ldflags]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, out)  # atomic for concurrent builders
     return out
+
+
+def _build_library() -> str:
+    """Compile ringbuf.cpp (cached by source mtime+size)."""
+    return build_native_library(_SRC, "ptring", ["-lrt"])
 
 
 def _load():

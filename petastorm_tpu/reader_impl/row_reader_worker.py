@@ -202,6 +202,9 @@ class RowReaderWorker(WorkerBase):
             self._needed = set(view_schema.fields.keys())
         self._decode_schema = schema.create_schema_view(
             [n for n in sorted(self._needed) if n in schema.fields])
+        # Columns whose cells all failed the strict native image decode —
+        # keep them on the per-cell path for the rest of this worker's life.
+        self._native_img_skip = set()
 
     # Lazily build per-process handles (cheap for threads, required for processes).
     def _ensure_open(self):
@@ -274,7 +277,9 @@ class RowReaderWorker(WorkerBase):
         """Column-major decode, then row assembly — one tight loop per field
         instead of a per-row schema walk (the row-path analog of the batch
         worker's vectorized conversion)."""
-        from petastorm_tpu.utils.decode import is_memoryview_safe
+        from petastorm_tpu.utils.decode import (batch_decode_images,
+                                                is_memoryview_safe,
+                                                native_image_eligible)
         cols = {}
         for name, field, codec in self._decode_schema.decode_plan:
             src = data.get(name)
@@ -282,6 +287,19 @@ class RowReaderWorker(WorkerBase):
                 continue
             dec = codec.decode
             if is_memoryview_safe(codec):
+                # Image columns: one GIL-free native call (libjpeg/libpng)
+                # decodes the whole column into independently-allocated
+                # per-row arrays (so a retained row never pins its row
+                # group's other images); falls through to the per-cell
+                # path when not applicable.
+                if (name not in self._native_img_skip
+                        and native_image_eligible(field, codec)):
+                    batched = batch_decode_images(
+                        field, codec, [src[i] for i in indices],
+                        skip_memo=self._native_img_skip)
+                    if batched is not None:
+                        cols[name] = batched
+                        continue
                 cols[name] = [None if src[i] is None else dec(field, src[i])
                               for i in indices]
             else:

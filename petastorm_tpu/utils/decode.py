@@ -31,6 +31,80 @@ def codec_safe_value(codec, value):
     return value
 
 
+def _cv2_present() -> bool:
+    global _CV2_PRESENT
+    if _CV2_PRESENT is None:
+        try:
+            import cv2  # noqa: F401
+            _CV2_PRESENT = True
+        except ImportError:
+            _CV2_PRESENT = False
+    return _CV2_PRESENT
+
+
+_CV2_PRESENT = None
+
+
+def native_image_eligible(field, codec) -> bool:
+    """True when ``field``'s image column can go through the native batch
+    decoder: exact :class:`CompressedImageCodec` (subclasses may override
+    ``decode``), uint8, fully-known 2-D shape or 3-D with 3/4 channels (the
+    only shapes whose native output matches the cv2 fallback — cv2 returns
+    2-D for grayscale, so (H, W, 1) fields stay on the Python path), native
+    library built, and cv2 importable (the strict-mode parity contract is
+    defined against cv2.IMREAD_UNCHANGED; on PIL-only hosts the fallback
+    decodes palette PNGs to index arrays, which the native path could not
+    match). Cheap enough for the worker to call per column before
+    materializing the blob list."""
+    if type(codec) is not CompressedImageCodec:
+        return False
+    shape = field.shape
+    if (field.numpy_dtype != np.uint8 or len(shape) not in (2, 3)
+            or any(d is None for d in shape)):
+        return False
+    if len(shape) == 3 and shape[2] not in (3, 4):
+        return False
+    if not _cv2_present():
+        return False
+    from petastorm_tpu.native import imgcodec
+    return imgcodec.imgcodec_available()
+
+
+def batch_decode_images(field, codec, blobs, skip_memo=None):
+    """Decode a whole image column in one native call when possible.
+
+    Returns a list of independently-allocated per-row uint8 arrays, or
+    ``None`` when the native path does not apply — unknown dims in the field
+    shape, nullable cells present, native library unavailable, or too few
+    rows to amortize the call. The native decode runs in strict-channels
+    mode, so any cell it rejects (channel mismatch vs the field shape,
+    16-bit PNG, CMYK JPEG, corrupt data) is re-decoded individually through
+    ``codec.decode`` — behavior matches the Python (cv2) path
+    cell-for-cell, including its native-channel output for odd sources.
+
+    ``skip_memo`` (optional mutable set): when EVERY cell of a batch fails
+    the strict native decode, the field name is added to it and ``None`` is
+    returned — the caller should consult the set to keep such columns
+    (e.g. grayscale JPEGs stored under an RGB field) on the per-cell path
+    instead of paying allocate-then-double-decode on every row group.
+    """
+    if not native_image_eligible(field, codec):
+        return None
+    if len(blobs) < 4 or any(b is None for b in blobs):
+        return None
+    from petastorm_tpu.native import imgcodec
+    rows, statuses = imgcodec.decode_image_batch(blobs, field.shape,
+                                                 strict=True)
+    if statuses.all():
+        if skip_memo is not None:
+            skip_memo.add(field.name)
+        return None
+    if statuses.any():
+        for i in np.flatnonzero(statuses):
+            rows[i] = codec.decode(field, blobs[i])  # memoryview-safe codec
+    return rows
+
+
 def decode_row(row: dict, schema: Unischema) -> dict:
     """Decode one storage row dict into in-memory numpy values.
 

@@ -1,0 +1,303 @@
+"""Native batch image decoder (``native/imgcodec.cpp``) tests.
+
+Covers the ctypes wrapper round-trips, per-cell fallback statuses, the
+``batch_decode_images`` column helper, and an end-to-end ``make_reader``
+read that exercises the native path inside the row worker.
+"""
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec
+from petastorm_tpu.native import imgcodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.utils.decode import batch_decode_images
+
+cv2 = pytest.importorskip("cv2")
+
+pytestmark = pytest.mark.skipif(not imgcodec.imgcodec_available(),
+                                reason="native image codec did not build")
+
+
+def _field(shape, dtype=np.uint8, codec=None):
+    return UnischemaField("image", dtype, shape,
+                          codec or CompressedImageCodec("png"), False)
+
+
+def _png(img):
+    ok, enc = cv2.imencode(".png", img[..., ::-1] if img.ndim == 3 else img)
+    assert ok
+    return enc.tobytes()
+
+
+def _jpeg(img, quality=90):
+    ok, enc = cv2.imencode(".jpg", img[..., ::-1] if img.ndim == 3 else img,
+                           [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+    assert ok
+    return enc.tobytes()
+
+
+@pytest.fixture(scope="module")
+def rgb():
+    rng = np.random.default_rng(7)
+    return cv2.GaussianBlur(
+        rng.integers(0, 255, (48, 64, 3)).astype(np.uint8), (5, 5), 2)
+
+
+def test_png_roundtrip_exact(rgb):
+    assert np.array_equal(imgcodec.decode_image(_png(rgb), rgb.shape), rgb)
+
+
+def test_jpeg_matches_cv2_decode(rgb):
+    blob = _jpeg(rgb)
+    ours = imgcodec.decode_image(blob, rgb.shape)
+    ref = cv2.cvtColor(cv2.imdecode(np.frombuffer(blob, np.uint8),
+                                    cv2.IMREAD_UNCHANGED), cv2.COLOR_BGR2RGB)
+    assert np.abs(ours.astype(int) - ref.astype(int)).max() <= 1
+
+
+def test_grayscale_png_and_jpeg():
+    gray = np.random.default_rng(3).integers(0, 255, (32, 40)).astype(np.uint8)
+    assert np.array_equal(imgcodec.decode_image(_png(gray), gray.shape), gray)
+    dec = imgcodec.decode_image(_jpeg(gray, 95), gray.shape)
+    assert dec.shape == gray.shape
+    assert np.abs(dec.astype(int) - gray.astype(int)).max() <= 12  # lossy
+
+
+def test_grayscale_jpeg_expands_to_rgb():
+    gray = np.full((16, 16), 77, np.uint8)
+    out = imgcodec.decode_image(_jpeg(gray, 100), (16, 16, 3))
+    assert out.shape == (16, 16, 3)
+    assert np.abs(out.astype(int) - 77).max() <= 3
+
+
+def test_rgba_png():
+    rng = np.random.default_rng(5)
+    rgba = rng.integers(0, 255, (20, 24, 4)).astype(np.uint8)
+    ok, enc = cv2.imencode(".png", cv2.cvtColor(rgba, cv2.COLOR_RGBA2BGRA))
+    out = imgcodec.decode_image(enc.tobytes(), rgba.shape)
+    assert np.array_equal(out, rgba)
+
+
+def test_probe(rgb):
+    assert imgcodec.probe(_png(rgb)) == (48, 64, 3)
+    assert imgcodec.probe(_jpeg(rgb)) == (48, 64, 3)
+    gray = np.zeros((8, 9), np.uint8)
+    assert imgcodec.probe(_png(gray)) == (8, 9, 1)
+    assert imgcodec.probe(b"definitely not an image") is None
+
+
+def test_dims_mismatch_raises(rgb):
+    with pytest.raises(ValueError):
+        imgcodec.decode_image(_png(rgb), (8, 8, 3))
+
+
+def test_corrupt_blob_raises(rgb):
+    blob = bytearray(_jpeg(rgb))
+    blob[30:] = b"\x00" * (len(blob) - 30)
+    with pytest.raises(ValueError):
+        imgcodec.decode_image(bytes(blob), rgb.shape)
+
+
+def test_batch_statuses_mark_bad_cells(rgb):
+    blobs = [_png(rgb), b"garbage garbage!", _png(rgb)]
+    batch, statuses = imgcodec.decode_image_batch(blobs, rgb.shape)
+    assert statuses[0] == 0 and statuses[2] == 0 and statuses[1] != 0
+    assert np.array_equal(batch[0], rgb) and np.array_equal(batch[2], rgb)
+
+
+def test_batch_memoryview_inputs(rgb):
+    blobs = [memoryview(_png(rgb)) for _ in range(6)]
+    batch, statuses = imgcodec.decode_image_batch(blobs, rgb.shape)
+    assert not statuses.any()
+    assert all(np.array_equal(b, rgb) for b in batch)
+
+
+def test_batch_multithreaded_matches(rgb):
+    blobs = [_jpeg(rgb, q) for q in (60, 70, 80, 90)] * 4
+    one, s1 = imgcodec.decode_image_batch(blobs, rgb.shape, n_threads=1)
+    four, s4 = imgcodec.decode_image_batch(blobs, rgb.shape, n_threads=4)
+    assert not s1.any() and not s4.any()
+    assert np.array_equal(one, four)
+
+
+# ------------------------------------------------- batch_decode_images seam
+def test_column_helper_decodes(rgb):
+    field = _field((48, 64, 3))
+    rows = batch_decode_images(field, field.codec, [_png(rgb)] * 5)
+    assert rows is not None and len(rows) == 5
+    assert all(np.array_equal(r, rgb) for r in rows)
+
+
+def test_column_helper_falls_back_per_cell(rgb):
+    """Cells the strict native decoder rejects must come back exactly as
+    codec.decode (cv2 IMREAD_UNCHANGED) would produce them — here an RGBA
+    PNG stored under an RGB field keeps its native 4 channels."""
+    field = _field((20, 24, 3))
+    rng = np.random.default_rng(5)
+    rgba = rng.integers(0, 255, (20, 24, 4)).astype(np.uint8)
+    ok, enc = cv2.imencode(".png", cv2.cvtColor(rgba, cv2.COLOR_RGBA2BGRA))
+    odd = enc.tobytes()
+    small = rng.integers(0, 255, (20, 24, 3)).astype(np.uint8)
+    good = _png(small)
+    rows = batch_decode_images(field, field.codec, [good, odd, good, good])
+    assert np.array_equal(rows[0], small)
+    ref = field.codec.decode(field, odd)
+    assert ref.shape == (20, 24, 4)  # cv2 keeps native channels
+    assert np.array_equal(rows[1], ref)
+
+
+def test_column_helper_gray_jpeg_under_rgb_field_matches_cv2():
+    """Grayscale JPEG under an (H,W,3) field: cv2 decodes it 2-D, so the
+    native path must NOT silently expand it to 3 channels."""
+    field = _field((16, 16, 3), codec=CompressedImageCodec("jpeg", 95))
+    gray = np.full((16, 16), 99, np.uint8)
+    blob = _jpeg(gray, 95)
+    rgbish = np.full((16, 16, 3), 50, np.uint8)
+    good = _jpeg(rgbish, 95)
+    rows = batch_decode_images(field, field.codec, [good, blob, good, good])
+    ref = field.codec.decode(field, blob)
+    assert rows[1].shape == ref.shape == (16, 16)
+    assert np.array_equal(rows[1], ref)
+
+
+def test_column_helper_trns_and_gray_alpha_match_cv2():
+    """Transparency sources cv2 expands to 4 channels (tRNS palette, tRNS
+    RGB, gray+alpha) must fall back so output matches cv2 cell-for-cell."""
+    import io
+    from PIL import Image
+
+    field = _field((8, 8, 3))
+    filler = _png(np.full((8, 8, 3), 120, np.uint8))
+
+    pal = Image.new("P", (8, 8), 0)
+    pal.putpalette([10, 20, 30] * 85 + [0] * 3)
+    buf_pal = io.BytesIO()
+    pal.save(buf_pal, format="PNG", transparency=0)
+
+    buf_rgb = io.BytesIO()
+    Image.new("RGB", (8, 8), (5, 6, 7)).save(buf_rgb, format="PNG",
+                                             transparency=(5, 6, 7))
+
+    ga = Image.fromarray(np.full((8, 8), 100, np.uint8)).convert("LA")
+    buf_ga = io.BytesIO()
+    ga.save(buf_ga, format="PNG")
+
+    for odd in (buf_pal.getvalue(), buf_rgb.getvalue(), buf_ga.getvalue()):
+        rows = batch_decode_images(field, field.codec,
+                                   [filler, odd, filler, filler])
+        ref = field.codec.decode(field, odd)
+        assert ref.shape == (8, 8, 4)  # cv2 gives BGRA->RGBA for all three
+        assert rows[1].shape == ref.shape
+        assert np.array_equal(rows[1], ref)
+
+
+def test_column_helper_plain_palette_png_matches_cv2():
+    """Palette PNG without transparency: both paths give (H, W, 3)."""
+    import io
+    from PIL import Image
+
+    field = _field((8, 8, 3))
+    buf = io.BytesIO()
+    pal = Image.new("P", (8, 8), 7)
+    pal.putpalette(list(range(255)) + [0])
+    pal.save(buf, format="PNG")
+    blob = buf.getvalue()
+    rows = batch_decode_images(field, field.codec, [blob] * 4)
+    ref = field.codec.decode(field, blob)
+    assert ref.shape == (8, 8, 3)
+    assert all(np.array_equal(r, ref) for r in rows)
+
+
+def test_rows_are_independent_allocations(rgb):
+    field = _field((48, 64, 3))
+    rows = batch_decode_images(field, field.codec, [_png(rgb)] * 5)
+    # Retaining one row must not pin a shared row-group tensor.
+    assert all(r.base is None and r.flags.owndata for r in rows)
+
+
+def test_column_helper_all_fail_memoizes_skip():
+    """A column whose every cell fails the strict decode (grayscale JPEGs
+    under an RGB field) returns None and records the field so the worker
+    stops retrying the native path for it."""
+    field = _field((16, 16, 3), codec=CompressedImageCodec("jpeg", 95))
+    gray_blobs = [_jpeg(np.full((16, 16), v, np.uint8), 95)
+                  for v in (10, 60, 110, 160)]
+    memo = set()
+    assert batch_decode_images(field, field.codec, gray_blobs,
+                               skip_memo=memo) is None
+    assert memo == {"image"}
+
+
+def test_hw1_field_stays_on_python_path():
+    """(H, W, 1) fields are ineligible: cv2 decodes grayscale 2-D, so the
+    native 3-D output would change row shapes."""
+    from petastorm_tpu.utils.decode import native_image_eligible
+    field = _field((16, 16, 1))
+    assert not native_image_eligible(field, field.codec)
+    assert batch_decode_images(
+        field, field.codec,
+        [_png(np.zeros((16, 16), np.uint8))] * 4) is None
+
+
+def test_column_helper_skips_variable_shape(rgb):
+    field = _field((None, None, 3))
+    assert batch_decode_images(field, field.codec, [_png(rgb)] * 5) is None
+
+
+def test_column_helper_skips_nullable_cells(rgb):
+    field = _field((48, 64, 3))
+    assert batch_decode_images(field, field.codec,
+                               [_png(rgb), None, _png(rgb), _png(rgb)]) is None
+
+
+def test_column_helper_skips_subclassed_codec(rgb):
+    class MyCodec(CompressedImageCodec):
+        pass
+
+    field = _field((48, 64, 3), codec=MyCodec("png"))
+    assert batch_decode_images(field, field.codec, [_png(rgb)] * 5) is None
+
+
+def test_column_helper_skips_tiny_columns(rgb):
+    field = _field((48, 64, 3))
+    assert batch_decode_images(field, field.codec, [_png(rgb)] * 2) is None
+
+
+# ---------------------------------------------------------- end to end
+def test_make_reader_uses_native_batch_path(tmp_path):
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.reader import make_reader
+
+    schema = Unischema("S", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("image", np.uint8, (24, 32, 3),
+                       CompressedImageCodec("png"), False),
+    ])
+    rng = np.random.default_rng(0)
+    expected = {}
+    url = f"file://{tmp_path}/store"
+    with materialize_dataset_local(url, schema, rows_per_row_group=10) as w:
+        for i in range(20):
+            img = rng.integers(0, 255, (24, 32, 3)).astype(np.uint8)
+            expected[i] = img
+            w.write_row({"id": np.int64(i), "image": img})
+
+    calls = []
+    orig = batch_decode_images
+
+    def spy(field, codec, blobs, **kwargs):
+        out = orig(field, codec, blobs, **kwargs)
+        calls.append(out is not None)
+        return out
+
+    import petastorm_tpu.utils.decode as dec_mod
+    from unittest import mock
+    with mock.patch.object(dec_mod, "batch_decode_images", side_effect=spy):
+        with make_reader(url, reader_pool_type="dummy") as reader:
+            seen = {int(r.id): r.image for r in reader}
+    # Called once per column per row group; only image columns decode natively.
+    assert any(calls)
+    assert len(seen) == 20
+    for i, img in expected.items():
+        assert np.array_equal(seen[i], img)
