@@ -36,8 +36,19 @@ ERR_ARGS = -5
 
 
 def _build_library() -> str:
+    from subprocess import CalledProcessError
+
     from petastorm_tpu.native import build_native_library
-    return build_native_library(_SRC, "ptimg", ["-ljpeg", "-lpng"])
+    try:
+        # libdeflate powers the PNG fast path but is optional: without it
+        # the JPEG path and the libpng PNG path must keep working.
+        return build_native_library(
+            _SRC, "ptimg", ["-DPT_HAVE_DEFLATE", "-ljpeg", "-lpng", "-ldeflate"])
+    except (CalledProcessError, OSError):
+        logger.info("libdeflate unavailable; building image codec without "
+                    "the PNG fast path")
+        return build_native_library(_SRC, "ptimg_nodeflate",
+                                    ["-ljpeg", "-lpng"])
 
 
 def _load():

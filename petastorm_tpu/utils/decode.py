@@ -31,20 +31,6 @@ def codec_safe_value(codec, value):
     return value
 
 
-def _cv2_present() -> bool:
-    global _CV2_PRESENT
-    if _CV2_PRESENT is None:
-        try:
-            import cv2  # noqa: F401
-            _CV2_PRESENT = True
-        except ImportError:
-            _CV2_PRESENT = False
-    return _CV2_PRESENT
-
-
-_CV2_PRESENT = None
-
-
 def native_image_eligible(field, codec) -> bool:
     """True when ``field``'s image column can go through the native batch
     decoder: exact :class:`CompressedImageCodec` (subclasses may override
@@ -64,10 +50,8 @@ def native_image_eligible(field, codec) -> bool:
         return False
     if len(shape) == 3 and shape[2] not in (3, 4):
         return False
-    if not _cv2_present():
-        return False
-    from petastorm_tpu.native import imgcodec
-    return imgcodec.imgcodec_available()
+    from petastorm_tpu.codecs import _native_decode_usable
+    return _native_decode_usable()
 
 
 def batch_decode_images(field, codec, blobs, skip_memo=None):

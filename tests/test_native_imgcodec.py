@@ -208,6 +208,64 @@ def test_column_helper_plain_palette_png_matches_cv2():
     assert all(np.array_equal(r, ref) for r in rows)
 
 
+def test_trns_rgb_under_rgba_field_decodes_natively():
+    """RGB PNG + tRNS requested as 4 channels: the fast path must hand off
+    to libpng (not reject), and strict mode must accept — cv2 expands tRNS
+    to alpha, so 4 channels IS the parity answer."""
+    import io
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (8, 8), (5, 6, 7)).save(buf, format="PNG",
+                                             transparency=(5, 6, 7))
+    out = imgcodec.decode_image(buf.getvalue(), (8, 8, 4), strict=True)
+    ref = cv2.cvtColor(cv2.imdecode(np.frombuffer(buf.getvalue(), np.uint8),
+                                    cv2.IMREAD_UNCHANGED), cv2.COLOR_BGRA2RGBA)
+    assert np.array_equal(out, ref)
+
+
+def test_build_falls_back_without_libdeflate(monkeypatch):
+    """If the -ldeflate link fails the codec must still build (JPEG +
+    libpng paths) rather than going dark."""
+    import subprocess
+
+    from petastorm_tpu.native import imgcodec as mod
+
+    calls = []
+    real = __import__("petastorm_tpu.native", fromlist=["build_native_library"]
+                      ).build_native_library
+
+    def flaky(src, name, ldflags=()):
+        calls.append(list(ldflags))
+        if "-ldeflate" in ldflags:
+            raise subprocess.CalledProcessError(1, "g++")
+        return real(src, name, ldflags)
+
+    import petastorm_tpu.native as native_pkg
+    monkeypatch.setattr(native_pkg, "build_native_library", flaky)
+    path = mod._build_library()
+    assert "ptimg_nodeflate" in path
+    assert calls[0] != calls[1]
+    import ctypes
+    lib = ctypes.CDLL(path)
+    assert hasattr(lib, "pt_img_decode")
+
+
+def test_threaded_batch_calls_do_not_grow_rss(rgb):
+    """The per-thread libdeflate decompressor is RAII-released at thread
+    exit; repeated threaded batch calls must not leak."""
+    import resource
+
+    blobs = [_png(rgb)] * 16
+    for _ in range(30):
+        imgcodec.decode_image_batch(blobs, rgb.shape, n_threads=4)
+    r0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    for _ in range(150):
+        imgcodec.decode_image_batch(blobs, rgb.shape, n_threads=4)
+    r1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert (r1 - r0) / 1024 < 8  # MB; a decompressor leak shows tens of MB
+
+
 def test_rows_are_independent_allocations(rgb):
     field = _field((48, 64, 3))
     rows = batch_decode_images(field, field.codec, [_png(rgb)] * 5)
