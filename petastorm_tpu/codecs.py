@@ -237,6 +237,58 @@ def _fast_npy_decode(encoded):
     return data.reshape(shape).copy()
 
 
+def _fast_npz_decode(encoded):
+    """Decode the single ``arr.npy`` member of an ``np.savez_compressed``
+    payload without the per-cell zipfile machinery (~7x faster than
+    ``np.load``): parse the zip local header, raw-inflate the deflate
+    stream, then reuse the cached-header npy fast path. Returns None when
+    the payload isn't the exact shape we write (foreign member name,
+    stored/encrypted entries, ...) — the generic loader handles those."""
+    import struct
+    import zlib
+    mv = memoryview(encoded)
+    if mv.format != "B":
+        mv = mv.cast("B")
+    if len(mv) < 30 or mv[:4] != b"PK\x03\x04":
+        return None
+    (_, _, flags, method, _, _, header_crc, _, _, nlen, elen) = \
+        struct.unpack_from("<IHHHHHIIIHH", mv, 0)
+    if flags & 0x1 or method != 8:  # encrypted / not deflate
+        return None
+    name_end = 30 + nlen
+    if bytes(mv[30:name_end]) != b"arr.npy":
+        return None
+    # np.savez streams members, so the local header carries no sizes (bit 3
+    # data descriptor); a raw decompressobj finds the stream end itself.
+    # The memoryview slice is zero-copy and zlib accepts it directly.
+    d = zlib.decompressobj(-15)
+    try:
+        npy = d.decompress(mv[name_end + elen:])
+    except zlib.error:
+        return None  # corrupt stream: np.load raises the canonical error
+    if not d.eof:
+        return None  # truncated stream: let np.load raise its own error
+    # Integrity: np.load verifies the member CRC-32 and raises BadZipFile on
+    # corruption; without this check a bit-flipped cell usually inflates to
+    # silently wrong data. With bit 3 the CRC lives in the data descriptor
+    # (optionally signed) right after the stream, else in the local header.
+    if flags & 0x8:
+        tail = d.unused_data
+        if tail[:4] == b"PK\x07\x08":
+            tail = tail[4:]
+        if len(tail) < 4:
+            return None
+        expected_crc = int.from_bytes(tail[:4], "little")
+    else:
+        expected_crc = header_crc
+    if zlib.crc32(npy) != expected_crc:
+        return None  # corrupt: np.load raises BadZipFile with the real story
+    fast = _fast_npy_decode(npy)
+    if fast is not None:
+        return fast
+    return np.load(io.BytesIO(npy), allow_pickle=False)
+
+
 class NdarrayCodec(DataframeColumnCodec):
     """Stores an ndarray as uncompressed ``.npy`` bytes (np.save round-trip).
 
@@ -281,6 +333,9 @@ class CompressedNdarrayCodec(NdarrayCodec):
         return buf.getvalue()
 
     def decode(self, unischema_field, encoded):
+        fast = _fast_npz_decode(encoded)
+        if fast is not None:
+            return fast
         with np.load(io.BytesIO(encoded), allow_pickle=False) as z:
             return z["arr"]
 
