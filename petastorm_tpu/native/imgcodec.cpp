@@ -70,29 +70,44 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 
 void jpeg_silent(j_common_ptr, int) {}
 
+// Lightweight SOF-marker scan — callers probe then decode, and a full
+// jpeg_read_header here would parse every header twice per cell.
 int jpeg_probe(const unsigned char* blob, uint64_t size, int* h, int* w, int* c) {
-  jpeg_decompress_struct cinfo;
-  JpegErr jerr;
-  cinfo.err = jpeg_std_error(&jerr.mgr);
-  jerr.mgr.error_exit = jpeg_err_exit;
-  jerr.mgr.emit_message = jpeg_silent;
-  if (setjmp(jerr.jump)) {
-    jpeg_destroy_decompress(&cinfo);
-    return PTIMG_ERR_CORRUPT;
+  uint64_t off = 2;  // past FFD8
+  while (off + 4 <= size) {
+    if (blob[off] != 0xFF) return PTIMG_ERR_CORRUPT;
+    unsigned char marker = blob[off + 1];
+    while (marker == 0xFF && off + 2 < size) {  // fill bytes
+      ++off;
+      marker = blob[off + 1];
+    }
+    // The fill skip moved off without the outer bound; re-establish it
+    // before any blob[off+2..3] read (truncated blobs ending in 0xFF
+    // padding would otherwise read past the buffer).
+    if (marker == 0xFF || off + 4 > size) return PTIMG_ERR_CORRUPT;
+    if (marker == 0xD8 || (marker >= 0xD0 && marker <= 0xD7)) {
+      off += 2;  // standalone markers carry no length
+      continue;
+    }
+    if (marker == 0xD9 || marker == 0xDA) break;  // EOI / start of scan
+    uint32_t seg_len = (uint32_t(blob[off + 2]) << 8) | blob[off + 3];
+    if (seg_len < 2 || off + 2 + seg_len > size) return PTIMG_ERR_CORRUPT;
+    bool is_sof = (marker >= 0xC0 && marker <= 0xCF) && marker != 0xC4 &&
+                  marker != 0xC8 && marker != 0xCC;
+    if (is_sof) {
+      if (seg_len < 8) return PTIMG_ERR_CORRUPT;
+      int precision = blob[off + 4];
+      if (precision != 8) return PTIMG_ERR_UNSUPPORTED;
+      *h = (int(blob[off + 5]) << 8) | blob[off + 6];
+      *w = (int(blob[off + 7]) << 8) | blob[off + 8];
+      int comps = blob[off + 9];
+      if (comps == 1) { *c = 1; return PTIMG_OK; }
+      if (comps == 3) { *c = 3; return PTIMG_OK; }
+      return PTIMG_ERR_UNSUPPORTED;  // CMYK / YCCK
+    }
+    off += 2 + seg_len;
   }
-  jpeg_create_decompress(&cinfo);
-  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(blob), size);
-  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
-    jpeg_destroy_decompress(&cinfo);
-    return PTIMG_ERR_FORMAT;
-  }
-  *h = static_cast<int>(cinfo.image_height);
-  *w = static_cast<int>(cinfo.image_width);
-  int comps = cinfo.num_components;
-  jpeg_destroy_decompress(&cinfo);
-  if (comps == 1) { *c = 1; return PTIMG_OK; }
-  if (comps == 3) { *c = 3; return PTIMG_OK; }
-  return PTIMG_ERR_UNSUPPORTED;  // CMYK / YCCK
+  return PTIMG_ERR_FORMAT;
 }
 
 // strict_channels: require the SOURCE's native decoded channel count to

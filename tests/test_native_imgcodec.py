@@ -78,6 +78,14 @@ def test_rgba_png():
     assert np.array_equal(out, rgba)
 
 
+def test_probe_truncated_fill_bytes_do_not_overread():
+    """Truncated JPEG ending in 0xFF padding: the SOF scan must bail, not
+    read past the buffer."""
+    for blob in (b"\xff\xd8\xff\xff\xff\xc0", b"\xff\xd8\xff\xff\xff\xff",
+                 b"\xff\xd8\xff\xe0\x00", b"\xff\xd8\xff"):
+        assert imgcodec.probe(blob) is None
+
+
 def test_probe(rgb):
     assert imgcodec.probe(_png(rgb)) == (48, 64, 3)
     assert imgcodec.probe(_jpeg(rgb)) == (48, 64, 3)
