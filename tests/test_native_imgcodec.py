@@ -81,8 +81,13 @@ def test_rgba_png():
 def test_probe_truncated_fill_bytes_do_not_overread():
     """Truncated JPEG ending in 0xFF padding: the SOF scan must bail, not
     read past the buffer."""
-    for blob in (b"\xff\xd8\xff\xff\xff\xc0", b"\xff\xd8\xff\xff\xff\xff",
-                 b"\xff\xd8\xff\xe0\x00", b"\xff\xd8\xff"):
+    # All >= 8 bytes: shorter blobs are rejected by pt_img_probe's size
+    # guard before the SOF scan ever runs (a <8-byte case never exercises
+    # the fill-byte bound being regression-tested here).
+    for blob in (b"\xff\xd8\xff\xff\xff\xff\xff\xc0",
+                 b"\xff\xd8\xff\xff\xff\xff\xff\xff",
+                 b"\xff\xd8\xff\xe0\x00\xff\xff\xff",
+                 b"\xff\xd8\xff\xc0\x00\x08\x08\xff"):
         assert imgcodec.probe(blob) is None
 
 
