@@ -1,7 +1,7 @@
 """Round benchmark. Prints ONE JSON line:
 ``{"metric", "value", "unit", "vs_baseline", ...extras}``.
 
-Three configs:
+Four configs:
 
 1. **hello_world (headline, ``vs_baseline``)** — the reference's only
    published absolute number: 709.84 samples/sec on the 10-row tutorial

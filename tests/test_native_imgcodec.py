@@ -335,6 +335,37 @@ def test_column_helper_skips_tiny_columns(rgb):
 
 
 # ---------------------------------------------------------- end to end
+def test_coalesced_row_groups_with_native_decode(tmp_path):
+    """rowgroup_coalescing merges several 1-row groups into one work item,
+    which is exactly what arms the native batch path (>=4 blobs); values
+    and ids must survive the combination across pool types."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.reader import make_reader
+
+    schema = Unischema("S", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("image", np.uint8, (16, 16, 3),
+                       CompressedImageCodec("png"), False),
+    ])
+    rng = np.random.default_rng(4)
+    expected = {}
+    url = f"file://{tmp_path}/store"
+    with materialize_dataset_local(url, schema, rows_per_row_group=1) as w:
+        for i in range(12):
+            img = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+            expected[i] = img
+            w.write_row({"id": np.int64(i), "image": img})
+
+    for pool in ("dummy", "thread"):
+        with make_reader(url, reader_pool_type=pool, workers_count=2,
+                         rowgroup_coalescing=6) as reader:
+            seen = {int(r.id): r.image for r in reader}
+        assert len(seen) == 12
+        for i, img in expected.items():
+            assert np.array_equal(seen[i], img), (pool, i)
+
+
 def test_make_reader_uses_native_batch_path(tmp_path):
     from petastorm_tpu.codecs import ScalarCodec
     from petastorm_tpu.etl.writer import materialize_dataset_local
