@@ -227,13 +227,14 @@ class RowReaderWorker(WorkerBase):
         rng = item_shuffle_rng(self.args.get("seed"), shuffle_context, self._rng)
 
         if predicate is not None:
-            rows = self._load_rows_with_predicate(rowgroup, needed, predicate,
-                                                  shuffle_row_drop_partition, rng)
-            decoded = [decode_row(r, self._decode_schema) for r in rows]
+            data, indices = self._load_columns_with_predicate(
+                rowgroup, needed, predicate, shuffle_row_drop_partition, rng)
         else:
             data, indices = self._maybe_cached(rowgroup, needed,
                                                shuffle_row_drop_partition, rng)
-            decoded = self._decode_columns_to_rows(data, indices)
+        # Column-major decode on both paths, so image columns keep the
+        # native batch decoder under predicates too.
+        decoded = self._decode_columns_to_rows(data, indices)
 
         if transform_spec is not None and transform_spec.func is not None:
             decoded = [transform_spec.func(r) for r in decoded]
@@ -325,15 +326,11 @@ class RowReaderWorker(WorkerBase):
                 for name in table.column_names}
         return _inject_partition_values(data, table.num_rows, rowgroup, columns)
 
-    @staticmethod
-    def _columns_to_rows(data: dict, indices) -> List[dict]:
-        names = list(data.keys())
-        return [{n: data[n][i] for n in names} for i in indices]
-
-    def _load_rows_with_predicate(self, rowgroup, needed, predicate, drop_part,
-                                  rng) -> List[dict]:
+    def _load_columns_with_predicate(self, rowgroup, needed, predicate,
+                                     drop_part, rng):
         """Load predicate columns first; early-exit if nothing matches
-        (parity: reference :197)."""
+        (parity: reference :197). Returns ``(columns, surviving indices)``
+        so the caller can decode column-major like the no-predicate path."""
         schema = self.args["schema"]
         predicate_fields = set(predicate.get_fields())
         unknown = predicate_fields - set(schema.fields.keys()) - {
@@ -353,7 +350,7 @@ class RowReaderWorker(WorkerBase):
                                              {k: v for k, v in row.items()
                                               if k not in pred_schema.fields}))
         if not any(mask):
-            return []
+            return pred_data, []
 
         part_index, num_parts = drop_part
         indices = select_drop_partition(num_rows, part_index, num_parts,
@@ -363,9 +360,5 @@ class RowReaderWorker(WorkerBase):
         other_fields = needed - predicate_fields
         if other_fields:
             other_data = self._read_columns(rowgroup, other_fields)
-            merged = {**pred_data, **other_data}
-        else:
-            merged = pred_data
-        rows = self._columns_to_rows(merged, indices)
-        wanted = needed | predicate_fields
-        return [{k: v for k, v in r.items() if k in wanted} for r in rows]
+            return {**pred_data, **other_data}, indices
+        return pred_data, indices
