@@ -8,7 +8,7 @@ import numpy as np
 
 from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
                                   NdarrayCodec, ScalarCodec)
-from petastorm_tpu.unischema import Unischema, _default_codec
+from petastorm_tpu.unischema import Unischema
 
 # The built-in codecs accept (and never leak) memoryview cells from the
 # zero-copy read path. Exact types only: a subclass overriding decode() may
