@@ -78,6 +78,35 @@ def test_rgba_png():
     assert np.array_equal(out, rgba)
 
 
+def test_fuzz_native_matches_cv2_on_random_pngs():
+    """Seeded fuzz: random sizes/content, cv2 + PIL encoders (different
+    filter/IDAT choices) — the native strict decode must be bit-identical
+    to the cv2 reference output for every 8-bit gray/RGB PNG."""
+    import io
+    from PIL import Image
+
+    rng = np.random.default_rng(42)
+    for trial in range(30):
+        h = int(rng.integers(1, 80))
+        w = int(rng.integers(1, 80))
+        gray = bool(rng.integers(0, 2))
+        img = rng.integers(0, 256, (h, w) if gray else (h, w, 3)).astype(np.uint8)
+        if rng.integers(0, 2) and h > 4 and w > 4:
+            img = cv2.GaussianBlur(img, (5, 5), 2)  # non-None filter rows
+        if rng.integers(0, 2):
+            blob = _png(img)
+        else:
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="PNG",
+                                      compress_level=int(rng.integers(0, 10)))
+            blob = buf.getvalue()
+        dec = imgcodec.decode_image(blob, img.shape, strict=True)
+        ref = cv2.imdecode(np.frombuffer(blob, np.uint8), cv2.IMREAD_UNCHANGED)
+        if ref.ndim == 3:
+            ref = cv2.cvtColor(ref, cv2.COLOR_BGR2RGB)
+        assert np.array_equal(dec, ref), (trial, img.shape, gray)
+
+
 def test_probe_truncated_fill_bytes_do_not_overread():
     """Truncated JPEG ending in 0xFF padding: the SOF scan must bail, not
     read past the buffer."""
