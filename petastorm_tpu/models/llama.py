@@ -203,15 +203,82 @@ def _dense_causal_attention(q, k, v):
     return dense_attention(q, k, v, causal=True)
 
 
+def _embed_lookup(embed, tokens, compute_dtype):
+    """Sharding-friendly embedding lookup: one-hot contraction over vocab.
+
+    A plain gather (``table[tokens]``) from a vocab-sharded table
+    (:func:`param_shardings` places ``embed`` as ``(model, None)``) with
+    batch-sharded indices forces GSPMD into involuntary full
+    rematerialization — the whole table is all-gathered every step. The
+    one-hot matmul keeps the contraction on the sharded vocab axis: each
+    device multiplies against its local vocab shard and partial results meet
+    in a psum, so the bytes moved are activations (b*s*dim), not the table.
+    Numerically identical to the gather: every product is exactly 0 or the
+    embedding value and the accumulation adds only zeros to it.
+    """
+    onehot = jax.nn.one_hot(tokens, embed.shape[0], dtype=compute_dtype)
+    return onehot @ embed.astype(compute_dtype)
+
+
+def apply_block(layer, x, cfg: LlamaConfig, attn_fn=None, constrain=None,
+                expert_spec=None):
+    """One transformer block (attention + MLP/MoE residuals) -> (x, aux).
+
+    Shared by :func:`apply`'s sequential layer loop and GPipe pipeline
+    stages (:mod:`petastorm_tpu.parallel.pipeline`), so a pipelined model
+    runs the exact same math per layer as the sequential one.
+    """
+    if constrain is None:
+        constrain = lambda t: t  # noqa: E731 - trivial identity
+    hd = cfg.head_dim
+    rep = cfg.n_heads // cfg.n_kv_heads
+    gqa_native = attn_fn is None or getattr(attn_fn, "supports_gqa", False)
+    aux = jnp.zeros((), jnp.float32)
+    h = _rmsnorm(x, layer["attn_norm"], cfg.norm_eps)
+    b, s, _ = h.shape
+    q = (h @ layer["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ layer["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ layer["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
+    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    if not gqa_native and rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = (attn_fn or _dense_causal_attention)(q, k, v)
+    attn = attn.reshape(b, s, cfg.n_heads * hd)
+    x = constrain(x + attn @ layer["wo"].astype(attn.dtype))
+    h = _rmsnorm(x, layer["mlp_norm"], cfg.norm_eps)
+    if "router" in layer:
+        if cfg.moe_dispatch == "switch":
+            from petastorm_tpu.parallel.moe import switch_moe_block
+            moe_out, layer_aux = switch_moe_block(
+                h, layer["router"], layer["ew1"], layer["ew3"],
+                layer["ew2"], top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                expert_spec=expert_spec)
+            aux = aux + layer_aux
+            x = constrain(x + moe_out)
+        else:
+            x = constrain(x + _moe_block(h, layer))
+    else:
+        gate = jax.nn.silu(h @ layer["w1"].astype(h.dtype))
+        up = h @ layer["w3"].astype(h.dtype)
+        x = constrain(x + (gate * up) @ layer["w2"].astype(h.dtype))
+    return x, aux
+
+
 def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
           activation_spec=None, compute_dtype=jnp.bfloat16,
-          expert_spec=None, with_aux=False):
+          expert_spec=None, with_aux=False, layers_fn=None,
+          embed_lookup: str = "gather"):
     """tokens: (batch, seq) int32 -> logits (batch, seq, vocab).
 
     :param attn_fn: attention callable ``(q, k, v) -> out`` on
         (b, s, h, hd) tensors; ``None`` uses dense causal attention. Pass a
         :func:`petastorm_tpu.parallel.ring_attention.make_ring_attention`
-        instance for sequence parallelism.
+        instance for sequence parallelism. Built-in attentions
+        (dense/ring/ulysses) handle grouped-query K/V natively — K/V stay at
+        n_kv_heads width; only user-supplied attentions without the
+        ``supports_gqa`` flag get the repeated layout.
     :param activation_spec: optional ``PartitionSpec`` for (b, s, d)
         activations; applied with ``with_sharding_constraint`` so GSPMD keeps
         the intended layout between layers.
@@ -219,62 +286,52 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
         (``moe_dispatch="switch"``); on the expert mesh axis it makes GSPMD
         lower dispatch/combine to all-to-alls.
     :param with_aux: also return the summed MoE load-balancing loss.
+    :param layers_fn: optional ``f(params["layers"], x) -> (x, aux)``
+        replacing the sequential layer loop — the pipeline-parallel hook
+        (pass a :func:`petastorm_tpu.parallel.pipeline.make_pipeline`
+        wrapper over :func:`apply_block` with stacked stage params).
+    :param embed_lookup: ``"gather"`` (default) | ``"onehot"``. A plain
+        gather is O(1) FLOPs and right for a replicated table, but forces
+        GSPMD into involuntary full rematerialization (an all-gather of the
+        whole table every step) when the table is vocab-sharded. Pass
+        ``"onehot"`` whenever the embed param is sharded on its vocab axis
+        (:func:`param_shardings` / :func:`param_shardings_fsdp` layouts):
+        the contraction (:func:`_embed_lookup`) partitions cleanly at
+        O(b*s*vocab*dim) FLOPs. Explicit because the table's sharding is
+        not visible on a tracer inside jit.
     """
     constrain = (lambda x: x) if activation_spec is None else \
         (lambda x: jax.lax.with_sharding_constraint(x, activation_spec))
-    hd = cfg.head_dim
     aux = jnp.zeros((), jnp.float32)
-    x = params["embed"].astype(compute_dtype)[tokens]
-    x = constrain(x)
-    rep = cfg.n_heads // cfg.n_kv_heads
-    # Built-in attentions (dense/ring/ulysses) handle grouped-query K/V
-    # natively — K/V stay at n_kv_heads width (rep x less ring/all-to-all
-    # traffic). Only user-supplied attentions without the flag get the
-    # repeated layout for backward compatibility.
-    gqa_native = attn_fn is None or getattr(attn_fn, "supports_gqa", False)
-    for layer in params["layers"]:
-        h = _rmsnorm(x, layer["attn_norm"], cfg.norm_eps)
-        b, s, _ = h.shape
-        q = (h @ layer["wq"].astype(h.dtype)).reshape(b, s, cfg.n_heads, hd)
-        k = (h @ layer["wk"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
-        v = (h @ layer["wv"].astype(h.dtype)).reshape(b, s, cfg.n_kv_heads, hd)
-        q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-        if not gqa_native and rep > 1:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = (attn_fn or _dense_causal_attention)(q, k, v)
-        attn = attn.reshape(b, s, cfg.n_heads * hd)
-        x = constrain(x + attn @ layer["wo"].astype(attn.dtype))
-        h = _rmsnorm(x, layer["mlp_norm"], cfg.norm_eps)
-        if "router" in layer:
-            if cfg.moe_dispatch == "switch":
-                from petastorm_tpu.parallel.moe import switch_moe_block
-                moe_out, layer_aux = switch_moe_block(
-                    h, layer["router"], layer["ew1"], layer["ew3"],
-                    layer["ew2"], top_k=cfg.moe_top_k,
-                    capacity_factor=cfg.moe_capacity_factor,
-                    expert_spec=expert_spec)
-                aux = aux + layer_aux
-                x = constrain(x + moe_out)
-            else:
-                x = constrain(x + _moe_block(h, layer))
-        else:
-            gate = jax.nn.silu(h @ layer["w1"].astype(h.dtype))
-            up = h @ layer["w3"].astype(h.dtype)
-            x = constrain(x + (gate * up) @ layer["w2"].astype(h.dtype))
+    if embed_lookup not in ("gather", "onehot"):
+        raise ValueError(f"unknown embed_lookup {embed_lookup!r}")
+    x = constrain(_embed_lookup(params["embed"], tokens, compute_dtype)
+                  if embed_lookup == "onehot"
+                  else params["embed"].astype(compute_dtype)[tokens])
+    if layers_fn is not None:
+        x, layers_aux = layers_fn(params["layers"], x)
+        aux = aux + layers_aux
+    else:
+        for layer in params["layers"]:
+            x, layer_aux = apply_block(layer, x, cfg, attn_fn=attn_fn,
+                                       constrain=constrain,
+                                       expert_spec=expert_spec)
+            aux = aux + layer_aux
     x = _rmsnorm(x, params["norm_out"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return (logits, aux) if with_aux else logits
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
-            expert_spec=None, aux_weight: float = 1e-2):
+            expert_spec=None, aux_weight: float = 1e-2, layers_fn=None,
+            embed_lookup: str = "gather"):
     """Next-token cross entropy (+ MoE load-balancing aux for switch
     dispatch). batch: {'tokens': (b, s) int32}."""
     tokens = batch["tokens"]
     logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
                         activation_spec=activation_spec,
-                        expert_spec=expert_spec, with_aux=True)
+                        expert_spec=expert_spec, with_aux=True,
+                        layers_fn=layers_fn, embed_lookup=embed_lookup)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
@@ -282,7 +339,8 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
 
 
 def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
-                    attn_fn=None, activation_spec=None, expert_spec=None):
+                    attn_fn=None, activation_spec=None, expert_spec=None,
+                    layers_fn=None, embed_lookup: str = "gather"):
     """AdamW train step via optax; jit with sharded params for TP/DP/SP."""
     import optax
     tx = optax.adamw(learning_rate, weight_decay=0.1)
@@ -294,7 +352,8 @@ def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
         loss, grads = jax.value_and_grad(
             partial(loss_fn, cfg=cfg, attn_fn=attn_fn,
                     activation_spec=activation_spec,
-                    expert_spec=expert_spec))(params, batch)
+                    expert_spec=expert_spec, layers_fn=layers_fn,
+                    embed_lookup=embed_lookup))(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
