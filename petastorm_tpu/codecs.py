@@ -341,6 +341,7 @@ class CompressedNdarrayCodec(NdarrayCodec):
 
 
 _NATIVE_DECODE_USABLE = None
+_NATIVE_JPEG_OK = None
 
 
 def _native_decode_usable() -> bool:
@@ -356,6 +357,51 @@ def _native_decode_usable() -> bool:
         except ImportError:
             _NATIVE_DECODE_USABLE = False
     return _NATIVE_DECODE_USABLE
+
+
+def _native_jpeg_parity_ok() -> bool:
+    """One-time per-process probe: must the native JPEG path stay off?
+
+    PNG decode is exact by construction (DEFLATE + defined filters), but
+    JPEG IDCT output is implementation-defined — a host whose system libjpeg
+    differs from cv2's bundled decoder could skew pixels by ±1 LSB between
+    the native and cv2 fallback paths (a silent train/eval inconsistency).
+    Encode one structured probe image with cv2 and require the native strict
+    decode to match cv2's decode bit-for-bit; any mismatch (or any probe
+    failure) disables the native JPEG path for this process. PNG stays on.
+    """
+    global _NATIVE_JPEG_OK
+    if _NATIVE_JPEG_OK is None:
+        try:
+            import cv2
+            from petastorm_tpu.native import imgcodec
+            rng = np.random.default_rng(20260730)
+            grad = np.linspace(0, 255, 64, dtype=np.uint8)
+            img = np.stack([np.tile(grad, (64, 1)),
+                            np.tile(grad[:, None], (1, 64)),
+                            rng.integers(0, 256, (64, 64), dtype=np.uint8)],
+                           axis=-1)
+            ok, enc = cv2.imencode(".jpg", img[..., ::-1],
+                                   [int(cv2.IMWRITE_JPEG_QUALITY), 85])
+            blob = enc.tobytes()
+            ref = cv2.cvtColor(
+                cv2.imdecode(np.frombuffer(blob, np.uint8),
+                             cv2.IMREAD_UNCHANGED), cv2.COLOR_BGR2RGB)
+            native = imgcodec.decode_image(blob, (64, 64, 3), strict=True)
+            _NATIVE_JPEG_OK = bool(ok) and np.array_equal(native, ref)
+        except Exception:  # noqa: BLE001 - any probe failure disables the path
+            _NATIVE_JPEG_OK = False
+    return _NATIVE_JPEG_OK
+
+
+def _is_jpeg_blob(encoded) -> bool:
+    # memoryview first: slicing a bytes-like is cheap, and numpy uint8
+    # blobs would otherwise compare elementwise (ambiguous-truth error).
+    try:
+        head = bytes(memoryview(encoded)[:2])
+    except TypeError:
+        head = bytes(encoded[:2])
+    return head == b"\xff\xd8"
 
 
 class CompressedImageCodec(DataframeColumnCodec):
@@ -400,7 +446,8 @@ class CompressedImageCodec(DataframeColumnCodec):
         # can't reproduce identically (alpha/tRNS, palette oddities, 16-bit,
         # CMYK) raise and fall through to cv2. Gated on cv2 being importable
         # so PIL-only hosts keep their historical PIL output.
-        if _native_decode_usable():
+        if _native_decode_usable() and (
+                not _is_jpeg_blob(encoded) or _native_jpeg_parity_ok()):
             from petastorm_tpu.native import imgcodec
             dims = imgcodec.probe(encoded)
             if dims is not None and dims[2] in (1, 3, 4):

@@ -23,11 +23,17 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Optional
 
 def _process_info():
     import jax
     return jax.process_index(), jax.process_count()
+
+
+def _url_scheme(url: str) -> Optional[str]:
+    m = re.match(r"^([A-Za-z][A-Za-z0-9+.-]*)://", url)
+    return m.group(1).lower() if m else None
 
 
 class CheckpointManager:
@@ -41,8 +47,23 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: Optional[int] = None,
                  **orbax_kwargs):
         import orbax.checkpoint as ocp
-        self._dir = os.path.abspath(str(directory))
-        os.makedirs(self._dir, exist_ok=True)
+        directory = str(directory)
+        scheme = _url_scheme(directory)
+        if scheme in (None, "file"):
+            # Local path: absolutize so orbax and the sidecar agree even if
+            # the process chdirs between save and restore.
+            local = directory[len("file://"):] if scheme == "file" else directory
+            self._remote = False
+            self._dir = os.path.abspath(local)
+            os.makedirs(self._dir, exist_ok=True)
+        else:
+            # Remote URI (gs://, s3://, ...): hand it to orbax UNTOUCHED —
+            # os.path.abspath would mangle 'gs://b/p' into '/cwd/gs:/b/p'
+            # and silently checkpoint to each host's local disk. Orbax
+            # handles cloud storage itself (tensorstore); the input-state
+            # sidecar goes through fsspec below.
+            self._remote = True
+            self._dir = directory.rstrip("/")
         options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                **orbax_kwargs)
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
@@ -68,10 +89,15 @@ class CheckpointManager:
             payload = {"process_count": count, "state": state,
                        "extra": extra_input_state or {}}
             path = self._input_state_path(step, idx)
-            tmp = f"{path}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)
+            if self._remote:
+                import fsspec
+                with fsspec.open(path, "w") as f:
+                    json.dump(payload, f)
+            else:
+                tmp = f"{path}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, path)
         return saved
 
     # --------------------------------------------------------------- restore
@@ -94,10 +120,10 @@ class CheckpointManager:
         own_path = self._input_state_path(step, idx)
         # Validate host count against any present sidecar (own, else process
         # 0's — catches e.g. saved-by-1/restored-by-4 on every process).
-        check_path = own_path if os.path.exists(own_path) \
+        check_path = own_path if self._sidecar_exists(own_path) \
             else self._input_state_path(step, 0)
-        if os.path.exists(check_path):
-            with open(check_path) as f:
+        if self._sidecar_exists(check_path):
+            with self._open_sidecar(check_path) as f:
                 payload = json.load(f)
             if payload.get("process_count") != count:
                 raise ValueError(
@@ -126,8 +152,23 @@ class CheckpointManager:
         return False
 
     def _input_state_path(self, step: int, process_index: int) -> str:
-        return os.path.join(self._dir, str(step),
-                            f"input_state.{process_index}.json")
+        name = f"input_state.{process_index}.json"
+        if self._remote:
+            return f"{self._dir}/{step}/{name}"
+        return os.path.join(self._dir, str(step), name)
+
+    def _sidecar_exists(self, path: str) -> bool:
+        if not self._remote:
+            return os.path.exists(path)
+        import fsspec
+        fs, fs_path = fsspec.core.url_to_fs(path)
+        return fs.exists(fs_path)
+
+    def _open_sidecar(self, path: str):
+        if not self._remote:
+            return open(path)
+        import fsspec
+        return fsspec.open(path).open()
 
     @staticmethod
     def _resolve_input_state(reader, loader) -> Optional[dict]:

@@ -287,9 +287,13 @@ class LoaderBase:
             # rather than hanging the consumer's break/Ctrl-C.
             thread.join(5.0)
             if thread.is_alive():
+                # Not a teardown race: pool.stop() is a poison pill (any
+                # blocked get_results raises EmptyResultError promptly), so
+                # the subsequent reader.stop() releases this thread
+                # deterministically even if it is mid-next() on the reader.
                 logger.warning(
                     "Staging thread still busy after stop (reader stalled "
-                    "mid-batch?); abandoning it as a daemon.")
+                    "mid-batch?); it will exit when the reader stops.")
 
     def _finalize_tail(self, cols: Dict[str, np.ndarray], count: int):
         """Handle the ragged last batch: drop, pad+mask, or emit as-is."""

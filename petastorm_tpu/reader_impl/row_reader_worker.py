@@ -202,9 +202,12 @@ class RowReaderWorker(WorkerBase):
             self._needed = set(view_schema.fields.keys())
         self._decode_schema = schema.create_schema_view(
             [n for n in sorted(self._needed) if n in schema.fields])
-        # Columns whose cells all failed the strict native image decode —
-        # keep them on the per-cell path for the rest of this worker's life.
-        self._native_img_skip = set()
+        # Columns whose cells all failed the strict native image decode stay
+        # on the per-cell path with exponential-backoff retry (mixed datasets
+        # — e.g. one all-grayscale row group under an RGB field — get the
+        # native fast path back after a few row groups).
+        from petastorm_tpu.utils.decode import NativeImageSkipMemo
+        self._native_img_skip = NativeImageSkipMemo()
 
     # Lazily build per-process handles (cheap for threads, required for processes).
     def _ensure_open(self):
@@ -293,7 +296,7 @@ class RowReaderWorker(WorkerBase):
                 # per-row arrays (so a retained row never pins its row
                 # group's other images); falls through to the per-cell
                 # path when not applicable.
-                if (name not in self._native_img_skip
+                if (not self._native_img_skip.should_skip(name)
                         and native_image_eligible(field, codec)):
                     batched = batch_decode_images(
                         field, codec, [src[i] for i in indices],

@@ -54,6 +54,51 @@ def native_image_eligible(field, codec) -> bool:
     return _native_decode_usable()
 
 
+class NativeImageSkipMemo:
+    """Per-column backoff for the native batch image decoder.
+
+    After a row group where EVERY cell fails the strict native decode the
+    column drops to the per-cell path — but not forever: mixed datasets
+    (e.g. one all-grayscale row group stored under an RGB field) get the
+    fast path back after ``base`` skipped row groups. Columns that fail
+    again back off exponentially up to ``cap``, so a genuinely incompatible
+    column costs one wasted native attempt every ``cap`` row groups instead
+    of allocate-then-double-decode on every one.
+
+    Duck-typed to the mutable-set subset :func:`batch_decode_images` uses
+    (``add`` on an all-fail batch, ``discard`` on native success), plus
+    :meth:`should_skip` which callers use in place of ``in`` — it decays
+    the countdown as a side effect.
+    """
+
+    def __init__(self, base: int = 8, cap: int = 256):
+        self._base, self._cap = base, cap
+        self._skip = {}     # column -> row groups left to skip
+        self._streak = {}   # column -> consecutive all-fail batches
+
+    def add(self, name: str):
+        streak = self._streak.get(name, 0) + 1
+        self._streak[name] = streak
+        self._skip[name] = min(self._base * (2 ** (streak - 1)), self._cap)
+
+    def discard(self, name: str):
+        self._streak.pop(name, None)
+        self._skip.pop(name, None)
+
+    def should_skip(self, name: str) -> bool:
+        left = self._skip.get(name)
+        if left is None:
+            return False
+        if left <= 0:
+            del self._skip[name]   # countdown expired: retry this row group
+            return False
+        self._skip[name] = left - 1
+        return True
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._skip
+
+
 def batch_decode_images(field, codec, blobs, skip_memo=None):
     """Decode a whole image column in one native call when possible.
 
@@ -76,6 +121,11 @@ def batch_decode_images(field, codec, blobs, skip_memo=None):
         return None
     if len(blobs) < 4 or any(b is None for b in blobs):
         return None
+    from petastorm_tpu.codecs import _is_jpeg_blob, _native_jpeg_parity_ok
+    if any(_is_jpeg_blob(b) for b in blobs) and not _native_jpeg_parity_ok():
+        # This host's libjpeg does not reproduce cv2's decode bit-for-bit
+        # (one-time probe); JPEG columns stay on the cv2 path.
+        return None
     from petastorm_tpu.native import imgcodec
     rows, statuses = imgcodec.decode_image_batch(blobs, field.shape,
                                                  strict=True)
@@ -83,6 +133,8 @@ def batch_decode_images(field, codec, blobs, skip_memo=None):
         if skip_memo is not None:
             skip_memo.add(field.name)
         return None
+    if skip_memo is not None:
+        skip_memo.discard(field.name)
     if statuses.any():
         for i in np.flatnonzero(statuses):
             rows[i] = codec.decode(field, blobs[i])  # memoryview-safe codec

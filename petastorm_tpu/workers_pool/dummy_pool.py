@@ -42,6 +42,9 @@ class DummyPool:
 
     def get_results(self):
         while True:
+            # stop() is a poison pill: consumers see end-of-data promptly.
+            if self._stopped:
+                raise EmptyResultError()
             while self._results:
                 result = self._results.popleft()
                 if isinstance(result, VentilatedItemProcessedMessage):

@@ -169,6 +169,9 @@ class ProcessPool:
     def get_results(self, timeout: float = None):
         deadline = None if timeout is None else time.time() + timeout
         while True:
+            # stop() is a poison pill: blocked consumers unblock promptly.
+            if self._stopped:
+                raise EmptyResultError()
             all_done = (self._processed == self._ventilated)
             if all_done and (self._ventilator is None or self._ventilator.completed()):
                 raise EmptyResultError()

@@ -154,10 +154,15 @@ class ThreadPool:
         """Next published result, in deterministic round-robin order.
 
         Raises :class:`EmptyResultError` when all ventilated work is done and
-        drained; re-raises worker exceptions.
+        drained; re-raises worker exceptions. ``stop()`` acts as a poison
+        pill: a consumer blocked here (e.g. a loader staging thread) sees
+        :class:`EmptyResultError` promptly instead of polling forever while
+        teardown proceeds under it.
         """
         empty_sweeps = 0
         while True:
+            if self._stop_event.is_set():
+                raise EmptyResultError()
             if all(self._worker_drained(i) for i in range(self.workers_count)):
                 if self._ventilator is None or self._ventilator.completed():
                     raise EmptyResultError()

@@ -224,3 +224,21 @@ def test_npz_fast_path_rejects_foreign_payloads():
     f = UnischemaField("x", np.float64, (6,), CompressedNdarrayCodec(), False)
     assert np.array_equal(CompressedNdarrayCodec().decode(f, buf2.getvalue()),
                           arr)
+
+
+def test_image_decode_accepts_ndarray_blob():
+    """decode() tolerates uint8 ndarray blobs (np.frombuffer callers) — the
+    jpeg-format sniff must not compare elementwise."""
+    import numpy as np
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import UnischemaField
+
+    rng = np.random.default_rng(9)
+    img = rng.integers(0, 255, (20, 20, 3), dtype=np.uint8)
+    for fmt in ("jpeg", "png"):
+        codec = CompressedImageCodec(fmt, 90)
+        field = UnischemaField("im", np.uint8, (20, 20, 3), codec, False)
+        blob = np.frombuffer(codec.encode(field, img), np.uint8)
+        out = codec.decode(field, blob)
+        assert out.shape == img.shape and out.dtype == np.uint8

@@ -474,3 +474,101 @@ def test_make_reader_uses_native_batch_path(tmp_path):
     assert len(seen) == 20
     for i, img in expected.items():
         assert np.array_equal(seen[i], img)
+
+
+def test_jpeg_parity_probe_runs_and_gates(monkeypatch):
+    """The one-time JPEG self-check (ADVICE r2): on this host the native
+    decode must be cv2-bit-identical, so the probe passes; when forced to
+    fail, the native JPEG path goes dark while PNG stays on."""
+    from petastorm_tpu import codecs as codecs_mod
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import UnischemaField
+
+    monkeypatch.setattr(codecs_mod, "_NATIVE_JPEG_OK", None)
+    if not codecs_mod._native_jpeg_parity_ok():
+        # Designed degradation on hosts whose libjpeg differs from cv2's:
+        # the gate below still must hold, but parity itself can't.
+        pytest.skip("host libjpeg lacks cv2 bit-parity; native JPEG path "
+                    "correctly disabled")
+
+    # Forced mismatch: jpeg decode falls back to cv2 (still correct values),
+    # png keeps the native path (exact by construction).
+    monkeypatch.setattr(codecs_mod, "_NATIVE_JPEG_OK", False)
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)
+    for fmt in ("jpeg", "png"):
+        codec = CompressedImageCodec(fmt, 90)
+        field = UnischemaField("im", np.uint8, (32, 32, 3), codec, False)
+        out = codec.decode(field, codec.encode(field, img))
+        assert out.shape == img.shape and out.dtype == np.uint8
+        if fmt == "png":
+            assert np.array_equal(out, img)
+
+
+def test_jpeg_parity_gate_skips_native_batch(monkeypatch):
+    """batch_decode_images refuses JPEG columns when the parity probe fails
+    (the per-cell cv2 path takes over); PNG columns still batch-decode."""
+    from petastorm_tpu import codecs as codecs_mod
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import UnischemaField
+    from petastorm_tpu.utils.decode import batch_decode_images
+
+    monkeypatch.setattr(codecs_mod, "_NATIVE_JPEG_OK", False)
+    rng = np.random.default_rng(4)
+    imgs = [rng.integers(0, 255, (16, 16, 3), dtype=np.uint8) for _ in range(5)]
+    for fmt, expect_native in (("jpeg", False), ("png", True)):
+        codec = CompressedImageCodec(fmt, 90)
+        field = UnischemaField("im", np.uint8, (16, 16, 3), codec, False)
+        blobs = [codec.encode(field, im) for im in imgs]
+        got = batch_decode_images(field, codec, blobs)
+        assert (got is not None) == expect_native
+
+
+def test_native_skip_memo_decays_and_backs_off():
+    """An all-fail column retries after `base` row groups; repeat failures
+    back off exponentially up to `cap`; a success resets the streak."""
+    from petastorm_tpu.utils.decode import NativeImageSkipMemo
+
+    memo = NativeImageSkipMemo(base=2, cap=8)
+    memo.add("im")                       # first all-fail: skip 2 row groups
+    assert memo.should_skip("im") is True
+    assert memo.should_skip("im") is True
+    assert memo.should_skip("im") is False   # countdown expired -> retry
+    memo.add("im")                       # second all-fail: skip 4
+    skips = sum(memo.should_skip("im") for _ in range(10))
+    assert skips == 4
+    memo.add("im"); memo.add("im")       # streak continues: capped at 8
+    skips = sum(memo.should_skip("im") for _ in range(20))
+    assert skips == 8
+    memo.discard("im")                   # native success resets everything
+    assert memo.should_skip("im") is False
+    memo.add("im")                       # back to base
+    assert sum(memo.should_skip("im") for _ in range(10)) == 2
+
+
+def test_mixed_dataset_regains_native_path():
+    """End-to-end memo flow: a row group of grayscale jpegs under an RGB
+    field disables the native batch path, and a later RGB row group gets it
+    back after the backoff window (ADVICE r2: permanent disable)."""
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import UnischemaField
+    from petastorm_tpu.utils.decode import (NativeImageSkipMemo,
+                                            batch_decode_images)
+
+    codec = CompressedImageCodec("png", 90)
+    field = UnischemaField("im", np.uint8, (16, 16, 3), codec, False)
+    rng = np.random.default_rng(5)
+    rgb = [codec.encode(field, rng.integers(0, 255, (16, 16, 3), dtype=np.uint8))
+           for _ in range(5)]
+    gray_field = UnischemaField("im", np.uint8, (16, 16), codec, False)
+    gray = [codec.encode(gray_field, rng.integers(0, 255, (16, 16), dtype=np.uint8))
+            for _ in range(5)]
+
+    memo = NativeImageSkipMemo(base=2, cap=8)
+    assert batch_decode_images(field, codec, gray, skip_memo=memo) is None
+    assert memo.should_skip("im") is True      # backoff window (2 groups)
+    assert memo.should_skip("im") is True
+    assert memo.should_skip("im") is False     # window over: retry
+    out = batch_decode_images(field, codec, rgb, skip_memo=memo)
+    assert out is not None and len(out) == 5   # fast path regained
+    assert "im" not in memo                    # success cleared the memo

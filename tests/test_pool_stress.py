@@ -155,3 +155,58 @@ def test_ventilator_single_inflight_completes():
     assert sorted(got) == list(range(30))
     pool.stop()
     pool.join()
+
+
+def test_stop_is_poison_pill_for_blocked_consumer():
+    """stop() unblocks a consumer parked inside get_results with
+    EmptyResultError (ADVICE r2: the loader staging thread must exit
+    deterministically when the reader stops mid-batch)."""
+    import threading
+
+    pool = ThreadPool(2)
+    pool.start(SleepyWorker, {"sleep_s": 2.0})
+    pool.ventilate(value=1)   # nothing completes for ~2s
+    outcome = {}
+
+    def consume():
+        try:
+            pool.get_results()
+            outcome["result"] = "value"
+        except EmptyResultError:
+            outcome["result"] = "empty"
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)           # consumer is now blocked polling for results
+    t0 = time.time()
+    pool.stop()
+    t.join(5.0)
+    assert not t.is_alive(), "consumer still blocked after stop()"
+    assert outcome["result"] == "empty"
+    assert time.time() - t0 < 5
+    pool.join()
+
+
+@pytest.mark.process_pool
+def test_stop_is_poison_pill_for_blocked_consumer_process_pool():
+    import threading
+
+    pool = ProcessPool(1)
+    pool.start(SleepyWorker, {"sleep_s": 5.0})
+    pool.ventilate(value=1)
+    outcome = {}
+
+    def consume():
+        try:
+            pool.get_results()
+            outcome["result"] = "value"
+        except EmptyResultError:
+            outcome["result"] = "empty"
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    pool.stop()
+    t.join(10.0)
+    assert not t.is_alive() and outcome["result"] == "empty"
+    pool.join()
