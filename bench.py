@@ -13,8 +13,18 @@ Four configs:
    10-row loop overhead (extra key ``hello_world_10k_samples_per_sec``).
 3. **imagenet** — the BASELINE.md target workload: jpeg-decode-bound reader
    feeding a real jitted ResNet-50 train step on the local chip(s); extra
-   keys ``imagenet_samples_per_sec`` (per chip) and
-   ``imagenet_input_stall_pct`` measured wait-vs-compute against that step.
+   keys ``imagenet_samples_per_sec`` (per chip), ``imagenet_input_stall_pct``
+   measured wait-vs-compute against that step, ``imagenet_step_time_ms``,
+   ``imagenet_model_flops_per_step_per_chip`` /
+   ``imagenet_achieved_tflops_per_chip`` from XLA's compiled cost model
+   (per-device), and ``imagenet_mfu_pct`` when
+   ``PETASTORM_TPU_PEAK_FLOPS`` names the chip's peak. The accelerator
+   probe retries with backoff spread across the run (transient tunnel
+   wedges recover); CPU fallback only after the last attempt.
+   Also **2b. best_config** — the best measured host-pipeline configuration
+   (process pool over the shm ring + native batch decode + rowgroup
+   coalescing) on the 10k store, reported as
+   ``best_config_samples_per_sec``/``best_config``.
 4. **scalar_batched** — the columnar path (``make_batch_reader`` ->
    ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
    key ``scalar_batched_samples_per_sec`` (the reference only ever made a
@@ -33,7 +43,8 @@ def _ensure(marker_url: str, generate):
         generate()
 
 
-def _probe_accelerator(timeout_s: float = 180.0) -> bool:
+def _probe_accelerator(timeout_s: float = 120.0, attempts: int = 1,
+                       backoff_s: float = 45.0) -> bool:
     """True when jax promptly brings up a NON-CPU default backend.
 
     Probed in a SUBPROCESS because a wedged TPU tunnel makes in-process
@@ -43,19 +54,38 @@ def _probe_accelerator(timeout_s: float = 180.0) -> bool:
     PJRT client C call); the parent's SIGKILL timeout is only a backstop —
     killing a process mid-client-creation is what wedges the tunnel.
     A backend that comes up but is CPU also returns False: running the full
-    ImageNet config on a 1-core host would stall for hours."""
+    ImageNet config on a 1-core host would stall for hours.
+
+    ``attempts`` > 1 retries with ``backoff_s`` sleeps: the tunnel's common
+    failure mode is a TRANSIENT wedge (child killed by its own alarm, or
+    parent timeout), so one wedged probe must not condemn the whole
+    ImageNet phase to CPU (round-2 verdict item 1). A child that exits
+    cleanly with a CPU-only backend is NOT a wedge — no accelerator exists,
+    so retrying would only waste minutes; return False immediately."""
     import subprocess
+    import time
     child = ("import signal, sys; signal.alarm(%d); import jax; "
              "sys.exit(0 if jax.default_backend() != 'cpu' else 1)"
              % int(timeout_s))
-    try:
-        rc = subprocess.run(
-            [sys.executable, "-c", child],
-            timeout=timeout_s + 30, stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL).returncode
-        return rc == 0
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(attempts):
+        try:
+            rc = subprocess.run(
+                [sys.executable, "-c", child],
+                timeout=timeout_s + 30, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode
+            if rc == 0:
+                return True
+            if rc == 1:   # clean exit, backend is CPU: deterministic, final
+                print("accelerator probe: CPU-only backend (no accelerator)",
+                      file=sys.stderr)
+                return False
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"accelerator probe attempt {attempt + 1}/{attempts} wedged",
+              file=sys.stderr)
+        if attempt < attempts - 1:
+            time.sleep(backoff_s)
+    return False
 
 
 def main():
@@ -88,6 +118,32 @@ def main():
                           pool_type="thread", loaders_count=3).samples_per_second
         for _ in range(2))  # best-of-2: transient host load shows up hard
                             # on a single-core VM
+
+    # ---- 2b. best measured config on the same 10k store: process pool
+    # (shm-ring transport) + native batch decode + rowgroup coalescing.
+    # Small results queue so the measurement drains the pipeline, not a
+    # warmup backlog of coalesced 800-row items. In a CPU-pinned subprocess
+    # for the same reason as the scalar phase.
+    best_cfg = ("process_pool+shm_ring+native_decode+rowgroup_coalescing=8"
+                "+workers=2")
+    best_child = (
+        "import json, os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.benchmark.throughput import reader_throughput\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'hello_world_10k')\n"
+        "sps = max(reader_throughput(url, warmup_cycles=800, measure_cycles=8000,\n"
+        "                            pool_type='process', loaders_count=2,\n"
+        "                            reader_extra_kwargs={'rowgroup_coalescing': 8,\n"
+        "                                                 'results_queue_size': 4}\n"
+        "                            ).samples_per_second for _ in range(2))\n"
+        "print('BENCHJSON:' + json.dumps({'sps': sps}))\n")
+    try:
+        best_cfg_sps = _cpu_subprocess(best_child, data_dir,
+                                       timeout_s=900.0)["sps"]
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        best_cfg_sps = None
+        print(f"best_config failed: {e!r}", file=sys.stderr)
 
     # ---- scalar columnar path: make_batch_reader -> BatchedDataLoader --
     # Always in a JAX_PLATFORMS=cpu subprocess: the metric is host-side
@@ -126,10 +182,19 @@ def main():
     }
     if scalar_sps is not None:
         out["scalar_batched_samples_per_sec"] = round(scalar_sps, 2)
+    if best_cfg_sps is not None:
+        out["best_config_samples_per_sec"] = round(best_cfg_sps, 2)
+        out["best_config"] = best_cfg
     imagenet = None
     try:
-        if not _probe_accelerator():
-            raise RuntimeError("accelerator probe failed (wedged or absent)")
+        # Probe IMMEDIATELY before the in-process jax init (a stale earlier
+        # result could send us into an uninterruptible PJRT hang), with
+        # retries + backoff so a transiently wedged tunnel gets several
+        # chances; the minutes of CPU phases above already gave it time.
+        if not _probe_accelerator(timeout_s=150.0, attempts=3,
+                                  backoff_s=60.0):
+            raise RuntimeError("accelerator probe failed (wedged or absent) "
+                               "after retries spread across the run")
         out["imagenet_platform"] = "accelerator"
         url_in = f"file://{data_dir}/imagenet"
         _ensure(url_in, lambda: write_synthetic_imagenet(url_in, rows=2048))
@@ -155,7 +220,14 @@ def main():
             "imagenet_input_stall_pct": round(imagenet["input_stall_pct"], 2),
             "imagenet_devices": imagenet["devices"],
             "imagenet_global_batch": imagenet["global_batch"],
+            "imagenet_step_time_ms": round(imagenet["step_time_ms"], 2),
         })
+        for key in ("model_flops_per_step_per_chip", "achieved_tflops_per_chip",
+                    "mfu_pct"):
+            if key in imagenet:
+                out[f"imagenet_{key}"] = (
+                    imagenet[key] if key == "model_flops_per_step_per_chip"
+                    else round(imagenet[key], 3))
 
     print(json.dumps(out))
     return 0
