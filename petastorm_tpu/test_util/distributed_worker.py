@@ -1,0 +1,79 @@
+"""Child process for the real 2-process global-batch test.
+
+Each process joins a ``jax.distributed`` CPU cluster, opens
+``make_reader(cur_shard="auto")`` (shard derived from the *distributed
+runtime*, not a monkeypatch) plus a sharded :class:`petastorm_tpu.jax.
+DataLoader`, and drives ``jax.make_array_from_process_local_data`` with
+``jax.process_count() == 2`` — the GSPMD global-assembly path that unit
+tests can only simulate (SURVEY.md §4 takeaway; round-2 verdict item 3).
+
+Run as ``python -m petastorm_tpu.test_util.distributed_worker <url>
+<coordinator> <process_id> <num_processes> <out_json>``.
+"""
+import json
+import sys
+
+
+def main(url: str, coordinator: str, process_id: int, num_processes: int,
+         out_path: str) -> None:
+    import jax
+
+    # The axon sitecustomize re-forces jax_platforms in every interpreter;
+    # config.update before first backend init is the reliable override.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    assert jax.process_count() == num_processes, jax.process_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.jax import DataLoader
+    from petastorm_tpu.reader import make_reader
+
+    devices = jax.devices()          # global: 2 per process
+    mesh = Mesh(np.array(devices), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def global_sum(arr):             # cross-host collective over the mesh
+        return jnp.sum(arr)
+
+    ids = []
+    global_shapes = []
+    device_counts = []
+    sums = []
+    # cur_shard="auto" resolves shard/count from jax.process_index/count —
+    # the real distributed runtime this time.
+    with make_reader(url, cur_shard="auto", shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        loader = DataLoader(reader, batch_size=4, sharding=sharding,
+                            drop_last=True)
+        for batch in loader:
+            arr = batch["id"]
+            assert isinstance(arr, jax.Array)
+            global_shapes.append(list(arr.shape))
+            device_counts.append(len(arr.sharding.device_set))
+            local = np.concatenate(
+                [np.asarray(s.data).reshape(-1)
+                 for s in sorted(arr.addressable_shards,
+                                 key=lambda s: s.index[0].start or 0)])
+            ids.extend(int(v) for v in local)
+            sums.append(float(global_sum(arr)))
+
+    with open(out_path, "w") as f:
+        json.dump({"process_id": process_id,
+                   "process_count": jax.process_count(),
+                   "local_device_count": jax.local_device_count(),
+                   "ids": ids,
+                   "global_shapes": global_shapes,
+                   "device_counts": device_counts,
+                   "global_sums": sums}, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+         sys.argv[5])

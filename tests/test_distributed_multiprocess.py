@@ -1,0 +1,107 @@
+"""REAL multi-process global-batch assembly: two ``jax.distributed``
+CPU processes, each reading its auto-derived shard and contributing to one
+global ``jax.Array`` via ``make_array_from_process_local_data``.
+
+Round-2 verdict item 3: until now this path only ever ran with
+``jax.process_count() == 1`` or monkeypatched process indices; here the
+sharding arithmetic, the loader's global assembly, and a cross-host
+collective all execute with ``process_count() == 2`` for real.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ROWS = 32
+GROUPS = 8
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def id_dataset(tmp_path_factory):
+    url = f"file://{tmp_path_factory.mktemp('dist')}/ids"
+    schema = Unischema("Ids", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    with materialize_dataset_local(url, schema,
+                                   rows_per_row_group=ROWS // GROUPS) as w:
+        for i in range(ROWS):
+            w.write_row({"id": np.int64(i)})
+    return url
+
+
+@pytest.mark.slow
+def test_two_process_global_batch_assembly(id_dataset, tmp_path):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"out{i}.json") for i in range(2)]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # children pin CPU via config.update
+    # Log to files, not pipes: the two workers block on each other at the
+    # distributed barrier, and a pipe filling with XLA warnings while the
+    # parent reads them sequentially would deadlock into a timeout.
+    logs = [tmp_path / f"log{i}.txt" for i in range(2)]
+    with logs[0].open("w") as l0, logs[1].open("w") as l1:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "petastorm_tpu.test_util.distributed_worker",
+                 id_dataset, coordinator, str(i), "2", outs[i]],
+                env=env, stdout=log, stderr=subprocess.STDOUT)
+            for i, log in enumerate((l0, l1))
+        ]
+        results = []
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            try:
+                p.wait(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("distributed worker timed out "
+                            "(coordinator barrier?)")
+            assert p.returncode == 0, \
+                f"worker {i} failed:\n{logs[i].read_text()[-2000:]}"
+            with open(out) as f:
+                results.append(json.load(f))
+
+    for r in results:
+        assert r["process_count"] == 2
+        assert r["local_device_count"] == 2
+        # Every batch is a GLOBAL array: 8 rows over all 4 devices while
+        # each host only contributed its local 4.
+        assert all(shape == [8] for shape in r["global_shapes"])
+        assert all(n == 4 for n in r["device_counts"])
+
+    # Shard contents: index % shard_count == cur_shard over row groups.
+    rows_per_group = ROWS // GROUPS
+    expected = {
+        pid: [g * rows_per_group + i
+              for g in range(GROUPS) if g % 2 == pid
+              for i in range(rows_per_group)]
+        for pid in (0, 1)
+    }
+    by_pid = {r["process_id"]: r for r in results}
+    for pid in (0, 1):
+        assert by_pid[pid]["ids"] == expected[pid], \
+            "local shard must be the deterministic index%2 row groups in order"
+
+    # Disjoint + complete across the cluster == the sequential read.
+    union = sorted(by_pid[0]["ids"] + by_pid[1]["ids"])
+    assert union == list(range(ROWS))
+
+    # The cross-host collective saw identical global batches on both hosts,
+    # and the summed stream covers every row exactly once.
+    assert by_pid[0]["global_sums"] == by_pid[1]["global_sums"]
+    assert sum(by_pid[0]["global_sums"]) == sum(range(ROWS))
