@@ -48,6 +48,11 @@ class DataType:
     def simpleString(self) -> str:
         return self.typeName()
 
+    def jsonValue(self):
+        # Real pyspark: scalar types serialize to their typeName string
+        # (pyspark/sql/types.py DataType.jsonValue).
+        return self.typeName()
+
     def __eq__(self, other):
         return type(self) is type(other) and self.__dict__ == other.__dict__
 
@@ -106,6 +111,10 @@ class DecimalType(DataType):
     def simpleString(self) -> str:
         return f"decimal({self.precision},{self.scale})"
 
+    def jsonValue(self) -> str:
+        # Real pyspark overrides jsonValue for decimals: 'decimal(p,s)'.
+        return f"decimal({self.precision},{self.scale})"
+
 
 class ArrayType(DataType):
     def __init__(self, elementType: DataType, containsNull: bool = True):
@@ -117,6 +126,11 @@ class ArrayType(DataType):
 
     def simpleString(self) -> str:
         return f"array<{self.elementType.simpleString()}>"
+
+    def jsonValue(self) -> dict:
+        return {"type": "array",
+                "elementType": self.elementType.jsonValue(),
+                "containsNull": self.containsNull}
 
     def __repr__(self):
         return f"ArrayType({self.elementType!r})"
@@ -130,6 +144,10 @@ class StructField:
 
     def __repr__(self):
         return f"StructField({self.name},{self.dataType.simpleString()},{self.nullable})"
+
+    def jsonValue(self) -> dict:
+        return {"name": self.name, "type": self.dataType.jsonValue(),
+                "nullable": self.nullable, "metadata": {}}
 
 
 class StructType(DataType):
@@ -146,6 +164,10 @@ class StructType(DataType):
 
     def __iter__(self):
         return iter(self.fields)
+
+    def jsonValue(self) -> dict:
+        return {"type": "struct",
+                "fields": [f.jsonValue() for f in self.fields]}
 
     def __repr__(self):
         return f"StructType({self.fields!r})"
@@ -201,11 +223,63 @@ class Vectors:
 
 
 class VectorUDT(DataType):
-    """User-defined type marker for ML vectors; the converter dispatches on
-    ``typeName() == 'vectorudt'`` (reference spark_dataset_converter.py:542)."""
+    """User-defined type marker for ML vectors.
+
+    Matches real pyspark's ``pyspark.ml.linalg.VectorUDT`` contracts
+    (transcribed from pyspark/ml/linalg/__init__.py; golden-file tested in
+    tests/test_spark_golden.py since this image has no pyspark):
+
+    * ``typeName() == 'vectorudt'`` (UserDefinedType.typeName is the
+      lowercased class name) — the converter dispatches on this;
+    * ``sqlType()``/``jsonValue()`` — the UDT's storage struct
+      (type byte, size int, indices array<int>, values array<double>);
+    * ``serialize()`` — dense ``(1, None, None, values)``, sparse
+      ``(0, size, indices, values)``.
+    """
 
     def typeName(self) -> str:
         return "vectorudt"
+
+    @classmethod
+    def sqlType(cls) -> "StructType":
+        return StructType([
+            StructField("type", ByteType(), False),
+            StructField("size", IntegerType(), True),
+            StructField("indices", ArrayType(IntegerType(), False), True),
+            StructField("values", ArrayType(DoubleType(), False), True),
+        ])
+
+    @classmethod
+    def module(cls) -> str:
+        return "pyspark.ml.linalg"
+
+    @classmethod
+    def scalaUDT(cls) -> str:
+        return "org.apache.spark.ml.linalg.VectorUDT"
+
+    def jsonValue(self) -> dict:
+        return {
+            "type": "udt",
+            "class": self.scalaUDT(),
+            "pyClass": f"{self.module()}.VectorUDT",
+            "sqlType": self.sqlType().jsonValue(),
+        }
+
+    def serialize(self, obj):
+        if isinstance(obj, SparseVector):
+            return (0, obj.size, [int(i) for i in obj.indices],
+                    [float(v) for v in obj.values])
+        if isinstance(obj, DenseVector):
+            return (1, None, None, [float(v) for v in obj.values])
+        raise TypeError(f"cannot serialize {type(obj).__name__} into Vector")
+
+    def deserialize(self, datum):
+        tpe = datum[0]
+        if tpe == 0:
+            return SparseVector(datum[1], datum[2], datum[3])
+        if tpe == 1:
+            return DenseVector(datum[3])
+        raise ValueError(f"unknown vector type marker {tpe}")
 
 
 # ------------------------------------------------------------------- columns
@@ -297,9 +371,22 @@ class DataFrameWriter:
         n = table.num_rows
         splits = [table.slice(0, n - n // 2), table.slice(n - n // 2)] \
             if n >= 2 else [table]
+        # Real Spark naming (HadoopMapReduceCommitProtocol +
+        # ParquetFileFormat): part-<split>-<jobUUID>-c000.<codec>.parquet,
+        # one job UUID shared by all files of the write, plus a _SUCCESS
+        # marker. Matching it keeps every downstream file-discovery
+        # assumption (suffix filter, underscore-sidecar skip) honest
+        # against what a real cluster produces.
+        import uuid
+        job_uuid = uuid.uuid4()
+        # Spark accepts both "none" and "uncompressed"; pyarrow needs None.
+        pq_comp = None if compression in (None, "none", "uncompressed") \
+            else compression
+        codec = f"{pq_comp}." if pq_comp else ""
         for i, part in enumerate(splits):
-            with fs.open(posixpath.join(path, f"part-{i:05d}.parquet"), "wb") as f:
-                pq.write_table(part, f, compression=compression)
+            name = f"part-{i:05d}-{job_uuid}-c000.{codec}parquet"
+            with fs.open(posixpath.join(path, name), "wb") as f:
+                pq.write_table(part, f, compression=pq_comp)
         with fs.open(posixpath.join(path, "_SUCCESS"), "wb"):
             pass
 

@@ -1,0 +1,89 @@
+"""Pin the vendored minispark engine to real Spark's documented contracts.
+
+Round-2 verdict item 6: the converter suite runs against minispark, so a
+silent minispark-vs-Spark divergence would pass every test. These goldens
+(tests/data/spark_golden/, transcribed from the Apache Spark sources — see
+the README there for file:line provenance; the image has no pyspark to
+record from live) fail if minispark drifts on any contract the converter
+or readers actually rely on: VectorUDT schema JSON + serialization,
+typeName dispatch strings, and the parquet output layout.
+"""
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+
+from petastorm_tpu.test_util import minispark as ms
+
+GOLDEN = Path(__file__).parent / "data" / "spark_golden"
+
+
+def test_vector_udt_json_matches_spark_golden():
+    golden = json.loads((GOLDEN / "vector_udt_schema.json").read_text())
+    assert ms.VectorUDT().jsonValue() == golden
+
+
+def test_vector_serialize_matches_spark_tuples():
+    udt = ms.VectorUDT()
+    dense = ms.Vectors.dense([1.0, 0.0, 3.0])
+    assert udt.serialize(dense) == (1, None, None, [1.0, 0.0, 3.0])
+    sparse = ms.Vectors.sparse(5, [1, 3], [2.0, 4.0])
+    assert udt.serialize(sparse) == (0, 5, [1, 3], [2.0, 4.0])
+    # round-trip
+    rt = udt.deserialize(udt.serialize(sparse))
+    assert np.array_equal(rt.toArray(), sparse.toArray())
+    assert np.array_equal(udt.deserialize(udt.serialize(dense)).toArray(),
+                          dense.toArray())
+
+
+def test_type_names_match_spark():
+    """The converter dispatches on typeName(); these strings are fixed by
+    pyspark/sql/types.py (UDTs: lowercased class name)."""
+    expected = {
+        ms.DoubleType(): "double", ms.FloatType(): "float",
+        ms.IntegerType(): "integer", ms.LongType(): "long",
+        ms.StringType(): "string", ms.BooleanType(): "boolean",
+        ms.BinaryType(): "binary", ms.ByteType(): "byte",
+        ms.ShortType(): "short",
+        ms.ArrayType(ms.IntegerType()): "array",
+        ms.VectorUDT(): "vectorudt",
+    }
+    for t, name in expected.items():
+        assert t.typeName() == name, type(t).__name__
+
+
+SPARK_PART_RE = re.compile(
+    r"^part-\d{5}-[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}"
+    r"-[0-9a-f]{12}-c000(\.\w+)?\.parquet$")
+
+
+def test_parquet_output_layout_matches_spark(tmp_path):
+    """Written stores look like a real Spark job's output: the canonical
+    part-file names (one job UUID across the write) plus _SUCCESS."""
+    spark = ms.SparkSession.builder.master("local[2]").getOrCreate()
+    df = spark.createDataFrame([(i, float(i)) for i in range(10)],
+                               ["id", "x"])
+    url = f"file://{tmp_path}/store"
+    df.write.option("compression", "snappy").parquet(url)
+
+    names = sorted(p.name for p in (tmp_path / "store").iterdir())
+    assert "_SUCCESS" in names
+    parts = [n for n in names if n != "_SUCCESS"]
+    assert parts and all(SPARK_PART_RE.match(n) for n in parts), parts
+    assert all(".snappy." in n for n in parts)
+    # one job UUID shared across the write's files
+    uuids = {n.split("-", 2)[2].rsplit("-c000", 1)[0] for n in parts}
+    assert len(uuids) == 1
+    # and the files are ordinary parquet a reader can open
+    import pyarrow.parquet as pq
+    total = sum(pq.read_table(tmp_path / "store" / n).num_rows for n in parts)
+    assert total == 10
+
+
+def test_uncompressed_layout_drops_codec_suffix(tmp_path):
+    spark = ms.SparkSession.builder.getOrCreate()
+    df = spark.createDataFrame([(1,)], ["id"])
+    df.write.option("compression", "none").parquet(f"file://{tmp_path}/u")
+    parts = [p.name for p in (tmp_path / "u").iterdir() if p.name != "_SUCCESS"]
+    assert parts and all(n.endswith("-c000.parquet") for n in parts), parts
