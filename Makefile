@@ -1,0 +1,14 @@
+# Developer entry points. The image has no sphinx/mkdocs (and no network
+# installs), so `docs` runs the vendored zero-dep generator instead.
+
+.PHONY: docs smoke test
+
+docs:
+	python tools/gen_api_docs.py
+
+# Fast tier: excludes tests marked `slow` (heavy e2e/parallel/example runs).
+smoke:
+	python -m pytest tests/ -q -m "not slow"
+
+test:
+	python -m pytest tests/ -q

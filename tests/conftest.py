@@ -66,3 +66,13 @@ def scalar_dataset(tmp_path_factory):
     url = f"file://{path}/ds"
     data = create_test_scalar_dataset(url, num_rows=100, row_group_size=10)
     return type("ScalarDataset", (), {"url": url, "data": data})
+
+
+def pytest_collection_modifyitems(config, items):
+    """Every process_pool test is also `slow`: spawning real worker
+    interpreters costs 4-17s each on this 1-core host. The smoke tier
+    (`pytest -m "not slow"`, `make smoke`) keeps thread/dummy coverage of
+    the same code paths; the full run (`make test`) covers everything."""
+    for item in items:
+        if item.get_closest_marker("process_pool") is not None:
+            item.add_marker(pytest.mark.slow)

@@ -8,6 +8,8 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # every example trains a model end-to-end
+
 _EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
 
 

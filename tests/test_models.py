@@ -26,6 +26,7 @@ def test_mlp_train_step_reduces_loss():
     assert _finite(params)
 
 
+@pytest.mark.slow
 def test_resnet50_forward_and_grads():
     params = resnet.init_params(jax.random.PRNGKey(0), num_classes=10)
     images = jnp.asarray(np.random.default_rng(0).random((2, 64, 64, 3)), jnp.float32)
@@ -46,6 +47,7 @@ def test_resnet50_forward_and_grads():
     assert float(jnp.abs(new_params["stem"]["bn"]["mean"]).sum()) > 0
 
 
+@pytest.mark.slow
 def test_vit_forward():
     params = vit.init_params(jax.random.PRNGKey(0), image_size=32, patch=8,
                              dim=64, depth=2, heads=4, mlp_dim=128, num_classes=10)
@@ -55,6 +57,7 @@ def test_vit_forward():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_llama_tiny_loss_and_grads():
     cfg = llama.TINY
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -67,6 +70,7 @@ def test_llama_tiny_loss_and_grads():
     assert _finite(grads)
 
 
+@pytest.mark.slow
 def test_llama_causality():
     """Changing a future token must not change past logits."""
     cfg = llama.TINY

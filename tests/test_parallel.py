@@ -102,6 +102,7 @@ def test_ulysses_rejects_indivisible_heads():
         jax.jit(make_ulysses_attention(mesh))(q, k, v)
 
 
+@pytest.mark.slow
 def test_llama_train_step_with_ulysses():
     """Llama's train step accepts either sequence-parallel attention; one
     step with Ulysses produces the same loss as ring (exact attention)."""
@@ -144,6 +145,7 @@ def test_make_mesh_helpers():
         make_mesh((3, 3), ("a", "b"))
 
 
+@pytest.mark.slow
 def test_llama_tp_sharded_matches_unsharded():
     from petastorm_tpu.models import llama
     cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
@@ -162,6 +164,7 @@ def test_llama_tp_sharded_matches_unsharded():
     assert loss_tp == pytest.approx(loss_plain, rel=2e-2)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun_multichip():
     import importlib.util
     spec = importlib.util.spec_from_file_location(
@@ -182,6 +185,7 @@ def test_graft_entry_forward_compiles():
     assert out.shape == (8, 1000)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential_fwd_and_grad():
     from petastorm_tpu.parallel.pipeline import make_pipeline, stack_stage_params
     rng = np.random.default_rng(0)
@@ -226,6 +230,7 @@ def test_pipeline_microbatch_validation():
         jax.jit(pipe)(stack_stage_params(stages), jnp.zeros((16, 4)))
 
 
+@pytest.mark.slow
 def test_llama_moe_ep_sharded_matches_unsharded():
     from petastorm_tpu.models import llama
     cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
@@ -246,6 +251,7 @@ def test_llama_moe_ep_sharded_matches_unsharded():
     assert loss_ep == pytest.approx(loss_plain, rel=2e-2)
 
 
+@pytest.mark.slow
 def test_llama_fsdp_sharded_matches_unsharded():
     """ZeRO-3 param sharding over the data axis (with and without TP) is
     numerically a no-op — GSPMD all-gathers reproduce the dense math."""
@@ -294,6 +300,7 @@ def test_llama_fsdp_actually_shards_matrices():
 
 # ------------------------------------------------------------- switch MoE ---
 
+@pytest.mark.slow
 def test_switch_route_invariants():
     """Every kept token occupies exactly one slot; no expert exceeds
     capacity; gate weights are the router probabilities."""
@@ -313,6 +320,7 @@ def test_switch_route_invariants():
     assert float(aux) > 0
 
 
+@pytest.mark.slow
 def test_switch_route_capacity_drops_overflow():
     from petastorm_tpu.parallel import moe
     # all 10 tokens prefer expert 0; capacity 3 keeps exactly 3
@@ -322,6 +330,7 @@ def test_switch_route_capacity_drops_overflow():
     assert float(dispatch[:, 1].sum()) == 0.0
 
 
+@pytest.mark.slow
 def test_switch_route_top2_uses_second_expert():
     from petastorm_tpu.parallel import moe
     rng = np.random.default_rng(1)
@@ -331,6 +340,7 @@ def test_switch_route_top2_uses_second_expert():
     assert float(d2.sum()) == pytest.approx(2 * float(d1.sum()))
 
 
+@pytest.mark.slow
 def test_switch_moe_block_matches_manual_dense_compute():
     """With capacity >= tokens and top_k=E, the sparse block must equal the
     soft-mixture computed densely with the same router probabilities
@@ -359,6 +369,7 @@ def test_switch_moe_block_matches_manual_dense_compute():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_llama_switch_moe_trains_sharded():
     """A switch-MoE Llama train step runs under dp x model mesh with the
     expert buffers constrained to the model axis; loss is finite and the
@@ -387,6 +398,7 @@ def test_llama_switch_moe_trains_sharded():
     assert float(loss2) < float(loss)  # it optimizes
 
 
+@pytest.mark.slow
 def test_llama_switch_vs_soft_dispatch_both_supported():
     from petastorm_tpu.models import llama
     rng = np.random.default_rng(3)
@@ -420,6 +432,7 @@ def test_dense_attention_gqa_matches_repeat(causal):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("seq_shards", [2, 4])
 def test_ring_attention_gqa_matches_dense(causal, seq_shards):
@@ -437,6 +450,7 @@ def test_ring_attention_gqa_matches_dense(causal, seq_shards):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_attention_gqa_matches_dense():
     from petastorm_tpu.parallel.ulysses_attention import make_ulysses_attention
     mesh = make_mesh((4, 2), ("data", "seq"))
@@ -450,6 +464,7 @@ def test_ulysses_attention_gqa_matches_dense():
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_llama_gqa_loss_unchanged_by_native_path():
     """The GQA-native path (no K/V repeat) is numerically identical to the
     repeated layout on the default dense attention."""

@@ -153,7 +153,8 @@ def test_transform_tensor_column_with_null_rows(scalar_dataset):
             assert float(batch.feat[i][0, 0]) == float(row_id)
 
 
-@pytest.mark.parametrize("pool", ["dummy", "thread", "process"])
+@pytest.mark.parametrize("pool", ["dummy", "thread", pytest.param(
+    "process", marks=pytest.mark.slow)])
 def test_convert_early_to_numpy(scalar_dataset, pool):
     """Worker-side numpy conversion yields identical batches to the default
     consumer-side conversion (reference test_parquet_reader.py:493)."""

@@ -266,6 +266,7 @@ def test_rowgroup_coalescing_coalescer_unit():
         ("a", (0, 1, 2)), ("b", 0), ("a", 3)]
 
 
+@pytest.mark.slow
 def test_rowgroup_coalescing_through_process_pool(synthetic_dataset):
     """Coalesced (larger) payloads stream intact through the shm-ring
     process pool, exercising the chunked-frame path for big items."""

@@ -233,6 +233,7 @@ def test_scalar_bench_generate_and_measure(tmp_path):
     assert sps > 0
 
 
+@pytest.mark.slow
 def test_imagenet_bench_runs_on_cpu(tmp_path):
     """run_imagenet_bench (the BENCH artifact's target workload) executes
     end to end on CPU with a small image size and reports stall+throughput."""

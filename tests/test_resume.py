@@ -136,6 +136,7 @@ def test_resume_offset_out_of_range(synthetic_dataset):
 
 # --------------------------------------------------- orbax joint checkpoint ---
 
+@pytest.mark.slow
 def test_checkpoint_manager_saves_train_and_input_state(tmp_path,
                                                         synthetic_dataset):
     """Model pytree and reader cursor round-trip through one orbax step dir;
