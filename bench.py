@@ -21,10 +21,10 @@ Four configs:
    ``PETASTORM_TPU_PEAK_FLOPS`` names the chip's peak. The accelerator
    probe retries with backoff spread across the run (transient tunnel
    wedges recover); CPU fallback only after the last attempt.
-   Also **2b. best_config** — the best measured host-pipeline configuration
-   (process pool over the shm ring + native batch decode + rowgroup
-   coalescing) on the 10k store, reported as
-   ``best_config_samples_per_sec``/``best_config``.
+   Also **2b. best_config** — a sweep of host-pipeline configurations
+   (thread pool, dummy+coalescing, process pool over the shm ring +
+   native decode + coalescing) on the 10k store; the measured winner is
+   reported as ``best_config_samples_per_sec``/``best_config``.
 4. **scalar_batched** — the columnar path (``make_batch_reader`` ->
    ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
    key ``scalar_batched_samples_per_sec`` (the reference only ever made a
@@ -119,30 +119,50 @@ def main():
         for _ in range(2))  # best-of-2: transient host load shows up hard
                             # on a single-core VM
 
-    # ---- 2b. best measured config on the same 10k store: process pool
-    # (shm-ring transport) + native batch decode + rowgroup coalescing.
+    # ---- 2b. best measured config on the same 10k store: a small sweep,
+    # reporting whichever pipeline configuration actually wins on THIS
+    # host. (Measured 2026-07-30 on the 1-core bench host: process pool +
+    # shm ring loses 4x to threads here — IPC serialization swamps the GIL
+    # win with no spare core — and all thread/dummy/coalescing variants
+    # land within ~10% of the decode-bound ceiling. Hosts with real core
+    # counts will pick differently, which is the point of sweeping.)
     # Small results queue so the measurement drains the pipeline, not a
     # warmup backlog of coalesced 800-row items. In a CPU-pinned subprocess
     # for the same reason as the scalar phase.
-    best_cfg = ("process_pool+shm_ring+native_decode+rowgroup_coalescing=8"
-                "+workers=2")
     best_child = (
         "import json, os\n"
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
         "from petastorm_tpu.benchmark.throughput import reader_throughput\n"
         "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'hello_world_10k')\n"
-        "sps = max(reader_throughput(url, warmup_cycles=800, measure_cycles=8000,\n"
-        "                            pool_type='process', loaders_count=2,\n"
-        "                            reader_extra_kwargs={'rowgroup_coalescing': 8,\n"
-        "                                                 'results_queue_size': 4}\n"
-        "                            ).samples_per_second for _ in range(2))\n"
-        "print('BENCHJSON:' + json.dumps({'sps': sps}))\n")
+        "coal = {'rowgroup_coalescing': 8, 'results_queue_size': 4}\n"
+        "sweep = {\n"
+        "    'thread_pool+workers=3': dict(pool_type='thread', loaders_count=3),\n"
+        "    'dummy_pool+native_decode+rowgroup_coalescing=8':\n"
+        "        dict(pool_type='dummy', reader_extra_kwargs=dict(coal)),\n"
+        "    'process_pool+shm_ring+native_decode+rowgroup_coalescing=8+workers=2':\n"
+        "        dict(pool_type='process', loaders_count=2,\n"
+        "             reader_extra_kwargs=dict(coal)),\n"
+        "}\n"
+        # best-of-2 per config: single-core load spikes exceed the ~10%
+        # margins between configs, so one lone run could crown the wrong
+        # winner (same mitigation as every other phase).
+        "results = {name: max(reader_throughput(url, warmup_cycles=800,\n"
+        "                                       measure_cycles=8000,\n"
+        "                                       **kw).samples_per_second\n"
+        "                     for _ in range(2))\n"
+        "           for name, kw in sweep.items()}\n"
+        "best = max(results, key=results.get)\n"
+        "print('BENCHJSON:' + json.dumps({'config': best, 'sps': results[best],\n"
+        "                                 'sweep': results}))\n")
     try:
-        best_cfg_sps = _cpu_subprocess(best_child, data_dir,
-                                       timeout_s=900.0)["sps"]
+        best_cfg_result = _cpu_subprocess(best_child, data_dir,
+                                          timeout_s=900.0)
+        best_cfg_sps = best_cfg_result["sps"]
+        best_cfg = best_cfg_result["config"]
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         best_cfg_sps = None
+        best_cfg = None
         print(f"best_config failed: {e!r}", file=sys.stderr)
 
     # ---- scalar columnar path: make_batch_reader -> BatchedDataLoader --
@@ -185,6 +205,8 @@ def main():
     if best_cfg_sps is not None:
         out["best_config_samples_per_sec"] = round(best_cfg_sps, 2)
         out["best_config"] = best_cfg
+        out["best_config_sweep"] = {k: round(v, 2) for k, v in
+                                    best_cfg_result["sweep"].items()}
     imagenet = None
     try:
         # Probe IMMEDIATELY before the in-process jax init (a stale earlier
