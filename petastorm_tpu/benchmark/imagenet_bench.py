@@ -68,7 +68,8 @@ def _flops_of_compiled(compiled) -> float | None:
 
 def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
                        workers_count: int = 4, pool_type: str = "thread",
-                       classes: int = 100, prefetch: int = 2) -> dict:
+                       classes: int = 100, prefetch: int = 2,
+                       remat: bool = False) -> dict:
     """One DP training run over all local devices; returns
     ``{samples_per_sec, samples_per_sec_per_chip, input_stall_pct,
     step_time_ms, model_flops_per_step_per_chip, achieved_tflops_per_chip
@@ -99,7 +100,10 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
     params = jax.device_put(resnet.init_params(jax.random.PRNGKey(0), classes),
                             replicated)
     velocity = jax.device_put(jax.tree.map(lambda p: p * 0, params), replicated)
-    raw_step = resnet.make_train_step(learning_rate=0.05)
+    # remat bounds activation memory (~83 MiB/image without it): batches
+    # >=192 on 16 GiB-class chips otherwise overflow HBM and fall off the
+    # throughput cliff documented in docs/performance.md.
+    raw_step = resnet.make_train_step(learning_rate=0.05, remat=remat)
 
     def preprocess_and_step(params, velocity, batch):
         images = batch["image"].astype(jnp.float32) / 255.0

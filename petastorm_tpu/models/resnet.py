@@ -93,8 +93,20 @@ def _bottleneck(x, block, stride, train):
     return jax.nn.relu(h + shortcut), stats
 
 
-def apply(params: Params, images, train: bool = False, compute_dtype=jnp.bfloat16):
-    """images: (N, H, W, 3) float32 in [0, 1] -> (logits, new_bn_stats)."""
+def apply(params: Params, images, train: bool = False, compute_dtype=jnp.bfloat16,
+          remat: bool = False):
+    """images: (N, H, W, 3) float32 in [0, 1] -> (logits, new_bn_stats).
+
+    ``remat=True`` wraps each bottleneck in :func:`jax.checkpoint` so the
+    backward pass recomputes block activations instead of storing them —
+    the standard FLOPs-for-HBM trade. Measured via XLA memory analysis,
+    the train step's temp memory scales ~83 MiB/image without remat
+    (21 GiB at batch 256), which overflows a 16 GiB-class chip and forces
+    involuntary spilling — the batch-256 throughput cliff in
+    docs/performance.md; remat keeps large batches inside HBM.
+    """
+    block_fn = jax.checkpoint(_bottleneck, static_argnums=(2, 3)) if remat \
+        else _bottleneck
     x = images.astype(compute_dtype)
     new_stats: Params = {"stem": {}}
     x, new_stats["stem"]["bn"] = _batch_norm(_conv(x, params["stem"]["conv"], 2),
@@ -106,7 +118,7 @@ def apply(params: Params, images, train: bool = False, compute_dtype=jnp.bfloat1
         stage_stats = []
         for block_idx in range(blocks):
             stride = 2 if (block_idx == 0 and stage_idx > 0) else 1
-            x, s = _bottleneck(x, params[f"stage{stage_idx}"][block_idx], stride, train)
+            x, s = block_fn(x, params[f"stage{stage_idx}"][block_idx], stride, train)
             stage_stats.append(s)
         new_stats[f"stage{stage_idx}"] = stage_stats
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
@@ -129,8 +141,8 @@ def merge_bn_stats(params: Params, new_stats: Params) -> Params:
     return merge(params, new_stats)
 
 
-def loss_fn(params, batch, train: bool = True):
-    logits, new_stats = apply(params, batch["image"], train=train)
+def loss_fn(params, batch, train: bool = True, remat: bool = False):
+    logits, new_stats = apply(params, batch["image"], train=train, remat=remat)
     labels = batch["label"]
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
@@ -139,11 +151,13 @@ def loss_fn(params, batch, train: bool = True):
 
 
 def make_train_step(learning_rate: float = 0.1, weight_decay: float = 1e-4,
-                    momentum: float = 0.9):
-    """SGD momentum + weight decay train step (standard ImageNet recipe)."""
+                    momentum: float = 0.9, remat: bool = False):
+    """SGD momentum + weight decay train step (standard ImageNet recipe).
+    ``remat`` rematerializes bottleneck activations in the backward pass
+    (see :func:`apply`)."""
     def train_step(params, velocity, batch):
         (loss, (acc, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, batch)
+            partial(loss_fn, remat=remat), has_aux=True)(params, batch)
         velocity = jax.tree.map(lambda v, g, p: momentum * v + g + weight_decay * p,
                                 velocity, grads, params)
         params = jax.tree.map(lambda p, v: p - learning_rate * v, params, velocity)

@@ -84,3 +84,24 @@ def test_llama_causality():
     np.testing.assert_allclose(np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]),
                                atol=1e-5)
     assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+@pytest.mark.slow
+def test_resnet_remat_matches_no_remat():
+    """jax.checkpoint remat recomputes activations without changing math:
+    loss and grads must match the stored-activation path bitwise-close."""
+    params = resnet.init_params(jax.random.PRNGKey(0), 5)
+    rng = np.random.default_rng(0)
+    batch = {"image": jnp.asarray(rng.random((2, 32, 32, 3)), jnp.float32),
+             "label": jnp.asarray([1, 3], jnp.int32)}
+    outs = {}
+    for remat in (False, True):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: resnet.loss_fn(p, batch, remat=remat),  # noqa: B023
+            has_aux=True)(params)
+        outs[remat] = (float(loss), grads)
+    assert abs(outs[True][0] - outs[False][0]) < 1e-5
+    flat_a = jax.tree.leaves(outs[False][1])
+    flat_b = jax.tree.leaves(outs[True][1])
+    for a, b in zip(flat_a, flat_b):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
