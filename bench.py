@@ -17,8 +17,9 @@ Four configs:
    measured wait-vs-compute against that step, ``imagenet_step_time_ms``,
    ``imagenet_model_flops_per_step_per_chip`` /
    ``imagenet_achieved_tflops_per_chip`` from XLA's compiled cost model
-   (per-device), and ``imagenet_mfu_pct`` when
-   ``PETASTORM_TPU_PEAK_FLOPS`` names the chip's peak. The accelerator
+   (per-device), and — on a TPU — ``imagenet_mfu_pct`` against
+   ``PETASTORM_TPU_PEAK_FLOPS`` if set, else the public bf16 peak looked
+   up from ``device_kind``. The accelerator
    probe retries with backoff spread across the run (transient tunnel
    wedges recover); CPU fallback only after the last attempt.
    Also **2b. best_config** — a sweep of host-pipeline configurations
@@ -245,11 +246,11 @@ def main():
             "imagenet_step_time_ms": round(imagenet["step_time_ms"], 2),
         })
         for key in ("model_flops_per_step_per_chip", "achieved_tflops_per_chip",
-                    "mfu_pct"):
+                    "mfu_pct", "device_kind", "peak_flops_source"):
             if key in imagenet:
-                out[f"imagenet_{key}"] = (
-                    imagenet[key] if key == "model_flops_per_step_per_chip"
-                    else round(imagenet[key], 3))
+                val = imagenet[key]
+                out[f"imagenet_{key}"] = (round(val, 3)
+                                          if isinstance(val, float) else val)
 
     print(json.dumps(out))
     return 0

@@ -52,6 +52,43 @@ def write_synthetic_imagenet(url: str, rows: int, classes: int = 100,
                          "label": np.int32(label)})
 
 
+# Public per-chip bf16 peaks (cloud.google.com/tpu docs). Used only when
+# PETASTORM_TPU_PEAK_FLOPS is unset; unknown chips report FLOP/s without MFU.
+_KNOWN_PEAK_BF16_FLOPS = (
+    ("v6", 918e12),          # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),          # v5e / "v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _peak_flops(device_kind: str):
+    """(peak_flops, source) for this chip: the PETASTORM_TPU_PEAK_FLOPS env
+    wins on a TPU; else a best-effort device_kind lookup; else (None, None).
+
+    Non-TPU devices never get a peak — the bench's CPU fallback would
+    otherwise inherit the operator's TPU peak from the environment and
+    record a meaningless ~0% MFU in the round artifact as if measured."""
+    import os
+
+    kind = (device_kind or "").lower().replace(" ", "")
+    if "tpu" not in kind:
+        return None, None
+    env = os.environ.get("PETASTORM_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            peak = float(env)
+        except ValueError:
+            peak = 0.0
+        return (peak, "env") if peak > 0 else (None, None)
+    for marker, peak in _KNOWN_PEAK_BF16_FLOPS:
+        if marker in kind:
+            return peak, f"device_kind:{device_kind}"
+    return None, None
+
+
 def _flops_of_compiled(compiled) -> float | None:
     """FLOP count from XLA's own cost model
     (``Compiled.cost_analysis()['flops']``); None when the backend does not
@@ -79,10 +116,10 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
 
     FLOP/s is XLA's compiled cost model over the measured device-step time,
     so single-chip performance is judgeable against the silicon;
-    ``mfu_pct`` is reported when the ``PETASTORM_TPU_PEAK_FLOPS`` env var
-    names the chip's peak (e.g. 4.59e14 for a v5p chip in bf16)."""
-    import os
-
+    ``mfu_pct`` is reported against ``PETASTORM_TPU_PEAK_FLOPS`` when set
+    (e.g. 4.59e14 for a v5p chip in bf16), else against the public bf16
+    peak looked up from ``device_kind`` — unknown chips report achieved
+    FLOP/s only."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -152,6 +189,7 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
         "loss_first": losses[0],
         "loss_last": losses[-1],
         "step_time_ms": 1000.0 * step_time_s,
+        "device_kind": devices[0].device_kind,
     }
     if flops_per_step is not None:
         # cost_analysis() on an SPMD executable reports PER-DEVICE flops
@@ -161,12 +199,8 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
         achieved_per_chip = flops_per_step / step_time_s
         result["model_flops_per_step_per_chip"] = flops_per_step
         result["achieved_tflops_per_chip"] = achieved_per_chip / 1e12
-        peak = os.environ.get("PETASTORM_TPU_PEAK_FLOPS")
+        peak, peak_source = _peak_flops(devices[0].device_kind)
         if peak:
-            try:
-                peak_flops = float(peak)
-            except ValueError:
-                peak_flops = 0.0
-            if peak_flops > 0:
-                result["mfu_pct"] = 100.0 * achieved_per_chip / peak_flops
+            result["mfu_pct"] = 100.0 * achieved_per_chip / peak
+            result["peak_flops_source"] = peak_source
     return result

@@ -247,3 +247,23 @@ def test_imagenet_bench_runs_on_cpu(tmp_path):
     assert r["samples_per_sec"] > 0
     assert 0.0 <= r["input_stall_pct"] <= 100.0
     assert r["global_batch"] == 2 * r["devices"]
+
+
+def test_peak_flops_lookup(monkeypatch):
+    """Env var wins on TPUs only; known TPU kinds map to public bf16 peaks;
+    non-TPU kinds never get a peak (the CPU fallback must not inherit the
+    operator's TPU peak and fake an MFU)."""
+    from petastorm_tpu.benchmark.imagenet_bench import _peak_flops
+
+    monkeypatch.delenv("PETASTORM_TPU_PEAK_FLOPS", raising=False)
+    assert _peak_flops("TPU v4") == (275e12, "device_kind:TPU v4")
+    assert _peak_flops("TPU v5p")[0] == 459e12
+    assert _peak_flops("TPU v5 lite")[0] == 197e12
+    assert _peak_flops("TPU v6e")[0] == 918e12
+    assert _peak_flops("cpu") == (None, None)
+    assert _peak_flops("") == (None, None)
+    monkeypatch.setenv("PETASTORM_TPU_PEAK_FLOPS", "1.5e14")
+    assert _peak_flops("TPU v4") == (1.5e14, "env")
+    assert _peak_flops("cpu") == (None, None)   # env never applies off-TPU
+    monkeypatch.setenv("PETASTORM_TPU_PEAK_FLOPS", "garbage")
+    assert _peak_flops("TPU v4") == (None, None)
