@@ -105,3 +105,19 @@ def test_resnet_remat_matches_no_remat():
     flat_b = jax.tree.leaves(outs[True][1])
     for a, b in zip(flat_a, flat_b):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_llama_embed_onehot_matches_gather():
+    """The one-hot embedding contraction (used when the table is
+    vocab-sharded) is numerically identical to the gather: products are
+    exactly 0 or the embedding value and accumulation adds only zeros."""
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab, (2, 17)), jnp.int32)}
+    losses = {mode: float(llama.loss_fn(params, batch, cfg, embed_lookup=mode))
+              for mode in ("gather", "onehot")}
+    assert losses["gather"] == pytest.approx(losses["onehot"], abs=1e-6)
+    with pytest.raises(ValueError, match="embed_lookup"):
+        llama.loss_fn(params, batch, cfg, embed_lookup="typo")
