@@ -1,7 +1,7 @@
 """Round benchmark. Prints ONE JSON line:
 ``{"metric", "value", "unit", "vs_baseline", ...extras}``.
 
-Four configs:
+Five phases:
 
 1. **hello_world (headline, ``vs_baseline``)** — the reference's only
    published absolute number: 709.84 samples/sec on the 10-row tutorial
@@ -11,7 +11,16 @@ Four configs:
 2. **hello_world_10k** — same schema scaled to 10k rows / 100-row groups so
    the number reflects steady-state decode+IO throughput rather than
    10-row loop overhead (extra key ``hello_world_10k_samples_per_sec``).
-3. **imagenet** — the BASELINE.md target workload: jpeg-decode-bound reader
+3. **best_config** — a sweep of host-pipeline configurations on the 10k
+   store (thread pool, dummy+coalescing, process pool over the shm ring +
+   native decode + coalescing); the measured winner is reported as
+   ``best_config_samples_per_sec``/``best_config`` with the per-config
+   breakdown in ``best_config_sweep``.
+4. **scalar_batched** — the columnar path (``make_batch_reader`` ->
+   ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
+   key ``scalar_batched_samples_per_sec`` (the reference only ever made a
+   qualitative "significantly higher throughput" claim here, README.rst:242).
+5. **imagenet** — the BASELINE.md target workload: jpeg-decode-bound reader
    feeding a real jitted ResNet-50 train step on the local chip(s); extra
    keys ``imagenet_samples_per_sec`` (per chip), ``imagenet_input_stall_pct``
    measured wait-vs-compute against that step, ``imagenet_step_time_ms``,
@@ -19,17 +28,9 @@ Four configs:
    ``imagenet_achieved_tflops_per_chip`` from XLA's compiled cost model
    (per-device), and — on a TPU — ``imagenet_mfu_pct`` against
    ``PETASTORM_TPU_PEAK_FLOPS`` if set, else the public bf16 peak looked
-   up from ``device_kind``. The accelerator
-   probe retries with backoff spread across the run (transient tunnel
+   up from ``device_kind``. The accelerator probe runs immediately before
+   the in-process jax init and retries with backoff (transient tunnel
    wedges recover); CPU fallback only after the last attempt.
-   Also **2b. best_config** — a sweep of host-pipeline configurations
-   (thread pool, dummy+coalescing, process pool over the shm ring +
-   native decode + coalescing) on the 10k store; the measured winner is
-   reported as ``best_config_samples_per_sec``/``best_config``.
-4. **scalar_batched** — the columnar path (``make_batch_reader`` ->
-   ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
-   key ``scalar_batched_samples_per_sec`` (the reference only ever made a
-   qualitative "significantly higher throughput" claim here, README.rst:242).
 """
 import json
 import os
