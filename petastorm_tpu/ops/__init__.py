@@ -1,7 +1,14 @@
-"""Device-side ops for the input-pipeline tail (normalize, augment)."""
+"""Device-side ops: input-pipeline tail kernels and flash attention.
+
+Normalize/augment run inside the jitted step so the host ships compact
+uint8 batches; ``flash_attention`` is the Pallas O(seq)-memory attention
+kernel.
+"""
 from petastorm_tpu.ops.augment import (cutout, mixup, random_crop,
                                        random_flip_horizontal)
+from petastorm_tpu.ops.flash_attention import (flash_attention,
+                                               make_flash_attention)
 from petastorm_tpu.ops.image_ops import normalize_images
 
 __all__ = ["normalize_images", "random_flip_horizontal", "random_crop",
-           "cutout", "mixup"]
+           "cutout", "mixup", "flash_attention", "make_flash_attention"]

@@ -1,0 +1,186 @@
+"""Flash attention as a Pallas TPU kernel: O(seq) memory attention.
+
+XLA's plain softmax attention materializes the O(seq^2) score matrix in
+HBM; this kernel never does. Design (flash-attention-2 style, TPU-first):
+
+* grid = (batch, q_heads, q_blocks, kv_blocks), kv innermost — TPU grids
+  execute sequentially, so the online-softmax state (running max ``m``,
+  normalizer ``l``, unnormalized accumulator ``acc``) lives in VMEM
+  scratch carried across the kv dimension. VMEM holds ONE q tile and ONE
+  K/V tile at a time (O(block * d), not O(seq * d)), which is what makes
+  long sequences fit;
+* grouped-query attention is native: the K/V BlockSpec index-maps the
+  q-head grid coordinate onto its kv head (``h // rep``) — K/V are never
+  repeated in memory;
+* causal programs whose K/V tile lies entirely above the diagonal skip
+  the matmuls via ``pl.when`` (the tile DMA still happens — acceptable:
+  bandwidth is prefetch-pipelined, MXU time is not);
+* scores accumulate in float32 regardless of input dtype (numerics parity
+  with :func:`petastorm_tpu.parallel.attention.dense_attention`);
+* the backward pass recomputes through the dense path via ``custom_vjp``
+  — the standard memory/FLOPs trade (no O(seq^2) residuals are stored),
+  and gradients are exactly the dense path's gradients;
+* off-TPU the kernel runs in Pallas interpret mode (tests), and shapes
+  that don't tile cleanly (seq not divisible by an 8-aligned block, or
+  ``causal`` with ``sq != sk``) fall back to the dense path —
+  numerically identical either way.
+
+Used as a drop-in ``attn_fn`` for :mod:`petastorm_tpu.models.llama` via
+:func:`make_flash_attention` (``supports_gqa`` — K/V stay at kv-head
+width). Fusing it into the ring-attention local step (the kernel would
+need to emit its m/l stats for the cross-device merge) is the next step.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DEFAULT_BLOCK = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_q: int, block_k: int, causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_off, k_off = qi * block_q, ki * block_k
+    # Tiles fully above the causal diagonal contribute nothing: skip the
+    # MXU work (roughly halves causal kernel time at long seq).
+    live = jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale        # (bq, d)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)                # (bk, d)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = q @ k.T                                              # (bq, bk)
+        if causal:
+            qpos = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_prev, l_prev = m_ref[:, 0], l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        # m_new is finite from the first live block (causal keeps the
+        # diagonal), so exp never sees inf-inf; a still--inf running max
+        # contributes alpha=0 exactly.
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_prev * alpha + p.sum(axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        o_ref[0, :, 0, :] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(
+            o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk, kv_h = k.shape[1], k.shape[2]
+    rep = h // kv_h
+    kernel = partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                     causal=causal, scale=1.0 / np.sqrt(d))
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, d),
+                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, d),
+                               lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),      # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),      # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),      # normalizer l
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense(q, k, v, causal):
+    from petastorm_tpu.parallel.attention import dense_attention
+    return dense_attention(q, k, v, causal=causal)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_vjp(causal, block_q, block_k, interpret, q, k, v):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(causal, block_q, block_k, interpret, q, k, v):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, residual, g):
+    # Recompute-through-dense backward: same function, so the same
+    # gradients; forward saved only (q, k, v) — no O(seq^2) residuals.
+    q, k, v = residual
+    _, vjp = jax.vjp(lambda q_, k_, v_: _dense(q_, k_, v_, causal), q, k, v)
+    return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: int = _DEFAULT_BLOCK,
+                    block_k: int = _DEFAULT_BLOCK,
+                    interpret=None):
+    """Drop-in for :func:`...parallel.attention.dense_attention`:
+    q ``(b, sq, heads, d)``, k/v ``(b, sk, kv_heads, d)`` ->
+    ``(b, sq, heads, d)``, grouped-query native.
+
+    Falls back to the dense path when the shape can't tile onto the
+    hardware: seq not divisible by the (clamped) block, a clamped block
+    not a multiple of 8 (Mosaic's second-minor tile granule — catches
+    e.g. seq=100), or ``causal`` with ``sq != sk`` (the mask diagonal
+    would straddle blocks). ``interpret=None`` auto-enables the Pallas
+    interpreter off-TPU so tests run on CPU.
+    """
+    b, sq, h, d = q.shape
+    sk, kv_h = k.shape[1], k.shape[2]
+    if h % kv_h:
+        raise ValueError(f"heads ({h}) must be a multiple of kv_heads ({kv_h})")
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if (sq % block_q or sk % block_k or block_q % 8 or block_k % 8
+            or (causal and sq != sk)):
+        return _dense(q, k, v, causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_vjp(causal, block_q, block_k, bool(interpret), q, k, v)
+
+
+def make_flash_attention(causal: bool = True, block_q: int = _DEFAULT_BLOCK,
+                         block_k: int = _DEFAULT_BLOCK, interpret=None):
+    """An ``attn_fn`` for :func:`petastorm_tpu.models.llama.apply`
+    (``supports_gqa``: K/V arrive at native kv-head width)."""
+    def attn(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+    attn.supports_gqa = True
+    return attn
