@@ -6,7 +6,7 @@ kernel.
 """
 from petastorm_tpu.ops.augment import (cutout, mixup, random_crop,
                                        random_flip_horizontal)
-from petastorm_tpu.ops.flash_attention import (flash_attention,
+from petastorm_tpu.ops.flash_attn import (flash_attention,
                                                make_flash_attention)
 from petastorm_tpu.ops.image_ops import normalize_images
 

@@ -17,9 +17,11 @@ HBM; this kernel never does. Design (flash-attention-2 style, TPU-first):
   bandwidth is prefetch-pipelined, MXU time is not);
 * scores accumulate in float32 regardless of input dtype (numerics parity
   with :func:`petastorm_tpu.parallel.attention.dense_attention`);
-* the backward pass recomputes through the dense path via ``custom_vjp``
-  — the standard memory/FLOPs trade (no O(seq^2) residuals are stored),
-  and gradients are exactly the dense path's gradients;
+* the backward pass recomputes through a CHUNKED dense path via
+  ``custom_vjp``: q blocks run under ``jax.checkpoint`` inside
+  ``lax.map``, so differentiating stores no O(seq^2) residuals and peaks
+  at O(block * seq) score memory per chunk — training keeps the linear
+  memory story, at the standard recompute-FLOPs cost;
 * off-TPU the kernel runs in Pallas interpret mode (tests), and shapes
   that don't tile cleanly (seq not divisible by an 8-aligned block, or
   ``causal`` with ``sq != sk``) fall back to the dense path —
@@ -126,6 +128,40 @@ def _dense(q, k, v, causal):
     return dense_attention(q, k, v, causal=causal)
 
 
+def _chunked_dense(q, k, v, causal: bool, block_q: int):
+    """Same function as :func:`_dense`, computed one q block at a time with
+    each block under ``jax.checkpoint`` — differentiating through this
+    stores only the block inputs, so the backward pass recomputes scores
+    chunk-by-chunk at O(block_q * seq) peak instead of materializing the
+    full O(seq^2) matrix. Reuses the ring's offset-masked block kernel so
+    the numerics (f32 scores, GQA grouping, masked-row guards) stay in one
+    place."""
+    from petastorm_tpu.parallel.ring_attention import _block_attention
+
+    b, sq, h, d = q.shape
+    if sq % block_q:
+        return _dense(q, k, v, causal)
+    lk = k.shape[1]
+    nq = sq // block_q
+    q_blocks = q.reshape(b, nq, block_q, h, d).transpose(1, 0, 2, 3, 4)
+    offsets = jnp.arange(nq) * block_q
+
+    @jax.checkpoint
+    def chunk(q_blk, off):
+        if causal:
+            qpos = off + jnp.arange(block_q)
+            bias = jnp.where(qpos[:, None] >= jnp.arange(lk)[None, :],
+                             0.0, -jnp.inf)[None, None]
+        else:
+            bias = jnp.zeros((1, 1, block_q, lk), jnp.float32)
+        o, _, l = _block_attention(q_blk, k, v, bias)
+        l = jnp.maximum(l, 1e-20)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q_blk.dtype)
+
+    out = jax.lax.map(lambda args: chunk(*args), (q_blocks, offsets))
+    return out.transpose(1, 0, 2, 3, 4).reshape(b, sq, h, d)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
 def _flash_vjp(causal, block_q, block_k, interpret, q, k, v):
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
@@ -136,10 +172,13 @@ def _flash_vjp_fwd(causal, block_q, block_k, interpret, q, k, v):
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, residual, g):
-    # Recompute-through-dense backward: same function, so the same
-    # gradients; forward saved only (q, k, v) — no O(seq^2) residuals.
+    # Recompute backward through the chunked dense path: same function, so
+    # the same gradients; forward saved only (q, k, v), and the chunking +
+    # jax.checkpoint keep the recompute at O(block_q * seq) score memory.
     q, k, v = residual
-    _, vjp = jax.vjp(lambda q_, k_, v_: _dense(q_, k_, v_, causal), q, k, v)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_dense(q_, k_, v_, causal, block_q),
+        q, k, v)
     return vjp(g)
 
 

@@ -12,12 +12,14 @@ Two ``lax.all_to_all`` collectives bracket a plain local attention:
 
 Versus :mod:`ring_attention` (P ``ppermute`` steps, O(block²) memory,
 perfectly causal-efficient): Ulysses is two collectives total — better when
-the interconnect favors fewer, larger transfers and ``heads >= P`` — but it
-materializes the full (seq x seq) score matrix for each of its
-``heads/P`` local heads, so peak score memory is O(seq² x heads_per_device):
-more sequence shards shrink it, more local heads grow it. Both are exact;
-pick per workload (DeepSpeed-Ulysses, Jacobs et al., arXiv:2309.14509; see
-PAPERS.md — pattern reference only).
+the interconnect favors fewer, larger transfers and ``heads >= P``. With the
+default dense local step it materializes the full (seq x seq) score matrix
+for each of its ``heads/P`` local heads (peak score memory
+O(seq² x heads_per_device)); ``local_attn="flash"`` swaps in the Pallas
+flash kernel (:mod:`petastorm_tpu.ops.flash_attn`) whose online
+softmax keeps the local step at O(seq) memory, removing that caveat on
+TPU. Both are exact; pick per workload (DeepSpeed-Ulysses, Jacobs et al.,
+arXiv:2309.14509; see PAPERS.md — pattern reference only).
 
 Composes with tensor parallelism exactly like ring attention: shard heads on
 the model axis first, then the LOCAL head count must divide the seq axis.
@@ -32,13 +34,19 @@ import jax
 from petastorm_tpu.parallel.attention import dense_attention
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      local_attn: str = "dense"):
     """Exact (optionally causal) attention across a sequence-sharded axis
     via two all-to-alls. Must run inside ``shard_map``.
 
     Local shapes: q/k/v are ``(batch_shard, seq_block, heads, head_dim)``;
     ``heads`` must be divisible by the ``axis_name`` axis size.
+    ``local_attn="flash"`` runs the post-exchange full-sequence attention
+    through the Pallas flash kernel (O(seq) memory; untileable shapes
+    fall back to dense inside it).
     """
+    if local_attn not in ("dense", "flash"):
+        raise ValueError(f"unknown local_attn {local_attn!r}")
     p = jax.lax.axis_size(axis_name)
     h, kv_h = q.shape[2], k.shape[2]
     if h % p or kv_h % p:
@@ -58,15 +66,20 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    out = dense_attention(seq_to_head(q), seq_to_head(k), seq_to_head(v),
-                          causal=causal)
+    if local_attn == "flash":
+        from petastorm_tpu.ops.flash_attn import flash_attention
+        local = partial(flash_attention, causal=causal)
+    else:
+        local = partial(dense_attention, causal=causal)
+    out = local(seq_to_head(q), seq_to_head(k), seq_to_head(v))
     return head_to_seq(out).astype(q.dtype)
 
 
 def make_ulysses_attention(mesh, seq_axis: str = "seq",
                            data_axis: str = "data",
                            head_axis: Optional[str] = None,
-                           causal: bool = True):
+                           causal: bool = True,
+                           local_attn: str = "dense"):
     """Build a ``shard_map``-wrapped Ulysses attention over ``mesh``.
 
     Drop-in interchangeable with :func:`make_ring_attention` — same
@@ -78,7 +91,8 @@ def make_ulysses_attention(mesh, seq_axis: str = "seq",
     from jax.sharding import PartitionSpec as P
 
     spec = P(data_axis, seq_axis, head_axis, None)
-    fn = partial(ulysses_attention, axis_name=seq_axis, causal=causal)
+    fn = partial(ulysses_attention, axis_name=seq_axis, causal=causal,
+                 local_attn=local_attn)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
 

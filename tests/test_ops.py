@@ -122,7 +122,7 @@ def _attn_inputs(s=256, h=4, kvh=2, d=64, dtype=jnp.float32, seed=0):
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("kvh", [4, 2])  # MHA and grouped-query
 def test_flash_attention_matches_dense(causal, kvh):
-    from petastorm_tpu.ops.flash_attention import flash_attention
+    from petastorm_tpu.ops.flash_attn import flash_attention
     from petastorm_tpu.parallel.attention import dense_attention
 
     q, k, v = _attn_inputs(kvh=kvh)
@@ -132,7 +132,7 @@ def test_flash_attention_matches_dense(causal, kvh):
 
 
 def test_flash_attention_grads_match_dense():
-    from petastorm_tpu.ops.flash_attention import flash_attention
+    from petastorm_tpu.ops.flash_attn import flash_attention
     from petastorm_tpu.parallel.attention import dense_attention
 
     q, k, v = _attn_inputs(s=128)
@@ -145,7 +145,7 @@ def test_flash_attention_grads_match_dense():
 
 
 def test_flash_attention_bf16():
-    from petastorm_tpu.ops.flash_attention import flash_attention
+    from petastorm_tpu.ops.flash_attn import flash_attention
     from petastorm_tpu.parallel.attention import dense_attention
 
     q, k, v = _attn_inputs(s=128, dtype=jnp.bfloat16)
@@ -167,7 +167,7 @@ def test_flash_attention_untileable_falls_back(monkeypatch):
 
     # The package re-export shadows the submodule attribute; resolve the
     # module itself to patch its internals.
-    fa_mod = importlib.import_module("petastorm_tpu.ops.flash_attention")
+    fa_mod = importlib.import_module("petastorm_tpu.ops.flash_attn")
 
     def _boom(*a, **kw):
         raise AssertionError("kernel must not run for untileable shapes")
@@ -186,7 +186,7 @@ def test_flash_attention_in_llama():
     """make_flash_attention drops into llama.apply as attn_fn (GQA-native)
     and reproduces the dense-attention loss."""
     from petastorm_tpu.models import llama
-    from petastorm_tpu.ops.flash_attention import make_flash_attention
+    from petastorm_tpu.ops.flash_attn import make_flash_attention
 
     cfg = llama.LlamaConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
                             n_kv_heads=2, hidden=96)
@@ -198,3 +198,19 @@ def test_flash_attention_in_llama():
     flash = float(llama.loss_fn(params, batch, cfg,
                                 attn_fn=make_flash_attention(causal=True)))
     assert flash == pytest.approx(base, abs=5e-3)
+
+
+def test_flash_attention_multichunk_grads_match_dense():
+    """s=256 with block 128 -> two q chunks through the checkpointed
+    backward; grads must still equal the dense path's."""
+    from petastorm_tpu.ops.flash_attn import flash_attention
+    from petastorm_tpu.parallel.attention import dense_attention
+
+    q, k, v = _attn_inputs(s=256)
+    for causal in (False, True):
+        gf = jax.grad(lambda *a: (flash_attention(*a, causal=causal) ** 2).sum(),  # noqa: B023
+                      argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda *a: (dense_attention(*a, causal=causal) ** 2).sum(),  # noqa: B023
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
