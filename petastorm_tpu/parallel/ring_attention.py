@@ -57,11 +57,51 @@ def _block_attention(q, k, v, bias):
     return o.astype(jnp.float32), m, l
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def _causal_bias(q_pos, k_pos):
+    return jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                     -jnp.inf)[None, None]
+
+
+def _block_attention_chunked(q, k, v, k_pos, q_pos, causal: bool,
+                             block_q: int):
+    """:func:`_block_attention` computed q-chunk by q-chunk, each chunk
+    under ``jax.checkpoint``: per-ring-step score memory drops from
+    O(lq * lk) to O(block_q * lk) in BOTH directions — q rows are
+    independent, so per-chunk (o, m, l) stats concatenate exactly, and the
+    causal bias is built per chunk from positions INSIDE the checkpointed
+    body (a precomputed full bias would itself be an O(lq * lk) residual).
+    The flash-attention memory recipe without a second kernel."""
+    b, lq, h, d = q.shape
+    if lq <= block_q:
+        bias = _causal_bias(q_pos, k_pos) if causal else \
+            jnp.zeros((1, 1, lq, k.shape[1]), jnp.float32)
+        return _block_attention(q, k, v, bias)
+    nq = lq // block_q
+    q_chunks = q.reshape(b, nq, block_q, h, d).transpose(1, 0, 2, 3, 4)
+    qpos_chunks = q_pos.reshape(nq, block_q)
+
+    @jax.checkpoint
+    def chunk(q_blk, qpos_blk):
+        bias = _causal_bias(qpos_blk, k_pos) if causal else \
+            jnp.zeros((1, 1, block_q, k.shape[1]), jnp.float32)
+        return _block_attention(q_blk, k, v, bias)
+
+    o, m, l = jax.lax.map(lambda args: chunk(*args), (q_chunks, qpos_chunks))
+    return (o.transpose(1, 0, 2, 3, 4).reshape(b, lq, h, d),
+            m.transpose(1, 2, 0, 3).reshape(b, h, lq),
+            l.transpose(1, 2, 0, 3).reshape(b, h, lq))
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   local_block_q: Optional[int] = None):
     """Exact (optionally causal) attention across a sequence-sharded ring.
 
     Must run inside ``shard_map``; ``axis_name`` is the sequence mesh axis.
     Returns the attention output for the local q block, same shape/dtype as q.
+    ``local_block_q`` chunks each ring step's local attention over q with
+    per-chunk rematerialization — peak score memory per step becomes
+    O(local_block_q * block) instead of O(block²), for sequence shards too
+    long to hold their own score tile.
     """
     axis_size = jax.lax.axis_size(axis_name)
     my_index = jax.lax.axis_index(axis_name)
@@ -70,9 +110,24 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     if h % k.shape[2]:
         raise ValueError(f"heads ({h}) must be a multiple of kv_heads "
                          f"({k.shape[2]})")
+    if local_block_q is not None and lq % local_block_q and lq > local_block_q:
+        # Silently skipping the chunking would quietly lose the memory
+        # bound the caller asked for — exactly on the long shards where
+        # it matters.
+        raise ValueError(f"local q length ({lq}) must be divisible by "
+                         f"local_block_q ({local_block_q})")
 
     # Global positions of the local q rows.
     q_pos = my_index * lq + jnp.arange(lq)
+
+    if local_block_q is None:
+        def local_attention(q_, k_blk, v_blk, k_pos):
+            bias = _causal_bias(q_pos, k_pos) if causal else \
+                jnp.zeros((1, 1, lq, lk), jnp.float32)
+            return _block_attention(q_, k_blk, v_blk, bias)
+    else:
+        local_attention = partial(_block_attention_chunked, q_pos=q_pos,
+                                  causal=causal, block_q=local_block_q)
 
     def step(carry, step_idx):
         k_blk, v_blk, o_acc, m_acc, l_acc = carry
@@ -81,9 +136,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         k_pos = kv_index * lk + jnp.arange(lk)
         if causal:
             def compute(_):
-                bias = jnp.where(q_pos[:, None] >= k_pos[None, :],
-                                 0.0, -jnp.inf)[None, None]
-                return _block_attention(q, k_blk, v_blk, bias)
+                return local_attention(q, k_blk, v_blk, k_pos)
 
             def skip(_):
                 return (jnp.zeros((b, lq, h, d), jnp.float32),
@@ -101,8 +154,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
             o_blk, m_blk, l_blk = jax.lax.cond(fully_masked, skip, compute,
                                                None)
         else:
-            bias = jnp.zeros((1, 1, lq, lk), jnp.float32)
-            o_blk, m_blk, l_blk = _block_attention(q, k_blk, v_blk, bias)
+            o_blk, m_blk, l_blk = local_attention(q, k_blk, v_blk, k_pos)
         # Online-softmax merge of the running and new block statistics.
         m_new = jnp.maximum(m_acc, m_blk)
         # Guard fully-masked blocks: exp(-inf - -inf) -> use finite fallback.
@@ -129,19 +181,22 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
 
 def make_ring_attention(mesh, seq_axis: str = "seq", data_axis: str = "data",
-                        head_axis: Optional[str] = None, causal: bool = True):
+                        head_axis: Optional[str] = None, causal: bool = True,
+                        local_block_q: Optional[int] = None):
     """Build a ``shard_map``-wrapped ring attention over ``mesh``.
 
     Input/output layout: (batch, seq, heads, head_dim) with batch sharded on
     ``data_axis``, seq sharded on ``seq_axis``, and heads optionally sharded
     on ``head_axis`` (tensor parallelism composes: each model shard rings its
-    own heads).
+    own heads). ``local_block_q`` bounds each ring step's local score
+    memory (see :func:`ring_attention`).
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(data_axis, seq_axis, head_axis, None)
-    fn = partial(ring_attention, axis_name=seq_axis, causal=causal)
+    fn = partial(ring_attention, axis_name=seq_axis, causal=causal,
+                 local_block_q=local_block_q)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
 

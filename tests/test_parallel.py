@@ -501,3 +501,37 @@ def test_ulysses_flash_local_step_matches_dense(causal):
     dense = jax.jit(make_ulysses_attention(mesh, causal=causal))
     np.testing.assert_allclose(np.asarray(flash(q, k, v)),
                                np.asarray(dense(q, k, v)), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_chunked_local_step_matches_default(causal):
+    """local_block_q chunks each ring step's local attention with per-chunk
+    remat; values and grads must equal the unchunked ring exactly (q rows
+    are independent, so per-chunk stats concatenate)."""
+    from petastorm_tpu.parallel.ring_attention import make_ring_attention
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(4, 128, 8, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(4, 128, 4, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(4, 128, 4, 16)), jnp.float32)
+    base = jax.jit(make_ring_attention(mesh, causal=causal))
+    chunked = jax.jit(make_ring_attention(mesh, causal=causal,
+                                          local_block_q=8))
+    np.testing.assert_allclose(np.asarray(chunked(q, k, v)),
+                               np.asarray(base(q, k, v)), atol=2e-5)
+    gb = jax.grad(lambda *a: (base(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(lambda *a: (chunked(*a) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gc, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ring_chunked_rejects_non_divisible_block():
+    """Silently dropping the chunking would lose the promised memory bound;
+    a mismatched local_block_q must raise at trace time."""
+    from petastorm_tpu.parallel.ring_attention import make_ring_attention
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    q = jnp.zeros((4, 96, 8, 16), jnp.float32)   # 24 per shard, block 9
+    attn = make_ring_attention(mesh, causal=True, local_block_q=9)
+    with pytest.raises(ValueError, match="local_block_q"):
+        attn(q, q[:, :, :4], q[:, :, :4])
