@@ -48,7 +48,8 @@ def write_token_stream(url: str, n_chunks: int, vocab: int, seed: int = 0):
 
 
 def train(url: str, steps: int = 30, per_shard_batch: int = 2,
-          window: int = 8, vocab: int = 256, dp: int = 2, sp: int = 4):
+          window: int = 8, vocab: int = 256, dp: int = 2, sp: int = 4,
+          attn_kind: str = "ring"):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -72,8 +73,24 @@ def train(url: str, steps: int = 30, per_shard_batch: int = 2,
 
     cfg = llama.LlamaConfig(vocab=vocab, dim=128, n_layers=2, n_heads=8,
                             n_kv_heads=4, hidden=256)
-    attn = make_ring_attention(mesh, seq_axis="seq", data_axis="data",
-                               causal=True)
+    # Sequence-parallel attention menu (all exact, all GQA-native):
+    # ring ppermute streaming; ring with chunked+remat local steps
+    # (bounded per-step score memory); Ulysses all-to-all; Ulysses with
+    # the Pallas flash local step.
+    if attn_kind == "ring":
+        attn = make_ring_attention(mesh, seq_axis="seq", data_axis="data",
+                                   causal=True)
+    elif attn_kind == "ring-chunked":
+        attn = make_ring_attention(mesh, seq_axis="seq", data_axis="data",
+                                   causal=True, local_block_q=CHUNK // 2)
+    elif attn_kind in ("ulysses", "ulysses-flash"):
+        from petastorm_tpu.parallel.ulysses_attention import \
+            make_ulysses_attention
+        attn = make_ulysses_attention(
+            mesh, seq_axis="seq", data_axis="data", causal=True,
+            local_attn="flash" if attn_kind == "ulysses-flash" else "dense")
+    else:
+        raise ValueError(f"unknown attn kind {attn_kind!r}")
     act_spec = NamedSharding(mesh, P("data", "seq", None))
     params = jax.device_put(llama.init_params(jax.random.PRNGKey(0), cfg),
                             NamedSharding(mesh, P()))
@@ -139,12 +156,15 @@ def main():
     parser.add_argument("--window", type=int, default=8)
     parser.add_argument("--dp", type=int, default=2)
     parser.add_argument("--sp", type=int, default=4)
+    parser.add_argument("--attn", default="ring",
+                        choices=["ring", "ring-chunked", "ulysses",
+                                 "ulysses-flash"])
     args = parser.parse_args()
     import os
     if not os.path.exists(args.url.replace("file://", "") + "/_common_metadata"):
         write_token_stream(args.url, args.chunks, args.vocab)
     train(args.url, steps=args.steps, window=args.window, vocab=args.vocab,
-          dp=args.dp, sp=args.sp)
+          dp=args.dp, sp=args.sp, attn_kind=args.attn)
 
 
 if __name__ == "__main__":

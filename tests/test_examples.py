@@ -138,3 +138,15 @@ def test_long_context_example_trains_on_mesh(tmp_path):
     losses = ex.train(url, steps=25, per_shard_batch=2, window=4,
                       vocab=256, dp=2, sp=4)
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("attn_kind", ["ring-chunked", "ulysses-flash"])
+def test_long_context_example_attention_menu(tmp_path, attn_kind):
+    """The example's alternative sequence-parallel attentions (chunked-remat
+    ring, Ulysses with the Pallas flash local step) train the same model."""
+    ex = _load_example("long_context")
+    url = f"file://{tmp_path}/lctx_{attn_kind}"
+    ex.write_token_stream(url, n_chunks=512, vocab=256)
+    losses = ex.train(url, steps=6, per_shard_batch=2, window=4,
+                      vocab=256, dp=2, sp=4, attn_kind=attn_kind)
+    assert np.isfinite(losses).all()
