@@ -1,0 +1,105 @@
+"""Guards for tools/tpu_evidence.py — the opportunistic TPU evidence
+capture. Its children only ever execute on the TPU host inside a scarce
+healthy-tunnel window, so every bug they can have must be caught here
+instead (same rationale as the bench.py snippet guard,
+test_reader_misc_depth.py::test_bench_embedded_children_compile_and_run).
+"""
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def te(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_evidence_under_test",
+        pathlib.Path(__file__).parent.parent / "tools" / "tpu_evidence.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "EVIDENCE_PATH", str(tmp_path / "ev.jsonl"))
+    return mod
+
+
+def test_child_templates_format_and_compile(te):
+    """The templates are str.format()-expanded, so every literal brace must
+    be doubled — an unescaped f-string or dict brace raises KeyError here,
+    not at capture time on the TPU host."""
+    for name in ("_PROBE_CHILD", "_IMAGENET_CHILD", "_FLASH_CHILD"):
+        code = getattr(te, name).format(alarm=7)
+        compile(code, name, "exec")
+        assert "signal.alarm(7)" in code
+
+
+def test_imagenet_child_generates_data_before_alarm(te):
+    """Datagen is minutes of pure-CPU work on the 1-core host; it must not
+    run on the alarm clock or a slow gen reads as a tunnel wedge."""
+    code = te._IMAGENET_CHILD.format(alarm=900)
+    assert code.index("write_synthetic_imagenet") < code.index(
+        "signal.alarm(900)")
+
+
+def test_append_and_latest_evidence_roundtrip(te):
+    te.append_evidence({"event": "probe", "status": "skipped", "reason": "x"})
+    te.append_evidence({"event": "flash_attn", "status": "ok", "speedup_seq4096": 2.0})
+    te.append_evidence({"event": "imagenet", "status": "skipped", "reason": "y"})
+    lines = [json.loads(ln) for ln in
+             open(te.EVIDENCE_PATH).read().splitlines()]
+    assert [ln["event"] for ln in lines] == ["probe", "flash_attn", "imagenet"]
+    assert all("ts" in ln for ln in lines)
+    # filtered: only ok records of the named event
+    assert te.latest_evidence("imagenet") is None
+    assert te.latest_evidence("flash_attn")["speedup_seq4096"] == 2.0
+    # unfiltered: the most recent record of any kind
+    assert te.latest_evidence()["event"] == "imagenet"
+
+
+def test_latest_evidence_tolerates_garbage_lines(te):
+    with open(te.EVIDENCE_PATH, "w") as f:
+        f.write('{"event": "probe", "status": "ok", "ts": "t"}\n')
+        f.write("not json at all\n")
+        f.write("\n")
+    assert te.latest_evidence("probe")["ts"] == "t"
+
+
+def test_run_phase_records_skipped_on_child_failure(te):
+    te._run_phase("unit", "import sys; sys.exit({alarm})", alarm_s=5)
+    rec = te.latest_evidence()
+    assert rec["event"] == "unit" and rec["status"] == "skipped"
+    assert "rc=5" in rec["reason"]
+
+
+def test_run_phase_records_skipped_on_truncated_payload(te):
+    # Child emits a truncated BENCHJSON line then dies: the parse failure
+    # must fall through to an honest skipped record, not a traceback.
+    child = ("import sys; sys.stdout.write('BENCHJSON:{{\"half\": ');"
+             " sys.stdout.flush(); sys.exit(1)  # alarm={alarm}")
+    te._run_phase("unit", child, alarm_s=5)
+    rec = te.latest_evidence()
+    assert rec["status"] == "skipped"
+
+
+def test_run_phase_records_ok_payload(te):
+    child = "import json; print('BENCHJSON:' + json.dumps({{'v': {alarm}}}))"
+    out = te._run_phase("unit", child, alarm_s=9)
+    assert out == {"v": 9}
+    rec = te.latest_evidence("unit")
+    assert rec["status"] == "ok" and rec["v"] == 9
+
+
+def test_probe_maps_rc42_to_cpu_only(te, monkeypatch):
+    """rc 42 is the deterministic clean-CPU-backend signal (advisor round-3
+    finding: rc 1 conflated crash with no-accelerator); anything else
+    nonzero must read as wedged/retryable."""
+    import subprocess
+
+    class R:
+        def __init__(self, rc):
+            self.returncode = rc
+            self.stdout, self.stderr = "", ""
+
+    for rc, expect in ((42, "cpu-only"), (1, "wedged"), (-14, "wedged")):
+        monkeypatch.setattr(subprocess, "run", lambda *a, rc=rc, **k: R(rc))
+        assert te.probe(alarm_s=1)[0] == expect

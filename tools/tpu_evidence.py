@@ -1,0 +1,294 @@
+"""Opportunistic TPU evidence capture (round-4 verdict items 1 & 2).
+
+The bench host reaches its single TPU chip through a tunnel that wedges
+for hours at a time; three rounds of ``bench.py`` runs landed in wedged
+windows and the round artifacts carry only CPU-fallback numbers. This
+tool decouples *measuring* from *the one end-of-round bench run*: run it
+whenever convenient (interactively, from a cron loop, or from bench.py
+itself) and every successful on-chip measurement is appended to the
+committed ``BENCH_TPU_EVIDENCE.jsonl`` so a later wedge can't erase the
+proof. Failed attempts append honest ``status: skipped`` records with the
+wedge mode, so the artifact also documents the attempts.
+
+Phases (each in its own SIGALRM-guarded subprocess — a wedged PJRT init
+hangs uninterruptibly, and a mid-run tunnel drop poisons the process's
+PJRT client, so nothing TPU-facing runs in this parent):
+
+* ``probe``    — bring up the backend, one tiny matmul. rc 0 = healthy
+  accelerator, rc 42 = clean CPU-only backend (deterministic: retry is
+  pointless), anything else = wedged/transient (retryable).
+* ``imagenet`` — the BASELINE.md target workload on the real chip:
+  :func:`petastorm_tpu.benchmark.imagenet_bench.run_imagenet_bench` at
+  the config the round-2 interactive sweep measured best (batch 128,
+  8 thread workers).
+* ``flash_attn`` — compiles ``ops/flash_attn.py`` for real (NOT Pallas
+  interpret mode), asserts on-device numerics vs the dense path, and
+  times kernel vs XLA dense attention at seq 4k/8k. This is the first
+  (and only) place the kernel's Mosaic lowering and VMEM fit are
+  validated on silicon.
+
+Usage::
+
+    python tools/tpu_evidence.py                 # probe; if healthy, all phases
+    python tools/tpu_evidence.py --probe-only    # just record tunnel health
+    python tools/tpu_evidence.py --phases flash_attn
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE_PATH = os.path.join(REPO_ROOT, "BENCH_TPU_EVIDENCE.jsonl")
+
+_PROBE_CHILD = (
+    "import signal, sys; signal.alarm({alarm}); import jax; "
+    "d = jax.devices(); "
+    "sys.exit(42) if d[0].platform == 'cpu' else None; "
+    "import jax.numpy as jnp; "
+    "x = jax.device_put(jnp.ones((128, 128), jnp.bfloat16)); "
+    "(x @ x).block_until_ready(); "
+    "print('PROBEKIND:' + d[0].device_kind); sys.exit(0)"
+)
+
+_IMAGENET_CHILD = """\
+import json, os, signal, sys
+# Dataset generation is pure-CPU (no jax import in these modules) and can
+# take minutes on the 1-core host: do it BEFORE arming the alarm, so the
+# scarce healthy-tunnel window is spent on the chip and a slow datagen
+# can't masquerade as a wedge in the evidence record.
+from petastorm_tpu.benchmark.imagenet_bench import (run_imagenet_bench,
+                                                    write_synthetic_imagenet)
+store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'imagenet')
+url = 'file://' + store
+if not os.path.exists(os.path.join(store, '_common_metadata')):
+    write_synthetic_imagenet(url, rows=2048)
+signal.alarm({alarm})
+r = run_imagenet_bench(url, steps=30, per_device_batch=128,
+                       workers_count=8, pool_type='thread')
+print('BENCHJSON:' + json.dumps(r))
+"""
+
+_FLASH_CHILD = """\
+import json, signal, sys, time
+signal.alarm({alarm})
+import jax
+import jax.numpy as jnp
+import numpy as np
+from petastorm_tpu.ops.flash_attn import flash_attention
+from petastorm_tpu.parallel.attention import dense_attention
+
+dev = jax.devices()[0]
+assert dev.platform != 'cpu', 'refusing to record CPU as flash evidence'
+out = {{'device_kind': dev.device_kind, 'platform': dev.platform}}
+
+def mk(seq, heads=8, kv_heads=4, d=128, batch=1):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (batch, seq, heads, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (batch, seq, kv_heads, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (batch, seq, kv_heads, d), jnp.bfloat16)
+    return q, k, v
+
+# --- parity on-device at seq 1k (dense f32 scores fit easily) ---------
+q, k, v = mk(1024)
+flash = jax.jit(lambda q, k, v: flash_attention(
+    q, k, v, causal=True, interpret=False))
+dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+f = np.asarray(flash(q, k, v), np.float32)
+g = np.asarray(dense(q, k, v), np.float32)
+err = float(np.max(np.abs(f - g)))
+# bf16 inputs, f32 accumulation both sides: tolerance is bf16 output ulp
+assert err < 3e-2, f'on-chip flash vs dense mismatch: max abs err {{err}}'
+out['parity_seq'] = 1024
+out['parity_max_abs_err'] = err
+
+# --- grad path compiles and matches on-device -------------------------
+def loss_flash(q, k, v):
+    return jnp.sum(flash_attention(q, k, v, causal=True,
+                                   interpret=False).astype(jnp.float32))
+def loss_dense(q, k, v):
+    return jnp.sum(dense_attention(q, k, v, causal=True).astype(jnp.float32))
+gq_f = jax.jit(jax.grad(loss_flash))(q, k, v)
+gq_d = jax.jit(jax.grad(loss_dense))(q, k, v)
+gerr = float(np.max(np.abs(np.asarray(gq_f, np.float32)
+                           - np.asarray(gq_d, np.float32))))
+assert gerr < 0.25, f'on-chip flash grad mismatch: max abs err {{gerr}}'
+out['grad_max_abs_err'] = gerr
+
+# --- timing vs XLA dense at 4k / 8k ----------------------------------
+def med_time(fn, args, iters=10):
+    jax.block_until_ready(fn(*args))  # warmup/compile outside the clock
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+for seq in (4096, 8192):
+    q, k, v = mk(seq)
+    tf = med_time(flash, (q, k, v))
+    td = med_time(dense, (q, k, v))
+    out[f'flash_ms_seq{{seq}}'] = round(tf * 1000, 3)
+    out[f'dense_ms_seq{{seq}}'] = round(td * 1000, 3)
+    out[f'speedup_seq{{seq}}'] = round(td / tf, 3)
+print('BENCHJSON:' + json.dumps(out))
+"""
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def append_evidence(record: dict) -> None:
+    record = {"ts": _now(), **record}
+    with open(EVIDENCE_PATH, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(f"evidence += {json.dumps(record)[:200]}", file=sys.stderr)
+
+
+def latest_evidence(event: str | None = None) -> dict | None:
+    """Most recent evidence record (optionally filtered to one ``event``
+    with ``status == 'ok'``). Used by bench.py to carry in-round TPU
+    measurements into the round JSON even when its own run hits a wedge."""
+    if not os.path.exists(EVIDENCE_PATH):
+        return None
+    best = None
+    with open(EVIDENCE_PATH) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if event is not None and (rec.get("event") != event
+                                      or rec.get("status") != "ok"):
+                continue
+            best = rec
+    return best
+
+
+def probe(alarm_s: int = 120) -> tuple[str, str | None]:
+    """-> (one of 'ok'/'cpu-only'/'wedged', device_kind or None).
+
+    The child times itself out via SIGALRM's *default action* — it fires
+    even while blocked inside the PJRT client C call, where a Python
+    handler would never run. rc 42 = clean CPU-only backend (advisor
+    round-3 fix: distinguishable from a crash, so callers don't retry a
+    deterministic outcome); any other nonzero rc = wedged/transient."""
+    child = _PROBE_CHILD.format(alarm=alarm_s)
+    try:
+        p = subprocess.run([sys.executable, "-c", child],
+                           capture_output=True, text=True,
+                           timeout=alarm_s + 30)
+    except subprocess.TimeoutExpired:
+        return "wedged", None
+    if p.returncode == 0:
+        kind = None
+        for line in p.stdout.splitlines():
+            if line.startswith("PROBEKIND:"):
+                kind = line[len("PROBEKIND:"):]
+        return "ok", kind
+    if p.returncode == 42:
+        return "cpu-only", None
+    return "wedged", None
+
+
+def _run_phase(event: str, child_template: str, alarm_s: int,
+               extra_env: dict | None = None,
+               pre_alarm_allowance_s: int = 0) -> dict | None:
+    """Run one capture phase in a guarded subprocess; append an evidence
+    record either way. Returns the measurement dict on success.
+
+    ``pre_alarm_allowance_s`` widens the parent's SIGKILL backstop for
+    children that do deliberate un-alarmed work before touching the TPU
+    (the imagenet child generates its dataset first — minutes of pure-CPU
+    time on the 1-core host); without it the parent would kill the child
+    mid-chip-run and misrecord a healthy tunnel as a wedge."""
+    child = child_template.format(alarm=alarm_s)
+    env = dict(os.environ, **(extra_env or {}))
+    try:
+        p = subprocess.run([sys.executable, "-c", child], env=env,
+                           capture_output=True, text=True,
+                           timeout=alarm_s + 60 + pre_alarm_allowance_s)
+    except subprocess.TimeoutExpired:
+        append_evidence({"event": event, "status": "skipped",
+                         "reason": "subprocess hard-timeout (tunnel wedge)"})
+        return None
+    payload = None
+    for line in p.stdout.splitlines():
+        if line.startswith("BENCHJSON:"):
+            try:
+                payload = json.loads(line[len("BENCHJSON:"):])
+            except ValueError:
+                pass  # truncated flush mid-kill: fall through to skipped
+    if p.returncode == 0 and payload is not None:
+        append_evidence({"event": event, "status": "ok", **payload})
+        return payload
+    reason = (f"rc={p.returncode}"
+              + (" (killed by own alarm)" if p.returncode == -14 else "")
+              + f", stderr tail: {p.stderr[-200:]!r}")
+    append_evidence({"event": event, "status": "skipped", "reason": reason})
+    return None
+
+
+def capture_imagenet(data_dir: str, alarm_s: int = 900) -> dict | None:
+    return _run_phase("imagenet", _IMAGENET_CHILD, alarm_s,
+                      {"PT_BENCH_DATA_DIR": data_dir},
+                      pre_alarm_allowance_s=900)  # first-run 2048-row datagen
+
+
+def capture_flash_attn(alarm_s: int = 600) -> dict | None:
+    return _run_phase("flash_attn", _FLASH_CHILD, alarm_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--probe-only", action="store_true")
+    ap.add_argument("--phases", default="imagenet,flash_attn",
+                    help="comma list from {imagenet,flash_attn}")
+    ap.add_argument("--data-dir",
+                    default=os.environ.get("BENCH_DATA_DIR", "/tmp/pt_bench"))
+    ap.add_argument("--probe-alarm", type=int, default=120)
+    ap.add_argument("--no-record-probe", action="store_true",
+                    help="don't append probe-only outcomes (cron loops poll "
+                         "often; only state CHANGES are worth a line)")
+    args = ap.parse_args(argv)
+
+    status, kind = probe(args.probe_alarm)
+    print(f"probe: {status}" + (f" ({kind})" if kind else ""))
+    if status != "ok":
+        if not args.no_record_probe:
+            append_evidence({"event": "probe", "status": "skipped",
+                             "reason": f"tunnel {status}"})
+        return 3 if status == "wedged" else 4
+    if not (args.no_record_probe and args.probe_only):
+        # A healthy probe that gates captures is worth recording; a bare
+        # healthy poll from a tight cron loop is not (same spam either way).
+        append_evidence({"event": "probe", "status": "ok",
+                         "device_kind": kind})
+    if args.probe_only:
+        return 0
+    rc = 0
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    for phase in phases:
+        if phase == "imagenet":
+            ok = capture_imagenet(args.data_dir)
+        elif phase == "flash_attn":
+            ok = capture_flash_attn()
+        else:
+            print(f"unknown phase {phase!r}", file=sys.stderr)
+            ok = None
+        rc = rc or (0 if ok else 1)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
