@@ -1,8 +1,13 @@
 """Round benchmark. Prints ONE JSON line:
 ``{"metric", "value", "unit", "vs_baseline", ...extras}``.
 
-Five phases:
+Phases (ordered so the scarce healthy-tunnel window is used FIRST):
 
+0. **accelerator window** — probe the TPU immediately; if healthy, run the
+   ImageNet phase (and the flash-attention on-chip check) right now,
+   before any CPU phase can burn the window. Every on-chip measurement is
+   also appended to the committed ``BENCH_TPU_EVIDENCE.jsonl`` via
+   :mod:`tools.tpu_evidence`, so a later wedge cannot erase the proof.
 1. **hello_world (headline, ``vs_baseline``)** — the reference's only
    published absolute number: 709.84 samples/sec on the 10-row tutorial
    store with default benchmark args (reference
@@ -20,20 +25,22 @@ Five phases:
    ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
    key ``scalar_batched_samples_per_sec`` (the reference only ever made a
    qualitative "significantly higher throughput" claim here, README.rst:242).
-5. **imagenet** — the BASELINE.md target workload: jpeg-decode-bound reader
-   feeding a real jitted ResNet-50 train step on the local chip(s); extra
-   keys ``imagenet_samples_per_sec`` (per chip), ``imagenet_input_stall_pct``
-   measured wait-vs-compute against that step, ``imagenet_step_time_ms``,
-   ``imagenet_model_flops_per_step_per_chip`` /
-   ``imagenet_achieved_tflops_per_chip`` from XLA's compiled cost model
-   (per-device), and — on a TPU — ``imagenet_mfu_pct`` against
-   ``PETASTORM_TPU_PEAK_FLOPS`` if set, else the public bf16 peak looked
-   up from ``device_kind``. The accelerator probe runs immediately before
-   the in-process jax init and retries with backoff (transient tunnel
-   wedges recover); CPU fallback only after the last attempt.
+5. **imagenet (late retry)** — if phase 0 found the tunnel wedged, re-probe
+   after the CPU phases (a second window per run) and run the BASELINE.md
+   target workload then; only after BOTH windows miss does the phase
+   degrade to the tiny CPU-fallback config.
+
+Every multi-rerun phase reports dispersion — ``*_p50`` (median of the
+reruns) and ``*_spread_pct`` ((max-min)/median) — alongside the best
+value, so a round-over-round delta is attributable to noise vs regression
+(round-3 verdict, "weak" item 1). The JSON line also carries a
+``tpu_evidence`` block with the latest committed on-chip measurements
+(which may come from an earlier opportunistic capture in the same round,
+not necessarily this run).
 """
 import json
 import os
+import statistics
 import sys
 
 BASELINE_SAMPLES_PER_SEC = 709.84  # reference docs/benchmarks_tutorial.rst:20
@@ -47,42 +54,27 @@ def _ensure(marker_url: str, generate):
 
 def _probe_accelerator(timeout_s: float = 120.0, attempts: int = 1,
                        backoff_s: float = 45.0) -> bool:
-    """True when jax promptly brings up a NON-CPU default backend.
+    """True when jax promptly brings up a healthy NON-CPU default backend.
 
-    Probed in a SUBPROCESS because a wedged TPU tunnel makes in-process
-    ``jax.devices()`` hang forever; the bench must degrade to CPU and still
-    print its JSON line rather than stall the round. The child times itself
-    out via SIGALRM's default action (works even while blocked inside the
-    PJRT client C call); the parent's SIGKILL timeout is only a backstop —
-    killing a process mid-client-creation is what wedges the tunnel.
-    A backend that comes up but is CPU also returns False: running the full
-    ImageNet config on a 1-core host would stall for hours.
-
-    ``attempts`` > 1 retries with ``backoff_s`` sleeps: the tunnel's common
-    failure mode is a TRANSIENT wedge (child killed by its own alarm, or
-    parent timeout), so one wedged probe must not condemn the whole
-    ImageNet phase to CPU (round-2 verdict item 1). A child that exits
-    cleanly with a CPU-only backend is NOT a wedge — no accelerator exists,
-    so retrying would only waste minutes; return False immediately."""
-    import subprocess
+    Delegates to :func:`tools.tpu_evidence.probe` (subprocess + SIGALRM
+    default action — fires even inside a blocked PJRT C call). A child
+    that exits with the distinctive rc 42 has a clean CPU-only backend:
+    deterministic, so no retry. ANY other failure — including rc 1, which
+    previously read as "clean CPU" but is also what an uncaught
+    ImportError/PJRT-init exception exits with (round-3 advisor finding) —
+    counts as wedged/transient and burns a retry with ``backoff_s`` sleeps.
+    """
     import time
-    child = ("import signal, sys; signal.alarm(%d); import jax; "
-             "sys.exit(0 if jax.default_backend() != 'cpu' else 1)"
-             % int(timeout_s))
+
+    from tools.tpu_evidence import probe
     for attempt in range(attempts):
-        try:
-            rc = subprocess.run(
-                [sys.executable, "-c", child],
-                timeout=timeout_s + 30, stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL).returncode
-            if rc == 0:
-                return True
-            if rc == 1:   # clean exit, backend is CPU: deterministic, final
-                print("accelerator probe: CPU-only backend (no accelerator)",
-                      file=sys.stderr)
-                return False
-        except subprocess.TimeoutExpired:
-            pass
+        status, _kind = probe(alarm_s=int(timeout_s))
+        if status == "ok":
+            return True
+        if status == "cpu-only":
+            print("accelerator probe: CPU-only backend (no accelerator)",
+                  file=sys.stderr)
+            return False
         print(f"accelerator probe attempt {attempt + 1}/{attempts} wedged",
               file=sys.stderr)
         if attempt < attempts - 1:
@@ -90,23 +82,71 @@ def _probe_accelerator(timeout_s: float = 120.0, attempts: int = 1,
     return False
 
 
+def _dispersion(out: dict, prefix: str, samples) -> float:
+    """Record best/median/spread for one phase's reruns; returns the best.
+
+    ``{prefix}_p50`` and ``{prefix}_spread_pct`` land next to the headline
+    best-of-N so noise (large spread) is distinguishable from regression
+    (shifted median) across rounds."""
+    samples = [float(s) for s in samples]
+    best = max(samples)
+    if len(samples) > 1:
+        p50 = statistics.median(samples)
+        out[f"{prefix}_p50"] = round(p50, 2)
+        out[f"{prefix}_spread_pct"] = round(
+            100.0 * (best - min(samples)) / p50, 1) if p50 else 0.0
+    return best
+
+
+def _try_accelerator_imagenet(out: dict, data_dir: str, window: str,
+                              attempts: int, backoff_s: float):
+    """One accelerator window: probe, and if healthy run the ImageNet
+    capture (+ flash-attention on-chip check, first window only) through
+    tools.tpu_evidence so the measurement is persisted to the evidence
+    file the moment it exists. Returns run_imagenet_bench's dict or None."""
+    from tools.tpu_evidence import capture_flash_attn, capture_imagenet
+    if not _probe_accelerator(timeout_s=150.0, attempts=attempts,
+                              backoff_s=backoff_s):
+        out.setdefault("imagenet_probe_windows", []).append(
+            f"{window}: wedged-or-absent")
+        return None
+    out.setdefault("imagenet_probe_windows", []).append(f"{window}: healthy")
+    imagenet = capture_imagenet(data_dir)
+    if window == "early":
+        capture_flash_attn()
+    return imagenet
+
+
 def main():
     data_dir = os.environ.get("BENCH_DATA_DIR", "/tmp/pt_bench")
     from petastorm_tpu.benchmark.hello_world import generate_hello_world_dataset
-    from petastorm_tpu.benchmark.imagenet_bench import (run_imagenet_bench,
-                                                        write_synthetic_imagenet)
     from petastorm_tpu.benchmark.throughput import reader_throughput
+
+    out = {}
+
+    # ---- 0. EARLY accelerator window (round-3 verdict item 1a): use the
+    # tunnel the moment it's healthy — the CPU phases below take ~10 min,
+    # and historically the tunnel wedges mid-run. One quick probe only;
+    # the late window retries with backoff. Guarded: partial bench beats
+    # no bench — nothing in the accelerator path may stop the JSON line.
+    try:
+        imagenet = _try_accelerator_imagenet(out, data_dir, "early",
+                                             attempts=1, backoff_s=0.0)
+    except Exception as e:  # noqa: BLE001 - phase 0 must never kill the run
+        imagenet = None
+        out.setdefault("imagenet_probe_windows", []).append(
+            f"early: error {e!r}"[:200])
 
     # ---- 1. headline: the reference's exact tutorial config ------------
     url = f"file://{data_dir}/hello_world"
     _ensure(url, lambda: generate_hello_world_dataset(url))
-    best = 0.0
     # best-of-5 warm reruns: single-core host load is spiky, so one clean
     # sample needs several tries (same spirit as the tutorial's warm rerun).
-    for _ in range(5):
-        result = reader_throughput(url, warmup_cycles=200, measure_cycles=1000,
-                                   pool_type="thread", loaders_count=3)
-        best = max(best, result.samples_per_second)
+    hello_samples = [
+        reader_throughput(url, warmup_cycles=200, measure_cycles=1000,
+                          pool_type="thread", loaders_count=3).samples_per_second
+        for _ in range(5)]
+    best = _dispersion(out, "value", hello_samples)
 
     # ---- 2. steady-state: 10k rows, 100-row groups ---------------------
     url_10k = f"file://{data_dir}/hello_world_10k"
@@ -115,13 +155,14 @@ def main():
     # NOTE: deliberately no rowgroup_coalescing here — with coalesced items
     # the default results queue can buffer the whole 10k-row epoch during
     # warmup and the measurement would drain memory, not the pipeline.
-    steady_sps = max(
+    steady_samples = [
         reader_throughput(url_10k, warmup_cycles=200, measure_cycles=2000,
                           pool_type="thread", loaders_count=3).samples_per_second
-        for _ in range(2))  # best-of-2: transient host load shows up hard
-                            # on a single-core VM
+        for _ in range(3)]  # 3 reruns: enough for a median on a spiky host
+    steady_sps = _dispersion(out, "hello_world_10k_samples_per_sec",
+                             steady_samples)
 
-    # ---- 2b. best measured config on the same 10k store: a small sweep,
+    # ---- 3. best measured config on the same 10k store: a small sweep,
     # reporting whichever pipeline configuration actually wins on THIS
     # host. (Measured 2026-07-30 on the 1-core bench host: process pool +
     # shm ring loses 4x to threads here — IPC serialization swamps the GIL
@@ -146,28 +187,29 @@ def main():
         "        dict(pool_type='process', loaders_count=2,\n"
         "             reader_extra_kwargs=dict(coal)),\n"
         "}\n"
-        # best-of-2 per config: single-core load spikes exceed the ~10%
+        # 2 reruns per config: single-core load spikes exceed the ~10%
         # margins between configs, so one lone run could crown the wrong
-        # winner (same mitigation as every other phase).
-        "results = {name: max(reader_throughput(url, warmup_cycles=800,\n"
-        "                                       measure_cycles=8000,\n"
-        "                                       **kw).samples_per_second\n"
-        "                     for _ in range(2))\n"
+        # winner. All samples are returned so the parent reports dispersion.
+        "results = {name: [reader_throughput(url, warmup_cycles=800,\n"
+        "                                    measure_cycles=8000,\n"
+        "                                    **kw).samples_per_second\n"
+        "                  for _ in range(2)]\n"
         "           for name, kw in sweep.items()}\n"
-        "best = max(results, key=results.get)\n"
-        "print('BENCHJSON:' + json.dumps({'config': best, 'sps': results[best],\n"
-        "                                 'sweep': results}))\n")
+        "best = max(results, key=lambda n: max(results[n]))\n"
+        "print('BENCHJSON:' + json.dumps({'config': best,\n"
+        "                                 'samples': results}))\n")
     try:
         best_cfg_result = _cpu_subprocess(best_child, data_dir,
                                           timeout_s=900.0)
-        best_cfg_sps = best_cfg_result["sps"]
         best_cfg = best_cfg_result["config"]
+        best_cfg_sps = _dispersion(out, "best_config_samples_per_sec",
+                                   best_cfg_result["samples"][best_cfg])
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         best_cfg_sps = None
         best_cfg = None
         print(f"best_config failed: {e!r}", file=sys.stderr)
 
-    # ---- scalar columnar path: make_batch_reader -> BatchedDataLoader --
+    # ---- 4. scalar columnar path: make_batch_reader -> BatchedDataLoader.
     # Always in a JAX_PLATFORMS=cpu subprocess: the metric is host-side
     # pipeline throughput ("no device in the loop", scalar_bench.py), so
     # staging must hit the CPU backend — in-process jax would device_put
@@ -184,56 +226,57 @@ def main():
         "jax.config.update('jax_platforms', 'cpu')\n"
         "from petastorm_tpu.benchmark.scalar_bench import batched_loader_throughput\n"
         "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
-        "sps = max(batched_loader_throughput(url) for _ in range(2))\n"
-        "print('BENCHJSON:' + json.dumps({'sps': sps}))\n")
+        "samples = [batched_loader_throughput(url) for _ in range(2)]\n"
+        "print('BENCHJSON:' + json.dumps({'samples': samples}))\n")
     try:
-        scalar_sps = _cpu_subprocess(scalar_child, data_dir,
-                                     timeout_s=600.0)["sps"]
+        scalar_sps = _dispersion(out, "scalar_batched_samples_per_sec",
+                                 _cpu_subprocess(scalar_child, data_dir,
+                                                 timeout_s=600.0)["samples"])
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         scalar_sps = None
         # (recorded below only when measured)
         print(f"scalar_batched failed: {e!r}", file=sys.stderr)
 
-    # ---- 3. imagenet: decode-bound reader vs real ResNet-50 step -------
-    out = {
+    # ---- assemble the line ---------------------------------------------
+    out.update({
         "metric": "hello_world reader throughput",
         "value": round(best, 2),
         "unit": "samples/sec",
         "vs_baseline": round(best / BASELINE_SAMPLES_PER_SEC, 3),
         "hello_world_10k_samples_per_sec": round(steady_sps, 2),
-    }
+    })
     if scalar_sps is not None:
         out["scalar_batched_samples_per_sec"] = round(scalar_sps, 2)
     if best_cfg_sps is not None:
         out["best_config_samples_per_sec"] = round(best_cfg_sps, 2)
         out["best_config"] = best_cfg
-        out["best_config_sweep"] = {k: round(v, 2) for k, v in
-                                    best_cfg_result["sweep"].items()}
-    imagenet = None
-    try:
-        # Probe IMMEDIATELY before the in-process jax init (a stale earlier
-        # result could send us into an uninterruptible PJRT hang), with
-        # retries + backoff so a transiently wedged tunnel gets several
-        # chances; the minutes of CPU phases above already gave it time.
-        if not _probe_accelerator(timeout_s=150.0, attempts=3,
-                                  backoff_s=60.0):
-            raise RuntimeError("accelerator probe failed (wedged or absent) "
-                               "after retries spread across the run")
+        out["best_config_sweep"] = {
+            k: round(max(v), 2)
+            for k, v in best_cfg_result["samples"].items()}
+
+    # ---- 5. imagenet LATE window: second chance if the early one missed.
+    if imagenet is None:
+        try:
+            imagenet = _try_accelerator_imagenet(out, data_dir, "late",
+                                                 attempts=2, backoff_s=60.0)
+        except Exception as e:  # noqa: BLE001 - same guard as the early one
+            out.setdefault("imagenet_probe_windows", []).append(
+                f"late: error {e!r}"[:200])
+    if imagenet is not None:
         out["imagenet_platform"] = "accelerator"
-        url_in = f"file://{data_dir}/imagenet"
-        _ensure(url_in, lambda: write_synthetic_imagenet(url_in, rows=2048))
-        # batch 128 / 8 workers measured best on the tunneled chip with
-        # the threaded staging pipeline: 465 sps/chip @ 0.03% stall vs
-        # 438 @ batch 64, 362 @ 32, 355 @ 192, 217 @ 256.
-        imagenet = run_imagenet_bench(url_in, steps=30,
-                                      per_device_batch=128,
-                                      workers_count=8, pool_type="thread")
-    except Exception as e:  # noqa: BLE001 - tunnel drops mid-run happen
+    else:
         # Degrade to CPU (tiny 64px config so the ResNet step stays
         # tractable) IN A SUBPROCESS — this process's jax may hold a broken
         # PJRT client after a mid-run transport failure.
+        windows = out.get("imagenet_probe_windows", [])
+        any_healthy = any("healthy" in w for w in windows)
         out["imagenet_platform"] = "cpu-fallback"
-        out["imagenet_accelerator_error"] = repr(e)[:300]
+        out["imagenet_accelerator_error"] = (
+            "probe found a healthy tunnel but the on-chip capture failed "
+            "(mid-run drop?); see imagenet_probe_windows and the skipped "
+            "records in BENCH_TPU_EVIDENCE.jsonl" if any_healthy else
+            "accelerator probe failed in both windows (wedged or absent); "
+            "see imagenet_probe_windows")
         try:
             imagenet = _imagenet_cpu_fallback(data_dir)
         except Exception as e2:  # noqa: BLE001 - partial beats nothing
@@ -252,6 +295,19 @@ def main():
                 val = imagenet[key]
                 out[f"imagenet_{key}"] = (round(val, 3)
                                           if isinstance(val, float) else val)
+
+    # ---- committed on-chip evidence, whenever it was captured ----------
+    # (round-3 verdict item 1b: a successful mid-round interactive TPU
+    # measurement must survive into the round JSON even if THIS run's
+    # windows were wedged.)
+    try:
+        from tools.tpu_evidence import latest_evidence
+        evidence = {ev: rec for ev in ("imagenet", "flash_attn")
+                    if (rec := latest_evidence(ev)) is not None}
+        if evidence:
+            out["tpu_evidence"] = evidence
+    except Exception as e:  # noqa: BLE001 - evidence is supplementary
+        print(f"tpu_evidence lookup failed: {e!r}", file=sys.stderr)
 
     print(json.dumps(out))
     return 0
