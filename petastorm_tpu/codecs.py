@@ -366,8 +366,12 @@ def _native_jpeg_parity_ok() -> bool:
     JPEG IDCT output is implementation-defined — a host whose system libjpeg
     differs from cv2's bundled decoder could skew pixels by ±1 LSB between
     the native and cv2 fallback paths (a silent train/eval inconsistency).
-    Encode one structured probe image with cv2 and require the native strict
-    decode to match cv2's decode bit-for-bit; any mismatch (or any probe
+    Encode structured probe images covering the stream variants real
+    datasets contain — cv2 baseline, cv2 **progressive**, and (when PIL is
+    importable) a PIL-encoded stream at a different quality/subsampling,
+    i.e. a second encoder entirely (round-3 advisor: one baseline blob was
+    necessary but not sufficient) — and require the native strict decode to
+    match cv2's decode bit-for-bit on every one; any mismatch (or any probe
     failure) disables the native JPEG path for this process. PNG stays on.
     """
     global _NATIVE_JPEG_OK
@@ -381,14 +385,32 @@ def _native_jpeg_parity_ok() -> bool:
                             np.tile(grad[:, None], (1, 64)),
                             rng.integers(0, 256, (64, 64), dtype=np.uint8)],
                            axis=-1)
+            blobs = []
             ok, enc = cv2.imencode(".jpg", img[..., ::-1],
                                    [int(cv2.IMWRITE_JPEG_QUALITY), 85])
-            blob = enc.tobytes()
-            ref = cv2.cvtColor(
-                cv2.imdecode(np.frombuffer(blob, np.uint8),
-                             cv2.IMREAD_UNCHANGED), cv2.COLOR_BGR2RGB)
-            native = imgcodec.decode_image(blob, (64, 64, 3), strict=True)
-            _NATIVE_JPEG_OK = bool(ok) and np.array_equal(native, ref)
+            if ok:
+                blobs.append(enc.tobytes())
+            ok, enc = cv2.imencode(".jpg", img[..., ::-1],
+                                   [int(cv2.IMWRITE_JPEG_QUALITY), 85,
+                                    int(cv2.IMWRITE_JPEG_PROGRESSIVE), 1])
+            if ok:
+                blobs.append(enc.tobytes())
+            try:
+                from PIL import Image
+                buf = io.BytesIO()
+                Image.fromarray(img).save(buf, format="JPEG", quality=92,
+                                          subsampling=1)  # 4:2:2
+                blobs.append(buf.getvalue())
+            except ImportError:  # pragma: no cover - PIL present in CI image
+                pass
+            _NATIVE_JPEG_OK = len(blobs) >= 2  # baseline AND progressive
+            for blob in blobs:
+                ref = cv2.cvtColor(
+                    cv2.imdecode(np.frombuffer(blob, np.uint8),
+                                 cv2.IMREAD_UNCHANGED), cv2.COLOR_BGR2RGB)
+                native = imgcodec.decode_image(blob, (64, 64, 3), strict=True)
+                _NATIVE_JPEG_OK = (_NATIVE_JPEG_OK
+                                   and np.array_equal(native, ref))
         except Exception:  # noqa: BLE001 - any probe failure disables the path
             _NATIVE_JPEG_OK = False
     return _NATIVE_JPEG_OK
