@@ -358,6 +358,11 @@ class DataLoader(LoaderBase):
     """Row-reader consumer (parity: reference pytorch.py DataLoader:131, with
     device staging replacing torch collate).
 
+    NGram readers batch natively: homogeneous windows stack into a dense
+    ``(batch, ngram_len, ...)`` sequence axis (see :meth:`_collate_ngram`),
+    so ``sharding=NamedSharding(mesh, P("data", "seq"))`` feeds dp x sp
+    meshes straight from a timestamped store.
+
     :param reader: a ``make_reader`` reader
     :param batch_size: rows per batch (static)
     :param shuffling_queue_capacity: >0 enables a row shuffling buffer
@@ -373,10 +378,7 @@ class DataLoader(LoaderBase):
         if reader.batched_output:
             raise TypeError("DataLoader consumes make_reader readers; use "
                             "BatchedDataLoader for make_batch_reader")
-        if getattr(reader, "ngram", None) is not None:
-            raise NotImplementedError(
-                "DataLoader does not batch ngram samples; iterate the reader "
-                "directly or use a TransformSpec to flatten windows")
+        self._ngram = getattr(reader, "ngram", None)
         self._reader = reader
         self._shuffling_capacity = shuffling_queue_capacity
         self._min_after = min_after_retrieve
@@ -411,6 +413,8 @@ class DataLoader(LoaderBase):
             yield from self._reader
 
     def _collate(self, rows) -> Dict[str, np.ndarray]:
+        if self._ngram is not None:
+            return self._collate_ngram(rows)
         fields = rows[0]._fields
         out = {}
         schema = self._reader.schema
@@ -437,6 +441,63 @@ class DataLoader(LoaderBase):
                         f"Field {name!r} contains nulls; fill them with a "
                         f"TransformSpec before batching, or exclude the field")
                 out[name] = np.stack([np.asarray(v) for v in values])
+        return out
+
+    def _collate_ngram(self, windows) -> Dict[str, np.ndarray]:
+        """TPU-first NGram batching: window offsets stack into a dense
+        sequence axis.
+
+        Each reader item is ``{offset: row-namedtuple}``. When every offset
+        carries the same field set (the homogeneous token-window case), each
+        field collates to ``(batch, ngram_len, *field_shape)`` — a static
+        dense array a ``NamedSharding(mesh, P("data", "seq"))`` shards
+        directly, which is how a petastorm store feeds a dp x sp mesh
+        (reference flattens windows to per-offset tf feed dicts instead,
+        tf_utils.py; a dense seq axis is the XLA-friendly layout).
+        Heterogeneous offset fields flatten to ``"{name}/{offset}"`` keys of
+        ``(batch, *field_shape)``."""
+        offsets = sorted(windows[0].keys())
+        fieldsets = [tuple(windows[0][o]._fields) for o in offsets]
+        schema = self._reader.schema
+
+        def column(name, values):
+            """-> (batch-stacked array, lengths or None) for one offset."""
+            field = schema.fields.get(name)
+            if any(v is None for v in values):
+                raise ValueError(
+                    f"Field {name!r} contains nulls; fill them with a "
+                    f"TransformSpec before batching, or exclude the field")
+            if field is not None and any(d is None for d in field.shape):
+                if self._pad_varlen is None:
+                    raise ValueError(
+                        f"Field {name!r} is variable-length; ngram windows "
+                        f"stack into dense arrays — pass "
+                        f"pad_variable_length_to, pad it with a "
+                        f"TransformSpec, or exclude the field")
+                target = (self._pad_varlen.get(name)
+                          if isinstance(self._pad_varlen, dict)
+                          else self._pad_varlen)
+                return _pad_to(values, target)
+            return np.stack([np.asarray(v) for v in values]), None
+
+        out = {}
+        if all(fs == fieldsets[0] for fs in fieldsets):
+            for name in fieldsets[0]:
+                per_offset = [column(name, [getattr(w[o], name)
+                                            for w in windows])
+                              for o in offsets]
+                out[name] = np.stack([arr for arr, _ in per_offset], axis=1)
+                if per_offset[0][1] is not None:
+                    out[name + "__len"] = np.stack(
+                        [ln for _, ln in per_offset], axis=1)
+        else:
+            for o in offsets:
+                for name in windows[0][o]._fields:
+                    arr, lengths = column(
+                        name, [getattr(w[o], name) for w in windows])
+                    out[f"{name}/{o}"] = arr
+                    if lengths is not None:
+                        out[f"{name}/{o}__len"] = lengths
         return out
 
     def _host_batches(self):

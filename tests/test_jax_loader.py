@@ -331,3 +331,134 @@ def test_staging_overlaps_slow_consumer(synthetic_dataset):
     # generous bound for CI noise, but inline assembly of a 10-row batch
     # with matrix columns takes well over 2ms on this host
     assert sorted(waits)[len(waits) // 2] < 0.02, waits
+
+
+# ------------------------------------------------------------- ngram ----
+
+def _write_token_store(tmp_path, rows=40, group=10):
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("Tok", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("token", np.int32, (), ScalarCodec(np.int32), False),
+        UnischemaField("label", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    url = f"file://{tmp_path}/tok"
+    with materialize_dataset_local(url, schema, rows_per_row_group=group) as w:
+        for i in range(rows):
+            w.write_row({"ts": np.int64(i), "token": np.int32(i * 7 % 97),
+                         "label": np.int32(i % 3)})
+    return url
+
+
+def test_ngram_loader_stacks_homogeneous_windows(tmp_path):
+    """All offsets carry the same fields -> each field becomes one dense
+    (batch, ngram_len, ...) array, tokens in window order."""
+    from petastorm_tpu.ngram import NGram
+    url = _write_token_store(tmp_path)
+    ngram = NGram({i: ["ts", "token"] for i in range(5)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        loader = DataLoader(reader, batch_size=2)
+        batches = list(loader)
+    assert batches, "no ngram batches produced"
+    b = batches[0]
+    assert set(b.keys()) == {"ts", "token"}
+    assert b["token"].shape == (2, 5)
+    ts = np.asarray(b["ts"])
+    # windows are consecutive timestamps; tokens follow the i*7%97 pattern
+    assert np.array_equal(ts[0], np.arange(ts[0][0], ts[0][0] + 5))
+    assert np.array_equal(np.asarray(b["token"][0]),
+                          (ts[0] * 7 % 97).astype(np.int32))
+
+
+def test_ngram_loader_flattens_heterogeneous_windows(tmp_path):
+    """Offsets with different field sets -> flat '{name}/{offset}' keys."""
+    from petastorm_tpu.ngram import NGram
+    url = _write_token_store(tmp_path)
+    ngram = NGram({0: ["ts", "token"], 1: ["ts", "label"]}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        b = next(iter(DataLoader(reader, batch_size=2)))
+    assert set(b.keys()) == {"ts/0", "token/0", "ts/1", "label/1"}
+    assert np.asarray(b["ts/1"]).shape == (2,)
+    assert np.array_equal(np.asarray(b["ts/1"]), np.asarray(b["ts/0"]) + 1)
+
+
+def test_ngram_loader_feeds_data_seq_sharding(tmp_path):
+    """store -> make_reader+NGram -> DataLoader -> NamedSharding P(data, seq):
+    the token windows land as ONE global array sharded over a dp x sp mesh
+    (round-3 verdict item 3's unit-level counterpart)."""
+    from petastorm_tpu.ngram import NGram
+    url = _write_token_store(tmp_path, rows=64, group=8)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "seq"))
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    ngram = NGram({i: ["token"] for i in range(8)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        b = next(iter(DataLoader(reader, batch_size=4, sharding=sharding)))
+    assert b["token"].shape == (4, 8)
+    assert b["token"].sharding == sharding
+    shard_shapes = {s.data.shape for s in b["token"].addressable_shards}
+    assert shard_shapes == {(1, 4)}  # 4 rows / dp4, 8 steps / sp2
+    total = jax.jit(lambda x: jnp.sum(x))(b["token"])
+    assert np.isfinite(float(total))
+
+
+def test_ngram_loader_varlen_field_rejected(tmp_path):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("V", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("seq", np.float32, (None,), NdarrayCodec(), False),
+    ])
+    url = f"file://{tmp_path}/varlen"
+    with materialize_dataset_local(url, schema, rows_per_row_group=10) as w:
+        for i in range(10):
+            w.write_row({"ts": np.int64(i),
+                         "seq": np.ones(i + 1, np.float32)})
+    ngram = NGram({0: ["ts", "seq"], 1: ["ts", "seq"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        with pytest.raises(ValueError, match="variable-length"):
+            next(iter(DataLoader(reader, batch_size=2)))
+
+
+def test_ngram_loader_pads_varlen_with_target(tmp_path):
+    """pad_variable_length_to works under ngram stacking too: each varlen
+    field pads per offset then stacks to (batch, ngram_len, target), with
+    true lengths in '<name>__len' (batch, ngram_len)."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("V", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("seq", np.float32, (None,), NdarrayCodec(), False),
+    ])
+    url = f"file://{tmp_path}/varlen_pad"
+    with materialize_dataset_local(url, schema, rows_per_row_group=8) as w:
+        for i in range(8):
+            w.write_row({"ts": np.int64(i),
+                         "seq": np.full(i + 1, float(i), np.float32)})
+    ngram = NGram({0: ["ts", "seq"], 1: ["ts", "seq"]}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        b = next(iter(DataLoader(reader, batch_size=2,
+                                 pad_variable_length_to=6)))
+    assert np.asarray(b["seq"]).shape == (2, 2, 6)
+    lens = np.asarray(b["seq__len"])
+    assert lens.shape == (2, 2)
+    # window w starts at ts=2w (overlap off): lengths are ts+1
+    assert np.array_equal(lens, [[1, 2], [3, 4]])
+    seq = np.asarray(b["seq"])
+    assert seq[1, 1, :4].tolist() == [3.0, 3.0, 3.0, 3.0]
+    assert seq[1, 1, 4:].tolist() == [0.0, 0.0]
