@@ -324,23 +324,52 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
 
 def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
             expert_spec=None, aux_weight: float = 1e-2, layers_fn=None,
-            embed_lookup: str = "gather"):
+            embed_lookup: str = "gather", compute_dtype=jnp.bfloat16,
+            shift: str = "split"):
     """Next-token cross entropy (+ MoE load-balancing aux for switch
-    dispatch). batch: {'tokens': (b, s) int32}."""
+    dispatch). batch: {'tokens': (b, s) int32}. ``compute_dtype=float32``
+    makes activation math exact — the PP-parity pinning mode (microbatched
+    accumulation reorders bf16 sums; in f32 the pipeline and the sequential
+    loop agree to ~1e-5 at dryrun shapes).
+
+    ``shift`` picks how inputs/targets derive from the token window:
+
+    * ``"split"`` (default): inputs ``tokens[:, :-1]``, targets
+      ``tokens[:, 1:]`` — the textbook layout, model seq = s - 1.
+    * ``"roll"``: inputs are the FULL window, targets are
+      ``roll(tokens, -1)`` with the wraparound position masked out of the
+      mean — model seq = s. This is the sharding-friendly layout (the one
+      production TPU trainers use): a ``P("data", "seq")``-sharded batch
+      stays divisible by the mesh seq axis end to end, whereas split mode
+      would need an s = multiple-of-sp **plus one** window that cannot be
+      device_put evenly.
+    """
     tokens = batch["tokens"]
-    logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
+    if shift not in ("split", "roll"):
+        raise ValueError(f"unknown shift {shift!r}")
+    inputs = tokens if shift == "roll" else tokens[:, :-1]
+    logits, aux = apply(params, inputs, cfg, attn_fn=attn_fn,
                         activation_spec=activation_spec,
                         expert_spec=expert_spec, with_aux=True,
-                        layers_fn=layers_fn, embed_lookup=embed_lookup)
-    targets = tokens[:, 1:]
+                        layers_fn=layers_fn, embed_lookup=embed_lookup,
+                        compute_dtype=compute_dtype)
     logp = jax.nn.log_softmax(logits)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    if shift == "roll":
+        targets = jnp.roll(tokens, -1, axis=1)
+        nll_tok = -jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]          # (b, s)
+        mask = (jnp.arange(tokens.shape[1]) < tokens.shape[1] - 1)
+        nll = (nll_tok * mask).sum() / (mask.sum() * tokens.shape[0])
+    else:
+        targets = tokens[:, 1:]
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
     return nll + aux_weight * aux
 
 
 def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
                     attn_fn=None, activation_spec=None, expert_spec=None,
-                    layers_fn=None, embed_lookup: str = "gather"):
+                    layers_fn=None, embed_lookup: str = "gather",
+                    compute_dtype=jnp.bfloat16, shift: str = "split"):
     """AdamW train step via optax; jit with sharded params for TP/DP/SP."""
     import optax
     tx = optax.adamw(learning_rate, weight_decay=0.1)
@@ -353,7 +382,8 @@ def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
             partial(loss_fn, cfg=cfg, attn_fn=attn_fn,
                     activation_spec=activation_spec,
                     expert_spec=expert_spec, layers_fn=layers_fn,
-                    embed_lookup=embed_lookup))(params, batch)
+                    embed_lookup=embed_lookup,
+                    compute_dtype=compute_dtype, shift=shift))(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -121,3 +121,28 @@ def test_llama_embed_onehot_matches_gather():
     assert losses["gather"] == pytest.approx(losses["onehot"], abs=1e-6)
     with pytest.raises(ValueError, match="embed_lookup"):
         llama.loss_fn(params, batch, cfg, embed_lookup="typo")
+
+
+def test_llama_roll_shift_loss_matches_manual_mask():
+    """shift="roll" feeds the FULL window and masks the wraparound target:
+    the loss must equal the hand-computed mean of -logp[target] over
+    positions 0..S-2 of the same logits (sharding-friendly layout used by
+    the store-fed dryrun; llama.loss_fn docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from petastorm_tpu.models import llama
+
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+    loss = float(llama.loss_fn(params, {"tokens": tokens}, cfg,
+                               shift="roll", aux_weight=0.0))
+
+    logits = llama.apply(params, tokens, cfg)            # (2, 8, vocab) f32
+    logp = jax.nn.log_softmax(logits)
+    expected = -float(jnp.mean(jnp.take_along_axis(
+        logp[:, :-1], tokens[:, 1:, None], axis=-1)))
+    assert loss == pytest.approx(expected, rel=1e-6)
+
+    with pytest.raises(ValueError, match="shift"):
+        llama.loss_fn(params, {"tokens": tokens}, cfg, shift="typo")
