@@ -74,8 +74,11 @@ class CheckpointManager:
         """Save ``train_state`` (any pytree) plus the input cursor.
 
         ``reader`` may be a Reader (its ``state_dict()`` is taken) or a dict
-        already produced by ``state_dict()``. ``loader`` is accepted for
-        symmetry: loaders expose their underlying reader via ``_reader``.
+        already produced by ``state_dict()``; when given it wins. Prefer
+        passing ``loader`` for loader-fed training: its ``state_dict()`` is
+        delivery-accurate (the prefetching staging thread advances the raw
+        reader watermark past batches training never saw — resuming from
+        the reader alone would skip them).
         """
         import orbax.checkpoint as ocp
         saved = self._mgr.save(step, args=ocp.args.StandardSave(train_state))
@@ -172,10 +175,22 @@ class CheckpointManager:
 
     @staticmethod
     def _resolve_input_state(reader, loader) -> Optional[dict]:
-        if reader is None and loader is not None:
-            reader = getattr(loader, "_reader", None)
-        if reader is None:
-            return None
-        if isinstance(reader, dict):
-            return reader
-        return reader.state_dict()
+        # An explicitly passed reader/state-dict always wins: the caller
+        # captured a cursor they mean to persist.
+        if reader is not None:
+            return reader if isinstance(reader, dict) else reader.state_dict()
+        if loader is not None:
+            if hasattr(loader, "state_dict"):
+                # Delivery-accurate: the loader's staging thread prefetches
+                # ahead of the consumer, so the raw reader watermark can
+                # sit past batches training never saw; the loader state
+                # resumes from the last DELIVERED batch (loader.py
+                # state_dict()). A shuffling loader raises here — loudly —
+                # rather than persisting a lossy cursor.
+                state = loader.state_dict()
+                if state is not None:
+                    return state
+            inner = getattr(loader, "_reader", None)
+            if inner is not None:
+                return inner.state_dict()
+        return None

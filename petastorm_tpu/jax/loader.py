@@ -65,6 +65,18 @@ class LoaderBase:
         self._pad_varlen = pad_variable_length_to
         self._keep_host = keep_host_fields
         self._in_iter = False
+        self._last_input_state = None
+        # Host-side buffering between the reader pull and batch delivery
+        # breaks delivery-accurate checkpointing (rows sit in the buffer
+        # past the snapshotted watermark); loaders set this to a human
+        # explanation and state_dict() refuses loudly instead of silently
+        # losing the buffered rows on resume.
+        self._ckpt_hazard: Optional[str] = None
+        # Loss-safe snapshot maintained by generators that buffer rows
+        # across group boundaries (BatchedDataLoader): taken only when the
+        # buffer is empty, so resume re-reads buffered groups (duplication)
+        # rather than skipping them (loss). None = snapshot live state.
+        self._pending_safe_state: Optional[dict] = None
         self.metrics = PipelineMetrics()
         self._last_staged_bytes = 0
         self._skipped_warned: set = set()
@@ -249,6 +261,13 @@ class LoaderBase:
                             hb = next(it)
                         except StopIteration:
                             break
+                    # Input-state snapshot BETWEEN reader pulls: it covers
+                    # exactly the rows assembled so far, so a checkpoint at
+                    # delivery of batch i resumes at batch i+1 — prefetched
+                    # but UNDELIVERED batches are re-read, not skipped (the
+                    # raw reader watermark would already have confirmed
+                    # them: data loss on resume).
+                    snap = self._snapshot_input_state()
                     t1 = time.perf_counter()
                     with trace("petastorm_tpu.stage"):
                         staged = self._stage(hb)
@@ -256,12 +275,12 @@ class LoaderBase:
                     n = len(next(iter(hb.values()))) if hb else 0
                     self.metrics.record_batch(n, self._last_staged_bytes,
                                               t1 - t0, t2 - t1)
-                    if not _put((None, staged)):
+                    if not _put((None, staged, snap)):
                         return
             except BaseException as e:  # noqa: BLE001 - re-raised on consumer
-                _put((_ERR, e))
+                _put((_ERR, e, None))
             finally:
-                _put((_END, None))
+                _put((_END, None, None))
                 # Exhausted generators close cleanly; an abandoned one (early
                 # consumer exit) closes here, on the thread that was running
                 # it, so reader teardown doesn't race the consumer.
@@ -273,11 +292,12 @@ class LoaderBase:
         thread.start()
         try:
             while True:
-                kind, item = q.get()
+                kind, item, snap = q.get()
                 if kind is _END:
                     break
                 if kind is _ERR:
                     raise item
+                self._last_input_state = snap
                 yield item
         finally:
             stop.set()
@@ -314,10 +334,47 @@ class LoaderBase:
             return out
         return cols
 
+    def _snapshot_live_state(self):
+        reader = getattr(self, "_reader", None)
+        if reader is None or not hasattr(reader, "state_dict"):
+            return None
+        return reader.state_dict()
+
+    def _snapshot_input_state(self):
+        if self._pending_safe_state is not None:
+            return dict(self._pending_safe_state)
+        return self._snapshot_live_state()
+
+    def state_dict(self):
+        """Resume point of the DELIVERED stream (not the reader's raw
+        watermark): the reader state as of the last batch this loader
+        yielded to the consumer. The staging thread prefetches ahead and
+        the reader confirms rows as they are *pulled*, so
+        ``reader.state_dict()`` mid-iteration can sit up to ``prefetch``
+        batches past what training actually consumed — resuming from it
+        would silently skip those rows. Resuming from this state re-reads
+        any prefetched-but-undelivered batches instead (the usual
+        watermark contract: bounded duplication, never loss). Before the
+        first delivered batch this is the reader's pre-pull state.
+
+        Loaders with a host-side *shuffling* buffer raise instead: the
+        buffer retains a random sample of rows indefinitely, so no reader
+        cursor can describe the delivered stream without loss. Use the
+        reader's own seeded shuffling (``shuffle_row_groups`` + ``seed``,
+        which IS resume-exact) for checkpointable runs."""
+        if self._ckpt_hazard is not None:
+            raise ValueError(
+                f"state_dict() would lose data with this loader "
+                f"configuration: {self._ckpt_hazard}")
+        return self._last_input_state
+
     def __iter__(self):
         if self._in_iter:
             raise RuntimeError("Loader is already being iterated")
         self._in_iter = True
+        self._pending_safe_state = None  # stale from a previous epoch
+        if self._last_input_state is None:
+            self._last_input_state = self._snapshot_input_state()
         try:
             yield from self._prefetched(self._host_batches())
         finally:
@@ -383,6 +440,11 @@ class DataLoader(LoaderBase):
         self._shuffling_capacity = shuffling_queue_capacity
         self._min_after = min_after_retrieve
         self._seed = seed
+        if shuffling_queue_capacity and shuffling_queue_capacity > 1:
+            self._ckpt_hazard = (
+                "shuffling_queue_capacity buffers a random sample of rows "
+                "host-side; checkpoint with reader-side seeded shuffling "
+                "instead")
 
     def _row_iterator(self):
         if self._reader.last_row_consumed:
@@ -529,6 +591,11 @@ class BatchedDataLoader(LoaderBase):
         self._shuffling_capacity = shuffling_queue_capacity
         self._min_after = min_after_retrieve
         self._seed = seed
+        if shuffling_queue_capacity and shuffling_queue_capacity > 1:
+            self._ckpt_hazard = (
+                "shuffling_queue_capacity buffers a random sample of rows "
+                "host-side; checkpoint with reader-side seeded shuffling "
+                "instead")
 
     def _group_to_columns(self, group) -> Dict[str, np.ndarray]:
         return self._batchable_columns(group)
@@ -550,11 +617,20 @@ class BatchedDataLoader(LoaderBase):
         it = iter(self._reader)
         exhausted = False
         tail_cols = None
+        buffered_rows = 0
         while True:
             while not exhausted and buf.can_add:
+                if buffered_rows == 0:
+                    # Rebatch buffer is empty: the reader cursor HERE is a
+                    # loss-safe resume point for every batch assembled from
+                    # rows pulled after it. Batches spanning a buffered
+                    # group tail keep the older snapshot — resume re-reads
+                    # the tail's group (duplication), never skips it.
+                    self._pending_safe_state = self._snapshot_live_state()
                 try:
                     cols = self._group_to_columns(next(it))
                     if cols:
+                        buffered_rows += len(next(iter(cols.values())))
                         buf.add_many(cols)
                 except StopIteration:
                     exhausted = True
@@ -562,6 +638,7 @@ class BatchedDataLoader(LoaderBase):
             if buf.can_retrieve:
                 batch = buf.retrieve()
                 n = len(next(iter(batch.values())))
+                buffered_rows = max(0, buffered_rows - n)
                 if n == self._batch_size:
                     yield batch
                 else:
