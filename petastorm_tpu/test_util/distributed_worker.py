@@ -1,4 +1,4 @@
-"""Child process for the real 2-process global-batch test.
+"""Child process for the real 2-process distributed tests.
 
 Each process joins a ``jax.distributed`` CPU cluster, opens
 ``make_reader(cur_shard="auto")`` (shard derived from the *distributed
@@ -7,15 +7,44 @@ DataLoader`, and drives ``jax.make_array_from_process_local_data`` with
 ``jax.process_count() == 2`` — the GSPMD global-assembly path that unit
 tests can only simulate (SURVEY.md §4 takeaway; round-2 verdict item 3).
 
+Modes (round-3 verdict item 5 added the image + resume coverage):
+
+* ``ids`` — scalar-id store; per-batch cross-host ``jnp.sum`` collectives.
+* ``img_full`` — png-image store through worker-side decode into sharded
+  global batches, per-batch pixel-sum collectives (the uninterrupted
+  reference stream).
+* ``img_part1`` — read ``k`` batches, save ``reader.state_dict()`` to
+  ``state_path``, then ``os._exit`` (abrupt death: no reader teardown,
+  like a killed trainer).
+* ``img_part2`` — restore ``resume_state`` from ``state_path`` and read
+  to the end. Watermark resume re-delivers in-flight groups and the two
+  processes' re-delivery counts can differ, so this phase runs NO
+  per-batch collectives (desynced counts would deadlock a psum); global
+  assembly is still exercised every batch (it is metadata + local
+  device_put, not a collective) and ONE final collective checks the
+  cluster is still coherent.
+
 Run as ``python -m petastorm_tpu.test_util.distributed_worker <url>
-<coordinator> <process_id> <num_processes> <out_json>``.
+<coordinator> <process_id> <num_processes> <out_json> [mode] [state_path]
+[k]``.
 """
 import json
+import os
 import sys
 
 
+def _local_ids_and_sums(arr):
+    """(ids-or-pixelsums list) for this process's addressable shards, in
+    global row order."""
+    import numpy as np
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return [np.asarray(s.data) for s in shards]
+
+
 def main(url: str, coordinator: str, process_id: int, num_processes: int,
-         out_path: str) -> None:
+         out_path: str, mode: str = "ids", state_path: str = None,
+         k: int = 2) -> None:
     import jax
 
     # The axon sitecustomize re-forces jax_platforms in every interpreter;
@@ -41,6 +70,81 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
     @jax.jit
     def global_sum(arr):             # cross-host collective over the mesh
         return jnp.sum(arr)
+
+    if mode == "ids":
+        _run_ids(url, out_path, process_id, sharding, global_sum)
+        return
+
+    resume_state = None
+    if mode == "img_part2":
+        with open(state_path) as f:
+            resume_state = json.load(f)
+
+    ids = []
+    pixel_sums = []                  # local per-row image pixel sums
+    global_shapes = []
+    global_pixel_sums = []           # collective (img_full only)
+    # Thread pool: the png decode happens in reader workers, not inline.
+    with make_reader(url, cur_shard="auto", shuffle_row_groups=False,
+                     reader_pool_type="thread", workers_count=2,
+                     num_epochs=1, resume_state=resume_state) as reader:
+        loader = DataLoader(reader, batch_size=4, sharding=sharding,
+                            drop_last=True)
+        for batch in loader:
+            labels, images = batch["label"], batch["image"]
+            assert isinstance(images, jax.Array)
+            global_shapes.append(list(images.shape))
+            for shard in _local_ids_and_sums(labels):
+                ids.extend(int(v) for v in shard.reshape(-1))
+            for shard in _local_ids_and_sums(images):
+                pixel_sums.extend(
+                    int(img.astype(np.int64).sum()) for img in shard)
+            if mode == "img_full":
+                global_pixel_sums.append(float(global_sum(
+                    images.astype(jnp.float32))))
+            if mode == "img_part1" and len(global_shapes) == k:
+                # Delivery-accurate loader state (NOT the raw reader
+                # watermark, which the prefetching staging thread may have
+                # advanced past undelivered batches).
+                with open(state_path, "w") as f:
+                    json.dump(loader.state_dict(), f)
+                _dump(out_path, process_id, ids, pixel_sums, global_shapes,
+                      global_pixel_sums)
+                # Abrupt death after the checkpoint: no reader/loader
+                # teardown, no atexit — the killed-trainer shape.
+                os._exit(0)
+
+    # One final REAL collective: each process contributes its delivered-row
+    # count through a global array; the mesh-wide sum must equal the
+    # cluster total on both hosts (proves the restarted cluster is
+    # coherent even though per-batch counts may differ after resume).
+    contrib = np.full(2, len(ids) / 2.0, np.float32)  # one per local device
+    garr = jax.make_array_from_process_local_data(sharding, contrib)
+    coherence = float(global_sum(garr))
+    _dump(out_path, process_id, ids, pixel_sums, global_shapes,
+          global_pixel_sums, coherence=coherence)
+
+
+def _dump(out_path, process_id, ids, pixel_sums, global_shapes,
+          global_pixel_sums, coherence=None):
+    import jax
+    with open(out_path, "w") as f:
+        json.dump({"process_id": process_id,
+                   "process_count": jax.process_count(),
+                   "local_device_count": jax.local_device_count(),
+                   "ids": ids,
+                   "pixel_sums": pixel_sums,
+                   "global_shapes": global_shapes,
+                   "global_pixel_sums": global_pixel_sums,
+                   "coherence": coherence}, f)
+
+
+def _run_ids(url, out_path, process_id, sharding, global_sum):
+    import jax
+    import numpy as np
+
+    from petastorm_tpu.jax import DataLoader
+    from petastorm_tpu.reader import make_reader
 
     ids = []
     global_shapes = []
@@ -76,4 +180,7 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
 
 if __name__ == "__main__":
     main(sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
-         sys.argv[5])
+         sys.argv[5],
+         sys.argv[6] if len(sys.argv) > 6 else "ids",
+         sys.argv[7] if len(sys.argv) > 7 else None,
+         int(sys.argv[8]) if len(sys.argv) > 8 else 2)
