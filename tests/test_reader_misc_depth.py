@@ -390,3 +390,22 @@ def test_bench_main_flow_probe_first_and_dispersion(monkeypatch, capsys,
     # committed evidence rides along even though this run was wedged
     assert parsed["tpu_evidence"]["imagenet"]["sps"] == 123.0
     assert "flash_attn" not in parsed["tpu_evidence"]
+
+
+def test_transport_bench_ring_vs_pipe_roundtrip():
+    """The transport micro-bench (shm ring vs pipe) produces sane rows and
+    a markdown table at tiny sizes — guards the producer/consumer protocol
+    and the ShmRing binding it drives."""
+    from petastorm_tpu.benchmark import transport_bench as tb
+    from petastorm_tpu.native import ring_available
+
+    if not ring_available():
+        import pytest as _pytest
+        _pytest.skip("native ring unavailable on this host")
+    rows = [tb.pipe_throughput(512, 64), tb.ring_throughput(512, 64),
+            tb.ring_throughput(512, 64, zero_copy=True)]
+    for r in rows:
+        assert r["items"] == 64
+        assert r["items_per_sec"] > 0 and r["mb_per_sec"] > 0
+    md = tb.to_markdown(rows)
+    assert "ring speedup" in md and "0 KB |" in md  # 512B renders as 0 KB
