@@ -29,8 +29,10 @@ HBM; this kernel never does. Design (flash-attention-2 style, TPU-first):
 
 Used as a drop-in ``attn_fn`` for :mod:`petastorm_tpu.models.llama` via
 :func:`make_flash_attention` (``supports_gqa`` — K/V stay at kv-head
-width). Fusing it into the ring-attention local step (the kernel would
-need to emit its m/l stats for the cross-device merge) is the next step.
+width), and fused into the ring-attention local step via
+:func:`flash_attention_stats`, which emits the online-softmax partials
+(unnormalized o, m, l) the ring's cross-device merge consumes
+(``ring_attention(..., local_attn="flash")``).
 """
 from __future__ import annotations
 
@@ -43,9 +45,15 @@ import numpy as np
 _DEFAULT_BLOCK = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  block_q: int, block_k: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
+                  block_k: int, causal: bool, scale: float,
+                  emit_stats: bool = False):
     from jax.experimental import pallas as pl
+
+    if emit_stats:
+        m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
 
     qi, ki = pl.program_id(2), pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -86,8 +94,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == n_k - 1)
     def _emit():
-        o_ref[0, :, 0, :] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(
-            o_ref.dtype)
+        if emit_stats:
+            # Unnormalized accumulator + online-softmax stats, f32: the
+            # caller (ring attention's cross-device merge) rescales and
+            # normalizes once after combining every block's contribution.
+            o_ref[0, :, 0, :] = acc_ref[:]
+            m_out_ref[0, :, 0] = m_ref[:, 0]
+            l_out_ref[0, :, 0] = l_ref[:, 0]
+        else:
+            o_ref[0, :, 0, :] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(
+                o_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -121,6 +137,118 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                         interpret: bool):
+    """Kernel launch emitting the ring-merge contract:
+    (unnormalized o f32 (b, sq, h, d), running max m (b, sq, h),
+    normalizer l (b, sq, h))."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk, kv_h = k.shape[1], k.shape[2]
+    rep = h // kv_h
+    kernel = partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                     causal=causal, scale=1.0 / np.sqrt(d), emit_stats=True)
+    stat_spec = pl.BlockSpec((1, block_q, 1),
+                             lambda bi, hi, qi, ki: (bi, qi, hi))
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, d),
+                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1, d),
+                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+            stat_spec,
+            stat_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, sq, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, sq, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),      # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),      # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),      # normalizer l
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_stats(q, k, v, causal: bool, block_q: int):
+    """The kernel's stats contract computed through the ring's chunked
+    dense block math — the fallback path AND the backward-recompute body
+    (one numerics home: f32 scores, GQA grouping, per-chunk remat).
+    Returns (o_unnormalized f32 (b, sq, h, d), m (b, sq, h), l (b, sq, h))."""
+    from petastorm_tpu.parallel.ring_attention import _block_attention_chunked
+
+    sq, sk = q.shape[1], k.shape[1]
+    bq = min(block_q, sq)
+    if sq % bq:
+        bq = sq  # chunking needs divisibility; fall back to one dense block
+    o, m, l = _block_attention_chunked(
+        q, k, v, k_pos=jnp.arange(sk), q_pos=jnp.arange(sq), causal=causal,
+        block_q=bq)
+    # ring layout (b, h, lq) -> kernel layout (b, sq, h)
+    return o, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_stats_vjp(causal, block_q, block_k, interpret, q, k, v):
+    return _flash_stats_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_stats_vjp_fwd(causal, block_q, block_k, interpret, q, k, v):
+    return (_flash_stats_forward(q, k, v, causal, block_q, block_k,
+                                 interpret), (q, k, v))
+
+
+def _flash_stats_vjp_bwd(causal, block_q, block_k, interpret, residual, g):
+    # Pallas kernels are not auto-differentiable: recompute through the
+    # chunked dense stats (mathematically the same function) and pull the
+    # (do, dm, dl) cotangents back through it. The ring's merge consumes
+    # m and l, so their cotangents are live, not zero.
+    q, k, v = residual
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _dense_stats(q_, k_, v_, causal, block_q), q, k, v)
+    return vjp(g)
+
+
+_flash_stats_vjp.defvjp(_flash_stats_vjp_fwd, _flash_stats_vjp_bwd)
+
+
+def flash_attention_stats(q, k, v, causal: bool = False,
+                          block_q: int = _DEFAULT_BLOCK,
+                          block_k: int = _DEFAULT_BLOCK, interpret=None):
+    """Flash kernel emitting the online-softmax partials instead of the
+    normalized output: ``(o_unnormalized f32, m, l)``, each ``(b, sq, h,
+    d)`` / ``(b, sq, h)`` — the contract ring attention's cross-device
+    merge consumes (``parallel.ring_attention`` step carry). Falls back to
+    the chunked dense path on shapes the kernel can't tile, numerically
+    identical. Differentiable via dense recompute (``custom_vjp``)."""
+    b, sq, h, d = q.shape
+    sk, kv_h = k.shape[1], k.shape[2]
+    if h % kv_h:
+        raise ValueError(f"heads ({h}) must be a multiple of kv_heads ({kv_h})")
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if (sq % block_q or sk % block_k or block_q % 8 or block_k % 8
+            or (causal and sq != sk)):
+        return _dense_stats(q, k, v, causal, block_q)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_stats_vjp(causal, block_q, block_k, bool(interpret),
+                            q, k, v)
 
 
 def _dense(q, k, v, causal):

@@ -26,6 +26,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# Default Pallas tile for the fused ring-local kernel (8-aligned; clamped
+# to the shard length inside flash_attention_stats).
+_FLASH_RING_BLOCK = 128
+
 
 def _block_attention(q, k, v, bias):
     """One (q-block, kv-block) pair -> (unnormalized out, row max, row sumexp).
@@ -93,7 +97,8 @@ def _block_attention_chunked(q, k, v, k_pos, q_pos, causal: bool,
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   local_block_q: Optional[int] = None):
+                   local_block_q: Optional[int] = None,
+                   local_attn: str = "dense"):
     """Exact (optionally causal) attention across a sequence-sharded ring.
 
     Must run inside ``shard_map``; ``axis_name`` is the sequence mesh axis.
@@ -102,7 +107,23 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     per-chunk rematerialization — peak score memory per step becomes
     O(local_block_q * block) instead of O(block²), for sequence shards too
     long to hold their own score tile.
+
+    ``local_attn="flash"`` fuses the Pallas flash kernel
+    (:func:`petastorm_tpu.ops.flash_attn.flash_attention_stats`) into each
+    ring step: the kernel emits the online-softmax partials (unnormalized
+    o, m, l) straight from VMEM, so the local step never materializes its
+    (lq, lk) score tile in HBM at all. Causality needs no global
+    positions inside the kernel — with equal sequence shards every held
+    K/V block is either fully in the past (plain kernel), the diagonal
+    block (causal kernel with LOCAL offsets), or fully in the future
+    (skipped before launch) — so the kernel stays static-shaped under the
+    traced ring index. Shapes the kernel can't tile (shard not divisible
+    by an 8-aligned block) fall back to the chunked dense math inside
+    ``flash_attention_stats``, numerically identical; the backward pass
+    recomputes through that same dense path (``custom_vjp``).
     """
+    if local_attn not in ("dense", "flash"):
+        raise ValueError(f"unknown local_attn {local_attn!r}")
     axis_size = jax.lax.axis_size(axis_name)
     my_index = jax.lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -120,7 +141,20 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # Global positions of the local q rows.
     q_pos = my_index * lq + jnp.arange(lq)
 
-    if local_block_q is None:
+    if local_attn == "flash":
+        from petastorm_tpu.ops.flash_attn import flash_attention_stats
+
+        def _flash_local(q_, k_blk, v_blk, diag_causal: bool):
+            o, m, l = flash_attention_stats(
+                q_, k_blk, v_blk, causal=diag_causal,
+                block_q=local_block_q or _FLASH_RING_BLOCK,
+                block_k=_FLASH_RING_BLOCK)
+            # kernel stat layout (b, lq, h) -> ring carry layout (b, h, lq)
+            return o, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+        def local_attention(q_, k_blk, v_blk, k_pos):  # non-causal steps
+            return _flash_local(q_, k_blk, v_blk, False)
+    elif local_block_q is None:
         def local_attention(q_, k_blk, v_blk, k_pos):
             bias = _causal_bias(q_pos, k_pos) if causal else \
                 jnp.zeros((1, 1, lq, lk), jnp.float32)
@@ -135,8 +169,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         kv_index = (my_index - step_idx) % axis_size
         k_pos = kv_index * lk + jnp.arange(lk)
         if causal:
-            def compute(_):
-                return local_attention(q, k_blk, v_blk, k_pos)
+            if local_attn == "flash":
+                def compute(_):
+                    # Diagonal block (the one my own K/V shard): causal
+                    # kernel with local offsets; strictly-past blocks:
+                    # plain kernel. Both branches are static-shaped.
+                    return jax.lax.cond(
+                        kv_index == my_index,
+                        lambda: _flash_local(q, k_blk, v_blk, True),
+                        lambda: _flash_local(q, k_blk, v_blk, False))
+            else:
+                def compute(_):
+                    return local_attention(q, k_blk, v_blk, k_pos)
 
             def skip(_):
                 return (jnp.zeros((b, lq, h, d), jnp.float32),
@@ -182,21 +226,23 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 def make_ring_attention(mesh, seq_axis: str = "seq", data_axis: str = "data",
                         head_axis: Optional[str] = None, causal: bool = True,
-                        local_block_q: Optional[int] = None):
+                        local_block_q: Optional[int] = None,
+                        local_attn: str = "dense"):
     """Build a ``shard_map``-wrapped ring attention over ``mesh``.
 
     Input/output layout: (batch, seq, heads, head_dim) with batch sharded on
     ``data_axis``, seq sharded on ``seq_axis``, and heads optionally sharded
     on ``head_axis`` (tensor parallelism composes: each model shard rings its
     own heads). ``local_block_q`` bounds each ring step's local score
-    memory (see :func:`ring_attention`).
+    memory; ``local_attn="flash"`` replaces the dense local step with the
+    fused Pallas flash kernel (see :func:`ring_attention`).
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(data_axis, seq_axis, head_axis, None)
     fn = partial(ring_attention, axis_name=seq_axis, causal=causal,
-                 local_block_q=local_block_q)
+                 local_block_q=local_block_q, local_attn=local_attn)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
 

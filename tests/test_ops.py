@@ -214,3 +214,92 @@ def test_flash_attention_multichunk_grads_match_dense():
                       argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+# ---------------------------------------------------------- flash stats ----
+
+def test_flash_stats_match_dense_stats():
+    """flash_attention_stats emits the ring-merge contract (unnormalized o,
+    m, l): must equal the chunked dense stats bit-for-tolerance, causal and
+    not, GQA included (kernel in interpret mode off-TPU)."""
+    import numpy as np
+    from petastorm_tpu.ops.flash_attn import (_dense_stats,
+                                              flash_attention_stats)
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(2, 64, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    for causal in (False, True):
+        o_f, m_f, l_f = flash_attention_stats(q, k, v, causal=causal,
+                                              block_q=16, block_k=16,
+                                              interpret=True)
+        o_d, m_d, l_d = _dense_stats(q, k, v, causal, block_q=16)
+        # m differs by the blockwise running max ONLY when a later block
+        # raises it; both are valid online-softmax states — compare the
+        # normalized outputs and the recombined normalizers instead.
+        np.testing.assert_allclose(
+            np.asarray(o_f / l_f[..., None]),
+            np.asarray(o_d / l_d[..., None]), atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(m_f + jnp.log(l_f)),   # logsumexp is state-invariant
+            np.asarray(m_d + jnp.log(l_d)), atol=2e-5)
+        assert o_f.shape == q.shape
+
+
+def test_flash_stats_fallback_non_tiling():
+    """Shapes the kernel can't tile (seq 20 -> block 20 not 8-aligned) fall
+    back to the dense stats transparently."""
+    import numpy as np
+    from petastorm_tpu.ops.flash_attn import (_dense_stats,
+                                              flash_attention_stats)
+
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 20, 2, 8)), jnp.float32)
+               for _ in range(3))
+    o_f, m_f, l_f = flash_attention_stats(q, k, v, causal=True)
+    o_d, m_d, l_d = _dense_stats(q, k, v, True, block_q=20)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m_f), np.asarray(m_d), atol=1e-6)
+
+
+def test_flash_stats_grad_matches_dense_stats_grad():
+    """The custom_vjp recomputes through the dense stats: gradients of a
+    loss touching ALL THREE outputs (o, m, l) must match the dense path."""
+    import numpy as np
+    from petastorm_tpu.ops.flash_attn import (_dense_stats,
+                                              flash_attention_stats)
+
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o, m, l = flash_attention_stats(q, k, v, causal=True, block_q=16,
+                                        block_k=16, interpret=True)
+        return jnp.sum(o / l[..., None]) + jnp.sum(m + jnp.log(l))
+
+    def loss_dense(q, k, v):
+        o, m, l = _dense_stats(q, k, v, True, block_q=16)
+        return jnp.sum(o / l[..., None]) + jnp.sum(m + jnp.log(l))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_flash_stats_fallback_large_non_multiple_seq():
+    """Regression: sq > default block and not a multiple of it (e.g. 200)
+    must fall back to ONE dense block, not crash in the chunked reshape."""
+    import numpy as np
+    from petastorm_tpu.ops.flash_attn import (_dense_stats,
+                                              flash_attention_stats)
+
+    rng = np.random.default_rng(6)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 200, 2, 8)), jnp.float32)
+               for _ in range(3))
+    o_f, m_f, l_f = flash_attention_stats(q, k, v, causal=True)
+    o_d, m_d, l_d = _dense_stats(q, k, v, True, block_q=200)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d), atol=1e-5)
