@@ -83,6 +83,11 @@ def train(url: str, steps: int = 30, per_shard_batch: int = 2,
     elif attn_kind == "ring-chunked":
         attn = make_ring_attention(mesh, seq_axis="seq", data_axis="data",
                                    causal=True, local_block_q=CHUNK // 2)
+    elif attn_kind == "ring-flash":
+        # Fused Pallas local step: each ring hop computes its block's
+        # online-softmax partials in VMEM (no HBM score tile at all).
+        attn = make_ring_attention(mesh, seq_axis="seq", data_axis="data",
+                                   causal=True, local_attn="flash")
     elif attn_kind in ("ulysses", "ulysses-flash"):
         from petastorm_tpu.parallel.ulysses_attention import \
             make_ulysses_attention
@@ -157,8 +162,8 @@ def main():
     parser.add_argument("--dp", type=int, default=2)
     parser.add_argument("--sp", type=int, default=4)
     parser.add_argument("--attn", default="ring",
-                        choices=["ring", "ring-chunked", "ulysses",
-                                 "ulysses-flash"])
+                        choices=["ring", "ring-chunked", "ring-flash",
+                                 "ulysses", "ulysses-flash"])
     args = parser.parse_args()
     import os
     if not os.path.exists(args.url.replace("file://", "") + "/_common_metadata"):

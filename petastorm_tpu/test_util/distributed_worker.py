@@ -13,7 +13,9 @@ Modes (round-3 verdict item 5 added the image + resume coverage):
 * ``img_full`` — png-image store through worker-side decode into sharded
   global batches, per-batch pixel-sum collectives (the uninterrupted
   reference stream).
-* ``img_part1`` — read ``k`` batches, save ``reader.state_dict()`` to
+* ``img_part1`` — read ``k`` batches, save the DELIVERY-ACCURATE
+  ``loader.state_dict()`` (not the raw reader watermark, which the
+  prefetching staging thread advances past undelivered batches) to
   ``state_path``, then ``os._exit`` (abrupt death: no reader teardown,
   like a killed trainer).
 * ``img_part2`` — restore ``resume_state`` from ``state_path`` and read

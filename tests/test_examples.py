@@ -140,7 +140,7 @@ def test_long_context_example_trains_on_mesh(tmp_path):
     assert losses[-1] < losses[0]
 
 
-@pytest.mark.parametrize("attn_kind", ["ring-chunked", "ulysses-flash"])
+@pytest.mark.parametrize("attn_kind", ["ring-chunked", "ring-flash", "ulysses-flash"])
 def test_long_context_example_attention_menu(tmp_path, attn_kind):
     """The example's alternative sequence-parallel attentions (chunked-remat
     ring, Ulysses with the Pallas flash local step) train the same model."""

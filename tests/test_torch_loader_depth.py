@@ -138,3 +138,39 @@ def test_collate_ndarray_stack():
 
 def test_collate_empty_input_passthrough():
     assert decimal_friendly_collate([]) == []
+
+
+def test_torch_loader_stacks_ngram_windows(tmp_path):
+    """NGram batching rides the shared loader machinery into the torch
+    adapter: homogeneous windows land as dense (batch, ngram_len) torch
+    tensors (reference collates ngram dicts per offset instead,
+    pytorch.py decimal_friendly_collate; the dense seq axis is this
+    framework's layout)."""
+    import numpy as np
+    import torch
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.pytorch import DataLoader
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema("Tok", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("token", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    url = f"file://{tmp_path}/tok"
+    with materialize_dataset_local(url, schema, rows_per_row_group=6) as w:
+        for i in range(24):
+            w.write_row({"ts": np.int64(i), "token": np.int32(i * 3)})
+    ngram = NGram({i: ["ts", "token"] for i in range(6)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        b = next(iter(DataLoader(reader, batch_size=2)))
+    assert isinstance(b["token"], torch.Tensor)
+    assert tuple(b["token"].shape) == (2, 6)
+    first = b["ts"][0, 0].item()
+    assert b["ts"][0].tolist() == list(range(first, first + 6))
+    assert b["token"][0].tolist() == [t * 3 for t in range(first, first + 6)]

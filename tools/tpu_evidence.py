@@ -119,6 +119,18 @@ gerr = float(np.max(np.abs(np.asarray(gq_f, np.float32)
 assert gerr < 0.25, f'on-chip flash grad mismatch: max abs err {{gerr}}'
 out['grad_max_abs_err'] = gerr
 
+# --- ring-merge stats variant compiles + matches on-device ------------
+from petastorm_tpu.ops.flash_attn import _dense_stats, flash_attention_stats
+q, k, v = mk(1024)
+o_f, m_f, l_f = jax.jit(lambda q, k, v: flash_attention_stats(
+    q, k, v, causal=True, interpret=False))(q, k, v)
+o_d, m_d, l_d = jax.jit(lambda q, k, v: _dense_stats(
+    q, k, v, True, block_q=128))(q, k, v)
+serr = float(np.max(np.abs(np.asarray(o_f / l_f[..., None], np.float32)
+                           - np.asarray(o_d / l_d[..., None], np.float32))))
+assert serr < 3e-2, f'on-chip stats kernel mismatch: max abs err {{serr}}'
+out['stats_parity_max_abs_err'] = serr
+
 # --- timing vs XLA dense at 4k / 8k ----------------------------------
 def med_time(fn, args, iters=10):
     jax.block_until_ready(fn(*args))  # warmup/compile outside the clock
