@@ -3,4 +3,5 @@ from petastorm_tpu.jax.checkpoint import CheckpointManager  # noqa: F401
 from petastorm_tpu.jax.device_cache import DeviceCachedDataset  # noqa: F401
 from petastorm_tpu.jax.dtypes import DTypePolicy, DEFAULT_POLICY  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader, BatchedDataLoader,  # noqa: F401
-                                      InMemBatchedDataLoader)
+                                      InMemBatchedDataLoader,
+                                      aligned_steps_per_epoch)

@@ -52,7 +52,8 @@ class LoaderBase:
     def __init__(self, batch_size: int, drop_last: bool = True,
                  pad_last: bool = False, sharding=None, device=None,
                  prefetch: int = 2, dtype_policy: DTypePolicy = DEFAULT_POLICY,
-                 pad_variable_length_to=None, keep_host_fields: bool = True):
+                 pad_variable_length_to=None, keep_host_fields: bool = True,
+                 steps_per_epoch: Optional[int] = None):
         if pad_last and drop_last:
             drop_last = False
         self._batch_size = batch_size
@@ -64,6 +65,11 @@ class LoaderBase:
         self._policy = dtype_policy
         self._pad_varlen = pad_variable_length_to
         self._keep_host = keep_host_fields
+        if steps_per_epoch is not None and steps_per_epoch < 1:
+            raise ValueError(f"steps_per_epoch must be >= 1, got "
+                             f"{steps_per_epoch}")
+        self._steps_per_epoch = steps_per_epoch
+        self._persistent_it = None
         self._in_iter = False
         self._last_input_state = None
         # Host-side buffering between the reader pull and batch delivery
@@ -372,11 +378,53 @@ class LoaderBase:
         if self._in_iter:
             raise RuntimeError("Loader is already being iterated")
         self._in_iter = True
-        self._pending_safe_state = None  # stale from a previous epoch
+        if self._persistent_it is None:
+            # Fresh pipeline: any safe-snapshot left over from a PREVIOUS
+            # (torn down) pipeline is stale. A live persistent pipeline
+            # keeps its snapshot — its buffers still hold the rows that
+            # snapshot guards, and clearing it would let state_dict() fall
+            # back to the raw watermark and skip them on resume.
+            self._pending_safe_state = None
         if self._last_input_state is None:
             self._last_input_state = self._snapshot_input_state()
         try:
-            yield from self._prefetched(self._host_batches())
+            if self._steps_per_epoch is None:
+                it = self._prefetched(self._host_batches())
+                try:
+                    yield from it
+                finally:
+                    it.close()
+            else:
+                # Truncate the pass at a fixed step count — the
+                # communication-free multi-host epoch alignment: every host
+                # passes the same ``steps_per_epoch`` (computed statically
+                # by :func:`aligned_steps_per_epoch`), so no host ever
+                # enters a collective its peers skip because their shard
+                # ran out of full batches first. The staging pipeline stays
+                # ALIVE between passes: tearing it down would drop its
+                # prefetched-but-undelivered batches from the stream, so
+                # with ``num_epochs=None`` the next pass continues exactly
+                # where this one stopped (a continuous stream chunked into
+                # aligned epochs). ``close()`` tears it down for real.
+                if self._persistent_it is None:
+                    self._persistent_it = self._prefetched(
+                        self._host_batches())
+                for step in range(self._steps_per_epoch):
+                    try:
+                        yield next(self._persistent_it)
+                    except StopIteration:
+                        self._persistent_it = None
+                        # A short pass recreates the cross-host desync this
+                        # feature exists to prevent (peer hosts may still
+                        # deliver full passes and block in collectives):
+                        # fail loudly instead of letting the cluster hang.
+                        raise RuntimeError(
+                            f"stream ended after {step} of "
+                            f"{self._steps_per_epoch} steps_per_epoch — a "
+                            f"finite reader ran dry mid-pass. Open the "
+                            f"reader with num_epochs=None (continuous "
+                            f"aligned passes) or bound steps_per_epoch to "
+                            f"what every epoch can deliver")
         finally:
             self._in_iter = False
 
@@ -386,6 +434,9 @@ class LoaderBase:
     def close(self):
         """Stop and join the underlying reader (no-op for loaders that
         already drained it). ``with loader: ...`` does this on exit."""
+        if self._persistent_it is not None:
+            self._persistent_it.close()   # stops the staging thread
+            self._persistent_it = None
         reader = getattr(self, "_reader", None)
         if reader is not None:
             reader.stop()
@@ -397,6 +448,78 @@ class LoaderBase:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+def aligned_steps_per_epoch(dataset_url_or_urls, batch_size: int,
+                            shard_count: Optional[int] = None,
+                            shard_seed: Optional[int] = None,
+                            drop_last: bool = True,
+                            storage_options: Optional[dict] = None,
+                            filesystem=None) -> int:
+    """Batches EVERY shard can deliver per epoch — the communication-free
+    epoch alignment for multi-host training.
+
+    ``index % shard_count`` sharding gives hosts different row counts
+    whenever the row groups don't divide evenly; ``drop_last`` only fixes
+    each host's own ragged tail, so the host with the largest shard would
+    still step into a collective its peers never join at epoch end
+    (SURVEY.md §7 "hard parts": ragged end-of-epoch shards). Because
+    shard assignment is static arithmetic over metadata every host can
+    read, each host computes the SAME bound without communication: min
+    over shards of floor (or ceil when ``drop_last=False``) of
+    shard_rows / batch_size. Pass it as ``DataLoader(...,
+    steps_per_epoch=N)`` on every host.
+
+    Mirrors the reader's planning exactly (``load_row_groups`` order +
+    ``Reader._partition_row_groups`` with the same ``shard_seed``). Row
+    counts come from the Parquet footers, so the bound is only valid for
+    readers that deliver every row of their shard — no ``predicate``, no
+    ``rowgroup_selector``, no ``shuffle_row_drop_partitions``, and not
+    the NGram window count (windows per group < rows per group).
+    ``shard_count`` defaults from the JAX distributed runtime.
+    """
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                    load_row_groups)
+    from petastorm_tpu.reader import Reader
+
+    if shard_count is None:
+        import jax
+        shard_count = jax.process_count()
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
+                         filesystem=filesystem)
+    groups = load_row_groups(ctx)
+
+    def _footer_rows(path):
+        with ctx.filesystem.open(path, "rb") as f:
+            md = pq.ParquetFile(f).metadata
+            return path, [md.row_group(i).num_rows
+                          for i in range(md.num_row_groups)]
+
+    # Footer reads fan out like load_row_groups' own scan — on remote
+    # stores a serial loop would be O(files) round trips per host.
+    from concurrent.futures import ThreadPoolExecutor
+    paths = sorted({rg.path for rg in groups})
+    with ThreadPoolExecutor(max_workers=10) as pool:
+        rows_by_path = dict(pool.map(_footer_rows, paths))
+
+    steps = []
+    for shard in range(shard_count):
+        refs = Reader._partition_row_groups(groups, shard, shard_count,
+                                            shard_seed)
+        rows = sum(rows_by_path[rg.path][rg.row_group] for rg in refs)
+        n = rows // batch_size if drop_last else -(-rows // batch_size)
+        if n == 0:
+            raise ValueError(
+                f"shard {shard}/{shard_count} holds only {rows} rows — "
+                f"fewer than one batch of {batch_size}"
+                f"{' (drop_last)' if drop_last else ''}. Use a smaller "
+                f"batch, fewer shards, or larger row groups")
+        steps.append(n)
+    return min(steps)
 
 
 def _pad_to(arr_list, target_len):

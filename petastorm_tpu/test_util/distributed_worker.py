@@ -76,6 +76,9 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
     if mode == "ids":
         _run_ids(url, out_path, process_id, sharding, global_sum)
         return
+    if mode == "ids_aligned":
+        _run_ids_aligned(url, out_path, process_id, sharding, global_sum)
+        return
 
     resume_state = None
     if mode == "img_part2":
@@ -139,6 +142,38 @@ def _dump(out_path, process_id, ids, pixel_sums, global_shapes,
                    "global_shapes": global_shapes,
                    "global_pixel_sums": global_pixel_sums,
                    "coherence": coherence}, f)
+
+
+def _run_ids_aligned(url, out_path, process_id, sharding, global_sum):
+    """Unequal shards + a collective EVERY batch: without the static epoch
+    alignment the larger shard would enter a psum its peer never joins
+    and the cluster would deadlock to the test timeout. Both processes
+    compute the same ``aligned_steps_per_epoch`` bound from metadata
+    alone and run two truncated passes — every collective pairs up."""
+    import jax
+    import numpy as np
+
+    from petastorm_tpu.jax import DataLoader, aligned_steps_per_epoch
+    from petastorm_tpu.reader import make_reader
+
+    steps = aligned_steps_per_epoch(url, batch_size=4,
+                                    shard_count=jax.process_count())
+    ids, sums = [], []
+    with make_reader(url, cur_shard="auto", shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=None) as reader:
+        with DataLoader(reader, batch_size=4, sharding=sharding,
+                        steps_per_epoch=steps) as loader:
+            for _ in range(2):                      # two aligned passes
+                for batch in loader:
+                    arr = batch["id"]
+                    for shard in _local_ids_and_sums(arr):
+                        ids.extend(int(v) for v in shard.reshape(-1))
+                    sums.append(float(global_sum(arr)))
+    with open(out_path, "w") as f:
+        json.dump({"process_id": process_id,
+                   "process_count": jax.process_count(),
+                   "steps_per_epoch": steps,
+                   "ids": ids, "global_sums": sums}, f)
 
 
 def _run_ids(url, out_path, process_id, sharding, global_sum):

@@ -210,3 +210,39 @@ def test_two_process_image_decode_and_cross_process_resume(image_dataset,
         # processes' id-counts summed over the mesh)
         assert part2[pid]["coherence"] == (
             len(part2[0]["ids"]) + len(part2[1]["ids"]))
+
+
+@pytest.fixture(scope="module")
+def unequal_dataset(tmp_path_factory):
+    """5 row groups over 2 shards: shard0 gets 3 (24 rows), shard1 gets 2
+    (16 rows) — the ragged multi-host epoch case."""
+    url = f"file://{tmp_path_factory.mktemp('dist_unequal')}/ids"
+    schema = Unischema("Ids", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    with materialize_dataset_local(url, schema, rows_per_row_group=8) as w:
+        for i in range(40):
+            w.write_row({"id": np.int64(i)})
+    return url
+
+
+@pytest.mark.slow
+def test_two_process_unequal_shards_aligned_epochs(unequal_dataset, tmp_path):
+    """Static epoch alignment across REAL processes: shard0 could deliver 6
+    batches, shard1 only 4 — with a psum on every batch the unaligned loop
+    would deadlock at batch 5. Both workers derive steps_per_epoch=4 from
+    metadata alone and complete two aligned passes with every collective
+    paired."""
+    by_pid = _spawn_pair(unequal_dataset, tmp_path, "aligned", "ids_aligned")
+    for pid in (0, 1):
+        assert by_pid[pid]["steps_per_epoch"] == 4
+        # 2 passes x 4 batches x 4 local rows
+        assert len(by_pid[pid]["ids"]) == 32
+    # every collective paired and agreed
+    assert by_pid[0]["global_sums"] == by_pid[1]["global_sums"]
+    assert len(by_pid[0]["global_sums"]) == 8
+    # shard0 (groups 0,2,4) cycles through its 24 rows; shard1 through 16;
+    # nothing out of shard
+    assert set(by_pid[0]["ids"]) <= set(range(0, 8)) | set(range(16, 24)) \
+        | set(range(32, 40))
+    assert set(by_pid[1]["ids"]) <= set(range(8, 16)) | set(range(24, 32))

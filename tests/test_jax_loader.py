@@ -462,3 +462,106 @@ def test_ngram_loader_pads_varlen_with_target(tmp_path):
     seq = np.asarray(b["seq"])
     assert seq[1, 1, :4].tolist() == [3.0, 3.0, 3.0, 3.0]
     assert seq[1, 1, 4:].tolist() == [0.0, 0.0]
+
+
+# --------------------------------------------- multi-host epoch alignment ----
+
+def _write_unequal_store(tmp_path, groups=5, rows_per_group=8):
+    """groups=5 over 2 shards -> shard0 gets 3 groups (24 rows), shard1
+    gets 2 (16 rows): the ragged multi-host case."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("U", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    url = f"file://{tmp_path}/unequal"
+    with materialize_dataset_local(url, schema,
+                                   rows_per_row_group=rows_per_group) as w:
+        for i in range(groups * rows_per_group):
+            w.write_row({"id": np.int64(i)})
+    return url
+
+
+def test_aligned_steps_per_epoch_takes_min_shard(tmp_path):
+    """5 groups x 8 rows over 2 shards: shard0 holds 24 rows, shard1 16.
+    With batch 8 the naive per-host counts are 3 vs 2 — the one-step
+    mismatch that deadlocks a collective at epoch end; the helper returns
+    the min every host can deliver."""
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+    url = _write_unequal_store(tmp_path)
+    assert aligned_steps_per_epoch(url, batch_size=8, shard_count=2) == 2
+    assert aligned_steps_per_epoch(url, batch_size=8, shard_count=1) == 5
+    # ceil mode (drop_last=False on every host)
+    assert aligned_steps_per_epoch(url, batch_size=7, shard_count=2,
+                                   drop_last=False) == 3  # ceil(16/7)
+    # seeded pre-shard shuffle changes the assignment; the helper mirrors it
+    n = aligned_steps_per_epoch(url, batch_size=8, shard_count=2,
+                                shard_seed=11)
+    assert n in (1, 2)
+
+
+def test_aligned_steps_match_actual_reader_batches(tmp_path):
+    """The helper's bound must equal what each sharded reader+loader pair
+    actually delivers (floor mode), shard by shard."""
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+    url = _write_unequal_store(tmp_path)
+    per_shard = []
+    for shard in (0, 1):
+        with make_reader(url, cur_shard=shard, shard_count=2,
+                         shuffle_row_groups=False, reader_pool_type="dummy",
+                         num_epochs=1) as r:
+            per_shard.append(sum(1 for _ in DataLoader(r, batch_size=8)))
+    assert min(per_shard) == aligned_steps_per_epoch(url, batch_size=8,
+                                                     shard_count=2)
+    assert per_shard == [3, 2]  # the raggedness the helper exists for
+
+
+def test_loader_steps_per_epoch_truncates_and_continues(tmp_path):
+    """steps_per_epoch caps every pass; with num_epochs=None the stream
+    continues across passes (continuous stream chunked into aligned
+    epochs), so every host sees identical pass lengths forever."""
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+    url = _write_unequal_store(tmp_path)
+    n = aligned_steps_per_epoch(url, batch_size=8, shard_count=2)
+    with make_reader(url, cur_shard=0, shard_count=2,
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=None) as r:
+        loader = DataLoader(r, batch_size=8, steps_per_epoch=n)
+        pass1 = [np.asarray(b["id"]) for b in loader]
+        pass2 = [np.asarray(b["id"]) for b in loader]
+    assert len(pass1) == n and len(pass2) == n
+    # pass2 continues the shard stream where pass1 stopped, losing nothing
+    # (the staging pipeline stays alive between passes): shard0 holds
+    # groups 0,2,4 -> rows [0-7],[16-23],[32-39]; pass1 delivered the
+    # first two batches, pass2 starts at 32.
+    assert pass1[0][0] == 0 and pass1[-1][-1] == 23
+    assert pass2[0][0] == 32
+
+    with make_reader(url, cur_shard=0, shard_count=2,
+                     reader_pool_type="dummy") as r2:
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            DataLoader(r2, batch_size=8, steps_per_epoch=0)
+
+
+def test_loader_steps_per_epoch_raises_on_short_pass(tmp_path):
+    """A finite reader running dry mid-pass would silently desync the
+    cluster (peer hosts still in collectives); the loader must fail loudly
+    instead."""
+    url = _write_unequal_store(tmp_path)
+    with make_reader(url, cur_shard=0, shard_count=2,
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=1) as r:
+        loader = DataLoader(r, batch_size=8, steps_per_epoch=2)
+        assert len(list(loader)) == 2       # pass 1 completes
+        with pytest.raises(RuntimeError, match="ran dry mid-pass"):
+            list(loader)                    # leftover stream: 1 < 2 steps
+
+
+def test_aligned_steps_raises_on_undersized_shard(tmp_path):
+    """A shard smaller than one batch must raise with the shard named, not
+    return 0 to blow up later inside DataLoader."""
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+    url = _write_unequal_store(tmp_path, groups=3, rows_per_group=4)
+    with pytest.raises(ValueError, match="shard 1/2 holds only 4 rows"):
+        aligned_steps_per_epoch(url, batch_size=8, shard_count=2)
