@@ -411,7 +411,7 @@ class LoaderBase:
                         self._host_batches())
                 for step in range(self._steps_per_epoch):
                     try:
-                        yield next(self._persistent_it)
+                        nxt = next(self._persistent_it)
                     except StopIteration:
                         self._persistent_it = None
                         # A short pass recreates the cross-host desync this
@@ -425,6 +425,15 @@ class LoaderBase:
                             f"reader with num_epochs=None (continuous "
                             f"aligned passes) or bound steps_per_epoch to "
                             f"what every epoch can deliver")
+                    except BaseException:
+                        # A real failure (reader I/O error re-raised by the
+                        # staging thread) terminates the generator: drop it
+                        # so a retrying caller rebuilds the pipeline instead
+                        # of hitting a misleading "ran dry mid-pass" on the
+                        # dead iterator.
+                        self._persistent_it = None
+                        raise
+                    yield nxt
         finally:
             self._in_iter = False
 
@@ -448,6 +457,42 @@ class LoaderBase:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+def _summary_row_counts(ctx, paths):
+    """Per-row-group row counts from the dataset's summary ``_metadata``
+    file — ONE sidecar read instead of a footer sweep over every file.
+    None when there is no usable/complete summary (caller falls back)."""
+    import os as os_mod
+    import posixpath
+
+    import pyarrow.parquet as pq
+
+    if getattr(ctx, "is_multi_path", False):
+        return None
+    sidecar = posixpath.join(ctx.root_path, "_metadata")
+    try:
+        if not ctx.filesystem.exists(sidecar):
+            return None
+        with ctx.filesystem.open(sidecar, "rb") as f:
+            md = pq.read_metadata(f)
+    except (OSError, IOError, ValueError):
+        return None
+    if md.num_row_groups == 0:
+        return None
+    out: Dict[str, list] = {}
+    for i in range(md.num_row_groups):
+        rg = md.row_group(i)
+        rel = rg.column(0).file_path
+        if not rel:
+            return None
+        out.setdefault(posixpath.join(ctx.root_path, rel), []).append(
+            rg.num_rows)
+    by_norm = {os_mod.path.normpath(p): p for p in out}
+    if {os_mod.path.normpath(p) for p in paths} != set(by_norm):
+        return None  # stale/partial summary: fall back to footers
+    return {paths_p: out[by_norm[os_mod.path.normpath(paths_p)]]
+            for paths_p in paths}
 
 
 def aligned_steps_per_epoch(dataset_url_or_urls, batch_size: int,
@@ -492,19 +537,29 @@ def aligned_steps_per_epoch(dataset_url_or_urls, batch_size: int,
     ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
                          filesystem=filesystem)
     groups = load_row_groups(ctx)
-
-    def _footer_rows(path):
-        with ctx.filesystem.open(path, "rb") as f:
-            md = pq.ParquetFile(f).metadata
-            return path, [md.row_group(i).num_rows
-                          for i in range(md.num_row_groups)]
-
-    # Footer reads fan out like load_row_groups' own scan — on remote
-    # stores a serial loop would be O(files) round trips per host.
-    from concurrent.futures import ThreadPoolExecutor
     paths = sorted({rg.path for rg in groups})
-    with ThreadPoolExecutor(max_workers=10) as pool:
-        rows_by_path = dict(pool.map(_footer_rows, paths))
+    rows_by_path = _summary_row_counts(ctx, paths)
+    if rows_by_path is not None:
+        # Ordinal indexing below relies on the summary listing each file's
+        # groups completely; a count mismatch means a stale summary.
+        per_path_groups: Dict[str, int] = {}
+        for rg in groups:
+            per_path_groups[rg.path] = per_path_groups.get(rg.path, 0) + 1
+        if any(len(rows_by_path[p]) != per_path_groups.get(p, 0)
+               for p in paths):
+            rows_by_path = None
+    if rows_by_path is None:
+        def _footer_rows(path):
+            with ctx.filesystem.open(path, "rb") as f:
+                md = pq.ParquetFile(f).metadata
+                return path, [md.row_group(i).num_rows
+                              for i in range(md.num_row_groups)]
+
+        # Footer reads fan out like load_row_groups' own scan — on remote
+        # stores a serial loop would be O(files) round trips per host.
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=10) as pool:
+            rows_by_path = dict(pool.map(_footer_rows, paths))
 
     steps = []
     for shard in range(shard_count):

@@ -67,11 +67,20 @@ class SparkDatasetConverter:
 
     # ------------------------------------------------------------ consumers
     def make_jax_loader(self, batch_size: int, sharding=None, cur_shard="auto",
-                        num_epochs: Optional[int] = None, **reader_kwargs):
+                        num_epochs: Optional[int] = None,
+                        steps_per_epoch="auto", **reader_kwargs):
         """Batched JAX loader over the cached store; shards per TPU host by
         default (the reference's Horovod-rank behavior, :124, rebuilt on
-        jax.process_index)."""
-        from petastorm_tpu.jax import BatchedDataLoader
+        jax.process_index).
+
+        ``steps_per_epoch="auto"`` (multi-host only) applies the
+        communication-free epoch alignment: every host truncates each pass
+        at :func:`petastorm_tpu.jax.aligned_steps_per_epoch` so ragged
+        shards of the cached store can't desync a collective. Pass an int
+        to override, or ``None`` to disable.
+        """
+        from petastorm_tpu.jax import (BatchedDataLoader,
+                                       aligned_steps_per_epoch)
         from petastorm_tpu.reader import make_batch_reader
         if cur_shard == "auto":
             try:
@@ -81,9 +90,31 @@ class SparkDatasetConverter:
                 logger.warning("cur_shard='auto' but the JAX runtime is "
                                "unavailable; reading unsharded")
                 cur_shard = None
+        if steps_per_epoch == "auto":
+            steps_per_epoch = None
+            # The static bound assumes every row of the shard is delivered:
+            # row-filtering knobs invalidate it, so auto stands down
+            # (transform_spec can drop rows from the whole group too —
+            # batch_reader_worker applies it to the group DataFrame).
+            filtered = any(reader_kwargs.get(k) is not None
+                           for k in ("predicate", "rowgroup_selector",
+                                     "transform_spec"))
+            if cur_shard is not None and not filtered:
+                import jax
+                count = reader_kwargs.get("shard_count") or jax.process_count()
+                if count > 1:
+                    # Mirror the reader it gates: same seeded pre-shard
+                    # shuffle, same credentials/filesystem.
+                    steps_per_epoch = aligned_steps_per_epoch(
+                        self.cache_dir_url, batch_size, shard_count=count,
+                        shard_seed=reader_kwargs.get("shard_seed"),
+                        storage_options=reader_kwargs.get("storage_options"),
+                        filesystem=reader_kwargs.get("filesystem"))
         reader = make_batch_reader(self.cache_dir_url, cur_shard=cur_shard,
                                    num_epochs=num_epochs, **reader_kwargs)
-        return BatchedDataLoader(reader, batch_size=batch_size, sharding=sharding)
+        return BatchedDataLoader(reader, batch_size=batch_size,
+                                 sharding=sharding,
+                                 steps_per_epoch=steps_per_epoch)
 
     def make_tf_dataset(self, batch_size: Optional[int] = None,
                         num_epochs: Optional[int] = None, **reader_kwargs):

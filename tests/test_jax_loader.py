@@ -565,3 +565,53 @@ def test_aligned_steps_raises_on_undersized_shard(tmp_path):
     url = _write_unequal_store(tmp_path, groups=3, rows_per_group=4)
     with pytest.raises(ValueError, match="shard 1/2 holds only 4 rows"):
         aligned_steps_per_epoch(url, batch_size=8, shard_count=2)
+
+
+def test_aligned_steps_summary_metadata_fast_path(tmp_path):
+    """With a summary _metadata sidecar present, the helper reads per-group
+    row counts in ONE sidecar read instead of sweeping footers — and gets
+    the same answer."""
+    from petastorm_tpu.etl.dataset_metadata import write_summary_metadata
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+    from petastorm_tpu.jax.loader import _summary_row_counts
+    from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                    load_row_groups)
+
+    url = _write_unequal_store(tmp_path)
+    before = aligned_steps_per_epoch(url, batch_size=8, shard_count=2)
+    write_summary_metadata(url)
+
+    ctx = DatasetContext(url)
+    paths = sorted({rg.path for rg in load_row_groups(ctx)})
+    counts = _summary_row_counts(ctx, paths)
+    assert counts is not None, "summary sidecar written but not used"
+    assert sorted(n for rows in counts.values() for n in rows) \
+        == [8, 8, 8, 8, 8]
+    assert aligned_steps_per_epoch(url, batch_size=8, shard_count=2) == before
+
+
+def test_loader_steps_per_epoch_drops_dead_pipeline_on_error(tmp_path):
+    """A real failure mid-pass must not leave the persistent pipeline
+    pointing at a terminated generator (the retry would then hit a
+    misleading 'ran dry mid-pass'); the next pass rebuilds cleanly."""
+    url = _write_unequal_store(tmp_path)
+
+    class FlakyLoader(DataLoader):
+        fail_next = True
+
+        def _host_batches(self):
+            for i, b in enumerate(super()._host_batches()):
+                if i == 1 and FlakyLoader.fail_next:
+                    FlakyLoader.fail_next = False
+                    raise OSError("transient read failure")
+                yield b
+
+    with make_reader(url, cur_shard=0, shard_count=2,
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=None) as r:
+        loader = FlakyLoader(r, batch_size=8, steps_per_epoch=2)
+        with pytest.raises(OSError, match="transient"):
+            list(loader)
+        assert loader._persistent_it is None
+        # retry rebuilds the pipeline and completes a full pass
+        assert len(list(loader)) == 2

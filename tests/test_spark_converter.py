@@ -414,3 +414,34 @@ def test_dataset_as_rdd_regex_fields(spark_session, synthetic_dataset):
     rdd = dataset_as_rdd(synthetic_dataset.url, spark_session,
                          schema_fields=["id.*"])
     assert set(rdd.first()._fields) == {"id", "id2"}
+
+
+def test_make_jax_loader_auto_aligned_steps(spark_session, cache_url):
+    """steps_per_epoch="auto" with an explicit 2-shard split applies the
+    static epoch alignment: both shards' loaders truncate at the same
+    bound, and the bound matches aligned_steps_per_epoch on the cached
+    store."""
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+
+    df = _make_df(spark_session)
+    conv = make_spark_converter(df, parent_cache_dir_url=cache_url)
+    expected = aligned_steps_per_epoch(conv.cache_dir_url, batch_size=3,
+                                       shard_count=2)
+    counts = []
+    for shard in (0, 1):
+        loader = conv.make_jax_loader(batch_size=3, cur_shard=shard,
+                                      shard_count=2, num_epochs=None,
+                                      shuffle_row_groups=False,
+                                      reader_pool_type="dummy")
+        with loader:
+            counts.append(sum(1 for _ in loader))
+    assert counts == [expected, expected]
+    # explicit None disables the truncation
+    loader = conv.make_jax_loader(batch_size=3, cur_shard=0, shard_count=2,
+                                  num_epochs=1, steps_per_epoch=None,
+                                  shuffle_row_groups=False,
+                                  reader_pool_type="dummy")
+    with loader:
+        untruncated = sum(1 for _ in loader)
+    assert untruncated >= expected
+    conv.delete()
