@@ -171,11 +171,11 @@ def _expand_row_groups(ctx: DatasetContext, path_counts) -> List[RowGroupRef]:
     return out
 
 
-def _row_groups_from_summary_metadata(ctx: DatasetContext,
-                                      files: List[str]) -> Optional[List[RowGroupRef]]:
-    """Row groups split out of a summary ``_metadata`` file, zero footer
-    reads (parity: reference etl/dataset_metadata.py:296-338). Returns None
-    when there is no usable summary (absent, row-group-free, or stale)."""
+def _read_summary_metadata(ctx: DatasetContext):
+    """``pq.FileMetaData`` of the summary ``_metadata`` sidecar, or None
+    when absent, unreadable, or schema-only (no row groups). Shared by the
+    planning fast path and :func:`summary_row_group_row_counts` so the
+    probe/degrade logic lives in one place."""
     if ctx.is_multi_path:
         return None
     p = posixpath.join(ctx.root_path, "_metadata")
@@ -190,6 +190,37 @@ def _row_groups_from_summary_metadata(ctx: DatasetContext,
         return None
     if md.num_row_groups == 0:
         return None  # schema-only sidecar, not a summary
+    return md
+
+
+def summary_row_group_row_counts(ctx: DatasetContext) -> Optional[Dict[str, List[int]]]:
+    """Per-row-group row counts from the summary ``_metadata`` sidecar —
+    ``{absolute file path: [rows of group 0, rows of group 1, ...]}`` in
+    summary order — or None when there is no usable summary. One sidecar
+    read replaces a footer sweep over every file (used by
+    ``petastorm_tpu.jax.aligned_steps_per_epoch``)."""
+    md = _read_summary_metadata(ctx)
+    if md is None:
+        return None
+    out: Dict[str, List[int]] = {}
+    for i in range(md.num_row_groups):
+        rg = md.row_group(i)
+        rel = rg.column(0).file_path
+        if not rel:
+            return None  # malformed summary: row group without a file path
+        out.setdefault(posixpath.join(ctx.root_path, rel), []).append(
+            rg.num_rows)
+    return out
+
+
+def _row_groups_from_summary_metadata(ctx: DatasetContext,
+                                      files: List[str]) -> Optional[List[RowGroupRef]]:
+    """Row groups split out of a summary ``_metadata`` file, zero footer
+    reads (parity: reference etl/dataset_metadata.py:296-338). Returns None
+    when there is no usable summary (absent, row-group-free, or stale)."""
+    md = _read_summary_metadata(ctx)
+    if md is None:
+        return None
     per_file: Dict[str, int] = {}
     for i in range(md.num_row_groups):
         file_path = md.row_group(i).column(0).file_path

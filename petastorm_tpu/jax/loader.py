@@ -53,7 +53,7 @@ class LoaderBase:
                  pad_last: bool = False, sharding=None, device=None,
                  prefetch: int = 2, dtype_policy: DTypePolicy = DEFAULT_POLICY,
                  pad_variable_length_to=None, keep_host_fields: bool = True,
-                 steps_per_epoch: Optional[int] = None):
+                 steps_per_epoch: Optional[int] = None, echo: int = 1):
         if pad_last and drop_last:
             drop_last = False
         self._batch_size = batch_size
@@ -70,6 +70,18 @@ class LoaderBase:
                              f"{steps_per_epoch}")
         self._steps_per_epoch = steps_per_epoch
         self._persistent_it = None
+        if echo < 1:
+            raise ValueError(f"echo must be >= 1, got {echo}")
+        # Data echoing (Choi et al., arXiv:1907.05550): when the host
+        # pipeline is the bottleneck, re-yield each staged batch ``echo``
+        # times. Repeats are cheap DEVICE-SIDE copies of the HBM-resident
+        # arrays (one intra-HBM copy, no host decode, no host->device
+        # transfer), so device utilization rises by up to ``echo``x at the
+        # cost of repeated gradient steps on the same data. Copies — not
+        # aliases — because a jitted train step with input donation
+        # deletes its batch buffers; an aliased repeat would crash with
+        # "Array has been deleted" for exactly the users echo targets.
+        self._echo = echo
         self._in_iter = False
         self._last_input_state = None
         # Host-side buffering between the reader pull and batch delivery
@@ -305,6 +317,8 @@ class LoaderBase:
                     raise item
                 self._last_input_state = snap
                 yield item
+                for _ in range(self._echo - 1):
+                    yield self._echo_copy(item)
         finally:
             stop.set()
             # _put polls `stop` every 50ms, so the producer exits on its own
@@ -339,6 +353,15 @@ class LoaderBase:
                 [np.ones(count, np.bool_), np.zeros(pad, np.bool_)])
             return out
         return cols
+
+    @staticmethod
+    def _echo_copy(item):
+        """Donation-safe repeat of a staged batch: device arrays are
+        copied on-device (intra-HBM), host columns pass through."""
+        import jax
+
+        return {k: (v.copy() if isinstance(v, jax.Array) else v)
+                for k, v in item.items()}
 
     def _snapshot_live_state(self):
         reader = getattr(self, "_reader", None)
@@ -460,34 +483,16 @@ class LoaderBase:
 
 
 def _summary_row_counts(ctx, paths):
-    """Per-row-group row counts from the dataset's summary ``_metadata``
-    file — ONE sidecar read instead of a footer sweep over every file.
-    None when there is no usable/complete summary (caller falls back)."""
+    """Per-row-group row counts keyed exactly by ``paths`` from the summary
+    ``_metadata`` sidecar (one read, shared probe logic in
+    ``etl.dataset_metadata``); None when absent/stale -> footer sweep."""
     import os as os_mod
-    import posixpath
 
-    import pyarrow.parquet as pq
+    from petastorm_tpu.etl.dataset_metadata import summary_row_group_row_counts
 
-    if getattr(ctx, "is_multi_path", False):
+    out = summary_row_group_row_counts(ctx)
+    if out is None:
         return None
-    sidecar = posixpath.join(ctx.root_path, "_metadata")
-    try:
-        if not ctx.filesystem.exists(sidecar):
-            return None
-        with ctx.filesystem.open(sidecar, "rb") as f:
-            md = pq.read_metadata(f)
-    except (OSError, IOError, ValueError):
-        return None
-    if md.num_row_groups == 0:
-        return None
-    out: Dict[str, list] = {}
-    for i in range(md.num_row_groups):
-        rg = md.row_group(i)
-        rel = rg.column(0).file_path
-        if not rel:
-            return None
-        out.setdefault(posixpath.join(ctx.root_path, rel), []).append(
-            rg.num_rows)
     by_norm = {os_mod.path.normpath(p): p for p in out}
     if {os_mod.path.normpath(p) for p in paths} != set(by_norm):
         return None  # stale/partial summary: fall back to footers

@@ -615,3 +615,53 @@ def test_loader_steps_per_epoch_drops_dead_pipeline_on_error(tmp_path):
         assert loader._persistent_it is None
         # retry rebuilds the pipeline and completes a full pass
         assert len(list(loader)) == 2
+
+
+# --------------------------------------------------------- data echoing ----
+
+def test_loader_echo_repeats_staged_batches(tmp_path):
+    """echo=3 yields every staged batch three times as the SAME device
+    arrays (no re-stage, no re-decode): the data-echoing remedy for a
+    host-bound input pipeline."""
+    url = _write_token_store(tmp_path, rows=20, group=5)
+    with make_reader(url, schema_fields=["ts"], shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as r:
+        loader = DataLoader(r, batch_size=5, echo=3)
+        batches = list(loader)
+    assert len(batches) == 4 * 3
+    for i in range(0, 12, 3):
+        # repeats are donation-safe DEVICE copies of the staged arrays:
+        # equal values, distinct buffers (a donating train step deletes
+        # its batch; an aliased repeat would crash)
+        assert batches[i]["ts"] is not batches[i + 1]["ts"]
+        assert batches[i + 1]["ts"] is not batches[i + 2]["ts"]
+        np.testing.assert_array_equal(np.asarray(batches[i]["ts"]),
+                                      np.asarray(batches[i + 1]["ts"]))
+        np.testing.assert_array_equal(np.asarray(batches[i]["ts"]),
+                                      np.asarray(batches[i + 2]["ts"]))
+    firsts = [int(b["ts"][0]) for b in batches[::3]]
+    assert firsts == [0, 5, 10, 15]
+    with make_reader(url, schema_fields=["ts"], reader_pool_type="dummy") as r2:
+        with pytest.raises(ValueError, match="echo"):
+            DataLoader(r2, batch_size=5, echo=0)
+
+
+def test_loader_echo_composes_with_steps_per_epoch(tmp_path):
+    """steps_per_epoch counts DELIVERED (echoed) batches, so the aligned
+    bound stays collective-safe: every host yields exactly N per pass
+    regardless of echo."""
+    url = _write_unequal_store(tmp_path)
+    with make_reader(url, cur_shard=0, shard_count=2,
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     num_epochs=None) as r:
+        loader = DataLoader(r, batch_size=8, echo=2, steps_per_epoch=3)
+        p1 = list(loader)
+        p2 = list(loader)
+    assert len(p1) == 3 and len(p2) == 3
+    # echo=2: batches arrive as A A B | B C C across the two passes
+    # (repeats are equal-valued device copies, donation-safe)
+    np.testing.assert_array_equal(np.asarray(p1[0]["id"]),
+                                  np.asarray(p1[1]["id"]))
+    np.testing.assert_array_equal(np.asarray(p1[2]["id"]),
+                                  np.asarray(p2[0]["id"]))
+    assert int(p1[2]["id"][0]) != int(p1[1]["id"][0])
