@@ -103,3 +103,20 @@ def test_probe_maps_rc42_to_cpu_only(te, monkeypatch):
     for rc, expect in ((42, "cpu-only"), (1, "wedged"), (-14, "wedged")):
         monkeypatch.setattr(subprocess, "run", lambda *a, rc=rc, **k: R(rc))
         assert te.probe(alarm_s=1)[0] == expect
+
+
+def test_committed_evidence_artifact_is_valid_jsonl():
+    """The committed BENCH_TPU_EVIDENCE.jsonl is an append-only artifact
+    written by multiple concurrent processes; every line must stay valid
+    JSON with the ts/event/status envelope or the round JSON inherits
+    garbage."""
+    path = pathlib.Path(__file__).parent.parent / "BENCH_TPU_EVIDENCE.jsonl"
+    if not path.exists():
+        pytest.skip("no evidence artifact yet")
+    lines = [ln for ln in path.read_text().splitlines() if ln.strip()]
+    assert lines, "artifact exists but is empty"
+    for ln in lines:
+        rec = json.loads(ln)
+        assert {"ts", "event", "status"} <= set(rec)
+        assert rec["status"] in ("ok", "skipped")
+        assert rec["event"] in ("probe", "imagenet", "flash_attn")
