@@ -79,6 +79,95 @@ def _coalesce_row_groups(refs, max_per_item: int):
     return out
 
 
+_FILTER_OPS = ("=", "==", "!=", "<", "<=", ">", ">=", "in", "not in")
+
+
+def _filter_value_eq(val, ref) -> bool:
+    """Hive partition values arrive as path strings; compare by string
+    render — with a numeric fallback so ``("year", "=", 2024.0)`` still
+    matches the ``year=2024`` directory (same coercion the ordering ops
+    use; string-only equality would silently match nothing)."""
+    if str(val) == str(ref):
+        return True
+    try:
+        return float(val) == float(ref)
+    except (TypeError, ValueError):
+        return False
+
+
+def _filter_compare(val, op: str, ref) -> bool:
+    if op in ("=", "=="):
+        return _filter_value_eq(val, ref)
+    if op == "!=":
+        return not _filter_value_eq(val, ref)
+    if op == "in":
+        return any(_filter_value_eq(val, r) for r in ref)
+    if op == "not in":
+        return not any(_filter_value_eq(val, r) for r in ref)
+    # ordering: numeric when both sides coerce, else lexicographic
+    try:
+        a, b = float(val), float(ref)
+    except (TypeError, ValueError):
+        a, b = str(val), str(ref)
+    return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+
+
+def _normalize_filters(filters):
+    """-> list of AND-groups (DNF). Accepts the two standard pyarrow forms:
+    ``[(col, op, val), ...]`` (one conjunction) and
+    ``[[(col, op, val), ...], ...]`` (disjunction of conjunctions).
+    Validation is EAGER — ops, clause shapes, empty groups, and in/not-in
+    reference types are all checked here, not lazily during matching where
+    short-circuiting would make errors data-dependent."""
+    if not filters:
+        return []
+    if all(isinstance(f, tuple) for f in filters):
+        groups = [list(filters)]
+    elif all(isinstance(f, (list, tuple)) for f in filters):
+        groups = [list(g) for g in filters]
+    else:
+        raise ValueError("filters must be a list of (col, op, val) tuples "
+                         "or a list of such lists")
+    for g in groups:
+        if not g:
+            raise ValueError("empty filter conjunction [] matches nothing "
+                             "meaningfully; remove it or add clauses")
+        for clause in g:
+            if not (isinstance(clause, tuple) and len(clause) == 3):
+                raise ValueError(f"bad filter clause {clause!r}; expected "
+                                 f"(column, op, value)")
+            _col, op, ref = clause
+            if op not in _FILTER_OPS:
+                raise ValueError(f"unsupported filter op {op!r} "
+                                 f"(supported: {' '.join(_FILTER_OPS)})")
+            if op in ("in", "not in") and isinstance(ref, (str, bytes)):
+                raise ValueError(
+                    f"filter ({_col!r}, {op!r}, {ref!r}): the reference "
+                    f"must be a list/tuple/set of values, not a string "
+                    f"(iterating a string compares its characters)")
+    return groups
+
+
+def _row_group_matches_filters(partition_dict: dict, groups) -> bool:
+    # A row group lacking a referenced key (heterogeneous multi-URL stores)
+    # can never satisfy the clause: non-match, not KeyError.
+    def clause_ok(col, op, ref):
+        if col not in partition_dict:
+            return False
+        return _filter_compare(partition_dict[col], op, ref)
+
+    return any(all(clause_ok(*clause) for clause in g) for g in groups)
+
+
+def _partition_keys(row_groups) -> set:
+    """Union of hive partition keys across all row groups (multi-URL views
+    can mix partitioned and unpartitioned stores)."""
+    keys: set = set()
+    for rg in row_groups:
+        keys.update(k for k, _ in rg.partition_values)
+    return keys
+
+
 def _resolve_shard(cur_shard, shard_count):
     """``cur_shard="auto"`` -> this JAX process's (index, count)."""
     if cur_shard == "auto":
@@ -124,6 +213,7 @@ def make_reader(dataset_url,
                 shuffle_row_drop_partitions: int = 1,
                 predicate=None,
                 rowgroup_selector=None,
+                filters=None,
                 num_epochs: Optional[int] = 1,
                 cur_shard=None,
                 shard_count: Optional[int] = None,
@@ -149,6 +239,11 @@ def make_reader(dataset_url,
     :param shuffle_rows: shuffle rows inside each row group
     :param shuffle_row_drop_partitions: ventilate each row group N times,
         each reading a different 1/N slice (decorrelates at memory cost)
+    :param filters: standard pyarrow partition filters — ``[(col, op,
+        val), ...]`` (ANDed) or a list of such lists (ORed) with ops
+        ``= == != < <= > >= in "not in"`` — pruning whole row groups by
+        hive partition values at planning time (columns must be partition
+        keys; use ``predicate`` for row-level filtering)
     :param num_epochs: passes over the dataset; ``None`` = infinite
     :param cur_shard/shard_count: this process's shard; ``cur_shard="auto"``
         derives both from the JAX distributed runtime
@@ -199,6 +294,7 @@ def make_reader(dataset_url,
                   transform_spec=transform_spec,
                   storage_options=storage_options,
                   resume_state=resume_state,
+                  filters=filters,
                   filesystem=filesystem,
                   rowgroup_coalescing=rowgroup_coalescing)
 
@@ -212,6 +308,7 @@ def make_batch_reader(dataset_url_or_urls,
                       shuffle_rows: bool = False,
                       shuffle_row_drop_partitions: int = 1,
                       predicate=None,
+                      filters=None,
                       num_epochs: Optional[int] = 1,
                       cur_shard=None,
                       shard_count: Optional[int] = None,
@@ -233,6 +330,8 @@ def make_batch_reader(dataset_url_or_urls,
     group; batch size = row-group size).
 
     ``schema_fields`` is a list of column names or name regexes.
+    ``filters`` takes standard pyarrow partition-filter tuples (see
+    :func:`make_reader`).
     ``convert_early_to_numpy`` moves the Arrow->numpy conversion into the
     workers (parity: reference reader.py:227, arrow_reader_worker.py:279) —
     useful when worker parallelism should absorb the conversion cost; the
@@ -280,6 +379,7 @@ def make_batch_reader(dataset_url_or_urls,
                   transform_spec=transform_spec,
                   storage_options=storage_options,
                   resume_state=resume_state,
+                  filters=filters,
                   filesystem=filesystem,
                   convert_early_to_numpy=convert_early_to_numpy,
                   rowgroup_coalescing=rowgroup_coalescing)
@@ -297,7 +397,7 @@ class Reader:
                  num_epochs, cur_shard, shard_count, shard_seed, seed, cache,
                  transform_spec, storage_options, resume_state=None,
                  filesystem=None, convert_early_to_numpy=False,
-                 rowgroup_coalescing=1):
+                 rowgroup_coalescing=1, filters=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -336,7 +436,8 @@ class Reader:
         all_row_groups = load_row_groups(ctx)
         filtered = self._filter_row_groups(all_row_groups, predicate,
                                            rowgroup_selector, cur_shard,
-                                           shard_count, shard_seed)
+                                           shard_count, shard_seed,
+                                           filters=filters)
         if not filtered:
             raise NoDataAvailableError(
                 "No row groups left after predicate/selector/shard filtering. "
@@ -429,8 +530,10 @@ class Reader:
 
     # ------------------------------------------------------------- planning
     def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
-                           cur_shard, shard_count, shard_seed):
+                           cur_shard, shard_count, shard_seed, filters=None):
         filtered = list(row_groups)
+        if filters:
+            filtered = self._apply_filters(filtered, filters)
         if predicate is not None:
             filtered = self._apply_partition_predicate(filtered, predicate)
         if rowgroup_selector is not None:
@@ -441,16 +544,43 @@ class Reader:
         return filtered
 
     @staticmethod
+    def _apply_filters(row_groups, filters):
+        """Standard pyarrow-style partition filters (``(col, op, val)``
+        DNF), pruning whole row groups against their hive partition values
+        at planning time — the reference hands the same syntax to
+        ``pq.ParquetDataset(filters=...)`` (reference reader.py:408,:433).
+        Columns must be partition keys: unlike a worker-side ``predicate``
+        there is nothing to evaluate them against later, so a typo'd or
+        non-partition column raises instead of silently matching nothing."""
+        groups = _normalize_filters(filters)
+        if not groups:
+            return row_groups
+        partition_keys = _partition_keys(row_groups)
+        referenced = {col for g in groups for col, _, _ in g}
+        unknown = referenced - partition_keys
+        if unknown:
+            raise ValueError(
+                f"filters reference non-partition column(s) "
+                f"{sorted(unknown)}; this dataset's partition keys are "
+                f"{sorted(partition_keys) or '(none - unpartitioned store)'}. "
+                f"Use predicate=... for row-level filtering")
+        return [rg for rg in row_groups
+                if _row_group_matches_filters(rg.partition_dict, groups)]
+
+    @staticmethod
     def _apply_partition_predicate(row_groups, predicate):
         """When every predicate field is a hive partition key, whole row
-        groups are pruned at planning time (reference reader.py:620)."""
+        groups are pruned at planning time (reference reader.py:620).
+        Groups missing one of the keys (heterogeneous multi-URL views) are
+        kept — the worker-side evaluation decides for them."""
         fields = predicate.get_fields()
         if not row_groups:
             return row_groups
-        partition_keys = {k for k, _ in row_groups[0].partition_values}
-        if not fields or not fields.issubset(partition_keys):
+        if not fields or not fields.issubset(_partition_keys(row_groups)):
             return row_groups
-        return [rg for rg in row_groups if predicate.do_include(rg.partition_dict)]
+        return [rg for rg in row_groups
+                if not fields.issubset(set(rg.partition_dict))
+                or predicate.do_include(rg.partition_dict)]
 
     def _apply_selector(self, all_row_groups, filtered, selector):
         from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes

@@ -276,3 +276,105 @@ def test_rowgroup_coalescing_through_process_pool(synthetic_dataset):
                      rowgroup_coalescing=2) as r:
         ids = sorted(row.id for row in r)
     assert ids == sorted(row["id"] for row in synthetic_dataset.rows)
+
+
+def test_filters_prune_partitions(partitioned_ds):
+    """Standard pyarrow filter tuples prune whole row groups by hive
+    partition value at planning time (the reference hands the same syntax
+    to pq.ParquetDataset(filters=...), reader.py:408)."""
+    with make_reader(partitioned_ds, filters=[("split", "=", "test")],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        rows = list(r)
+        ventilated = len(r._ventilator._items)
+    assert sorted(s.id for s in rows) == [i for i in range(30) if i % 3 == 0]
+    assert ventilated == 2  # 10 test rows / 5-row groups: planner pruning
+
+    # DNF: list of lists = OR of AND-groups
+    with make_reader(partitioned_ds,
+                     filters=[[("split", "=", "test")],
+                              [("split", "in", ["train"])]],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        assert len(list(r)) == 30
+
+    with make_reader(partitioned_ds, filters=[("split", "!=", "test")],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        assert sorted(s.id for s in list(r)) == \
+            [i for i in range(30) if i % 3 != 0]
+
+
+def test_filters_validate_columns_and_ops(partitioned_ds, ds):
+    with pytest.raises(ValueError, match="non-partition column"):
+        make_reader(partitioned_ds, filters=[("id", "=", 3)])
+    with pytest.raises(ValueError, match="partition keys"):
+        make_reader(ds.url, filters=[("split", "=", "x")])  # unpartitioned
+    with pytest.raises(ValueError, match="unsupported filter op"):
+        make_reader(partitioned_ds, filters=[("split", "~", "t")])
+    with pytest.raises(ValueError, match="filter clause"):
+        make_reader(partitioned_ds, filters=[[("split", "=")]])
+
+
+def test_filters_numeric_ordering_on_string_partitions(tmp_path):
+    """Ordering ops coerce both sides numerically when possible, so
+    ("year", ">=", 2023) matches year=2023/2024 directories written as
+    path strings."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema as _U, UnischemaField as _UF
+    schema = _U("Y", [
+        _UF("id", np.int64, (), ScalarCodec(np.int64), False),
+        _UF("year", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    url = f"file://{tmp_path}/years"
+    with materialize_dataset_local(url, schema, rows_per_row_group=4,
+                                   partition_by=["year"]) as w:
+        for i in range(16):
+            w.write_row({"id": i, "year": np.int32(2021 + i % 4)})
+    with make_reader(url, filters=[("year", ">=", 2023)],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        years = {int(s.year) for s in r}
+    assert years == {2023, 2024}
+
+
+def test_filters_on_batch_reader(partitioned_ds):
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(partitioned_ds, filters=[("split", "=", "test")],
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        ids = [int(v) for g in r for v in g.id]
+    assert sorted(ids) == [i for i in range(30) if i % 3 == 0]
+
+
+def test_filters_validation_is_eager_and_strict(partitioned_ds):
+    """Malformed filters raise at construction regardless of whether any
+    matching row group would have short-circuited past them."""
+    # typo'd op in a LATER OR-group, first group matches everything
+    with pytest.raises(ValueError, match="unsupported filter op"):
+        make_reader(partitioned_ds,
+                    filters=[[("split", "in", ["train", "test"])],
+                             [("split", "=q=", "val")]])
+    with pytest.raises(ValueError, match="empty filter conjunction"):
+        make_reader(partitioned_ds, filters=[[]])
+    # a string reference for `in` would iterate characters: rejected
+    with pytest.raises(ValueError, match="not a string"):
+        make_reader(partitioned_ds, filters=[("split", "in", "test")])
+
+
+def test_filters_numeric_equality_coercion(tmp_path):
+    """("year", "=", 2024.0) must match the year=2024 hive directory: the
+    equality comparison falls back to the same numeric coercion the
+    ordering ops use."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema as _U, UnischemaField as _UF
+    schema = _U("Y", [
+        _UF("id", np.int64, (), ScalarCodec(np.int64), False),
+        _UF("year", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    url = f"file://{tmp_path}/eqyears"
+    with materialize_dataset_local(url, schema, rows_per_row_group=4,
+                                   partition_by=["year"]) as w:
+        for i in range(8):
+            w.write_row({"id": i, "year": np.int32(2023 + i % 2)})
+    with make_reader(url, filters=[("year", "=", 2024.0)],
+                     shuffle_row_groups=False, reader_pool_type="dummy") as r:
+        assert {int(s.year) for s in r} == {2024}
