@@ -494,8 +494,11 @@ def _summary_row_counts(ctx, paths):
     if out is None:
         return None
     by_norm = {os_mod.path.normpath(p): p for p in out}
-    if {os_mod.path.normpath(p) for p in paths} != set(by_norm):
-        return None  # stale/partial summary: fall back to footers
+    # The summary must COVER every requested path (it may be a superset:
+    # plan-level filters prune paths before this lookup); missing entries
+    # mean a stale summary -> footer fallback.
+    if not {os_mod.path.normpath(p) for p in paths} <= set(by_norm):
+        return None
     return {paths_p: out[by_norm[os_mod.path.normpath(paths_p)]]
             for paths_p in paths}
 
@@ -505,7 +508,7 @@ def aligned_steps_per_epoch(dataset_url_or_urls, batch_size: int,
                             shard_seed: Optional[int] = None,
                             drop_last: bool = True,
                             storage_options: Optional[dict] = None,
-                            filesystem=None) -> int:
+                            filesystem=None, filters=None) -> int:
     """Batches EVERY shard can deliver per epoch — the communication-free
     epoch alignment for multi-host training.
 
@@ -520,12 +523,15 @@ def aligned_steps_per_epoch(dataset_url_or_urls, batch_size: int,
     shard_rows / batch_size. Pass it as ``DataLoader(...,
     steps_per_epoch=N)`` on every host.
 
-    Mirrors the reader's planning exactly (``load_row_groups`` order +
+    Mirrors the reader's planning exactly (``load_row_groups`` order,
+    the same ``filters`` partition pruning, then
     ``Reader._partition_row_groups`` with the same ``shard_seed``). Row
-    counts come from the Parquet footers, so the bound is only valid for
-    readers that deliver every row of their shard — no ``predicate``, no
-    ``rowgroup_selector``, no ``shuffle_row_drop_partitions``, and not
-    the NGram window count (windows per group < rows per group).
+    counts come from the summary/footer metadata, so the bound is only
+    valid for readers that deliver every row of their planned shard — no
+    ``predicate``, no ``rowgroup_selector``, no
+    ``shuffle_row_drop_partitions``, and not the NGram window count
+    (windows per group < rows per group). Plan-level ``filters`` ARE
+    supported: pass the same value the reader gets.
     ``shard_count`` defaults from the JAX distributed runtime.
     """
     import pyarrow.parquet as pq
@@ -542,6 +548,8 @@ def aligned_steps_per_epoch(dataset_url_or_urls, batch_size: int,
     ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
                          filesystem=filesystem)
     groups = load_row_groups(ctx)
+    if filters:
+        groups = Reader._apply_filters(groups, filters)
     paths = sorted({rg.path for rg in groups})
     rows_by_path = _summary_row_counts(ctx, paths)
     if rows_by_path is not None:

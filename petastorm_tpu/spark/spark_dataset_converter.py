@@ -104,12 +104,14 @@ class SparkDatasetConverter:
                 count = reader_kwargs.get("shard_count") or jax.process_count()
                 if count > 1:
                     # Mirror the reader it gates: same seeded pre-shard
-                    # shuffle, same credentials/filesystem.
+                    # shuffle, same plan-level partition filters, same
+                    # credentials/filesystem.
                     steps_per_epoch = aligned_steps_per_epoch(
                         self.cache_dir_url, batch_size, shard_count=count,
                         shard_seed=reader_kwargs.get("shard_seed"),
                         storage_options=reader_kwargs.get("storage_options"),
-                        filesystem=reader_kwargs.get("filesystem"))
+                        filesystem=reader_kwargs.get("filesystem"),
+                        filters=reader_kwargs.get("filters"))
         reader = make_batch_reader(self.cache_dir_url, cur_shard=cur_shard,
                                    num_epochs=num_epochs, **reader_kwargs)
         return BatchedDataLoader(reader, batch_size=batch_size,

@@ -665,3 +665,36 @@ def test_loader_echo_composes_with_steps_per_epoch(tmp_path):
     np.testing.assert_array_equal(np.asarray(p1[2]["id"]),
                                   np.asarray(p2[0]["id"]))
     assert int(p1[2]["id"][0]) != int(p1[1]["id"][0])
+
+
+def test_aligned_steps_respects_plan_level_filters(tmp_path):
+    """filters prune at planning time, so the aligned bound must apply the
+    SAME pruning or it overcounts and hosts run dry mid-pass."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.jax import aligned_steps_per_epoch
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema("F", [
+        UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("split", str, (), ScalarCodec(str), False),
+    ])
+    url = f"file://{tmp_path}/filt"
+    with materialize_dataset_local(url, schema, rows_per_row_group=4,
+                                   partition_by=["split"]) as w:
+        for i in range(32):
+            w.write_row({"id": np.int64(i),
+                         "split": "train" if i % 4 else "val"})
+    full = aligned_steps_per_epoch(url, batch_size=4, shard_count=2)
+    train_only = aligned_steps_per_epoch(
+        url, batch_size=4, shard_count=2,
+        filters=[("split", "=", "train")])
+    assert train_only < full
+    # ground truth: count what filtered sharded readers actually deliver
+    per_shard = []
+    for shard in (0, 1):
+        with make_reader(url, cur_shard=shard, shard_count=2,
+                         filters=[("split", "=", "train")],
+                         shuffle_row_groups=False,
+                         reader_pool_type="dummy", num_epochs=1) as r:
+            per_shard.append(sum(1 for _ in DataLoader(r, batch_size=4)))
+    assert train_only == min(per_shard)
