@@ -1,0 +1,109 @@
+"""Round-long TPU watcher (round-5 verdict item 1).
+
+Per-bench-run probing failed 7/7 times in round 4 — the tunnel never
+happened to be open when a bench run wanted it. This inverts the
+arrangement: started at round open, this watcher probes the accelerator
+every ``--interval`` seconds for the whole session and, in the FIRST
+healthy window, fires the full on-chip evidence suite in cheapest-first
+order (flash-attn compile+parity+timing, then the ImageNet bench with
+sps/chip + stall% + MFU). Each phase appends to the committed
+``BENCH_TPU_EVIDENCE.jsonl`` *as it completes*, so a mid-suite wedge
+still banks partial proof.
+
+Every probe attempt — healthy or not — is appended to
+``TPU_PROBE_LOG.jsonl`` so the round artifact either carries on-chip
+numbers or a wall-clock log proving the tunnel never opened for even one
+window. (Reference analog for the workload being evidenced:
+/root/reference/petastorm/benchmark/throughput.py:112-149.)
+
+Usage (backgrounded at round open)::
+
+    nohup python tools/tpu_watcher.py >> /tmp/tpu_watcher.out 2>&1 &
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import tpu_evidence  # noqa: E402
+
+REPO_ROOT = tpu_evidence.REPO_ROOT
+PROBE_LOG = os.path.join(REPO_ROOT, "TPU_PROBE_LOG.jsonl")
+
+
+def _log_probe(status: str, kind: str | None, note: str = "") -> None:
+    rec = {"ts": datetime.datetime.now(datetime.timezone.utc)
+           .strftime("%Y-%m-%dT%H:%M:%SZ"), "status": status}
+    if kind:
+        rec["device_kind"] = kind
+    if note:
+        rec["note"] = note
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"probe: {json.dumps(rec)}", flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=int, default=300,
+                    help="seconds between probes while waiting (default 300)")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--data-dir",
+                    default=os.environ.get("BENCH_DATA_DIR", "/tmp/pt_bench"))
+    ap.add_argument("--max-captures", type=int, default=2,
+                    help="stop re-capturing after this many full successes "
+                         "(a second window gives a dispersion check; more is "
+                         "just load on the shared 1-core host)")
+    args = ap.parse_args(argv)
+
+    deadline = time.time() + args.max_hours * 3600
+    # Phase completion is tracked per phase: a wedge between flash and
+    # imagenet must not cause a later window to redo the banked phase.
+    done: dict[str, int] = {"flash_attn": 0, "imagenet": 0}
+    full_captures = 0
+
+    while time.time() < deadline:
+        status, kind = tpu_evidence.probe()
+        _log_probe(status, kind)
+        if status == "ok":
+            tpu_evidence.append_evidence(
+                {"event": "probe", "status": "ok", "device_kind": kind})
+            window_ok = True
+            for phase, fn in (
+                    ("flash_attn",
+                     lambda: tpu_evidence.capture_flash_attn()),
+                    ("imagenet",
+                     lambda: tpu_evidence.capture_imagenet(args.data_dir))):
+                if done[phase] > full_captures:
+                    continue  # banked this round already
+                result = fn()
+                if result is not None:
+                    done[phase] += 1
+                    _log_probe("capture-ok", kind, note=phase)
+                else:
+                    window_ok = False
+                    _log_probe("capture-failed", kind, note=phase)
+                    break  # window likely wedged mid-suite; re-probe first
+            if window_ok and min(done.values()) > full_captures:
+                full_captures += 1
+                _log_probe("suite-complete", kind,
+                           note=f"full capture #{full_captures}")
+            if full_captures >= args.max_captures:
+                _log_probe("watcher-done", kind,
+                           note=f"{full_captures} full captures banked")
+                return 0
+        # After at least one full capture, back off to an hourly heartbeat:
+        # the proof is banked and the host has one core to share.
+        time.sleep(args.interval if full_captures == 0 else 3600)
+    _log_probe("watcher-timeout", None,
+               note=f"{full_captures} full captures in {args.max_hours}h")
+    return 0 if full_captures else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
