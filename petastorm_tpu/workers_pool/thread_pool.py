@@ -46,46 +46,63 @@ class WorkerTerminationRequested(Exception):
 
 class _WorkerThread(threading.Thread):
     def __init__(self, worker_impl, input_queue, result_queue, stop_event,
-                 put_fn, profiling_enabled=False):
+                 put_fn, prof=None):
         super().__init__(name=f"pt-worker-{worker_impl.worker_id}", daemon=True)
         self._worker_impl = worker_impl
         self._input_queue = input_queue
         self._result_queue = result_queue
         self._stop_event = stop_event
         self._put = put_fn
-        self.prof = cProfile.Profile() if profiling_enabled else None
+        self.prof = prof  # per-worker cProfile; pre-3.12 only (see ThreadPool)
 
     def run(self):
-        if self.prof:
-            self.prof.enable()
+        # ANY exit path that isn't an explicit stop must surface to the
+        # consumer as a WorkerFailure: a worker that dies silently (e.g. an
+        # error before/around the processing loop) leaves its assigned items
+        # forever unprocessed and the pipeline spinning in get_results().
+        try:
+            if self.prof:
+                self.prof.enable()  # inside the guard: a failed enable()
+                # (single profiler slot) must surface, not hang the pipeline
+            self._loop()
+        except WorkerTerminationRequested:
+            pass
+        except Exception as e:  # noqa: BLE001 - propagate to consumer
+            tb = format_exc()
+            sys.stderr.write(f"Worker {self._worker_impl.worker_id} terminated: {tb}\n")
+            try:
+                self._put(WorkerFailure(e, tb))
+            except WorkerTerminationRequested:
+                pass
+        finally:
+            self._worker_impl.shutdown()
+            if self.prof:
+                self.prof.disable()
+
+    def _loop(self):
         while not self._stop_event.is_set():
             try:
                 args, kwargs = self._input_queue.get(block=True, timeout=_IO_TIMEOUT_S)
             except queue.Empty:
                 continue
-            try:
-                self._worker_impl.process(*args, **kwargs)
-                self._put(VentilatedItemProcessedMessage(
-                    kwargs.get(ITEM_CONTEXT_KWARG)))
-            except WorkerTerminationRequested:
-                break
-            except Exception as e:  # noqa: BLE001 - propagate to consumer
-                tb = format_exc()
-                sys.stderr.write(f"Worker {self._worker_impl.worker_id} terminated: {tb}\n")
-                try:
-                    self._put(WorkerFailure(e, tb))
-                except WorkerTerminationRequested:
-                    pass
-                break
-        self._worker_impl.shutdown()
-        if self.prof:
-            self.prof.disable()
+            self._worker_impl.process(*args, **kwargs)
+            self._put(VentilatedItemProcessedMessage(
+                kwargs.get(ITEM_CONTEXT_KWARG)))
 
 
 class ThreadPool:
     """:param workers_count: number of worker threads
     :param results_queue_size: bound of each per-worker result queue
-    :param profiling_enabled: wrap workers in cProfile; merged stats print on join
+    :param profiling_enabled: cProfile the pool; stats print on ``join()``.
+        On CPython 3.12+ cProfile registers a process-global
+        ``sys.monitoring`` tool — one profiler enabled at ``start()``
+        already observes every thread, and a second ``enable()`` raises
+        "Another profiling tool is already active" — so 3.12+ uses ONE
+        pool-level profile (covering workers plus whatever the consumer
+        thread ran between start and join). Pre-3.12, ``enable()`` is
+        per-thread (``PyEval_SetProfile``), so each worker gets its own
+        profile and ``join()`` merges them — the reference's design
+        (thread_pool.py:47-52).
     :param shuffle_rows/seed: when rows are shuffled without a seed, result
         readout is non-blocking (no determinism to preserve)
     """
@@ -96,6 +113,7 @@ class ThreadPool:
         self.workers_count = workers_count
         self._results_queue_size = results_queue_size
         self._profiling_enabled = profiling_enabled
+        self._prof = None
         self._strict_order = not (shuffle_rows and seed is None)
         self._stop_event = threading.Event()
         self._workers = []
@@ -119,8 +137,18 @@ class ThreadPool:
             self._input_queues.append(in_q)
             self._result_queues.append(out_q)
             worker = worker_class(i, self._make_put(i), worker_args)
+            per_worker_prof = (cProfile.Profile() if self._profiling_enabled
+                               and sys.version_info < (3, 12) else None)
             self._workers.append(_WorkerThread(worker, in_q, out_q, self._stop_event,
-                                               self._make_put(i), self._profiling_enabled))
+                                               self._make_put(i), per_worker_prof))
+        if self._profiling_enabled and sys.version_info >= (3, 12):
+            self._prof = cProfile.Profile()
+            try:
+                self._prof.enable()
+            except ValueError:  # another sys.monitoring tool already active
+                logger.warning("profiling_enabled ignored: another profiler "
+                               "is already active in this process")
+                self._prof = None
         for w in self._workers:
             w.start()
         if ventilator is not None:
@@ -208,16 +236,16 @@ class ThreadPool:
         for w in self._workers:
             if w.is_alive():
                 w.join()
-        if self._profiling_enabled and self._workers:
-            stats = None
-            for w in self._workers:
-                if w.prof is None:
-                    continue
-                if stats is None:
-                    stats = pstats.Stats(w.prof)
-                else:
-                    stats.add(w.prof)
-            if stats is not None:
+        if self._prof is not None:  # 3.12+: one pool-level profile
+            self._prof.disable()
+            pstats.Stats(self._prof).sort_stats("cumulative").print_stats()
+            self._prof = None
+        elif self._profiling_enabled:  # pre-3.12: merge per-worker profiles
+            profs = [w.prof for w in self._workers if w.prof is not None]
+            if profs:
+                stats = pstats.Stats(profs[0])
+                for p in profs[1:]:
+                    stats.add(p)
                 stats.sort_stats("cumulative").print_stats()
 
     def results_qsize(self) -> int:

@@ -210,3 +210,38 @@ def test_stop_is_poison_pill_for_blocked_consumer_process_pool():
     t.join(10.0)
     assert not t.is_alive() and outcome["result"] == "empty"
     pool.join()
+
+
+def test_worker_infrastructure_failure_surfaces_not_hangs():
+    """A worker that dies OUTSIDE its process() call (infrastructure
+    failure — e.g. cProfile's single sys.monitoring slot on 3.12 used to
+    kill the second worker in prof.enable()) must surface as a raised
+    failure in the consumer, not leave its assigned items spinning
+    get_results() forever."""
+    pool = ThreadPool(1)
+    pool.start(IdentityWorker)
+    # Poison the input queue with an item the dispatch loop itself cannot
+    # unpack: the failure happens before process() is entered.
+    pool._input_queues[0].put("not-a-(args, kwargs)-tuple")
+    pool._assigned[0] += 1
+    with pytest.raises((ValueError, TypeError)):
+        pool.get_results()
+    pool.stop()
+    pool.join()
+
+
+def test_pool_profiling_prints_worker_frames(capsys):
+    """profiling_enabled=True: one pool-level cProfile (3.12's global
+    sys.monitoring slot forbids per-worker profiles) captures worker-thread
+    frames; stats print on join()."""
+    pool = ThreadPool(2, profiling_enabled=True)
+    pool.start(IdentityWorker)
+    for i in range(20):
+        pool.ventilate(value=i)
+    got = sorted(pool.get_results() for _ in range(20))
+    assert got == list(range(20))
+    pool.stop()
+    pool.join()
+    out = capsys.readouterr().out
+    assert "function calls" in out and "cumulative" in out
+    assert "stub_workers" in out  # a worker-side frame, not just consumer
