@@ -27,6 +27,13 @@ def build_parser():
                         help="With -d jax: overlap batches against a calibrated "
                              "on-device step of this duration and report honest "
                              "input-stall%% (approaches 0 when the step dominates)")
+    parser.add_argument("--profile-threads", action="store_true",
+                        help="With -p thread: cProfile the reader pool and "
+                             "print stats (cumulative-sorted) when the reader "
+                             "closes. Per-worker profiles pre-3.12; on 3.12+ "
+                             "one process-wide profile (cProfile's global "
+                             "sys.monitoring slot) that also includes the "
+                             "measurement thread's frames and overhead")
     parser.add_argument("--spawn-new-process", action="store_true",
                         help="Re-run the measurement in a fresh interpreter so "
                              "RSS is not polluted by this process's history")
@@ -66,6 +73,7 @@ def main(argv=None):
         min_after_dequeue=args.min_after_dequeue,
         read_method=args.read_method,
         device_step_ms=args.device_step_ms,
+        profile_threads=args.profile_threads,
         reader_extra_kwargs=(
             {"rowgroup_coalescing": args.rowgroup_coalescing}
             if args.rowgroup_coalescing > 1 else None))
@@ -73,7 +81,8 @@ def main(argv=None):
         print(json.dumps({"samples_per_second": result.samples_per_second,
                           "memory_rss_mb": result.memory_rss_mb,
                           "cpu_percent": result.cpu_percent,
-                          "input_stall_percent": result.input_stall_percent}))
+                          "input_stall_percent": result.input_stall_percent,
+                          "device_step_ms_actual": result.device_step_ms_actual}))
     else:
         print(result)
     return 0

@@ -23,12 +23,18 @@ class BenchmarkResult:
     memory_rss_mb: float
     cpu_percent: float
     input_stall_percent: Optional[float] = None
+    #: Measured mean duration of the synthetic device step (may differ from
+    #: the requested ``device_step_ms`` — calibration granularity, backend
+    #: speed): the stall%% is honest only relative to THIS number.
+    device_step_ms_actual: Optional[float] = None
 
     def __str__(self):
         s = (f"{self.samples_per_second:.2f} samples/sec; "
              f"{self.memory_rss_mb:.2f} MB RSS; {self.cpu_percent:.1f}% CPU")
         if self.input_stall_percent is not None:
             s += f"; {self.input_stall_percent:.1f}% input stall"
+        if self.device_step_ms_actual is not None:
+            s += f" (vs {self.device_step_ms_actual:.1f}ms actual step)"
         return s
 
 
@@ -42,6 +48,7 @@ def reader_throughput(dataset_url: str,
                       min_after_dequeue: int = 400,
                       read_method: str = "python",
                       device_step_ms: Optional[float] = None,
+                      profile_threads: bool = False,
                       reader_extra_kwargs: Optional[dict] = None) -> BenchmarkResult:
     """Measure samples/sec of ``make_reader`` on ``dataset_url``.
 
@@ -51,6 +58,12 @@ def reader_throughput(dataset_url: str,
     when ``device_step_ms`` sets a (calibrated, on-device) synthetic step to
     overlap against — with no compute between batches the loader waits by
     construction and a stall number would be meaningless.
+    ``profile_threads`` cProfiles the thread pool; stats print when the
+    reader closes (parity: reference benchmark/throughput.py:113,129
+    ``profile_threads``). On 3.12+ the profile is process-wide (cProfile's
+    single ``sys.monitoring`` slot), so it includes this measurement
+    thread's frames and slows the measured loop — don't quote samples/sec
+    from a profiled run.
     """
     import psutil
 
@@ -65,6 +78,7 @@ def reader_throughput(dataset_url: str,
                      workers_count=loaders_count,
                      num_epochs=None,
                      shuffle_row_groups=True,
+                     pool_profiling_enabled=profile_threads,
                      **(reader_extra_kwargs or {})) as reader:
         if read_method in ("python", "tf"):
             if read_method == "tf":
@@ -80,6 +94,7 @@ def reader_throughput(dataset_url: str,
             dt = time.perf_counter() - t0
             samples = measure_cycles
             stall = None
+            step_ms_actual = None
         elif read_method == "jax":
             import jax
 
@@ -92,6 +107,7 @@ def reader_throughput(dataset_url: str,
             for _ in range(max(1, warmup_cycles // batch_size)):
                 next(it)
             steps = max(1, measure_cycles // batch_size)
+            step_ms_actual = None
             if device_step_ms is not None:
                 device_step = make_synthetic_device_step(device_step_ms)
                 measured = training_input_stall(loader, lambda b: device_step(),
@@ -101,6 +117,8 @@ def reader_throughput(dataset_url: str,
                 dt = measured["wait_s"] + measured["compute_s"]
                 steps = measured["steps"]
                 stall = measured["input_stall_percent"]
+                if steps:
+                    step_ms_actual = 1000.0 * measured["compute_s"] / steps
             else:
                 t0 = time.perf_counter()
                 for _ in range(steps):
@@ -115,36 +133,79 @@ def reader_throughput(dataset_url: str,
         samples_per_second=samples / dt,
         memory_rss_mb=process.memory_info().rss / (1 << 20),
         cpu_percent=process.cpu_percent(),
-        input_stall_percent=stall)
+        input_stall_percent=stall,
+        device_step_ms_actual=step_ms_actual)
 
 
 def make_synthetic_device_step(target_ms: float):
     """A jitted on-device compute kernel calibrated to run ~``target_ms``
     per call — stands in for a real model step when measuring how well the
-    input pipeline overlaps with device compute."""
+    input pipeline overlaps with device compute.
+
+    On an accelerator backend the step is real on-device compute (a matmul
+    chain). On a CPU backend it is a plain ``time.sleep``: there, jax
+    "device" compute and the reader pipeline would contend for the same
+    host cores — the opposite of the TPU regime being emulated, where the
+    chip computes off-host while host threads keep producing batches. A
+    sleeping consumer with the GIL released is the faithful model of that,
+    and it makes the requested duration exact.
+
+    For the compute path, calibration picks the largest matmul chunk that
+    still gives >=4 chunks per step (a fixed big chunk overshoots small
+    targets; a fixed tiny chunk drowns a fast device in dispatch overhead),
+    then refines n against one assembled-step measurement."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    x = jnp.ones((512, 512), jnp.float32)
+    target_s = target_ms / 1000.0
 
-    @jax.jit
-    def chunk(x):
-        def body(_, x):
-            return x @ x * (1.0 / 512.0)
-        return lax.fori_loop(0, 8, body, x)
+    if jax.devices()[0].platform == "cpu":
+        def sleep_step():
+            time.sleep(target_s)
+        return sleep_step
 
-    jax.block_until_ready(chunk(x))  # compile
-    t0 = time.perf_counter()
-    jax.block_until_ready(chunk(x))
-    per_chunk = time.perf_counter() - t0
-    n = max(1, round(target_ms / 1000.0 / per_chunk))
+    def _mk_chunk(size, iters):
+        x = jnp.ones((size, size), jnp.float32)
 
-    def step():
+        @jax.jit
+        def chunk(x):
+            def body(_, x):
+                return x @ x * (1.0 / size)
+            return lax.fori_loop(0, iters, body, x)
+
+        jax.block_until_ready(chunk(x))  # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(chunk(x))
+        return chunk, x, time.perf_counter() - t0
+
+    chosen = None
+    for size, iters in ((64, 2), (128, 4), (256, 8), (512, 8), (1024, 16)):
+        chunk, x, per_chunk = _mk_chunk(size, iters)
+        if chosen is None or per_chunk <= target_s / 4:
+            chosen = (chunk, x, per_chunk)
+        if per_chunk > target_s / 4:
+            break
+    chunk, x, per_chunk = chosen
+    n = max(1, round(target_s / per_chunk))
+
+    def _step(count):
         y = x
-        for _ in range(n):
+        for _ in range(count):
             y = chunk(y)
         return y
+
+    # One refinement pass: the single-chunk sample above under-measures on a
+    # loaded host (cache-warm one-shot), so time the assembled step and
+    # rescale n once.
+    t0 = time.perf_counter()
+    jax.block_until_ready(_step(n))
+    actual_s = time.perf_counter() - t0
+    if actual_s > 0:
+        n = max(1, round(n * target_s / actual_s))
+
+    def step():
+        return _step(n)
 
     return step
 

@@ -177,10 +177,20 @@ def _resolve_shard(cur_shard, shard_count):
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
-               shuffle_rows, seed, zmq_copy_buffers=True):
+               shuffle_rows, seed, zmq_copy_buffers=True,
+               pool_profiling_enabled=False):
     if reader_pool_type == "thread":
         return ThreadPool(workers_count, results_queue_size=results_queue_size,
+                          profiling_enabled=pool_profiling_enabled,
                           shuffle_rows=shuffle_rows, seed=seed)
+    if pool_profiling_enabled:
+        # cProfile instruments python frames in THIS process; process-pool
+        # workers run elsewhere and the dummy pool has no worker threads
+        # (reference scopes profiling to the thread pool the same way:
+        # petastorm/workers_pool/thread_pool.py:47-52).
+        warnings.warn(f"pool_profiling_enabled only applies to "
+                      f"reader_pool_type='thread'; ignored for "
+                      f"{reader_pool_type!r}")
     if reader_pool_type == "process":
         return ProcessPool(workers_count, serializer=serializer,
                            zmq_copy_buffers=zmq_copy_buffers,
@@ -229,7 +239,8 @@ def make_reader(dataset_url,
                 filesystem=None,
                 zmq_copy_buffers: bool = True,
                 resume_state: Optional[dict] = None,
-                rowgroup_coalescing: int = 1):
+                rowgroup_coalescing: int = 1,
+                pool_profiling_enabled: bool = False):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -254,6 +265,13 @@ def make_reader(dataset_url,
         tiny groups. Coarsens shuffle/shard/resume granularity to the
         coalesced unit, and NGram windows may span the original group
         boundaries inside a unit (no equivalent in the reference).
+    :param pool_profiling_enabled: (thread pool only) cProfile the pool and
+        print stats when the reader closes (parity: reference
+        thread_pool.py:47-52; exposed as ``--profile-threads`` on the
+        throughput CLI like the reference's benchmark/cli.py). Per-worker
+        merged profiles pre-3.12; on 3.12+ one process-wide profile that
+        also captures consumer-thread frames (see
+        :class:`~petastorm_tpu.workers_pool.thread_pool.ThreadPool`)
 
     Parity: reference reader.py:60.
     """
@@ -272,7 +290,8 @@ def make_reader(dataset_url,
 
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      PickleSerializer(), shuffle_rows, seed, zmq_copy_buffers)
+                      PickleSerializer(), shuffle_rows, seed, zmq_copy_buffers,
+                      pool_profiling_enabled)
 
     return Reader(ctx, stored_schema,
                   dataset_url_or_urls=dataset_url,
@@ -325,7 +344,8 @@ def make_batch_reader(dataset_url_or_urls,
                       zmq_copy_buffers: bool = True,
                       convert_early_to_numpy: bool = False,
                       resume_state: Optional[dict] = None,
-                      rowgroup_coalescing: int = 1):
+                      rowgroup_coalescing: int = 1,
+                      pool_profiling_enabled: bool = False):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -357,7 +377,8 @@ def make_batch_reader(dataset_url_or_urls,
         from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
         serializer = ArrowTableSerializer()
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      serializer, shuffle_rows, seed, zmq_copy_buffers)
+                      serializer, shuffle_rows, seed, zmq_copy_buffers,
+                      pool_profiling_enabled)
 
     return Reader(ctx, schema,
                   dataset_url_or_urls=dataset_url_or_urls,
