@@ -128,6 +128,57 @@ def run_sweep(sizes=(1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10,
     return rows
 
 
+def reader_transport_sweep(dataset_url: str, workers: int = 2,
+                           warmup: int = 400, measure: int = 4000,
+                           reruns: int = 2) -> dict:
+    """End-to-end reader throughput for thread vs process x {zmq, shm} on
+    one decode-heavy store — the measurement behind the process pool's
+    ``transport="auto"`` rule (round-4 verdict "weak" 2). Each process
+    config runs in a fresh subprocess with ``PETASTORM_TPU_TRANSPORT``
+    pinned so the transport choice is exact, and the env knobs that shape
+    decode (``PETASTORM_TPU_IMG_THREADS``) are pinned to 1."""
+    import subprocess
+    import sys
+
+    child = (
+        "import json, os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.benchmark.throughput import reader_throughput\n"
+        "cfg = json.loads(os.environ['PT_SWEEP_CFG'])\n"
+        "samples = [reader_throughput(cfg['url'], warmup_cycles=cfg['warmup'],\n"
+        "                             measure_cycles=cfg['measure'],\n"
+        "                             pool_type=cfg['pool'],\n"
+        "                             loaders_count=cfg['workers'])\n"
+        "           .samples_per_second for _ in range(cfg['reruns'])]\n"
+        "print('BENCHJSON:' + json.dumps(samples))\n")
+
+    def _run(pool, transport=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PETASTORM_TPU_IMG_THREADS="1",
+                   PT_SWEEP_CFG=json.dumps({
+                       "url": dataset_url, "pool": pool, "workers": workers,
+                       "warmup": warmup, "measure": measure,
+                       "reruns": reruns}))
+        if transport:
+            env["PETASTORM_TPU_TRANSPORT"] = transport
+        else:
+            env.pop("PETASTORM_TPU_TRANSPORT", None)
+        p = subprocess.run([sys.executable, "-c", child], env=env,
+                           capture_output=True, text=True, timeout=900)
+        for line in p.stdout.splitlines():
+            if line.startswith("BENCHJSON:"):
+                return json.loads(line[len("BENCHJSON:"):])
+        raise RuntimeError(f"{pool}/{transport}: rc={p.returncode}, "
+                           f"stderr tail {p.stderr[-300:]!r}")
+
+    return {
+        f"thread_x{workers}": _run("thread"),
+        f"process_x{workers}_zmq": _run("process", "zmq"),
+        f"process_x{workers}_shm": _run("process", "shm"),
+    }
+
+
 def to_markdown(rows) -> str:
     by_size = {}
     for r in rows:
@@ -160,7 +211,14 @@ def main(argv=None) -> int:
                     default=[1 << 10, 4 << 10, 16 << 10, 64 << 10,
                              256 << 10, 1 << 20])
     ap.add_argument("--total-mb", type=int, default=64)
+    ap.add_argument("--reader-sweep", metavar="DATASET_URL",
+                    help="instead of the raw-transport sweep, run the "
+                         "end-to-end reader sweep (thread vs process x "
+                         "{zmq, shm}) on this store")
     args = ap.parse_args(argv)
+    if args.reader_sweep:
+        print(json.dumps(reader_transport_sweep(args.reader_sweep)))
+        return 0
     rows = run_sweep(args.sizes, args.total_mb << 20)
     for r in rows:
         print(json.dumps(r))
