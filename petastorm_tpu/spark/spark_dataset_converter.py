@@ -177,22 +177,39 @@ def _spark_df_plan_hash(df) -> str:
 
 
 def _convert_precision_and_vectors(df, dtype: Optional[str]):
-    """float precision unification + Spark ML vector -> array conversion
-    (parity: reference :542,:565)."""
+    """Spark ML vector -> array conversion, then float precision
+    unification (parity: reference :542 ``_convert_precision`` — including
+    the ArrayType element-cast branch and the unsupported-dtype ValueError
+    — and :565 ``_convert_vector``, which passes ``dtype`` through to
+    ``vector_to_array``; applied in the reference's order, :594-596)."""
     from pyspark.sql import functions as F
     from pyspark.sql import types as T
+    if dtype is not None and dtype not in ("float32", "float64"):
+        # Validate BEFORE touching vector_to_array: its Scala side throws
+        # an opaque Py4JJavaError for unsupported dtypes.
+        raise ValueError(f"dtype {dtype!r} is not supported. "
+                         f"Use 'float32' or 'float64'")
     converted = df
     for field in df.schema.fields:
-        type_name = field.dataType.typeName()
-        if type_name in ("vectorudt",):
+        if field.dataType.typeName() == "vectorudt":
             from pyspark.ml.functions import vector_to_array
-            converted = converted.withColumn(field.name, vector_to_array(F.col(field.name)))
-        elif dtype == "float32" and isinstance(field.dataType, T.DoubleType):
-            converted = converted.withColumn(field.name,
-                                             F.col(field.name).cast(T.FloatType()))
-        elif dtype == "float64" and isinstance(field.dataType, T.FloatType):
-            converted = converted.withColumn(field.name,
-                                             F.col(field.name).cast(T.DoubleType()))
+            converted = converted.withColumn(
+                field.name,
+                vector_to_array(F.col(field.name), dtype or "float64"))
+    if dtype is None:
+        return converted
+    source_type, target_type = ((T.DoubleType, T.FloatType)
+                                if dtype == "float32"
+                                else (T.FloatType, T.DoubleType))
+    for field in converted.schema.fields:
+        if isinstance(field.dataType, source_type):
+            converted = converted.withColumn(
+                field.name, F.col(field.name).cast(target_type()))
+        elif (isinstance(field.dataType, T.ArrayType)
+              and isinstance(field.dataType.elementType, source_type)):
+            converted = converted.withColumn(
+                field.name,
+                F.col(field.name).cast(T.ArrayType(target_type())))
     return converted
 
 

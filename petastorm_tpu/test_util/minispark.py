@@ -296,8 +296,17 @@ class Column:
         inner = self._fn
 
         def fn(rows):
-            np_t = _NUMPY_BY_TYPE.get(type(data_type))
             vals = inner(rows)
+            if isinstance(data_type, ArrayType):
+                # Spark's cast(array<a> as array<b>) casts each element.
+                elem_t = _NUMPY_BY_TYPE.get(type(data_type.elementType))
+                if elem_t is None:
+                    return vals
+                return [None if v is None
+                        else [None if x is None else elem_t(x).item()
+                              for x in v]
+                        for v in vals]
+            np_t = _NUMPY_BY_TYPE.get(type(data_type))
             if np_t is None:
                 return vals
             return [None if v is None else np_t(v) for v in vals]
@@ -312,6 +321,10 @@ def col(name: str) -> Column:
 
 
 def vector_to_array(column: Column, dtype: str = "float64") -> Column:
+    if dtype not in ("float64", "float32"):
+        # Real Spark's Scala UDF rejects unsupported dtypes (surfaced as
+        # Py4JJavaError); silently coercing here would mask caller bugs.
+        raise ValueError(f"Unsupported dtype: {dtype!r}")
     inner = column._fn
     elem = DoubleType() if dtype == "float64" else FloatType()
     np_t = np.float64 if dtype == "float64" else np.float32

@@ -9,6 +9,8 @@ or readers actually rely on: VectorUDT schema JSON + serialization,
 typeName dispatch strings, and the parquet output layout.
 """
 import json
+
+import pytest
 import re
 from pathlib import Path
 
@@ -87,3 +89,72 @@ def test_uncompressed_layout_drops_codec_suffix(tmp_path):
     df.write.option("compression", "none").parquet(f"file://{tmp_path}/u")
     parts = [p.name for p in (tmp_path / "u").iterdir() if p.name != "_SUCCESS"]
     assert parts and all(n.endswith("-c000.parquet") for n in parts), parts
+
+
+# ------------------------------------------------ converter dtype semantics
+
+def _conversion_df(spark):
+    schema = ms.StructType([
+        ms.StructField("vec", ms.VectorUDT(), False),
+        ms.StructField("d", ms.DoubleType(), False),
+        ms.StructField("darr", ms.ArrayType(ms.DoubleType()), False),
+        ms.StructField("f", ms.FloatType(), False),
+    ])
+    g = json.loads((GOLDEN / "conversion_semantics.json").read_text())["inputs"]
+    sparse = g["vec_sparse"]
+    rows = [
+        (ms.Vectors.dense(g["vec_dense"]), g["d_scalar"], g["d_array"],
+         g["f_scalar"]),
+        (ms.Vectors.sparse(sparse["size"], sparse["indices"],
+                           sparse["values"]), g["d_scalar"], g["d_array"],
+         g["f_scalar"]),
+    ]
+    return spark.createDataFrame(rows, schema)
+
+
+def _type_names(df):
+    out = {}
+    for field in df.schema.fields:
+        name = field.dataType.typeName()
+        if name == "array":
+            out[field.name] = ("array", field.dataType.elementType.typeName())
+        else:
+            out[field.name] = name
+    return out
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", None])
+def test_converter_dtype_conversions_match_spark_golden(dtype, spark_session):
+    """Every branch the converter rewrites (vector->array with dtype,
+    Double<->Float scalar cast, ArrayType element cast, vectors-always
+    -converted when dtype=None) pinned to documented Spark semantics —
+    including the exact IEEE float32 truncations (reference
+    spark_dataset_converter.py:542-596)."""
+    from petastorm_tpu.spark.spark_dataset_converter import (
+        _convert_precision_and_vectors)
+    g = json.loads((GOLDEN / "conversion_semantics.json").read_text())
+    exp = g[dtype or "none"]
+    out = _convert_precision_and_vectors(_conversion_df(spark_session), dtype)
+
+    types = _type_names(out)
+    assert types["vec"] == ("array", exp["vec_elem_type"])
+    assert types["d"] == exp["d_scalar_type"]
+    assert types["darr"] == ("array", exp["d_array_elem_type"])
+    assert types["f"] == exp["f_scalar_type"]
+
+    r0, r1 = out.collect()
+    assert list(r0["vec"]) == exp["vec_dense"]
+    assert list(r1["vec"]) == exp["vec_sparse"]
+    if dtype is not None:
+        assert float(r0["d"]) == exp["d_scalar"]
+        assert [float(x) for x in r0["darr"]] == exp["d_array"]
+        assert float(r0["f"]) == exp["f_scalar"]
+
+
+def test_converter_rejects_unsupported_dtype(spark_session):
+    """Reference parity: dtype outside {float32, float64} raises ValueError
+    (reference :545-548) instead of silently skipping conversion."""
+    from petastorm_tpu.spark.spark_dataset_converter import (
+        _convert_precision_and_vectors)
+    with pytest.raises(ValueError, match="float32"):
+        _convert_precision_and_vectors(_conversion_df(spark_session), "float16")
