@@ -354,9 +354,22 @@ def test_bench_main_flow_probe_first_and_dispersion(monkeypatch, capsys,
             return {"samples_per_sec_per_chip": 2.0, "input_stall_pct": 0.1,
                     "devices": 1, "global_batch": 2, "step_time_ms": 900.0,
                     "device_kind": "cpu"}
+        if "stall_pct_at_" in child:
+            return {"stall_pct_at_5ms": 30.2, "step_ms_actual_at_5ms": 5.9,
+                    "stall_pct_at_10ms": 0.9, "step_ms_actual_at_10ms": 10.4,
+                    "stall_pct_at_20ms": 1.8, "step_ms_actual_at_20ms": 20.1}
         return {"config": "thread_pool+workers=3",
                 "samples": {"thread_pool+workers=3": [5000.0, 5100.0]}}
     monkeypatch.setattr(bench, "_cpu_subprocess", fake_cpu_subprocess)
+    # Pin the prior-round artifact: the real glob would read whatever
+    # BENCH_r*.json is newest in the repo root, coupling this test to each
+    # round's committed numbers.
+    monkeypatch.setattr(
+        bench, "_prior_round_artifact",
+        lambda: ("BENCH_rXX.json",
+                 {"value_p50": 2000.0, "value_spread_pct": 10.0,
+                  "hello_world_10k_samples_per_sec_p50": 4100.0,
+                  "hello_world_10k_samples_per_sec_spread_pct": 30.0}))
     monkeypatch.setenv("BENCH_DATA_DIR", str(tmp_path))
     # markers exist -> _ensure skips generation
     for d in ("hello_world", "hello_world_10k", "scalar_100k"):
@@ -386,6 +399,19 @@ def test_bench_main_flow_probe_first_and_dispersion(monkeypatch, capsys,
     assert "scalar_batched_samples_per_sec_p50" in parsed
     assert "best_config_samples_per_sec_p50" in parsed
     assert parsed["best_config_sweep"] == {"thread_pool+workers=3": 5100.0}
+
+    # stall sweep keys + the derived <5%-stall boundary (round-4 verdict
+    # item 2): 5ms stalls 30%, 10ms is the first step under 5%
+    assert parsed["stall_pct_at_5ms"] == 30.2
+    assert parsed["stall_pct_at_10ms"] == 0.9
+    assert parsed["min_step_ms_under_5pct_stall"] == 10
+
+    # cross-round regression guard against the pinned synthetic prior:
+    # the stubbed 710-sps headline is a big drop (flagged); the 10k phase
+    # sits within its noise bound (not flagged)
+    assert parsed["vs_prior_round"]["against"] == "BENCH_rXX.json"
+    assert "value" in parsed["regressions"]
+    assert "hello_world_10k_samples_per_sec" not in parsed["regressions"]
 
     # committed evidence rides along even though this run was wedged
     assert parsed["tpu_evidence"]["imagenet"]["sps"] == 123.0
