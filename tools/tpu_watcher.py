@@ -66,10 +66,18 @@ def main(argv=None) -> int:
     # imagenet must not cause a later window to redo the banked phase.
     done: dict[str, int] = {"flash_attn": 0, "imagenet": 0}
     full_captures = 0
+    probe_n = 0
 
     while time.time() < deadline:
-        status, kind = tpu_evidence.probe()
-        _log_probe(status, kind)
+        # Hourly long probe: a tunnel that is merely SLOW to bring up a
+        # backend (vs hard-wedged) would fail every 120 s alarm forever;
+        # give it 600 s once an hour so slow-init is distinguishable.
+        probe_n += 1
+        long_probe = (probe_n % max(1, 3600 // max(args.interval, 1)) == 0)
+        status, kind = tpu_evidence.probe(
+            alarm_s=600 if long_probe else 120)
+        _log_probe(status, kind,
+                   note="long-probe-600s" if long_probe else "")
         if status == "ok":
             tpu_evidence.append_evidence(
                 {"event": "probe", "status": "ok", "device_kind": kind})
