@@ -36,6 +36,28 @@ REPO_ROOT = tpu_evidence.REPO_ROOT
 PROBE_LOG = os.path.join(REPO_ROOT, "TPU_PROBE_LOG.jsonl")
 
 
+def _bench_running() -> bool:
+    """True while a ``python [flags] bench.py`` process is live.
+
+    Exact-ELEMENT basename match, not substring: the driver's own command
+    line contains "bench.py" inside its prompt text (one long argv
+    element — a substring match would pause the watcher forever), and
+    ``transport_bench.py``-style siblings must not match either. Scanning
+    every element (not just argv[1]) catches interpreter flags like
+    ``python -u bench.py``."""
+    import glob
+    for cmdline in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            with open(cmdline, "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if (argv and argv[0].split(b"/")[-1].startswith(b"python")
+                and any(a.split(b"/")[-1] == b"bench.py" for a in argv[1:])):
+            return True
+    return False
+
+
 def _log_probe(status: str, kind: str | None, note: str = "") -> None:
     rec = {"ts": datetime.datetime.now(datetime.timezone.utc)
            .strftime("%Y-%m-%dT%H:%M:%SZ"), "status": status}
@@ -68,7 +90,21 @@ def main(argv=None) -> int:
     full_captures = 0
     probe_n = 0
 
+    paused = False
     while time.time() < deadline:
+        if _bench_running():
+            # A probe child costs ~15 s of the single core; colliding with
+            # the round bench run would skew its numbers. Log only the
+            # transitions: a silent multi-hour gap would be
+            # indistinguishable from the watcher having died.
+            if not paused:
+                paused = True
+                _log_probe("paused", None, note="bench.py running")
+            time.sleep(60)
+            continue
+        if paused:
+            paused = False
+            _log_probe("resumed", None, note="bench.py finished")
         # Hourly long probe: a tunnel that is merely SLOW to bring up a
         # backend (vs hard-wedged) would fail every 120 s alarm forever;
         # give it 600 s once an hour so slow-init is distinguishable.
