@@ -21,7 +21,6 @@ from __future__ import annotations
 import decimal
 from typing import Dict, List, Optional, Sequence, Union
 
-import numpy as np
 
 from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
 

@@ -39,7 +39,7 @@ from petastorm_tpu.reader_impl.batch_reader_worker import (BatchReaderWorker,
                                                            arrow_table_to_numpy_dict)
 from petastorm_tpu.reader_impl.row_reader_worker import RowReaderWorker
 from petastorm_tpu.transform import transform_schema
-from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
+from petastorm_tpu.unischema import Unischema, UnischemaField
 from petastorm_tpu.workers_pool import EmptyResultError, ITEM_CONTEXT_KWARG
 from petastorm_tpu.workers_pool.dummy_pool import DummyPool
 from petastorm_tpu.workers_pool.process_pool import ProcessPool

@@ -25,10 +25,9 @@ The implementation is new; only the behavioral contract is reproduced.
 from __future__ import annotations
 
 import re
-import sys
 import warnings
 from collections import OrderedDict, namedtuple
-from dataclasses import dataclass, field as _dc_field
+from dataclasses import dataclass
 from decimal import Decimal
 from typing import Any, Optional, Sequence, Tuple
 
