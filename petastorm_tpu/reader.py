@@ -168,6 +168,19 @@ def _partition_keys(row_groups) -> set:
     return keys
 
 
+def _warn_compat_kwargs(hdfs_driver, pyarrow_serialize):
+    """Reference kwargs accepted for drop-in compatibility but meaningless
+    here; warn (once per process via the warnings registry) instead of
+    raising TypeError on ported call sites."""
+    if hdfs_driver is not None:
+        warnings.warn("hdfs_driver is ignored: hdfs access goes through "
+                      "fsspec/pyarrow (HA failover via petastorm_tpu.hdfs)",
+                      stacklevel=3)  # point at the make_*_reader caller
+    if pyarrow_serialize:
+        warnings.warn("pyarrow_serialize was deprecated in petastorm and "
+                      "is a no-op here", DeprecationWarning, stacklevel=3)
+
+
 def _resolve_shard(cur_shard, shard_count):
     """``cur_shard="auto"`` -> this JAX process's (index, count)."""
     if cur_shard == "auto":
@@ -240,7 +253,10 @@ def make_reader(dataset_url,
                 zmq_copy_buffers: bool = True,
                 resume_state: Optional[dict] = None,
                 rowgroup_coalescing: int = 1,
-                pool_profiling_enabled: bool = False):
+                pool_profiling_enabled: bool = False,
+                hdfs_driver: Optional[str] = None,
+                pyarrow_serialize: bool = False,
+                convert_early_to_numpy: Optional[bool] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -272,9 +288,19 @@ def make_reader(dataset_url,
         merged profiles pre-3.12; on 3.12+ one process-wide profile that
         also captures consumer-thread frames (see
         :class:`~petastorm_tpu.workers_pool.thread_pool.ThreadPool`)
+    :param hdfs_driver: accepted for drop-in petastorm compatibility and
+        ignored — hdfs access goes through fsspec/pyarrow here, with HA
+        namenode failover handled by :mod:`petastorm_tpu.hdfs`
+    :param pyarrow_serialize: deprecated no-op, as in the reference
+        (reader.py:96,167-168)
+    :param convert_early_to_numpy: accepted for drop-in compatibility; the
+        row path always decodes to numpy inside the workers (the "early"
+        behavior), so both values are satisfied
 
     Parity: reference reader.py:60.
     """
+    _warn_compat_kwargs(hdfs_driver, pyarrow_serialize)
+    del convert_early_to_numpy  # row workers always decode early
     ctx = DatasetContext(dataset_url, storage_options=storage_options,
                          filesystem=filesystem)
     try:
@@ -345,7 +371,9 @@ def make_batch_reader(dataset_url_or_urls,
                       convert_early_to_numpy: bool = False,
                       resume_state: Optional[dict] = None,
                       rowgroup_coalescing: int = 1,
-                      pool_profiling_enabled: bool = False):
+                      pool_profiling_enabled: bool = False,
+                      rowgroup_selector=None,
+                      hdfs_driver: Optional[str] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -357,8 +385,12 @@ def make_batch_reader(dataset_url_or_urls,
     useful when worker parallelism should absorb the conversion cost; the
     default converts at the consumer (zero-copy from shared memory on the
     process pool's shm transport).
+    ``rowgroup_selector`` prunes row groups through stored inverted indexes
+    exactly as in :func:`make_reader` (parity: reference reader.py:216).
+    ``hdfs_driver`` is accepted for drop-in compatibility and ignored.
     Parity: reference reader.py:209.
     """
+    _warn_compat_kwargs(hdfs_driver, False)
     ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
                          filesystem=filesystem)
     schema = infer_or_load_unischema(ctx)
@@ -390,7 +422,7 @@ def make_batch_reader(dataset_url_or_urls,
                   shuffle_rows=shuffle_rows,
                   shuffle_row_drop_partitions=shuffle_row_drop_partitions,
                   predicate=predicate,
-                  rowgroup_selector=None,
+                  rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs,
                   cur_shard=cur_shard,
                   shard_count=shard_count,

@@ -110,3 +110,46 @@ def test_missing_index_raises(synthetic_dataset):
     selector = SingleIndexSelector("no_such_index", ["x"])
     with pytest.raises(ValueError, match="no_such_index"):
         make_reader(synthetic_dataset.url, rowgroup_selector=selector)
+
+
+def test_batch_reader_honors_rowgroup_selector(tmp_path):
+    """Reference parity (reader.py:216): make_batch_reader prunes row
+    groups through stored inverted indexes exactly like make_reader."""
+    from dataset_utils import TestSchema, make_test_row
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.reader import make_batch_reader
+    url = f"file://{tmp_path}/ds"
+    rng = np.random.default_rng(0)
+    rows = [make_test_row(i, rng) for i in range(100)]
+    for r in rows:
+        r["partition_key"] = f"p_{r['id'] // 25}"
+    with materialize_dataset_local(url, TestSchema, rows_per_row_group=25,
+                                   rows_per_file=50) as w:
+        w.write_rows(rows)
+    build_rowgroup_index(url, [SingleFieldIndexer("by_pk", "partition_key")])
+
+    selector = SingleIndexSelector("by_pk", ["p_1"])
+    with make_batch_reader(url, rowgroup_selector=selector,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy",
+                           schema_fields=["id"]) as r:
+        ids = sorted(int(i) for b in r for i in b.id)
+    assert ids == list(range(25, 50))
+
+
+def test_reference_compat_kwargs_warn_not_raise(synthetic_dataset):
+    """Ported petastorm call sites pass hdfs_driver / pyarrow_serialize /
+    convert_early_to_numpy to make_reader: accepted with a warning (or
+    silently, where our behavior already satisfies both values), never a
+    TypeError."""
+    with pytest.warns(UserWarning, match="hdfs_driver"):
+        with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                         shuffle_row_groups=False, schema_fields=["id"],
+                         hdfs_driver="libhdfs3",
+                         convert_early_to_numpy=True) as r:
+            next(iter(r))
+    with pytest.warns(DeprecationWarning, match="pyarrow_serialize"):
+        with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                         shuffle_row_groups=False, schema_fields=["id"],
+                         pyarrow_serialize=True) as r:
+            next(iter(r))
