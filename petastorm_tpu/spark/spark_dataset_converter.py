@@ -119,24 +119,53 @@ class SparkDatasetConverter:
                                  steps_per_epoch=steps_per_epoch)
 
     def make_tf_dataset(self, batch_size: Optional[int] = None,
-                        num_epochs: Optional[int] = None, **reader_kwargs):
+                        prefetch: Optional[int] = None,
+                        num_epochs: Optional[int] = None,
+                        workers_count: Optional[int] = None,
+                        shuffling_queue_capacity: Optional[int] = None,
+                        **reader_kwargs):
+        """Reference-parity signature (spark_dataset_converter.py:199-246):
+        ``batch_size=None`` batches at 32 like the reference's "current
+        implementation"; ``prefetch=None`` uses tf AUTOTUNE;
+        ``shuffling_queue_capacity`` shuffles the unbatched row stream."""
         from petastorm_tpu.reader import make_batch_reader
         from petastorm_tpu.tf_utils import make_petastorm_dataset
+        if workers_count is not None:
+            reader_kwargs["workers_count"] = workers_count
         reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
                                    **_apply_env_rank_defaults(reader_kwargs))
-        dataset = make_petastorm_dataset(reader)
-        if batch_size is not None:
-            dataset = dataset.unbatch().batch(batch_size)
+        dataset = make_petastorm_dataset(reader).unbatch()
+        if shuffling_queue_capacity:
+            dataset = dataset.shuffle(shuffling_queue_capacity)
+        dataset = dataset.batch(batch_size if batch_size is not None else 32)
+        if prefetch != 0:
+            import tensorflow as tf
+            dataset = dataset.prefetch(
+                prefetch if prefetch is not None else tf.data.AUTOTUNE)
         return _ContextManagedAdapter(dataset, reader)
 
     def make_torch_dataloader(self, batch_size: int = 32,
-                              num_epochs: Optional[int] = None, **reader_kwargs):
+                              num_epochs: Optional[int] = None,
+                              workers_count: Optional[int] = None,
+                              shuffling_queue_capacity: int = 0,
+                              data_loader_fn=None, **reader_kwargs):
+        """Reference-parity signature (spark_dataset_converter.py:251-289):
+        ``data_loader_fn`` overrides the loader class (default
+        :class:`petastorm_tpu.pytorch.BatchedDataLoader`);
+        ``shuffling_queue_capacity=0`` means no shuffling."""
         from petastorm_tpu.pytorch import BatchedDataLoader
         from petastorm_tpu.reader import make_batch_reader
+        if workers_count is not None:
+            reader_kwargs["workers_count"] = workers_count
         reader = make_batch_reader(self.cache_dir_url, num_epochs=num_epochs,
                                    **_apply_env_rank_defaults(reader_kwargs))
+        loader_fn = data_loader_fn or BatchedDataLoader
+        # Always forward the kwarg (even 0) — reference-written
+        # data_loader_fn callables may require the parameter.
         return _ContextManagedAdapter(
-            BatchedDataLoader(reader, batch_size=batch_size), reader)
+            loader_fn(reader, batch_size=batch_size,
+                      shuffling_queue_capacity=shuffling_queue_capacity),
+            reader)
 
     def delete(self):
         """Delete the cached store now."""

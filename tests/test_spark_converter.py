@@ -445,3 +445,52 @@ def test_make_jax_loader_auto_aligned_steps(spark_session, cache_url):
         untruncated = sum(1 for _ in loader)
     assert untruncated >= expected
     conv.delete()
+
+
+def test_make_tf_dataset_reference_parity_kwargs(spark_session, cache_url):
+    """Reference-parity surface (spark_dataset_converter.py:199-246):
+    batch_size=None batches at 32; shuffling_queue_capacity shuffles the
+    row stream; prefetch/workers_count accepted."""
+    tf = pytest.importorskip("tensorflow")
+    df = _make_df(spark_session, rows=50)
+    conv = make_spark_converter(df, parent_cache_dir_url=cache_url)
+    with conv.make_tf_dataset(num_epochs=1, workers_count=2,
+                              prefetch=2, shuffling_queue_capacity=20,
+                              shuffle_row_groups=False,
+                              reader_pool_type="dummy") as dataset:
+        sizes, ids = [], []
+        for batch in dataset:
+            batch = batch if isinstance(batch, dict) else batch._asdict()
+            arr = np.asarray(batch["id"])
+            sizes.append(len(arr))
+            ids.extend(arr.tolist())
+    assert sorted(ids) == list(range(50))      # shuffled, nothing lost
+    assert sizes[0] == 32                      # reference default batch
+    assert ids != sorted(ids)                  # the shuffle actually acted
+    conv.delete()
+
+
+def test_make_torch_dataloader_data_loader_fn_and_shuffle(spark_session,
+                                                          cache_url):
+    """data_loader_fn swaps the loader class (reference :276-278);
+    shuffling_queue_capacity reaches the loader."""
+    captured = {}
+
+    def spy_loader_fn(reader, batch_size, **kwargs):
+        from petastorm_tpu.pytorch import BatchedDataLoader
+        captured["kwargs"] = dict(kwargs)
+        captured["batch_size"] = batch_size
+        return BatchedDataLoader(reader, batch_size, **kwargs)
+
+    df = _make_df(spark_session, rows=30)
+    conv = make_spark_converter(df, parent_cache_dir_url=cache_url)
+    with conv.make_torch_dataloader(batch_size=10, num_epochs=1,
+                                    shuffling_queue_capacity=16,
+                                    data_loader_fn=spy_loader_fn,
+                                    shuffle_row_groups=False,
+                                    reader_pool_type="dummy") as loader:
+        ids = [int(v) for b in loader for v in b["id"]]
+    assert captured["batch_size"] == 10
+    assert captured["kwargs"].get("shuffling_queue_capacity") == 16
+    assert sorted(ids) == list(range(30))
+    conv.delete()
