@@ -43,13 +43,19 @@ def _sanitize_for_torch(arr: np.ndarray) -> Optional[np.ndarray]:
 class _TorchStagingMixin(LoaderBase):
     """Overrides device staging: numpy -> torch tensors (CPU or given device)."""
 
-    def _init_torch(self, torch_device=None):
+    def _init_torch(self, torch_device=None, transform_fn=None):
         self._torch_device = torch_device
+        self._transform_fn = transform_fn
 
     def _stage(self, host_batch):
         import torch
         out = {}
         for name, arr in host_batch.items():
+            if self._transform_fn is not None:
+                # Reference semantics (pytorch.py:294,337-339): transform_fn
+                # replaces the default numpy->tensor conversion per column.
+                out[name] = self._transform_fn(arr)
+                continue
             arr = np.asarray(arr)
             clean = _sanitize_for_torch(arr)
             if clean is None:
@@ -63,27 +69,83 @@ class _TorchStagingMixin(LoaderBase):
 
 
 class DataLoader(_TorchStagingMixin, _JaxLoader):
-    """Row-reader torch loader (parity: reference pytorch.py:131)."""
+    """Row-reader torch loader (parity: reference pytorch.py:131).
 
-    def __init__(self, reader, batch_size: int, torch_device=None, **kwargs):
+    ``collate_fn`` (reference :73,:131) switches to row-collate mode: rows
+    accumulate as dicts and ``collate_fn(rows)`` builds each batch — e.g.
+    :func:`decimal_friendly_collate` for Decimal-bearing schemas. Without
+    it, the shared staged column path converts numpy batches to tensors.
+    In collate mode the ragged tail is yielded like the reference unless
+    ``drop_last`` was passed explicitly."""
+
+    def __init__(self, reader, batch_size: int, torch_device=None,
+                 collate_fn=None, drop_last: Optional[bool] = None, **kwargs):
+        # None = "caller didn't choose": staged mode keeps the base default
+        # (drop), collate mode keeps reference parity (yield the tail).
+        self._explicit_drop_last = drop_last is not None
+        if drop_last is not None:
+            kwargs["drop_last"] = drop_last
         super().__init__(reader, batch_size, **kwargs)
         self._init_torch(torch_device)
+        self._collate_fn = collate_fn
+        if collate_fn is not None:
+            # Collate mode bypasses the staged iterator, so features that
+            # live there must refuse loudly rather than silently not act.
+            if self._steps_per_epoch is not None:
+                raise ValueError("steps_per_epoch is not supported with "
+                                 "collate_fn; use the staged column path")
+            if self._pad_last:
+                raise ValueError("pad_last is not supported with collate_fn")
+            if self._echo != 1:
+                raise ValueError("echo is not supported with collate_fn")
+            if getattr(reader, "ngram", None) is not None:
+                raise TypeError("collate_fn mode does not support NGram "
+                                "readers; the staged path collates windows "
+                                "into a dense sequence axis")
+
+    def __iter__(self):
+        if self._collate_fn is None:
+            yield from super().__iter__()
+            return
+        drop_tail = self._drop_last if self._explicit_drop_last else False
+        buf = []
+        for row in self._row_iterator():
+            buf.append(row._asdict())
+            if len(buf) == self._batch_size:
+                yield self._collate_fn(buf)
+                buf = []
+        if buf and not drop_tail:
+            yield self._collate_fn(buf)
+
+    def state_dict(self):
+        if self._collate_fn is not None:
+            # The delivered-stream watermark is maintained by the staged
+            # iterator; a silent None here would resume from row 0.
+            raise ValueError("state_dict() is not supported in collate_fn "
+                             "mode; use the staged column path for "
+                             "checkpointable loading")
+        return super().state_dict()
 
 
 class BatchedDataLoader(_TorchStagingMixin, _JaxBatchedLoader):
-    """Columnar torch loader (parity: reference pytorch.py:259)."""
+    """Columnar torch loader (parity: reference pytorch.py:259).
+    ``transform_fn`` overrides the per-column numpy->tensor conversion
+    (reference default ``torch.as_tensor``, :294)."""
 
-    def __init__(self, reader, batch_size: int, torch_device=None, **kwargs):
+    def __init__(self, reader, batch_size: int, torch_device=None,
+                 transform_fn=None, **kwargs):
         super().__init__(reader, batch_size, **kwargs)
-        self._init_torch(torch_device)
+        self._init_torch(torch_device, transform_fn)
 
 
 class InMemBatchedDataLoader(_TorchStagingMixin, _JaxInMemLoader):
-    """One-pass in-memory torch loader (parity: reference pytorch.py:437)."""
+    """One-pass in-memory torch loader (parity: reference pytorch.py:437).
+    ``transform_fn`` as in :class:`BatchedDataLoader`."""
 
-    def __init__(self, reader, batch_size: int, torch_device=None, **kwargs):
+    def __init__(self, reader, batch_size: int, torch_device=None,
+                 transform_fn=None, **kwargs):
         super().__init__(reader, batch_size, **kwargs)
-        self._init_torch(torch_device)
+        self._init_torch(torch_device, transform_fn)
 
 
 def decimal_friendly_collate(batch):

@@ -174,3 +174,71 @@ def test_torch_loader_stacks_ngram_windows(tmp_path):
     first = b["ts"][0, 0].item()
     assert b["ts"][0].tolist() == list(range(first, first + 6))
     assert b["token"][0].tolist() == [t * 3 for t in range(first, first + 6)]
+
+
+def test_torch_dataloader_collate_fn_row_mode(synthetic_dataset):
+    """Reference parity (pytorch.py:73,:131): an explicit collate_fn gets
+    row dicts and builds each batch — decimal_friendly_collate stringifies
+    Decimals like the reference; the ragged tail is yielded."""
+    from petastorm_tpu.pytorch import DataLoader, decimal_friendly_collate
+    from petastorm_tpu.reader import make_reader
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "decimal_col"],
+                     reader_pool_type="dummy", shuffle_row_groups=False,
+                     num_epochs=1) as r:
+        loader = DataLoader(r, batch_size=32,
+                            collate_fn=decimal_friendly_collate)
+        batches = list(loader)
+    # 100 rows at batch 32 -> 3 full + ragged tail of 4 (reference yields it)
+    assert [len(b["id"]) for b in batches] == [32, 32, 32, 4]
+    import torch
+    assert isinstance(batches[0]["id"], torch.Tensor)
+    assert isinstance(batches[0]["decimal_col"], list)       # stringified
+    assert all(isinstance(x, str) for x in batches[0]["decimal_col"])
+    ids = [int(v) for b in batches for v in b["id"]]
+    assert sorted(ids) == list(range(100))
+
+
+def test_batched_loader_transform_fn_overrides_conversion(scalar_dataset):
+    """Reference parity (pytorch.py:294): transform_fn replaces the
+    per-column numpy->tensor conversion."""
+    from petastorm_tpu.pytorch import BatchedDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    seen_types = []
+
+    def double_to_tensor(col):
+        import torch
+        seen_types.append(type(col))
+        return torch.as_tensor(np.asarray(col, np.float64) * 2)
+
+    with make_batch_reader(scalar_dataset.url, schema_fields=["id"],
+                           reader_pool_type="dummy", shuffle_row_groups=False,
+                           num_epochs=1) as r:
+        loader = BatchedDataLoader(r, batch_size=25,
+                                   transform_fn=double_to_tensor)
+        vals = sorted(float(v) for b in loader for v in b["id"])
+    assert vals == [2.0 * i for i in range(100)]
+    assert seen_types  # the override actually ran
+
+
+def test_collate_fn_mode_refuses_staged_only_features(synthetic_dataset):
+    """collate_fn bypasses the staged iterator; combining it with features
+    that live there (steps_per_epoch, pad_last, echo, NGram, state_dict)
+    must refuse loudly rather than silently not act."""
+    from petastorm_tpu.pytorch import DataLoader, decimal_friendly_collate
+    from petastorm_tpu.reader import make_reader
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     reader_pool_type="dummy", shuffle_row_groups=False,
+                     num_epochs=1) as r:
+        for bad in (dict(steps_per_epoch=2), dict(pad_last=True),
+                    dict(echo=2)):
+            with pytest.raises(ValueError):
+                DataLoader(r, batch_size=10,
+                           collate_fn=decimal_friendly_collate, **bad)
+        loader = DataLoader(r, batch_size=10,
+                            collate_fn=decimal_friendly_collate)
+        with pytest.raises(ValueError, match="state_dict"):
+            loader.state_dict()
+        # explicit drop_last=True in collate mode drops the ragged tail
+        loader2 = DataLoader(r, batch_size=32, drop_last=True,
+                             collate_fn=decimal_friendly_collate)
+        assert [len(b["id"]) for b in loader2] == [32, 32, 32]
