@@ -18,11 +18,26 @@ from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
                                                 write_summary_metadata)
 
 
+def _load_unischema_class(class_path: str):
+    """Resolve ``package.module.SchemaObject`` (reference
+    petastorm_generate_metadata.py:121 ``--unischema_class``) to the
+    Unischema instance it names."""
+    import importlib
+    module_name, _, attr = class_path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"--unischema_class must be a full dotted path, "
+                         f"got {class_path!r}")
+    return getattr(importlib.import_module(module_name), attr)
+
+
 def generate_metadata(dataset_url: str, use_inferred_schema: bool = False,
-                      use_summary_metadata: bool = False) -> int:
+                      use_summary_metadata: bool = False,
+                      unischema_class: str = None) -> int:
     """Returns the number of row groups indexed."""
     ctx = DatasetContext(dataset_url)
-    if use_inferred_schema:
+    if unischema_class:
+        schema = _load_unischema_class(unischema_class)
+    elif use_inferred_schema:
         from petastorm_tpu.unischema import Unischema
         schema = Unischema.from_arrow_schema(ctx.arrow_schema(),
                                              omit_unsupported_fields=True)
@@ -40,20 +55,42 @@ def generate_metadata(dataset_url: str, use_inferred_schema: bool = False,
 
 def build_parser():
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("dataset_url")
+    # Reference invocations use `--dataset_url URL` (an option there,
+    # petastorm_generate_metadata.py:119); accept both spellings.
+    parser.add_argument("dataset_url", nargs="?", default=None)
+    parser.add_argument("--dataset_url", dest="dataset_url_opt", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--unischema_class", default=None,
+                        help="Full dotted path of a Unischema instance to "
+                             "store, instead of loading/inferring one "
+                             "(reference parity)")
     parser.add_argument("--use-inferred-schema", action="store_true",
                         help="Ignore any stored unischema; infer from Arrow")
     parser.add_argument("--use-summary-metadata", action="store_true",
                         help="Also write a summary _metadata file (row groups "
                              "of every data file, file_path-tagged) readable "
                              "by any Parquet planner")
+    for ignored in ("--master", "--spark-driver-memory", "--hdfs-driver"):
+        parser.add_argument(ignored, default=None,
+                            help="Accepted for reference-CLI compatibility "
+                                 "and ignored (Spark-free implementation)")
     return parser
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    n = generate_metadata(args.dataset_url, args.use_inferred_schema,
-                          args.use_summary_metadata)
+    if (args.dataset_url and args.dataset_url_opt
+            and args.dataset_url != args.dataset_url_opt):
+        build_parser().error(f"conflicting dataset urls: positional "
+                             f"{args.dataset_url!r} vs --dataset_url "
+                             f"{args.dataset_url_opt!r}")
+    url = args.dataset_url or args.dataset_url_opt
+    if not url:
+        build_parser().error("dataset_url is required (positional or "
+                             "--dataset_url)")
+    n = generate_metadata(url, args.use_inferred_schema,
+                          args.use_summary_metadata,
+                          unischema_class=args.unischema_class)
     print(f"metadata written; {n} row groups indexed")
     return 0
 

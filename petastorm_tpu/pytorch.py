@@ -98,6 +98,14 @@ class DataLoader(_TorchStagingMixin, _JaxLoader):
                 raise ValueError("pad_last is not supported with collate_fn")
             if self._echo != 1:
                 raise ValueError("echo is not supported with collate_fn")
+            if self._pad_varlen is not None:
+                raise ValueError("pad_variable_length_to is not supported "
+                                 "with collate_fn; pad inside the collate")
+            if torch_device is not None:
+                raise ValueError("torch_device is not supported with "
+                                 "collate_fn; move tensors inside the "
+                                 "collate (its output structure is opaque "
+                                 "to the loader)")
             if getattr(reader, "ngram", None) is not None:
                 raise TypeError("collate_fn mode does not support NGram "
                                 "readers; the staged path collates windows "
@@ -107,15 +115,21 @@ class DataLoader(_TorchStagingMixin, _JaxLoader):
         if self._collate_fn is None:
             yield from super().__iter__()
             return
-        drop_tail = self._drop_last if self._explicit_drop_last else False
-        buf = []
-        for row in self._row_iterator():
-            buf.append(row._asdict())
-            if len(buf) == self._batch_size:
+        if self._in_iter:
+            raise RuntimeError("Loader is already being iterated")
+        self._in_iter = True
+        try:
+            drop_tail = self._drop_last if self._explicit_drop_last else False
+            buf = []
+            for row in self._row_iterator():
+                buf.append(row._asdict())
+                if len(buf) == self._batch_size:
+                    yield self._collate_fn(buf)
+                    buf = []
+            if buf and not drop_tail:
                 yield self._collate_fn(buf)
-                buf = []
-        if buf and not drop_tail:
-            yield self._collate_fn(buf)
+        finally:
+            self._in_iter = False
 
     def state_dict(self):
         if self._collate_fn is not None:

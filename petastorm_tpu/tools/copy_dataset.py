@@ -16,20 +16,45 @@ from petastorm_tpu.unischema import Unischema
 
 def copy_dataset(source_url: str, target_url: str, field_regex=None,
                  not_null_fields=None, rows_per_row_group: int = 1000,
-                 workers_count: int = 4) -> int:
-    """Copy rows from one petastorm store to another; returns rows copied."""
+                 workers_count: int = 4, overwrite_output: bool = False,
+                 row_group_size_mb: int = None) -> int:
+    """Copy rows from one petastorm store to another; returns rows copied.
+
+    ``overwrite_output`` deletes an existing target first; without it an
+    existing target is an error (parity: reference tools/copy_dataset.py:104
+    — Spark's ``overwrite``/``error`` save modes). ``row_group_size_mb``
+    bounds output row groups by bytes instead of ``rows_per_row_group``
+    (reference :119)."""
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+    src_fs, source_path = get_filesystem_and_path_or_paths(source_url)
+    fs, target_path = get_filesystem_and_path_or_paths(target_url)
+    if type(src_fs) is type(fs) and source_path == target_path:
+        raise ValueError(f"source and target are the same dataset "
+                         f"({source_url}); refusing to copy in place")
+
     predicate = None
     if not_null_fields:
         predicate = in_lambda(list(not_null_fields),
                               lambda row: all(row[f] is not None for f in not_null_fields))
+    writer_kwargs = ({"row_group_size_mb": row_group_size_mb}
+                     if row_group_size_mb is not None
+                     else {"rows_per_row_group": rows_per_row_group})
     copied = 0
     with make_reader(source_url, schema_fields=field_regex, predicate=predicate,
                      shuffle_row_groups=False, num_epochs=1,
                      workers_count=workers_count) as reader:
+        # Remove the target only AFTER the source opened successfully: a
+        # typo'd/unreadable source must never cost the existing target.
+        if fs.exists(target_path) and fs.ls(target_path):
+            if not overwrite_output:
+                raise ValueError(f"Target {target_url} already exists; pass "
+                                 f"overwrite_output=True (--overwrite-output) "
+                                 f"to replace it")
+            fs.rm(target_path, recursive=True)
         out_schema = Unischema(reader.schema.name + "_copy",
                                list(reader.schema.fields.values()))
         with materialize_dataset_local(target_url, out_schema,
-                                       rows_per_row_group=rows_per_row_group) as writer:
+                                       **writer_kwargs) as writer:
             for sample in reader:
                 writer.write_row(sample._asdict())
                 copied += 1
@@ -44,18 +69,36 @@ def build_parser():
                         help="Copy only fields matching these regexes")
     parser.add_argument("--not-null-fields", nargs="+",
                         help="Skip rows where any of these fields is null")
+    parser.add_argument("--overwrite-output", action="store_true",
+                        help="Replace an existing target dataset (reference "
+                             "parity; default errors if the target exists)")
     parser.add_argument("--rows-per-row-group", type=int, default=1000)
+    parser.add_argument("--row-group-size-mb", type=int, default=None,
+                        help="Bound output row groups by bytes instead of "
+                             "--rows-per-row-group")
+    parser.add_argument("--partition-count", type=int, default=None,
+                        help="Accepted for reference-CLI compatibility and "
+                             "ignored (Spark repartitioning; this copy is "
+                             "Spark-free)")
+    parser.add_argument("--hdfs-driver", default=None,
+                        help="Accepted for reference-CLI compatibility and "
+                             "ignored (hdfs goes through fsspec/pyarrow)")
     parser.add_argument("-w", "--workers-count", type=int, default=4)
     return parser
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.partition_count is not None:
+        print("note: --partition-count is ignored (Spark-free copy)",
+              file=sys.stderr)
     copied = copy_dataset(args.source_url, args.target_url,
                           field_regex=args.field_regex,
                           not_null_fields=args.not_null_fields,
                           rows_per_row_group=args.rows_per_row_group,
-                          workers_count=args.workers_count)
+                          workers_count=args.workers_count,
+                          overwrite_output=args.overwrite_output,
+                          row_group_size_mb=args.row_group_size_mb)
     print(f"copied {copied} rows to {args.target_url}")
     return 0
 
