@@ -78,16 +78,16 @@ def build_parser():
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if (args.dataset_url and args.dataset_url_opt
             and args.dataset_url != args.dataset_url_opt):
-        build_parser().error(f"conflicting dataset urls: positional "
-                             f"{args.dataset_url!r} vs --dataset_url "
-                             f"{args.dataset_url_opt!r}")
+        parser.error(f"conflicting dataset urls: positional "
+                     f"{args.dataset_url!r} vs --dataset_url "
+                     f"{args.dataset_url_opt!r}")
     url = args.dataset_url or args.dataset_url_opt
     if not url:
-        build_parser().error("dataset_url is required (positional or "
-                             "--dataset_url)")
+        parser.error("dataset_url is required (positional or --dataset_url)")
     n = generate_metadata(url, args.use_inferred_schema,
                           args.use_summary_metadata,
                           unischema_class=args.unischema_class)

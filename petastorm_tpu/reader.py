@@ -516,6 +516,7 @@ class Reader:
             warnings.warn("reader_pool_type='process' workers reconnect from the "
                           "dataset URL; the custom filesystem object is used for "
                           "planning only. Pass storage_options for credentials.")
+        self._cache = cache
         worker_args = {
             "dataset_url_or_urls": dataset_url_or_urls,
             "storage_options": storage_options,
@@ -722,6 +723,14 @@ class Reader:
     @property
     def diagnostics(self):
         return self._pool.diagnostics
+
+    def cleanup_cache(self):
+        """Remove this reader's row-group cache contents (parity: reference
+        reader.py:693 — a no-op with the default NullCache)."""
+        try:
+            self._cache.cleanup()
+        except OSError as e:
+            logger.warning("Error cleaning cache: %s", e)
 
     @property
     def batched_output(self):

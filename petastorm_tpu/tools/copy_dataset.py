@@ -28,9 +28,18 @@ def copy_dataset(source_url: str, target_url: str, field_regex=None,
     from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
     src_fs, source_path = get_filesystem_and_path_or_paths(source_url)
     fs, target_path = get_filesystem_and_path_or_paths(target_url)
-    if type(src_fs) is type(fs) and source_path == target_path:
-        raise ValueError(f"source and target are the same dataset "
-                         f"({source_url}); refusing to copy in place")
+    if type(src_fs) is type(fs):
+        # Containment either way is fatal: overwrite_output recursively
+        # removes the target, and a target above the source would take the
+        # source with it (a target below it gets destroyed mid-read).
+        src_parts = str(source_path).rstrip("/").split("/")
+        tgt_parts = str(target_path).rstrip("/").split("/")
+        shorter = min(len(src_parts), len(tgt_parts))
+        if src_parts[:shorter] == tgt_parts[:shorter]:
+            raise ValueError(
+                f"source ({source_url}) and target ({target_url}) are the "
+                f"same path or nested within each other; refusing — "
+                f"--overwrite-output would delete source data")
 
     predicate = None
     if not_null_fields:
@@ -40,9 +49,11 @@ def copy_dataset(source_url: str, target_url: str, field_regex=None,
                      if row_group_size_mb is not None
                      else {"rows_per_row_group": rows_per_row_group})
     copied = 0
+    # Reuse the filesystem resolved for the containment check (in-process
+    # thread workers share it; no second resolver round-trip).
     with make_reader(source_url, schema_fields=field_regex, predicate=predicate,
                      shuffle_row_groups=False, num_epochs=1,
-                     workers_count=workers_count) as reader:
+                     workers_count=workers_count, filesystem=src_fs) as reader:
         # Remove the target only AFTER the source opened successfully: a
         # typo'd/unreadable source must never cost the existing target.
         if fs.exists(target_path) and fs.ls(target_path):

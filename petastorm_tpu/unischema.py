@@ -216,6 +216,11 @@ class Unischema:
         tt = self.namedtuple
         return tt(**{k: kwargs[k] for k in tt._fields})
 
+    def make_namedtuple_tf(self, *args, **kwargs):
+        """Reference-parity alias (unischema.py:299): the row namedtuple
+        type applied to tf tensors (or any positional/keyword values)."""
+        return self.namedtuple(*args, **kwargs)
+
     def make_namedtuple_from_dict(self, row: dict):
         tt = self.namedtuple
         return tt(**{k: row.get(k) for k in tt._fields})
@@ -427,6 +432,23 @@ def dict_to_encoded_row(schema: Unischema, row: dict) -> dict:
         codec = field.codec or _default_codec(field)
         encoded[name] = codec.encode(field, value)
     return encoded
+
+
+def dict_to_spark_row(unischema: Unischema, row_dict: dict):
+    """Codec-encode one row dict and wrap it as a ``pyspark.sql.Row`` —
+    the reference's Spark write-path helper (unischema.py:359), for ported
+    ``materialize_dataset`` jobs. Parameters are keywords-compatible with
+    ``functools.partial(dict_to_spark_row, unischema)`` exactly as the
+    reference's examples use it. Requires pyspark (or the vendored
+    minispark test double) to be importable; the Spark-free equivalent is
+    :func:`dict_to_encoded_row`."""
+    import pyspark.sql
+
+    encoded = dict_to_encoded_row(unischema, row_dict)
+    # Fields in SCHEMA order (reference :399-405): Spark matches by
+    # position against the DataFrame schema built from the same unischema.
+    return pyspark.sql.Row(**{name: encoded[name]
+                              for name in unischema.fields})
 
 
 def insert_explicit_nulls(schema: Unischema, row: dict) -> None:

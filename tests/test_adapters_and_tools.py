@@ -1,6 +1,7 @@
 """TF/torch adapters, CLIs, mocks, batching queue
 (strategy parity: reference test_tf_dataset.py / test_pytorch_dataloader.py /
 metadata CLI suites)."""
+import os
 import numpy as np
 import pytest
 
@@ -201,3 +202,21 @@ def test_generate_metadata_reference_cli_spelling(tmp_path):
                  "--unischema_class", "dataset_utils.TestSchema"]) == 0
     stored = get_schema(DatasetContext(f"file://{path}"))
     assert set(stored.fields) == set(TestSchema.fields)
+
+
+def test_copy_dataset_refuses_nested_paths(synthetic_dataset, tmp_path):
+    """--overwrite-output recursively removes the target, so a target
+    containing (or contained in) the source must refuse up front — either
+    nesting direction would delete source data."""
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+    src_path = synthetic_dataset.url.replace("file://", "")
+    for bad_target in (synthetic_dataset.url,             # identical
+                       f"file://{src_path}/sub",          # below the source
+                       f"file://{os.path.dirname(src_path)}"):  # above it
+        with pytest.raises(ValueError, match="nested|same path"):
+            copy_dataset(synthetic_dataset.url, bad_target,
+                         overwrite_output=True)
+    # sibling with a shared name prefix is fine
+    ok_target = f"file://{tmp_path}/copy_sib"
+    assert copy_dataset(synthetic_dataset.url, ok_target,
+                        field_regex=["id"]) == 100

@@ -232,6 +232,16 @@ def test_local_disk_cache_round(synthetic_dataset, tmp_path):
     assert len(cache) == 10  # one entry per row group
     cache.cleanup()
 
+    # Reader.cleanup_cache (reference parity, reader.py:693): releases the
+    # reader's own cache handle; safe on NullCache too.
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        next(iter(reader))
+        reader.cleanup_cache()
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        reader.cleanup_cache()  # NullCache: no-op, no error
+
 
 def test_weighted_sampling_mix(synthetic_dataset):
     from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader

@@ -169,3 +169,42 @@ def test_schema_with_more_than_255_fields():
     row = {f.name: i for i, f in enumerate(fields)}
     t = s.make_namedtuple_from_dict(row)
     assert t.col_0299 == 299 and len(t._fields) == 300
+
+
+def test_dict_to_spark_row_reference_write_path(spark_session):
+    """The reference's Spark write-path helper (unischema.py:359): encodes
+    through the field codecs and wraps as a pyspark Row in schema field
+    order — usable with functools.partial exactly like the reference's
+    materialize examples."""
+    import functools
+
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.unischema import dict_to_spark_row
+
+    schema = Unischema("WriteRow", [
+        UnischemaField("id", np.int32, (), ScalarCodec(np.int32), False),
+        UnischemaField("img", np.uint8, (8, 6, 3),
+                       CompressedImageCodec("png"), False),
+        UnischemaField("maybe", np.int64, (), ScalarCodec(np.int64), True),
+    ])
+    rng = np.random.default_rng(3)
+    to_row = functools.partial(dict_to_spark_row, schema)
+    row = to_row({"id": np.int32(7),
+                  "img": rng.integers(0, 255, (8, 6, 3), np.uint8)})
+    assert row["id"] == 7
+    assert isinstance(row["img"], (bytes, bytearray))  # png-encoded
+    assert row["maybe"] is None                        # explicit null added
+    assert list(row.keys() if hasattr(row, "keys") else row.__fields__) \
+        == ["id", "img", "maybe"]                      # schema order
+
+
+def test_make_namedtuple_tf_alias():
+    """Reference-parity alias (unischema.py:299)."""
+    schema = Unischema("T", [
+        UnischemaField("a", np.int64, (), None, False),
+        UnischemaField("b", np.int64, (), None, False),
+    ])
+    t = schema.make_namedtuple_tf(a=1, b=2)
+    assert (t.a, t.b) == (1, 2)
+    t2 = schema.make_namedtuple_tf(3, 4)
+    assert (t2.a, t2.b) == (3, 4)
