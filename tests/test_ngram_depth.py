@@ -1,6 +1,8 @@
 """NGram depth tests: pool flavors, shuffling, length-1 windows, epochs,
 drop partitions, mixing (strategy parity: reference
 tests/test_ngram_end_to_end.py:203-604, test_weighted_sampling_reader.py:125)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -210,3 +212,75 @@ def test_ngram_windows_span_coalesced_groups(tmp_path):
     coalesced = count_windows(4)      # 15 windows over the merged 16 rows
     assert per_group == 12
     assert coalesced == 15
+
+
+def test_form_ngram_window_parity_with_reference_code():
+    """Window formation validated against the REFERENCE'S OWN form_ngram
+    (its ngram module loaded from the checkout, its unischema under the
+    package name it imports): identical windows across delta thresholds
+    and with timestamp_overlap=False — a sequence dataset windows the same
+    way after migration (reference ngram.py:225-271)."""
+    import importlib.util
+    import sys
+    import types
+
+    ref = "/root/reference/petastorm"
+    if not os.path.isdir(ref):
+        pytest.skip("reference checkout not available")
+    saved = {k: sys.modules.get(k) for k in ("petastorm",
+                                             "petastorm.unischema",
+                                             "petastorm.ngram")}
+    try:
+        pkg = types.ModuleType("petastorm")
+        pkg.__path__ = [ref]
+        sys.modules["petastorm"] = pkg
+        for name in ("unischema", "ngram"):
+            spec = importlib.util.spec_from_file_location(
+                f"petastorm.{name}", f"{ref}/{name}.py")
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[f"petastorm.{name}"] = mod
+            spec.loader.exec_module(mod)
+        ref_uni = sys.modules["petastorm.unischema"]
+        ref_ng = sys.modules["petastorm.ngram"]
+
+        ref_schema = ref_uni.Unischema("S", [
+            ref_uni.UnischemaField("ts", np.int64, (), None, False),
+            ref_uni.UnischemaField("v", np.int64, (), None, False),
+        ])
+        my_schema = Unischema("S", [
+            UnischemaField("ts", np.int64, (), None, False),
+            UnischemaField("v", np.int64, (), None, False),
+        ])
+        rng = np.random.default_rng(5)
+        ts_vals = sorted(rng.choice(np.arange(0, 60), size=20,
+                                    replace=False).tolist())
+        rows = [{"ts": np.int64(t), "v": np.int64(t * 7)} for t in ts_vals]
+
+        def norm(windows):
+            def cell(v):
+                # (ts, v) per timestep: comparing ts alone would let a
+                # wrong-row or dropped 'v' field slip through
+                if isinstance(v, dict):
+                    return (int(v["ts"]), int(v["v"]))
+                return (int(v.ts), int(v.v))
+            return [tuple(cell(w[k]) for k in sorted(w)) for w in windows]
+
+        for delta in (3, 5, 100):
+            for overlap in (True, False):
+                ref_gram = ref_ng.NGram(
+                    {k: [ref_schema.ts, ref_schema.v] for k in range(3)},
+                    delta_threshold=delta, timestamp_field=ref_schema.ts,
+                    timestamp_overlap=overlap)
+                mine = NGram({k: ["ts", "v"] for k in range(3)},
+                             delta_threshold=delta, timestamp_field="ts",
+                             timestamp_overlap=overlap)
+                r = norm(ref_gram.form_ngram(list(rows), ref_schema))
+                m = norm(mine.form_ngram(list(rows), my_schema))
+                assert r == m, (delta, overlap, r[:4], m[:4])
+                assert r, (delta, overlap)  # never vacuous
+    finally:
+        for name, mod in saved.items():
+            if mod is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = mod
