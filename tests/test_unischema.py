@@ -208,3 +208,18 @@ def test_make_namedtuple_tf_alias():
     assert (t.a, t.b) == (1, 2)
     t2 = schema.make_namedtuple_tf(3, 4)
     assert (t2.a, t2.b) == (3, 4)
+
+
+def test_schema_with_more_than_255_fields():
+    """The reference ships namedtuple_gt_255_fields.py to work around the
+    pre-3.7 CPython 255-argument limit; modern namedtuples have no such
+    limit, but the capability itself (wide schemas through the namedtuple
+    cache, views, and row construction) must still hold."""
+    fields = [UnischemaField(f"f{i:03d}", np.int64, (), None, False)
+              for i in range(300)]
+    schema = Unischema("Wide", fields)
+    assert len(schema.fields) == 300
+    row = schema.make_namedtuple(**{f"f{i:03d}": i for i in range(300)})
+    assert row.f000 == 0 and row.f299 == 299
+    view = schema.create_schema_view([f"f0[0-4][0-9]"])
+    assert len(view.fields) == 50
