@@ -71,9 +71,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale        # (bq, d)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)                # (bk, d)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # (bq, d)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)                # (bk, d)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = q @ k.T                                              # (bq, bk)
         if causal:
             qpos = q_off + jax.lax.broadcasted_iota(
@@ -98,11 +98,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
             # Unnormalized accumulator + online-softmax stats, f32: the
             # caller (ring attention's cross-device merge) rescales and
             # normalizes once after combining every block's contribution.
-            o_ref[0, :, 0, :] = acc_ref[:]
-            m_out_ref[0, :, 0] = m_ref[:, 0]
-            l_out_ref[0, :, 0] = l_ref[:, 0]
+            o_ref[0, 0, :, :] = acc_ref[:]
+            m_out_ref[0, 0, :, :] = m_ref[:]
+            l_out_ref[0, 0, :, :] = l_ref[:]
         else:
-            o_ref[0, :, 0, :] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(
+            o_ref[0, 0, :, :] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(
                 o_ref.dtype)
 
 
@@ -116,27 +116,35 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     rep = h // kv_h
     kernel = partial(_flash_kernel, block_q=block_q, block_k=block_k,
                      causal=causal, scale=1.0 / np.sqrt(d))
-    return pl.pallas_call(
+    # Kernel-internal layout is (b, heads, seq, d): Mosaic requires the
+    # block's minor-most two dims to tile as (sublane, lane) — (block_q, d)
+    # satisfies the (8, 128) granule, whereas the model-side (b, seq,
+    # heads, d) layout would put a size-1 block dim over the heads axis,
+    # which the TPU lowering rejects. XLA fuses the boundary transposes
+    # into the surrounding copies.
+    out = pl.pallas_call(
         kernel,
         grid=(b, h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, 1, d),
-                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, d),
-                               lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),      # acc
             pltpu.VMEM((block_q, 1), jnp.float32),      # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),      # normalizer l
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+      v.transpose(0, 2, 1, 3))
+    return out.transpose(0, 2, 1, 3)
 
 
 def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -152,29 +160,33 @@ def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     rep = h // kv_h
     kernel = partial(_flash_kernel, block_q=block_q, block_k=block_k,
                      causal=causal, scale=1.0 / np.sqrt(d), emit_stats=True)
-    stat_spec = pl.BlockSpec((1, block_q, 1),
-                             lambda bi, hi, qi, ki: (bi, qi, hi))
-    return pl.pallas_call(
+    # Same kernel-internal (b, heads, seq, d) layout as _flash_forward
+    # (see comment there); the m/l stats ride out as (b, h, sq, 1) so
+    # their minor-most dims ((block_q, 1)) tile legally, then squeeze +
+    # transpose back to the ring-merge contract's (b, sq, h).
+    stat_spec = pl.BlockSpec((1, 1, block_q, 1),
+                             lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    o, m, l = pl.pallas_call(
         kernel,
         grid=(b, h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, 1, d),
-                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, hi, qi, ki: (bi, ki, hi // rep, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, 1, d),
-                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             stat_spec,
             stat_spec,
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, sq, h, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, sq, h), jnp.float32),
-            jax.ShapeDtypeStruct((b, sq, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),      # acc
@@ -182,7 +194,10 @@ def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, 1), jnp.float32),      # normalizer l
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+      v.transpose(0, 2, 1, 3))
+    return (o.transpose(0, 2, 1, 3), m[..., 0].transpose(0, 2, 1),
+            l[..., 0].transpose(0, 2, 1))
 
 
 def _dense_stats(q, k, v, causal: bool, block_q: int):

@@ -69,7 +69,8 @@ if not os.path.exists(os.path.join(store, '_common_metadata')):
     write_synthetic_imagenet(url, rows=2048)
 signal.alarm({alarm})
 r = run_imagenet_bench(url, steps=30, per_device_batch=128,
-                       workers_count=8, pool_type='thread')
+                       workers_count=8, pool_type='thread',
+                       resident_steps=10)
 print('BENCHJSON:' + json.dumps(r))
 """
 
@@ -141,6 +142,23 @@ def med_time(fn, args, iters=10):
         ts.append(time.perf_counter() - t0)
     return float(np.median(ts))
 
+def chained_time(fn, args, chain=20):
+    # Per-call sync timing on the tunneled device is dominated by a
+    # ~70 ms dispatch round-trip (measured: dense/flash at different
+    # seq all cluster at the same floor). Chain `chain` dependent calls
+    # (output feeds the next q: shapes are preserved) and block once —
+    # async dispatch pipelines the RTT, so the per-call quotient is the
+    # kernel's own device time.
+    q, k, v = args
+    o = fn(q, k, v)
+    jax.block_until_ready(o)  # warmup
+    t0 = time.perf_counter()
+    o = q
+    for _ in range(chain):
+        o = fn(o.astype(q.dtype), k, v)
+    jax.block_until_ready(o)
+    return (time.perf_counter() - t0) / chain
+
 for seq in (4096, 8192):
     q, k, v = mk(seq)
     tf = med_time(flash, (q, k, v))
@@ -148,6 +166,11 @@ for seq in (4096, 8192):
     out[f'flash_ms_seq{{seq}}'] = round(tf * 1000, 3)
     out[f'dense_ms_seq{{seq}}'] = round(td * 1000, 3)
     out[f'speedup_seq{{seq}}'] = round(td / tf, 3)
+    tfa = chained_time(flash, (q, k, v))
+    tda = chained_time(dense, (q, k, v))
+    out[f'flash_ms_seq{{seq}}_amortized'] = round(tfa * 1000, 3)
+    out[f'dense_ms_seq{{seq}}_amortized'] = round(tda * 1000, 3)
+    out[f'speedup_seq{{seq}}_amortized'] = round(tda / tfa, 3)
 print('BENCHJSON:' + json.dumps(out))
 """
 
