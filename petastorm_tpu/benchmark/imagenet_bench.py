@@ -208,6 +208,8 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
     if resident_s is not None:
         result["step_time_ms_resident"] = 1000.0 * resident_s
         result["samples_per_sec_resident"] = batch_size / resident_s
+        result["samples_per_sec_per_chip_resident"] = (
+            batch_size / resident_s / len(devices))
     if flops_per_step is not None:
         # cost_analysis() on an SPMD executable reports PER-DEVICE flops
         # (verified: sharding a batch over 4 devices reports global/4), so

@@ -43,6 +43,23 @@ import jax.numpy as jnp
 import numpy as np
 
 _DEFAULT_BLOCK = 128
+# Launch defaults: bigger tiles amortize per-program overhead (an 8k seq
+# at 128x128 is a 32k-program grid; at 256x512 it is 2k) while staying
+# far under VMEM (q 64KB + k/v 128KB each + f32 scores 512KB per step).
+# Seqs the big tiles don't divide step down to _DEFAULT_BLOCK before
+# falling back to dense, so the kernel-path coverage of the old 128
+# defaults (e.g. seq 1280) is preserved.
+_DEFAULT_BLOCK_Q = 256
+_DEFAULT_BLOCK_K = 512
+
+
+def _pick_block(requested: int, seq: int) -> int:
+    """Clamp ``requested`` to ``seq``; if it doesn't divide, retry the
+    128 granule before the caller's dense-fallback guard rejects it."""
+    blk = min(requested, seq)
+    if seq % blk and not seq % _DEFAULT_BLOCK:
+        blk = _DEFAULT_BLOCK
+    return blk
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
@@ -71,10 +88,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale        # (bq, d)
-        k = k_ref[0, 0, :, :].astype(jnp.float32)                # (bk, d)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
-        s = q @ k.T                                              # (bq, bk)
+        # Matmuls stay in the input dtype (bf16 on the training path) with
+        # f32 accumulation — the MXU's native mode; upcasting the operands
+        # to f32 first would run the systolic array at a fraction of peak.
+        # All softmax bookkeeping (max, exp, normalizer) is f32.
+        q = q_ref[0, 0, :, :]                                    # (bq, d)
+        k = k_ref[0, 0, :, :]                                    # (bk, d)
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(                                 # (bq, bk)
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = q_off + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -90,7 +113,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:, 0] = l_prev * alpha + p.sum(axis=-1)
         m_ref[:, 0] = m_new
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
+        # p rounds to the v dtype for the second MXU pass (standard flash
+        # practice: p is in [0, 1], the f32 accumulator absorbs the sum).
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_k - 1)
     def _emit():
@@ -243,8 +270,8 @@ _flash_stats_vjp.defvjp(_flash_stats_vjp_fwd, _flash_stats_vjp_bwd)
 
 
 def flash_attention_stats(q, k, v, causal: bool = False,
-                          block_q: int = _DEFAULT_BLOCK,
-                          block_k: int = _DEFAULT_BLOCK, interpret=None):
+                          block_q: int = _DEFAULT_BLOCK_Q,
+                          block_k: int = _DEFAULT_BLOCK_K, interpret=None):
     """Flash kernel emitting the online-softmax partials instead of the
     normalized output: ``(o_unnormalized f32, m, l)``, each ``(b, sq, h,
     d)`` / ``(b, sq, h)`` — the contract ring attention's cross-device
@@ -255,8 +282,8 @@ def flash_attention_stats(q, k, v, causal: bool = False,
     sk, kv_h = k.shape[1], k.shape[2]
     if h % kv_h:
         raise ValueError(f"heads ({h}) must be a multiple of kv_heads ({kv_h})")
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(block_q, sq)
+    block_k = _pick_block(block_k, sk)
     if (sq % block_q or sk % block_k or block_q % 8 or block_k % 8
             or (causal and sq != sk)):
         return _dense_stats(q, k, v, causal, block_q)
@@ -329,8 +356,8 @@ _flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False,
-                    block_q: int = _DEFAULT_BLOCK,
-                    block_k: int = _DEFAULT_BLOCK,
+                    block_q: int = _DEFAULT_BLOCK_Q,
+                    block_k: int = _DEFAULT_BLOCK_K,
                     interpret=None):
     """Drop-in for :func:`...parallel.attention.dense_attention`:
     q ``(b, sq, heads, d)``, k/v ``(b, sk, kv_heads, d)`` ->
@@ -347,8 +374,8 @@ def flash_attention(q, k, v, causal: bool = False,
     sk, kv_h = k.shape[1], k.shape[2]
     if h % kv_h:
         raise ValueError(f"heads ({h}) must be a multiple of kv_heads ({kv_h})")
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(block_q, sq)
+    block_k = _pick_block(block_k, sk)
     if (sq % block_q or sk % block_k or block_q % 8 or block_k % 8
             or (causal and sq != sk)):
         return _dense(q, k, v, causal)
@@ -357,8 +384,8 @@ def flash_attention(q, k, v, causal: bool = False,
     return _flash_vjp(causal, block_q, block_k, bool(interpret), q, k, v)
 
 
-def make_flash_attention(causal: bool = True, block_q: int = _DEFAULT_BLOCK,
-                         block_k: int = _DEFAULT_BLOCK, interpret=None):
+def make_flash_attention(causal: bool = True, block_q: int = _DEFAULT_BLOCK_Q,
+                         block_k: int = _DEFAULT_BLOCK_K, interpret=None):
     """An ``attn_fn`` for :func:`petastorm_tpu.models.llama.apply`
     (``supports_gqa``: K/V arrive at native kv-head width)."""
     def attn(q, k, v):

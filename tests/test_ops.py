@@ -182,6 +182,37 @@ def test_flash_attention_untileable_falls_back(monkeypatch):
     assert out2.shape == (2, 96, 4, 64)
 
 
+def test_flash_attention_block_fallback_keeps_kernel_path(monkeypatch):
+    """seq=1280 divides the 128 granule but not the 256/512 launch
+    defaults: _pick_block must step the blocks down to 128 and stay on
+    the kernel path (regression: raising the defaults silently pushed
+    these seqs onto the O(seq^2) dense fallback)."""
+    import importlib
+
+    fa_mod = importlib.import_module("petastorm_tpu.ops.flash_attn")
+    assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_K, 1280) == 128
+    assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_K, 4096) == 512
+    assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_Q, 100) == 100  # -> dense
+
+    calls = {}
+    real = fa_mod._flash_forward
+
+    def spy(q, k, v, causal, block_q, block_k, interpret):
+        calls["blocks"] = (block_q, block_k)
+        return real(q, k, v, causal, block_q, block_k, interpret)
+
+    monkeypatch.setattr(fa_mod, "_flash_forward", spy)
+    q, k, v = _attn_inputs(s=1280)
+    out = fa_mod.flash_attention(q, k, v, causal=True)
+    # 1280 = 5*256 so block_q keeps the 256 default; block_k steps
+    # 512 -> 128 (1280 % 512 != 0)
+    assert calls["blocks"] == (256, 128)
+    from petastorm_tpu.parallel.attention import dense_attention
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
 def test_flash_attention_in_llama():
     """make_flash_attention drops into llama.apply as attn_fn (GQA-native)
     and reproduces the dense-attention loss."""

@@ -171,6 +171,27 @@ for seq in (4096, 8192):
     out[f'flash_ms_seq{{seq}}_amortized'] = round(tfa * 1000, 3)
     out[f'dense_ms_seq{{seq}}_amortized'] = round(tda * 1000, 3)
     out[f'speedup_seq{{seq}}_amortized'] = round(tda / tfa, 3)
+
+# --- long-context: the regime the O(seq) kernel exists for ------------
+# Dense causal attention at seq 32k wants a (1, 8, 32k, 32k) f32 score
+# tensor = 34 GB — far past a 16 GB chip. The flash kernel streams K/V
+# tiles through VMEM, so it keeps running; record how far dense gets on
+# the same silicon for the memory-ceiling comparison.
+for seq in (16384, 32768):
+    q, k, v = mk(seq)
+    # Both sides guarded: a tunnel flake on EITHER path must not abort
+    # the script before BENCHJSON flushes the measurements already taken
+    # in this scarce healthy window.
+    try:
+        out[f'flash_ms_seq{{seq}}_amortized'] = round(
+            chained_time(flash, (q, k, v), chain=8) * 1000, 3)
+    except Exception as e:
+        out[f'flash_seq{{seq}}_error'] = type(e).__name__ + ': ' + str(e)[:120]
+    try:
+        out[f'dense_ms_seq{{seq}}_amortized'] = round(
+            chained_time(dense, (q, k, v), chain=8) * 1000, 3)
+    except Exception as e:  # XlaRuntimeError: RESOURCE_EXHAUSTED
+        out[f'dense_seq{{seq}}_error'] = type(e).__name__ + ': ' + str(e)[:120]
 print('BENCHJSON:' + json.dumps(out))
 """
 
