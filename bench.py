@@ -175,7 +175,8 @@ def _try_accelerator_imagenet(out: dict, data_dir: str, window: str,
     capture (+ flash-attention on-chip check, first window only) through
     tools.tpu_evidence so the measurement is persisted to the evidence
     file the moment it exists. Returns run_imagenet_bench's dict or None."""
-    from tools.tpu_evidence import capture_flash_attn, capture_imagenet
+    from tools.tpu_evidence import (capture_flash_attn, capture_imagenet,
+                                    capture_llama)
     if not _probe_accelerator(timeout_s=150.0, attempts=attempts,
                               backoff_s=backoff_s):
         out.setdefault("imagenet_probe_windows", []).append(
@@ -185,6 +186,7 @@ def _try_accelerator_imagenet(out: dict, data_dir: str, window: str,
     imagenet = capture_imagenet(data_dir)
     if window == "early":
         capture_flash_attn()
+        capture_llama()
     return imagenet
 
 
@@ -412,7 +414,8 @@ def main():
     # windows were wedged.)
     try:
         from tools.tpu_evidence import latest_evidence
-        evidence = {ev: rec for ev in ("imagenet", "flash_attn")
+        evidence = {ev: rec for ev in ("imagenet", "flash_attn",
+                                       "llama_train")
                     if (rec := latest_evidence(ev)) is not None}
         if evidence:
             out["tpu_evidence"] = evidence

@@ -86,7 +86,7 @@ def main(argv=None) -> int:
     deadline = time.time() + args.max_hours * 3600
     # Phase completion is tracked per phase: a wedge between flash and
     # imagenet must not cause a later window to redo the banked phase.
-    done: dict[str, int] = {"flash_attn": 0, "imagenet": 0}
+    done: dict[str, int] = {"flash_attn": 0, "imagenet": 0, "llama": 0}
     full_captures = 0
     probe_n = 0
 
@@ -122,7 +122,9 @@ def main(argv=None) -> int:
                     ("flash_attn",
                      lambda: tpu_evidence.capture_flash_attn()),
                     ("imagenet",
-                     lambda: tpu_evidence.capture_imagenet(args.data_dir))):
+                     lambda: tpu_evidence.capture_imagenet(args.data_dir)),
+                    ("llama",
+                     lambda: tpu_evidence.capture_llama())):
                 if done[phase] > full_captures:
                     continue  # banked this round already
                 result = fn()
