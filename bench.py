@@ -415,7 +415,7 @@ def main():
     try:
         from tools.tpu_evidence import latest_evidence
         evidence = {ev: rec for ev in ("imagenet", "flash_attn",
-                                       "llama_train")
+                                       "llama_train", "llm_pipeline")
                     if (rec := latest_evidence(ev)) is not None}
         if evidence:
             out["tpu_evidence"] = evidence

@@ -117,6 +117,83 @@ def _flops_of_compiled(compiled) -> float | None:
         return None
 
 
+def pipelined_window(run_step, next_batch, steps: int, resident_steps: int,
+                     warm_loss):
+    """Shared measurement harness for the training benchmarks
+    (:func:`run_imagenet_bench`, :func:`..llm_bench.run_llm_bench` —
+    one home so their methodologies cannot drift).
+
+    Timing design for an async backend: the measured window is
+    wall-clock over ``steps`` pipelined step dispatches, closed by ONE
+    :func:`hard_sync` readback. Per-step syncing would serialize
+    transfer against compute and measure a regime no real training loop
+    runs in (measured: it doubled step time on the tunneled chip), and
+    per-step ``block_until_ready`` is worse — on the axon backend it
+    has returned before execution finished (see :func:`hard_sync`).
+    Stall is attributed per-step: ``next_batch()`` waits are host-side
+    and need no device sync. Caveat: under async dispatch, device
+    execution can overlap a loader wait, so ``wall - wait`` is an
+    UPPER-bound attribution of stall and LOWER-bound of step time; the
+    resident phase (re-running the step on the last staged batch, no
+    host transfer in the loop) is the overlap-free step-time
+    measurement.
+
+    ``run_step(batch) -> loss`` threads the caller's train state via
+    closure; ``next_batch()`` returns a staged batch. Returns
+    ``(loss_first, loss_last, wait_s, total_wall_s, resident_s)``
+    (``resident_s`` is None when ``resident_steps`` is 0)."""
+    loss_first = hard_sync(warm_loss)  # warmup's loss; syncs pre-window
+    wait_s = 0.0
+    batch = None
+    t_start = time.perf_counter()
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        batch = next_batch()
+        wait_s += time.perf_counter() - t0
+        loss = run_step(batch)
+    loss_last = hard_sync(loss)  # closes the window
+    total_wall = time.perf_counter() - t_start
+
+    resident_s = None
+    if resident_steps:
+        t0 = time.perf_counter()
+        for _ in range(resident_steps):
+            loss = run_step(batch)
+        hard_sync(loss)
+        resident_s = (time.perf_counter() - t0) / resident_steps
+    return loss_first, loss_last, wait_s, total_wall, resident_s
+
+
+def utilization_metrics(result: dict, flops_per_step, step_time_s: float,
+                        resident_s, device_kind: str) -> None:
+    """Fill the shared FLOPs/MFU block (pipelined + resident variants,
+    physical-plausibility guard) into ``result`` in place. Per-chip by
+    construction: ``flops_per_step`` comes from
+    :func:`_flops_of_compiled`, which reports per-device FLOPs on SPMD
+    executables."""
+    if flops_per_step is None:
+        return
+    result["model_flops_per_step_per_chip"] = flops_per_step
+    achieved = flops_per_step / step_time_s
+    result["achieved_tflops_per_chip"] = achieved / 1e12
+    peak, peak_source = _peak_flops(device_kind)
+    if peak:
+        result["mfu_pct"] = 100.0 * achieved / peak
+        result["peak_flops_source"] = peak_source
+        if achieved > peak:
+            # wall - wait underestimates step time when device execution
+            # overlaps a loader wait (see pipelined_window): physically
+            # impossible rate = that regime was hit, not a measurement.
+            result["mfu_suspect"] = (
+                "achieved exceeds chip peak: loader-bound window, "
+                "wait/compute overlap; use the resident metrics")
+    if resident_s is not None:
+        r_achieved = flops_per_step / resident_s
+        result["achieved_tflops_per_chip_resident"] = r_achieved / 1e12
+        if peak:
+            result["mfu_pct_resident"] = 100.0 * r_achieved / peak
+
+
 def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
                        workers_count: int = 4, pool_type: str = "thread",
                        classes: int = 100, prefetch: int = 2,
@@ -183,57 +260,26 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
         step = step.lower(params, velocity, batch).compile()
         flops_per_step = _flops_of_compiled(step)
         params, velocity, loss, acc = step(params, velocity, batch)
-        jax.block_until_ready(loss)
 
-        # Timing design for an async backend: the measured window is
-        # wall-clock over `steps` pipelined steps closed by ONE
-        # hard_sync readback. Per-step syncing would serialize transfer
-        # against compute and measure a regime no real training loop
-        # runs in (measured: it doubled step time on the tunneled chip).
-        # Stall is still attributed per-step: next(it) waits are
-        # host-side and need no device sync. Caveat recorded below:
-        # under async dispatch, device execution can overlap a loader
-        # wait, so compute_s = wall - wait is an UPPER-bound attribution
-        # of stall and lower-bound of step time; the resident phase is
-        # the overlap-free step-time measurement.
-        loss_first = hard_sync(loss)  # warmup's loss; syncs pre-window
-        wait_s = 0.0
-        t_start = time.perf_counter()
-        for _ in range(steps):
-            t0 = time.perf_counter()
-            batch = next(it)
-            wait_s += time.perf_counter() - t0
-            params, velocity, loss, acc = step(params, velocity, batch)
-        loss_last = hard_sync(loss)  # closes the window
-        total_wall = time.perf_counter() - t_start
-        compute_s = total_wall - wait_s
-        losses = [loss_first, loss_last]
+        def run_step(b):
+            nonlocal params, velocity, acc
+            params, velocity, loss, acc = step(params, velocity, b)
+            return loss
 
-        # Resident-batch phase: re-run the step on the batch already in
-        # HBM — no host->device transfer inside the loop, so this
-        # isolates the chip's compute rate from the host link. On a
-        # tunneled device (axon) the link, not the MXU, can bound the
-        # end-to-end step; reporting both makes that attribution visible
-        # instead of folding link time into "compute".
-        resident_s = None
-        if resident_steps:
-            t0 = time.perf_counter()
-            for _ in range(resident_steps):
-                params, velocity, loss, acc = step(params, velocity, batch)
-            hard_sync(loss)
-            resident_s = (time.perf_counter() - t0) / resident_steps
+        loss_first, loss_last, wait_s, total_wall, resident_s = (
+            pipelined_window(run_step, lambda: next(it), steps,
+                             resident_steps, warm_loss=loss))
 
-    total = wait_s + compute_s
-    sps = steps * batch_size / total
-    step_time_s = compute_s / steps
+    sps = steps * batch_size / total_wall
+    step_time_s = (total_wall - wait_s) / steps
     result = {
         "samples_per_sec": sps,
         "samples_per_sec_per_chip": sps / len(devices),
-        "input_stall_pct": 100.0 * wait_s / total,
+        "input_stall_pct": 100.0 * wait_s / total_wall,
         "devices": len(devices),
         "global_batch": batch_size,
-        "loss_first": losses[0],
-        "loss_last": losses[-1],
+        "loss_first": loss_first,
+        "loss_last": loss_last,
         "step_time_ms": 1000.0 * step_time_s,
         "device_kind": devices[0].device_kind,
     }
@@ -242,30 +288,6 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
         result["samples_per_sec_resident"] = batch_size / resident_s
         result["samples_per_sec_per_chip_resident"] = (
             batch_size / resident_s / len(devices))
-    if flops_per_step is not None:
-        # cost_analysis() on an SPMD executable reports PER-DEVICE flops
-        # (verified: sharding a batch over 4 devices reports global/4), so
-        # flops/step_time is per-chip FLOP/s — directly comparable to the
-        # chip's peak.
-        achieved_per_chip = flops_per_step / step_time_s
-        result["model_flops_per_step_per_chip"] = flops_per_step
-        result["achieved_tflops_per_chip"] = achieved_per_chip / 1e12
-        peak, peak_source = _peak_flops(devices[0].device_kind)
-        if peak:
-            result["mfu_pct"] = 100.0 * achieved_per_chip / peak
-            result["peak_flops_source"] = peak_source
-            if achieved_per_chip > peak:
-                # compute_s = wall - wait underestimates step time when
-                # device execution overlaps a loader wait (see timing
-                # comment): a physically impossible rate means that
-                # regime was hit and the split is not a measurement.
-                result["mfu_suspect"] = (
-                    "achieved exceeds chip peak: loader-bound window, "
-                    "wait/compute overlap; use the resident metrics")
-        if resident_s is not None:
-            result["achieved_tflops_per_chip_resident"] = (
-                flops_per_step / resident_s / 1e12)
-            if peak:
-                result["mfu_pct_resident"] = (
-                    100.0 * flops_per_step / resident_s / peak)
+    utilization_metrics(result, flops_per_step, step_time_s, resident_s,
+                        devices[0].device_kind)
     return result

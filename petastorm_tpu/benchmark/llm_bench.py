@@ -1,0 +1,136 @@
+"""LLM-pretrain pipeline benchmark: token store -> NGram windows ->
+DataLoader -> llama train step (BASELINE config 5's shape).
+
+This is the end-to-end counterpart to :mod:`.imagenet_bench` for the
+sequence path: the reference's only sequence feature is NGram windowed
+readout (``/root/reference/petastorm/ngram.py:225`` ``form_ngram``), and
+the BASELINE LLM config feeds token windows to a decoder. Here the whole
+chain runs on real hardware: rows decode in reader workers, NGram
+assembles timestamp-ordered windows per row group, the loader stacks
+windows into a dense ``(batch, window)`` int32 array staged into HBM,
+and a real AdamW llama step consumes it. Metrics mirror
+:func:`.imagenet_bench.run_imagenet_bench`: pipelined wall-clock window
+closed by one :func:`.imagenet_bench.hard_sync`, per-step host-side
+stall attribution, and a resident-batch phase isolating chip compute.
+
+``echo`` exercises data echoing (jax/loader.py) in the regime it was
+built for: when the single-host reader cannot feed the step rate,
+``echo=k`` re-yields each staged batch k times as device-side copies —
+the stall comparison echo=1 vs echo>1 is the feature's measurement.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_token_store(url: str, windows: int, window: int,
+                      vocab: int = 32000, seed: int = 0) -> None:
+    """Timestamped token store, one NGram window per row group (windows
+    never cross row groups — same layout contract as the reference's
+    NGram, ngram.py:86-91 there)."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema("TokSchema", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("token", np.int32, (), ScalarCodec(np.int32), False),
+    ])
+    rng = np.random.default_rng(seed)
+    with materialize_dataset_local(url, schema,
+                                   rows_per_row_group=window) as w:
+        for i in range(windows * window):
+            w.write_row({"ts": np.int64(i),
+                         "token": np.int32(rng.integers(0, vocab))})
+
+
+def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
+                  window: int = 512, workers_count: int = 8,
+                  pool_type: str = "thread", echo: int = 1,
+                  resident_steps: int = 0,
+                  model_kwargs: dict | None = None) -> dict:
+    """Token windows through the full reader stack into a real llama
+    train step; returns ``{tokens_per_sec, input_stall_pct,
+    step_time_ms, loss_first, loss_last[, *_resident], ...}``.
+
+    Timing methodology is identical to
+    :func:`.imagenet_bench.run_imagenet_bench` (pipelined window, single
+    readback sync, per-step host-side stall split, wait/compute-overlap
+    caveat and all).
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.benchmark.imagenet_bench import (_flops_of_compiled,
+                                                        pipelined_window,
+                                                        utilization_metrics)
+    from petastorm_tpu.jax import DataLoader
+    from petastorm_tpu.models import llama
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader import make_reader
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices).reshape(len(devices)), ("data",))
+    kw = dict(vocab=32000, dim=1024, n_layers=8, n_heads=8, n_kv_heads=4,
+              hidden=2816)
+    kw.update(model_kwargs or {})
+    cfg = llama.LlamaConfig(**kw)
+
+    params = jax.device_put(llama.init_params(jax.random.PRNGKey(0), cfg),
+                            NamedSharding(mesh, P()))
+    init_opt, raw_step = llama.make_train_step(cfg, shift="roll")
+    opt = init_opt(params)
+
+    def step_fn(params, opt, tokens):
+        return raw_step(params, opt, {"tokens": tokens})
+
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    ngram = NGram({o: ["ts", "token"] for o in range(window)},
+                  delta_threshold=1, timestamp_field="ts",
+                  timestamp_overlap=False)
+    with make_reader(url, schema_fields=ngram, num_epochs=None,
+                     shuffle_row_groups=True, seed=0,
+                     reader_pool_type=pool_type,
+                     workers_count=workers_count) as reader:
+        loader = DataLoader(reader, batch_size=batch_size,
+                            sharding=NamedSharding(mesh, P("data")),
+                            echo=echo)
+        it = iter(loader)
+        tokens = next(it)["token"]
+        assert tokens.shape == (batch_size, window), tokens.shape
+        step = step.lower(params, opt, tokens).compile()
+        flops_per_step = _flops_of_compiled(step)
+        params, opt, loss = step(params, opt, tokens)
+
+        def run_step(toks):
+            nonlocal params, opt
+            params, opt, loss = step(params, opt, toks)
+            return loss
+
+        loss_first, loss_last, wait_s, total_wall, resident_s = (
+            pipelined_window(run_step, lambda: next(it)["token"], steps,
+                             resident_steps, warm_loss=loss))
+
+    tokens_per_step = batch_size * window
+    step_time_s = (total_wall - wait_s) / steps
+    result = {
+        "tokens_per_sec": tokens_per_step * steps / total_wall,
+        "input_stall_pct": 100.0 * wait_s / total_wall,
+        "step_time_ms": 1000.0 * step_time_s,
+        "tokens_per_step": tokens_per_step,
+        "echo": echo,
+        "window": window,
+        "devices": len(devices),
+        "loss_first": loss_first,
+        "loss_last": loss_last,
+        "device_kind": devices[0].device_kind,
+    }
+    if resident_s is not None:
+        result["step_time_ms_resident"] = 1000.0 * resident_s
+        result["tokens_per_sec_resident"] = tokens_per_step / resident_s
+        result["tokens_per_sec_per_chip_resident"] = (
+            tokens_per_step / resident_s / len(devices))
+    utilization_metrics(result, flops_per_step, step_time_s, resident_s,
+                        devices[0].device_kind)
+    return result

@@ -249,6 +249,29 @@ def test_imagenet_bench_runs_on_cpu(tmp_path):
     assert r["global_batch"] == 2 * r["devices"]
 
 
+@pytest.mark.slow
+def test_llm_bench_runs_on_cpu(tmp_path):
+    """run_llm_bench (BASELINE config 5's pipeline: token store -> NGram
+    windows -> DataLoader -> llama AdamW step) executes end to end on CPU
+    with tiny shapes; echo>1 and the resident phase are exercised."""
+    from petastorm_tpu.benchmark.llm_bench import (run_llm_bench,
+                                                   write_token_store)
+    url = f"file://{tmp_path}/tok"
+    write_token_store(url, windows=16, window=16, vocab=128)
+    tiny = dict(vocab=128, dim=32, n_layers=2, n_heads=2, n_kv_heads=1,
+                hidden=64)
+    # batch must divide the data axis: the CPU conftest runs an 8-device
+    # virtual mesh, so the P("data") batch sharding is exercised for real
+    r = run_llm_bench(url, steps=2, batch_size=8, window=16,
+                      workers_count=2, echo=2, resident_steps=2,
+                      model_kwargs=tiny)
+    assert r["tokens_per_step"] == 128 and r["echo"] == 2
+    assert r["tokens_per_sec"] > 0
+    assert 0.0 <= r["input_stall_pct"] <= 100.0
+    assert np.isfinite(r["loss_first"]) and np.isfinite(r["loss_last"])
+    assert r["step_time_ms_resident"] > 0
+
+
 def test_peak_flops_lookup(monkeypatch):
     """Env var wins on TPUs only; known TPU kinds map to public bf16 peaks;
     non-TPU kinds never get a peak (the CPU fallback must not inherit the

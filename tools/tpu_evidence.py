@@ -197,6 +197,29 @@ print('BENCHJSON:' + json.dumps(out))
 """
 
 
+_LLM_PIPELINE_CHILD = """\
+import json, os, signal, sys
+# Store generation is pure-CPU; do it before arming the alarm (same
+# rationale as the imagenet child).
+from petastorm_tpu.benchmark.llm_bench import run_llm_bench, write_token_store
+store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'tokens512')
+url = 'file://' + store
+if not os.path.exists(os.path.join(store, '_common_metadata')):
+    write_token_store(url, windows=64, window=512)
+signal.alarm({alarm})
+out = {{}}
+# echo=1 is the honest single-host feed rate; echo=2 measures the data-
+# echoing feature in exactly the regime it exists for (reader slower
+# than the device step).
+for echo in (1, 2):
+    r = run_llm_bench(url, steps=20, batch_size=8, window=512,
+                      workers_count=8, pool_type='thread', echo=echo,
+                      resident_steps=8)
+    prefix = 'echo%d_' % echo
+    out.update({{prefix + k: v for k, v in r.items()}})
+print('BENCHJSON:' + json.dumps(out))
+"""
+
 _LLAMA_CHILD = """\
 import json, signal, sys, time
 signal.alarm({alarm})
@@ -381,11 +404,21 @@ def capture_llama(alarm_s: int = 600) -> dict | None:
     return _run_phase("llama_train", _LLAMA_CHILD, alarm_s)
 
 
+def capture_llm_pipeline(data_dir: str, alarm_s: int = 900) -> dict | None:
+    """BASELINE config 5 end-to-end: token store -> make_reader+NGram ->
+    DataLoader staging -> llama train step on the chip, echo=1 vs echo=2
+    (data echoing measured in its regime)."""
+    return _run_phase("llm_pipeline", _LLM_PIPELINE_CHILD, alarm_s,
+                      {"PT_BENCH_DATA_DIR": data_dir},
+                      pre_alarm_allowance_s=600)  # first-run 32k-row store
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--probe-only", action="store_true")
     ap.add_argument("--phases", default="imagenet,flash_attn",
-                    help="comma list from {imagenet,flash_attn,llama}")
+                    help="comma list from {imagenet,flash_attn,llama,"
+                         "llm_pipeline}")
     ap.add_argument("--data-dir",
                     default=os.environ.get("BENCH_DATA_DIR", "/tmp/pt_bench"))
     ap.add_argument("--probe-alarm", type=int, default=120)
@@ -417,6 +450,8 @@ def main(argv=None) -> int:
             ok = capture_flash_attn()
         elif phase == "llama":
             ok = capture_llama()
+        elif phase == "llm_pipeline":
+            ok = capture_llm_pipeline(args.data_dir)
         else:
             print(f"unknown phase {phase!r}", file=sys.stderr)
             ok = None

@@ -86,7 +86,8 @@ def main(argv=None) -> int:
     deadline = time.time() + args.max_hours * 3600
     # Phase completion is tracked per phase: a wedge between flash and
     # imagenet must not cause a later window to redo the banked phase.
-    done: dict[str, int] = {"flash_attn": 0, "imagenet": 0, "llama": 0}
+    done: dict[str, int] = {"flash_attn": 0, "imagenet": 0, "llama": 0,
+                            "llm_pipeline": 0}
     full_captures = 0
     probe_n = 0
 
@@ -124,7 +125,10 @@ def main(argv=None) -> int:
                     ("imagenet",
                      lambda: tpu_evidence.capture_imagenet(args.data_dir)),
                     ("llama",
-                     lambda: tpu_evidence.capture_llama())):
+                     lambda: tpu_evidence.capture_llama()),
+                    ("llm_pipeline",
+                     lambda: tpu_evidence.capture_llm_pipeline(
+                         args.data_dir))):
                 if done[phase] > full_captures:
                     continue  # banked this round already
                 result = fn()
