@@ -142,6 +142,8 @@ def pipelined_window(run_step, next_batch, steps: int, resident_steps: int,
     closure; ``next_batch()`` returns a staged batch. Returns
     ``(loss_first, loss_last, wait_s, total_wall_s, resident_s)``
     (``resident_s`` is None when ``resident_steps`` is 0)."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
     loss_first = hard_sync(warm_loss)  # warmup's loss; syncs pre-window
     wait_s = 0.0
     batch = None
@@ -184,9 +186,16 @@ def utilization_metrics(result: dict, flops_per_step, step_time_s: float,
             # wall - wait underestimates step time when device execution
             # overlaps a loader wait (see pipelined_window): physically
             # impossible rate = that regime was hit, not a measurement.
-            result["mfu_suspect"] = (
-                "achieved exceeds chip peak: loader-bound window, "
-                "wait/compute overlap; use the resident metrics")
+            # Drop the bogus pipelined numbers rather than carrying them;
+            # the resident metrics below remain valid, so the capture as
+            # a whole is still good evidence.
+            del result["mfu_pct"]
+            del result["achieved_tflops_per_chip"]
+            result["mfu_pipelined_dropped"] = (
+                "achieved exceeded chip peak: loader-bound window, "
+                "wait/compute overlap; "
+                + ("use the resident metrics" if resident_s is not None
+                   else "re-run with resident_steps>0 for valid MFU"))
     if resident_s is not None:
         r_achieved = flops_per_step / resident_s
         result["achieved_tflops_per_chip_resident"] = r_achieved / 1e12

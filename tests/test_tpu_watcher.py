@@ -62,6 +62,10 @@ def test_first_healthy_window_fires_cheapest_first_and_banks_partial(
     monkeypatch.setattr(ev, "capture_imagenet",
                         lambda d: (calls.append("imagenet"),
                                    next(imagenet_results))[1])
+    monkeypatch.setattr(ev, "capture_llama",
+                        lambda: (calls.append("llama"), {"ok": 1})[1])
+    monkeypatch.setattr(ev, "capture_llm_pipeline",
+                        lambda d: (calls.append("llm"), {"ok": 1})[1])
 
     rc = w.main(["--interval", "1", "--max-hours", "1",
                  "--max-captures", "1"])
@@ -69,11 +73,11 @@ def test_first_healthy_window_fires_cheapest_first_and_banks_partial(
     # cheapest-first in window 1; window 2 skips the banked flash
     assert calls == ["probe",                       # wedged
                      "probe", "flash", "imagenet",  # window 1: partial
-                     "probe", "imagenet"]           # window 2: completes
+                     "probe", "imagenet", "llama", "llm"]  # window 2
     statuses = [r["status"] for r in _probe_log(w)]
     assert statuses == ["wedged", "ok", "capture-ok", "capture-failed",
-                        "ok", "capture-ok", "suite-complete",
-                        "watcher-done"]
+                        "ok", "capture-ok", "capture-ok", "capture-ok",
+                        "suite-complete", "watcher-done"]
 
 
 def test_every_probe_logged_and_timeout_rc(watcher, monkeypatch):
