@@ -201,6 +201,16 @@ def utilization_metrics(result: dict, flops_per_step, step_time_s: float,
         result["achieved_tflops_per_chip_resident"] = r_achieved / 1e12
         if peak:
             result["mfu_pct_resident"] = 100.0 * r_achieved / peak
+            if r_achieved > peak:
+                # Same physical-plausibility bar as the pipelined window:
+                # a resident rate above chip peak means the sync lied
+                # (e.g. an async readback returning early), not that the
+                # chip did. Drop rather than carry impossible numbers.
+                del result["mfu_pct_resident"]
+                del result["achieved_tflops_per_chip_resident"]
+                result["mfu_resident_dropped"] = (
+                    "resident achieved exceeded chip peak: timing/sync "
+                    "artifact; no valid MFU for this run")
 
 
 def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,

@@ -47,7 +47,7 @@ def write_token_store(url: str, windows: int, window: int,
 def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
                   window: int = 512, workers_count: int = 8,
                   pool_type: str = "thread", echo: int = 1,
-                  resident_steps: int = 0,
+                  resident_steps: int = 0, dense: bool = True,
                   model_kwargs: dict | None = None) -> dict:
     """Token windows through the full reader stack into a real llama
     train step; returns ``{tokens_per_sec, input_stall_pct,
@@ -86,9 +86,12 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
 
     step = jax.jit(step_fn, donate_argnums=(0, 1))
 
+    # dense=True is the TPU-first readout (column-major window assembly in
+    # the worker, no per-row namedtuples); dense=False measures the
+    # reference-parity row path for comparison.
     ngram = NGram({o: ["ts", "token"] for o in range(window)},
                   delta_threshold=1, timestamp_field="ts",
-                  timestamp_overlap=False)
+                  timestamp_overlap=False, dense=dense)
     with make_reader(url, schema_fields=ngram, num_epochs=None,
                      shuffle_row_groups=True, seed=0,
                      reader_pool_type=pool_type,
@@ -120,6 +123,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         "step_time_ms": 1000.0 * step_time_s,
         "tokens_per_step": tokens_per_step,
         "echo": echo,
+        "dense": dense,
         "window": window,
         "devices": len(devices),
         "loss_first": loss_first,

@@ -709,6 +709,22 @@ class DataLoader(LoaderBase):
         tf_utils.py; a dense seq axis is the XLA-friendly layout).
         Heterogeneous offset fields flatten to ``"{name}/{offset}"`` keys of
         ``(batch, *field_shape)``."""
+        if getattr(self._ngram, "dense", False):
+            # Dense readers already emit {name: (ngram_len, *shape)} arrays
+            # (assembled column-major in the worker); one stack per field
+            # yields the same (batch, ngram_len, *shape) layout as below.
+            out = {}
+            for name in windows[0]:
+                arr = np.stack([w[name] for w in windows])
+                if arr.dtype == object:
+                    # Same contract as the row path's null check: nulls must
+                    # fail loudly here, not cryptically at device_put/jit.
+                    raise ValueError(
+                        f"Field {name!r} contains nulls or ragged values; "
+                        f"fill them with a TransformSpec before batching, "
+                        f"or exclude the field")
+                out[name] = arr
+            return out
         offsets = sorted(windows[0].keys())
         fieldsets = [tuple(windows[0][o]._fields) for o in offsets]
         schema = self._reader.schema

@@ -19,6 +19,8 @@ resolution (:195), ``get_schema_at_timestep`` (:215).
 from __future__ import annotations
 
 import decimal
+
+import numpy as np
 from typing import Dict, List, Optional, Sequence, Union
 
 
@@ -32,13 +34,21 @@ class NGram:
         rows of a window; windows containing a larger gap are dropped
     :param timestamp_field: the field (or its name) windows are ordered by
     :param timestamp_overlap: when False, yielded windows do not share rows
+    :param dense: opt-in TPU-first readout — samples become
+        ``{field_name: np.ndarray}`` with a leading ``(length,)`` window
+        axis instead of ``{offset: namedtuple}``. Requires every offset to
+        declare the same field set. When all window fields decode to plain
+        numeric columns the reader assembles windows column-major (no
+        per-row dicts/namedtuples at all), which is the fast path for
+        token-stream stores feeding LLM training.
     """
 
     def __init__(self,
                  fields: Dict[int, Sequence[Union[UnischemaField, str]]],
                  delta_threshold: Union[int, float, decimal.Decimal],
                  timestamp_field: Union[UnischemaField, str],
-                 timestamp_overlap: bool = True):
+                 timestamp_overlap: bool = True,
+                 dense: bool = False):
         if not isinstance(fields, dict) or not fields:
             raise ValueError("fields must be a non-empty dict of {offset: [fields]}")
         keys = sorted(fields.keys())
@@ -48,7 +58,10 @@ class NGram:
         self._delta_threshold = delta_threshold
         self._timestamp_field = timestamp_field
         self._timestamp_overlap = timestamp_overlap
+        self._dense = dense
         self._resolved: Optional[Dict[int, List[UnischemaField]]] = None
+        if dense:
+            self._validate_dense()
 
     @property
     def length(self) -> int:
@@ -71,6 +84,22 @@ class NGram:
     def timestamp_overlap(self) -> bool:
         return self._timestamp_overlap
 
+    @property
+    def dense(self) -> bool:
+        return self._dense
+
+    def _validate_dense(self) -> None:
+        """Dense windows stack one array per field over the window axis, so
+        every offset must read the same fields (regex specs are checked
+        again after :meth:`resolve_regex_field_names` expands them)."""
+        names = [tuple(sorted(f.name if isinstance(f, UnischemaField) else f
+                              for f in specs))
+                 for specs in self._fields.values()]
+        if any(n != names[0] for n in names):
+            raise ValueError(
+                "dense=True requires the same field set at every offset; "
+                f"got {dict(zip(sorted(self._fields), names))}")
+
     # -------------------------------------------------------------- schemas
     def resolve_regex_field_names(self, schema: Unischema) -> None:
         """Expand any string patterns in ``fields`` against ``schema``
@@ -91,6 +120,15 @@ class NGram:
             resolved[offset] = [f for f in out if not (f.name in seen or seen.add(f.name))]
         self._resolved = resolved
         self._fields = resolved
+        if self._dense:
+            self._validate_dense()
+            varlen = sorted({f.name for specs in resolved.values()
+                             for f in specs if None in (f.shape or ())})
+            if varlen:
+                raise ValueError(
+                    f"dense=True requires fixed-shape fields; {varlen} are "
+                    f"variable-length. Pad them at write time, exclude "
+                    f"them, or use dense=False with pad_variable_length_to")
 
     def get_field_names_at_timestep(self, timestep: int) -> List[str]:
         if timestep not in self._fields:
@@ -152,3 +190,75 @@ class NGram:
 
     def make_namedtuple(self, schema: Unischema, sample_by_offset: dict) -> dict:
         return sample_by_offset  # samples are already {offset: namedtuple}
+
+    # ------------------------------------------------------- dense assembly
+    def _window_starts(self, timestamps) -> List[int]:
+        """Accepted window start indices over timestamp-sorted rows, with
+        the exact acceptance walk of :meth:`form_ngram` (reject -> advance
+        by 1; accept -> advance by 1 or ``length``), but the per-window
+        delta check vectorized: a start is valid iff no consecutive delta
+        inside its window exceeds ``delta_threshold``."""
+        n = len(timestamps)
+        length = self.length
+        if n < length:
+            return []
+        ts = np.asarray(timestamps)
+        # bad[j] = gap between row j and j+1 too large; window starting at i
+        # is valid iff bad[i : i+length-1] has no True -> prefix-sum check.
+        if length == 1:
+            valid = np.ones(n, bool)
+        else:
+            thr = self._delta_threshold
+            if isinstance(thr, decimal.Decimal):
+                # numpy can't compare numeric arrays against Decimal; the
+                # vectorized path only sees numeric ts columns, where
+                # float64 is exact for any realistic timestamp delta.
+                thr = float(thr)
+            bad = (np.diff(ts) > thr)
+            csum = np.concatenate(([0], np.cumsum(bad)))
+            valid = csum[length - 1:] == csum[:n - length + 1]
+        starts = []
+        i = 0
+        while i + length <= n:
+            if valid[i]:
+                starts.append(i)
+                i += 1 if self._timestamp_overlap else length
+            else:
+                i += 1
+        return starts
+
+    def form_ngram_dense(self, cols: Dict[str, "object"],
+                         order) -> List[Dict[str, "object"]]:
+        """Column-major window assembly for ``dense=True``: ``cols`` maps
+        field name -> full per-row-group numpy column, ``order`` is the
+        row permutation that timestamp-sorts (and drop-partition-selects)
+        it. Returns ``[{name: (length, *shape) array}, ...]`` without ever
+        materializing per-row dicts or namedtuples — the TPU-first readout
+        for token-stream stores (cf. reference ngram.py:225 form_ngram,
+        which is row-oriented by design).
+        """
+        names = self.get_field_names_at_timestep(min(self._fields))
+        ts_sorted = np.asarray(cols[self.timestamp_field_name])[order]
+        starts = self._window_starts(ts_sorted)
+        if not starts:
+            return []
+        length = self.length
+        sorted_cols = {name: np.asarray(cols[name])[order] for name in names}
+        # .copy() detaches each window from the row-group-sized buffer so a
+        # retained window never pins the whole group (same rationale as the
+        # image batch decoder's per-row allocations).
+        return [{name: col[i:i + length].copy()
+                 for name, col in sorted_cols.items()}
+                for i in starts]
+
+    def densify_windows(self, windows: List[Dict[int, object]]
+                        ) -> List[Dict[str, "object"]]:
+        """Convert :meth:`form_ngram` output to the dense representation —
+        the correctness fallback when a field needs per-cell codec decode
+        (images, strings) or a TransformSpec runs per row."""
+        offsets = sorted(self._fields)
+        names = self.get_field_names_at_timestep(offsets[0])
+        return [{name: np.stack([np.asarray(getattr(w[off], name))
+                                 for off in offsets])
+                 for name in names}
+                for w in windows]

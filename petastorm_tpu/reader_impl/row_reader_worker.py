@@ -235,6 +235,18 @@ class RowReaderWorker(WorkerBase):
         else:
             data, indices = self._maybe_cached(rowgroup, needed,
                                                shuffle_row_drop_partition, rng)
+        if (ngram is not None and getattr(ngram, "dense", False)
+                and (transform_spec is None or transform_spec.func is None)
+                and self._dense_ngram_vectorizable(data)):
+            # TPU-first fast path: windows assembled column-major straight
+            # from the numeric Arrow columns — no per-row dicts, no
+            # namedtuples, no per-cell codec calls (ScalarCodec.decode is a
+            # dtype cast, applied per column below).
+            result = self._dense_ngram_windows(ngram, data, indices)
+            if result:
+                self.publish_func(result)
+            return
+
         # Column-major decode on both paths, so image columns keep the
         # native batch decoder under predicates too.
         decoded = self._decode_columns_to_rows(data, indices)
@@ -246,10 +258,42 @@ class RowReaderWorker(WorkerBase):
             ts = ngram.timestamp_field_name
             decoded.sort(key=lambda r: r[ts])
             result = ngram.form_ngram(decoded, view_schema)
+            if getattr(ngram, "dense", False):
+                # Correctness fallback (codec-decoded / transformed rows):
+                # same dense sample type, assembled from the row windows.
+                result = ngram.densify_windows(result)
         else:
             result = decoded
         if result:
             self.publish_func(result)
+
+    def _dense_ngram_vectorizable(self, data: dict) -> bool:
+        """True when every needed field's column is a plain numeric numpy
+        array whose decode is a dtype cast — i.e. scalar fields on the
+        zero-copy read path. Anything else (images, strings, object
+        columns, disk-cache pylist payloads) takes the row fallback."""
+        for name, field, codec in self._decode_schema.decode_plan:
+            col = data.get(name)
+            if not (isinstance(col, np.ndarray) and col.dtype.kind in "biuf"
+                    and field.shape == ()
+                    and type(codec).__name__ == "ScalarCodec"):
+                return False
+        return True
+
+    def _dense_ngram_windows(self, ngram, data: dict, indices):
+        """Column-major dense window assembly: select/permute rows, cast
+        each column to its field dtype (the vectorized ScalarCodec.decode),
+        timestamp-sort, and hand columns to
+        :meth:`petastorm_tpu.ngram.NGram.form_ngram_dense`."""
+        idx = np.asarray(indices, dtype=np.intp)
+        cols = {}
+        for name, field, codec in self._decode_schema.decode_plan:
+            col = data[name]
+            dt = np.dtype(field.numpy_dtype)
+            cols[name] = col if col.dtype == dt else col.astype(dt)
+        ts = np.asarray(cols[ngram.timestamp_field_name])
+        order = idx[np.argsort(ts[idx], kind="stable")]
+        return ngram.form_ngram_dense(cols, order)
 
     # ------------------------------------------------------------ load paths
     def _cache_key(self, rowgroup, columns) -> str:

@@ -92,6 +92,28 @@ def _make_ngram_dataset(reader):
     flattens/unflattens through TF1 plumbing; tf.data's structure support
     handles the nested form directly)."""
     tf = _tf()
+    if getattr(reader.ngram, "dense", False):
+        # Dense NGram samples are already {name: (length, *shape) array};
+        # expose them as a flat dict dataset with the window axis leading.
+        length = reader.ngram.length
+        view = reader.ngram.get_schema_at_timestep(
+            reader.schema, min(reader.ngram.fields))
+        signature = {
+            name: tf.TensorSpec(
+                shape=[length] + [None if d is None else d for d in f.shape],
+                dtype=_tf_dtype_for(f.numpy_dtype))
+            for name, f in view.fields.items()}
+
+        def dense_generator():
+            if reader.last_row_consumed:
+                reader.reset()
+            for sample in reader:
+                yield {name: _promote(_sanitize_value(sample[name]),
+                                      view.fields[name].numpy_dtype)
+                       for name in signature}
+
+        return tf.data.Dataset.from_generator(dense_generator,
+                                              output_signature=signature)
     views = _ngram_views(reader)
     signature = {}
     for off, view in views.items():
@@ -167,6 +189,11 @@ def tf_tensors(reader, shuffling_queue_capacity: int = 0, min_after_dequeue: int
     tf = _tf()
     schema = reader.schema
     if getattr(reader, "ngram", None) is not None:
+        if getattr(reader.ngram, "dense", False):
+            raise TypeError(
+                "tf_tensors (TF1 graph mode) does not support dense NGram "
+                "readers; use make_petastorm_dataset, which yields "
+                "{name: (length, ...)} tensors directly")
         views = _ngram_views(reader)
         flat = [(off, name, f) for off, view in views.items()
                 for name, f in view.fields.items()]

@@ -1,0 +1,263 @@
+"""Dense NGram readout (``NGram(dense=True)``) — the TPU-first window
+path: samples are ``{field: (length, *shape) ndarray}`` assembled
+column-major in the worker when every window field is a plain scalar
+column (no per-row dicts/namedtuples), with a row-path fallback for
+codec/transform fields that must produce identical values.
+
+No reference counterpart (reference ngram.py:225 form_ngram is
+row-oriented by design); parity is pinned against OUR standard path.
+"""
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import materialize_dataset_local
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TokSchema = Unischema("TokSchema", [
+    UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField("token", np.int32, (), ScalarCodec(np.int32), False),
+])
+
+
+def _write_tokens(tmp_path, rows=40, rows_per_group=10, gap_at=None):
+    url = f"file://{tmp_path}/toks"
+    rng = np.random.default_rng(7)
+    with materialize_dataset_local(url, TokSchema,
+                                   rows_per_row_group=rows_per_group) as w:
+        for i in range(rows):
+            ts = i + 5 if (gap_at is not None and i >= gap_at) else i
+            w.write_row({"ts": np.int64(ts),
+                         "token": np.int32(rng.integers(0, 1000))})
+    return url
+
+
+def _dense_windows(url, ngram, **reader_kw):
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", **reader_kw) as reader:
+        return list(reader)
+
+
+def test_dense_matches_row_path_values(tmp_path):
+    """The vectorized column-major assembly must yield exactly the windows
+    the standard {offset: namedtuple} path yields, densified."""
+    url = _write_tokens(tmp_path)
+    mk = lambda dense: NGram({o: ["ts", "token"] for o in range(4)},
+                             delta_threshold=1, timestamp_field="ts",
+                             timestamp_overlap=True, dense=dense)
+    dense = _dense_windows(url, mk(True))
+    rows = _dense_windows(url, mk(False))
+    assert len(dense) == len(rows) > 0
+    for d, r in zip(dense, rows):
+        assert set(d) == {"ts", "token"}
+        assert d["ts"].shape == (4,) and d["ts"].dtype == np.int64
+        assert d["token"].dtype == np.int32
+        np.testing.assert_array_equal(
+            d["ts"], [r[o].ts for o in range(4)])
+        np.testing.assert_array_equal(
+            d["token"], [r[o].token for o in range(4)])
+
+
+def test_dense_delta_threshold_and_nonoverlap(tmp_path):
+    url = _write_tokens(tmp_path, rows=20, rows_per_group=20, gap_at=10)
+    ngram = NGram({o: ["ts"] for o in range(3)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False, dense=True)
+    windows = _dense_windows(url, ngram)
+    # ts 0..9 then 15..24: non-overlapping length-3 windows, none crossing
+    # the gap: [0,1,2],[3,4,5],[6,7,8] then [15,16,17],[18,19,20],[21,22,23]
+    got = [w["ts"].tolist() for w in windows]
+    assert got == [[0, 1, 2], [3, 4, 5], [6, 7, 8],
+                   [15, 16, 17], [18, 19, 20], [21, 22, 23]]
+
+
+def test_dense_requires_homogeneous_offsets():
+    with pytest.raises(ValueError, match="same field set"):
+        NGram({0: ["ts", "a"], 1: ["ts"]}, delta_threshold=1,
+              timestamp_field="ts", dense=True)
+
+
+def test_dense_fallback_with_transform_matches_vectorized_shape(tmp_path):
+    """A per-row TransformSpec forces the row fallback; samples must keep
+    the dense {name: (length,)} contract, with the transform applied."""
+    url = _write_tokens(tmp_path, rows=12, rows_per_group=12)
+    ngram = NGram({o: ["ts", "token"] for o in range(3)}, delta_threshold=1,
+                  timestamp_field="ts", dense=True)
+
+    def double(row):
+        row["token"] = np.int32(row["token"] * 2)
+        return row
+
+    plain = _dense_windows(url, ngram)
+    doubled = _dense_windows(url, ngram,
+                             transform_spec=TransformSpec(double))
+    assert len(plain) == len(doubled) > 0
+    for p, d in zip(plain, doubled):
+        np.testing.assert_array_equal(p["token"] * 2, d["token"])
+        assert d["token"].shape == (3,)
+
+
+def test_dense_fallback_with_ndarray_field(tmp_path):
+    """Non-scalar window fields (codec decode) take the row fallback and
+    stack to (length, *field_shape)."""
+    schema = Unischema("VecSchema", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("vec", np.float32, (2,), NdarrayCodec(), False),
+    ])
+    url = f"file://{tmp_path}/vecs"
+    rng = np.random.default_rng(1)
+    with materialize_dataset_local(url, schema, rows_per_row_group=8) as w:
+        for i in range(16):
+            w.write_row({"ts": np.int64(i),
+                         "vec": rng.normal(size=2).astype(np.float32)})
+    ngram = NGram({0: ["ts", "vec"], 1: ["ts", "vec"]}, delta_threshold=1,
+                  timestamp_field="ts", dense=True)
+    windows = _dense_windows(url, ngram)
+    assert windows and windows[0]["vec"].shape == (2, 2)
+    assert windows[0]["vec"].dtype == np.float32
+
+
+def test_dense_loader_collates_batch_seq_axes(tmp_path):
+    from petastorm_tpu.jax import DataLoader
+
+    url = _write_tokens(tmp_path, rows=40, rows_per_group=10)
+    ngram = NGram({o: ["ts", "token"] for o in range(10)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False, dense=True)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        loader = DataLoader(reader, batch_size=4)
+        batch = next(iter(loader))
+    assert batch["token"].shape == (4, 10)
+    assert batch["ts"].shape == (4, 10)
+
+
+def test_dense_loader_matches_row_loader_batches(tmp_path):
+    """End-to-end parity of the two readouts THROUGH the loader: identical
+    (batch, ngram_len) arrays."""
+    from petastorm_tpu.jax import DataLoader
+
+    url = _write_tokens(tmp_path, rows=30, rows_per_group=10)
+
+    def batches(dense):
+        ngram = NGram({o: ["ts", "token"] for o in range(5)},
+                      delta_threshold=1, timestamp_field="ts",
+                      timestamp_overlap=False, dense=dense)
+        with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                         reader_pool_type="dummy") as reader:
+            loader = DataLoader(reader, batch_size=2)
+            return [{k: np.asarray(v) for k, v in b.items()}
+                    for b in loader]
+
+    d, r = batches(True), batches(False)
+    assert len(d) == len(r) > 0
+    for bd, br in zip(d, r):
+        np.testing.assert_array_equal(bd["token"], br["token"])
+        np.testing.assert_array_equal(bd["ts"], br["ts"])
+
+
+def test_dense_with_predicate_vectorized(tmp_path):
+    """Predicates thin rows before window assembly on both paths; the
+    vectorized path must see the surviving rows only."""
+    from petastorm_tpu.predicates import in_lambda
+
+    url = _write_tokens(tmp_path, rows=20, rows_per_group=20)
+    ngram = NGram({o: ["ts"] for o in range(2)}, delta_threshold=2,
+                  timestamp_field="ts", timestamp_overlap=False, dense=True)
+    pred = in_lambda(["ts"], lambda row: row["ts"] % 2 == 0)  # keep even ts
+    windows = _dense_windows(url, ngram, predicate=pred)
+    got = [w["ts"].tolist() for w in windows]
+    # surviving ts 0,2,4,...,18 -> deltas of 2 pass threshold 2
+    assert got == [[0, 2], [4, 6], [8, 10], [12, 14], [16, 18]]
+
+
+def test_dense_tf_dataset(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    url = _write_tokens(tmp_path, rows=12, rows_per_group=12)
+    ngram = NGram({o: ["ts", "token"] for o in range(3)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False, dense=True)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader)
+        got = [s for s in ds.as_numpy_iterator()]
+    assert len(got) == 4
+    assert got[0]["token"].shape == (3,)
+    np.testing.assert_array_equal(got[0]["ts"], [0, 1, 2])
+
+
+def test_dense_tf_tensors_rejected(tmp_path):
+    pytest.importorskip("tensorflow")
+    from petastorm_tpu.tf_utils import tf_tensors
+
+    url = _write_tokens(tmp_path, rows=6, rows_per_group=6)
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1,
+                  timestamp_field="ts", dense=True)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        with pytest.raises(TypeError, match="dense NGram"):
+            tf_tensors(reader)
+
+
+def test_window_starts_matches_pass_threshold_walk():
+    """The vectorized start selection must replicate form_ngram's
+    acceptance walk on arbitrary gap patterns, both overlap modes."""
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        ts = np.cumsum(rng.integers(1, 4, size=30))
+        for overlap in (True, False):
+            ngram = NGram({o: ["ts"] for o in range(3)}, delta_threshold=2,
+                          timestamp_field="ts", timestamp_overlap=overlap,
+                          dense=True)
+            starts = ngram._window_starts(ts)
+            # replicate the reference walk with the scalar threshold check
+            expect, i = [], 0
+            while i + 3 <= len(ts):
+                if ngram._pass_threshold(list(ts[i:i + 3])):
+                    expect.append(i)
+                    i += 1 if overlap else 3
+                else:
+                    i += 1
+            assert starts == expect
+
+
+def test_dense_rejects_variable_length_fields(tmp_path):
+    schema = Unischema("VarSchema", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("seq", np.float32, (None,), NdarrayCodec(), False),
+    ])
+    url = f"file://{tmp_path}/var"
+    with materialize_dataset_local(url, schema, rows_per_row_group=4) as w:
+        for i in range(8):
+            w.write_row({"ts": np.int64(i),
+                         "seq": np.zeros(i + 1, np.float32)})
+    ngram = NGram({0: ["ts", "seq"], 1: ["ts", "seq"]}, delta_threshold=1,
+                  timestamp_field="ts", dense=True)
+    with pytest.raises(ValueError, match="fixed-shape"):
+        make_reader(url, schema_fields=ngram, reader_pool_type="dummy")
+
+
+def test_dense_nulls_fail_loudly_at_collate(tmp_path):
+    """Nullable window fields must hit the row path's explicit null error,
+    not an object-dtype array at device_put."""
+    from petastorm_tpu.jax import DataLoader
+
+    schema = Unischema("NullSchema", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("tok", np.int32, (), ScalarCodec(np.int32), True),
+    ])
+    url = f"file://{tmp_path}/nulls"
+    with materialize_dataset_local(url, schema, rows_per_row_group=4) as w:
+        for i in range(8):
+            w.write_row({"ts": np.int64(i),
+                         "tok": None if i == 2 else np.int32(i)})
+    ngram = NGram({0: ["ts", "tok"], 1: ["ts", "tok"]}, delta_threshold=1,
+                  timestamp_field="ts", dense=True)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        loader = DataLoader(reader, batch_size=2)
+        with pytest.raises(ValueError, match="nulls"):
+            for _ in loader:
+                pass

@@ -134,16 +134,32 @@ def test_utilization_metrics_drops_impossible_pipelined_mfu(monkeypatch):
 
     monkeypatch.setenv("PETASTORM_TPU_PEAK_FLOPS", "1e12")
     out = {}
-    # 1e13 flops in 1 ms -> 1e16 flops/s, 10000x the declared 1e12 peak.
-    utilization_metrics(out, 1e13, 1e-3, resident_s=0.1,
+    # 1e13 flops in 1 ms -> 1e16 flops/s, 10000x the declared 1e12 peak;
+    # resident: 1e13 / 20s = 5e11 flops/s = a plausible 50% MFU.
+    utilization_metrics(out, 1e13, 1e-3, resident_s=20.0,
                         device_kind="TPU v5 lite")
     assert "mfu_pct" not in out
     assert "achieved_tflops_per_chip" not in out
     assert "mfu_pipelined_dropped" in out
     assert "suspect" not in " ".join(out)  # no demotion-triggering key
-    # resident path: 1e13 / 0.1s = 1e14 flops/s = 10% of nothing bogus
-    assert out["mfu_pct_resident"] == pytest.approx(1e4)
-    assert out["achieved_tflops_per_chip_resident"] == pytest.approx(100.0)
+    assert out["mfu_pct_resident"] == pytest.approx(50.0)
+    assert out["achieved_tflops_per_chip_resident"] == pytest.approx(0.5)
+
+
+def test_utilization_metrics_drops_impossible_resident_mfu(monkeypatch):
+    """The resident window gets the same physical-plausibility bar: a rate
+    above chip peak means the sync lied, and no MFU is carried at all."""
+    from petastorm_tpu.benchmark.imagenet_bench import utilization_metrics
+
+    monkeypatch.setenv("PETASTORM_TPU_PEAK_FLOPS", "1e12")
+    out = {}
+    # pipelined plausible (50%), resident impossible (1e13/1e-3 = 1e16/s)
+    utilization_metrics(out, 1e13, 20.0, resident_s=1e-3,
+                        device_kind="TPU v5 lite")
+    assert out["mfu_pct"] == pytest.approx(50.0)
+    assert "mfu_pct_resident" not in out
+    assert "achieved_tflops_per_chip_resident" not in out
+    assert "mfu_resident_dropped" in out
 
 
 def test_utilization_metrics_plausible_rate_keeps_pipelined_mfu(monkeypatch):
