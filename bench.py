@@ -349,6 +349,49 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"stall sweep failed: {e!r}", file=sys.stderr)
 
+    # ---- 4c. dense NGram readout vs the reference-parity row path on the
+    # LLM token store (512-token windows, one per row group). The dense
+    # path assembles windows column-major in the worker (ngram.py
+    # form_ngram_dense) — this phase records the measured speedup that
+    # makes the on-chip LLM pipeline feedable (see BENCH_TPU_EVIDENCE
+    # llm_pipeline rowpath_* vs echo1_* for the same comparison on chip).
+    ngram_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.benchmark.llm_bench import write_token_store\n"
+        "from petastorm_tpu.ngram import NGram\n"
+        "from petastorm_tpu.reader import make_reader\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'tokens512')\n"
+        "url = 'file://' + store\n"
+        "if not os.path.exists(os.path.join(store, '_common_metadata')):\n"
+        "    write_token_store(url, windows=64, window=512)\n"
+        "def measure(dense, n=128):\n"
+        "    ngram = NGram({o: ['ts', 'token'] for o in range(512)},\n"
+        "                  delta_threshold=1, timestamp_field='ts',\n"
+        "                  timestamp_overlap=False, dense=dense)\n"
+        "    with make_reader(url, schema_fields=ngram, num_epochs=None,\n"
+        "                     shuffle_row_groups=True, seed=0,\n"
+        "                     reader_pool_type='thread',\n"
+        "                     workers_count=4) as r:\n"
+        "        it = iter(r)\n"
+        "        for _ in range(16):\n"
+        "            next(it)\n"
+        "        t0 = time.perf_counter()\n"
+        "        for _ in range(n):\n"
+        "            next(it)\n"
+        "        return n / (time.perf_counter() - t0)\n"
+        "row = measure(False)\n"
+        "dense = measure(True)\n"
+        "print('BENCHJSON:' + json.dumps({\n"
+        "    'ngram_row_windows_per_sec': round(row, 1),\n"
+        "    'ngram_dense_windows_per_sec': round(dense, 1),\n"
+        "    'ngram_dense_speedup': round(dense / row, 2)}))\n")
+    try:
+        out.update(_cpu_subprocess(ngram_child, data_dir, timeout_s=1200.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"ngram dense phase failed: {e!r}", file=sys.stderr)
+
     # ---- assemble the line ---------------------------------------------
     out.update({
         "metric": "hello_world reader throughput",

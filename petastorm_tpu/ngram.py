@@ -93,8 +93,8 @@ class NGram:
         every offset must read the same fields (regex specs are checked
         again after :meth:`resolve_regex_field_names` expands them)."""
         names = [tuple(sorted(f.name if isinstance(f, UnischemaField) else f
-                              for f in specs))
-                 for specs in self._fields.values()]
+                              for f in self._fields[k]))
+                 for k in sorted(self._fields)]
         if any(n != names[0] for n in names):
             raise ValueError(
                 "dense=True requires the same field set at every offset; "

@@ -216,22 +216,24 @@ out = {{}}
 # echo=1 is the honest single-host feed rate; echo=2 measures the data-
 # echoing feature in exactly the regime it exists for (reader slower
 # than the device step).
-for echo in (1, 2):
-    # Each echo config guarded separately: a tunnel flake (or the alarm)
-    # during echo=2 must not discard the echo=1 measurements already
-    # taken in this scarce healthy window (same convention as the flash
-    # child's per-seq guards).
+configs = [('echo1_', dict(echo=1)),            # dense readout (default)
+           ('echo2_', dict(echo=2)),            # data echoing, its regime
+           ('rowpath_', dict(echo=1, dense=False))]  # reference-parity row
+for prefix, cfg in configs:
+    # Each config guarded separately: a tunnel flake (or the alarm) in a
+    # later run must not discard measurements already taken in this
+    # scarce healthy window (same convention as the flash child's
+    # per-seq guards).
     try:
         r = run_llm_bench(url, steps=20, batch_size=8, window=512,
-                          workers_count=8, pool_type='thread', echo=echo,
-                          resident_steps=8)
+                          workers_count=8, pool_type='thread',
+                          resident_steps=8, **cfg)
     except TimeoutError:
-        out['echo%d_error' % echo] = 'TimeoutError: alarm'
+        out[prefix + 'error'] = 'TimeoutError: alarm'
         break  # flush immediately; no alarm budget left for more runs
     except Exception as e:
-        out['echo%d_error' % echo] = type(e).__name__ + ': ' + str(e)[:120]
+        out[prefix + 'error'] = type(e).__name__ + ': ' + str(e)[:120]
         continue
-    prefix = 'echo%d_' % echo
     out.update({{prefix + k: v for k, v in r.items()}})
 print('BENCHJSON:' + json.dumps(out))
 # A payload of nothing but error keys is not evidence: exit nonzero so
