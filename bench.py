@@ -436,13 +436,16 @@ def main():
             imagenet = _imagenet_cpu_fallback(data_dir)
         except Exception as e2:  # noqa: BLE001 - partial beats nothing
             out["imagenet_error"] = repr(e2)[:300]
-    if imagenet is not None:
+    # Defensive .get: the capture child exits 0 only when the primary
+    # (unprefixed) metrics exist, but a KeyError here must never cost the
+    # round JSON its other hours of measurements.
+    if imagenet is not None and "samples_per_sec_per_chip" in imagenet:
         out.update({
             "imagenet_samples_per_sec": round(imagenet["samples_per_sec_per_chip"], 2),
-            "imagenet_input_stall_pct": round(imagenet["input_stall_pct"], 2),
-            "imagenet_devices": imagenet["devices"],
-            "imagenet_global_batch": imagenet["global_batch"],
-            "imagenet_step_time_ms": round(imagenet["step_time_ms"], 2),
+            "imagenet_input_stall_pct": round(imagenet.get("input_stall_pct", -1.0), 2),
+            "imagenet_devices": imagenet.get("devices"),
+            "imagenet_global_batch": imagenet.get("global_batch"),
+            "imagenet_step_time_ms": round(imagenet.get("step_time_ms", -1.0), 2),
         })
         for key in ("model_flops_per_step_per_chip", "achieved_tflops_per_chip",
                     "mfu_pct", "device_kind", "peak_flops_source"):

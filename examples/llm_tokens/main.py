@@ -54,9 +54,14 @@ def train(url: str, steps: int = 40, batch_size: int = 8, window: int = 4,
     opt_state = init_opt(params)
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
-    ngram = NGram({i: ["tokens"] if i else ["tokens", "seq"] for i in range(window)},
+    # dense=True: each sample arrives as {"tokens": (window, CHUNK) array}
+    # instead of {offset: namedtuple} — one reshape away from a training
+    # sequence. (On scalar token stores — one token per row — dense also
+    # unlocks the fully vectorized column-major assembly; see
+    # petastorm_tpu/benchmark/llm_bench.py and docs/performance.md.)
+    ngram = NGram({i: ["tokens"] for i in range(window)},
                   delta_threshold=1, timestamp_field="seq",
-                  timestamp_overlap=True)
+                  timestamp_overlap=True, dense=True)
 
     def batches():
         while True:
@@ -65,9 +70,7 @@ def train(url: str, steps: int = 40, batch_size: int = 8, window: int = 4,
                              workers_count=2) as reader:
                 buf = []
                 for win in reader:
-                    seq = np.concatenate([np.asarray(win[i].tokens)
-                                          for i in range(window)])
-                    buf.append(seq)
+                    buf.append(win["tokens"].reshape(-1))  # (window*CHUNK,)
                     if len(buf) == batch_size:
                         yield {"tokens": jnp.asarray(np.stack(buf), jnp.int32)}
                         buf = []

@@ -223,7 +223,8 @@ def utilization_metrics(result: dict, flops_per_step, step_time_s: float,
 def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
                        workers_count: int = 4, pool_type: str = "thread",
                        classes: int = 100, prefetch: int = 2,
-                       remat: bool = False, resident_steps: int = 0) -> dict:
+                       remat: bool = False, resident_steps: int = 0,
+                       echo: int = 1) -> dict:
     """One DP training run over all local devices; returns
     ``{samples_per_sec, samples_per_sec_per_chip, input_stall_pct,
     step_time_ms, model_flops_per_step_per_chip, achieved_tflops_per_chip
@@ -278,7 +279,7 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
                      workers_count=workers_count) as reader:
         loader = DataLoader(reader, batch_size=batch_size,
                             sharding=batch_sharding, prefetch=prefetch,
-                            dtype_policy=DTypePolicy())
+                            dtype_policy=DTypePolicy(), echo=echo)
         it = iter(loader)
         batch = next(it)
         # AOT-compile once: the compiled object both runs the loop and
@@ -304,6 +305,7 @@ def run_imagenet_bench(url: str, steps: int = 30, per_device_batch: int = 32,
         "input_stall_pct": 100.0 * wait_s / total_wall,
         "devices": len(devices),
         "global_batch": batch_size,
+        "echo": echo,
         "loss_first": loss_first,
         "loss_last": loss_last,
         "step_time_ms": 1000.0 * step_time_s,

@@ -261,3 +261,21 @@ def test_dense_nulls_fail_loudly_at_collate(tmp_path):
         with pytest.raises(ValueError, match="nulls"):
             for _ in loader:
                 pass
+
+
+def test_dense_through_torch_loader(tmp_path):
+    """The torch adapter rides the JAX loader's collate, so dense windows
+    must arrive as (batch, length) torch tensors."""
+    torch = pytest.importorskip("torch")
+    from petastorm_tpu.pytorch import DataLoader as TorchDataLoader
+
+    url = _write_tokens(tmp_path, rows=20, rows_per_group=10)
+    ngram = NGram({o: ["ts", "token"] for o in range(5)}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False, dense=True)
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False,
+                     reader_pool_type="dummy", num_epochs=1) as reader:
+        batches = list(TorchDataLoader(reader, batch_size=2))
+    assert batches
+    assert isinstance(batches[0]["token"], torch.Tensor)
+    assert tuple(batches[0]["token"].shape) == (2, 5)
+    assert batches[0]["ts"].dtype == torch.int64

@@ -234,19 +234,23 @@ def test_scalar_bench_generate_and_measure(tmp_path):
 
 
 @pytest.mark.slow
-def test_imagenet_bench_runs_on_cpu(tmp_path):
+@pytest.mark.parametrize("echo", [1, 2])
+def test_imagenet_bench_runs_on_cpu(tmp_path, echo):
     """run_imagenet_bench (the BENCH artifact's target workload) executes
-    end to end on CPU with a small image size and reports stall+throughput."""
+    end to end on CPU with a small image size and reports stall+throughput
+    — at the default echo=1 (every production caller's honest feed rate)
+    and with image-regime data echoing wired through."""
     from petastorm_tpu.benchmark.imagenet_bench import (run_imagenet_bench,
                                                         write_synthetic_imagenet)
     url = f"file://{tmp_path}/imgnet48"
     write_synthetic_imagenet(url, rows=64, classes=4, rows_per_row_group=32,
                              image_size=48)
     r = run_imagenet_bench(url, steps=3, per_device_batch=2, workers_count=2,
-                           pool_type="thread")
+                           pool_type="thread", echo=echo)
     assert r["samples_per_sec"] > 0
     assert 0.0 <= r["input_stall_pct"] <= 100.0
     assert r["global_batch"] == 2 * r["devices"]
+    assert r["echo"] == echo
 
 
 @pytest.mark.slow

@@ -56,7 +56,7 @@ _PROBE_CHILD = (
 )
 
 _IMAGENET_CHILD = """\
-import json, os, signal, sys
+import json, os, signal, sys, time
 # Dataset generation is pure-CPU (no jax import in these modules) and can
 # take minutes on the 1-core host: do it BEFORE arming the alarm, so the
 # scarce healthy-tunnel window is spent on the chip and a slow datagen
@@ -67,11 +67,36 @@ store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'imagenet')
 url = 'file://' + store
 if not os.path.exists(os.path.join(store, '_common_metadata')):
     write_synthetic_imagenet(url, rows=2048)
+# SIGALRM keeps its DEFAULT action (kill): the alarm exists to kill a
+# child wedged inside an uninterruptible PJRT C call, where a Python
+# handler would never run (see probe()); the per-config try/except
+# below covers the Python-level failure modes without weakening that.
 signal.alarm({alarm})
-r = run_imagenet_bench(url, steps=30, per_device_batch=128,
-                       workers_count=8, pool_type='thread',
-                       resident_steps=10)
-print('BENCHJSON:' + json.dumps(r))
+out = {{}}
+# A slow-but-healthy host must not ride into the alarm kill and lose
+# banked configs: stop starting new configs at 70% of the budget and
+# flush what's measured. (The alarm stays the hard backstop for wedges.)
+deadline = time.monotonic() + {alarm} * 0.7
+# echo=1 is the honest feed rate (unprefixed keys — bench.py's
+# imagenet_* fields depend on them); echo=2 banks the image-regime
+# data-echoing comparison (the jpeg-decode-bound host is exactly the
+# starved regime the feature exists for — cf. docs/performance.md).
+for prefix, echo in (('', 1), ('echo2_', 2)):
+    if time.monotonic() > deadline:
+        out[prefix + 'error'] = 'window budget exhausted before this config'
+        break
+    try:
+        r = run_imagenet_bench(url, steps=30, per_device_batch=128,
+                               workers_count=8, pool_type='thread',
+                               resident_steps=10, echo=echo)
+    except Exception as e:
+        out[prefix + 'error'] = type(e).__name__ + ': ' + str(e)[:120]
+        continue
+    out.update({{prefix + k: v for k, v in r.items()}})
+print('BENCHJSON:' + json.dumps(out))
+# The primary (echo=1, unprefixed) metrics are the evidence contract;
+# an echo2-only payload must read as skipped, not ok.
+sys.exit(0 if 'samples_per_sec' in out else 1)
 """
 
 _FLASH_CHILD = """\
@@ -198,7 +223,7 @@ print('BENCHJSON:' + json.dumps(out))
 
 
 _LLM_PIPELINE_CHILD = """\
-import json, os, signal, sys
+import json, os, signal, sys, time
 # Store generation is pure-CPU; do it before arming the alarm (same
 # rationale as the imagenet child).
 from petastorm_tpu.benchmark.llm_bench import run_llm_bench, write_token_store
@@ -206,12 +231,12 @@ store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'tokens512')
 url = 'file://' + store
 if not os.path.exists(os.path.join(store, '_common_metadata')):
     write_token_store(url, windows=64, window=512)
-# Raise instead of the default SIGALRM kill so a timeout mid-suite still
-# reaches the BENCHJSON flush with whatever was measured before it.
-def _alarm(*_):
-    raise TimeoutError('alarm')
-signal.signal(signal.SIGALRM, _alarm)
+# SIGALRM keeps its DEFAULT action (kill): it exists to kill a child
+# wedged inside an uninterruptible PJRT C call, where a Python handler
+# would never run (see probe()). A slow-but-healthy host instead stops
+# starting new configs at 70% of the budget so banked configs flush.
 signal.alarm({alarm})
+deadline = time.monotonic() + {alarm} * 0.7
 out = {{}}
 # echo=1 is the honest single-host feed rate; echo=2 measures the data-
 # echoing feature in exactly the regime it exists for (reader slower
@@ -220,17 +245,16 @@ configs = [('echo1_', dict(echo=1)),            # dense readout (default)
            ('echo2_', dict(echo=2)),            # data echoing, its regime
            ('rowpath_', dict(echo=1, dense=False))]  # reference-parity row
 for prefix, cfg in configs:
-    # Each config guarded separately: a tunnel flake (or the alarm) in a
-    # later run must not discard measurements already taken in this
-    # scarce healthy window (same convention as the flash child's
-    # per-seq guards).
+    # Each config guarded separately: a tunnel flake in a later run must
+    # not discard measurements already taken in this scarce healthy
+    # window (same convention as the flash child's per-seq guards).
+    if time.monotonic() > deadline:
+        out[prefix + 'error'] = 'window budget exhausted before this config'
+        break
     try:
         r = run_llm_bench(url, steps=20, batch_size=8, window=512,
                           workers_count=8, pool_type='thread',
                           resident_steps=8, **cfg)
-    except TimeoutError:
-        out[prefix + 'error'] = 'TimeoutError: alarm'
-        break  # flush immediately; no alarm budget left for more runs
     except Exception as e:
         out[prefix + 'error'] = type(e).__name__ + ': ' + str(e)[:120]
         continue
