@@ -107,10 +107,10 @@ def train(url: str, steps: int = 30, per_shard_batch: int = 2,
 
     # window+1 chunks per sample: seq_len tokens of input + 1 for the shifted
     # next-token target (loss_fn uses tokens[:-1] -> predict tokens[1:]).
-    ngram = NGram({i: ["tokens"] if i else ["tokens", "seq"]
-                   for i in range(window + 1)},
+    # dense=True: each window arrives as {"tokens": (window+1, CHUNK)}.
+    ngram = NGram({i: ["tokens"] for i in range(window + 1)},
                   delta_threshold=1, timestamp_field="seq",
-                  timestamp_overlap=True)
+                  timestamp_overlap=True, dense=True)
 
     def batches():
         while True:
@@ -119,8 +119,7 @@ def train(url: str, steps: int = 30, per_shard_batch: int = 2,
                              workers_count=2, rowgroup_coalescing=4) as reader:
                 buf = []
                 for win in reader:
-                    seq = np.concatenate([np.asarray(win[i].tokens)
-                                          for i in range(window + 1)])
+                    seq = win["tokens"].reshape(-1)
                     # seq_len model inputs + 1 shifted target token
                     buf.append(seq[:seq_len + 1])
                     if len(buf) == batch_size:

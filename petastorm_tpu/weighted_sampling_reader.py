@@ -36,6 +36,12 @@ class WeightedSamplingReader:
                 raise ValueError("All readers must share the same output schema")
             if bool(getattr(other, "ngram", None)) != bool(getattr(first, "ngram", None)):
                 raise ValueError("Cannot mix ngram and non-ngram readers")
+            if (getattr(getattr(other, "ngram", None), "dense", False)
+                    != getattr(getattr(first, "ngram", None), "dense", False)):
+                raise ValueError(
+                    "Cannot mix dense and row-format ngram readers: their "
+                    "sample types differ ({name: array} vs {offset: "
+                    "namedtuple})")
             if other.batched_output != first.batched_output:
                 raise ValueError("Cannot mix batched and row readers")
         self.schema = first.schema

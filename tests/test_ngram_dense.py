@@ -279,3 +279,21 @@ def test_dense_through_torch_loader(tmp_path):
     assert isinstance(batches[0]["token"], torch.Tensor)
     assert tuple(batches[0]["token"].shape) == (2, 5)
     assert batches[0]["ts"].dtype == torch.int64
+
+
+def test_weighted_sampling_rejects_mixed_dense(tmp_path):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    url = _write_tokens(tmp_path, rows=12, rows_per_group=12)
+    mk = lambda dense: make_reader(
+        url, schema_fields=NGram({o: ["ts"] for o in range(2)},
+                                 delta_threshold=1, timestamp_field="ts",
+                                 dense=dense),
+        shuffle_row_groups=False, reader_pool_type="dummy")
+    r_dense, r_row = mk(True), mk(False)
+    try:
+        with pytest.raises(ValueError, match="dense and row-format"):
+            WeightedSamplingReader([r_dense, r_row], [0.5, 0.5])
+    finally:
+        r_dense.stop()
+        r_row.stop()
