@@ -230,9 +230,10 @@ class NGram:
     def form_ngram_dense(self, cols: Dict[str, "object"],
                          order) -> List[Dict[str, "object"]]:
         """Column-major window assembly for ``dense=True``: ``cols`` maps
-        field name -> full per-row-group numpy column, ``order`` is the
-        row permutation that timestamp-sorts (and drop-partition-selects)
-        it. Returns ``[{name: (length, *shape) array}, ...]`` without ever
+        field name -> per-row numpy column (any leading-axis array), and
+        ``order`` is the index array that timestamp-sorts (and optionally
+        row-selects) it. Returns
+        ``[{name: (length, *shape) array}, ...]`` without ever
         materializing per-row dicts or namedtuples — the TPU-first readout
         for token-stream stores (cf. reference ngram.py:225 form_ngram,
         which is row-oriented by design).

@@ -237,11 +237,12 @@ class RowReaderWorker(WorkerBase):
                                                shuffle_row_drop_partition, rng)
         if (ngram is not None and getattr(ngram, "dense", False)
                 and (transform_spec is None or transform_spec.func is None)
-                and self._dense_ngram_vectorizable(data)):
-            # TPU-first fast path: windows assembled column-major straight
-            # from the numeric Arrow columns — no per-row dicts, no
-            # namedtuples, no per-cell codec calls (ScalarCodec.decode is a
-            # dtype cast, applied per column below).
+                and self._dense_ngram_vectorizable(data, indices)):
+            # TPU-first fast path: windows assembled column-major — no
+            # per-row dicts or namedtuples. Scalar numeric columns skip
+            # codec calls entirely (ScalarCodec.decode is a dtype cast,
+            # applied per column); fixed-shape codec fields (ndarray,
+            # image) decode column-major and stack once per field.
             result = self._dense_ngram_windows(ngram, data, indices)
             if result:
                 self.publish_func(result)
@@ -267,32 +268,79 @@ class RowReaderWorker(WorkerBase):
         if result:
             self.publish_func(result)
 
-    def _dense_ngram_vectorizable(self, data: dict) -> bool:
-        """True when every needed field's column is a plain numeric numpy
-        array whose decode is a dtype cast — i.e. scalar fields on the
-        zero-copy read path. Anything else (images, strings, object
-        columns, disk-cache pylist payloads) takes the row fallback."""
+    @staticmethod
+    def _scalar_fast_col(field, codec, col) -> bool:
+        """Scalar numeric column whose decode is a pure dtype cast."""
+        return (isinstance(col, np.ndarray) and col.dtype.kind in "biuf"
+                and field.shape == ()
+                and type(codec).__name__ == "ScalarCodec")
+
+    def _dense_ngram_vectorizable(self, data: dict, indices) -> bool:
+        """True when every needed field can be assembled column-major:
+        scalar numeric columns (decode = dtype cast), or fixed-shape codec
+        fields (ndarray/image/...) with no null cells, which decode
+        column-major and stack to ``(n, *shape)``. Variable-length fields
+        are rejected at reader construction; strings/objects, nulls and
+        datetime timestamps take the row fallback (which preserves the
+        null error message at collate)."""
+        ts_name = self.args["ngram"].timestamp_field_name
         for name, field, codec in self._decode_schema.decode_plan:
             col = data.get(name)
-            if not (isinstance(col, np.ndarray) and col.dtype.kind in "biuf"
-                    and field.shape == ()
-                    and type(codec).__name__ == "ScalarCodec"):
+            if col is None:
+                return False
+            if self._scalar_fast_col(field, codec, col):
+                continue
+            if name == ts_name:
+                return False  # sorting/threshold needs a numeric ts column
+            shape = field.shape or ()
+            if not shape or any(d is None for d in shape):
+                return False  # scalar-but-odd (str/Decimal/dt64) or varlen
+            if isinstance(col, np.ndarray):
+                # A multi-dim field's column arrives as a list of encoded
+                # cells from _column_values; an ndarray here is some other
+                # read path whose cells codec.decode can't accept — the
+                # row fallback handles it.
+                return False
+            if any(col[i] is None for i in indices):
                 return False
         return True
 
     def _dense_ngram_windows(self, ngram, data: dict, indices):
-        """Column-major dense window assembly: select/permute rows, cast
-        each column to its field dtype (the vectorized ScalarCodec.decode),
+        """Column-major dense window assembly: select rows, produce one
+        ``(n, *shape)`` array per field (dtype cast for scalar columns,
+        column-major codec decode + one stack for the rest),
         timestamp-sort, and hand columns to
         :meth:`petastorm_tpu.ngram.NGram.form_ngram_dense`."""
         idx = np.asarray(indices, dtype=np.intp)
         cols = {}
+        slow = {}
         for name, field, codec in self._decode_schema.decode_plan:
             col = data[name]
-            dt = np.dtype(field.numpy_dtype)
-            cols[name] = col if col.dtype == dt else col.astype(dt)
-        ts = np.asarray(cols[ngram.timestamp_field_name])
-        order = idx[np.argsort(ts[idx], kind="stable")]
+            if self._scalar_fast_col(field, codec, col):
+                dt = np.dtype(field.numpy_dtype)
+                sel = col[idx]
+                cols[name] = sel if sel.dtype == dt else sel.astype(dt)
+            else:
+                slow[name] = col
+        if slow:
+            decoded = self._decode_columns(slow, idx)
+            for name, vals in decoded.items():
+                try:
+                    arr = np.asarray(vals)  # no-op for the native decoder
+                except ValueError as e:  # ragged decodes (e.g. a grayscale
+                    raise TypeError(     # image under an RGB field)
+                        f"Field {name!r}: codec produced non-uniform "
+                        f"values; dense NGram requires fixed-shape "
+                        f"decodes") from e
+                if arr.dtype == object:
+                    raise TypeError(
+                        f"Field {name!r}: codec produced non-uniform values; "
+                        f"dense NGram requires fixed-shape decodes")
+                cols[name] = arr
+        # scalar fast columns were selected by idx above; decoded slow
+        # columns come back already in idx order — so windows form over
+        # an argsort of the selected timestamp column.
+        order = np.argsort(cols[ngram.timestamp_field_name], kind="stable")
         return ngram.form_ngram_dense(cols, order)
 
     # ------------------------------------------------------------ load paths
@@ -325,6 +373,15 @@ class RowReaderWorker(WorkerBase):
         """Column-major decode, then row assembly — one tight loop per field
         instead of a per-row schema walk (the row-path analog of the batch
         worker's vectorized conversion)."""
+        cols = self._decode_columns(data, indices)
+        names = list(cols.keys())
+        return [{n: cols[n][j] for n in names} for j in range(len(indices))]
+
+    def _decode_columns(self, data: dict, indices) -> dict:
+        """Codec-decode the selected rows of every needed column; returns
+        ``{name: per-row decoded values}`` (list, or ndarray from the
+        native image batch decoder). Shared by the row path above and the
+        dense NGram path (which stacks these instead of building rows)."""
         from petastorm_tpu.utils.decode import (batch_decode_images,
                                                 is_memoryview_safe,
                                                 native_image_eligible)
@@ -357,8 +414,7 @@ class RowReaderWorker(WorkerBase):
                     None if (v := src[i]) is None
                     else dec(field, bytes(v) if isinstance(v, memoryview) else v)
                     for i in indices]
-        names = list(cols.keys())
-        return [{n: cols[n][j] for n in names} for j in range(len(indices))]
+        return cols
 
     def _read_columns(self, rowgroup, columns, zero_copy: bool = True) -> dict:
         """Read the row group; returns {column: values} incl. partition keys.

@@ -99,9 +99,10 @@ def test_dense_fallback_with_transform_matches_vectorized_shape(tmp_path):
         assert d["token"].shape == (3,)
 
 
-def test_dense_fallback_with_ndarray_field(tmp_path):
-    """Non-scalar window fields (codec decode) take the row fallback and
-    stack to (length, *field_shape)."""
+def test_dense_with_ndarray_field_matches_row_path(tmp_path):
+    """Fixed-shape codec fields (NdarrayCodec — the chunked-token LLM
+    layout) assemble column-major too: one decode + stack per field,
+    (length, *field_shape) windows, values identical to the row path."""
     schema = Unischema("VecSchema", [
         UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
         UnischemaField("vec", np.float32, (2,), NdarrayCodec(), False),
@@ -112,11 +113,45 @@ def test_dense_fallback_with_ndarray_field(tmp_path):
         for i in range(16):
             w.write_row({"ts": np.int64(i),
                          "vec": rng.normal(size=2).astype(np.float32)})
-    ngram = NGram({0: ["ts", "vec"], 1: ["ts", "vec"]}, delta_threshold=1,
-                  timestamp_field="ts", dense=True)
-    windows = _dense_windows(url, ngram)
+    mk = lambda dense: NGram({0: ["ts", "vec"], 1: ["ts", "vec"]},
+                             delta_threshold=1, timestamp_field="ts",
+                             dense=dense)
+    windows = _dense_windows(url, mk(True))
     assert windows and windows[0]["vec"].shape == (2, 2)
     assert windows[0]["vec"].dtype == np.float32
+    rows = _dense_windows(url, mk(False))
+    assert len(windows) == len(rows)
+    for d, r in zip(windows, rows):
+        np.testing.assert_array_equal(d["vec"],
+                                      np.stack([r[0].vec, r[1].vec]))
+        np.testing.assert_array_equal(d["ts"], [r[0].ts, r[1].ts])
+
+
+def test_dense_with_image_field_matches_row_path(tmp_path):
+    """Image codec fields ride the native batch decoder column-major and
+    stack to (length, H, W, C) windows — frame-sequence readout."""
+    schema = Unischema("FrameSchema", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField("frame", np.uint8, (8, 8, 3),
+                       CompressedImageCodec("png"), False),
+    ])
+    url = f"file://{tmp_path}/frames"
+    rng = np.random.default_rng(2)
+    with materialize_dataset_local(url, schema, rows_per_row_group=6) as w:
+        for i in range(12):
+            w.write_row({"ts": np.int64(i),
+                         "frame": rng.integers(0, 255, (8, 8, 3),
+                                               ).astype(np.uint8)})
+    mk = lambda dense: NGram({o: ["ts", "frame"] for o in range(3)},
+                             delta_threshold=1, timestamp_field="ts",
+                             timestamp_overlap=False, dense=dense)
+    dense = _dense_windows(url, mk(True))
+    rows = _dense_windows(url, mk(False))
+    assert len(dense) == len(rows) > 0
+    for d, r in zip(dense, rows):
+        assert d["frame"].shape == (3, 8, 8, 3)
+        np.testing.assert_array_equal(
+            d["frame"], np.stack([r[o].frame for o in range(3)]))
 
 
 def test_dense_loader_collates_batch_seq_axes(tmp_path):
