@@ -191,7 +191,8 @@ def test_flash_attention_block_fallback_keeps_kernel_path(monkeypatch):
 
     fa_mod = importlib.import_module("petastorm_tpu.ops.flash_attn")
     assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_K, 1280) == 128
-    assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_K, 4096) == 512
+    assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_K, 4096) \
+        == fa_mod._DEFAULT_BLOCK_K  # divides: launch default stays
     assert fa_mod._pick_block(fa_mod._DEFAULT_BLOCK_Q, 100) == 100  # -> dense
 
     calls = {}
