@@ -48,6 +48,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
                   window: int = 512, workers_count: int = 8,
                   pool_type: str = "thread", echo: int = 1,
                   resident_steps: int = 0, dense: bool = True,
+                  flash: bool = False,
                   model_kwargs: dict | None = None) -> dict:
     """Token windows through the full reader stack into a real llama
     train step; returns ``{tokens_per_sec, input_stall_pct,
@@ -78,7 +79,14 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
 
     params = jax.device_put(llama.init_params(jax.random.PRNGKey(0), cfg),
                             NamedSharding(mesh, P()))
-    init_opt, raw_step = llama.make_train_step(cfg, shift="roll")
+    # flash=True swaps the Pallas flash kernel in for XLA dense attention
+    # (the win regime is window >= 8k — the long-context pipeline config).
+    attn_fn = None
+    if flash:
+        from petastorm_tpu.ops.flash_attn import make_flash_attention
+        attn_fn = make_flash_attention(causal=True)
+    init_opt, raw_step = llama.make_train_step(cfg, shift="roll",
+                                               attn_fn=attn_fn)
     opt = init_opt(params)
 
     def step_fn(params, opt, tokens):
@@ -124,6 +132,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         "tokens_per_step": tokens_per_step,
         "echo": echo,
         "dense": dense,
+        "flash": flash,
         "window": window,
         "devices": len(devices),
         "loss_first": loss_first,

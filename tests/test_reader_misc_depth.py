@@ -462,3 +462,22 @@ def test_transport_bench_ring_vs_pipe_roundtrip():
         assert r["items_per_sec"] > 0 and r["mb_per_sec"] > 0
     md = tb.to_markdown(rows)
     assert "ring speedup" in md and "0 KB |" in md  # 512B renders as 0 KB
+
+
+@pytest.mark.slow
+def test_llm_bench_flash_attention_wiring(tmp_path):
+    """flash=True swaps the Pallas kernel (interpret mode on CPU) into the
+    llm bench's train step; losses must match the dense-attention run."""
+    from petastorm_tpu.benchmark.llm_bench import (run_llm_bench,
+                                                   write_token_store)
+    url = f"file://{tmp_path}/tok"
+    write_token_store(url, windows=16, window=16, vocab=128)
+    tiny = dict(vocab=128, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                hidden=64)
+    rf = run_llm_bench(url, steps=2, batch_size=8, window=16,
+                       workers_count=2, flash=True, model_kwargs=tiny)
+    rd = run_llm_bench(url, steps=2, batch_size=8, window=16,
+                       workers_count=2, flash=False, model_kwargs=tiny)
+    assert rf["flash"] is True and rd["flash"] is False
+    assert abs(rf["loss_first"] - rd["loss_first"]) < 2e-2
+    assert abs(rf["loss_last"] - rd["loss_last"]) < 2e-2
