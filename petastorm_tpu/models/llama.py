@@ -353,16 +353,23 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
                         expert_spec=expert_spec, with_aux=True,
                         layers_fn=layers_fn, embed_lookup=embed_lookup,
                         compute_dtype=compute_dtype)
-    logp = jax.nn.log_softmax(logits)
+    # Fused form: nll = logsumexp(logits) - logits[target]. Identical math
+    # to log_softmax + gather (log_softmax = logits - lse), but XLA skips
+    # materializing the full (b, s, V) log-prob tensor — measured 13%
+    # faster for the 4k-token loss+grad on TPU v5 lite (10.8 -> 9.4 ms;
+    # a chunked/remat variant measured slower at this scale, 11.4 ms).
+    lse = jax.nn.logsumexp(logits, axis=-1)                      # (b, s)
     if shift == "roll":
         targets = jnp.roll(tokens, -1, axis=1)
-        nll_tok = -jnp.take_along_axis(logp, targets[..., None],
-                                       axis=-1)[..., 0]          # (b, s)
+        tl = jnp.take_along_axis(logits, targets[..., None],
+                                 axis=-1)[..., 0]                # (b, s)
+        nll_tok = lse - tl
         mask = (jnp.arange(tokens.shape[1]) < tokens.shape[1] - 1)
         nll = (nll_tok * mask).sum() / (mask.sum() * tokens.shape[0])
     else:
         targets = tokens[:, 1:]
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+        tl = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        nll = (lse - tl).mean()
     return nll + aux_weight * aux
 
 
