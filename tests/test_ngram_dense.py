@@ -401,3 +401,25 @@ def test_dense_loader_checkpoint_never_loses_windows(tmp_path):
     assert part2[-len(rest):] == rest
     assert set(map(tuple, part1)) | set(map(tuple, part2)) \
         == set(map(tuple, full))
+
+
+def test_dense_parity_under_rowgroup_coalescing(tmp_path):
+    """rowgroup_coalescing merges same-file groups into one work item
+    (windows may span the original boundaries — documented, reader.py);
+    the dense and row readouts must agree on exactly which windows that
+    yields."""
+    url = _write_tokens(tmp_path, rows=40, rows_per_group=10)
+
+    def windows(dense):
+        ngram = NGram({o: ["ts", "token"] for o in range(4)},
+                      delta_threshold=1, timestamp_field="ts",
+                      timestamp_overlap=False, dense=dense)
+        return [tuple(w["ts"].tolist()) if dense
+                else tuple(int(w[o].ts) for o in range(4))
+                for w in _dense_windows(url, ngram, rowgroup_coalescing=2)]
+
+    d, r = windows(True), windows(False)
+    assert d == r and len(d) > 0
+    # coalescing=2 merges pairs of 10-row groups: 5 disjoint length-4
+    # windows per 20-row unit (vs 2 per 10-row group uncoalesced)
+    assert len(d) == 10
