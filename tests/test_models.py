@@ -146,3 +146,24 @@ def test_llama_roll_shift_loss_matches_manual_mask():
 
     with pytest.raises(ValueError, match="shift"):
         llama.loss_fn(params, {"tokens": tokens}, cfg, shift="typo")
+
+
+def test_llama_split_shift_loss_matches_log_softmax_reference():
+    """The fused nll (logsumexp - target logit; models/llama.py loss_fn)
+    must equal the textbook log_softmax + gather form in split mode too
+    (roll mode is pinned above)."""
+    import jax
+    import jax.numpy as jnp
+    from petastorm_tpu.models import llama
+
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 9), 0, cfg.vocab)
+    loss = float(llama.loss_fn(params, {"tokens": tokens}, cfg,
+                               shift="split", aux_weight=0.0))
+
+    logits = llama.apply(params, tokens[:, :-1], cfg)
+    logp = jax.nn.log_softmax(logits)
+    expected = -float(jnp.mean(jnp.take_along_axis(
+        logp, tokens[:, 1:, None], axis=-1)))
+    assert loss == pytest.approx(expected, rel=1e-6)
