@@ -17,11 +17,12 @@ HBM; this kernel never does. Design (flash-attention-2 style, TPU-first):
   bandwidth is prefetch-pipelined, MXU time is not);
 * scores accumulate in float32 regardless of input dtype (numerics parity
   with :func:`petastorm_tpu.parallel.attention.dense_attention`);
-* the backward pass recomputes through a CHUNKED dense path via
-  ``custom_vjp``: q blocks run under ``jax.checkpoint`` inside
-  ``lax.map``, so differentiating stores no O(seq^2) residuals and peaks
-  at O(block * seq) score memory per chunk — training keeps the linear
-  memory story, at the standard recompute-FLOPs cost;
+* the backward pass is two Pallas kernels (flash-attention-2 style,
+  ``custom_vjp``): the forward saves ``(q, k, v, o, lse)``, then a
+  kv-innermost pass accumulates dQ and a q-innermost pass accumulates
+  dK/dV — with grouped-query head gradients summed inside the kernel by
+  walking every (group head, q block) pair over one K/V tile. No
+  O(seq^2) or O(block*seq) tensors touch HBM in training either;
 * off-TPU the kernel runs in Pallas interpret mode (tests), and shapes
   that don't tile cleanly (seq not divisible by an 8-aligned block, or
   ``causal`` with ``sq != sk``) fall back to the dense path —
@@ -67,11 +68,13 @@ def _pick_block(requested: int, seq: int) -> int:
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
                   block_k: int, causal: bool, scale: float,
-                  emit_stats: bool = False):
+                  emit_stats: bool = False, emit_lse: bool = False):
     from jax.experimental import pallas as pl
 
     if emit_stats:
         m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
+    elif emit_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
     else:
         acc_ref, m_ref, l_ref = rest
 
@@ -134,10 +137,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
         else:
             o_ref[0, 0, :, :] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(
                 o_ref.dtype)
+            if emit_lse:
+                # logsumexp per q row — the softmax residual the flash
+                # backward kernels re-exponentiate against.
+                lse_ref[0, 0, :, :] = m_ref[:] + jnp.log(l_ref[:])
 
 
-def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+def _flash_launch(q, k, v, causal: bool, block_q: int, block_k: int,
+                  interpret: bool, mode: str):
+    """One launcher for every forward variant — same grid, BlockSpecs and
+    scratch; ``mode`` picks the kernel's emit: ``"out"`` (normalized
+    output), ``"lse"`` (output + logsumexp, the backward's residual), or
+    ``"stats"`` (unnormalized o + m/l, the ring-merge contract).
+
+    Kernel-internal layout is (b, heads, seq, d): Mosaic requires the
+    block's minor-most two dims to tile as (sublane, lane) — (block_q, d)
+    satisfies the (8, 128) granule, whereas the model-side (b, seq,
+    heads, d) layout would put a size-1 block dim over the heads axis,
+    which the TPU lowering rejects. XLA fuses the boundary transposes
+    into the surrounding copies."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -145,27 +163,36 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     sk, kv_h = k.shape[1], k.shape[2]
     rep = h // kv_h
     kernel = partial(_flash_kernel, block_q=block_q, block_k=block_k,
-                     causal=causal, scale=1.0 / np.sqrt(d))
-    # Kernel-internal layout is (b, heads, seq, d): Mosaic requires the
-    # block's minor-most two dims to tile as (sublane, lane) — (block_q, d)
-    # satisfies the (8, 128) granule, whereas the model-side (b, seq,
-    # heads, d) layout would put a size-1 block dim over the heads axis,
-    # which the TPU lowering rejects. XLA fuses the boundary transposes
-    # into the surrounding copies.
-    out = pl.pallas_call(
+                     causal=causal, scale=1.0 / np.sqrt(d),
+                     emit_stats=(mode == "stats"), emit_lse=(mode == "lse"))
+    o_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    stat_spec = pl.BlockSpec((1, 1, block_q, 1),
+                             lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    stat_shape = jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)
+    if mode == "out":
+        out_specs = o_spec
+        out_shape = jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)
+    elif mode == "lse":
+        out_specs = [o_spec, stat_spec]
+        out_shape = [jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                     stat_shape]
+    else:  # stats: unnormalized f32 accumulator + m/l
+        out_specs = [o_spec, stat_spec, stat_spec]
+        out_shape = [jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+                     stat_shape, stat_shape]
+    return pl.pallas_call(
         kernel,
         grid=(b, h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            o_spec,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),      # acc
             pltpu.VMEM((block_q, 1), jnp.float32),      # running max m
@@ -174,7 +201,22 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         interpret=interpret,
     )(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
       v.transpose(0, 2, 1, 3))
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    out = _flash_launch(q, k, v, causal, block_q, block_k, interpret, "out")
     return out.transpose(0, 2, 1, 3)
+
+
+def _flash_forward_lse(q, k, v, causal: bool, block_q: int, block_k: int,
+                       interpret: bool):
+    """Forward that also emits logsumexp per q row — the residual the
+    Pallas backward needs. Returns (o (b, sq, h, d) in q.dtype,
+    lse (b, sq, h) f32)."""
+    o, lse = _flash_launch(q, k, v, causal, block_q, block_k, interpret,
+                           "lse")
+    return o.transpose(0, 2, 1, 3), lse[..., 0].transpose(0, 2, 1)
 
 
 def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -182,52 +224,181 @@ def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     """Kernel launch emitting the ring-merge contract:
     (unnormalized o f32 (b, sq, h, d), running max m (b, sq, h),
     normalizer l (b, sq, h))."""
+    o, m, l = _flash_launch(q, k, v, causal, block_q, block_k, interpret,
+                            "stats")
+    return (o.transpose(0, 2, 1, 3), m[..., 0].transpose(0, 2, 1),
+            l[..., 0].transpose(0, 2, 1))
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, q_off, k_off,
+              block_q, block_k, causal, scale):
+    """Shared softmax-gradient tile math for both backward kernels:
+    recompute scores from the refs, re-exponentiate against the saved
+    lse (lse >= running max, so exp(s - lse) <= 1), and return
+    ``(p, ds)`` with ``ds`` already scaled — keeping the numerics in ONE
+    place so dQ and dK/dV cannot drift apart."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    lse = lse_ref[0, 0, :, 0]                                   # (bq,)
+    dd = dd_ref[0, 0, :, 0]                                     # (bq,)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    p = jnp.exp(s - lse[:, None])                               # (bq, bk)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dd[:, None]) * scale
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                         dq_ref, dq_acc, *, block_q: int, block_k: int,
+                         causal: bool, scale: float):
+    """dQ pass (flash-attention-2 backward): grid (b, h, q_blocks,
+    kv_blocks), kv innermost; dq accumulates in VMEM scratch across the
+    kv dimension. P is re-exponentiated from the saved lse, so no
+    softmax state needs carrying."""
+    from jax.experimental import pallas as pl
+
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_off, k_off = qi * block_q, ki * block_k
+    live = jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+
+    @pl.when(live)
+    def _step():
+        _, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                          q_off, k_off, block_q, block_k, causal, scale)
+        k = k_ref[0, 0, :, :]
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, rep: int, n_q: int, causal: bool,
+                          scale: float):
+    """dK/dV pass: grid (b, kv_heads, kv_blocks, rep * q_blocks) — the
+    innermost dimension walks every (grouped-query head, q block) pair
+    that attends to this K/V tile, accumulating dk/dv in VMEM scratch
+    (GQA gradients sum over the head group here instead of a host-side
+    reduction over repeated K/V)."""
+    from jax.experimental import pallas as pl
+
+    ki, t = pl.program_id(2), pl.program_id(3)
+    qi = t % n_q
+    n_t = pl.num_programs(3)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_off, k_off = qi * block_q, ki * block_k
+    live = jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+
+    @pl.when(live)
+    def _step():
+        p, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                          q_off, k_off, block_q, block_k, causal, scale)
+        q = q_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # (bk, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # (bk, d)
+
+    @pl.when(t == n_t - 1)
+    def _emit():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    """Pallas flash backward: dq via a kv-innermost pass, dk/dv via a
+    q-innermost pass with in-kernel GQA group accumulation. O(block)
+    VMEM per program, no O(seq^2) or O(block*seq) HBM tensors — the
+    memory story of the forward, extended to training."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
     sk, kv_h = k.shape[1], k.shape[2]
     rep = h // kv_h
-    kernel = partial(_flash_kernel, block_q=block_q, block_k=block_k,
-                     causal=causal, scale=1.0 / np.sqrt(d), emit_stats=True)
-    # Same kernel-internal (b, heads, seq, d) layout as _flash_forward
-    # (see comment there); the m/l stats ride out as (b, h, sq, 1) so
-    # their minor-most dims ((block_q, 1)) tile legally, then squeeze +
-    # transpose back to the ring-merge contract's (b, sq, h).
+    scale = 1.0 / np.sqrt(d)
+    # D_i = rowsum(dO ∘ O): O(seq·d) elementwise, fine outside the kernel.
+    dd = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    doT = do.transpose(0, 2, 1, 3)
+    lseT = lse.transpose(0, 2, 1)[..., None]                # (b, h, sq, 1)
+    ddT = dd.transpose(0, 2, 1)[..., None]
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d),
+                           lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0))
     stat_spec = pl.BlockSpec((1, 1, block_q, 1),
                              lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-    o, m, l = pl.pallas_call(
-        kernel,
+    dq = pl.pallas_call(
+        partial(_flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                causal=causal, scale=scale),
         grid=(b, h, sq // block_q, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // rep, ki, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            stat_spec,
-            stat_spec,
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),      # acc
-            pltpu.VMEM((block_q, 1), jnp.float32),      # running max m
-            pltpu.VMEM((block_q, 1), jnp.float32),      # normalizer l
-        ],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-      v.transpose(0, 2, 1, 3))
-    return (o.transpose(0, 2, 1, 3), m[..., 0].transpose(0, 2, 1),
-            l[..., 0].transpose(0, 2, 1))
+    )(qT, kT, vT, doT, lseT, ddT)
+
+    n_q = sq // block_q
+    kv_out_spec = pl.BlockSpec((1, 1, block_k, d),
+                               lambda bi, gi, ki, t: (bi, gi, ki, 0))
+    # Per-(kv head, q tile) inputs: head gi*rep + t//n_q, q block t%n_q.
+    q_in = pl.BlockSpec(
+        (1, 1, block_q, d),
+        lambda bi, gi, ki, t: (bi, gi * rep + t // n_q, t % n_q, 0))
+    stat_in = pl.BlockSpec(
+        (1, 1, block_q, 1),
+        lambda bi, gi, ki, t: (bi, gi * rep + t // n_q, t % n_q, 0))
+    kv_in = pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, gi, ki, t: (bi, gi, ki, 0))
+    dk, dv = pl.pallas_call(
+        partial(_flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                rep=rep, n_q=n_q, causal=causal, scale=scale),
+        grid=(b, kv_h, sk // block_k, rep * n_q),
+        in_specs=[kv_in, kv_in, q_in, q_in, stat_in, stat_in],
+        out_specs=[kv_out_spec, kv_out_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, kv_h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, kv_h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(kT, vT, qT, doT, lseT, ddT)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
 
 
 def _dense_stats(q, k, v, causal: bool, block_q: int):
@@ -301,58 +472,24 @@ def _dense(q, k, v, causal):
     return dense_attention(q, k, v, causal=causal)
 
 
-def _chunked_dense(q, k, v, causal: bool, block_q: int):
-    """Same function as :func:`_dense`, computed one q block at a time with
-    each block under ``jax.checkpoint`` — differentiating through this
-    stores only the block inputs, so the backward pass recomputes scores
-    chunk-by-chunk at O(block_q * seq) peak instead of materializing the
-    full O(seq^2) matrix. Reuses the ring's offset-masked block kernel so
-    the numerics (f32 scores, GQA grouping, masked-row guards) stay in one
-    place."""
-    from petastorm_tpu.parallel.ring_attention import _block_attention
-
-    b, sq, h, d = q.shape
-    if sq % block_q:
-        return _dense(q, k, v, causal)
-    lk = k.shape[1]
-    nq = sq // block_q
-    q_blocks = q.reshape(b, nq, block_q, h, d).transpose(1, 0, 2, 3, 4)
-    offsets = jnp.arange(nq) * block_q
-
-    @jax.checkpoint
-    def chunk(q_blk, off):
-        if causal:
-            qpos = off + jnp.arange(block_q)
-            bias = jnp.where(qpos[:, None] >= jnp.arange(lk)[None, :],
-                             0.0, -jnp.inf)[None, None]
-        else:
-            bias = jnp.zeros((1, 1, block_q, lk), jnp.float32)
-        o, _, l = _block_attention(q_blk, k, v, bias)
-        l = jnp.maximum(l, 1e-20)
-        return (o / l.transpose(0, 2, 1)[..., None]).astype(q_blk.dtype)
-
-    out = jax.lax.map(lambda args: chunk(*args), (q_blocks, offsets))
-    return out.transpose(1, 0, 2, 3, 4).reshape(b, sq, h, d)
-
-
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
 def _flash_vjp(causal, block_q, block_k, interpret, q, k, v):
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
 
 def _flash_vjp_fwd(causal, block_q, block_k, interpret, q, k, v):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    # The lse-emitting launch costs one extra (b, sq, h) f32 write over
+    # the plain forward and saves the backward an entire forward
+    # recompute (the old chunked-dense bwd re-ran the whole attention).
+    o, lse = _flash_forward_lse(q, k, v, causal, block_q, block_k,
+                                interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, residual, g):
-    # Recompute backward through the chunked dense path: same function, so
-    # the same gradients; forward saved only (q, k, v), and the chunking +
-    # jax.checkpoint keep the recompute at O(block_q * seq) score memory.
-    q, k, v = residual
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _chunked_dense(q_, k_, v_, causal, block_q),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residual
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 _flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
