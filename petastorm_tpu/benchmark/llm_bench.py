@@ -48,7 +48,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
                   window: int = 512, workers_count: int = 8,
                   pool_type: str = "thread", echo: int = 1,
                   resident_steps: int = 0, dense: bool = True,
-                  flash: bool = False,
+                  flash: bool = False, xent_chunk: int | None = None,
                   model_kwargs: dict | None = None) -> dict:
     """Token windows through the full reader stack into a real llama
     train step; returns ``{tokens_per_sec, input_stall_pct,
@@ -86,7 +86,8 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         from petastorm_tpu.ops.flash_attn import make_flash_attention
         attn_fn = make_flash_attention(causal=True)
     init_opt, raw_step = llama.make_train_step(cfg, shift="roll",
-                                               attn_fn=attn_fn)
+                                               attn_fn=attn_fn,
+                                               xent_chunk=xent_chunk)
     opt = init_opt(params)
 
     def step_fn(params, opt, tokens):
@@ -133,6 +134,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         "echo": echo,
         "dense": dense,
         "flash": flash,
+        "xent_chunk": xent_chunk,
         "window": window,
         "devices": len(devices),
         "loss_first": loss_first,

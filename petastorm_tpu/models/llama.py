@@ -269,8 +269,10 @@ def apply_block(layer, x, cfg: LlamaConfig, attn_fn=None, constrain=None,
 def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
           activation_spec=None, compute_dtype=jnp.bfloat16,
           expert_spec=None, with_aux=False, layers_fn=None,
-          embed_lookup: str = "gather"):
-    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab).
+          embed_lookup: str = "gather", return_hidden: bool = False):
+    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab)
+    (or the pre-lm_head hidden states when ``return_hidden`` — the
+    chunked-cross-entropy path computes per-chunk logits itself).
 
     :param attn_fn: attention callable ``(q, k, v) -> out`` on
         (b, s, h, hd) tensors; ``None`` uses dense causal attention. Pass a
@@ -318,6 +320,8 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
                                        expert_spec=expert_spec)
             aux = aux + layer_aux
     x = _rmsnorm(x, params["norm_out"], cfg.norm_eps)
+    if return_hidden:
+        return (x, aux) if with_aux else x
     logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return (logits, aux) if with_aux else logits
 
@@ -325,7 +329,7 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
 def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
             expert_spec=None, aux_weight: float = 1e-2, layers_fn=None,
             embed_lookup: str = "gather", compute_dtype=jnp.bfloat16,
-            shift: str = "split"):
+            shift: str = "split", xent_chunk: int | None = None):
     """Next-token cross entropy (+ MoE load-balancing aux for switch
     dispatch). batch: {'tokens': (b, s) int32}. ``compute_dtype=float32``
     makes activation math exact — the PP-parity pinning mode (microbatched
@@ -348,6 +352,48 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
     if shift not in ("split", "roll"):
         raise ValueError(f"unknown shift {shift!r}")
     inputs = tokens if shift == "roll" else tokens[:, :-1]
+    if xent_chunk:
+        # Long-context path: never materialize the (b, s, V) logits. The
+        # lm_head matmul + logsumexp run per token chunk under
+        # jax.checkpoint, so fwd AND bwd peak at O(chunk * V) logit
+        # memory — at 32k context and 32k vocab the full tensor is
+        # ~4.2 GB f32 (plus its cotangent), which alone decides whether
+        # a single 16 GB chip can train. Measured slower than the fused
+        # full-logits form at 4k (recompute cost > memory savings),
+        # so it stays opt-in for the long-context regime.
+        x, aux = apply(params, inputs, cfg, attn_fn=attn_fn,
+                       activation_spec=activation_spec,
+                       expert_spec=expert_spec, with_aux=True,
+                       layers_fn=layers_fn, embed_lookup=embed_lookup,
+                       compute_dtype=compute_dtype, return_hidden=True)
+        if shift == "roll":
+            targets = jnp.roll(tokens, -1, axis=1)
+            mask = (jnp.arange(tokens.shape[1]) < tokens.shape[1] - 1)
+            denom = mask.sum() * tokens.shape[0]
+        else:
+            targets = tokens[:, 1:]
+            mask = jnp.ones((inputs.shape[1],), bool)
+            denom = targets.size
+        b, s, dm = x.shape
+        head = params["lm_head"]
+        n_tok = b * s
+        if n_tok % xent_chunk:
+            raise ValueError(f"xent_chunk ({xent_chunk}) must divide "
+                             f"batch*seq ({n_tok})")
+        xf = x.reshape(n_tok // xent_chunk, xent_chunk, dm)
+        tg = targets.reshape(n_tok // xent_chunk, xent_chunk)
+
+        @jax.checkpoint
+        def chunk_nll(args):
+            xc, tc = args
+            logits = (xc @ head.astype(xc.dtype)).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tl = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+            return lse - tl
+
+        nll_tok = jax.lax.map(chunk_nll, (xf, tg)).reshape(b, s)
+        nll = (nll_tok * mask).sum() / denom
+        return nll + aux_weight * aux
     logits, aux = apply(params, inputs, cfg, attn_fn=attn_fn,
                         activation_spec=activation_spec,
                         expert_spec=expert_spec, with_aux=True,
@@ -376,7 +422,8 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
 def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
                     attn_fn=None, activation_spec=None, expert_spec=None,
                     layers_fn=None, embed_lookup: str = "gather",
-                    compute_dtype=jnp.bfloat16, shift: str = "split"):
+                    compute_dtype=jnp.bfloat16, shift: str = "split",
+                    xent_chunk: int | None = None):
     """AdamW train step via optax; jit with sharded params for TP/DP/SP."""
     import optax
     tx = optax.adamw(learning_rate, weight_decay=0.1)
@@ -390,7 +437,8 @@ def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
                     activation_spec=activation_spec,
                     expert_spec=expert_spec, layers_fn=layers_fn,
                     embed_lookup=embed_lookup,
-                    compute_dtype=compute_dtype, shift=shift))(params, batch)
+                    compute_dtype=compute_dtype, shift=shift,
+                    xent_chunk=xent_chunk))(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -66,6 +66,26 @@ def _pick_block(requested: int, seq: int) -> int:
     return blk
 
 
+def _causal_live(causal: bool, q_off, k_off, block_q: int):
+    """True when this (q tile, kv tile) pair has any on-or-below-diagonal
+    element — the skip predicate shared by the forward and both backward
+    kernels."""
+    return jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+
+
+def _mask_causal(s, causal: bool, q_off, k_off, block_q: int, block_k: int):
+    """Apply the causal mask to a (block_q, block_k) score tile — ONE home
+    for the mask numerics so the backward recompute can never drift from
+    what the forward computed."""
+    if not causal:
+        return s
+    qpos = q_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = k_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, -jnp.inf)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
                   block_k: int, causal: bool, scale: float,
                   emit_stats: bool = False, emit_lse: bool = False):
@@ -90,7 +110,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
     q_off, k_off = qi * block_q, ki * block_k
     # Tiles fully above the causal diagonal contribute nothing: skip the
     # MXU work (roughly halves causal kernel time at long seq).
-    live = jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+    live = _causal_live(causal, q_off, k_off, block_q)
 
     @pl.when(live)
     def _step():
@@ -104,12 +124,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q: int,
         s = jax.lax.dot_general(                                 # (bq, bk)
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = q_off + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = k_off + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        s = _mask_causal(s, causal, q_off, k_off, block_q, block_k)
         m_prev, l_prev = m_ref[:, 0], l_ref[:, 0]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
         # m_new is finite from the first live block (causal keeps the
@@ -213,10 +228,11 @@ def _flash_forward_lse(q, k, v, causal: bool, block_q: int, block_k: int,
                        interpret: bool):
     """Forward that also emits logsumexp per q row — the residual the
     Pallas backward needs. Returns (o (b, sq, h, d) in q.dtype,
-    lse (b, sq, h) f32)."""
+    lse (b, h, sq, 1) f32 — KERNEL layout: only the backward launch
+    consumes it, so the model-side transpose round-trip is skipped)."""
     o, lse = _flash_launch(q, k, v, causal, block_q, block_k, interpret,
                            "lse")
-    return o.transpose(0, 2, 1, 3), lse[..., 0].transpose(0, 2, 1)
+    return o.transpose(0, 2, 1, 3), lse
 
 
 def _flash_stats_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -245,12 +261,7 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, q_off, k_off,
     dd = dd_ref[0, 0, :, 0]                                     # (bq,)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    if causal:
-        qpos = q_off + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        kpos = k_off + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    s = _mask_causal(s, causal, q_off, k_off, block_q, block_k)
     p = jnp.exp(s - lse[:, None])                               # (bq, bk)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -275,7 +286,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     q_off, k_off = qi * block_q, ki * block_k
-    live = jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+    live = _causal_live(causal, q_off, k_off, block_q)
 
     @pl.when(live)
     def _step():
@@ -312,7 +323,7 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     q_off, k_off = qi * block_q, ki * block_k
-    live = jnp.logical_or(not causal, q_off + block_q - 1 >= k_off)
+    live = _causal_live(causal, q_off, k_off, block_q)
 
     @pl.when(live)
     def _step():
@@ -353,7 +364,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
     doT = do.transpose(0, 2, 1, 3)
-    lseT = lse.transpose(0, 2, 1)[..., None]                # (b, h, sq, 1)
+    lseT = lse                                  # already (b, h, sq, 1)
     ddT = dd.transpose(0, 2, 1)[..., None]
 
     q_spec = pl.BlockSpec((1, 1, block_q, d),
@@ -478,7 +489,7 @@ def _flash_vjp(causal, block_q, block_k, interpret, q, k, v):
 
 
 def _flash_vjp_fwd(causal, block_q, block_k, interpret, q, k, v):
-    # The lse-emitting launch costs one extra (b, sq, h) f32 write over
+    # The lse-emitting launch costs one extra (b, h, sq) f32 write over
     # the plain forward and saves the backward an entire forward
     # recompute (the old chunked-dense bwd re-ran the whole attention).
     o, lse = _flash_forward_lse(q, k, v, causal, block_q, block_k,

@@ -167,3 +167,39 @@ def test_llama_split_shift_loss_matches_log_softmax_reference():
     expected = -float(jnp.mean(jnp.take_along_axis(
         logp, tokens[:, 1:, None], axis=-1)))
     assert loss == pytest.approx(expected, rel=1e-6)
+
+
+def test_llama_chunked_xent_matches_full_loss():
+    """xent_chunk computes the lm_head matmul + logsumexp per token chunk
+    under jax.checkpoint (never materializing (b, s, V) logits) — loss
+    and grads must match the full path at bf16-reassociation tolerance
+    in both shift modes, and indivisible chunking must raise."""
+    import jax
+    import numpy as np
+    from petastorm_tpu.models import llama
+
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab)
+    for shift, ck in (("roll", 6), ("split", 8)):
+        full = float(llama.loss_fn(params, {"tokens": tokens}, cfg,
+                                   shift=shift, aux_weight=0.0))
+        chunked = float(llama.loss_fn(params, {"tokens": tokens}, cfg,
+                                      shift=shift, aux_weight=0.0,
+                                      xent_chunk=ck))
+        assert chunked == pytest.approx(full, rel=1e-3)
+
+    g1 = jax.grad(lambda p: llama.loss_fn(
+        p, {"tokens": tokens}, cfg, shift="roll", aux_weight=0.0))(params)
+    g2 = jax.grad(lambda p: llama.loss_fn(
+        p, {"tokens": tokens}, cfg, shift="roll", aux_weight=0.0,
+        xent_chunk=6))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-9)
+        assert rel < 3e-2  # bf16 cotangent reassociation
+
+    with pytest.raises(ValueError, match="must divide"):
+        llama.loss_fn(params, {"tokens": tokens}, cfg, shift="roll",
+                      xent_chunk=5)

@@ -475,7 +475,8 @@ def test_llm_bench_flash_attention_wiring(tmp_path):
     tiny = dict(vocab=128, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
                 hidden=64)
     rf = run_llm_bench(url, steps=2, batch_size=8, window=16,
-                       workers_count=2, flash=True, model_kwargs=tiny)
+                       workers_count=2, flash=True, xent_chunk=32,
+                       model_kwargs=tiny)
     rd = run_llm_bench(url, steps=2, batch_size=8, window=16,
                        workers_count=2, flash=False, model_kwargs=tiny)
     assert rf["flash"] is True and rd["flash"] is False
