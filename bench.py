@@ -461,8 +461,19 @@ def main():
     try:
         from tools.tpu_evidence import latest_evidence
         evidence = {ev: rec for ev in ("imagenet", "flash_attn",
-                                       "llama_train", "llm_pipeline")
+                                       "llama_train")
                     if (rec := latest_evidence(ev)) is not None}
+        # llm_pipeline spans several configurations under one event name;
+        # pick the latest of EACH by a key only that configuration emits,
+        # so a long-context one-off can't shadow the standard (BASELINE
+        # config 5) echo sweep in the round JSON.
+        for slot, key in (("llm_pipeline", "echo1_tokens_per_sec"),
+                          ("llm_longctx_8k", "longctx_flash_tokens_per_sec"),
+                          ("llm_ctx32k", "ctx32k_tokens_per_sec"),
+                          ("llm_ctx64k", "ctx64k_tokens_per_sec")):
+            rec = latest_evidence("llm_pipeline", require_key=key)
+            if rec is not None:
+                evidence[slot] = rec
         if evidence:
             out["tpu_evidence"] = evidence
     except Exception as e:  # noqa: BLE001 - evidence is supplementary

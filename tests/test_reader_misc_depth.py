@@ -357,8 +357,9 @@ def test_bench_main_flow_probe_first_and_dispersion(monkeypatch, capsys,
                         lambda: calls.append("capture_flash"))
     monkeypatch.setattr(
         te, "latest_evidence",
-        lambda ev=None: {"event": ev, "status": "ok", "sps": 123.0}
-        if ev == "imagenet" else None)
+        lambda ev=None, require_key=None:
+        {"event": ev, "status": "ok", "sps": 123.0}
+        if ev == "imagenet" and require_key is None else None)
 
     import petastorm_tpu.benchmark.hello_world as hw
     import petastorm_tpu.benchmark.scalar_bench as sb

@@ -172,3 +172,36 @@ def test_utilization_metrics_plausible_rate_keeps_pipelined_mfu(monkeypatch):
     # 1e14 flops/s on a 1e15 peak = 10% MFU, physically plausible
     assert out["mfu_pct"] == pytest.approx(10.0)
     assert "mfu_pipelined_dropped" not in out
+
+
+def test_latest_evidence_require_key_selects_configuration(te):
+    """llm_pipeline spans configurations (standard echo sweep,
+    long-context one-offs) under one event name; require_key must pick
+    the latest record of each so bench.py's round JSON carries them all
+    instead of the newest shadowing the rest."""
+    te.append_evidence({"event": "llm_pipeline", "status": "ok",
+                        "echo1_tokens_per_sec": 1.0})
+    te.append_evidence({"event": "llm_pipeline", "status": "ok",
+                        "ctx32k_tokens_per_sec": 2.0})
+    te.append_evidence({"event": "llm_pipeline", "status": "ok",
+                        "echo1_tokens_per_sec": 3.0})
+    assert te.latest_evidence("llm_pipeline")["echo1_tokens_per_sec"] == 3.0
+    std = te.latest_evidence("llm_pipeline",
+                             require_key="echo1_tokens_per_sec")
+    assert std["echo1_tokens_per_sec"] == 3.0
+    ctx = te.latest_evidence("llm_pipeline",
+                             require_key="ctx32k_tokens_per_sec")
+    assert ctx["ctx32k_tokens_per_sec"] == 2.0
+    assert te.latest_evidence("llm_pipeline",
+                              require_key="ctx64k_tokens_per_sec") is None
+
+
+def test_latest_evidence_require_key_only_still_filters_status(te):
+    """A require_key-only lookup is still selecting a headline: demoted
+    records must not resurface through it."""
+    te.append_evidence({"event": "llm_pipeline", "status": "ok",
+                        "echo1_tokens_per_sec": 1.0})
+    te.append_evidence({"event": "llm_pipeline", "status": "suspect",
+                        "echo1_tokens_per_sec": 99.0})
+    rec = te.latest_evidence(require_key="echo1_tokens_per_sec")
+    assert rec["echo1_tokens_per_sec"] == 1.0

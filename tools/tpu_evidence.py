@@ -340,10 +340,15 @@ def append_evidence(record: dict) -> None:
     print(f"evidence += {json.dumps(record)[:200]}", file=sys.stderr)
 
 
-def latest_evidence(event: str | None = None) -> dict | None:
+def latest_evidence(event: str | None = None,
+                    require_key: str | None = None) -> dict | None:
     """Most recent evidence record (optionally filtered to one ``event``
-    with ``status == 'ok'``). Used by bench.py to carry in-round TPU
-    measurements into the round JSON even when its own run hits a wedge."""
+    with ``status == 'ok'``, and/or to records carrying ``require_key``).
+    Used by bench.py to carry in-round TPU measurements into the round
+    JSON even when its own run hits a wedge; ``require_key`` lets it pick
+    the latest record of a specific *configuration* when one event name
+    spans several (e.g. llm_pipeline's standard echo sweep vs. its
+    long-context one-offs)."""
     if not os.path.exists(EVIDENCE_PATH):
         return None
     best = None
@@ -356,8 +361,15 @@ def latest_evidence(event: str | None = None) -> dict | None:
                 rec = json.loads(line)
             except ValueError:
                 continue
-            if event is not None and (rec.get("event") != event
-                                      or rec.get("status") != "ok"):
+            if ((event is not None or require_key is not None)
+                    and rec.get("status") != "ok"):
+                # Any filtered lookup is selecting a headline: demoted
+                # (suspect/skipped) records must never resurface through
+                # the require_key-only form either.
+                continue
+            if event is not None and rec.get("event") != event:
+                continue
+            if require_key is not None and require_key not in rec:
                 continue
             best = rec
     return best
