@@ -49,6 +49,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
                   pool_type: str = "thread", echo: int = 1,
                   resident_steps: int = 0, dense: bool = True,
                   flash: bool = False, xent_chunk: int | None = None,
+                  remat_layers: bool = False,
                   model_kwargs: dict | None = None) -> dict:
     """Token windows through the full reader stack into a real llama
     train step; returns ``{tokens_per_sec, input_stall_pct,
@@ -87,7 +88,8 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         attn_fn = make_flash_attention(causal=True)
     init_opt, raw_step = llama.make_train_step(cfg, shift="roll",
                                                attn_fn=attn_fn,
-                                               xent_chunk=xent_chunk)
+                                               xent_chunk=xent_chunk,
+                                               remat_layers=remat_layers)
     opt = init_opt(params)
 
     def step_fn(params, opt, tokens):
@@ -135,6 +137,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         "dense": dense,
         "flash": flash,
         "xent_chunk": xent_chunk,
+        "remat_layers": remat_layers,
         "window": window,
         "devices": len(devices),
         "loss_first": loss_first,

@@ -269,7 +269,8 @@ def apply_block(layer, x, cfg: LlamaConfig, attn_fn=None, constrain=None,
 def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
           activation_spec=None, compute_dtype=jnp.bfloat16,
           expert_spec=None, with_aux=False, layers_fn=None,
-          embed_lookup: str = "gather", return_hidden: bool = False):
+          embed_lookup: str = "gather", return_hidden: bool = False,
+          remat_layers: bool = False):
     """tokens: (batch, seq) int32 -> logits (batch, seq, vocab)
     (or the pre-lm_head hidden states when ``return_hidden`` — the
     chunked-cross-entropy path computes per-chunk logits itself).
@@ -292,6 +293,12 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
         replacing the sequential layer loop — the pipeline-parallel hook
         (pass a :func:`petastorm_tpu.parallel.pipeline.make_pipeline`
         wrapper over :func:`apply_block` with stacked stage params).
+    :param remat_layers: wrap each transformer block in ``jax.checkpoint``
+        (the long-context memory lever: only layer-boundary activations
+        are saved; the backward recomputes each block). Applies to the
+        sequential layer loop only — a ``layers_fn`` (pipeline
+        parallelism) owns its own rematerialization and combining the
+        two is rejected below.
     :param embed_lookup: ``"gather"`` (default) | ``"onehot"``. A plain
         gather is O(1) FLOPs and right for a replicated table, but forces
         GSPMD into involuntary full rematerialization (an all-gather of the
@@ -311,13 +318,24 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
                   if embed_lookup == "onehot"
                   else params["embed"].astype(compute_dtype)[tokens])
     if layers_fn is not None:
+        if remat_layers:
+            raise ValueError(
+                "remat_layers applies to the sequential layer loop; a "
+                "layers_fn (pipeline parallelism) owns its own "
+                "rematerialization — wrap it there instead")
         x, layers_aux = layers_fn(params["layers"], x)
         aux = aux + layers_aux
     else:
+        def one_block(layer, x):
+            return apply_block(layer, x, cfg, attn_fn=attn_fn,
+                               constrain=constrain, expert_spec=expert_spec)
+        if remat_layers:
+            # Long-context lever: save only layer-boundary activations;
+            # the backward recomputes each block (jax.checkpoint trades
+            # one extra forward per block for O(layers) less residual HBM).
+            one_block = jax.checkpoint(one_block)
         for layer in params["layers"]:
-            x, layer_aux = apply_block(layer, x, cfg, attn_fn=attn_fn,
-                                       constrain=constrain,
-                                       expert_spec=expert_spec)
+            x, layer_aux = one_block(layer, x)
             aux = aux + layer_aux
     x = _rmsnorm(x, params["norm_out"], cfg.norm_eps)
     if return_hidden:
@@ -329,7 +347,8 @@ def apply(params, tokens, cfg: LlamaConfig, attn_fn=None,
 def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
             expert_spec=None, aux_weight: float = 1e-2, layers_fn=None,
             embed_lookup: str = "gather", compute_dtype=jnp.bfloat16,
-            shift: str = "split", xent_chunk: int | None = None):
+            shift: str = "split", xent_chunk: int | None = None,
+            remat_layers: bool = False):
     """Next-token cross entropy (+ MoE load-balancing aux for switch
     dispatch). batch: {'tokens': (b, s) int32}. ``compute_dtype=float32``
     makes activation math exact — the PP-parity pinning mode (microbatched
@@ -365,7 +384,8 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
                        activation_spec=activation_spec,
                        expert_spec=expert_spec, with_aux=True,
                        layers_fn=layers_fn, embed_lookup=embed_lookup,
-                       compute_dtype=compute_dtype, return_hidden=True)
+                       compute_dtype=compute_dtype, return_hidden=True,
+                       remat_layers=remat_layers)
         if shift == "roll":
             targets = jnp.roll(tokens, -1, axis=1)
             mask = (jnp.arange(tokens.shape[1]) < tokens.shape[1] - 1)
@@ -398,7 +418,8 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_fn=None, activation_spec=None,
                         activation_spec=activation_spec,
                         expert_spec=expert_spec, with_aux=True,
                         layers_fn=layers_fn, embed_lookup=embed_lookup,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype,
+                        remat_layers=remat_layers)
     # Fused form: nll = logsumexp(logits) - logits[target]. Identical math
     # to log_softmax + gather (log_softmax = logits - lse), but XLA skips
     # materializing the full (b, s, V) log-prob tensor — measured 13%
@@ -423,7 +444,8 @@ def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
                     attn_fn=None, activation_spec=None, expert_spec=None,
                     layers_fn=None, embed_lookup: str = "gather",
                     compute_dtype=jnp.bfloat16, shift: str = "split",
-                    xent_chunk: int | None = None):
+                    xent_chunk: int | None = None,
+                    remat_layers: bool = False):
     """AdamW train step via optax; jit with sharded params for TP/DP/SP."""
     import optax
     tx = optax.adamw(learning_rate, weight_decay=0.1)
@@ -438,7 +460,8 @@ def make_train_step(cfg: LlamaConfig, learning_rate: float = 3e-4,
                     expert_spec=expert_spec, layers_fn=layers_fn,
                     embed_lookup=embed_lookup,
                     compute_dtype=compute_dtype, shift=shift,
-                    xent_chunk=xent_chunk))(params, batch)
+                    xent_chunk=xent_chunk,
+                    remat_layers=remat_layers))(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -203,3 +203,23 @@ def test_llama_chunked_xent_matches_full_loss():
     with pytest.raises(ValueError, match="must divide"):
         llama.loss_fn(params, {"tokens": tokens}, cfg, shift="roll",
                       xent_chunk=5)
+
+
+def test_llama_remat_layers_matches_no_remat():
+    """remat_layers wraps each block in jax.checkpoint — the long-context
+    memory lever; loss and grads must be identical (checkpoint recompute
+    is exact)."""
+    import jax
+    import numpy as np
+    from petastorm_tpu.models import llama
+
+    cfg = llama.TINY
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+    f = lambda p, r: llama.loss_fn(p, {"tokens": tokens}, cfg,
+                                   aux_weight=0.0, remat_layers=r)
+    assert float(f(params, True)) == float(f(params, False))
+    g1 = jax.grad(lambda p: f(p, False))(params)
+    g2 = jax.grad(lambda p: f(p, True))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
