@@ -25,6 +25,11 @@ Phases (ordered so the scarce healthy-tunnel window is used FIRST):
    ``BatchedDataLoader``) on a plain 20-column numeric Parquet store; extra
    key ``scalar_batched_samples_per_sec`` (the reference only ever made a
    qualitative "significantly higher throughput" claim here, README.rst:242).
+4d. **stage_breakdown** — the columnar loader run under the pipeline's
+   :mod:`petastorm_tpu.telemetry` registry; the JSON line gains a
+   ``stage_breakdown`` block (decode / pool-queue / shuffle / host_wait /
+   stage / device_put wait, cumulative seconds) and a
+   ``stall_attribution`` verdict (docs/observability.md).
 5. **imagenet (late retry)** — if phase 0 found the tunnel wedged, re-probe
    after the CPU phases (a second window per run) and run the BASELINE.md
    target workload then; only after BOTH windows miss does the phase
@@ -355,6 +360,42 @@ def main():
     # form_ngram_dense) — this phase records the measured speedup that
     # makes the on-chip LLM pipeline feedable (see BENCH_TPU_EVIDENCE
     # llm_pipeline rowpath_* vs echo1_* for the same comparison on chip).
+    # ---- 4d. per-stage telemetry breakdown (docs/observability.md): run
+    # the columnar loader on the scalar store with the pipeline's shared
+    # TelemetryRegistry active and report where the wall-clock went —
+    # decode / pool-queue / shuffle / host_wait / stage / device_put wait —
+    # plus the stall attributor's host-vs-device verdict. This is the
+    # measurement layer later perf PRs are judged against: a regression in
+    # any one stage is visible here even when the headline samples/sec
+    # moves within noise.
+    breakdown_child = (
+        "import json, os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.jax import BatchedDataLoader\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "with make_batch_reader(url, num_epochs=None, shuffle_row_groups=False,\n"
+        "                       reader_pool_type='thread', workers_count=3) as reader:\n"
+        "    with BatchedDataLoader(reader, batch_size=1024,\n"
+        "                           shuffling_queue_capacity=8192,\n"
+        "                           seed=0) as loader:\n"
+        "        it = iter(loader)\n"
+        "        for _ in range(200):\n"
+        "            next(it)\n"
+        "        stall = loader.stall_report()\n"
+        "        breakdown = loader.stage_breakdown()\n"
+        "print('BENCHJSON:' + json.dumps({\n"
+        "    'stage_breakdown': breakdown,\n"
+        "    'stall_attribution': {'verdict': stall['verdict'],\n"
+        "                          'wait_fraction': stall['wait_fraction'],\n"
+        "                          'fractions': stall['fractions'],\n"
+        "                          'host_side': stall.get('host_side')}}))\n")
+    try:
+        out.update(_cpu_subprocess(breakdown_child, data_dir, timeout_s=600.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"stage breakdown phase failed: {e!r}", file=sys.stderr)
+
     ngram_child = (
         "import json, os, time\n"
         "import jax\n"

@@ -71,6 +71,10 @@ class BatchedNoopShufflingBuffer:
     def size(self) -> int:
         return self._size
 
+    @property
+    def capacity(self) -> int:
+        return 2 * self._batch_size
+
 
 class BatchedRandomShufflingBuffer:
     """Uniform random batch sampling out of a growable column-tensor pool.
@@ -142,3 +146,7 @@ class BatchedRandomShufflingBuffer:
     @property
     def size(self) -> int:
         return self._size
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity

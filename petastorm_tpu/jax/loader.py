@@ -41,7 +41,8 @@ from petastorm_tpu.jax.batched_buffer import (BatchedNoopShufflingBuffer,
                                               BatchedRandomShufflingBuffer)
 from petastorm_tpu.jax.dtypes import (DEFAULT_POLICY, DTypePolicy,
                                       sanitize_array, sanitize_batch)
-from petastorm_tpu.metrics import PipelineMetrics, trace
+from petastorm_tpu.metrics import PipelineMetrics, traced_span
+from petastorm_tpu.telemetry import StallAttributor, make_registry
 
 logger = logging.getLogger(__name__)
 
@@ -53,7 +54,8 @@ class LoaderBase:
                  pad_last: bool = False, sharding=None, device=None,
                  prefetch: int = 2, dtype_policy: DTypePolicy = DEFAULT_POLICY,
                  pad_variable_length_to=None, keep_host_fields: bool = True,
-                 steps_per_epoch: Optional[int] = None, echo: int = 1):
+                 steps_per_epoch: Optional[int] = None, echo: int = 1,
+                 telemetry=None):
         if pad_last and drop_last:
             drop_last = False
         self._batch_size = batch_size
@@ -95,7 +97,20 @@ class LoaderBase:
         # buffer is empty, so resume re-reads buffered groups (duplication)
         # rather than skipping them (loss). None = snapshot live state.
         self._pending_safe_state: Optional[dict] = None
-        self.metrics = PipelineMetrics()
+        # One registry for the whole pipeline: loaders consuming a Reader
+        # adopt ITS registry (subclasses pass it through ``telemetry=``), so
+        # worker decode, pool wait, shuffle, staging and stall attribution
+        # land in a single snapshot (docs/observability.md).
+        self.telemetry = telemetry if telemetry is not None else make_registry()
+        self.metrics = PipelineMetrics(telemetry=self.telemetry)
+        #: Per-``__next__`` host-bound / device-bound / balanced classifier;
+        #: see :meth:`stall_report`.
+        self.stall = StallAttributor(registry=self.telemetry)
+        self._shuffle_time = self.telemetry.counter("loader.shuffle_s")
+        # The registry is pipeline-cumulative; a second loader over the same
+        # reader must not inherit the first one's shuffle seconds in ITS
+        # stage_breakdown(), so remember where this loader started.
+        self._shuffle_base = self._shuffle_time.value
         self._last_staged_bytes = 0
         self._skipped_warned: set = set()
         # Per-column sticky conversion: "drop" or (kind, row_shape, dtype).
@@ -257,6 +272,15 @@ class LoaderBase:
         import threading
 
         q: queue_mod.Queue = queue_mod.Queue(maxsize=self._prefetch)
+        # One stable bound-method object: the identity-checked teardown in
+        # the finally below must see the same callable it registered.
+        depth_fn = q.qsize
+        self.telemetry.gauge("loader.prefetch_queue_depth", depth_fn)
+        # Plain value, not a closure over self: capacity is fixed for the
+        # iteration, and a callable gauge here would pin the whole loader
+        # in the reader-owned registry after this loader is discarded.
+        self.telemetry.gauge("loader.prefetch_queue_capacity").set(
+            self._prefetch)
         stop = threading.Event()
         _END, _ERR = object(), object()
 
@@ -274,7 +298,8 @@ class LoaderBase:
                 it = iter(host_batches)
                 while not stop.is_set():
                     t0 = time.perf_counter()
-                    with trace("petastorm_tpu.host_batch"):
+                    with traced_span("petastorm_tpu.host_batch",
+                                     self.telemetry):
                         try:
                             hb = next(it)
                         except StopIteration:
@@ -287,7 +312,7 @@ class LoaderBase:
                     # them: data loss on resume).
                     snap = self._snapshot_input_state()
                     t1 = time.perf_counter()
-                    with trace("petastorm_tpu.stage"):
+                    with traced_span("petastorm_tpu.stage", self.telemetry):
                         staged = self._stage(hb)
                     t2 = time.perf_counter()
                     n = len(next(iter(hb.values()))) if hb else 0
@@ -309,18 +334,41 @@ class LoaderBase:
                                   name="petastorm-tpu-stage")
         thread.start()
         try:
+            # Stall attribution: time blocked in q.get() is the input
+            # pipeline failing to keep ahead (the "device_put wait" a
+            # training step sees); time between our yields is the
+            # consumer's device step. The first delivery is pipeline
+            # spin-up, not a steady-state stall — skip it (same exclusion
+            # as benchmark.throughput.training_input_stall).
+            last_resume = None
             while True:
+                t0 = time.perf_counter()
                 kind, item, snap = q.get()
+                t1 = time.perf_counter()
                 if kind is _END:
                     break
                 if kind is _ERR:
                     raise item
+                if last_resume is not None:
+                    self.stall.observe(wait_s=t1 - t0,
+                                       busy_s=t0 - last_resume)
                 self._last_input_state = snap
+                # Timestamp BEFORE yielding: the consumer's device step runs
+                # while this generator is suspended in the yields below, so
+                # the next iteration's t0 - last_resume spans exactly that
+                # step (taking it after resume would measure microseconds of
+                # generator overhead and misclassify every step host_bound).
+                last_resume = time.perf_counter()
                 yield item
                 for _ in range(self._echo - 1):
                     yield self._echo_copy(item)
         finally:
             stop.set()
+            # Drop the queue-bound gauge closure: the registry outlives this
+            # iteration and would otherwise pin up to `prefetch` staged
+            # device batches (HBM!) through q.qsize's bound self.
+            self.telemetry.gauge(
+                "loader.prefetch_queue_depth").clear_function(depth_fn)
             # _put polls `stop` every 50ms, so the producer exits on its own
             # after at most one in-flight collate+stage. Bound the wait: if
             # the reader is wedged mid-next() the daemon thread is abandoned
@@ -462,6 +510,77 @@ class LoaderBase:
 
     def _host_batches(self):
         raise NotImplementedError
+
+    # ---------------------------------------------------------- telemetry
+    def stall_report(self) -> dict:
+        """Aggregate stall attribution for this loader's delivered batches:
+        per-class counts/fractions (host-bound / device-bound / balanced),
+        total delivery wait vs consumer busy time, and the host-side
+        ``host_wait_s``/``stage_s`` sub-attribution (production vs staging).
+        """
+        return self.stall.report(self.metrics)
+
+    def stage_breakdown(self) -> dict:
+        """Cumulative seconds per pipeline stage (the ``stage_breakdown``
+        block ``bench.py`` emits):
+
+        * ``decode_s`` — in-worker row-group read+decode (thread/dummy
+          pools; 0 for spawned process pools, whose workers cannot share
+          the registry)
+        * ``pool_queue_s`` — consumer blocked on the worker pool's results
+        * ``shuffle_s`` — shuffling-buffer add/retrieve time
+        * ``host_wait_s`` — staging thread waiting on batch production
+          (reader pull + collate; overlaps the two stages above)
+        * ``stage_s`` — sanitize + ``device_put`` dispatch
+        * ``device_put_wait_s`` — consumer blocked on the staged-batch
+          queue: the input stall a training step actually sees
+
+        The loader-side entries (shuffle/host_wait/stage/device_put wait)
+        count THIS loader's work only; the reader-side ones (decode,
+        pool-queue) are pipeline-cumulative, shared with any other loader
+        over the same reader — exactly like the reader they describe.
+        """
+        snap = self.telemetry.snapshot()
+        hists = snap["histograms"]
+        m = self.metrics.as_dict()
+
+        def _hsum(name):
+            return hists.get(name, {}).get("sum", 0.0)
+
+        shuffle_total = self._shuffle_time.value
+        if shuffle_total < self._shuffle_base:
+            # A registry-wide telemetry.reset() zeroed the shared counter
+            # underneath us; re-baseline at the reset point (see
+            # PipelineMetrics._read_raw for the same heal).
+            self._shuffle_base = 0.0
+        return {
+            "decode_s": round(_hsum("worker.decode_s"), 6),
+            "pool_queue_s": round(_hsum("reader.pool_wait_s"), 6),
+            "shuffle_s": round(shuffle_total - self._shuffle_base, 6),
+            "host_wait_s": m["host_wait_s"],
+            "stage_s": m["stage_s"],
+            "device_put_wait_s": self.stall.report()["delivery_wait_s"],
+        }
+
+    def _register_shuffle_gauges(self, buf):
+        """Register the buffer-occupancy gauges; returns the closures so
+        teardown can clear exactly what it registered."""
+        fill_fn = lambda: buf.size        # noqa: E731 - identity matters
+        capacity_fn = lambda: buf.capacity  # noqa: E731
+        self.telemetry.gauge("shuffle_buffer.fill", fill_fn)
+        self.telemetry.gauge("shuffle_buffer.capacity", capacity_fn)
+        return fill_fn, capacity_fn
+
+    def _clear_shuffle_gauges(self, fns) -> None:
+        """Drop the gauge closures once iteration ends: the registry lives
+        as long as the reader, and a retained closure would pin the whole
+        shuffling buffer (and its buffered rows) in memory. Identity-checked
+        (``clear_function``), so a stale iteration never nulls the gauges a
+        newer iteration re-registered."""
+        fill_fn, capacity_fn = fns
+        self.telemetry.gauge("shuffle_buffer.fill").clear_function(fill_fn)
+        self.telemetry.gauge(
+            "shuffle_buffer.capacity").clear_function(capacity_fn)
 
     def close(self):
         """Stop and join the underlying reader (no-op for loaders that
@@ -618,10 +737,16 @@ class DataLoader(LoaderBase):
     :param seed: buffer RNG seed
     """
 
+    #: Rows between flushes of locally-accumulated shuffle seconds into the
+    #: shared registry counter (bounds the staleness a mid-epoch snapshot
+    #: can see, while keeping the per-row hot path lock-free).
+    _SHUFFLE_FLUSH_ROWS = 256
+
     def __init__(self, reader, batch_size: int,
                  shuffling_queue_capacity: int = 0,
                  min_after_retrieve: Optional[int] = None,
                  seed: Optional[int] = None, **kwargs):
+        kwargs.setdefault("telemetry", getattr(reader, "telemetry", None))
         super().__init__(batch_size, **kwargs)
         if reader.batched_output:
             raise TypeError("DataLoader consumes make_reader readers; use "
@@ -649,19 +774,43 @@ class DataLoader(LoaderBase):
                                     else self._shuffling_capacity // 2),
                 extra_capacity=max(1000, self._shuffling_capacity),
                 seed=self._seed)
+            gauge_fns = self._register_shuffle_gauges(buf)
+            shuffle_time = self._shuffle_time
+            # This path is per-ROW (the batched loader is per-row-group):
+            # accumulate the measured seconds locally and flush to the
+            # shared locked counter every _SHUFFLE_FLUSH_ROWS rows, so the
+            # measurement itself doesn't pay two lock acquisitions per row.
+            pending_s, rows_out = 0.0, 0
             it = iter(self._reader)
             exhausted = False
-            while True:
-                while not exhausted and buf.can_add:
-                    try:
-                        buf.add_many([next(it)])
-                    except StopIteration:
-                        exhausted = True
-                        buf.finish()
-                if buf.can_retrieve:
-                    yield buf.retrieve()
-                elif exhausted:
-                    return
+            try:
+                while True:
+                    while not exhausted and buf.can_add:
+                        try:
+                            row = next(it)
+                        except StopIteration:
+                            exhausted = True
+                            buf.finish()
+                            break
+                        t0 = time.perf_counter()
+                        buf.add_many([row])
+                        pending_s += time.perf_counter() - t0
+                    if buf.can_retrieve:
+                        t0 = time.perf_counter()
+                        row = buf.retrieve()
+                        pending_s += time.perf_counter() - t0
+                        rows_out += 1
+                        if rows_out % self._SHUFFLE_FLUSH_ROWS == 0:
+                            shuffle_time.add(pending_s)
+                            pending_s = 0.0
+                        yield row
+                    elif exhausted:
+                        return
+            finally:
+                shuffle_time.add(pending_s)
+                # Generator close/exhaustion: stop the gauges from pinning
+                # the buffer (and its buffered rows) via their closures.
+                self._clear_shuffle_gauges(gauge_fns)
         else:
             yield from self._reader
 
@@ -791,6 +940,7 @@ class BatchedDataLoader(LoaderBase):
                  shuffling_queue_capacity: int = 0,
                  min_after_retrieve: Optional[int] = None,
                  seed: Optional[int] = None, **kwargs):
+        kwargs.setdefault("telemetry", getattr(reader, "telemetry", None))
         super().__init__(batch_size, **kwargs)
         if not reader.batched_output:
             raise TypeError("BatchedDataLoader consumes make_batch_reader readers")
@@ -820,42 +970,55 @@ class BatchedDataLoader(LoaderBase):
                 seed=self._seed)
         else:
             buf = BatchedNoopShufflingBuffer(self._batch_size)
+        gauge_fns = self._register_shuffle_gauges(buf)
+        shuffle_time = self._shuffle_time
 
         it = iter(self._reader)
         exhausted = False
         tail_cols = None
         buffered_rows = 0
-        while True:
-            while not exhausted and buf.can_add:
-                if buffered_rows == 0:
-                    # Rebatch buffer is empty: the reader cursor HERE is a
-                    # loss-safe resume point for every batch assembled from
-                    # rows pulled after it. Batches spanning a buffered
-                    # group tail keep the older snapshot — resume re-reads
-                    # the tail's group (duplication), never skips it.
-                    self._pending_safe_state = self._snapshot_live_state()
-                try:
-                    cols = self._group_to_columns(next(it))
-                    if cols:
-                        buffered_rows += len(next(iter(cols.values())))
-                        buf.add_many(cols)
-                except StopIteration:
-                    exhausted = True
-                    buf.finish()
-            if buf.can_retrieve:
-                batch = buf.retrieve()
-                n = len(next(iter(batch.values())))
-                buffered_rows = max(0, buffered_rows - n)
-                if n == self._batch_size:
-                    yield batch
-                else:
-                    tail_cols = batch
-            elif exhausted:
-                break
-        if tail_cols is not None:
-            tail = self._finalize_tail(tail_cols, len(next(iter(tail_cols.values()))))
-            if tail is not None:
-                yield tail
+        try:
+            while True:
+                while not exhausted and buf.can_add:
+                    if buffered_rows == 0:
+                        # Rebatch buffer is empty: the reader cursor HERE is
+                        # a loss-safe resume point for every batch assembled
+                        # from rows pulled after it. Batches spanning a
+                        # buffered group tail keep the older snapshot —
+                        # resume re-reads the tail's group (duplication),
+                        # never skips it.
+                        self._pending_safe_state = self._snapshot_live_state()
+                    try:
+                        cols = self._group_to_columns(next(it))
+                        if cols:
+                            buffered_rows += len(next(iter(cols.values())))
+                            t0 = time.perf_counter()
+                            buf.add_many(cols)
+                            shuffle_time.add(time.perf_counter() - t0)
+                    except StopIteration:
+                        exhausted = True
+                        buf.finish()
+                if buf.can_retrieve:
+                    t0 = time.perf_counter()
+                    batch = buf.retrieve()
+                    shuffle_time.add(time.perf_counter() - t0)
+                    n = len(next(iter(batch.values())))
+                    buffered_rows = max(0, buffered_rows - n)
+                    if n == self._batch_size:
+                        yield batch
+                    else:
+                        tail_cols = batch
+                elif exhausted:
+                    break
+            if tail_cols is not None:
+                tail = self._finalize_tail(
+                    tail_cols, len(next(iter(tail_cols.values()))))
+                if tail is not None:
+                    yield tail
+        finally:
+            # Generator close/exhaustion: stop the gauges from pinning the
+            # buffer (and its buffered column tensors) via their closures.
+            self._clear_shuffle_gauges(gauge_fns)
 
 
 class InMemBatchedDataLoader(LoaderBase):
@@ -864,6 +1027,7 @@ class InMemBatchedDataLoader(LoaderBase):
 
     def __init__(self, reader, batch_size: int, num_epochs: int = 1,
                  shuffle: bool = True, seed: Optional[int] = None, **kwargs):
+        kwargs.setdefault("telemetry", getattr(reader, "telemetry", None))
         super().__init__(batch_size, **kwargs)
         self._num_epochs = num_epochs
         self._shuffle = shuffle

@@ -2,47 +2,139 @@
 
 The reference's observability is three ad-hoc hooks (cProfile-wrapped
 threads, per-pool diagnostics dicts, a TF queue-size node — SURVEY.md §5).
-Here every loader keeps a :class:`PipelineMetrics` and the staging path is
-wrapped in ``jax.profiler`` trace annotations, so input-pipeline time shows
-up by name in TPU profiler traces next to the device steps.
+Here every loader keeps a :class:`PipelineMetrics` — a thread-safe view over
+the pipeline's :class:`~petastorm_tpu.telemetry.TelemetryRegistry` — and the
+staging path is wrapped in ``jax.profiler`` trace annotations, so
+input-pipeline time shows up by name in TPU profiler traces next to the
+device steps. The full per-stage picture (spans, queue gauges, stall
+attribution, Prometheus/JSON export) lives in
+:mod:`petastorm_tpu.telemetry`; see ``docs/observability.md``.
 """
 from __future__ import annotations
 
 import threading
 from contextlib import contextmanager
-from dataclasses import dataclass, field
 
 
-@dataclass
 class PipelineMetrics:
-    """Thread-safe counters for one loader/reader pipeline."""
-    batches: int = 0
-    samples: int = 0
-    bytes_staged: int = 0
-    host_wait_s: float = 0.0     # waiting on reader/collate (host side)
-    stage_s: float = 0.0         # sanitize + device_put dispatch
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    """Thread-safe counters for one loader/reader pipeline.
 
+    Backed by a :class:`~petastorm_tpu.telemetry.TelemetryRegistry` (the
+    loader's, so one registry covers the whole pipeline): ``record_batch``
+    feeds the registry's counters and per-stage latency/size histograms, and
+    :meth:`as_dict` is a view over those counters. The registry itself is
+    pipeline-cumulative — a second loader built over the same reader
+    CONTINUES the pipeline's ``loader.*`` totals (Prometheus counters never
+    go backwards) — so this view subtracts a construction-time baseline:
+    the public attributes (``batches``, ``samples``, ``bytes_staged``,
+    ``host_wait_s``, ``stage_s``) always count this instance's batches
+    only, matching the old per-loader dataclass semantics.
+    """
+
+    _FIELDS = ("batches", "samples", "bytes_staged", "host_wait_s",
+               "stage_s")
+
+    def __init__(self, telemetry=None):
+        if telemetry is None:
+            from petastorm_tpu.telemetry import make_registry
+            telemetry = make_registry()
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        from petastorm_tpu.telemetry import SIZE_BOUNDS
+        self._counters = {
+            "batches": telemetry.counter("loader.batches"),
+            "samples": telemetry.counter("loader.samples"),
+            "bytes_staged": telemetry.counter("loader.bytes_staged"),
+            "host_wait_s": telemetry.counter("loader.host_wait_s"),
+            "stage_s": telemetry.counter("loader.stage_s"),
+        }
+        self._host_wait_hist = telemetry.histogram("loader.host_wait_seconds")
+        self._stage_hist = telemetry.histogram("loader.stage_seconds")
+        self._bytes_hist = telemetry.histogram("loader.batch_bytes",
+                                               bounds=SIZE_BOUNDS)
+        self._base = {f: 0.0 for f in self._FIELDS}
+        self._base = self._read_raw()
+
+    def _read_raw(self) -> dict:
+        raw = {f: self._counters[f].value for f in self._FIELDS}
+        # A registry-wide ``telemetry.reset()`` zeroes the shared counters
+        # underneath every live view; a raw value below our baseline can
+        # only mean that happened, so re-baseline at zero (the reset point)
+        # instead of reporting negative deltas forever after.
+        for f, v in raw.items():
+            if v < self._base[f]:
+                self._base[f] = 0.0
+        return raw
+
+    def _delta(self, field: str):
+        v = self._counters[field].value
+        if v < self._base[field]:
+            self._base[field] = 0.0
+        return v - self._base[field]
+
+    # ------------------------------------------------------- compat fields
+    @property
+    def batches(self) -> int:
+        return int(self._delta("batches"))
+
+    @property
+    def samples(self) -> int:
+        return int(self._delta("samples"))
+
+    @property
+    def bytes_staged(self) -> int:
+        return int(self._delta("bytes_staged"))
+
+    @property
+    def host_wait_s(self) -> float:
+        return self._delta("host_wait_s")
+
+    @property
+    def stage_s(self) -> float:
+        return self._delta("stage_s")
+
+    # ------------------------------------------------------------ recording
     def record_batch(self, samples: int, nbytes: int, host_wait_s: float,
                      stage_s: float) -> None:
         with self._lock:
-            self.batches += 1
-            self.samples += samples
-            self.bytes_staged += nbytes
-            self.host_wait_s += host_wait_s
-            self.stage_s += stage_s
+            self._counters["batches"].add(1)
+            self._counters["samples"].add(samples)
+            self._counters["bytes_staged"].add(nbytes)
+            self._counters["host_wait_s"].add(host_wait_s)
+            self._counters["stage_s"].add(stage_s)
+        # Distributions are additive — no need to hold the group lock.
+        self._host_wait_hist.observe(host_wait_s)
+        self._stage_hist.observe(stage_s)
+        self._bytes_hist.observe(nbytes)
+
+    @staticmethod
+    def _rounded(raw: dict, base: dict) -> dict:
+        return {"batches": int(raw["batches"] - base["batches"]),
+                "samples": int(raw["samples"] - base["samples"]),
+                "bytes_staged": int(raw["bytes_staged"]
+                                    - base["bytes_staged"]),
+                "host_wait_s": round(raw["host_wait_s"]
+                                     - base["host_wait_s"], 4),
+                "stage_s": round(raw["stage_s"] - base["stage_s"], 4)}
 
     def as_dict(self) -> dict:
         with self._lock:
-            return {"batches": self.batches, "samples": self.samples,
-                    "bytes_staged": self.bytes_staged,
-                    "host_wait_s": round(self.host_wait_s, 4),
-                    "stage_s": round(self.stage_s, 4)}
+            return self._rounded(self._read_raw(), self._base)
 
-    def reset(self) -> None:
+    def reset(self) -> dict:
+        """Zero this view and return the pre-reset snapshot — one atomic
+        operation, so a metrics poller can never lose a batch recorded
+        between a separate read and reset (the old two-call race). Only
+        the baseline advances; the shared registry metrics — counters AND
+        the ``loader.*`` histograms — are untouched, because they may be
+        exported (Prometheus series must never decrease) and are shared
+        with any sibling loader over the same reader. Use
+        ``telemetry.reset()`` to drain the whole registry."""
         with self._lock:
-            self.batches = self.samples = self.bytes_staged = 0
-            self.host_wait_s = self.stage_s = 0.0
+            raw = self._read_raw()
+            snapshot = self._rounded(raw, self._base)
+            self._base = raw
+        return snapshot
 
 
 _TRACE_ANNOTATION = None  # resolved once; False = jax unavailable
@@ -66,3 +158,37 @@ def trace(name: str):
         return
     with _TRACE_ANNOTATION(name):
         yield
+
+
+def traced_span(name: str, telemetry=None):
+    """Context manager pairing a ``jax.profiler`` trace annotation with a
+    telemetry recorder span of the SAME name, so the profiler timeline and
+    the telemetry snapshot attribute time to identical labels."""
+    if telemetry is None:
+        return trace(name)
+    return _TracedSpan(name, telemetry)
+
+
+class _TracedSpan:
+    __slots__ = ("_name", "_telemetry", "_trace_cm", "_span_cm")
+
+    def __init__(self, name: str, telemetry):
+        self._name = name
+        self._telemetry = telemetry
+
+    def __enter__(self):
+        self._trace_cm = trace(self._name)
+        self._span_cm = self._telemetry.span(self._name)
+        self._trace_cm.__enter__()
+        self._span_cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self._span_cm.__exit__(*exc)
+        finally:
+            self._trace_cm.__exit__(*exc)
+        return False
+
+
+__all__ = ["PipelineMetrics", "trace", "traced_span"]

@@ -25,6 +25,8 @@ Parity: reference petastorm/reader.py — ``make_reader`` (:60),
 from __future__ import annotations
 
 import logging
+import os
+import time
 import warnings
 from collections import deque
 from typing import Optional
@@ -38,6 +40,8 @@ from petastorm_tpu.ngram import NGram
 from petastorm_tpu.reader_impl.batch_reader_worker import (BatchReaderWorker,
                                                            arrow_table_to_numpy_dict)
 from petastorm_tpu.reader_impl.row_reader_worker import RowReaderWorker
+from petastorm_tpu.telemetry import (PeriodicExporter, TELEMETRY_EXPORT_ENV,
+                                     make_registry)
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import Unischema, UnischemaField
 from petastorm_tpu.workers_pool import EmptyResultError, ITEM_CONTEXT_KWARG
@@ -456,6 +460,13 @@ class Reader:
         self.is_batched_reader = is_batched_reader
         self.last_row_consumed = False
         self._error = None
+        # One registry covers the whole pipeline: the pool's worker decode
+        # timings, the ventilator backlog gauge, this reader's pool-wait
+        # histogram, and (when a JAX loader consumes this reader) the
+        # loader's staging/stall metrics all land here. See
+        # docs/observability.md for the metric schema.
+        self.telemetry = make_registry()
+        self._telemetry_exporter = None
 
         cur_shard, shard_count = _resolve_shard(cur_shard, shard_count)
         if (cur_shard is None) != (shard_count is None):
@@ -575,12 +586,33 @@ class Reader:
             # each group as an uninterrupted one; pools echo the same context
             # in processed markers for the exact-resume watermark.
             item_context_key=ITEM_CONTEXT_KWARG)
+        # Queue gauges: sampled lazily at snapshot time, so they cost nothing
+        # on the hot path. The pool gets the shared registry BEFORE start()
+        # so worker threads can publish in-worker decode timings.
+        self.telemetry.gauge("ventilator.backlog",
+                             lambda: self._ventilator.inflight)
+        self.telemetry.gauge("ventilator.max_inflight",
+                             lambda: self._ventilator.max_inflight)
+        self.telemetry.gauge("pool.results_queue_depth",
+                             self._pool.results_qsize)
+        self.telemetry.counter("reader.rows")
+        self._pool.telemetry = self.telemetry
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         if is_batched_reader:
-            self._results_reader = _BatchResultsReader(self._pool, self.schema)
+            self._results_reader = _BatchResultsReader(self._pool, self.schema,
+                                                       telemetry=self.telemetry)
         else:
-            self._results_reader = _RowResultsReader(self._pool, self.schema, self.ngram)
+            self._results_reader = _RowResultsReader(self._pool, self.schema,
+                                                     self.ngram,
+                                                     telemetry=self.telemetry)
+
+        export_path = os.environ.get(TELEMETRY_EXPORT_ENV)
+        if export_path:
+            self._telemetry_exporter = PeriodicExporter(
+                self.telemetry, export_path,
+                fmt=("prometheus" if export_path.endswith(".prom")
+                     else "json")).start()
 
     # ------------------------------------------------------------- planning
     def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
@@ -707,6 +739,9 @@ class Reader:
 
     # ------------------------------------------------------------- lifetime
     def stop(self):
+        if self._telemetry_exporter is not None:
+            self._telemetry_exporter.stop()
+            self._telemetry_exporter = None
         self._pool.stop()
 
     def join(self):
@@ -722,7 +757,14 @@ class Reader:
 
     @property
     def diagnostics(self):
-        return self._pool.diagnostics
+        """Pipeline health view: the pool's unified queue/item counters
+        (same keys for every pool type), the ventilator backlog, and the
+        full telemetry snapshot (counters/gauges/histograms/spans) under
+        ``"telemetry"`` — one dict a dashboard can serialize as-is."""
+        d = dict(self._pool.diagnostics)
+        d["ventilator_backlog"] = self._ventilator.inflight
+        d["telemetry"] = self.telemetry.snapshot()
+        return d
 
     def cleanup_cache(self):
         """Remove this reader's row-group cache contents (parity: reference
@@ -737,39 +779,78 @@ class Reader:
         return self.is_batched_reader
 
 
-class _RowResultsReader:
+class _PoolWaitTimer:
+    """Times consumer blocking in ``pool.get_results()`` into the pipeline
+    registry (``reader.pool_wait_s`` histogram + a recorder span) — the
+    "pool-queue" stage of the per-stage breakdown."""
+
+    def __init__(self, pool, telemetry):
+        self._pool = pool
+        self._telemetry = telemetry
+        self._wait_hist = (telemetry.histogram("reader.pool_wait_s")
+                           if telemetry is not None else None)
+        # DummyPool decodes INLINE inside get_results; subtract that growth
+        # so pool_wait_s and worker.decode_s stay disjoint stages. Resolved
+        # once: threaded/process pools (no such attribute) skip the reads.
+        self._inline_decode_pool = (
+            pool if hasattr(pool, "inline_decode_s") else None)
+
+    def get_results(self):
+        if self._wait_hist is None:
+            return self._pool.get_results()
+        inline0 = (self._inline_decode_pool.inline_decode_s
+                   if self._inline_decode_pool is not None else 0.0)
+        t0 = time.perf_counter()
+        with self._telemetry.span("petastorm_tpu.pool_wait"):
+            result = self._pool.get_results()
+        wait = time.perf_counter() - t0
+        if self._inline_decode_pool is not None:
+            wait -= self._inline_decode_pool.inline_decode_s - inline0
+        self._wait_hist.observe(max(0.0, wait))
+        return result
+
+
+class _RowResultsReader(_PoolWaitTimer):
     """Buffers published row lists; yields one namedtuple (or ngram dict of
     namedtuples) per ``read_next`` (parity: py_dict_reader_worker.py:64-97)."""
 
-    def __init__(self, pool, schema, ngram):
-        self._pool = pool
+    def __init__(self, pool, schema, ngram, telemetry=None):
+        super().__init__(pool, telemetry)
         self._schema = schema
         self._ngram = ngram
         self._buffer = deque()
+        self._rows = (telemetry.counter("reader.rows")
+                      if telemetry is not None else None)
 
     def read_next(self):
         while not self._buffer:
-            self._buffer.extend(self._pool.get_results())
+            self._buffer.extend(self.get_results())
         item = self._buffer.popleft()
+        if self._rows is not None:
+            self._rows.add(1)
         if self._ngram is not None:
             return item  # already {offset: namedtuple}
         return self._schema.make_namedtuple_from_dict(item)
 
 
-class _BatchResultsReader:
+class _BatchResultsReader(_PoolWaitTimer):
     """Yields one namedtuple-of-numpy-arrays per row group
     (parity: arrow_reader_worker.py:89-111, batched_output=True)."""
 
-    def __init__(self, pool, schema):
-        self._pool = pool
+    def __init__(self, pool, schema, telemetry=None):
+        super().__init__(pool, telemetry)
         self._schema = schema
+        self._rows = (telemetry.counter("reader.rows")
+                      if telemetry is not None else None)
 
     def read_next(self):
-        result = self._pool.get_results()
+        result = self.get_results()
         if not isinstance(result, dict):
             # Payload shape depends on convert_early_to_numpy, not pool type:
             # workers publish Tables by default (converted here) and numpy
             # dicts when converting early (incl. the process pool's shm
             # result_transform path).
             result = arrow_table_to_numpy_dict(result, self._schema)
+        if self._rows is not None and result:
+            self._rows.add(len(next(iter(result.values()))))
         return self._schema.make_namedtuple_from_dict(result)

@@ -44,6 +44,12 @@ class ShufflingBufferBase:
     def size(self) -> int:
         raise NotImplementedError
 
+    @property
+    def capacity(self) -> int:
+        """Nominal row capacity (0 = unbounded) — lets telemetry gauges
+        report fill alongside the bound."""
+        return 0
+
 
 class NoopShufflingBuffer(ShufflingBufferBase):
     """Pass-through FIFO (shuffling disabled)."""
@@ -132,3 +138,7 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     @property
     def size(self):
         return len(self._items)
+
+    @property
+    def capacity(self):
+        return self._capacity

@@ -1,0 +1,78 @@
+"""End-to-end pipeline telemetry.
+
+One :class:`TelemetryRegistry` per pipeline collects:
+
+* **spans** — monotonic-clock timed sections with thread/process provenance
+  (ring-buffer bounded, :class:`SpanRecorder`), kept name-coherent with the
+  ``jax.profiler`` trace annotations emitted on the same paths;
+* **histograms** — streaming fixed-bucket latency / byte-size distributions
+  (:class:`StreamingHistogram`);
+* **gauges** — live queue depths: ventilator backlog, worker-pool results
+  queue, shuffling-buffer fill, prefetch queue;
+* **counters** — rows, batches, bytes, per-stage cumulative seconds;
+* **stall attribution** — per-``__next__`` host-bound / device-bound /
+  balanced classification (:class:`StallAttributor`).
+
+Exports: Prometheus text format and JSON snapshots
+(:mod:`petastorm_tpu.telemetry.exporters`), plus a ``python -m
+petastorm_tpu.telemetry`` CLI to dump/watch a live pipeline. See
+``docs/observability.md``.
+
+Stage metric names (the documented schema; also the keys behind
+``bench.py``'s ``stage_breakdown``):
+
+==============================  =================================================
+metric                          meaning
+==============================  =================================================
+``worker.decode_s``             in-worker row-group read+decode (histogram; in-
+                                process pools only — 0 for spawned process pools)
+``reader.pool_wait_s``          consumer blocked on the pool's results queue
+``loader.shuffle_s``            shuffling-buffer add/retrieve time (counter)
+``loader.host_wait_s``          staging thread waiting on batch production
+``loader.stage_s``              sanitize + ``device_put`` dispatch (histogram)
+``loader.delivery_wait_s``      consumer blocked on the staged-batch queue
+                                (the "device_put wait" a training step sees)
+``ventilator.backlog``          ventilated-but-unprocessed row groups (gauge)
+``pool.results_queue_depth``    results queue fill (gauge)
+``shuffle_buffer.fill``         shuffling-buffer occupancy (gauge)
+==============================  =================================================
+"""
+from petastorm_tpu.telemetry.exporters import (PeriodicExporter, from_json,
+                                               parse_prometheus_text,
+                                               to_json, to_prometheus_text,
+                                               write_snapshot)
+from petastorm_tpu.telemetry.histogram import (LATENCY_BOUNDS_S, SIZE_BOUNDS,
+                                               StreamingHistogram)
+from petastorm_tpu.telemetry.recorder import Span, SpanRecorder
+from petastorm_tpu.telemetry.registry import (SNAPSHOT_SCHEMA_VERSION,
+                                              Counter, Gauge,
+                                              TelemetryRegistry)
+from petastorm_tpu.telemetry.stall import StallAttributor
+
+#: Environment variable: when set to a path, every Reader auto-starts a
+#: PeriodicExporter writing JSON snapshots there (``.prom`` suffix switches
+#: to Prometheus text format) — the hook ``python -m petastorm_tpu.telemetry
+#: watch <path>`` consumes.
+TELEMETRY_EXPORT_ENV = "PETASTORM_TPU_TELEMETRY_EXPORT"
+
+#: Environment variable: any non-empty value enables span recording on every
+#: new registry (spans default off — gauges/counters/histograms are always
+#: on, they are cheap).
+TELEMETRY_SPANS_ENV = "PETASTORM_TPU_TELEMETRY_SPANS"
+
+
+def make_registry() -> TelemetryRegistry:
+    """A registry honoring :data:`TELEMETRY_SPANS_ENV`."""
+    import os
+    return TelemetryRegistry(
+        spans_enabled=bool(os.environ.get(TELEMETRY_SPANS_ENV)))
+
+
+__all__ = [
+    "Counter", "Gauge", "LATENCY_BOUNDS_S", "PeriodicExporter",
+    "SIZE_BOUNDS", "SNAPSHOT_SCHEMA_VERSION", "Span", "SpanRecorder",
+    "StallAttributor", "StreamingHistogram", "TELEMETRY_EXPORT_ENV",
+    "TELEMETRY_SPANS_ENV", "TelemetryRegistry", "from_json", "make_registry",
+    "parse_prometheus_text", "to_json", "to_prometheus_text",
+    "write_snapshot",
+]

@@ -1,0 +1,123 @@
+"""Telemetry CLI: inspect a live pipeline's exported snapshots.
+
+A pipeline process started with ``PETASTORM_TPU_TELEMETRY_EXPORT=/tmp/pt.json``
+(or one that called ``PeriodicExporter(registry, path).start()``) keeps a
+fresh JSON snapshot on disk; this tool renders it:
+
+    python -m petastorm_tpu.telemetry dump /tmp/pt.json
+    python -m petastorm_tpu.telemetry dump /tmp/pt.json --format prometheus
+    python -m petastorm_tpu.telemetry watch /tmp/pt.json --interval 2
+
+``dump`` prints one rendering and exits; ``watch`` re-renders every
+``--interval`` seconds until interrupted (or ``--count`` iterations, for
+scripting). Exit code 1 when the snapshot file is missing/unreadable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from petastorm_tpu.telemetry.exporters import from_json, to_prometheus_text
+
+_STAGE_ORDER = ("worker.decode_s", "reader.pool_wait_s", "loader.shuffle_s",
+                "loader.host_wait_s", "loader.stage_s",
+                "loader.delivery_wait_s")
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return from_json(f.read())
+
+
+def _render_pretty(snap: dict) -> str:
+    lines = [f"schema_version: {snap.get('schema_version', '?')}"]
+    gauges = snap.get("gauges", {})
+    if gauges:
+        lines.append("gauges:")
+        for name, value in gauges.items():
+            lines.append(f"  {name:<32} {'n/a' if value is None else value}")
+    counters = snap.get("counters", {})
+    if counters:
+        lines.append("counters:")
+        for name, value in counters.items():
+            lines.append(f"  {name:<32} {value}")
+    hists = snap.get("histograms", {})
+    if hists:
+        lines.append("histograms (count / p50 / p95 / p99 / sum):")
+        for name, h in hists.items():
+            lines.append(
+                f"  {name:<32} {h['count']:>8} / {h['p50']:.6g} / "
+                f"{h['p95']:.6g} / {h['p99']:.6g} / {h['sum']:.6g}")
+    spans = snap.get("spans", {})
+    if spans:
+        lines.append("spans (count / total_s / max_s):")
+        for name, agg in spans.items():
+            lines.append(f"  {name:<32} {agg['count']:>8} / "
+                         f"{agg['total_s']:.6g} / {agg['max_s']:.6g}")
+    stage = _stage_breakdown(snap)
+    if stage:
+        lines.append("per-stage seconds:")
+        for name, total in stage.items():
+            lines.append(f"  {name:<32} {total:.6g}")
+    return "\n".join(lines)
+
+
+def _stage_breakdown(snap: dict) -> dict:
+    """Cumulative seconds per pipeline stage from a snapshot (counters hold
+    ``*_s`` totals; histograms contribute their sums)."""
+    out = {}
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    for name in _STAGE_ORDER:
+        if name in counters:
+            out[name] = counters[name]
+        elif name in hists:
+            out[name] = hists[name].get("sum", 0.0)
+    return out
+
+
+def _render(snap: dict, fmt: str) -> str:
+    if fmt == "json":
+        return json.dumps(snap, indent=2, sort_keys=True)
+    if fmt == "prometheus":
+        return to_prometheus_text(snap)
+    return _render_pretty(snap)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m petastorm_tpu.telemetry",
+        description="Dump or watch a pipeline telemetry snapshot file.")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name in ("dump", "watch"):
+        p = sub.add_parser(name)
+        p.add_argument("path", help="snapshot file written by "
+                                    "PeriodicExporter / "
+                                    "PETASTORM_TPU_TELEMETRY_EXPORT")
+        p.add_argument("--format", choices=("pretty", "json", "prometheus"),
+                       default="pretty")
+    watch = sub.choices["watch"]
+    watch.add_argument("--interval", type=float, default=2.0)
+    watch.add_argument("--count", type=int, default=0,
+                       help="stop after N renders (0 = forever)")
+    args = parser.parse_args(argv)
+
+    renders = 0
+    while True:
+        try:
+            snap = _load(args.path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot {args.path}: {e}", file=sys.stderr)
+            return 1
+        print(_render(snap, args.format))
+        renders += 1
+        if args.cmd == "dump" or (args.count and renders >= args.count):
+            return 0
+        print("---", flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,175 @@
+"""Snapshot exporters: Prometheus text exposition and JSON.
+
+Both render the dict produced by
+:meth:`petastorm_tpu.telemetry.TelemetryRegistry.snapshot`:
+
+* :func:`to_prometheus_text` — the ``text/plain; version=0.0.4`` exposition
+  format (``# TYPE`` headers, cumulative ``_bucket{le=...}`` histogram
+  series). Scrape it from a file with Prometheus' node-exporter textfile
+  collector, or serve the string from any HTTP handler.
+* :func:`to_json` / :func:`from_json` — a lossless round-trip of the
+  snapshot for programmatic consumers and the ``python -m
+  petastorm_tpu.telemetry`` CLI.
+* :class:`PeriodicExporter` — background thread writing fresh snapshots to a
+  file (atomic rename) every ``interval_s``; setting
+  ``PETASTORM_TPU_TELEMETRY_EXPORT=/path.json`` on any reader-owning process
+  turns this on automatically (see :mod:`petastorm_tpu.reader`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Optional
+
+__all__ = ["to_prometheus_text", "parse_prometheus_text", "to_json",
+           "from_json", "write_snapshot", "PeriodicExporter"]
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)$")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    return _NAME_SANITIZE.sub("_", f"{prefix}_{name}")
+
+
+def _fmt(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(round(float(value), 9))
+
+
+def to_prometheus_text(snapshot: dict,
+                       prefix: str = "petastorm_tpu") -> str:
+    """Render a registry snapshot in Prometheus text exposition format.
+    Dead gauges (value ``None``) are skipped; span aggregates export as
+    ``<prefix>_span_seconds_total``/``_count`` with a ``name`` label."""
+    lines = []
+    for name, value in snapshot.get("counters", {}).items():
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {_fmt(value)}")
+    for name, value in snapshot.get("gauges", {}).items():
+        if value is None:
+            continue
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {_fmt(value)}")
+    for name, h in snapshot.get("histograms", {}).items():
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} histogram")
+        for bound, cum in h.get("buckets", []):
+            le = "+Inf" if bound is None else _fmt(bound)
+            lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+        lines.append(f"{pname}_sum {_fmt(h.get('sum', 0.0))}")
+        lines.append(f"{pname}_count {h.get('count', 0)}")
+    spans = snapshot.get("spans", {})
+    if spans:
+        total = _prom_name("span_seconds_total", prefix)
+        count = _prom_name("span_count", prefix)
+        lines.append(f"# TYPE {total} counter")
+        lines.append(f"# TYPE {count} counter")
+        for name, agg in spans.items():
+            label = name.replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(f'{total}{{name="{label}"}} '
+                         f'{_fmt(agg["total_s"])}')
+            lines.append(f'{count}{{name="{label}"}} {agg["count"]}')
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Minimal exposition-format parser (used by tests and the CLI to
+    verify/inspect exports): returns ``{metric_name: {labels_str_or_"":
+    float}}`` and raises ``ValueError`` on any malformed sample line."""
+    out: dict = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if not m:
+            raise ValueError(f"malformed Prometheus sample on line "
+                             f"{lineno}: {raw!r}")
+        value = m.group("value")
+        if value == "+Inf":
+            fval = float("inf")
+        elif value == "-Inf":
+            fval = float("-inf")
+        else:
+            fval = float(value)  # raises ValueError on garbage
+        out.setdefault(m.group("name"), {})[m.group("labels") or ""] = fval
+    return out
+
+
+def to_json(snapshot: dict, indent: Optional[int] = None) -> str:
+    return json.dumps(snapshot, indent=indent, sort_keys=True)
+
+
+def from_json(text: str) -> dict:
+    return json.loads(text)
+
+
+def write_snapshot(path: str, snapshot: dict, fmt: str = "json") -> None:
+    """Write a snapshot atomically (same-directory temp file + rename), so a
+    concurrent ``telemetry watch`` never reads a half-written file. The temp
+    name is pid- AND thread-unique: two exporters in one process (e.g. two
+    Readers auto-started by the same export env var) must not truncate each
+    other's in-progress write."""
+    if fmt == "json":
+        payload = to_json(snapshot, indent=2)
+    elif fmt == "prometheus":
+        payload = to_prometheus_text(snapshot)
+    else:
+        raise ValueError(f"fmt must be 'json' or 'prometheus', got {fmt!r}")
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+class PeriodicExporter:
+    """Daemon thread exporting ``registry.snapshot()`` to ``path`` every
+    ``interval_s`` (and once more on ``stop()``, so the final state always
+    lands on disk)."""
+
+    def __init__(self, registry, path: str, interval_s: float = 2.0,
+                 fmt: str = "json"):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self._registry = registry
+        self._path = path
+        self._interval = interval_s
+        self._fmt = fmt
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PeriodicExporter":
+        if self._thread is not None:
+            raise RuntimeError("PeriodicExporter already started")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="petastorm-tpu-telemetry-export")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            self._write_once()
+
+    def _write_once(self):
+        try:
+            write_snapshot(self._path, self._registry.snapshot(), self._fmt)
+        except OSError:
+            pass  # a transiently unwritable path must not kill the pipeline
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 5.0)
+            self._thread = None
+        self._write_once()

@@ -1,0 +1,160 @@
+"""Low-overhead span/event recorder.
+
+A :class:`SpanRecorder` collects named, monotonic-clock spans with thread and
+process provenance into a bounded ring buffer (old spans are evicted, the
+pipeline never grows without bound). The disabled hot path is a single
+attribute check returning a shared no-op context manager — cheap enough to
+leave ``recorder.span(...)`` permanently inlined on per-batch paths.
+
+Clock discipline: spans use ``time.perf_counter()`` exclusively.
+``time.time()`` is wall-clock and can step backwards under NTP slew — it is
+banned from hot paths repo-wide (enforced by ``tools/check_monotonic.py``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Span", "SpanRecorder"]
+
+
+@dataclass(frozen=True)
+class Span:
+    """One completed span. ``start_s`` is a ``perf_counter`` timestamp —
+    meaningful only relative to other spans from the same process."""
+    name: str
+    start_s: float
+    duration_s: float
+    thread: str
+    thread_id: int
+    pid: int
+    extra: Optional[dict] = field(default=None)
+
+    def as_dict(self) -> dict:
+        d = {"name": self.name, "start_s": round(self.start_s, 6),
+             "duration_s": round(self.duration_s, 6), "thread": self.thread,
+             "thread_id": self.thread_id, "pid": self.pid}
+        if self.extra:
+            d["extra"] = dict(self.extra)
+        return d
+
+
+class _NoopSpan:
+    """Shared disabled-path context manager: no allocation per call."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_recorder", "_name", "_extra", "_t0")
+
+    def __init__(self, recorder, name, extra):
+        self._recorder = recorder
+        self._name = name
+        self._extra = extra
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._recorder.record(self._name, self._t0, t1 - self._t0,
+                              extra=self._extra)
+        return False
+
+
+class SpanRecorder:
+    """Ring-buffer bounded span sink.
+
+    :param capacity: max retained spans (oldest evicted first)
+    :param enabled: record spans when True; when False ``span()`` returns a
+        shared no-op context manager (sub-microsecond)
+    """
+
+    def __init__(self, capacity: int = 4096, enabled: bool = False):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self.enabled = bool(enabled)
+        self.capacity = capacity
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def span(self, name: str, extra: Optional[dict] = None):
+        """Context manager timing one span; no-op while disabled."""
+        if not self.enabled:
+            return _NOOP_SPAN
+        return _LiveSpan(self, name, extra)
+
+    def record(self, name: str, start_s: float, duration_s: float,
+               extra: Optional[dict] = None) -> None:
+        if not self.enabled:
+            return
+        t = threading.current_thread()
+        sp = Span(name, start_s, duration_s, t.name, t.ident or 0,
+                  os.getpid(), extra)
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(sp)
+
+    def record_event(self, name: str, extra: Optional[dict] = None) -> None:
+        """Zero-duration marker (e.g. 'epoch_end', 'worker_failure')."""
+        self.record(name, time.perf_counter(), 0.0, extra=extra)
+
+    # ------------------------------------------------------------ readout
+    def spans(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def drain(self) -> list:
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+            return out
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def aggregate(self) -> dict:
+        """Per-name aggregate of currently retained spans:
+        ``{name: {"count", "total_s", "max_s"}}``."""
+        return self.aggregate_spans(self.spans())
+
+    @staticmethod
+    def aggregate_spans(spans) -> dict:
+        """:meth:`aggregate` over an explicit span list — lets a caller
+        aggregate exactly what :meth:`drain` returned, with no window for
+        concurrent records to slip between the two."""
+        out: dict = {}
+        for sp in spans:
+            agg = out.setdefault(sp.name, {"count": 0, "total_s": 0.0,
+                                           "max_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += sp.duration_s
+            agg["max_s"] = max(agg["max_s"], sp.duration_s)
+        for agg in out.values():
+            agg["total_s"] = round(agg["total_s"], 6)
+            agg["max_s"] = round(agg["max_s"], 6)
+        return out

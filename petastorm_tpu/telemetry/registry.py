@@ -1,0 +1,174 @@
+"""Named-metric registry: counters, gauges, histograms + a span recorder.
+
+One :class:`TelemetryRegistry` instance covers one pipeline end-to-end — the
+Reader creates it, hands it to its worker pool and ventilator, and a JAX
+loader consuming that reader adopts the same instance, so a single
+``snapshot()`` shows decode, queueing, shuffling, and staging side by side.
+
+Metric names are dotted (``reader.pool_wait_s``); exporters sanitize them
+for their format (Prometheus rewrites ``.`` to ``_``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Sequence
+
+from petastorm_tpu.telemetry.histogram import StreamingHistogram
+from petastorm_tpu.telemetry.recorder import SpanRecorder
+
+__all__ = ["Counter", "Gauge", "TelemetryRegistry", "SNAPSHOT_SCHEMA_VERSION"]
+
+SNAPSHOT_SCHEMA_VERSION = 1
+
+
+class Counter:
+    """Monotonic (never decremented) thread-safe counter; float-valued so
+    it can accumulate seconds as well as item counts."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> float:
+        """Zero the counter, returning the pre-reset value (atomic)."""
+        with self._lock:
+            v, self._value = self._value, 0.0
+            return v
+
+
+class Gauge:
+    """Point-in-time value: either ``set()`` explicitly or backed by a
+    zero-argument callable sampled at snapshot time."""
+
+    __slots__ = ("_value", "_fn", "_lock")
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._value = 0.0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def set_function(self, fn: Optional[Callable[[], float]]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def clear_function(self, expected: Callable[[], float]) -> None:
+        """Drop the backing callable only while it is still ``expected`` —
+        so a stale iteration's teardown can't null the closure a newer
+        iteration (or a sibling loader sharing the registry) re-registered
+        under the same name."""
+        with self._lock:
+            if self._fn is expected:
+                self._fn = None
+
+    @property
+    def value(self) -> Optional[float]:
+        """Current value; ``None`` when a callable-backed gauge fails (its
+        subject was torn down) — exporters skip None rather than lying."""
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:  # noqa: BLE001 - dead gauge target, not an error
+            return None
+
+
+class TelemetryRegistry:
+    """Get-or-create keyed metric store. All accessors are thread-safe and
+    idempotent: the first caller fixes a histogram's bucket bounds."""
+
+    def __init__(self, span_capacity: int = 4096,
+                 spans_enabled: bool = False):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, StreamingHistogram] = {}
+        self.recorder = SpanRecorder(capacity=span_capacity,
+                                     enabled=spans_enabled)
+
+    # ------------------------------------------------------------ create
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            return c
+
+    def gauge(self, name: str,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(fn)
+            elif fn is not None:
+                g.set_function(fn)
+            return g
+
+    def histogram(self, name: str,
+                  bounds: Optional[Sequence[float]] = None) -> StreamingHistogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = StreamingHistogram(bounds)
+            return h
+
+    def span(self, name: str, extra: Optional[dict] = None):
+        """Shortcut for ``registry.recorder.span(...)``."""
+        return self.recorder.span(name, extra)
+
+    # ------------------------------------------------------------ readout
+    def snapshot(self) -> dict:
+        """JSON-safe point-in-time view of every registered metric."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "counters": {k: round(c.value, 6)
+                         for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
+            "histograms": {k: h.as_dict()
+                           for k, h in sorted(histograms.items())},
+            "spans": self.recorder.aggregate(),
+        }
+
+    def reset(self) -> dict:
+        """Zero counters/histograms and drain spans, returning the pre-reset
+        snapshot. Atomic per metric: each counter/histogram is read AND
+        zeroed under one lock hold (:meth:`Counter.reset`,
+        :meth:`StreamingHistogram.drain`), so a concurrent ``add()`` /
+        ``observe()`` lands either in the returned snapshot or in the new
+        epoch — never lost between a read and a reset. Gauges are live
+        views and are left alone."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "counters": {k: round(c.reset(), 6)
+                         for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
+            "histograms": {k: h.drain()
+                           for k, h in sorted(histograms.items())},
+            "spans": SpanRecorder.aggregate_spans(self.recorder.drain()),
+        }

@@ -135,8 +135,10 @@ def main(url: str, coordinator: str, process_id: int, num_processes: int,
                 # results — the mid-stream teardown path).
                 import time
                 time.sleep(0.5)
+                # Unified diagnostics schema: every pool type reports
+                # output_queue_size (no special-casing needed).
                 queued_at_stop = int(
-                    reader.diagnostics.get("output_queue_size", 0))
+                    reader.diagnostics["output_queue_size"])
                 break
     if mode == "img_part1_stop":
         _dump(out_path, process_id, ids, pixel_sums, global_shapes,

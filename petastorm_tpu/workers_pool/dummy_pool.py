@@ -9,6 +9,7 @@ Parity: reference petastorm/workers_pool/dummy_pool.py — ``DummyPool`` (:20),
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 
 from petastorm_tpu.workers_pool import (EmptyResultError,
@@ -25,6 +26,20 @@ class DummyPool:
         self._worker = None
         self._ventilator = None
         self._stopped = False
+        self._ventilated = 0
+        self._processed = 0
+        # Pipeline telemetry registry (assigned by the owning Reader before
+        # start()); decode runs inline so it is timed right here. The decode
+        # histogram is resolved once and cached — per-item registry lookups
+        # would pay a lock acquire on every row group.
+        self.telemetry = None
+        self._decode_hist = None
+        #: Cumulative seconds of decode run INLINE inside ``get_results``.
+        #: The reader's pool-wait timer wraps ``get_results`` and subtracts
+        #: the growth of this value, so ``reader.pool_wait_s`` and
+        #: ``worker.decode_s`` stay disjoint stages for this pool too
+        #: (threaded pools decode off-thread, so only this pool needs it).
+        self.inline_decode_s = 0.0
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         if self._worker is not None:
@@ -38,6 +53,7 @@ class DummyPool:
         self._results.append(data)
 
     def ventilate(self, *args, **kwargs):
+        self._ventilated += 1
         self._pending.append((args, kwargs))
 
     def get_results(self):
@@ -48,20 +64,31 @@ class DummyPool:
             while self._results:
                 result = self._results.popleft()
                 if isinstance(result, VentilatedItemProcessedMessage):
+                    self._processed += 1
                     if self._ventilator:
                         self._ventilator.processed_item(result.item_context)
                     continue
                 return result
             if self._pending:
                 args, kwargs = self._pending.popleft()
-                self._worker.process(*args, **kwargs)
+                if self.telemetry is not None:
+                    if self._decode_hist is None:
+                        self._decode_hist = self.telemetry.histogram(
+                            "worker.decode_s")
+                    t0 = time.perf_counter()
+                    with self.telemetry.span("petastorm_tpu.worker_decode"):
+                        self._worker.process(*args, **kwargs)
+                    dt = time.perf_counter() - t0
+                    self._decode_hist.observe(dt)
+                    self.inline_decode_s += dt
+                else:
+                    self._worker.process(*args, **kwargs)
                 self._results.append(VentilatedItemProcessedMessage(
                     kwargs.get(ITEM_CONTEXT_KWARG)))
                 continue
             if self._ventilator is None or self._ventilator.completed():
                 raise EmptyResultError()
             # The ventilator thread may still be feeding us; yield briefly.
-            import time
             time.sleep(0.001)
 
     def stop(self):
@@ -78,4 +105,12 @@ class DummyPool:
 
     @property
     def diagnostics(self):
-        return {"output_queue_size": len(self._results)}
+        """Unified pool schema (same keys across thread/process/dummy
+        pools). ``output_queue_size`` counts pending publications, which may
+        include processed-item markers not yet consumed."""
+        return {"output_queue_size": len(self._results),
+                "items_ventilated": self._ventilated,
+                "items_processed": self._processed,
+                "items_inprocess": self._ventilated - self._processed,
+                "workers_count": self.workers_count,
+                "results_queue_capacity": 0}

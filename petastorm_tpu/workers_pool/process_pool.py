@@ -128,6 +128,11 @@ class ProcessPool:
         self._ventilated = 0
         self._processed = 0
         self._stopped = False
+        # Pipeline telemetry registry (assigned by the owning Reader before
+        # start()). Spawned workers cannot share it, so in-worker decode
+        # time is not observable here — the consumer-side pool wait recorded
+        # by the reader is this pool's queueing signal.
+        self.telemetry = None
         ipc_dir = tempfile.mkdtemp(prefix="pt_pool_")
         token = uuid.uuid4().hex[:8]
         self._endpoints = {
@@ -169,9 +174,9 @@ class ProcessPool:
         # Ready-handshake: every worker's PUSH is connected before any
         # ventilation, so no work item can hit a half-built topology.
         ready = set()
-        deadline = time.time() + _WORKER_START_TIMEOUT_S
+        deadline = time.monotonic() + _WORKER_START_TIMEOUT_S
         while len(ready) < self.workers_count:
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 self.stop(); self.join()
                 raise RuntimeError(
                     f"Only {len(ready)}/{self.workers_count} workers started within "
@@ -195,7 +200,7 @@ class ProcessPool:
         self._work_socket.send_pyobj((args, kwargs))
 
     def get_results(self, timeout: float = None):
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             # stop() is a poison pill: blocked consumers unblock promptly.
             if self._stopped:
@@ -206,7 +211,7 @@ class ProcessPool:
             msg = self._poll_result(timeout_ms=_POLL_MS)
             if msg is None:
                 self._check_processes_alive()
-                if deadline is not None and time.time() > deadline:
+                if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutWaitingForResultError()
                 continue
             if isinstance(msg, VentilatedItemProcessedMessage):
@@ -244,8 +249,8 @@ class ProcessPool:
     def join(self):
         # Re-send FINISH while waiting: a worker whose SUB connected after
         # the first send (slow joiner) would otherwise never hear it.
-        deadline = time.time() + _JOIN_TIMEOUT_S
-        while any(p.poll() is None for p in self._processes) and time.time() < deadline:
+        deadline = time.monotonic() + _JOIN_TIMEOUT_S
+        while any(p.poll() is None for p in self._processes) and time.monotonic() < deadline:
             if self._control_socket is not None:
                 try:
                     self._control_socket.send(_CONTROL_FINISH)
@@ -273,10 +278,16 @@ class ProcessPool:
 
     @property
     def diagnostics(self):
-        return {"items_ventilated": self._ventilated,
+        """Unified pool schema (same keys across thread/process/dummy pools;
+        ``output_queue_size`` is zero-valued here — queued results live in
+        ZMQ/ring buffers that are not observable across the socket, parity
+        with reference :303)."""
+        return {"output_queue_size": self.results_qsize(),
+                "items_ventilated": self._ventilated,
                 "items_processed": self._processed,
                 "items_inprocess": self._ventilated - self._processed,
-                "socket_hwm": self._results_hwm}
+                "workers_count": self.workers_count,
+                "results_queue_capacity": self._results_hwm}
 
     # ------------------------------------------------------------ internals
     def _poll_result(self, timeout_ms: int):
@@ -295,7 +306,7 @@ class ProcessPool:
         numpy before requesting another). Holding returned tables across
         get_results calls is therefore not allowed on the shm transport."""
         from petastorm_tpu.native import RingClosed
-        deadline = time.time() + timeout_ms / 1000.0
+        deadline = time.monotonic() + timeout_ms / 1000.0
         while True:
             progressed = False
             for _ in range(len(self._rings)):
@@ -345,7 +356,7 @@ class ProcessPool:
                         pass
                     ring.advance()
             if not progressed:
-                if time.time() >= deadline:
+                if time.monotonic() >= deadline:
                     return None
                 time.sleep(0.0001)
 

@@ -46,7 +46,7 @@ class WorkerTerminationRequested(Exception):
 
 class _WorkerThread(threading.Thread):
     def __init__(self, worker_impl, input_queue, result_queue, stop_event,
-                 put_fn, prof=None):
+                 put_fn, prof=None, telemetry=None):
         super().__init__(name=f"pt-worker-{worker_impl.worker_id}", daemon=True)
         self._worker_impl = worker_impl
         self._input_queue = input_queue
@@ -54,6 +54,11 @@ class _WorkerThread(threading.Thread):
         self._stop_event = stop_event
         self._put = put_fn
         self.prof = prof  # per-worker cProfile; pre-3.12 only (see ThreadPool)
+        # Shared pipeline registry (set by the reader through the pool):
+        # in-worker decode time is only observable from inside the worker.
+        self._decode_hist = (telemetry.histogram("worker.decode_s")
+                             if telemetry is not None else None)
+        self._telemetry = telemetry
 
     def run(self):
         # ANY exit path that isn't an explicit stop must surface to the
@@ -85,7 +90,13 @@ class _WorkerThread(threading.Thread):
                 args, kwargs = self._input_queue.get(block=True, timeout=_IO_TIMEOUT_S)
             except queue.Empty:
                 continue
-            self._worker_impl.process(*args, **kwargs)
+            if self._decode_hist is not None:
+                t0 = time.perf_counter()
+                with self._telemetry.span("petastorm_tpu.worker_decode"):
+                    self._worker_impl.process(*args, **kwargs)
+                self._decode_hist.observe(time.perf_counter() - t0)
+            else:
+                self._worker_impl.process(*args, **kwargs)
             self._put(VentilatedItemProcessedMessage(
                 kwargs.get(ITEM_CONTEXT_KWARG)))
 
@@ -124,6 +135,9 @@ class ThreadPool:
         self._next_assign = 0
         self._next_read = 0
         self._ventilator = None
+        # Pipeline telemetry registry; the owning Reader assigns it before
+        # start() so worker threads can publish in-worker decode timings.
+        self.telemetry = None
 
     # ------------------------------------------------------------------ api
     def start(self, worker_class, worker_args=None, ventilator=None):
@@ -140,7 +154,8 @@ class ThreadPool:
             per_worker_prof = (cProfile.Profile() if self._profiling_enabled
                                and sys.version_info < (3, 12) else None)
             self._workers.append(_WorkerThread(worker, in_q, out_q, self._stop_event,
-                                               self._make_put(i), per_worker_prof))
+                                               self._make_put(i), per_worker_prof,
+                                               telemetry=self.telemetry))
         if self._profiling_enabled and sys.version_info >= (3, 12):
             self._prof = cProfile.Profile()
             try:
@@ -253,4 +268,14 @@ class ThreadPool:
 
     @property
     def diagnostics(self):
-        return {"output_queue_size": self.results_qsize()}
+        """Unified pool schema (same keys across thread/process/dummy pools,
+        zero-valued where a pool cannot observe them — see
+        docs/observability.md)."""
+        ventilated = sum(self._assigned)
+        processed = sum(self._processed)
+        return {"output_queue_size": self.results_qsize(),
+                "items_ventilated": ventilated,
+                "items_processed": processed,
+                "items_inprocess": ventilated - processed,
+                "workers_count": self.workers_count,
+                "results_queue_capacity": self._results_queue_size}

@@ -157,6 +157,17 @@ class ConcurrentVentilator(Ventilator):
         return {"epoch": linear // n, "offset": linear % n,
                 "seed": self._seed, "randomized": self._randomize}
 
+    @property
+    def inflight(self) -> int:
+        """Ventilated-but-unprocessed items right now — the backlog the
+        telemetry gauge ``ventilator.backlog`` samples."""
+        with self._inflight_cv:
+            return self._inflight
+
+    @property
+    def max_inflight(self) -> int:
+        return self._max_inflight
+
     def completed(self) -> bool:
         # A stopped ventilator will never ventilate again: report completed
         # so consumers drain and raise EmptyResultError instead of spinning
