@@ -396,6 +396,51 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"stage breakdown phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4e. resilience under injected faults (docs/resilience.md): the
+    # same columnar epoch with a seeded FaultPlan throwing transient
+    # IOErrors on 10% of row-group reads plus one permanently corrupt row
+    # group in degraded mode. Reports the retry/quarantine counters and the
+    # row-completeness + throughput cost of surviving the faults — the
+    # number a production pipeline pays for not dying.
+    resilience_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "from petastorm_tpu.resilience import (ExponentialBackoff, FaultPlan,\n"
+        "                                      FaultSpec, RetryPolicy)\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "def epoch(fault_plan=None, degraded=False):\n"
+        "    policy = RetryPolicy(max_attempts=3, seed=0,\n"
+        "                         backoff=ExponentialBackoff(base=0.001, cap=0.01))\n"
+        "    t0 = time.perf_counter()\n"
+        "    with make_batch_reader(url, num_epochs=1, shuffle_row_groups=False,\n"
+        "                           reader_pool_type='thread', workers_count=3,\n"
+        "                           retry_policy=policy, degraded_mode=degraded,\n"
+        "                           fault_plan=fault_plan) as reader:\n"
+        "        rows = sum(len(b[0]) for b in reader)\n"
+        "        diag = reader.diagnostics\n"
+        "        report = reader.quarantine_report()\n"
+        "    return rows, time.perf_counter() - t0, diag, report\n"
+        "epoch()  # warm-up: first epoch pays import + fs metadata costs\n"
+        "clean_rows, clean_s, _, _ = epoch()\n"
+        "plan = FaultPlan([\n"
+        "    FaultSpec(site='rowgroup.read', kind='ioerror', rate=0.10),\n"
+        "    FaultSpec(site='rowgroup.read', kind='ioerror', at=1),\n"
+        "    FaultSpec(site='rowgroup.read', kind='corruption', at=7)], seed=0)\n"
+        "rows, faulted_s, diag, report = epoch(plan, degraded=True)\n"
+        "counters = diag['telemetry']['counters']\n"
+        "print('BENCHJSON:' + json.dumps({'resilience_fault_epoch': {\n"
+        "    'clean_rows': clean_rows,\n"
+        "    'faulted_rows': rows,\n"
+        "    'quarantined_rowgroups': report['quarantined'],\n"
+        "    'retries_total': counters.get('resilience.retries_total', 0),\n"
+        "    'overhead_pct': round(100.0 * (faulted_s - clean_s) / clean_s, 1)}}))\n")
+    try:
+        out.update(_cpu_subprocess(resilience_child, data_dir, timeout_s=600.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"resilience phase failed: {e!r}", file=sys.stderr)
+
     ngram_child = (
         "import json, os, time\n"
         "import jax\n"

@@ -10,7 +10,9 @@ Parity: reference petastorm/hdfs/namenode.py — ``HdfsNamenodeResolver``
 (:31, hadoop XML parse :67), ``HAHdfsClient`` (:211) with the
 ``namenode_failover`` retry decorator (:146, max 3 attempts :152),
 ``HdfsConnector`` (:241) with round-robin ``_try_next_namenode`` (:288).
-Implementation is new and fsspec/pyarrow.fs-based.
+Implementation is new and fsspec/pyarrow.fs-based; the failover retry loop
+runs on :class:`petastorm_tpu.resilience.RetryPolicy` (docs/resilience.md)
+instead of the reference's hand-rolled decorator loop.
 """
 from __future__ import annotations
 
@@ -20,9 +22,20 @@ import os
 import xml.etree.ElementTree as ET
 from typing import List, Optional, Tuple
 
+from petastorm_tpu.resilience.policy import (PERMANENT, ExponentialBackoff,
+                                             RetryPolicy, failover_classifier)
+
 logger = logging.getLogger(__name__)
 
 MAX_NAMENODE_FAILOVER_ATTEMPTS = 2  # total tries = attempts + 1
+
+#: The HA failover policy: one try per failover, immediately (an HA pair's
+#: standby is ready now or not at all — backing off only delays the switch),
+#: transient-vs-definite split by :func:`failover_classifier`.
+FAILOVER_POLICY = RetryPolicy(
+    max_attempts=MAX_NAMENODE_FAILOVER_ATTEMPTS + 1,
+    backoff=ExponentialBackoff(base=0.0, multiplier=1.0, cap=0.0),
+    jitter="none", seed=0, classify=failover_classifier)
 
 
 class HdfsConnectError(IOError):
@@ -110,33 +123,35 @@ class HdfsNamenodeResolver:
         return netloc, endpoints
 
 
-# OSError subclasses that indicate a definite answer from a healthy
-# namenode — failing over on these would mask the real error.
-_NON_FAILOVER_ERRORS = (FileNotFoundError, PermissionError, FileExistsError,
-                        IsADirectoryError, NotADirectoryError)
-
-
 def namenode_failover(func):
-    """Method decorator: on connection-level IO/OS errors, reconnect to the
-    next namenode and retry, up to ``MAX_NAMENODE_FAILOVER_ATTEMPTS``
-    failovers. Definite filesystem answers (missing file, permission denied)
-    propagate untouched."""
+    """Method decorator: run the call under :data:`FAILOVER_POLICY` —
+    connection-level IO/OS errors reconnect to the next namenode and retry,
+    up to ``MAX_NAMENODE_FAILOVER_ATTEMPTS`` failovers. Definite filesystem
+    answers (missing file, permission denied) propagate untouched (the
+    policy's :func:`~petastorm_tpu.resilience.failover_classifier` owns the
+    transient-vs-definite split)."""
     @functools.wraps(func)
     def wrapper(self, *args, **kwargs):
-        last_exc = None
-        for attempt in range(MAX_NAMENODE_FAILOVER_ATTEMPTS + 1):
-            try:
-                return func(self, *args, **kwargs)
-            except _NON_FAILOVER_ERRORS:
+        def _on_retry(attempt, exc, _delay):
+            logger.warning("HDFS call %s failed (attempt %d): %s; failing over",
+                           func.__name__, attempt, exc)
+            self._do_failover()
+
+        try:
+            return FAILOVER_POLICY.call(
+                functools.partial(func, self, *args, **kwargs),
+                on_retry=_on_retry)
+        except Exception as e:  # noqa: BLE001 - classifier already ruled
+            if failover_classifier(e) == PERMANENT:
                 raise
-            except (IOError, OSError) as e:  # ArrowIOError subclasses OSError
-                last_exc = e
-                logger.warning("HDFS call %s failed (attempt %d): %s; failing over",
-                               func.__name__, attempt + 1, e)
-                self._do_failover()
-        raise HdfsConnectError(
-            f"HDFS call {func.__name__} failed after "
-            f"{MAX_NAMENODE_FAILOVER_ATTEMPTS + 1} attempts") from last_exc
+            # Fail over once more after the final failed attempt too, so the
+            # client is not pinned to the namenode that just proved dead —
+            # the next proxied call starts on a different node instead of
+            # burning its first attempt re-hitting this one.
+            self._do_failover()
+            raise HdfsConnectError(
+                f"HDFS call {func.__name__} failed after "
+                f"{FAILOVER_POLICY.max_attempts} attempts") from e
     return wrapper
 
 
@@ -150,12 +165,18 @@ class HAHdfsClient:
     _PROXIED = ("ls", "isdir", "isfile", "exists", "open", "info", "glob",
                 "makedirs", "rm", "mkdir", "cat_file", "pipe_file")
 
-    def __init__(self, connector_cls, namenodes: List[str], user=None, storage_options=None):
+    def __init__(self, connector_cls, namenodes: List[str], user=None,
+                 storage_options=None, fault_plan=None):
         self._connector_cls = connector_cls
         self._namenodes = list(namenodes)
         self._index = 0
         self._user = user
         self._storage_options = storage_options or {}
+        #: Optional :class:`~petastorm_tpu.resilience.FaultPlan`, consulted
+        #: at the ``hdfs.call`` site (key = proxied method name) before each
+        #: attempt — lets tests/benchmarks exercise the failover path
+        #: without a broken namenode.
+        self._fault_plan = fault_plan
         self._fs = self._connect(self._namenodes[self._index])
 
     def _connect(self, namenode: str):
@@ -173,6 +194,8 @@ class HAHdfsClient:
         if name in type(self)._PROXIED:
             @namenode_failover
             def call(self, *args, __name=name, **kwargs):
+                if self._fault_plan is not None:
+                    self._fault_plan.fire("hdfs.call", key=__name)
                 return getattr(self._fs, __name)(*args, **kwargs)
             return functools.partial(call, self)
         return getattr(self._fs, name)
@@ -192,7 +215,8 @@ class HdfsConnector:
         return ArrowFSWrapper(hdfs)
 
     @classmethod
-    def connect_to_either_namenode(cls, namenodes: List[str], user=None, storage_options=None):
+    def connect_to_either_namenode(cls, namenodes: List[str], user=None,
+                                   storage_options=None, fault_plan=None):
         """Try each namenode round-robin; return an HA failover client.
 
         Parity: reference namenode.py:241,:288 (round-robin namenode retry).
@@ -201,7 +225,8 @@ class HdfsConnector:
         for i, nn in enumerate(namenodes[:cls.MAX_NAMENODES + 1]):
             try:
                 client = HAHdfsClient(cls, namenodes[i:] + namenodes[:i],
-                                      user=user, storage_options=storage_options)
+                                      user=user, storage_options=storage_options,
+                                      fault_plan=fault_plan)
                 return client
             except (IOError, OSError) as e:
                 errors.append((nn, e))

@@ -10,10 +10,16 @@ library — the native path) with:
 * WAL journaling so concurrent reader threads/processes can share the cache;
 * a capacity sanity check mirroring the reference's
   (local_disk_cache.py:47): refuses a cache too small to hold a meaningful
-  number of row groups.
+  number of row groups;
+* sqlite lookups/stores run under a :class:`~petastorm_tpu.resilience
+  .RetryPolicy` with the sqlite classifier ("database is locked" under
+  concurrent readers is transient), and cache misses consult the reader's
+  :class:`~petastorm_tpu.resilience.FaultPlan` at the ``cache.fill`` site
+  (see docs/resilience.md).
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 import pickle
 import sqlite3
@@ -21,6 +27,8 @@ import threading
 import time
 
 from petastorm_tpu.cache import CacheBase
+from petastorm_tpu.resilience.policy import (DEFAULT_READ_POLICY, RetryPolicy,
+                                             sqlite_classifier)
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS cache (
@@ -39,15 +47,25 @@ class LocalDiskCache(CacheBase):
         only for the capacity sanity check
     :param shards: kept for API familiarity (sqlite needs no fanout sharding)
     :param cleanup: if True, delete the cache directory on :meth:`cleanup`
+    :param retry_policy: governs the sqlite lookup/store calls, reclassified
+        through :func:`~petastorm_tpu.resilience.sqlite_classifier`; default
+        :data:`~petastorm_tpu.resilience.DEFAULT_READ_POLICY`
+    :param fault_plan: fault-injection plan consulted at the ``cache.fill``
+        site on every miss (tests/benchmarks only)
     """
 
     def __reduce__(self):
         # Crossing a process boundary (worker args) re-opens the same cache
         # directory in the child; live sqlite connections never travel.
-        return (type(self), (self._path, self._size_limit, 0, 6, self._cleanup_on_exit))
+        # Policies/plans are plain picklable values (fault counters restart
+        # per process, which is the per-process determinism faults.py wants).
+        return (type(self), (self._path, self._size_limit, 0, 6,
+                             self._cleanup_on_exit, self._retry_policy_arg,
+                             self._fault_plan))
 
     def __init__(self, path: str, size_limit_bytes: int, expected_row_size_bytes: int = 0,
-                 shards: int = 6, cleanup: bool = False, **_ignored):
+                 shards: int = 6, cleanup: bool = False, retry_policy: RetryPolicy = None,
+                 fault_plan=None, **_ignored):
         min_rows = 100
         if expected_row_size_bytes and size_limit_bytes < min_rows * expected_row_size_bytes:
             raise ValueError(
@@ -56,6 +74,12 @@ class LocalDiskCache(CacheBase):
         self._path = path
         self._cleanup_on_exit = cleanup
         self._size_limit = size_limit_bytes
+        self._retry_policy_arg = retry_policy
+        base_policy = retry_policy if retry_policy is not None else DEFAULT_READ_POLICY
+        # Same schedule as the reader's row-group policy; only the classifier
+        # changes (sqlite "database is locked" is transient here).
+        self._policy = dataclasses.replace(base_policy, classify=sqlite_classifier)
+        self._fault_plan = fault_plan
         self._db_path = os.path.join(path, "cache.sqlite3")
         self._local = threading.local()
         self._all_conns = []
@@ -90,18 +114,32 @@ class LocalDiskCache(CacheBase):
 
     def get(self, key, fill_cache_func):
         key = str(key)
-        conn = self._conn()
-        row = conn.execute("SELECT value FROM cache WHERE key = ?", (key,)).fetchone()
+        # Lookup and store each run under the retry policy (transient
+        # "database is locked" contention); _conn() inside the retried
+        # function so a connection closed under us reconnects per attempt.
+        # The fill itself is NOT retried here — the worker's RowGroupGuard
+        # owns load/decode retries.
+        row = self._policy.call(self._lookup, key)
         if row is not None:
             return pickle.loads(row[0])
+        if self._fault_plan is not None:
+            self._fault_plan.fire("cache.fill", key=key)
         value = fill_cache_func()
         blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        self._policy.call(self._store, key, blob)
+        return value
+
+    def _lookup(self, key):
+        return self._conn().execute(
+            "SELECT value FROM cache WHERE key = ?", (key,)).fetchone()
+
+    def _store(self, key, blob):
+        conn = self._conn()
         with conn:
             conn.execute(
                 "INSERT OR REPLACE INTO cache (key, value, size, stored_at) VALUES (?, ?, ?, ?)",
                 (key, sqlite3.Binary(blob), len(blob), time.time()))
             self._evict_locked(conn)
-        return value
 
     def _evict_locked(self, conn):
         (total,) = conn.execute("SELECT COALESCE(SUM(size), 0) FROM cache").fetchone()

@@ -219,13 +219,15 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
 
 
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
-                cache_extra_settings):
+                cache_extra_settings, retry_policy=None, fault_plan=None):
     if cache_type in (None, "null"):
         return NullCache()
     if cache_type == "local-disk":
         from petastorm_tpu.local_disk_cache import LocalDiskCache
         return LocalDiskCache(cache_location, cache_size_limit,
                               cache_row_size_estimate or 0,
+                              retry_policy=retry_policy,
+                              fault_plan=fault_plan,
                               **(cache_extra_settings or {}))
     raise ValueError(f"Unknown cache_type {cache_type!r}")
 
@@ -260,7 +262,11 @@ def make_reader(dataset_url,
                 pool_profiling_enabled: bool = False,
                 hdfs_driver: Optional[str] = None,
                 pyarrow_serialize: bool = False,
-                convert_early_to_numpy: Optional[bool] = None):
+                convert_early_to_numpy: Optional[bool] = None,
+                retry_policy=None,
+                degraded_mode: bool = False,
+                fault_plan=None,
+                worker_crash_budget: int = 0):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -300,6 +306,19 @@ def make_reader(dataset_url,
     :param convert_early_to_numpy: accepted for drop-in compatibility; the
         row path always decodes to numpy inside the workers (the "early"
         behavior), so both values are satisfied
+    :param retry_policy: a :class:`petastorm_tpu.resilience.RetryPolicy`
+        governing row-group IO/decode retries in the workers and (with its
+        classifier swapped to the sqlite flavor) disk-cache fills; default
+        :data:`~petastorm_tpu.resilience.DEFAULT_READ_POLICY`
+    :param degraded_mode: when True, a row group that still fails after
+        retries is **quarantined** (skipped, with provenance on
+        :meth:`Reader.quarantine_report`) instead of killing the epoch
+    :param fault_plan: a :class:`petastorm_tpu.resilience.FaultPlan` for
+        deterministic fault injection (tests/benchmarks only)
+    :param worker_crash_budget: with ``reader_pool_type='process'``, tolerate
+        up to N hard worker deaths per epoch by re-ventilating the lost row
+        groups onto surviving workers (0 = any crash is fatal, the previous
+        behavior). See docs/resilience.md.
 
     Parity: reference reader.py:60.
     """
@@ -316,7 +335,8 @@ def make_reader(dataset_url,
             f"make_batch_reader() instead.") from e
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
-                        cache_row_size_estimate, cache_extra_settings)
+                        cache_row_size_estimate, cache_extra_settings,
+                        retry_policy=retry_policy, fault_plan=fault_plan)
 
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
@@ -345,7 +365,11 @@ def make_reader(dataset_url,
                   resume_state=resume_state,
                   filters=filters,
                   filesystem=filesystem,
-                  rowgroup_coalescing=rowgroup_coalescing)
+                  rowgroup_coalescing=rowgroup_coalescing,
+                  retry_policy=retry_policy,
+                  degraded_mode=degraded_mode,
+                  fault_plan=fault_plan,
+                  worker_crash_budget=worker_crash_budget)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -377,7 +401,11 @@ def make_batch_reader(dataset_url_or_urls,
                       rowgroup_coalescing: int = 1,
                       pool_profiling_enabled: bool = False,
                       rowgroup_selector=None,
-                      hdfs_driver: Optional[str] = None):
+                      hdfs_driver: Optional[str] = None,
+                      retry_policy=None,
+                      degraded_mode: bool = False,
+                      fault_plan=None,
+                      worker_crash_budget: int = 0):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -392,6 +420,9 @@ def make_batch_reader(dataset_url_or_urls,
     ``rowgroup_selector`` prunes row groups through stored inverted indexes
     exactly as in :func:`make_reader` (parity: reference reader.py:216).
     ``hdfs_driver`` is accepted for drop-in compatibility and ignored.
+    ``retry_policy`` / ``degraded_mode`` / ``fault_plan`` /
+    ``worker_crash_budget`` behave exactly as in :func:`make_reader`
+    (see docs/resilience.md).
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -403,7 +434,8 @@ def make_batch_reader(dataset_url_or_urls,
         raise ValueError("NGram is not supported by make_batch_reader; use make_reader")
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
-                        cache_row_size_estimate, cache_extra_settings)
+                        cache_row_size_estimate, cache_extra_settings,
+                        retry_policy=retry_policy, fault_plan=fault_plan)
 
     if convert_early_to_numpy:
         # Workers publish numpy dicts, which Arrow IPC cannot carry.
@@ -439,7 +471,11 @@ def make_batch_reader(dataset_url_or_urls,
                   filters=filters,
                   filesystem=filesystem,
                   convert_early_to_numpy=convert_early_to_numpy,
-                  rowgroup_coalescing=rowgroup_coalescing)
+                  rowgroup_coalescing=rowgroup_coalescing,
+                  retry_policy=retry_policy,
+                  degraded_mode=degraded_mode,
+                  fault_plan=fault_plan,
+                  worker_crash_budget=worker_crash_budget)
 
 
 class Reader:
@@ -454,7 +490,8 @@ class Reader:
                  num_epochs, cur_shard, shard_count, shard_seed, seed, cache,
                  transform_spec, storage_options, resume_state=None,
                  filesystem=None, convert_early_to_numpy=False,
-                 rowgroup_coalescing=1, filters=None):
+                 rowgroup_coalescing=1, filters=None, retry_policy=None,
+                 degraded_mode=False, fault_plan=None, worker_crash_budget=0):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -528,6 +565,24 @@ class Reader:
                           "dataset URL; the custom filesystem object is used for "
                           "planning only. Pass storage_options for credentials.")
         self._cache = cache
+
+        # ---------------- resilience wiring (docs/resilience.md)
+        from petastorm_tpu.resilience import (RowGroupQuarantine,
+                                              WorkerCrashRecovery)
+        #: Consumer-side aggregator of degraded-mode skip records; query via
+        #: :meth:`quarantine_report`. Attached to every pool type.
+        self.quarantine = RowGroupQuarantine(telemetry=self.telemetry)
+        self._pool.quarantine = self.quarantine
+        if worker_crash_budget:
+            if isinstance(self._pool, ProcessPool):
+                self._pool.recovery = WorkerCrashRecovery(
+                    worker_crash_budget, telemetry=self.telemetry)
+            else:
+                # In-process workers can't die independently of the trainer;
+                # a crash budget only means something for spawned processes.
+                warnings.warn("worker_crash_budget only applies to "
+                              "reader_pool_type='process'; ignored")
+
         worker_args = {
             "dataset_url_or_urls": dataset_url_or_urls,
             "storage_options": storage_options,
@@ -542,6 +597,15 @@ class Reader:
             "shuffle_rows": shuffle_rows,
             "seed": seed,
             "convert_early_to_numpy": convert_early_to_numpy,
+            "retry_policy": retry_policy,
+            "degraded_mode": degraded_mode,
+            "fault_plan": fault_plan,
+            # The shared registry cannot cross the spawn boundary (same
+            # limitation as the worker decode histogram): spawned workers
+            # retry without exporting per-retry counters; quarantine and
+            # recovery events are counted consumer-side for every pool.
+            "resilience_telemetry": (None if isinstance(self._pool, ProcessPool)
+                                     else self.telemetry),
         }
 
         if is_batched_reader and not convert_early_to_numpy \
@@ -746,6 +810,13 @@ class Reader:
 
     def join(self):
         self._pool.join()
+        # Close the cache with the reader (sqlite connections otherwise leak
+        # past shutdown); cleanup() is idempotent, so an explicit
+        # cleanup_cache() before or after this is fine.
+        try:
+            self._cache.cleanup()
+        except OSError as e:
+            logger.warning("Error closing cache on reader shutdown: %s", e)
 
     def __enter__(self):
         return self
@@ -765,6 +836,14 @@ class Reader:
         d["ventilator_backlog"] = self._ventilator.inflight
         d["telemetry"] = self.telemetry.snapshot()
         return d
+
+    def quarantine_report(self) -> dict:
+        """Degraded-mode outcome of this reader so far: how many row groups
+        were skipped, per-error-type tallies, and each skipped piece's full
+        provenance (path, row group, exception, attempts burned, worker).
+        Empty report when ``degraded_mode`` is off or nothing failed. See
+        docs/resilience.md for the schema."""
+        return self.quarantine.report()
 
     def cleanup_cache(self):
         """Remove this reader's row-group cache contents (parity: reference

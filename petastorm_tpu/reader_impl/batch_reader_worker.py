@@ -19,7 +19,7 @@ import numpy as np
 import pyarrow as pa
 
 from petastorm_tpu.reader_impl.row_reader_worker import (_ParquetFileLRU,
-                                                         _read_row_group_with_retry,
+                                                         _read_row_group,
                                                          item_shuffle_rng,
                                                          select_drop_partition)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
@@ -35,6 +35,17 @@ class BatchReaderWorker(WorkerBase):
         self._files = None
         self._rng = np.random.default_rng(
             None if args.get("seed") is None else args["seed"] + worker_id)
+        # Same failure boundary as the row worker: retries per the reader's
+        # RetryPolicy; in degraded_mode the row group is quarantined (the
+        # pool forwards the record to the Reader) instead of killing the
+        # epoch.
+        from petastorm_tpu.resilience import RowGroupGuard
+        self._guard = RowGroupGuard(
+            policy=args.get("retry_policy"),
+            degraded_mode=args.get("degraded_mode", False),
+            worker_id=worker_id,
+            telemetry=args.get("resilience_telemetry"))
+        self._fault_plan = args.get("fault_plan")
 
     def _ensure_open(self):
         if self._ctx is None:
@@ -48,6 +59,21 @@ class BatchReaderWorker(WorkerBase):
     def process(self, rowgroup, shuffle_row_drop_partition=(0, 1),
                 shuffle_context=None):
         self._ensure_open()
+        if self._fault_plan is not None:
+            self._fault_plan.fire("worker.item", key=str(rowgroup.path),
+                                  worker_id=self.worker_id)
+        # The whole load+transform is the retry unit; publish stays OUTSIDE
+        # the guard so a retried item can never publish twice.
+        result = self._guard.run(
+            lambda: self._build_result(rowgroup, shuffle_row_drop_partition,
+                                       shuffle_context),
+            rowgroup,
+            on_retry=lambda _a, _e, _d: self._files.evict(rowgroup.path))
+        if result is not None:
+            self.publish_func(result)
+
+    def _build_result(self, rowgroup, shuffle_row_drop_partition,
+                      shuffle_context):
         view_schema = self.args["view_schema"]
         predicate = self.args.get("predicate")
         transform_spec = self.args.get("transform_spec")
@@ -64,7 +90,7 @@ class BatchReaderWorker(WorkerBase):
                                  rng=item_shuffle_rng(self.args.get("seed"),
                                                       shuffle_context, self._rng))
         if table is None or table.num_rows == 0:
-            return
+            return None
 
         if transform_spec is not None and transform_spec.func is not None:
             df = table.to_pandas()
@@ -89,9 +115,8 @@ class BatchReaderWorker(WorkerBase):
             # Worker-side conversion (parity: reference
             # arrow_reader_worker.py:279): worker parallelism absorbs the
             # Arrow->numpy cost; payloads cross pools as numpy dicts.
-            self.publish_func(arrow_table_to_numpy_dict(table, out_schema))
-        else:
-            self.publish_func(table)
+            return arrow_table_to_numpy_dict(table, out_schema)
+        return table
 
     # ------------------------------------------------------------ internals
     def _cache_key(self, rowgroup, columns) -> str:
@@ -102,7 +127,9 @@ class BatchReaderWorker(WorkerBase):
         return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
     def _read_table(self, rowgroup, columns) -> pa.Table:
-        table = _read_row_group_with_retry(self._files, rowgroup, columns)
+        table = _read_row_group(self._files, rowgroup, columns,
+                                fault_plan=self._fault_plan,
+                                worker_id=self.worker_id)
         # Surface hive partition keys as constant columns when requested.
         for key, value in rowgroup.partition_values:
             if key in columns and key not in table.column_names:

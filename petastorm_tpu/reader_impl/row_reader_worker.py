@@ -18,7 +18,6 @@ Parity: reference petastorm/py_dict_reader_worker.py — ``PyDictReaderWorker``
 from __future__ import annotations
 
 import hashlib
-import time
 from typing import List, Optional
 
 import numpy as np
@@ -76,37 +75,26 @@ class _ParquetFileLRU:
         return self._fs.open(path, "rb")
 
 
-_IO_RETRIES = 2
-
-
-def _read_row_group_with_retry(files: "_ParquetFileLRU", rowgroup, columns):
-    """Read a row group, retrying OSErrors a couple of times (transient
-    remote-filesystem failures); the stale handle is evicted and reopened
-    between attempts. Missing-file/permission errors propagate immediately.
-    A permanently corrupt file still fails, ~0.3s later than it otherwise
-    would (Arrow IO errors are not reliably separable from transient ones)."""
-    last = None
-    for attempt in range(_IO_RETRIES + 1):
-        try:
-            pf = files.get(rowgroup.path)
-            names = files.schema_names(rowgroup.path)
-            file_columns = [c for c in sorted(columns) if c in names]
-            # Workers ARE the parallelism unit: arrow's own thread pool only
-            # adds oversubscription on top of N decode workers.
-            ids = rowgroup.row_group
-            if isinstance(ids, tuple):  # coalesced work item: one IO call
-                return pf.read_row_groups(list(ids), columns=file_columns,
-                                          use_threads=False)
-            return pf.read_row_group(ids, columns=file_columns,
-                                     use_threads=False)
-        except (FileNotFoundError, PermissionError):
-            raise
-        except OSError as e:
-            last = e
-            files.evict(rowgroup.path)
-            if attempt < _IO_RETRIES:
-                time.sleep(0.1 * (attempt + 1))
-    raise last
+def _read_row_group(files: "_ParquetFileLRU", rowgroup, columns,
+                    fault_plan=None, worker_id: int = 0):
+    """One row-group read attempt (no retry loop here — the worker's
+    :class:`~petastorm_tpu.resilience.RowGroupGuard` owns retries per its
+    :class:`~petastorm_tpu.resilience.RetryPolicy`, evicting the stale
+    handle between attempts)."""
+    if fault_plan is not None:
+        fault_plan.fire("rowgroup.read", key=str(rowgroup.path),
+                        worker_id=worker_id)
+    pf = files.get(rowgroup.path)
+    names = files.schema_names(rowgroup.path)
+    file_columns = [c for c in sorted(columns) if c in names]
+    # Workers ARE the parallelism unit: arrow's own thread pool only
+    # adds oversubscription on top of N decode workers.
+    ids = rowgroup.row_group
+    if isinstance(ids, tuple):  # coalesced work item: one IO call
+        return pf.read_row_groups(list(ids), columns=file_columns,
+                                  use_threads=False)
+    return pf.read_row_group(ids, columns=file_columns,
+                             use_threads=False)
 
 
 def _column_values(col, zero_copy: bool = True):
@@ -208,6 +196,16 @@ class RowReaderWorker(WorkerBase):
         # native fast path back after a few row groups).
         from petastorm_tpu.utils.decode import NativeImageSkipMemo
         self._native_img_skip = NativeImageSkipMemo()
+        # Failure boundary: retries per the reader's RetryPolicy; in
+        # degraded_mode gives up by *quarantining* the row group (the pool
+        # forwards the record to the Reader) instead of killing the epoch.
+        from petastorm_tpu.resilience import RowGroupGuard
+        self._guard = RowGroupGuard(
+            policy=args.get("retry_policy"),
+            degraded_mode=args.get("degraded_mode", False),
+            worker_id=worker_id,
+            telemetry=args.get("resilience_telemetry"))
+        self._fault_plan = args.get("fault_plan")
 
     # Lazily build per-process handles (cheap for threads, required for processes).
     def _ensure_open(self):
@@ -222,6 +220,22 @@ class RowReaderWorker(WorkerBase):
     def process(self, rowgroup, shuffle_row_drop_partition=(0, 1),
                 shuffle_context=None):
         self._ensure_open()
+        if self._fault_plan is not None:
+            self._fault_plan.fire("worker.item", key=str(rowgroup.path),
+                                  worker_id=self.worker_id)
+        # The whole load+decode is the retry unit (decode failures on corrupt
+        # bytes quarantine too, not just IO); publish stays OUTSIDE the guard
+        # so a retried item can never publish twice.
+        result = self._guard.run(
+            lambda: self._build_result(rowgroup, shuffle_row_drop_partition,
+                                       shuffle_context),
+            rowgroup,
+            on_retry=lambda _a, _e, _d: self._files.evict(rowgroup.path))
+        if result:
+            self.publish_func(result)
+
+    def _build_result(self, rowgroup, shuffle_row_drop_partition,
+                      shuffle_context):
         ngram = self.args.get("ngram")
         predicate = self.args.get("predicate")
         transform_spec = self.args.get("transform_spec")
@@ -243,10 +257,7 @@ class RowReaderWorker(WorkerBase):
             # codec calls entirely (ScalarCodec.decode is a dtype cast,
             # applied per column); fixed-shape codec fields (ndarray,
             # image) decode column-major and stack once per field.
-            result = self._dense_ngram_windows(ngram, data, indices)
-            if result:
-                self.publish_func(result)
-            return
+            return self._dense_ngram_windows(ngram, data, indices)
 
         # Column-major decode on both paths, so image columns keep the
         # native batch decoder under predicates too.
@@ -265,8 +276,7 @@ class RowReaderWorker(WorkerBase):
                 result = ngram.densify_windows(result)
         else:
             result = decoded
-        if result:
-            self.publish_func(result)
+        return result
 
     @staticmethod
     def _scalar_fast_col(field, codec, col) -> bool:
@@ -424,7 +434,9 @@ class RowReaderWorker(WorkerBase):
         ~5x faster than per-cell ``to_pylist`` on image/ndarray stores. The
         codecs accept memoryviews and copy on decode. Pass ``zero_copy=False``
         when the raw columns must be picklable (disk cache)."""
-        table = _read_row_group_with_retry(self._files, rowgroup, columns)
+        table = _read_row_group(self._files, rowgroup, columns,
+                                fault_plan=self._fault_plan,
+                                worker_id=self.worker_id)
         data = {name: _column_values(table.column(name), zero_copy)
                 for name in table.column_names}
         return _inject_partition_values(data, table.num_rows, rowgroup, columns)

@@ -1,0 +1,54 @@
+"""Unified fault-tolerance layer for the input pipeline.
+
+Four pieces, one coherent policy object threaded through every layer that
+can fail (see docs/resilience.md):
+
+* :mod:`~petastorm_tpu.resilience.policy` — composable
+  :class:`RetryPolicy` (seeded exponential backoff + jitter, deadlines,
+  transient-vs-permanent classifiers); the single source of backoff truth
+  (``tools/check_backoff.py`` lints that nothing else sleeps in a retry
+  loop).
+* :mod:`~petastorm_tpu.resilience.quarantine` — worker-side
+  :class:`RowGroupGuard` (retry, then skip-and-record in
+  ``degraded_mode``) and the consumer-side :class:`RowGroupQuarantine`
+  report on the Reader.
+* :mod:`~petastorm_tpu.resilience.recovery` — process-pool worker-crash
+  detection + re-ventilation of lost row groups under a crash budget.
+* :mod:`~petastorm_tpu.resilience.faults` — deterministic seeded
+  :class:`FaultPlan` injection (IOError / corruption / latency /
+  worker-kill) for tests and ``bench.py``.
+
+Every retry/quarantine/recovery event lands on the pipeline's telemetry
+registry: ``resilience.retries_total``, ``resilience.giveups_total``,
+``resilience.quarantined_rowgroups``, ``resilience.worker_crashes``,
+``resilience.reventilated_items``.
+"""
+from petastorm_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                             InjectedCorruptionError,
+                                             InjectedFault, InjectedIOError,
+                                             in_spawned_worker,
+                                             mark_spawned_worker)
+from petastorm_tpu.resilience.policy import (DEFAULT_READ_POLICY, PERMANENT,
+                                             TRANSIENT, ExponentialBackoff,
+                                             RetryPolicy,
+                                             default_io_classifier,
+                                             failover_classifier, no_retry,
+                                             sqlite_classifier)
+from petastorm_tpu.resilience.quarantine import (QuarantineRecord,
+                                                 RowGroupGuard,
+                                                 RowGroupQuarantine,
+                                                 RowGroupSkipped,
+                                                 RowGroupSkippedMessage)
+from petastorm_tpu.resilience.recovery import (CrashBudgetExceededError,
+                                               ItemStartedMessage,
+                                               WorkerCrashRecovery)
+
+__all__ = [
+    "CrashBudgetExceededError", "DEFAULT_READ_POLICY", "ExponentialBackoff",
+    "FaultPlan", "FaultSpec", "InjectedCorruptionError", "InjectedFault",
+    "InjectedIOError", "ItemStartedMessage", "PERMANENT", "QuarantineRecord",
+    "RetryPolicy", "RowGroupGuard", "RowGroupQuarantine", "RowGroupSkipped",
+    "RowGroupSkippedMessage", "TRANSIENT", "WorkerCrashRecovery",
+    "default_io_classifier", "failover_classifier", "in_spawned_worker",
+    "mark_spawned_worker", "no_retry", "sqlite_classifier",
+]

@@ -1,0 +1,180 @@
+"""Worker-crash recovery for the process pool.
+
+A spawned decode worker can die hard (OOM kill, segfault in a native
+decoder, a ``worker_kill`` fault). Without recovery the pool turns any dead
+PID into a fatal ``RuntimeError``. With a crash budget
+(``worker_crash_budget=N`` on the reader), the pool instead re-ventilates
+the dead worker's lost row groups onto the surviving workers and the epoch
+completes losslessly.
+
+Exactly-once accounting uses a **claim protocol**: recovery-enabled workers
+send an :class:`ItemStartedMessage` control frame *before* processing each
+item (and publish data before the processed marker), so the consumer always
+knows which in-flight items are owned by which worker:
+
+* items **claimed** by the dead worker and never marked processed are
+  definitely lost → re-ventilated immediately on crash detection;
+* items pushed into the dead worker's receive buffer but never claimed
+  cannot be distinguished from items queued at a live worker **at crash
+  time** — but live workers claim their queue within milliseconds, so once
+  every claim is settled and the pool has been idle for a grace period, the
+  remaining unclaimed in-flight items are exactly the lost ones →
+  re-ventilated then (:meth:`WorkerCrashRecovery.unaccounted_after_quiesce`).
+
+Delivery semantics: a worker killed before publishing its claimed item
+(the ``worker_kill`` fault site fires pre-processing, and real OOM/segfault
+deaths overwhelmingly land inside load/decode) re-ventilates exactly once —
+data precedes the processed marker on the same FIFO transport, so a
+claimed-but-unmarked item was never half-delivered. A crash landing in the
+narrow window *between* the data publish and the processed marker delivers
+that row group twice: recovery is at-least-once in the worst case, never
+lossy. Epochs that must be duplicate-proof under arbitrary mid-publish
+crashes should dedup on a sample key downstream.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["CrashBudgetExceededError", "ItemStartedMessage",
+           "WorkerCrashRecovery"]
+
+#: Idle time after which unclaimed in-flight items are deemed lost
+#: (post-crash only; live workers claim queued items within milliseconds).
+_QUIESCE_GRACE_S = 2.0
+
+
+class CrashBudgetExceededError(RuntimeError):
+    """More workers died than ``worker_crash_budget`` tolerates."""
+
+
+class ItemStartedMessage:
+    """Worker -> pool control frame: ``worker_id`` claimed ``item_context``
+    and is about to process it."""
+
+    def __init__(self, worker_id: int, item_context):
+        self.worker_id = worker_id
+        self.item_context = item_context
+
+
+class WorkerCrashRecovery:
+    """Consumer-side ledger of in-flight work ownership.
+
+    The pool feeds it ventilation/claim/processed events; on a worker death
+    it returns the work items to re-ventilate. Thread-safe: the pool's poll
+    loop and ``ventilate`` may run on different threads (the ventilator
+    thread calls ``ventilate``).
+    """
+
+    def __init__(self, budget: int, telemetry=None,
+                 grace_s: float = _QUIESCE_GRACE_S):
+        self.budget = budget
+        self.crashes = 0
+        self._grace_s = grace_s
+        self._lock = threading.Lock()
+        self._inflight: Dict[Tuple, tuple] = {}   # ctx -> (args, kwargs)
+        self._claims: Dict[Tuple, int] = {}       # ctx -> worker_id
+        self._swept: set = set()                  # re-sent by sweep, unclaimed
+        self._dead: set = set()
+        self._last_activity = time.monotonic()
+        self._crash_counter = (telemetry.counter("resilience.worker_crashes")
+                               if telemetry is not None else None)
+        self._revent_counter = (
+            telemetry.counter("resilience.reventilated_items")
+            if telemetry is not None else None)
+
+    # ------------------------------------------------------------- bookkeeping
+    def note_activity(self) -> None:
+        with self._lock:
+            self._last_activity = time.monotonic()
+
+    def on_ventilated(self, ctx, item) -> None:
+        """``ctx`` is the ventilator's (epoch, position); items without one
+        (bare pool use) cannot be tracked and are skipped."""
+        if ctx is None:
+            return
+        with self._lock:
+            self._inflight[ctx] = item
+
+    def on_started(self, worker_id: int, ctx) -> None:
+        if ctx is None:
+            return
+        with self._lock:
+            self._claims[ctx] = worker_id
+            self._swept.discard(ctx)  # re-sent copy reached a live worker
+            self._last_activity = time.monotonic()
+
+    def on_processed(self, ctx) -> None:
+        if ctx is None:
+            return
+        with self._lock:
+            self._claims.pop(ctx, None)
+            self._inflight.pop(ctx, None)
+            self._swept.discard(ctx)
+            self._last_activity = time.monotonic()
+
+    # ------------------------------------------------------------------ crash
+    def on_worker_death(self, worker_id: int, exit_code) -> List[tuple]:
+        """Record one crash; returns the items the dead worker had claimed
+        (to re-ventilate now). Raises :class:`CrashBudgetExceededError` when
+        the budget is spent."""
+        with self._lock:
+            if worker_id in self._dead:
+                return []
+            self._dead.add(worker_id)
+            self.crashes += 1
+            if self.crashes > self.budget:
+                raise CrashBudgetExceededError(
+                    f"{self.crashes} worker crash(es) exceed "
+                    f"worker_crash_budget={self.budget} "
+                    f"(last: worker {worker_id}, exit code {exit_code})")
+            lost = [ctx for ctx, wid in self._claims.items()
+                    if wid == worker_id]
+            items = []
+            for ctx in lost:
+                del self._claims[ctx]
+                item = self._inflight.get(ctx)
+                if item is not None:
+                    items.append(item)
+            # A new crash invalidates sweep state: an item re-sent by an
+            # earlier sweep and still unclaimed may be sitting in THIS dead
+            # worker's buffer — make it sweep-eligible again.
+            self._swept.clear()
+            self._last_activity = time.monotonic()
+        if self._crash_counter is not None:
+            self._crash_counter.add(1)
+        self._count_reventilated(len(items))
+        return items
+
+    def unaccounted_after_quiesce(self) -> List[tuple]:
+        """Post-crash sweep for items that were sitting in the dead worker's
+        receive buffer (ventilated, never claimed, never processed). Only
+        returns them once every claim is settled and no pool activity has
+        been seen for the grace period — at that point no live worker can
+        still own them."""
+        with self._lock:
+            if (self.crashes == 0 or self._claims
+                    or time.monotonic() - self._last_activity < self._grace_s):
+                return []
+            # Items stay in _inflight (a worker that claims a re-sent copy
+            # and then dies must still find them re-ventilatable); _swept
+            # keeps this sweep from returning the same items every poll
+            # while their re-sent copies are in flight to a live worker.
+            pending = {ctx: item for ctx, item in self._inflight.items()
+                       if ctx not in self._swept}
+            if not pending:
+                return []
+            self._swept.update(pending)
+            self._last_activity = time.monotonic()
+        self._count_reventilated(len(pending))
+        return list(pending.values())
+
+    def _count_reventilated(self, n: int) -> None:
+        if n and self._revent_counter is not None:
+            self._revent_counter.add(n)
+
+    @property
+    def dead_workers(self) -> set:
+        with self._lock:
+            return set(self._dead)

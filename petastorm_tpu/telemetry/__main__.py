@@ -116,7 +116,7 @@ def main(argv=None) -> int:
         if args.cmd == "dump" or (args.count and renders >= args.count):
             return 0
         print("---", flush=True)
-        time.sleep(args.interval)
+        time.sleep(args.interval)  # backoff-ok: watch-mode refresh cadence, not a retry
 
 
 if __name__ == "__main__":

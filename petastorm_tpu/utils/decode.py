@@ -72,14 +72,17 @@ class NativeImageSkipMemo:
     """
 
     def __init__(self, base: int = 8, cap: int = 256):
-        self._base, self._cap = base, cap
+        # Count-based backoff (values are row-group counts, not seconds) on
+        # the shared resilience schedule — one backoff formula repo-wide.
+        from petastorm_tpu.resilience.policy import ExponentialBackoff
+        self._backoff = ExponentialBackoff(base=base, multiplier=2.0, cap=cap)
         self._skip = {}     # column -> row groups left to skip
         self._streak = {}   # column -> consecutive all-fail batches
 
     def add(self, name: str):
         streak = self._streak.get(name, 0) + 1
         self._streak[name] = streak
-        self._skip[name] = min(self._base * (2 ** (streak - 1)), self._cap)
+        self._skip[name] = int(self._backoff.value(streak - 1))
 
     def discard(self, name: str):
         self._streak.pop(name, None)

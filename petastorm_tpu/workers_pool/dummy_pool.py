@@ -12,6 +12,8 @@ from __future__ import annotations
 import time
 from collections import deque
 
+from petastorm_tpu.resilience.quarantine import (RowGroupSkipped,
+                                                 RowGroupSkippedMessage)
 from petastorm_tpu.workers_pool import (EmptyResultError,
                                         ITEM_CONTEXT_KWARG,
                                         VentilatedItemProcessedMessage)
@@ -34,6 +36,9 @@ class DummyPool:
         # would pay a lock acquire on every row group.
         self.telemetry = None
         self._decode_hist = None
+        # Consumer-side RowGroupQuarantine aggregator (assigned by the Reader
+        # before start(); same contract as the threaded pools).
+        self.quarantine = None
         #: Cumulative seconds of decode run INLINE inside ``get_results``.
         #: The reader's pool-wait timer wraps ``get_results`` and subtracts
         #: the growth of this value, so ``reader.pool_wait_s`` and
@@ -63,6 +68,10 @@ class DummyPool:
                 raise EmptyResultError()
             while self._results:
                 result = self._results.popleft()
+                if isinstance(result, RowGroupSkippedMessage):
+                    if self.quarantine is not None:
+                        self.quarantine.add(result.record)
+                    continue
                 if isinstance(result, VentilatedItemProcessedMessage):
                     self._processed += 1
                     if self._ventilator:
@@ -77,12 +86,12 @@ class DummyPool:
                             "worker.decode_s")
                     t0 = time.perf_counter()
                     with self.telemetry.span("petastorm_tpu.worker_decode"):
-                        self._worker.process(*args, **kwargs)
+                        self._process_item(args, kwargs)
                     dt = time.perf_counter() - t0
                     self._decode_hist.observe(dt)
                     self.inline_decode_s += dt
                 else:
-                    self._worker.process(*args, **kwargs)
+                    self._process_item(args, kwargs)
                 self._results.append(VentilatedItemProcessedMessage(
                     kwargs.get(ITEM_CONTEXT_KWARG)))
                 continue
@@ -90,6 +99,14 @@ class DummyPool:
                 raise EmptyResultError()
             # The ventilator thread may still be feeding us; yield briefly.
             time.sleep(0.001)
+
+    def _process_item(self, args, kwargs):
+        try:
+            self._worker.process(*args, **kwargs)
+        except RowGroupSkipped as skip:
+            # Degraded-mode give-up: record replaces the item's data; the
+            # processed marker the caller appends keeps accounting exact.
+            self._results.append(RowGroupSkippedMessage(skip.record))
 
     def stop(self):
         if self._ventilator:

@@ -39,6 +39,10 @@ import uuid
 from traceback import format_exc
 
 from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+from petastorm_tpu.resilience.quarantine import (RowGroupSkipped,
+                                                 RowGroupSkippedMessage)
+from petastorm_tpu.resilience.recovery import (CrashBudgetExceededError,
+                                               ItemStartedMessage)
 from petastorm_tpu.workers_pool import (EmptyResultError,
                                         ITEM_CONTEXT_KWARG,
                                         TimeoutWaitingForResultError,
@@ -133,6 +137,13 @@ class ProcessPool:
         # time is not observable here — the consumer-side pool wait recorded
         # by the reader is this pool's queueing signal.
         self.telemetry = None
+        # Consumer-side resilience hooks, assigned by the owning Reader
+        # before start() (like telemetry): a RowGroupQuarantine aggregator
+        # for degraded-mode skip records, and a WorkerCrashRecovery ledger
+        # that turns dead-worker detection into re-ventilation of the lost
+        # row groups instead of a fatal RuntimeError.
+        self.quarantine = None
+        self.recovery = None
         ipc_dir = tempfile.mkdtemp(prefix="pt_pool_")
         token = uuid.uuid4().hex[:8]
         self._endpoints = {
@@ -168,14 +179,21 @@ class ProcessPool:
             p = exec_in_new_process(
                 _worker_bootstrap, worker_id, worker_class, worker_args,
                 type(self._serializer), self._endpoints, os.getpid(),
-                ring_names[worker_id] if ring_names else None)
+                ring_names[worker_id] if ring_names else None,
+                # Claim frames cost a control send per item; only pay when a
+                # crash-recovery ledger is attached to consume them.
+                self.recovery is not None)
             self._processes.append(p)
 
         # Ready-handshake: every worker's PUSH is connected before any
         # ventilation, so no work item can hit a half-built topology.
         ready = set()
         deadline = time.monotonic() + _WORKER_START_TIMEOUT_S
-        while len(ready) < self.workers_count:
+        # A worker that crashes during startup consumes crash budget like a
+        # mid-epoch death; the handshake then only waits for the survivors.
+        while len(ready) < self.workers_count - (
+                len(self.recovery.dead_workers) if self.recovery is not None
+                else 0):
             if time.monotonic() > deadline:
                 self.stop(); self.join()
                 raise RuntimeError(
@@ -196,6 +214,9 @@ class ProcessPool:
             self._ventilator.start()
 
     def ventilate(self, *args, **kwargs):
+        if self.recovery is not None:
+            self.recovery.on_ventilated(kwargs.get(ITEM_CONTEXT_KWARG),
+                                        (args, kwargs))
         self._ventilated += 1
         self._work_socket.send_pyobj((args, kwargs))
 
@@ -211,13 +232,31 @@ class ProcessPool:
             msg = self._poll_result(timeout_ms=_POLL_MS)
             if msg is None:
                 self._check_processes_alive()
+                if self.recovery is not None:
+                    # Post-crash sweep: items that sat unclaimed in a dead
+                    # worker's receive buffer surface once the pool quiesces.
+                    for item in self.recovery.unaccounted_after_quiesce():
+                        self._resend(item)
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutWaitingForResultError()
                 continue
             if isinstance(msg, VentilatedItemProcessedMessage):
                 self._processed += 1
+                if self.recovery is not None:
+                    self.recovery.on_processed(msg.item_context)
                 if self._ventilator:
                     self._ventilator.processed_item(msg.item_context)
+                continue
+            if isinstance(msg, ItemStartedMessage):
+                if self.recovery is not None:
+                    self.recovery.on_started(msg.worker_id, msg.item_context)
+                continue
+            if isinstance(msg, RowGroupSkippedMessage):
+                if self.quarantine is not None:
+                    self.quarantine.add(msg.record)
+                else:
+                    logger.warning("Row group quarantined with no aggregator "
+                                   "attached: %s", msg.record.piece)
                 continue
             if isinstance(msg, WorkerFailure):
                 logger.error("Worker failed:\n%s", msg.traceback_str)
@@ -256,7 +295,7 @@ class ProcessPool:
                     self._control_socket.send(_CONTROL_FINISH)
                 except Exception:  # noqa: BLE001
                     break
-            time.sleep(0.05)
+            time.sleep(0.05)  # backoff-ok: graceful-shutdown pacing, not a retry
         for p in self._processes:
             if p.poll() is None:
                 p.kill()
@@ -358,7 +397,7 @@ class ProcessPool:
             if not progressed:
                 if time.monotonic() >= deadline:
                     return None
-                time.sleep(0.0001)
+                time.sleep(0.0001)  # backoff-ok: ring poll yield, not a retry
 
     def _poll_result_zmq(self, timeout_ms: int):
         import zmq
@@ -377,20 +416,52 @@ class ProcessPool:
             result = self.result_transform(result)
         return result
 
+    def _resend(self, item):
+        """Re-ventilate a lost work item WITHOUT bumping ``_ventilated``:
+        the original ventilation already counted it, and the dead worker
+        will never send its processed marker — the re-sent copy's marker
+        balances the books. ZMQ routes the send to a connected (live) PULL
+        peer; the dead worker's socket is gone."""
+        args, kwargs = item
+        self._work_socket.send_pyobj((args, kwargs))
+
     def _check_processes_alive(self):
         for i, p in enumerate(self._processes):
             rc = p.poll()
-            if rc is not None and rc != 0 and not self._stopped:
-                self.stop(); self.join()
-                raise RuntimeError(
-                    f"Worker process {i} died unexpectedly with exit code {rc}")
+            if rc is None or rc == 0 or self._stopped:
+                continue
+            if self.recovery is not None:
+                if i in self.recovery.dead_workers:
+                    continue  # already recovered
+                try:
+                    lost = self.recovery.on_worker_death(i, rc)
+                except CrashBudgetExceededError:
+                    self.stop(); self.join()
+                    raise
+                logger.warning(
+                    "Worker process %d died with exit code %s; re-ventilating "
+                    "%d claimed item(s) onto the %d surviving worker(s)",
+                    i, rc, len(lost),
+                    self.workers_count - len(self.recovery.dead_workers))
+                for item in lost:
+                    self._resend(item)
+                continue
+            self.stop(); self.join()
+            raise RuntimeError(
+                f"Worker process {i} died unexpectedly with exit code {rc}")
 
 
 # ------------------------------------------------------------- worker side
 def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
-                      endpoints, parent_pid, ring_name=None):
+                      endpoints, parent_pid, ring_name=None,
+                      send_claims=False):
     """Entry function of a spawned worker process (reference :330)."""
     import zmq
+
+    from petastorm_tpu.resilience.faults import mark_spawned_worker
+    # Legalize worker_kill faults (they refuse to fire in non-pool
+    # processes) and let fault plans key per-process determinism.
+    mark_spawned_worker()
 
     context = zmq.Context()
     work_socket = context.socket(zmq.PULL)
@@ -458,7 +529,22 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
             if work_socket in events:
                 args, kwargs = work_socket.recv_pyobj()
                 try:
-                    worker.process(*args, **kwargs)
+                    # Claim frame BEFORE processing: on a hard crash the
+                    # consumer's recovery ledger knows exactly which item
+                    # this worker owned and re-ventilates it. Data precedes
+                    # the processed marker on the same FIFO transport, so a
+                    # claimed-but-unmarked item is never half-delivered.
+                    # Skipped when no recovery ledger is attached — the
+                    # consumer would just discard the frame.
+                    if send_claims:
+                        send_ctrl(ItemStartedMessage(
+                            worker_id, kwargs.get(ITEM_CONTEXT_KWARG)))
+                    try:
+                        worker.process(*args, **kwargs)
+                    except RowGroupSkipped as skip:
+                        # Degraded mode: ship the quarantine record; the
+                        # processed marker below completes the item.
+                        send_ctrl(RowGroupSkippedMessage(skip.record))
                     send_ctrl(VentilatedItemProcessedMessage(
                         kwargs.get(ITEM_CONTEXT_KWARG)))
                 except _RING_CLOSED_ERRORS:

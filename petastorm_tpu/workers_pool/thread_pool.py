@@ -29,6 +29,8 @@ import threading
 from traceback import format_exc
 from typing import Optional
 
+from petastorm_tpu.resilience.quarantine import (RowGroupSkipped,
+                                                 RowGroupSkippedMessage)
 from petastorm_tpu.workers_pool import (EmptyResultError,
                                         ITEM_CONTEXT_KWARG,
                                         VentilatedItemProcessedMessage,
@@ -93,12 +95,21 @@ class _WorkerThread(threading.Thread):
             if self._decode_hist is not None:
                 t0 = time.perf_counter()
                 with self._telemetry.span("petastorm_tpu.worker_decode"):
-                    self._worker_impl.process(*args, **kwargs)
+                    self._process_item(args, kwargs)
                 self._decode_hist.observe(time.perf_counter() - t0)
             else:
-                self._worker_impl.process(*args, **kwargs)
+                self._process_item(args, kwargs)
             self._put(VentilatedItemProcessedMessage(
                 kwargs.get(ITEM_CONTEXT_KWARG)))
+
+    def _process_item(self, args, kwargs):
+        try:
+            self._worker_impl.process(*args, **kwargs)
+        except RowGroupSkipped as skip:
+            # Degraded-mode give-up: the skip record replaces the item's
+            # data; the processed marker still follows, so pool accounting
+            # treats the item as complete.
+            self._put(RowGroupSkippedMessage(skip.record))
 
 
 class ThreadPool:
@@ -138,6 +149,10 @@ class ThreadPool:
         # Pipeline telemetry registry; the owning Reader assigns it before
         # start() so worker threads can publish in-worker decode timings.
         self.telemetry = None
+        # Consumer-side RowGroupQuarantine aggregator (assigned by the Reader
+        # before start() when degraded mode is available); skip messages are
+        # dropped with a warning when nothing is attached.
+        self.quarantine = None
 
     # ------------------------------------------------------------------ api
     def start(self, worker_class, worker_args=None, ventilator=None):
@@ -215,7 +230,7 @@ class ThreadPool:
                 self._next_read = (self._next_read + 1) % self.workers_count
                 empty_sweeps += 1
                 if empty_sweeps >= self.workers_count:
-                    time.sleep(_IO_TIMEOUT_S)
+                    time.sleep(_IO_TIMEOUT_S)  # backoff-ok: queue-poll yield, not a retry
                     empty_sweeps = 0
                 continue
             try:
@@ -226,10 +241,17 @@ class ThreadPool:
                     self._next_read = (self._next_read + 1) % self.workers_count
                     empty_sweeps += 1
                     if empty_sweeps >= self.workers_count:
-                        time.sleep(_IO_TIMEOUT_S)
+                        time.sleep(_IO_TIMEOUT_S)  # backoff-ok: queue-poll yield, not a retry
                         empty_sweeps = 0
                 continue
             empty_sweeps = 0
+            if isinstance(result, RowGroupSkippedMessage):
+                if self.quarantine is not None:
+                    self.quarantine.add(result.record)
+                else:
+                    logger.warning("Row group quarantined with no aggregator "
+                                   "attached: %s", result.record.piece)
+                continue  # the item's processed marker follows on this queue
             if isinstance(result, VentilatedItemProcessedMessage):
                 self._processed[wid] += 1
                 if self._ventilator:

@@ -1,0 +1,711 @@
+"""Fault-tolerance subsystem tests: retry policies, deterministic fault
+injection, row-group quarantine, worker-crash recovery — unit level plus the
+end-to-end acceptance scenarios (transient faults survive losslessly; a
+permanently corrupt row group is quarantined in degraded mode; a killed
+process-pool worker's row groups are re-ventilated exactly once)."""
+import glob
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.resilience import (CrashBudgetExceededError,
+                                      DEFAULT_READ_POLICY, ExponentialBackoff,
+                                      FaultPlan, FaultSpec,
+                                      InjectedCorruptionError, InjectedFault,
+                                      InjectedIOError, PERMANENT,
+                                      QuarantineRecord, RetryPolicy,
+                                      RowGroupGuard, RowGroupQuarantine,
+                                      RowGroupSkipped, TRANSIENT,
+                                      WorkerCrashRecovery,
+                                      default_io_classifier,
+                                      failover_classifier, no_retry,
+                                      sqlite_classifier)
+from petastorm_tpu.telemetry import (TelemetryRegistry, parse_prometheus_text,
+                                     to_prometheus_text)
+
+pytestmark = pytest.mark.resilience
+
+#: Zero-delay policy for tests: full retry semantics, no wall-clock sleeps.
+FAST = RetryPolicy(max_attempts=3,
+                   backoff=ExponentialBackoff(base=0.0, multiplier=1.0, cap=0.0),
+                   jitter="none", seed=0)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+class TestExponentialBackoff:
+    def test_schedule_values_and_cap(self):
+        b = ExponentialBackoff(base=0.1, multiplier=2.0, cap=0.5)
+        assert [b.value(n) for n in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="base>=0"):
+            ExponentialBackoff(base=-1)
+        with pytest.raises(ValueError, match="multiplier>=1"):
+            ExponentialBackoff(multiplier=0.5)
+
+
+class TestClassifiers:
+    @pytest.mark.parametrize("exc,verdict", [
+        (IOError("conn reset"), TRANSIENT),
+        (OSError("timeout"), TRANSIENT),
+        (InjectedIOError("x"), TRANSIENT),
+        (FileNotFoundError("gone"), PERMANENT),
+        (PermissionError("denied"), PERMANENT),
+        (ValueError("corrupt"), PERMANENT),
+        (InjectedCorruptionError("x"), PERMANENT),
+        (KeyError("k"), PERMANENT),
+    ])
+    def test_default_io(self, exc, verdict):
+        assert default_io_classifier(exc) == verdict
+        assert failover_classifier(exc) == verdict
+
+    def test_sqlite_locked_is_transient(self):
+        assert sqlite_classifier(sqlite3.OperationalError("database is locked")) \
+            == TRANSIENT
+        assert sqlite_classifier(FileNotFoundError("x")) == PERMANENT
+
+
+class TestRetryPolicy:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter="bogus")
+
+    def test_seeded_schedule_is_reproducible(self):
+        p = RetryPolicy(max_attempts=6, jitter="full", seed=7)
+        assert p.schedule() == p.schedule()
+        assert p.schedule() != RetryPolicy(max_attempts=6, jitter="full",
+                                           seed=8).schedule()
+
+    def test_full_jitter_bounded_by_raw_delay(self):
+        p = RetryPolicy(max_attempts=8, jitter="full", seed=1,
+                        backoff=ExponentialBackoff(base=0.1, multiplier=2.0,
+                                                   cap=1.0))
+        for i, d in enumerate(p.schedule()):
+            assert 0.0 <= d <= p.backoff.value(i)
+
+    def test_decorrelated_jitter_bounded_by_cap(self):
+        p = RetryPolicy(max_attempts=10, jitter="decorrelated", seed=3,
+                        backoff=ExponentialBackoff(base=0.05, cap=0.4))
+        assert all(0.05 <= d <= 0.4 for d in p.schedule())
+
+    def test_success_first_try(self):
+        assert FAST.call(lambda: 42) == 42
+
+    def test_retries_transient_then_succeeds(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        assert FAST.call(flaky) == "ok"
+        assert len(attempts) == 3
+
+    def test_permanent_propagates_immediately(self):
+        attempts = []
+
+        def broken():
+            attempts.append(1)
+            raise FileNotFoundError("gone")
+
+        with pytest.raises(FileNotFoundError):
+            FAST.call(broken)
+        assert len(attempts) == 1
+
+    def test_exhaustion_reraises_last_original(self):
+        err = IOError("always")
+        with pytest.raises(IOError) as info:
+            FAST.call(lambda: (_ for _ in ()).throw(err))
+        assert info.value is err
+
+    def test_on_retry_and_on_give_up_callbacks(self):
+        retries, giveups = [], []
+        with pytest.raises(IOError):
+            FAST.call(lambda: (_ for _ in ()).throw(IOError("x")),
+                      on_retry=lambda a, e, d: retries.append((a, d)),
+                      on_give_up=lambda a, e: giveups.append(a))
+        assert [a for a, _ in retries] == [1, 2]
+        assert giveups == [3]
+
+    def test_injectable_sleep_receives_schedule(self):
+        p = RetryPolicy(max_attempts=3, seed=0,
+                        backoff=ExponentialBackoff(base=0.1, multiplier=2.0,
+                                                   cap=10.0))
+        slept = []
+        with pytest.raises(IOError):
+            p.call(lambda: (_ for _ in ()).throw(IOError("x")),
+                   sleep=slept.append)
+        assert slept == pytest.approx([0.1, 0.2])
+
+    def test_total_deadline_stops_retrying(self):
+        p = RetryPolicy(max_attempts=10, total_deadline_s=0.0,
+                        backoff=ExponentialBackoff(base=0.05))
+        attempts = []
+        with pytest.raises(IOError):
+            p.call(lambda: attempts.append(1) or
+                   (_ for _ in ()).throw(IOError("x")))
+        assert len(attempts) == 1  # first delay would already bust the deadline
+
+    def test_attempt_timeout_stops_slow_site(self):
+        p = RetryPolicy(max_attempts=5, attempt_timeout_s=0.0,
+                        backoff=ExponentialBackoff(base=0.0))
+        attempts = []
+
+        def slow():
+            attempts.append(1)
+            time.sleep(0.01)
+            raise IOError("slow failure")
+
+        with pytest.raises(IOError):
+            p.call(slow)
+        assert len(attempts) == 1
+
+    def test_no_retry_single_attempt(self):
+        attempts = []
+        with pytest.raises(IOError):
+            no_retry().call(lambda: attempts.append(1) or
+                            (_ for _ in ()).throw(IOError("x")))
+        assert len(attempts) == 1
+
+    def test_wrap_decorator(self):
+        calls = []
+
+        @FAST.wrap
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise IOError("once")
+            return "done"
+
+        assert flaky() == "done"
+        assert len(calls) == 2
+
+    def test_policy_pickles(self):
+        p = pickle.loads(pickle.dumps(
+            RetryPolicy(max_attempts=4, jitter="decorrelated", seed=11,
+                        classify=sqlite_classifier)))
+        assert p.max_attempts == 4 and p.classify is sqlite_classifier
+        assert pickle.loads(pickle.dumps(DEFAULT_READ_POLICY)).schedule() \
+            == DEFAULT_READ_POLICY.schedule()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+class TestFaultPlan:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultSpec(site="s", kind="nope", at=1)
+        with pytest.raises(ValueError, match="exactly one"):
+            FaultSpec(site="s")
+        with pytest.raises(ValueError, match="exactly one"):
+            FaultSpec(site="s", at=1, rate=0.5)
+        with pytest.raises(ValueError, match="1-based"):
+            FaultSpec(site="s", at=0)
+        with pytest.raises(ValueError, match="rate"):
+            FaultSpec(site="s", rate=1.5)
+
+    def test_at_fires_on_exactly_nth_access_once(self):
+        plan = FaultPlan([FaultSpec(site="s", at=3)])
+        plan.fire("s"); plan.fire("s")
+        with pytest.raises(InjectedIOError):
+            plan.fire("s")
+        for _ in range(5):
+            plan.fire("s")  # budget spent: never again
+        assert plan.stats()["specs"][0] == {"site": "s", "kind": "ioerror",
+                                            "seen": 8, "fired": 1}
+
+    def test_site_and_key_substring_filtering(self):
+        plan = FaultPlan([FaultSpec(site="s", at=1, key_substring="bad")])
+        plan.fire("other", key="bad")   # wrong site
+        plan.fire("s", key="good")      # key mismatch
+        with pytest.raises(InjectedIOError):
+            plan.fire("s", key="very-bad-file")
+
+    def test_worker_filter(self):
+        plan = FaultPlan([FaultSpec(site="s", at=1, worker=1)])
+        plan.fire("s", worker_id=0)
+        plan.fire("s", worker_id=2)
+        with pytest.raises(InjectedIOError):
+            plan.fire("s", worker_id=1)
+
+    def test_rate_is_seeded_and_per_worker_deterministic(self):
+        def sequence(seed, worker_id, n=50):
+            plan = FaultPlan([FaultSpec(site="s", rate=0.3)], seed=seed)
+            out = []
+            for _ in range(n):
+                try:
+                    plan.fire("s", worker_id=worker_id)
+                    out.append(0)
+                except InjectedIOError:
+                    out.append(1)
+            return out
+
+        assert sequence(0, 0) == sequence(0, 0)
+        assert sequence(0, 0) != sequence(0, 1)   # workers draw independently
+        assert sequence(0, 0) != sequence(1, 0)   # seed changes the run
+        assert sum(sequence(0, 0)) > 0
+
+    def test_rate_with_times_cap(self):
+        plan = FaultPlan([FaultSpec(site="s", rate=1.0, times=2)])
+        fired = 0
+        for _ in range(10):
+            try:
+                plan.fire("s")
+            except InjectedIOError:
+                fired += 1
+        assert fired == 2
+
+    def test_corruption_is_permanent_injected_valueerror(self):
+        plan = FaultPlan([FaultSpec(site="s", at=1, kind="corruption")])
+        with pytest.raises(InjectedCorruptionError) as info:
+            plan.fire("s")
+        assert isinstance(info.value, (ValueError, InjectedFault))
+        assert default_io_classifier(info.value) == PERMANENT
+
+    def test_latency_fault_sleeps_then_returns(self):
+        plan = FaultPlan([FaultSpec(site="s", at=1, kind="latency",
+                                    latency_s=0.02)])
+        t0 = time.monotonic()
+        plan.fire("s")
+        assert time.monotonic() - t0 >= 0.02
+
+    def test_worker_kill_refuses_outside_spawned_worker(self):
+        plan = FaultPlan([FaultSpec(site="s", at=1, kind="worker_kill")])
+        with pytest.raises(RuntimeError, match="spawned process-pool worker"):
+            plan.fire("s")
+
+    def test_pickle_roundtrip_resets_runtime_counters(self):
+        plan = FaultPlan([FaultSpec(site="s", at=2)], seed=5)
+        plan.fire("s")
+        clone = pickle.loads(pickle.dumps(plan))
+        assert clone.seed == 5
+        assert clone.stats()["specs"][0]["seen"] == 0
+        clone.fire("s")
+        with pytest.raises(InjectedIOError):
+            clone.fire("s")  # per-process determinism: the clone counts anew
+
+
+# ---------------------------------------------------------------------------
+# RowGroupGuard / RowGroupQuarantine
+# ---------------------------------------------------------------------------
+def _rowgroup(path="/data/part-0.parquet", rg=3):
+    return SimpleNamespace(path=path, row_group=rg)
+
+
+class TestRowGroupGuard:
+    def test_retries_then_returns_and_counts(self):
+        registry = TelemetryRegistry()
+        guard = RowGroupGuard(policy=FAST, telemetry=registry)
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise IOError("transient")
+            return "data"
+
+        assert guard.run(flaky, _rowgroup()) == "data"
+        snap = registry.snapshot()["counters"]
+        assert snap["resilience.retries_total"] == 1
+        assert snap["resilience.giveups_total"] == 0
+
+    def test_failfast_mode_propagates_after_exhaustion(self):
+        registry = TelemetryRegistry()
+        guard = RowGroupGuard(policy=FAST, degraded_mode=False,
+                              telemetry=registry)
+        with pytest.raises(IOError):
+            guard.run(lambda: (_ for _ in ()).throw(IOError("x")), _rowgroup())
+        assert registry.snapshot()["counters"]["resilience.giveups_total"] == 1
+
+    def test_degraded_mode_raises_skip_with_provenance(self):
+        guard = RowGroupGuard(policy=FAST, degraded_mode=True, worker_id=7)
+        with pytest.raises(RowGroupSkipped) as info:
+            guard.run(lambda: (_ for _ in ()).throw(InjectedIOError("io down")),
+                      _rowgroup("/d/p.parquet", 5))
+        rec = info.value.record
+        assert rec.path == "/d/p.parquet" and rec.row_group == 5
+        assert rec.error_type == "InjectedIOError"
+        assert "io down" in rec.error_message
+        assert rec.attempts == FAST.max_attempts
+        assert rec.worker_id == 7 and rec.injected
+        assert rec.piece == "/d/p.parquet#5"
+        pickle.loads(pickle.dumps(rec))  # crosses the process-pool boundary
+
+    def test_degraded_mode_permanent_failure_skips_without_retry(self):
+        guard = RowGroupGuard(policy=FAST, degraded_mode=True)
+        attempts = []
+        with pytest.raises(RowGroupSkipped) as info:
+            guard.run(lambda: attempts.append(1) or
+                      (_ for _ in ()).throw(ValueError("corrupt")),
+                      _rowgroup())
+        assert len(attempts) == 1
+        assert info.value.record.attempts == 1
+        assert not info.value.record.injected
+
+    def test_on_retry_hook_fires(self):
+        evictions = []
+        guard = RowGroupGuard(policy=FAST)
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise IOError("x")
+            return 1
+
+        guard.run(flaky, _rowgroup(), on_retry=lambda a, e, d: evictions.append(a))
+        assert evictions == [1, 2]
+
+
+class TestRowGroupQuarantine:
+    def test_report_schema_and_telemetry(self):
+        registry = TelemetryRegistry()
+        q = RowGroupQuarantine(telemetry=registry)
+        q.add(QuarantineRecord(path="/d/a.parquet", row_group=0,
+                               error_type="InjectedIOError",
+                               error_message="io", attempts=3))
+        q.add(QuarantineRecord(path="/d/b.parquet", row_group=2,
+                               error_type="ValueError",
+                               error_message="corrupt", attempts=1))
+        q.add(QuarantineRecord(path="/d/b.parquet", row_group=3,
+                               error_type="ValueError",
+                               error_message="corrupt", attempts=1))
+        assert len(q) == 3
+        assert q.paths() == ["/d/a.parquet", "/d/b.parquet"]
+        report = q.report()
+        assert report["quarantined"] == 3
+        assert report["by_error_type"] == {"InjectedIOError": 1, "ValueError": 2}
+        assert report["pieces"][0]["piece"] == "/d/a.parquet#0"
+        assert registry.snapshot()["counters"][
+            "resilience.quarantined_rowgroups"] == 3
+
+    def test_thread_safety(self):
+        q = RowGroupQuarantine()
+
+        def add_many():
+            for i in range(200):
+                q.add(QuarantineRecord(path="/p", row_group=i, error_type="E",
+                                       error_message="m", attempts=1))
+
+        threads = [threading.Thread(target=add_many) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(q) == 800
+
+
+# ---------------------------------------------------------------------------
+# WorkerCrashRecovery ledger
+# ---------------------------------------------------------------------------
+class TestWorkerCrashRecovery:
+    def test_claimed_items_of_dead_worker_are_returned(self):
+        registry = TelemetryRegistry()
+        rec = WorkerCrashRecovery(budget=1, telemetry=registry)
+        rec.on_ventilated((0, 0), (("a",), {}))
+        rec.on_ventilated((0, 1), (("b",), {}))
+        rec.on_started(0, (0, 0))
+        rec.on_started(1, (0, 1))
+        rec.on_processed((0, 1))
+        lost = rec.on_worker_death(0, -9)
+        assert lost == [(("a",), {})]
+        counters = registry.snapshot()["counters"]
+        assert counters["resilience.worker_crashes"] == 1
+        assert counters["resilience.reventilated_items"] == 1
+        assert rec.dead_workers == {0}
+
+    def test_double_death_is_idempotent(self):
+        rec = WorkerCrashRecovery(budget=1)
+        assert rec.on_worker_death(0, -9) == []
+        assert rec.on_worker_death(0, -9) == []
+        assert rec.crashes == 1
+
+    def test_budget_exceeded_raises(self):
+        rec = WorkerCrashRecovery(budget=1)
+        rec.on_worker_death(0, -9)
+        with pytest.raises(CrashBudgetExceededError, match="worker_crash_budget=1"):
+            rec.on_worker_death(1, -9)
+
+    def test_untracked_items_are_skipped(self):
+        rec = WorkerCrashRecovery(budget=1)
+        rec.on_ventilated(None, (("bare",), {}))   # no ventilator context
+        rec.on_started(0, None)
+        rec.on_processed(None)
+        assert rec.on_worker_death(0, -9) == []
+
+    def test_quiesce_sweep_returns_unclaimed_after_grace(self):
+        rec = WorkerCrashRecovery(budget=1, grace_s=0.0)
+        rec.on_ventilated((0, 0), (("buffered",), {}))
+        assert rec.unaccounted_after_quiesce() == []   # no crash yet
+        rec.on_worker_death(0, -9)
+        items = rec.unaccounted_after_quiesce()
+        assert items == [(("buffered",), {})]
+        assert rec.unaccounted_after_quiesce() == []   # drained once
+
+    def test_quiesce_waits_for_outstanding_claims(self):
+        rec = WorkerCrashRecovery(budget=1, grace_s=0.0)
+        rec.on_ventilated((0, 0), (("x",), {}))
+        rec.on_ventilated((0, 1), (("y",), {}))
+        rec.on_started(1, (0, 1))     # live worker still owns (0, 1)
+        rec.on_worker_death(0, -9)
+        assert rec.unaccounted_after_quiesce() == []
+        rec.on_processed((0, 1))
+        assert rec.unaccounted_after_quiesce() == [(("x",), {})]
+
+    def test_quiesce_respects_grace_period(self):
+        rec = WorkerCrashRecovery(budget=1, grace_s=30.0)
+        rec.on_ventilated((0, 0), (("x",), {}))
+        rec.on_worker_death(0, -9)
+        rec.note_activity()
+        assert rec.unaccounted_after_quiesce() == []   # pool still active
+
+    def test_swept_item_survives_second_crash(self):
+        """A swept (re-sent) item stays in the ledger: if the live worker
+        that claims the re-sent copy then dies too, the item is
+        re-ventilated again instead of silently lost."""
+        rec = WorkerCrashRecovery(budget=2, grace_s=0.0)
+        rec.on_ventilated((0, 0), (("x",), {}))
+        rec.on_worker_death(0, -9)                     # x unclaimed in 0's buffer
+        assert rec.unaccounted_after_quiesce() == [(("x",), {})]
+        rec.on_started(1, (0, 0))                      # re-sent copy claimed by 1
+        assert rec.on_worker_death(1, -9) == [(("x",), {})]   # 1 dies too
+        rec.on_started(2, (0, 0))
+        rec.on_processed((0, 0))
+        assert rec.unaccounted_after_quiesce() == []   # fully settled
+
+    def test_second_crash_makes_swept_items_sweep_eligible_again(self):
+        """An item re-sent by a sweep and STILL unclaimed when another
+        worker dies may be sitting in that dead worker's buffer — the next
+        quiesce sweep must return it again."""
+        rec = WorkerCrashRecovery(budget=2, grace_s=0.0)
+        rec.on_ventilated((0, 0), (("x",), {}))
+        rec.on_worker_death(0, -9)
+        assert rec.unaccounted_after_quiesce() == [(("x",), {})]
+        assert rec.unaccounted_after_quiesce() == []   # swept: not re-returned
+        rec.on_worker_death(1, -9)                     # re-sent copy maybe lost too
+        assert rec.unaccounted_after_quiesce() == [(("x",), {})]
+
+
+# ---------------------------------------------------------------------------
+# LocalDiskCache resilience + Reader shutdown
+# ---------------------------------------------------------------------------
+class TestDiskCacheResilience:
+    def test_fill_fault_site_fires_on_miss_only(self, tmp_path):
+        from petastorm_tpu.local_disk_cache import LocalDiskCache
+        plan = FaultPlan([FaultSpec(site="cache.fill", at=1)])
+        cache = LocalDiskCache(str(tmp_path), 10 << 20, fault_plan=plan)
+        with pytest.raises(InjectedIOError):
+            cache.get("k", lambda: b"v")
+        assert cache.get("k", lambda: b"v") == b"v"   # budget spent: fill runs
+        assert cache.get("k", lambda: 1 / 0) == b"v"  # hit path: no fill, no fault
+        cache.cleanup()
+
+    def test_locked_database_retries(self, tmp_path, monkeypatch):
+        from petastorm_tpu.local_disk_cache import LocalDiskCache
+        cache = LocalDiskCache(str(tmp_path), 10 << 20)
+        real_lookup, attempts = cache._lookup, []
+
+        def flaky_lookup(key):
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise sqlite3.OperationalError("database is locked")
+            return real_lookup(key)
+
+        monkeypatch.setattr(cache, "_lookup", flaky_lookup)
+        assert cache.get("k", lambda: "filled") == "filled"
+        assert len(attempts) == 2
+        cache.cleanup()
+
+    def test_cleanup_is_idempotent_and_cache_reusable(self, tmp_path):
+        from petastorm_tpu.local_disk_cache import LocalDiskCache
+        cache = LocalDiskCache(str(tmp_path), 10 << 20)
+        cache.get("k", lambda: "v")
+        cache.cleanup()
+        cache.cleanup()                                # second close: no-op
+        assert cache.get("k", lambda: "v2") == "v"    # reconnects transparently
+        cache.cleanup()
+
+    def test_pickle_carries_policy_and_plan(self, tmp_path):
+        from petastorm_tpu.local_disk_cache import LocalDiskCache
+        plan = FaultPlan([FaultSpec(site="cache.fill", at=1)], seed=3)
+        cache = LocalDiskCache(str(tmp_path), 10 << 20,
+                               retry_policy=FAST, fault_plan=plan)
+        clone = pickle.loads(pickle.dumps(cache))
+        assert clone._policy.max_attempts == FAST.max_attempts
+        assert clone._fault_plan.seed == 3
+        cache.cleanup(); clone.cleanup()
+
+    def test_reader_join_closes_cache(self, synthetic_dataset, tmp_path):
+        with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                         cache_type="local-disk", cache_location=str(tmp_path),
+                         cache_size_limit=50 << 20,
+                         shuffle_row_groups=False) as reader:
+            next(reader)
+            cache = reader._cache
+            assert cache._all_conns
+        assert not cache._all_conns    # __exit__ -> join() -> cache.cleanup()
+        cache.cleanup()                # and an extra explicit close is fine
+
+
+# ---------------------------------------------------------------------------
+# HDFS failover on the shared policy
+# ---------------------------------------------------------------------------
+class TestHdfsFailoverPolicy:
+    def test_injected_fault_drives_failover(self):
+        from petastorm_tpu.hdfs.namenode import HAHdfsClient, HdfsConnector
+
+        class _Fs:
+            def __init__(self, name):
+                self.name = name
+
+            def ls(self, path):
+                return [f"{path}/from-{self.name}"]
+
+        class _Connector(HdfsConnector):
+            @classmethod
+            def hdfs_connect_namenode(cls, netloc, user=None, **kwargs):
+                return _Fs(netloc)
+
+        plan = FaultPlan([FaultSpec(site="hdfs.call", at=1,
+                                    key_substring="ls")])
+        client = HAHdfsClient(_Connector, ["nn1:8020", "nn2:8020"],
+                              fault_plan=plan)
+        # First attempt hits the injected IOError -> policy fails over to
+        # nn2 and the call succeeds there.
+        assert client.ls("/x") == ["/x/from-nn2:8020"]
+        assert plan.stats()["specs"][0]["fired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end acceptance scenarios
+# ---------------------------------------------------------------------------
+#: rowgroup.read runs under the guard: generous attempts make the chance of
+#: a 10%-rate fault exhausting the policy negligible (0.1^5) while the
+#: zero-second schedule keeps the test fast.
+E2E_POLICY = RetryPolicy(max_attempts=5,
+                         backoff=ExponentialBackoff(base=0.0, multiplier=1.0,
+                                                    cap=0.0),
+                         jitter="none", seed=0)
+
+
+def _read_all_ids(reader):
+    ids = []
+    for sample in reader:
+        ids.append(int(sample.id))
+    return ids
+
+
+class TestEndToEndResilience:
+    def test_transient_faults_epoch_lossless(self, synthetic_dataset):
+        """10% injected transient IOErrors on row-group reads (plus one
+        deterministic first-read fault so at least one retry always happens):
+        the epoch completes losslessly via retries."""
+        plan = FaultPlan([
+            FaultSpec(site="rowgroup.read", kind="ioerror", rate=0.10),
+            FaultSpec(site="rowgroup.read", kind="ioerror", at=1),
+        ], seed=42)
+        with make_reader(synthetic_dataset.url, reader_pool_type="thread",
+                         workers_count=2, shuffle_row_groups=False,
+                         retry_policy=E2E_POLICY, fault_plan=plan) as reader:
+            ids = _read_all_ids(reader)
+            diag = reader.diagnostics
+        assert sorted(ids) == list(range(100))
+        counters = diag["telemetry"]["counters"]
+        assert counters["resilience.retries_total"] >= 1
+        assert reader.quarantine_report()["quarantined"] == 0
+        # The acceptance counter is nonzero in the Prometheus export too.
+        prom = parse_prometheus_text(to_prometheus_text(diag["telemetry"]))
+        assert prom["petastorm_tpu_resilience_retries_total"][""] >= 1
+
+    def test_corrupt_rowgroup_quarantined_in_degraded_mode(self,
+                                                           synthetic_dataset):
+        """A permanently corrupt file: degraded_mode=True completes the
+        epoch, the quarantine report names the pieces, and the telemetry
+        export carries nonzero resilience counters."""
+        corrupt = os.path.basename(sorted(glob.glob(
+            os.path.join(synthetic_dataset.path, "*.parquet")))[0])
+        plan = FaultPlan([
+            FaultSpec(site="rowgroup.read", kind="corruption", rate=1.0,
+                      key_substring=corrupt),
+            FaultSpec(site="rowgroup.read", kind="ioerror", at=1),  # 1 retry
+        ], seed=0)
+        with make_reader(synthetic_dataset.url, reader_pool_type="thread",
+                         workers_count=2, shuffle_row_groups=False,
+                         retry_policy=E2E_POLICY, degraded_mode=True,
+                         fault_plan=plan) as reader:
+            ids = _read_all_ids(reader)
+            report = reader.quarantine_report()
+            diag = reader.diagnostics
+        # Every row group of the corrupt file was skipped (2 per file), the
+        # other 80 rows all arrived exactly once.
+        assert report["quarantined"] == 2
+        assert all(corrupt in p["path"] for p in report["pieces"])
+        assert all(p["error_type"] == "InjectedCorruptionError"
+                   and p["injected"] for p in report["pieces"])
+        assert len(ids) == len(set(ids)) == 80
+        prom = parse_prometheus_text(to_prometheus_text(diag["telemetry"]))
+        assert prom["petastorm_tpu_resilience_quarantined_rowgroups"][""] == 2
+        assert prom["petastorm_tpu_resilience_retries_total"][""] >= 1
+
+    def test_corruption_without_degraded_mode_fails_fast(self,
+                                                         synthetic_dataset):
+        plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="corruption",
+                                    at=1)])
+        with pytest.raises(InjectedCorruptionError):
+            with make_reader(synthetic_dataset.url, reader_pool_type="thread",
+                             workers_count=2, shuffle_row_groups=False,
+                             retry_policy=E2E_POLICY,
+                             fault_plan=plan) as reader:
+                _read_all_ids(reader)
+
+    def test_crash_budget_warns_on_inprocess_pools(self, synthetic_dataset):
+        with pytest.warns(UserWarning, match="worker_crash_budget"):
+            with make_reader(synthetic_dataset.url, reader_pool_type="thread",
+                             worker_crash_budget=1,
+                             shuffle_row_groups=False) as reader:
+                next(reader)
+
+    @pytest.mark.process_pool
+    def test_worker_kill_recovery_epoch_exactly_once(self, synthetic_dataset):
+        """Kill worker 0 (SIGKILL via the fault plan) at its second row group
+        while 10% transient IOErrors also fly: with worker_crash_budget=1 the
+        epoch still delivers every row exactly once and telemetry records the
+        crash + re-ventilation."""
+        plan = FaultPlan([
+            FaultSpec(site="worker.item", kind="worker_kill", at=2, worker=0),
+            FaultSpec(site="rowgroup.read", kind="ioerror", rate=0.10),
+        ], seed=7)
+        with make_reader(synthetic_dataset.url, reader_pool_type="process",
+                         workers_count=2, shuffle_row_groups=False,
+                         retry_policy=E2E_POLICY, fault_plan=plan,
+                         worker_crash_budget=1) as reader:
+            ids = _read_all_ids(reader)
+            diag = reader.diagnostics
+        assert sorted(ids) == list(range(100))   # lossless AND duplicate-free
+        counters = diag["telemetry"]["counters"]
+        assert counters["resilience.worker_crashes"] == 1
+        assert counters["resilience.reventilated_items"] >= 1
+
+    @pytest.mark.process_pool
+    def test_worker_kill_without_budget_is_fatal(self, synthetic_dataset):
+        plan = FaultPlan([FaultSpec(site="worker.item", kind="worker_kill",
+                                    at=1, worker=0)])
+        with pytest.raises(RuntimeError, match="died unexpectedly"):
+            with make_reader(synthetic_dataset.url, reader_pool_type="process",
+                             workers_count=2, shuffle_row_groups=False,
+                             fault_plan=plan) as reader:
+                _read_all_ids(reader)

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Lint guard: one source of backoff truth — ``petastorm_tpu/resilience/``.
+
+A *retry loop* (a ``for``/``while`` whose body both catches exceptions and
+sleeps) hand-rolls backoff policy: its schedule is untestable, unseeded, and
+invisible to telemetry. Every such loop must run through
+:class:`petastorm_tpu.resilience.RetryPolicy` instead (docs/resilience.md) —
+this check fails CI when any module outside ``petastorm_tpu/resilience/``
+contains a ``time.sleep`` call inside a loop that also has a ``try/except``.
+
+Not every sleep-in-a-loop is a retry loop: polling loops (a results-queue
+poll that yields the GIL, a watcher tick) sleep without reacting to a
+failure. The AST heuristic therefore requires BOTH an ``except`` handler and
+a sleep in the same loop body; a genuine poll loop that still trips it may
+opt out with a ``backoff-ok`` comment on the sleep line, stating why it is
+not a retry.
+
+Usage::
+
+    python tools/check_backoff.py            # scan petastorm_tpu/ (minus resilience/)
+    python tools/check_backoff.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The whole package is in scope; the resilience package itself is the one
+#: place allowed to sleep between attempts.
+DEFAULT_PATHS = ("petastorm_tpu",)
+EXEMPT_DIRS = (os.path.join("petastorm_tpu", "resilience"),)
+
+WAIVER = "backoff-ok"
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _sleep_aliases(tree: ast.AST) -> set:
+    """Names that ``from time import sleep [as x]`` bound in this module."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _is_sleep_call(node: ast.AST, aliases: set) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if (isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "time"):
+        return True
+    return isinstance(fn, ast.Name) and fn.id in aliases
+
+
+def _loop_violations(tree: ast.AST, aliases: set):
+    """Yield sleep-call nodes inside loops that also catch exceptions.
+
+    Nested defs inside a loop body are not 'this loop retrying' — a worker
+    loop that *defines* a helper which sleeps is the helper's problem (and
+    the helper is linted on its own if it loops)."""
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        body_nodes = []
+        stack = list(loop.body) + list(loop.orelse)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            body_nodes.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        has_except = any(isinstance(n, ast.ExceptHandler) for n in body_nodes)
+        if not has_except:
+            continue
+        for n in body_nodes:
+            if _is_sleep_call(n, aliases):
+                yield n
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived retry-loop sleep."""
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    if any(rel == d or rel.startswith(d + os.sep) for d in EXEMPT_DIRS):
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    for call in sorted(_loop_violations(tree, _sleep_aliases(tree)),
+                       key=lambda c: c.lineno):
+        line = lines[call.lineno - 1] if call.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{call.lineno}: time.sleep in a retry loop — run the "
+            f"attempts through petastorm_tpu.resilience.RetryPolicy (single "
+            f"source of backoff truth; see docs/resilience.md), or add "
+            f"'# {WAIVER}: <why this is a poll, not a retry>' if the sleep "
+            f"is not backoff")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    checked = 0
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+        checked += 1
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_backoff: {len(all_violations)} violation(s) in "
+              f"{checked} file(s)", file=sys.stderr)
+        return 1
+    print(f"check_backoff: {checked} file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
