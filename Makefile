@@ -42,6 +42,7 @@ ci-lint:
 	python -m compileall -q petastorm_tpu tests tools examples bench.py __graft_entry__.py
 	python tools/check_monotonic.py
 	python tools/check_backoff.py
+	python tools/check_knobs.py
 
 ci-adapters:
 	timeout 1200 python -m pytest tests/test_torch_loader_depth.py \

@@ -467,8 +467,16 @@ def main():
         "        for _ in range(n):\n"
         "            next(it)\n"
         "        return n / (time.perf_counter() - t0)\n"
-        "row = measure(False)\n"
-        "dense = measure(True)\n"
+        "# Ordering-bias control: a throwaway pass warms the page cache for\n"
+        "# BOTH paths, then row/dense interleave (row,dense,row,dense) and\n"
+        "# average — so neither path systematically reads cold pages.\n"
+        "measure(False, n=32)\n"
+        "row_runs, dense_runs = [], []\n"
+        "for _ in range(2):\n"
+        "    row_runs.append(measure(False))\n"
+        "    dense_runs.append(measure(True))\n"
+        "row = sum(row_runs) / len(row_runs)\n"
+        "dense = sum(dense_runs) / len(dense_runs)\n"
         "print('BENCHJSON:' + json.dumps({\n"
         "    'ngram_row_windows_per_sec': round(row, 1),\n"
         "    'ngram_dense_windows_per_sec': round(dense, 1),\n"
@@ -477,6 +485,93 @@ def main():
         out.update(_cpu_subprocess(ngram_child, data_dir, timeout_s=1200.0))
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"ngram dense phase failed: {e!r}", file=sys.stderr)
+
+    # ---- 4f. in-memory row-group cache across epochs (docs/autotune.md):
+    # two epochs over the decode-heavy synthetic imagenet store with the
+    # memory tier sized to hold all decoded row groups. Epoch 1 pays the
+    # Parquet read + png decode and fills the cache; epoch 2 serves decoded
+    # columns from RAM — the speedup is the whole decode+IO cost the cache
+    # removes (acceptance: epoch-2 >= 1.3x epoch-1).
+    mem_cache_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.benchmark.imagenet_bench import write_synthetic_imagenet\n"
+        "from petastorm_tpu.reader import make_reader\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'imagenet')\n"
+        "url = 'file://' + store\n"
+        "if not os.path.exists(os.path.join(store, '_common_metadata')):\n"
+        "    write_synthetic_imagenet(url, rows=2048)\n"
+        "def two_epochs(cache_bytes):\n"
+        "    epoch_s, counters = [], {}\n"
+        "    with make_reader(url, num_epochs=2, shuffle_row_groups=False,\n"
+        "                     reader_pool_type='thread', workers_count=3,\n"
+        "                     memory_cache_size_bytes=cache_bytes) as r:\n"
+        "        n, t0 = 0, time.perf_counter()\n"
+        "        for _ in r:\n"
+        "            n += 1\n"
+        "            if n == 2048:\n"
+        "                epoch_s.append(time.perf_counter() - t0)\n"
+        "                t0 = time.perf_counter()\n"
+        "        epoch_s.append(time.perf_counter() - t0)\n"
+        "        counters = r.telemetry.snapshot()['counters']\n"
+        "    return n, epoch_s, counters\n"
+        "rows, epoch_s, counters = two_epochs(2 << 30)\n"
+        "e1_sps, e2_sps = 2048 / epoch_s[0], 2048 / epoch_s[1]\n"
+        "print('BENCHJSON:' + json.dumps({'mem_cache_epoch': {\n"
+        "    'rows': rows,\n"
+        "    'epoch1_samples_per_sec': round(e1_sps, 1),\n"
+        "    'epoch2_samples_per_sec': round(e2_sps, 1),\n"
+        "    'epoch2_speedup': round(e2_sps / e1_sps, 2),\n"
+        "    'cache_hits': counters.get('cache.mem.hits', 0),\n"
+        "    'cache_misses': counters.get('cache.mem.misses', 0),\n"
+        "    'cache_inserts': counters.get('cache.mem.inserts', 0)}}))\n")
+    try:
+        out.update(_cpu_subprocess(mem_cache_child, data_dir, timeout_s=1200.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"mem cache phase failed: {e!r}", file=sys.stderr)
+
+    # ---- 4g. autotune feedback loop (docs/autotune.md): the columnar
+    # loader epoch from 4d, with the controller live on a fast tick.
+    # Reports the tick/verdict counters, every adjustment it made, and the
+    # final actuator values — the convergence evidence (history stops
+    # growing) next to the throughput it tuned.
+    autotune_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.autotune import AutotuneConfig\n"
+        "from petastorm_tpu.jax import BatchedDataLoader\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "cfg = AutotuneConfig(interval_s=0.05)\n"
+        "t0 = time.perf_counter()\n"
+        "with make_batch_reader(url, num_epochs=None, shuffle_row_groups=False,\n"
+        "                       reader_pool_type='thread', workers_count=3,\n"
+        "                       autotune=True, autotune_config=cfg) as reader:\n"
+        "    with BatchedDataLoader(reader, batch_size=1024,\n"
+        "                           shuffling_queue_capacity=8192,\n"
+        "                           seed=0) as loader:\n"
+        "        it = iter(loader)\n"
+        "        for _ in range(200):\n"
+        "            next(it)\n"
+        "    report = reader.autotune_report()\n"
+        "    counters = reader.telemetry.snapshot()['counters']\n"
+        "elapsed = time.perf_counter() - t0\n"
+        "verdicts = {k.split('autotune.verdict_', 1)[1]: v\n"
+        "            for k, v in counters.items()\n"
+        "            if k.startswith('autotune.verdict_') and v}\n"
+        "print('BENCHJSON:' + json.dumps({'autotune_epoch': {\n"
+        "    'samples_per_sec': round(200 * 1024 / elapsed, 1),\n"
+        "    'ticks': report['ticks'],\n"
+        "    'verdicts': verdicts,\n"
+        "    'adjustments': report['adjustments'],\n"
+        "    'final_actuators': {k: v['value']\n"
+        "                        for k, v in report['actuators'].items()}}}))\n")
+    try:
+        out.update(_cpu_subprocess(autotune_child, data_dir, timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"autotune phase failed: {e!r}", file=sys.stderr)
 
     # ---- assemble the line ---------------------------------------------
     out.update({

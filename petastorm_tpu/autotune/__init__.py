@@ -1,0 +1,42 @@
+"""Adaptive pipeline autotuning + in-memory row-group cache.
+
+The first subsystem that *closes* the telemetry loop instead of only
+reporting it (docs/autotune.md):
+
+* :mod:`~petastorm_tpu.autotune.controller` — a background feedback
+  controller sampling the pipeline's :class:`TelemetryRegistry`, diagnosing
+  the bottleneck stage (stall-attributor verdicts + queue depths) and
+  nudging actuators with hysteresis;
+* :mod:`~petastorm_tpu.autotune.actuators` — clamped runtime knobs over the
+  thread pool's admission gate, the ventilator's in-flight cap, the
+  shuffling buffers' target size, and the JAX loader's prefetch depth
+  (``tools/check_knobs.py`` lints that nothing outside this package calls
+  the underlying setters);
+* :mod:`~petastorm_tpu.autotune.budget` — the byte-accounting
+  :class:`MemoryBudget` shared by buffers and the cache (payload sizes, no
+  psutil);
+* :mod:`~petastorm_tpu.autotune.mem_cache` — the in-memory *decoded*
+  row-group LRU :class:`InMemoryRowGroupCache` with cost-aware admission,
+  so multi-epoch training reads Parquet once and serves epochs >= 2 from
+  RAM.
+
+Enable via ``make_reader(..., autotune=True,
+memory_cache_size_bytes=2 << 30)``; every decision lands in ``autotune.*``
+and ``cache.mem.*`` telemetry on the pipeline registry.
+"""
+from petastorm_tpu.autotune.actuators import (Actuator,
+                                              PrefetchDepthActuator,
+                                              ShuffleTargetActuator,
+                                              VentilatorDepthActuator,
+                                              WorkerConcurrencyActuator)
+from petastorm_tpu.autotune.budget import MemoryBudget, payload_nbytes
+from petastorm_tpu.autotune.controller import (AutotuneConfig,
+                                               AutotuneController)
+from petastorm_tpu.autotune.mem_cache import InMemoryRowGroupCache
+
+__all__ = [
+    "Actuator", "AutotuneConfig", "AutotuneController",
+    "InMemoryRowGroupCache", "MemoryBudget", "PrefetchDepthActuator",
+    "ShuffleTargetActuator", "VentilatorDepthActuator",
+    "WorkerConcurrencyActuator", "payload_nbytes",
+]

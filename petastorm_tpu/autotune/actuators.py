@@ -1,0 +1,167 @@
+"""Runtime-adjustable pipeline knobs with clamped safe ranges.
+
+An :class:`Actuator` wraps ONE mutable throughput knob that the rest of the
+pipeline exposes but must never mutate itself (``tools/check_knobs.py``
+lints that the setters below are only called from this package):
+
+* ``worker_concurrency`` — the thread pool's admission gate
+  (:class:`~petastorm_tpu.workers_pool.thread_pool.ConcurrencyGate`):
+  live decode concurrency without killing/spawning threads;
+* ``ventilate_ahead`` — the ventilator's in-flight row-group cap
+  (:meth:`ConcurrentVentilator.set_max_inflight`);
+* ``shuffle_target`` — a shuffling buffer's target row count
+  (``set_target_capacity`` on either buffer flavor);
+* ``prefetch_depth`` — the JAX loader's staged-batch queue depth
+  (:meth:`LoaderBase.set_prefetch_depth`).
+
+Every ``set()`` clamps to ``[lo, hi]``, mirrors the applied value into an
+``autotune.<name>`` gauge, and bumps ``autotune.adjustments_total`` — the
+telemetry trail the acceptance tests replay to prove convergence.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["Actuator", "WorkerConcurrencyActuator", "VentilatorDepthActuator",
+           "ShuffleTargetActuator", "PrefetchDepthActuator"]
+
+
+class Actuator:
+    """Base: a named integer knob with a clamped range.
+
+    Subclasses implement ``_apply(value)`` — the ONLY place the underlying
+    component's setter is invoked (the knob lint's single source of
+    mutation). ``set()`` is thread-safe and idempotent: re-applying the
+    current value records nothing.
+    """
+
+    def __init__(self, name: str, lo: int, hi: int, initial: int,
+                 telemetry=None):
+        if lo > hi:
+            raise ValueError(f"{name}: lo {lo} > hi {hi}")
+        self.name = name
+        self.lo = int(lo)
+        self.hi = int(hi)
+        self._value = self._clamp(initial)
+        self._lock = threading.Lock()
+        self._gauge = None
+        self._adjustments = None
+        if telemetry is not None:
+            self.attach_telemetry(telemetry)
+
+    def attach_telemetry(self, telemetry) -> None:
+        self._gauge = telemetry.gauge(f"autotune.{self.name}")
+        self._gauge.set(self._value)
+        self._adjustments = telemetry.counter("autotune.adjustments_total")
+
+    def _clamp(self, value: int) -> int:
+        return max(self.lo, min(self.hi, int(value)))
+
+    def _apply(self, value: int) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ api
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    @property
+    def at_max(self) -> bool:
+        with self._lock:
+            return self._value >= self.hi
+
+    @property
+    def at_min(self) -> bool:
+        with self._lock:
+            return self._value <= self.lo
+
+    def set(self, value: int) -> int:
+        """Clamp, apply, record; returns the applied value."""
+        value = self._clamp(value)
+        with self._lock:
+            if value == self._value:
+                return value
+            self._apply(value)
+            self._value = value
+        if self._gauge is not None:
+            self._gauge.set(value)
+        if self._adjustments is not None:
+            self._adjustments.add(1)
+        return value
+
+    def nudge(self, delta: int) -> int:
+        with self._lock:
+            target = self._value + int(delta)
+        return self.set(target)
+
+
+class WorkerConcurrencyActuator(Actuator):
+    """Live decode concurrency over a thread pool's admission gate: workers
+    above the limit park before taking their next item (no thread churn, no
+    lost items). Range ``[1, workers_count]``."""
+
+    def __init__(self, gate, workers_count: int, telemetry=None):
+        self._gate = gate
+        super().__init__("worker_concurrency", 1, workers_count,
+                         gate.limit, telemetry=telemetry)
+
+    def _apply(self, value: int) -> None:
+        self._gate.set_limit(value)
+
+
+class VentilatorDepthActuator(Actuator):
+    """In-flight row-group cap. Floor = 1 per admitted worker's slot
+    (starving the pool deadlocks nothing but wastes it); ceiling defaults to
+    4x the construction-time cap — beyond that, queued row groups only buy
+    memory pressure."""
+
+    def __init__(self, ventilator, lo: Optional[int] = None,
+                 hi: Optional[int] = None, telemetry=None):
+        self._ventilator = ventilator
+        initial = ventilator.max_inflight
+        super().__init__("ventilate_ahead",
+                         lo if lo is not None else max(1, initial // 4),
+                         hi if hi is not None else max(1, initial * 4),
+                         initial, telemetry=telemetry)
+
+    def _apply(self, value: int) -> None:
+        self._ventilator.set_max_inflight(value)
+
+
+class ShuffleTargetActuator(Actuator):
+    """Shuffling-buffer target size. Floor keeps shuffle quality above the
+    buffer's ``min_after_retrieve``; ceiling is the construction-time
+    capacity (the batched buffer's store is pre-allocated at that size, so
+    growth beyond it would force a reallocation mid-epoch)."""
+
+    def __init__(self, buf, telemetry=None):
+        self._buf = buf
+        hi = buf.capacity
+        lo = max(1, getattr(buf, "min_target", None) or max(1, hi // 4))
+        # A tight buffer (quality floor ~ capacity) leaves no tuning room:
+        # degrade to a fixed knob rather than an inverted range.
+        lo = min(lo, hi)
+        super().__init__("shuffle_target", lo, hi, buf.capacity,
+                         telemetry=telemetry)
+
+    def _apply(self, value: int) -> None:
+        self._buf.set_target_capacity(value)
+
+
+class PrefetchDepthActuator(Actuator):
+    """Staged-batch queue depth on the JAX loader. Floor 1 (single
+    buffering); ceiling defaults to 4x the configured depth — each unit
+    pins one whole device batch in HBM, so the ceiling is a memory bound,
+    not a latency one."""
+
+    def __init__(self, loader, hi: Optional[int] = None, telemetry=None):
+        self._loader = loader
+        initial = loader.prefetch_depth
+        super().__init__("prefetch_depth", 1,
+                         hi if hi is not None else max(2, initial * 4),
+                         initial, telemetry=telemetry)
+
+    def _apply(self, value: int) -> None:
+        self._loader.set_prefetch_depth(value)

@@ -1,0 +1,117 @@
+"""Byte-accounting memory budget shared by buffers and the memory cache.
+
+The pipeline's host-memory consumers — the in-memory row-group cache, the
+shuffling buffers, the prefetch queue — each hold payloads whose sizes are
+known (or cheaply estimable) at insertion time. A :class:`MemoryBudget` is
+the one ledger they all charge against, so the autotune controller can read
+a single *pressure* number instead of guessing at RSS (no psutil: sizes come
+from the payloads themselves, the way the serializers already measure them).
+
+Accounting is advisory-but-honest: ``reserve()`` never blocks, it answers
+whether the charge fits; callers that must proceed anyway (a buffer that
+already holds the rows) use ``force=True`` and the overshoot shows up in
+``pressure`` — exactly the signal the controller backs off on.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Optional
+
+__all__ = ["MemoryBudget", "payload_nbytes"]
+
+
+def payload_nbytes(obj) -> int:
+    """Best-effort byte size of a pipeline payload.
+
+    Numpy arrays / Arrow tables report their buffer sizes directly;
+    containers sum their elements; anything unrecognized falls back to its
+    pickled length — the same size the payload would occupy on a serialized
+    transport, which is what the budget models."""
+    nbytes = getattr(obj, "nbytes", None)
+    if nbytes is not None:
+        try:
+            return int(nbytes)
+        except (TypeError, ValueError):
+            pass
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj.encode("utf-8", errors="replace"))
+    if isinstance(obj, dict):
+        return sum(payload_nbytes(k) + payload_nbytes(v)
+                   for k, v in obj.items()) + 64
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return sum(payload_nbytes(v) for v in obj) + 56
+    if obj is None or isinstance(obj, (int, float, bool)):
+        return 32
+    try:
+        return len(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    except Exception:  # noqa: BLE001 - unpicklable exotic payload
+        return 1024  # charged *something* so it cannot hide from the ledger
+
+
+class MemoryBudget:
+    """Thread-safe byte ledger with a fixed capacity.
+
+    :param capacity_bytes: total bytes the pipeline's host-side holders may
+        charge; ``reserve`` answers False once it would be exceeded
+    :param telemetry: optional registry; publishes ``budget.capacity_bytes``
+        and a live ``budget.used_bytes`` gauge
+    """
+
+    def __init__(self, capacity_bytes: int, telemetry=None):
+        if capacity_bytes <= 0:
+            raise ValueError(f"capacity_bytes must be > 0, got {capacity_bytes}")
+        self._capacity = int(capacity_bytes)
+        self._used = 0
+        self._lock = threading.Lock()
+        if telemetry is not None:
+            self.attach_telemetry(telemetry)
+
+    def attach_telemetry(self, telemetry) -> None:
+        telemetry.gauge("budget.capacity_bytes").set(self._capacity)
+        telemetry.gauge("budget.used_bytes", lambda: self.used)
+
+    # ------------------------------------------------------------------ api
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return max(0, self._capacity - self._used)
+
+    @property
+    def pressure(self) -> float:
+        """``used / capacity`` — may exceed 1.0 when forced reservations
+        overshoot; the controller treats > high-watermark as back-off."""
+        with self._lock:
+            return self._used / self._capacity
+
+    def reserve(self, nbytes: int, force: bool = False) -> bool:
+        """Charge ``nbytes`` if it fits (always, with ``force=True``).
+        Returns whether the charge was taken."""
+        if nbytes < 0:
+            raise ValueError(f"nbytes must be >= 0, got {nbytes}")
+        with self._lock:
+            if not force and self._used + nbytes > self._capacity:
+                return False
+            self._used += nbytes
+            return True
+
+    def release(self, nbytes: int) -> None:
+        if nbytes < 0:
+            raise ValueError(f"nbytes must be >= 0, got {nbytes}")
+        with self._lock:
+            self._used = max(0, self._used - nbytes)
+
+    def would_fit(self, nbytes: int) -> bool:
+        with self._lock:
+            return self._used + nbytes <= self._capacity

@@ -1,0 +1,203 @@
+"""In-memory decoded row-group LRU cache (:class:`CacheBase`).
+
+Petastorm's only cache tier was on-disk sqlite holding *raw* columns; this
+tier holds **decoded** payloads in RAM so multi-epoch training reads and
+codec-decodes each row group once and serves epochs >= 2 from memory. The
+reader workers consult it at the same point as the disk cache; the row
+worker additionally recognizes ``caches_decoded`` and stores post-codec
+columns (decode is the dominant cost on image/tensor stores — caching raw
+bytes would only save the IO).
+
+Policy:
+
+* **byte budget** — every entry is charged to a
+  :class:`~petastorm_tpu.autotune.budget.MemoryBudget` at its payload size
+  (:func:`~petastorm_tpu.autotune.budget.payload_nbytes`);
+* **LRU eviction** — least-recently-*hit* entries evict first;
+* **cost-aware admission** — when admission requires displacing resident
+  entries, the candidate must have cost at least the *fill seconds* it
+  displaces: a fast-to-fill row group never evicts slow-to-fill ones
+  (tf.data/cedar-style cost awareness: cache what is expensive to recompute);
+* **failure safety** — a fill that raises caches nothing, so quarantined
+  row groups and injected ``cache.fill``/``rowgroup.read`` faults can never
+  poison the cache (the fault site fires *before* the fill, like the disk
+  cache's).
+
+Telemetry (on the pipeline registry once the Reader attaches it):
+``cache.mem.hits`` / ``misses`` / ``inserts`` / ``evictions`` /
+``rejected_admissions`` counters, ``cache.mem.bytes`` / ``entries`` gauges.
+
+Process pools: the cache pickles as an *empty* cache with the same
+parameters — each spawned worker keeps a private cache over its own
+(deterministic, round-robin) item subset. The budget then applies
+per-worker-process; ``make_reader`` warns about the multiplier.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from petastorm_tpu.cache import CacheBase
+from petastorm_tpu.autotune.budget import MemoryBudget, payload_nbytes
+
+__all__ = ["InMemoryRowGroupCache"]
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "fill_s")
+
+    def __init__(self, value, nbytes: int, fill_s: float):
+        self.value = value
+        self.nbytes = nbytes
+        self.fill_s = fill_s
+
+
+class InMemoryRowGroupCache(CacheBase):
+    """:param size_limit_bytes: byte budget for cached payloads
+    :param budget: optional shared :class:`MemoryBudget` (defaults to a
+        private one of ``size_limit_bytes``)
+    :param fault_plan: fault-injection plan consulted at the ``cache.fill``
+        site on every miss (tests/benchmarks only)
+    :param telemetry: optional registry; the owning Reader attaches its
+        pipeline registry after construction via :meth:`attach_telemetry`
+    """
+
+    #: Read by the row reader worker: payloads under this cache are
+    #: post-codec decoded columns, not raw Arrow values.
+    caches_decoded = True
+
+    def __reduce__(self):
+        # Crossing a process boundary re-creates an EMPTY per-worker cache
+        # with the same policy; entries and live telemetry never travel.
+        return (type(self), (self._size_limit,), {"_fault_plan": self._fault_plan})
+
+    def __setstate__(self, state):
+        self._fault_plan = state.get("_fault_plan")
+
+    def __init__(self, size_limit_bytes: int,
+                 budget: Optional[MemoryBudget] = None,
+                 fault_plan=None, telemetry=None):
+        self._size_limit = int(size_limit_bytes)
+        self.budget = budget if budget is not None \
+            else MemoryBudget(self._size_limit)
+        self._fault_plan = fault_plan
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._resident = 0  # bytes held, always <= _size_limit
+        self._counters = None
+        if telemetry is not None:
+            self.attach_telemetry(telemetry)
+
+    def attach_telemetry(self, telemetry) -> None:
+        """Adopt the pipeline registry (idempotent; in-process pools only —
+        spawned workers count nothing, same limitation as worker.decode_s)."""
+        self._counters = {name: telemetry.counter(f"cache.mem.{name}")
+                          for name in ("hits", "misses", "inserts",
+                                       "evictions", "rejected_admissions")}
+        telemetry.gauge("cache.mem.bytes", lambda: self.size_bytes())
+        telemetry.gauge("cache.mem.entries", lambda: len(self))
+        self.budget.attach_telemetry(telemetry)
+
+    def _count(self, name: str, n: float = 1.0) -> None:
+        if self._counters is not None:
+            self._counters[name].add(n)
+
+    # ------------------------------------------------------------------ api
+    def get(self, key, fill_cache_func):
+        key = str(key)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is not None:
+            self._count("hits")
+            return entry.value
+        self._count("misses")
+        if self._fault_plan is not None:
+            self._fault_plan.fire("cache.fill", key=key)
+        # Fill OUTSIDE the lock: fills are the slow path and other threads'
+        # hits must not serialize behind them. A raising fill propagates and
+        # caches nothing — the quarantine/fault-poisoning guarantee.
+        t0 = time.perf_counter()
+        value = fill_cache_func()
+        fill_s = time.perf_counter() - t0
+        self._admit(key, value, fill_s)
+        return value
+
+    def _admit(self, key: str, value, fill_s: float) -> None:
+        nbytes = payload_nbytes(value)
+        if nbytes > self._size_limit:
+            self._count("rejected_admissions")
+            return
+        with self._lock:
+            if key in self._entries:   # concurrent filler won the race
+                return
+            # Cost-aware displacement: walk LRU-first victims until the
+            # candidate fits BOTH bounds — this cache's own size limit
+            # (enforced even when ``budget`` is a larger shared ledger the
+            # Reader repointed us at) and the budget itself. Admit only if
+            # the evicted fill seconds don't exceed the candidate's own
+            # (slow-to-fill stays resident).
+            def _fits(freed):
+                return (self._resident - freed + nbytes <= self._size_limit
+                        and self.budget.would_fit(nbytes - freed))
+            victims, freed, victim_cost = [], 0, 0.0
+            for vkey, ventry in self._entries.items():  # OrderedDict: LRU first
+                if _fits(freed):
+                    break
+                victims.append(vkey)
+                freed += ventry.nbytes
+                victim_cost += ventry.fill_s
+            if not _fits(freed):
+                self._count("rejected_admissions")
+                return  # budget shared with other holders is too tight
+            if victims and victim_cost > fill_s:
+                self._count("rejected_admissions")
+                return
+            for vkey in victims:
+                ventry = self._entries.pop(vkey)
+                self._resident -= ventry.nbytes
+                self.budget.release(ventry.nbytes)
+                self._count("evictions")
+            if not self.budget.reserve(nbytes):
+                self._count("rejected_admissions")
+                return  # another holder charged the freed bytes first
+            self._entries[key] = _Entry(value, nbytes, fill_s)
+            self._resident += nbytes
+            self._count("inserts")
+
+    # ------------------------------------------------------------- readout
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return str(key) in self._entries
+
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        """JSON-safe snapshot: entry count, resident bytes, budget view."""
+        with self._lock:
+            entries = len(self._entries)
+            resident = self._resident
+        return {"entries": entries, "resident_bytes": resident,
+                "size_limit_bytes": self._size_limit,
+                "budget_used_bytes": self.budget.used,
+                "budget_capacity_bytes": self.budget.capacity}
+
+    def cleanup(self):
+        with self._lock:
+            for entry in self._entries.values():
+                self.budget.release(entry.nbytes)
+            self._entries.clear()
+            self._resident = 0

@@ -91,6 +91,7 @@ class BatchedRandomShufflingBuffer:
                  seed: Optional[int] = None):
         if min_after_retrieve >= shuffling_queue_capacity:
             raise ValueError("min_after_retrieve must be < shuffling_queue_capacity")
+        self._configured_capacity = shuffling_queue_capacity
         self._capacity = shuffling_queue_capacity
         self._min_after = min_after_retrieve
         self._extra = extra_capacity
@@ -104,11 +105,14 @@ class BatchedRandomShufflingBuffer:
         if self._done:
             raise RuntimeError("Cannot add to a finished buffer")
         n = len(next(iter(batch.values())))
-        if self._size + n > self._capacity + self._extra:
+        if self._size + n > self._configured_capacity + self._extra:
             raise RuntimeError("Buffer overfill: check can_add before adding")
         if self._store is None:
             # Allocate once at capacity+extra; grow only if a bulk add needs it.
-            self._store = {k: np.empty((self._capacity + self._extra,) + v.shape[1:],
+            # Sized from the CONFIGURED capacity, not the live tuned one:
+            # set_target_capacity may shrink before the first add and grow
+            # back later, and the store must hold the documented bound.
+            self._store = {k: np.empty((self._configured_capacity + self._extra,) + v.shape[1:],
                                        dtype=v.dtype)
                            for k, v in batch.items()}
         for k, v in batch.items():
@@ -150,3 +154,23 @@ class BatchedRandomShufflingBuffer:
     @property
     def capacity(self) -> int:
         return self._capacity
+
+    @property
+    def min_target(self) -> int:
+        """Smallest target the autotune actuator may set (shuffle-quality
+        floor plus one retrievable batch)."""
+        return self._min_after + self._batch_size
+
+    def set_target_capacity(self, n: int) -> None:
+        """Runtime knob over the target row count (autotune's
+        ``shuffle_target`` actuator; ``tools/check_knobs.py`` lints that
+        only :mod:`petastorm_tpu.autotune` calls this). Clamped to
+        [min_target, configured capacity]: the column store is
+        pre-allocated at ``configured + extra`` rows, so growth past the
+        configured bound would overrun it. The configured bound wins when
+        the two conflict (a tight buffer with ``min_after + batch_size >
+        capacity`` degrades to a fixed knob rather than an inverted range
+        that could exceed the store). Shrinking below the current fill
+        pauses admission until retrieval drains the excess."""
+        floor = min(self.min_target, self._configured_capacity)
+        self._capacity = max(floor, min(int(n), self._configured_capacity))

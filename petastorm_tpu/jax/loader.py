@@ -97,6 +97,11 @@ class LoaderBase:
         # buffer is empty, so resume re-reads buffered groups (duplication)
         # rather than skipping them (loss). None = snapshot live state.
         self._pending_safe_state: Optional[dict] = None
+        # Stop event of the live staging pipeline (one at most: __iter__
+        # guards re-entry). close() sets it so a consumer that abandoned
+        # its iterator without closing it cannot leave the staging daemon
+        # thread running past loader teardown.
+        self._stage_stop = None
         # One registry for the whole pipeline: loaders consuming a Reader
         # adopt ITS registry (subclasses pass it through ``telemetry=``), so
         # worker decode, pool wait, shuffle, staging and stall attribution
@@ -257,6 +262,19 @@ class LoaderBase:
             staged = {**staged, **host_cols}
         return staged
 
+    # ------------------------------------------------------ runtime knobs
+    @property
+    def prefetch_depth(self) -> int:
+        return self._prefetch
+
+    def set_prefetch_depth(self, n: int) -> None:
+        """Runtime knob over the staged-batch queue depth (autotune's
+        ``prefetch_depth`` actuator; ``tools/check_knobs.py`` lints that
+        only :mod:`petastorm_tpu.autotune` calls this). Takes effect at the
+        producer's next put: a shrunk depth stops staging new batches until
+        the consumer drains below it (already-staged batches stay valid)."""
+        self._prefetch = max(1, int(n))
+
     def _prefetched(self, host_batches):
         """Keep ``prefetch`` staged batches in flight, assembled on a
         background thread.
@@ -271,26 +289,36 @@ class LoaderBase:
         import queue as queue_mod
         import threading
 
-        q: queue_mod.Queue = queue_mod.Queue(maxsize=self._prefetch)
+        # Unbounded queue, depth-gated in _put against the LIVE
+        # self._prefetch: the autotune prefetch actuator adjusts the depth
+        # mid-iteration, which a fixed Queue(maxsize=...) could not honor.
+        q: queue_mod.Queue = queue_mod.Queue()
         # One stable bound-method object: the identity-checked teardown in
         # the finally below must see the same callable it registered.
         depth_fn = q.qsize
         self.telemetry.gauge("loader.prefetch_queue_depth", depth_fn)
-        # Plain value, not a closure over self: capacity is fixed for the
-        # iteration, and a callable gauge here would pin the whole loader
-        # in the reader-owned registry after this loader is discarded.
+        # Plain value, not a closure over self: a callable gauge here would
+        # pin the whole loader in the reader-owned registry after this
+        # loader is discarded (the live tuned value is the
+        # ``autotune.prefetch_depth`` gauge).
         self.telemetry.gauge("loader.prefetch_queue_capacity").set(
             self._prefetch)
         stop = threading.Event()
+        self._stage_stop = stop
         _END, _ERR = object(), object()
 
+        # Consumer notifies after every get, so the producer wakes the
+        # moment a slot frees (the bounded wait only bounds how late a
+        # stop/knob change is noticed, it is not the delivery latency).
+        space = threading.Condition()
+
         def _put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue_mod.Full:
-                    continue
+            with space:
+                while not stop.is_set():
+                    if q.qsize() < max(1, self._prefetch):
+                        q.put(item)
+                        return True
+                    space.wait(0.05)
             return False
 
         def _produce():
@@ -333,6 +361,14 @@ class LoaderBase:
         thread = threading.Thread(target=_produce, daemon=True,
                                   name="petastorm-tpu-stage")
         thread.start()
+        # The reader's autotune controller (when enabled) tunes this
+        # iteration's prefetch depth; registration is dynamic so the knob
+        # exists exactly while a staging pipeline does.
+        autotune = self._autotune_controller()
+        prefetch_actuator = None
+        if autotune is not None:
+            from petastorm_tpu.autotune import PrefetchDepthActuator
+            prefetch_actuator = autotune.register(PrefetchDepthActuator(self))
         try:
             # Stall attribution: time blocked in q.get() is the input
             # pipeline failing to keep ahead (the "device_put wait" a
@@ -344,6 +380,8 @@ class LoaderBase:
             while True:
                 t0 = time.perf_counter()
                 kind, item, snap = q.get()
+                with space:
+                    space.notify()
                 t1 = time.perf_counter()
                 if kind is _END:
                     break
@@ -364,6 +402,11 @@ class LoaderBase:
                     yield self._echo_copy(item)
         finally:
             stop.set()
+            with space:
+                space.notify_all()  # a depth-parked producer exits now
+            self._stage_stop = None
+            if prefetch_actuator is not None:
+                autotune.unregister(prefetch_actuator.name)
             # Drop the queue-bound gauge closure: the registry outlives this
             # iteration and would otherwise pin up to `prefetch` staged
             # device batches (HBM!) through q.qsize's bound self.
@@ -416,6 +459,27 @@ class LoaderBase:
         if reader is None or not hasattr(reader, "state_dict"):
             return None
         return reader.state_dict()
+
+    def _autotune_controller(self):
+        """The consumed reader's AutotuneController, or None (autotune off /
+        no reader): loaders register their knobs on the READER's controller
+        so one feedback loop sees the whole pipeline."""
+        reader = getattr(self, "_reader", None)
+        return getattr(reader, "autotune", None) if reader is not None else None
+
+    def _register_shuffle_actuator(self, buf):
+        """Register the buffer's target-size knob with the reader's autotune
+        controller (when enabled and the buffer is tunable); returns the
+        actuator or None — callers unregister it on teardown."""
+        autotune = self._autotune_controller()
+        if autotune is None or not hasattr(buf, "set_target_capacity"):
+            return None
+        from petastorm_tpu.autotune import ShuffleTargetActuator
+        return autotune.register(ShuffleTargetActuator(buf))
+
+    def _unregister_shuffle_actuator(self, actuator) -> None:
+        if actuator is not None:
+            self._autotune_controller().unregister(actuator.name)
 
     def _snapshot_input_state(self):
         if self._pending_safe_state is not None:
@@ -588,6 +652,14 @@ class LoaderBase:
         if self._persistent_it is not None:
             self._persistent_it.close()   # stops the staging thread
             self._persistent_it = None
+        if self._stage_stop is not None:
+            # Consumer abandoned its iterator without closing it: the
+            # staging generator is still suspended and would only be closed
+            # by GC — possibly mid-interpreter-shutdown, with its daemon
+            # thread inside a half-torn-down jax runtime. Halt it now; the
+            # generator's own finally still runs full cleanup at GC.
+            self._stage_stop.set()
+            self._stage_stop = None
         reader = getattr(self, "_reader", None)
         if reader is not None:
             reader.stop()
@@ -775,6 +847,7 @@ class DataLoader(LoaderBase):
                 extra_capacity=max(1000, self._shuffling_capacity),
                 seed=self._seed)
             gauge_fns = self._register_shuffle_gauges(buf)
+            shuffle_actuator = self._register_shuffle_actuator(buf)
             shuffle_time = self._shuffle_time
             # This path is per-ROW (the batched loader is per-row-group):
             # accumulate the measured seconds locally and flush to the
@@ -808,6 +881,7 @@ class DataLoader(LoaderBase):
                         return
             finally:
                 shuffle_time.add(pending_s)
+                self._unregister_shuffle_actuator(shuffle_actuator)
                 # Generator close/exhaustion: stop the gauges from pinning
                 # the buffer (and its buffered rows) via their closures.
                 self._clear_shuffle_gauges(gauge_fns)
@@ -971,6 +1045,7 @@ class BatchedDataLoader(LoaderBase):
         else:
             buf = BatchedNoopShufflingBuffer(self._batch_size)
         gauge_fns = self._register_shuffle_gauges(buf)
+        shuffle_actuator = self._register_shuffle_actuator(buf)
         shuffle_time = self._shuffle_time
 
         it = iter(self._reader)
@@ -1016,6 +1091,7 @@ class BatchedDataLoader(LoaderBase):
                 if tail is not None:
                     yield tail
         finally:
+            self._unregister_shuffle_actuator(shuffle_actuator)
             # Generator close/exhaustion: stop the gauges from pinning the
             # buffer (and its buffered column tensors) via their closures.
             self._clear_shuffle_gauges(gauge_fns)

@@ -304,7 +304,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                          block_k: int, rep: int, n_q: int, causal: bool,
+                          block_k: int, n_q: int, causal: bool,
                           scale: float):
     """dK/dV pass: grid (b, kv_heads, kv_blocks, rep * q_blocks) — the
     innermost dimension walks every (grouped-query head, q block) pair
@@ -398,7 +398,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
                          lambda bi, gi, ki, t: (bi, gi, ki, 0))
     dk, dv = pl.pallas_call(
         partial(_flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                rep=rep, n_q=n_q, causal=causal, scale=scale),
+                n_q=n_q, causal=causal, scale=scale),
         grid=(b, kv_h, sk // block_k, rep * n_q),
         in_specs=[kv_in, kv_in, q_in, q_in, stat_in, stat_in],
         out_specs=[kv_out_spec, kv_out_spec],

@@ -218,8 +218,33 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
                      f"(expected 'thread', 'process' or 'dummy')")
 
 
+def _warn_predicate_bypasses_cache(predicate, memory_cache_size_bytes):
+    """Both reader workers evaluate worker-side predicates on freshly-read
+    columns and never consult the row-group cache on that path (a cached
+    payload cannot be predicate-filtered without freezing the row set), so
+    a memory cache sized per the docs would silently record zero hits."""
+    if predicate is not None and memory_cache_size_bytes:
+        warnings.warn(
+            "predicate= bypasses row-group caching: every epoch re-reads "
+            "and re-decodes the predicate's row groups, and the "
+            f"{memory_cache_size_bytes}-byte memory cache will record no "
+            "hits. Drop the predicate (filter after read) to cache, or "
+            "drop memory_cache_size_bytes to silence this.")
+
+
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
-                cache_extra_settings, retry_policy=None, fault_plan=None):
+                cache_extra_settings, retry_policy=None, fault_plan=None,
+                memory_cache_size_bytes=None):
+    if memory_cache_size_bytes:
+        if cache_type not in (None, "null"):
+            raise ValueError(
+                f"memory_cache_size_bytes and cache_type={cache_type!r} are "
+                f"mutually exclusive: the memory tier caches decoded "
+                f"payloads, the disk tier raw ones — pick the tier matching "
+                f"where the time goes (docs/autotune.md)")
+        from petastorm_tpu.autotune import InMemoryRowGroupCache
+        return InMemoryRowGroupCache(memory_cache_size_bytes,
+                                     fault_plan=fault_plan)
     if cache_type in (None, "null"):
         return NullCache()
     if cache_type == "local-disk":
@@ -266,7 +291,10 @@ def make_reader(dataset_url,
                 retry_policy=None,
                 degraded_mode: bool = False,
                 fault_plan=None,
-                worker_crash_budget: int = 0):
+                worker_crash_budget: int = 0,
+                autotune: bool = False,
+                autotune_config=None,
+                memory_cache_size_bytes: Optional[int] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -319,6 +347,23 @@ def make_reader(dataset_url,
         up to N hard worker deaths per epoch by re-ventilating the lost row
         groups onto surviving workers (0 = any crash is fatal, the previous
         behavior). See docs/resilience.md.
+    :param autotune: start a background
+        :class:`~petastorm_tpu.autotune.AutotuneController` that samples
+        this pipeline's telemetry and adjusts worker concurrency,
+        ventilation depth, shuffle-buffer target, and (when a JAX loader
+        consumes this reader) prefetch depth — with hysteresis and clamped
+        safe ranges. See docs/autotune.md.
+    :param autotune_config: an
+        :class:`~petastorm_tpu.autotune.AutotuneConfig` overriding the
+        controller's interval/hysteresis/watermarks
+    :param memory_cache_size_bytes: enable the in-memory **decoded**
+        row-group LRU cache with this byte budget — epochs >= 2 serve from
+        RAM instead of re-reading and re-decoding Parquet. Mutually
+        exclusive with ``cache_type='local-disk'``; a worker-side
+        ``predicate`` bypasses row-group caching entirely (a warning says
+        so). With ``reader_pool_type='process'`` each spawned worker keeps
+        a private cache of this size over its own item subset (the budget
+        multiplies by ``workers_count``).
 
     Parity: reference reader.py:60.
     """
@@ -334,9 +379,11 @@ def make_reader(dataset_url,
             f"(underlying error: {e}). If this is a plain Parquet store, use "
             f"make_batch_reader() instead.") from e
 
+    _warn_predicate_bypasses_cache(predicate, memory_cache_size_bytes)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
-                        retry_policy=retry_policy, fault_plan=fault_plan)
+                        retry_policy=retry_policy, fault_plan=fault_plan,
+                        memory_cache_size_bytes=memory_cache_size_bytes)
 
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
@@ -369,7 +416,9 @@ def make_reader(dataset_url,
                   retry_policy=retry_policy,
                   degraded_mode=degraded_mode,
                   fault_plan=fault_plan,
-                  worker_crash_budget=worker_crash_budget)
+                  worker_crash_budget=worker_crash_budget,
+                  autotune=autotune,
+                  autotune_config=autotune_config)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -405,7 +454,10 @@ def make_batch_reader(dataset_url_or_urls,
                       retry_policy=None,
                       degraded_mode: bool = False,
                       fault_plan=None,
-                      worker_crash_budget: int = 0):
+                      worker_crash_budget: int = 0,
+                      autotune: bool = False,
+                      autotune_config=None,
+                      memory_cache_size_bytes: Optional[int] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -423,6 +475,10 @@ def make_batch_reader(dataset_url_or_urls,
     ``retry_policy`` / ``degraded_mode`` / ``fault_plan`` /
     ``worker_crash_budget`` behave exactly as in :func:`make_reader`
     (see docs/resilience.md).
+    ``autotune`` / ``autotune_config`` / ``memory_cache_size_bytes`` behave
+    exactly as in :func:`make_reader` (see docs/autotune.md); the memory
+    cache holds this reader's raw row-group tables — the columnar path has
+    no codec decode to cache past.
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -433,9 +489,11 @@ def make_batch_reader(dataset_url_or_urls,
     if isinstance(schema_fields, NGram):
         raise ValueError("NGram is not supported by make_batch_reader; use make_reader")
 
+    _warn_predicate_bypasses_cache(predicate, memory_cache_size_bytes)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
-                        retry_policy=retry_policy, fault_plan=fault_plan)
+                        retry_policy=retry_policy, fault_plan=fault_plan,
+                        memory_cache_size_bytes=memory_cache_size_bytes)
 
     if convert_early_to_numpy:
         # Workers publish numpy dicts, which Arrow IPC cannot carry.
@@ -475,7 +533,9 @@ def make_batch_reader(dataset_url_or_urls,
                   retry_policy=retry_policy,
                   degraded_mode=degraded_mode,
                   fault_plan=fault_plan,
-                  worker_crash_budget=worker_crash_budget)
+                  worker_crash_budget=worker_crash_budget,
+                  autotune=autotune,
+                  autotune_config=autotune_config)
 
 
 class Reader:
@@ -491,7 +551,8 @@ class Reader:
                  transform_spec, storage_options, resume_state=None,
                  filesystem=None, convert_early_to_numpy=False,
                  rowgroup_coalescing=1, filters=None, retry_policy=None,
-                 degraded_mode=False, fault_plan=None, worker_crash_budget=0):
+                 degraded_mode=False, fault_plan=None, worker_crash_budget=0,
+                 autotune=False, autotune_config=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -565,6 +626,26 @@ class Reader:
                           "dataset URL; the custom filesystem object is used for "
                           "planning only. Pass storage_options for credentials.")
         self._cache = cache
+
+        # ---------------- memory-cache wiring (docs/autotune.md)
+        from petastorm_tpu.autotune import InMemoryRowGroupCache
+        if isinstance(cache, InMemoryRowGroupCache):
+            if isinstance(self._pool, ProcessPool):
+                # The cache pickles as an EMPTY per-worker cache (live
+                # entries and telemetry cannot cross the spawn boundary), so
+                # each spawned worker holds a private budget of the full
+                # configured size over its own round-robin item subset.
+                warnings.warn(
+                    "memory_cache_size_bytes with reader_pool_type='process' "
+                    "keeps a PRIVATE cache of that size in every spawned "
+                    f"worker: up to {self._pool.workers_count}x the "
+                    "configured bytes host-wide. Size accordingly, or use "
+                    "the thread pool to share one cache.")
+            else:
+                # In-process pools share this one instance with every
+                # worker: hits/misses/evictions land on the pipeline
+                # registry.
+                cache.attach_telemetry(self.telemetry)
 
         # ---------------- resilience wiring (docs/resilience.md)
         from petastorm_tpu.resilience import (RowGroupQuarantine,
@@ -659,8 +740,62 @@ class Reader:
                              lambda: self._ventilator.max_inflight)
         self.telemetry.gauge("pool.results_queue_depth",
                              self._pool.results_qsize)
+        # Fixed for the pool's lifetime; the autotune controller's fallback
+        # bottleneck diagnosis reads depth/capacity as a fill fraction.
+        # NOT registered for the process pool: its results_qsize() is a
+        # constant 0 (queued results live in ZMQ/ring buffers, unobservable
+        # across the socket), and a permanently-empty-looking queue would
+        # read as producer_bound forever, ratcheting the ventilation knob
+        # to its ceiling. Without the gauge the controller holds instead.
+        if not isinstance(self._pool, ProcessPool):
+            # Aggregate bound: results_qsize() sums every per-worker queue,
+            # so the fill fraction's denominator must scale the per-queue
+            # capacity by the worker count or a 1/N-full pool reads full.
+            self.telemetry.gauge("pool.results_queue_capacity").set(
+                self._pool.diagnostics["results_queue_capacity"]
+                * max(1, self._pool.workers_count))
         self.telemetry.counter("reader.rows")
         self._pool.telemetry = self.telemetry
+
+        # ---------------- autotune wiring (docs/autotune.md)
+        #: Background :class:`~petastorm_tpu.autotune.AutotuneController`
+        #: when ``autotune=True`` (else None). A JAX loader consuming this
+        #: reader registers its prefetch/shuffle knobs here, so ONE feedback
+        #: loop sees the whole pipeline.
+        self.autotune = None
+        if autotune:
+            from petastorm_tpu.autotune import (AutotuneController,
+                                                VentilatorDepthActuator,
+                                                WorkerConcurrencyActuator)
+            # The memory cache's PRIVATE budget is deliberately NOT the
+            # controller's pressure signal: an LRU cache sits at ~100% of
+            # its byte budget in steady state by design, which would read
+            # as permanent memory_pressure and throttle every knob to its
+            # floor. memory_pressure engages only against an explicit
+            # host-payload allowance (AutotuneConfig.memory_budget_bytes):
+            # one shared ledger the cache charges, sized above the cache
+            # limit so crossing the watermark means the PIPELINE is eating
+            # into headroom, not that the cache is healthy-full.
+            budget = None
+            budget_bytes = getattr(autotune_config, "memory_budget_bytes",
+                                   None)
+            if budget_bytes:
+                from petastorm_tpu.autotune import MemoryBudget
+                budget = MemoryBudget(budget_bytes, telemetry=self.telemetry)
+                if isinstance(cache, InMemoryRowGroupCache):
+                    # Before any fill: repoint the cache's accounting at
+                    # the shared ledger (its size_limit still caps it).
+                    cache.budget = budget
+            self.autotune = AutotuneController(self.telemetry,
+                                               autotune_config,
+                                               budget=budget)
+            gate = getattr(self._pool, "concurrency_gate", None)
+            if gate is not None:
+                self.autotune.register(WorkerConcurrencyActuator(
+                    gate, self._pool.workers_count))
+            self.autotune.register(VentilatorDepthActuator(self._ventilator))
+            self.autotune.start()
+
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         if is_batched_reader:
@@ -803,6 +938,8 @@ class Reader:
 
     # ------------------------------------------------------------- lifetime
     def stop(self):
+        if self.autotune is not None:
+            self.autotune.stop()
         if self._telemetry_exporter is not None:
             self._telemetry_exporter.stop()
             self._telemetry_exporter = None
@@ -844,6 +981,13 @@ class Reader:
         Empty report when ``degraded_mode`` is off or nothing failed. See
         docs/resilience.md for the schema."""
         return self.quarantine.report()
+
+    def autotune_report(self) -> dict:
+        """Controller readout: tick count, per-actuator current values and
+        safe ranges, and every adjustment it made (tick, actuator, old, new,
+        verdict). Empty dict when ``autotune`` is off. See docs/autotune.md
+        for the schema."""
+        return {} if self.autotune is None else self.autotune.report()
 
     def cleanup_cache(self):
         """Remove this reader's row-group cache contents (parity: reference

@@ -243,13 +243,21 @@ class RowReaderWorker(WorkerBase):
         needed = self._needed
         rng = item_shuffle_rng(self.args.get("seed"), shuffle_context, self._rng)
 
+        decoded_cache = False
         if predicate is not None:
             data, indices = self._load_columns_with_predicate(
                 rowgroup, needed, predicate, shuffle_row_drop_partition, rng)
         else:
-            data, indices = self._maybe_cached(rowgroup, needed,
-                                               shuffle_row_drop_partition, rng)
-        if (ngram is not None and getattr(ngram, "dense", False)
+            data, indices, decoded_cache = self._maybe_cached(
+                rowgroup, needed, shuffle_row_drop_partition, rng)
+        if decoded_cache:
+            # Memory-tier hit/fill: ``data`` is already post-codec columns
+            # over the WHOLE row group — assemble rows by index selection
+            # and skip straight past the codec stage (dense NGram windows
+            # take the row-fallback assembly; the decode they'd vectorize
+            # is exactly what the cache already paid for).
+            decoded = self._rows_from_decoded(data, indices)
+        elif (ngram is not None and getattr(ngram, "dense", False)
                 and (transform_spec is None or transform_spec.func is None)
                 and self._dense_ngram_vectorizable(data, indices)):
             # TPU-first fast path: windows assembled column-major — no
@@ -258,10 +266,10 @@ class RowReaderWorker(WorkerBase):
             # applied per column); fixed-shape codec fields (ndarray,
             # image) decode column-major and stack once per field.
             return self._dense_ngram_windows(ngram, data, indices)
-
-        # Column-major decode on both paths, so image columns keep the
-        # native batch decoder under predicates too.
-        decoded = self._decode_columns_to_rows(data, indices)
+        else:
+            # Column-major decode on both paths, so image columns keep the
+            # native batch decoder under predicates too.
+            decoded = self._decode_columns_to_rows(data, indices)
 
         if transform_spec is not None and transform_spec.func is not None:
             decoded = [transform_spec.func(r) for r in decoded]
@@ -361,23 +369,74 @@ class RowReaderWorker(WorkerBase):
         return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
     def _maybe_cached(self, rowgroup, needed, drop_part, rng):
-        # Cache the RAW columns only — shuffling and drop-partition slicing
-        # happen after retrieval, so a cache hit never freezes an epoch's
-        # shuffle order or leaks one reader's shuffle into another's.
+        # Shuffling and drop-partition slicing always happen AFTER
+        # retrieval, so a cache hit never freezes an epoch's shuffle order
+        # or leaks one reader's shuffle into another's. Returns
+        # ``(columns, indices, decoded)`` — ``decoded`` marks a memory-tier
+        # payload whose columns are already post-codec.
         cache = self.args.get("cache")
         from petastorm_tpu.cache import NullCache
         if cache is None or isinstance(cache, NullCache):
             data = self._read_columns(rowgroup, needed)
+            decoded = False
+        elif getattr(cache, "caches_decoded", False):
+            # Memory tier (docs/autotune.md): cache POST-codec columns over
+            # the whole row group, so epochs >= 2 skip the Parquet read AND
+            # the codec decode (the dominant cost on image/tensor stores).
+            # A fill that raises caches nothing — quarantined row groups
+            # and injected faults can never poison the cache.
+            data = cache.get(self._cache_key(rowgroup, needed) + ":decoded",
+                             lambda: self._decode_all_columns(rowgroup,
+                                                              needed))
+            decoded = True
         else:
-            # Cached payloads are pickled; memoryviews are not picklable.
+            # Disk tier: RAW columns (pickled; memoryviews are not
+            # picklable), decode re-runs per epoch.
             data = cache.get(self._cache_key(rowgroup, needed),
                              lambda: self._read_columns(rowgroup, needed,
                                                         zero_copy=False))
+            decoded = False
         num_rows = len(next(iter(data.values()))) if data else 0
         part_index, num_parts = drop_part
         indices = select_drop_partition(num_rows, part_index, num_parts,
                                         self.args.get("shuffle_rows", False), rng)
-        return data, indices
+        return data, indices, decoded
+
+    def _decode_all_columns(self, rowgroup, needed) -> dict:
+        """Memory-cache fill: read and codec-decode EVERY row of the row
+        group in natural order (index selection happens per retrieval).
+        Only decode-plan columns are kept — exactly the fields row assembly
+        would read — so the cached payload carries no dead weight."""
+        data = self._read_columns(rowgroup, needed)
+        num_rows = len(next(iter(data.values()))) if data else 0
+        return self._decode_columns(data, range(num_rows))
+
+    def _rows_from_decoded(self, cols: dict, indices) -> List[dict]:
+        """Assemble row dicts from cached full-row-group decoded columns —
+        the hit-path analog of :meth:`_decode_columns_to_rows` (which
+        receives columns already narrowed to ``indices``).
+
+        Mutable cells are COPIED out of the cache: the uncached path hands
+        every consumer freshly-decoded values, so an in-place TransformSpec
+        (``r['image'] -= mean``) or a mutating training loop must not write
+        through to the cache-resident columns (epoch 2 would serve
+        already-transformed data — and for native-batch-decoded columns a
+        returned row is otherwise a VIEW pinning the whole row group).
+        Builtin codecs decode to ndarrays or immutables
+        (str/Decimal/np scalars/bytes); container cells from user codecs
+        deep-copy."""
+        names = list(cols.keys())
+        return [{n: self._copy_cell(cols[n][i]) for n in names}
+                for i in indices]
+
+    @staticmethod
+    def _copy_cell(v):
+        if isinstance(v, np.ndarray):
+            return v.copy()
+        if isinstance(v, (list, dict, set, bytearray)):
+            import copy
+            return copy.deepcopy(v)
+        return v  # immutable (or a user type we cannot safely clone)
 
     def _decode_columns_to_rows(self, data: dict, indices) -> List[dict]:
         """Column-major decode, then row assembly — one tight loop per field

@@ -96,6 +96,7 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         if min_after_retrieve >= shuffling_buffer_capacity:
             raise ValueError("min_after_retrieve must be smaller than "
                              "shuffling_buffer_capacity")
+        self._configured_capacity = shuffling_buffer_capacity
         self._capacity = shuffling_buffer_capacity
         self._min_after_retrieve = min_after_retrieve
         self._extra_capacity = extra_capacity
@@ -107,11 +108,15 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         if self._done_adding:
             raise RuntimeError("Cannot add to a finished shuffling buffer")
         items = list(items)
-        if len(self._items) + len(items) > self._capacity + self._extra_capacity:
+        # Guard against the CONFIGURED bound, not the live tuned target: a
+        # controller-thread shrink may interleave between the producer's
+        # can_add check and this bulk add, and the bulk-add slack contract
+        # (a whole row group after one can_add) is sized for configured.
+        if len(self._items) + len(items) > self._configured_capacity + self._extra_capacity:
             raise RuntimeError(
                 f"Attempt to overfill shuffling buffer: {len(self._items)} buffered + "
-                f"{len(items)} new > {self._capacity} + {self._extra_capacity} slack. "
-                f"Check can_add before adding.")
+                f"{len(items)} new > {self._configured_capacity} + "
+                f"{self._extra_capacity} slack. Check can_add before adding.")
         self._items.extend(items)
 
     def retrieve(self):
@@ -142,3 +147,20 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     @property
     def capacity(self):
         return self._capacity
+
+    @property
+    def min_target(self) -> int:
+        """Smallest target the autotune actuator may set: the shuffle-quality
+        floor (``min_after_retrieve``) plus one retrievable row."""
+        return self._min_after_retrieve + 1
+
+    def set_target_capacity(self, n: int) -> None:
+        """Runtime knob over the target row count (autotune's
+        ``shuffle_target`` actuator; ``tools/check_knobs.py`` lints that
+        only :mod:`petastorm_tpu.autotune` calls this). Clamped to
+        [min_target, configured capacity] — the extra-capacity slack is
+        sized for the configured bound, so growth past it could overfill.
+        Shrinking below the current fill just pauses admission until
+        retrieval drains the excess; no buffered row is dropped."""
+        self._capacity = max(self.min_target,
+                             min(int(n), self._configured_capacity))

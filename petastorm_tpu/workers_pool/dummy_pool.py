@@ -39,6 +39,9 @@ class DummyPool:
         # Consumer-side RowGroupQuarantine aggregator (assigned by the Reader
         # before start(); same contract as the threaded pools).
         self.quarantine = None
+        #: Uniform knob surface with ThreadPool. None: work runs inline in
+        #: the consumer's own thread — there is no concurrency to gate.
+        self.concurrency_gate = None
         #: Cumulative seconds of decode run INLINE inside ``get_results``.
         #: The reader's pool-wait timer wraps ``get_results`` and subtracts
         #: the growth of this value, so ``reader.pool_wait_s`` and

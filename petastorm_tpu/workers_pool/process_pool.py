@@ -144,6 +144,13 @@ class ProcessPool:
         # row groups instead of a fatal RuntimeError.
         self.quarantine = None
         self.recovery = None
+        #: Uniform knob surface with ThreadPool. None: spawned workers pull
+        #: work through pre-buffering PUSH/PULL sockets, so parking one
+        #: would strand the items already routed to its receive buffer (an
+        #: epoch stall, not a concurrency reduction). The process pool's
+        #: producer-side knob is the ventilator's in-flight cap instead
+        #: (docs/autotune.md).
+        self.concurrency_gate = None
         ipc_dir = tempfile.mkdtemp(prefix="pt_pool_")
         token = uuid.uuid4().hex[:8]
         self._endpoints = {

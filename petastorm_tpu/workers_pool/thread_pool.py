@@ -46,15 +46,88 @@ class WorkerTerminationRequested(Exception):
     """Raised inside a worker thread to unwind when the pool is stopping."""
 
 
+class ConcurrencyGate:
+    """Admission gate over live worker concurrency.
+
+    All ``workers_count`` threads stay alive, but only ``limit`` of them may
+    be *processing an item* at once — the rest park before taking their next
+    item. This is the runtime decode-concurrency knob the autotune subsystem
+    actuates (``set_limit`` is the knob setter; ``tools/check_knobs.py``
+    lints that only :mod:`petastorm_tpu.autotune` calls it): concurrency
+    changes take effect at the next item boundary with no thread churn, no
+    lost items, and no effect on the round-robin result determinism (parked
+    workers simply publish later; readout order is unchanged).
+
+    Deadlock safety under the strict-order consumer: a slot-holding worker
+    blocked publishing into its FULL result queue *yields* its slot
+    (:meth:`yield_if_held` from the pool's bounded put) so a parked worker —
+    possibly the exact one the round-robin consumer is waiting on — can run;
+    the yielder re-acquires before resuming decode. Without this, limit <
+    workers_count could wedge: consumer waits on a parked worker while every
+    slot holder waits on the consumer.
+    """
+
+    def __init__(self, limit: int):
+        self._limit = max(1, int(limit))
+        self._active = 0
+        self._holders: set = set()   # thread idents holding a slot
+        self._cv = threading.Condition()
+
+    @property
+    def limit(self) -> int:
+        with self._cv:
+            return self._limit
+
+    @property
+    def active(self) -> int:
+        with self._cv:
+            return self._active
+
+    def set_limit(self, limit: int) -> None:
+        with self._cv:
+            self._limit = max(1, int(limit))
+            self._cv.notify_all()
+
+    def acquire(self, stop_event) -> bool:
+        """Block until a processing slot frees (or the pool stops: False)."""
+        with self._cv:
+            while self._active >= self._limit:
+                if stop_event.is_set():
+                    return False
+                self._cv.wait(_END_OF_VENTILATION_POLL_S)
+            self._active += 1
+            self._holders.add(threading.get_ident())
+            return True
+
+    def release(self) -> None:
+        """Free the calling thread's slot; no-op when it holds none (so the
+        worker loop's unconditional release composes with a mid-publish
+        yield)."""
+        self.yield_if_held()
+
+    def yield_if_held(self) -> bool:
+        """Backpressure escape hatch: release the calling thread's slot if
+        it holds one; returns whether it did (caller re-acquires later)."""
+        with self._cv:
+            ident = threading.get_ident()
+            if ident not in self._holders:
+                return False
+            self._holders.discard(ident)
+            self._active = max(0, self._active - 1)
+            self._cv.notify_all()
+            return True
+
+
 class _WorkerThread(threading.Thread):
     def __init__(self, worker_impl, input_queue, result_queue, stop_event,
-                 put_fn, prof=None, telemetry=None):
+                 put_fn, prof=None, telemetry=None, gate=None):
         super().__init__(name=f"pt-worker-{worker_impl.worker_id}", daemon=True)
         self._worker_impl = worker_impl
         self._input_queue = input_queue
         self._result_queue = result_queue
         self._stop_event = stop_event
         self._put = put_fn
+        self._gate = gate
         self.prof = prof  # per-worker cProfile; pre-3.12 only (see ThreadPool)
         # Shared pipeline registry (set by the reader through the pool):
         # in-worker decode time is only observable from inside the worker.
@@ -92,13 +165,22 @@ class _WorkerThread(threading.Thread):
                 args, kwargs = self._input_queue.get(block=True, timeout=_IO_TIMEOUT_S)
             except queue.Empty:
                 continue
-            if self._decode_hist is not None:
-                t0 = time.perf_counter()
-                with self._telemetry.span("petastorm_tpu.worker_decode"):
+            # Admission gate: park until a processing slot frees. The item
+            # stays ours (round-robin assignment is fixed), so determinism
+            # holds; a stop while parked drops the item like any other stop.
+            if self._gate is not None and not self._gate.acquire(self._stop_event):
+                return
+            try:
+                if self._decode_hist is not None:
+                    t0 = time.perf_counter()
+                    with self._telemetry.span("petastorm_tpu.worker_decode"):
+                        self._process_item(args, kwargs)
+                    self._decode_hist.observe(time.perf_counter() - t0)
+                else:
                     self._process_item(args, kwargs)
-                self._decode_hist.observe(time.perf_counter() - t0)
-            else:
-                self._process_item(args, kwargs)
+            finally:
+                if self._gate is not None:
+                    self._gate.release()
             self._put(VentilatedItemProcessedMessage(
                 kwargs.get(ITEM_CONTEXT_KWARG)))
 
@@ -153,6 +235,10 @@ class ThreadPool:
         # before start() when degraded mode is available); skip messages are
         # dropped with a warning when nothing is attached.
         self.quarantine = None
+        #: Runtime decode-concurrency knob: always present (one lock
+        #: round-trip per row group, noise next to a decode), actuated only
+        #: when the owning Reader enables autotune.
+        self.concurrency_gate = ConcurrencyGate(workers_count)
 
     # ------------------------------------------------------------------ api
     def start(self, worker_class, worker_args=None, ventilator=None):
@@ -170,7 +256,8 @@ class ThreadPool:
                                and sys.version_info < (3, 12) else None)
             self._workers.append(_WorkerThread(worker, in_q, out_q, self._stop_event,
                                                self._make_put(i), per_worker_prof,
-                                               telemetry=self.telemetry))
+                                               telemetry=self.telemetry,
+                                               gate=self.concurrency_gate))
         if self._profiling_enabled and sys.version_info >= (3, 12):
             self._prof = cProfile.Profile()
             try:
@@ -186,16 +273,30 @@ class ThreadPool:
             self._ventilator.start()
 
     def _make_put(self, worker_id):
+        gate = self.concurrency_gate
+
         def _put(data):
             # Bounded put that aborts when the pool is stopping, so workers
-            # never deadlock against a full queue (reference :242).
-            while True:
-                try:
-                    self._result_queues[worker_id].put(data, block=True, timeout=_IO_TIMEOUT_S)
-                    return
-                except queue.Full:
-                    if self._stop_event.is_set():
-                        raise WorkerTerminationRequested()
+            # never deadlock against a full queue (reference :242). While
+            # blocked on a FULL queue, a slot-holding worker yields its
+            # admission slot (see ConcurrencyGate): with a shrunk
+            # concurrency limit the strict-order consumer may be waiting on
+            # a PARKED worker, and a slot holder waiting on the consumer
+            # would complete the cycle.
+            yielded = False
+            try:
+                while True:
+                    try:
+                        self._result_queues[worker_id].put(data, block=True, timeout=_IO_TIMEOUT_S)
+                        return
+                    except queue.Full:
+                        if self._stop_event.is_set():
+                            raise WorkerTerminationRequested()
+                        if not yielded:
+                            yielded = gate.yield_if_held()
+            finally:
+                if yielded and not gate.acquire(self._stop_event):
+                    raise WorkerTerminationRequested()
         return _put
 
     def ventilate(self, *args, **kwargs):

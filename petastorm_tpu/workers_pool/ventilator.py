@@ -168,6 +168,17 @@ class ConcurrentVentilator(Ventilator):
     def max_inflight(self) -> int:
         return self._max_inflight
 
+    def set_max_inflight(self, n: int) -> None:
+        """Runtime knob over the in-flight cap (autotune's
+        ``ventilate_ahead`` actuator; ``tools/check_knobs.py`` lints that
+        only :mod:`petastorm_tpu.autotune` calls this). A raised cap wakes
+        the ventilation thread immediately; a lowered one simply stops
+        admitting new items until the backlog drains below it — items
+        already ventilated are never recalled."""
+        with self._inflight_cv:
+            self._max_inflight = max(1, int(n))
+            self._inflight_cv.notify_all()
+
     def completed(self) -> bool:
         # A stopped ventilator will never ventilate again: report completed
         # so consumers drain and raise EmptyResultError instead of spinning

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Lint guard: one source of knob truth — ``petastorm_tpu/autotune/``.
+
+The pipeline's runtime throughput knobs — the thread pool's admission-gate
+limit, the ventilator's in-flight cap, the shuffling buffers' target size,
+the JAX loader's prefetch depth — are actuated by the autotune feedback
+controller through clamped :class:`~petastorm_tpu.autotune.Actuator`
+wrappers that mirror every change into ``autotune.*`` telemetry
+(docs/autotune.md). A direct setter call anywhere else mutates pipeline
+shape invisibly: unclamped, unrecorded, and racing the controller. This
+check fails CI when any module outside ``petastorm_tpu/autotune/`` calls
+one of the knob setters:
+
+* ``set_limit``          (ConcurrencyGate — live decode concurrency)
+* ``set_max_inflight``   (ConcurrentVentilator — ventilation depth)
+* ``set_target_capacity``(shuffling buffers — target row count)
+* ``set_prefetch_depth`` (JAX LoaderBase — staged-batch queue depth)
+
+A definition of these methods is fine anywhere (the components OWN their
+knobs); only *calls* are restricted. A legitimate out-of-band call (e.g. a
+diagnostic harness) may opt out with a ``knob-ok`` comment on the call
+line, stating why the mutation is safe without the controller.
+
+Usage::
+
+    python tools/check_knobs.py            # scan petastorm_tpu/ (minus autotune/)
+    python tools/check_knobs.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The whole package is in scope; the autotune package itself is the one
+#: place allowed to actuate pipeline knobs.
+DEFAULT_PATHS = ("petastorm_tpu",)
+EXEMPT_DIRS = (os.path.join("petastorm_tpu", "autotune"),)
+
+WAIVER = "knob-ok"
+
+KNOB_SETTERS = frozenset({
+    "set_limit",
+    "set_max_inflight",
+    "set_target_capacity",
+    "set_prefetch_depth",
+})
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _knob_calls(tree: ast.AST):
+    """Yield every ``<expr>.<knob_setter>(...)`` call node."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in KNOB_SETTERS):
+            yield node
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived knob mutation."""
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    if any(rel == d or rel.startswith(d + os.sep) for d in EXEMPT_DIRS):
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    for call in sorted(_knob_calls(tree), key=lambda c: c.lineno):
+        line = lines[call.lineno - 1] if call.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{call.lineno}: direct call to knob setter "
+            f"'{call.func.attr}' outside petastorm_tpu/autotune/ — actuate "
+            f"through the controller's Actuator (clamped + telemetry-"
+            f"recorded; see docs/autotune.md), or add "
+            f"'# {WAIVER}: <why this mutation is safe without the "
+            f"controller>'")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+    for violation in all_violations:
+        print(violation, file=sys.stderr)
+    if all_violations:
+        print(f"check_knobs: {len(all_violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_knobs: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
