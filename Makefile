@@ -43,6 +43,7 @@ ci-lint:
 	python tools/check_monotonic.py
 	python tools/check_backoff.py
 	python tools/check_knobs.py
+	python tools/check_timeouts.py
 
 ci-adapters:
 	timeout 1200 python -m pytest tests/test_torch_loader_depth.py \

@@ -42,9 +42,42 @@ from petastorm_tpu.jax.batched_buffer import (BatchedNoopShufflingBuffer,
 from petastorm_tpu.jax.dtypes import (DEFAULT_POLICY, DTypePolicy,
                                       sanitize_array, sanitize_batch)
 from petastorm_tpu.metrics import PipelineMetrics, traced_span
+from petastorm_tpu.resilience import PipelineHungError
 from petastorm_tpu.telemetry import StallAttributor, make_registry
 
 logger = logging.getLogger(__name__)
+
+#: Consumer-side poll period on the staged-batch queue. Bounds how late a
+#: dead staging thread is *noticed*, not delivery latency — a staged batch
+#: is taken the moment it arrives.
+_STAGE_POLL_S = 0.5
+
+
+def _get_staged(q, thread, poll_s: float = _STAGE_POLL_S):
+    """Blocking staged-batch ``get`` that can never hang on a dead
+    producer: poll with a timeout and check staging-thread liveness each
+    wake-up. The staging thread's ``finally`` always enqueues the
+    end/error sentinel, so a dead thread with an empty queue means it was
+    torn down without ever delivering (e.g. killed mid-interpreter
+    teardown) — raise :class:`~petastorm_tpu.resilience.PipelineHungError`
+    instead of blocking the training step forever."""
+    import queue as queue_mod
+    while True:
+        try:
+            return q.get(timeout=poll_s)
+        except queue_mod.Empty:
+            if not thread.is_alive():
+                # Drain once more: the thread may have enqueued its final
+                # sentinel and exited between our timeout and the liveness
+                # check — a clean end-of-stream, not a death.
+                try:
+                    return q.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                raise PipelineHungError(
+                    "Loader staging thread died without delivering a batch, "
+                    "an error, or end-of-stream; the input pipeline is gone. "
+                    "Check earlier log output for the thread's demise.")
 
 
 class LoaderBase:
@@ -379,7 +412,7 @@ class LoaderBase:
             last_resume = None
             while True:
                 t0 = time.perf_counter()
-                kind, item, snap = q.get()
+                kind, item, snap = _get_staged(q, thread)
                 with space:
                     space.notify()
                 t1 = time.perf_counter()

@@ -294,7 +294,10 @@ def make_reader(dataset_url,
                 worker_crash_budget: int = 0,
                 autotune: bool = False,
                 autotune_config=None,
-                memory_cache_size_bytes: Optional[int] = None):
+                memory_cache_size_bytes: Optional[int] = None,
+                stage_deadline_s=None,
+                hedge_policy=None,
+                hang_timeout_s: Optional[float] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -364,6 +367,22 @@ def make_reader(dataset_url,
         so). With ``reader_pool_type='process'`` each spawned worker keeps
         a private cache of this size over its own item subset (the budget
         multiplies by ``workers_count``).
+    :param stage_deadline_s: per-attempt latency budget for each work
+        item's load+decode: a number ``h`` means hard deadline ``h`` with
+        a soft (straggler-telemetry-only) budget at ``h/2``; pass a
+        :class:`petastorm_tpu.resilience.StageDeadline` for independent
+        soft/hard budgets. Hard overruns cancel the attempt into the
+        retry/quarantine machinery (docs/resilience.md § "Deadlines,
+        hedging, and the watchdog").
+    :param hedge_policy: a :class:`petastorm_tpu.resilience.HedgePolicy`
+        enabling speculative duplicate row-group reads once the primary
+        read straggles past a quantile-tracked delay; first result wins,
+        byte-identical either way (seeded epochs stay reproducible).
+    :param hang_timeout_s: start a :class:`petastorm_tpu.resilience.
+        PipelineWatchdog`: if the consumer starves for this long with no
+        progress anywhere in the pipeline, thread stacks are dumped to
+        telemetry and the watchdog escalates nudge -> cancel/kill ->
+        ``PipelineHungError`` — the reader never blocks indefinitely.
 
     Parity: reference reader.py:60.
     """
@@ -418,7 +437,10 @@ def make_reader(dataset_url,
                   fault_plan=fault_plan,
                   worker_crash_budget=worker_crash_budget,
                   autotune=autotune,
-                  autotune_config=autotune_config)
+                  autotune_config=autotune_config,
+                  stage_deadline_s=stage_deadline_s,
+                  hedge_policy=hedge_policy,
+                  hang_timeout_s=hang_timeout_s)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -457,7 +479,10 @@ def make_batch_reader(dataset_url_or_urls,
                       worker_crash_budget: int = 0,
                       autotune: bool = False,
                       autotune_config=None,
-                      memory_cache_size_bytes: Optional[int] = None):
+                      memory_cache_size_bytes: Optional[int] = None,
+                      stage_deadline_s=None,
+                      hedge_policy=None,
+                      hang_timeout_s: Optional[float] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -479,6 +504,9 @@ def make_batch_reader(dataset_url_or_urls,
     exactly as in :func:`make_reader` (see docs/autotune.md); the memory
     cache holds this reader's raw row-group tables — the columnar path has
     no codec decode to cache past.
+    ``stage_deadline_s`` / ``hedge_policy`` / ``hang_timeout_s`` behave
+    exactly as in :func:`make_reader` (docs/resilience.md § "Deadlines,
+    hedging, and the watchdog").
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -535,7 +563,10 @@ def make_batch_reader(dataset_url_or_urls,
                   fault_plan=fault_plan,
                   worker_crash_budget=worker_crash_budget,
                   autotune=autotune,
-                  autotune_config=autotune_config)
+                  autotune_config=autotune_config,
+                  stage_deadline_s=stage_deadline_s,
+                  hedge_policy=hedge_policy,
+                  hang_timeout_s=hang_timeout_s)
 
 
 class Reader:
@@ -552,7 +583,8 @@ class Reader:
                  filesystem=None, convert_early_to_numpy=False,
                  rowgroup_coalescing=1, filters=None, retry_policy=None,
                  degraded_mode=False, fault_plan=None, worker_crash_budget=0,
-                 autotune=False, autotune_config=None):
+                 autotune=False, autotune_config=None, stage_deadline_s=None,
+                 hedge_policy=None, hang_timeout_s=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -648,7 +680,9 @@ class Reader:
                 cache.attach_telemetry(self.telemetry)
 
         # ---------------- resilience wiring (docs/resilience.md)
-        from petastorm_tpu.resilience import (RowGroupQuarantine,
+        from petastorm_tpu.resilience import (CancellationToken, HedgePolicy,
+                                              RowGroupQuarantine,
+                                              StageDeadline,
                                               WorkerCrashRecovery)
         #: Consumer-side aggregator of degraded-mode skip records; query via
         #: :meth:`quarantine_report`. Attached to every pool type.
@@ -663,6 +697,30 @@ class Reader:
                 # a crash budget only means something for spawned processes.
                 warnings.warn("worker_crash_budget only applies to "
                               "reader_pool_type='process'; ignored")
+
+        # ---------------- straggler & hang defense (docs/resilience.md)
+        stage_deadline = StageDeadline.from_arg(stage_deadline_s)
+        if hedge_policy is not None and not isinstance(hedge_policy,
+                                                       HedgePolicy):
+            raise TypeError(
+                f"hedge_policy must be a petastorm_tpu.resilience."
+                f"HedgePolicy (or None), got {type(hedge_policy).__name__}")
+        if hang_timeout_s is not None and hang_timeout_s <= 0:
+            raise ValueError(f"hang_timeout_s must be positive, "
+                             f"got {hang_timeout_s}")
+        # One shared cancel token covers in-process workers: deadline
+        # checkpoints consult it, the watchdog's cancel rung requests it.
+        # Spawned workers get None — there is no cross-process flag to
+        # flip; the watchdog escalates to the crash-recovery kill there.
+        self._cancel_token = (
+            CancellationToken()
+            if (stage_deadline is not None or hang_timeout_s is not None)
+            and not isinstance(self._pool, ProcessPool) else None)
+        if hasattr(self._pool, "stage_deadline"):
+            # Thread/dummy pools also account whole-item soft overruns
+            # (decode + publish backpressure) on top of the workers'
+            # per-attempt enforcement.
+            self._pool.stage_deadline = stage_deadline
 
         worker_args = {
             "dataset_url_or_urls": dataset_url_or_urls,
@@ -681,6 +739,12 @@ class Reader:
             "retry_policy": retry_policy,
             "degraded_mode": degraded_mode,
             "fault_plan": fault_plan,
+            # Straggler defense: the deadline/hedge policies are picklable
+            # values (spawned workers enforce them in-process); the cancel
+            # token is in-process only (None for process pools).
+            "stage_deadline": stage_deadline,
+            "hedge_policy": hedge_policy,
+            "cancel_token": self._cancel_token,
             # The shared registry cannot cross the spawn boundary (same
             # limitation as the worker decode histogram): spawned workers
             # retry without exporting per-retry counters; quarantine and
@@ -798,13 +862,29 @@ class Reader:
 
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
+        # ---------------- watchdog (docs/resilience.md)
+        #: Background :class:`~petastorm_tpu.resilience.PipelineWatchdog`
+        #: when ``hang_timeout_s`` is set (else None). The pool-wait timer
+        #: below reports consumer starvation to it; see
+        #: :meth:`watchdog_report`.
+        self.watchdog = None
+        if hang_timeout_s is not None:
+            from petastorm_tpu.resilience import PipelineWatchdog
+            self.watchdog = PipelineWatchdog(
+                self._pool, ventilator=self._ventilator,
+                telemetry=self.telemetry, hang_timeout_s=hang_timeout_s,
+                recovery=getattr(self._pool, "recovery", None),
+                cancel_token=self._cancel_token).start()
+
         if is_batched_reader:
             self._results_reader = _BatchResultsReader(self._pool, self.schema,
-                                                       telemetry=self.telemetry)
+                                                       telemetry=self.telemetry,
+                                                       watchdog=self.watchdog)
         else:
             self._results_reader = _RowResultsReader(self._pool, self.schema,
                                                      self.ngram,
-                                                     telemetry=self.telemetry)
+                                                     telemetry=self.telemetry,
+                                                     watchdog=self.watchdog)
 
         export_path = os.environ.get(TELEMETRY_EXPORT_ENV)
         if export_path:
@@ -938,6 +1018,8 @@ class Reader:
 
     # ------------------------------------------------------------- lifetime
     def stop(self):
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.autotune is not None:
             self.autotune.stop()
         if self._telemetry_exporter is not None:
@@ -989,6 +1071,12 @@ class Reader:
         for the schema."""
         return {} if self.autotune is None else self.autotune.report()
 
+    def watchdog_report(self) -> dict:
+        """Watchdog readout: hang detections/recoveries, the current
+        escalation stage, and the latest thread-stack dump. Empty dict
+        when ``hang_timeout_s`` is off. See docs/resilience.md."""
+        return {} if self.watchdog is None else self.watchdog.report()
+
     def cleanup_cache(self):
         """Remove this reader's row-group cache contents (parity: reference
         reader.py:693 — a no-op with the default NullCache)."""
@@ -1007,9 +1095,13 @@ class _PoolWaitTimer:
     registry (``reader.pool_wait_s`` histogram + a recorder span) — the
     "pool-queue" stage of the per-stage breakdown."""
 
-    def __init__(self, pool, telemetry):
+    def __init__(self, pool, telemetry, watchdog=None):
         self._pool = pool
         self._telemetry = telemetry
+        # The pipeline watchdog (when enabled) learns here whether the
+        # consumer is actually starving: a hang is only a hang while
+        # someone is blocked waiting on the pipeline.
+        self._watchdog = watchdog
         self._wait_hist = (telemetry.histogram("reader.pool_wait_s")
                            if telemetry is not None else None)
         # DummyPool decodes INLINE inside get_results; subtract that growth
@@ -1019,6 +1111,15 @@ class _PoolWaitTimer:
             pool if hasattr(pool, "inline_decode_s") else None)
 
     def get_results(self):
+        if self._watchdog is not None:
+            self._watchdog.enter_wait()
+        try:
+            return self._timed_get_results()
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.exit_wait()
+
+    def _timed_get_results(self):
         if self._wait_hist is None:
             return self._pool.get_results()
         inline0 = (self._inline_decode_pool.inline_decode_s
@@ -1037,8 +1138,8 @@ class _RowResultsReader(_PoolWaitTimer):
     """Buffers published row lists; yields one namedtuple (or ngram dict of
     namedtuples) per ``read_next`` (parity: py_dict_reader_worker.py:64-97)."""
 
-    def __init__(self, pool, schema, ngram, telemetry=None):
-        super().__init__(pool, telemetry)
+    def __init__(self, pool, schema, ngram, telemetry=None, watchdog=None):
+        super().__init__(pool, telemetry, watchdog=watchdog)
         self._schema = schema
         self._ngram = ngram
         self._buffer = deque()
@@ -1060,8 +1161,8 @@ class _BatchResultsReader(_PoolWaitTimer):
     """Yields one namedtuple-of-numpy-arrays per row group
     (parity: arrow_reader_worker.py:89-111, batched_output=True)."""
 
-    def __init__(self, pool, schema, telemetry=None):
-        super().__init__(pool, telemetry)
+    def __init__(self, pool, schema, telemetry=None, watchdog=None):
+        super().__init__(pool, telemetry, watchdog=watchdog)
         self._schema = schema
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)

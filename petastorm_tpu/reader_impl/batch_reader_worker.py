@@ -18,10 +18,10 @@ from decimal import Decimal
 import numpy as np
 import pyarrow as pa
 
-from petastorm_tpu.reader_impl.row_reader_worker import (_ParquetFileLRU,
-                                                         _read_row_group,
-                                                         item_shuffle_rng,
-                                                         select_drop_partition)
+from petastorm_tpu.reader_impl.row_reader_worker import (
+    _ParquetFileLRU, _init_latency_defense, deadline_checkpoint,
+    item_shuffle_rng, read_row_group_maybe_hedged, run_guarded_attempt,
+    select_drop_partition)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -46,6 +46,7 @@ class BatchReaderWorker(WorkerBase):
             worker_id=worker_id,
             telemetry=args.get("resilience_telemetry"))
         self._fault_plan = args.get("fault_plan")
+        _init_latency_defense(self, args)
 
     def _ensure_open(self):
         if self._ctx is None:
@@ -63,11 +64,12 @@ class BatchReaderWorker(WorkerBase):
             self._fault_plan.fire("worker.item", key=str(rowgroup.path),
                                   worker_id=self.worker_id)
         # The whole load+transform is the retry unit; publish stays OUTSIDE
-        # the guard so a retried item can never publish twice.
-        result = self._guard.run(
+        # the guard so a retried item can never publish twice. Each attempt
+        # runs under the stage deadline (when configured).
+        result = run_guarded_attempt(
+            self, rowgroup,
             lambda: self._build_result(rowgroup, shuffle_row_drop_partition,
                                        shuffle_context),
-            rowgroup,
             on_retry=lambda _a, _e, _d: self._files.evict(rowgroup.path))
         if result is not None:
             self.publish_func(result)
@@ -89,6 +91,9 @@ class BatchReaderWorker(WorkerBase):
                                  shuffle_row_drop_partition, cache,
                                  rng=item_shuffle_rng(self.args.get("seed"),
                                                       shuffle_context, self._rng))
+        # Stage boundary (read done, transform/convert ahead): a
+        # hard-overrun or watchdog-cancelled attempt stops here.
+        deadline_checkpoint(self)
         if table is None or table.num_rows == 0:
             return None
 
@@ -127,9 +132,7 @@ class BatchReaderWorker(WorkerBase):
         return f"{h}:{rowgroup.path}:{rowgroup.row_group}:{','.join(sorted(columns))}"
 
     def _read_table(self, rowgroup, columns) -> pa.Table:
-        table = _read_row_group(self._files, rowgroup, columns,
-                                fault_plan=self._fault_plan,
-                                worker_id=self.worker_id)
+        table = read_row_group_maybe_hedged(self, rowgroup, columns)
         # Surface hive partition keys as constant columns when requested.
         for key, value in rowgroup.partition_values:
             if key in columns and key not in table.column_names:

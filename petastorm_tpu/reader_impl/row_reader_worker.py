@@ -97,6 +97,41 @@ def _read_row_group(files: "_ParquetFileLRU", rowgroup, columns,
                              use_threads=False)
 
 
+def read_row_group_maybe_hedged(worker, rowgroup, columns):
+    """The row-group IO call both workers share, with optional hedging.
+
+    Without a hedger this is exactly :func:`_read_row_group` over the
+    worker's shared handle LRU. With one (``hedge_policy=`` on the
+    reader), a straggling primary races a duplicate read — see
+    :mod:`petastorm_tpu.resilience.hedging` — and BOTH attempts open
+    **private** file handles, closed by the attempt itself: a losing
+    attempt is abandoned mid-read, and the shared ``worker._files`` LRU
+    is neither thread-safe nor safe to evict (close) under a concurrent
+    reader, so abandoned threads must never touch it. The per-read open
+    is the price of abandonment safety — noise against the remote,
+    ms-scale reads hedging exists for (hedge_policy=None, the default,
+    keeps the zero-overhead shared-LRU path). Both attempts read the
+    same immutable row group, so the winner's bytes are identical either
+    way and seeded epochs stay reproducible. Fault-plan sites fire per
+    attempt, exactly as real storage would misbehave per request."""
+    if worker._hedger is None:
+        return _read_row_group(worker._files, rowgroup, columns,
+                               fault_plan=worker._fault_plan,
+                               worker_id=worker.worker_id)
+
+    def attempt(_cancel):
+        private = _ParquetFileLRU(worker._ctx.filesystem, capacity=1)
+        try:
+            return _read_row_group(private, rowgroup, columns,
+                                   fault_plan=worker._fault_plan,
+                                   worker_id=worker.worker_id)
+        finally:
+            private.evict(rowgroup.path)
+
+    return worker._hedger.read(attempt, attempt,
+                               key=str(rowgroup.path))
+
+
 def _column_values(col, zero_copy: bool = True):
     """Extract one pyarrow ChunkedArray as per-row Python values.
 
@@ -134,6 +169,67 @@ def _inject_partition_values(table_dict, num_rows, rowgroup, wanted_columns):
         if key in wanted_columns and key not in table_dict:
             table_dict[key] = [value] * num_rows
     return table_dict
+
+
+def _init_latency_defense(worker, args):
+    """Shared straggler-defense wiring for both reader workers: a
+    per-attempt :class:`~petastorm_tpu.resilience.StageDeadline` (soft
+    overruns -> straggler telemetry; hard overruns cancel the attempt into
+    the retry/quarantine machinery) and an optional
+    :class:`~petastorm_tpu.resilience.HedgedReadExecutor` for the
+    row-group IO call. Both default off (no hot-path cost)."""
+    from petastorm_tpu.resilience import HedgedReadExecutor, StragglerMonitor
+    telemetry = args.get("resilience_telemetry")
+    worker._deadline = args.get("stage_deadline")
+    worker._cancel_token = args.get("cancel_token")
+    worker._active_timer = None
+    worker._straggler = (
+        StragglerMonitor(worker._deadline, telemetry=telemetry,
+                         site="worker.attempt")
+        if worker._deadline is not None else None)
+    policy = args.get("hedge_policy")
+    worker._hedger = (
+        HedgedReadExecutor(policy, telemetry=telemetry,
+                           worker_id=worker.worker_id)
+        if policy is not None else None)
+
+
+def run_guarded_attempt(worker, rowgroup, build, on_retry):
+    """One work item through the worker's guard, each attempt under the
+    stage deadline: the timer is armed for the attempt's duration (nested
+    code reaches it through :func:`deadline_checkpoint`), ``finish()``
+    cancels a hard overrun — the completed-but-late result is discarded
+    and the guard retries/quarantines — and a soft overrun that still
+    delivered is counted as a straggler. A cancel token WITHOUT a
+    deadline (``hang_timeout_s`` alone) still arms a cancellation-only
+    timer, so the watchdog's cancel rung has checkpoints to reach."""
+    if worker._deadline is None and worker._cancel_token is None:
+        return worker._guard.run(build, rowgroup, on_retry=on_retry)
+    from petastorm_tpu.resilience import DeadlineTimer
+
+    def attempt():
+        timer = DeadlineTimer(worker._deadline, worker._cancel_token)
+        worker._active_timer = timer
+        try:
+            result = build()
+            elapsed = timer.finish()
+        finally:
+            worker._active_timer = None
+        if worker._straggler is not None:
+            worker._straggler.observe(elapsed, key=str(rowgroup.path),
+                                      worker_id=worker.worker_id)
+        return result
+
+    return worker._guard.run(attempt, rowgroup, on_retry=on_retry)
+
+
+def deadline_checkpoint(worker) -> None:
+    """Cooperative cancellation point between attempt stages (post-read,
+    post-decode): raises ``StageDeadlineExceeded`` on a hard overrun or a
+    pending watchdog cancel request; no-op without an armed deadline."""
+    timer = worker._active_timer
+    if timer is not None:
+        timer.check()
 
 
 def item_shuffle_rng(seed, shuffle_context, fallback_rng):
@@ -206,6 +302,7 @@ class RowReaderWorker(WorkerBase):
             worker_id=worker_id,
             telemetry=args.get("resilience_telemetry"))
         self._fault_plan = args.get("fault_plan")
+        _init_latency_defense(self, args)
 
     # Lazily build per-process handles (cheap for threads, required for processes).
     def _ensure_open(self):
@@ -225,11 +322,12 @@ class RowReaderWorker(WorkerBase):
                                   worker_id=self.worker_id)
         # The whole load+decode is the retry unit (decode failures on corrupt
         # bytes quarantine too, not just IO); publish stays OUTSIDE the guard
-        # so a retried item can never publish twice.
-        result = self._guard.run(
+        # so a retried item can never publish twice. Each attempt runs under
+        # the stage deadline (when configured).
+        result = run_guarded_attempt(
+            self, rowgroup,
             lambda: self._build_result(rowgroup, shuffle_row_drop_partition,
                                        shuffle_context),
-            rowgroup,
             on_retry=lambda _a, _e, _d: self._files.evict(rowgroup.path))
         if result:
             self.publish_func(result)
@@ -250,6 +348,10 @@ class RowReaderWorker(WorkerBase):
         else:
             data, indices, decoded_cache = self._maybe_cached(
                 rowgroup, needed, shuffle_row_drop_partition, rng)
+        # Stage boundary (read done, decode ahead): a hard-overrun or
+        # watchdog-cancelled attempt stops here instead of paying the
+        # decode too.
+        deadline_checkpoint(self)
         if decoded_cache:
             # Memory-tier hit/fill: ``data`` is already post-codec columns
             # over the WHOLE row group — assemble rows by index selection
@@ -493,9 +595,7 @@ class RowReaderWorker(WorkerBase):
         ~5x faster than per-cell ``to_pylist`` on image/ndarray stores. The
         codecs accept memoryviews and copy on decode. Pass ``zero_copy=False``
         when the raw columns must be picklable (disk cache)."""
-        table = _read_row_group(self._files, rowgroup, columns,
-                                fault_plan=self._fault_plan,
-                                worker_id=self.worker_id)
+        table = read_row_group_maybe_hedged(self, rowgroup, columns)
         data = {name: _column_values(table.column(name), zero_copy)
                 for name in table.column_names}
         return _inject_partition_values(data, table.num_rows, rowgroup, columns)

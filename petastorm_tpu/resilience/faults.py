@@ -84,7 +84,18 @@ class FaultSpec:
         ``at=N`` kill would fire in EVERY worker that reaches its Nth item
         (and again in whichever worker inherits the re-ventilated work) —
         pinning the spec to one worker kills exactly one process.
-    :param latency_s: sleep duration for ``latency`` faults
+    :param latency_s: base sleep duration for ``latency`` faults
+    :param latency_jitter_s: additional seeded jitter for ``latency``
+        faults — each injection sleeps ``latency_s + j`` where ``j`` is a
+        fresh **decorrelated** draw in ``(0, latency_jitter_s]``
+        (AWS-style ``min(jit, uniform(jit/10, 3 * prev))``, per
+        ``(spec, worker)`` RNG keyed off the plan seed). Real straggler
+        distributions are long-tailed and uncorrelated injection-to-
+        injection, not a constant; the seeded draw keeps tests and
+        ``bench.py straggler_epoch`` byte-reproducible run-to-run. The
+        jitter RNG stream is separate from the ``rate`` decision stream,
+        so adding jitter to an existing plan never shifts which accesses
+        fire.
     :param message: carried in the injected exception
     """
 
@@ -96,6 +107,7 @@ class FaultSpec:
     key_substring: Optional[str] = None
     worker: Optional[int] = None
     latency_s: float = 0.05
+    latency_jitter_s: float = 0.0
     message: str = ""
 
     def __post_init__(self):
@@ -108,6 +120,9 @@ class FaultSpec:
             raise ValueError(f"at is 1-based, got {self.at}")
         if self.rate is not None and not 0.0 <= self.rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+        if self.latency_jitter_s < 0:
+            raise ValueError(f"latency_jitter_s must be >= 0, "
+                             f"got {self.latency_jitter_s}")
 
 
 class FaultPlan:
@@ -126,6 +141,11 @@ class FaultPlan:
         self._seen = [0] * len(self.specs)    # matching accesses per spec
         self._fired = [0] * len(self.specs)   # firings per spec
         self._rngs = {}                       # (spec_idx, worker_id) -> Random
+        # Decorrelated latency-jitter state: separate RNG stream and
+        # previous-draw memory per (spec, worker), so jitter draws never
+        # perturb the rate-decision sequences above.
+        self._jitter_rngs = {}
+        self._jitter_prev = {}
 
     # Counters/RNGs are per-process runtime state, not plan identity.
     def __getstate__(self):
@@ -153,7 +173,7 @@ class FaultPlan:
                 # A raising kind aborts the loop here, so later specs never
                 # see this access — same ordering a single-threaded walk of
                 # the spec list produces.
-                self._execute(spec, site, key)
+                self._execute(spec, site, key, idx, worker_id)
 
     def _should_fire(self, idx: int, spec: FaultSpec, site: str, key: str,
                      worker_id: int) -> bool:
@@ -177,14 +197,35 @@ class FaultPlan:
         self._fired[idx] += 1
         return True
 
-    def _execute(self, spec: FaultSpec, site: str, key: str) -> None:
+    def _latency_jitter(self, idx: int, spec: FaultSpec,
+                        worker_id: int) -> float:
+        """One decorrelated seeded jitter draw in ``(0, latency_jitter_s]``
+        (state mutates under the lock; the sleep itself happens outside)."""
+        jit = spec.latency_jitter_s
+        with self._lock:
+            k = (idx, worker_id)
+            rng = self._jitter_rngs.get(k)
+            if rng is None:
+                rng = self._jitter_rngs[k] = random.Random(
+                    f"{self.seed}:{idx}:{worker_id}:jitter")
+            prev = self._jitter_prev.get(k, jit / 3.0)
+            draw = min(jit, rng.uniform(jit / 10.0,
+                                        max(jit / 10.0, 3.0 * prev)))
+            self._jitter_prev[k] = draw
+        return draw
+
+    def _execute(self, spec: FaultSpec, site: str, key: str,
+                 idx: int = 0, worker_id: int = 0) -> None:
         detail = spec.message or f"injected {spec.kind} at {site} ({key})"
         if spec.kind == "ioerror":
             raise InjectedIOError(detail)
         if spec.kind == "corruption":
             raise InjectedCorruptionError(detail)
         if spec.kind == "latency":
-            time.sleep(spec.latency_s)
+            delay = spec.latency_s
+            if spec.latency_jitter_s > 0:
+                delay += self._latency_jitter(idx, spec, worker_id)
+            time.sleep(delay)
             return
         # worker_kill: hard SIGKILL, the crashed-decode-worker shape. Only
         # legal inside a spawned pool worker — anywhere else the "fault"

@@ -174,6 +174,13 @@ class WorkerCrashRecovery:
         if n and self._revent_counter is not None:
             self._revent_counter.add(n)
 
+    def claimed_workers(self) -> set:
+        """Worker ids currently holding an outstanding (claimed, not yet
+        processed) item. In a globally-stalled pipeline these are exactly
+        the stuck workers — the watchdog's kill-escalation target set."""
+        with self._lock:
+            return set(self._claims.values())
+
     @property
     def dead_workers(self) -> set:
         with self._lock:

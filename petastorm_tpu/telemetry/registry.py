@@ -11,6 +11,7 @@ for their format (Prometheus rewrites ``.`` to ``_``).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Callable, Dict, Optional, Sequence
 
 from petastorm_tpu.telemetry.histogram import StreamingHistogram
@@ -95,12 +96,19 @@ class TelemetryRegistry:
     """Get-or-create keyed metric store. All accessors are thread-safe and
     idempotent: the first caller fixes a histogram's bucket bounds."""
 
+    #: Events retained per event name (ring per name, so a chatty event —
+    #: per-straggler records — can never evict a rare one — a watchdog
+    #: stack dump).
+    EVENTS_PER_NAME = 16
+
     def __init__(self, span_capacity: int = 4096,
                  spans_enabled: bool = False):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, StreamingHistogram] = {}
+        self._events: Dict[str, deque] = {}
+        self._event_seq = 0
         self.recorder = SpanRecorder(capacity=span_capacity,
                                      enabled=spans_enabled)
 
@@ -134,14 +142,37 @@ class TelemetryRegistry:
         """Shortcut for ``registry.recorder.span(...)``."""
         return self.recorder.span(name, extra)
 
+    def record_event(self, name: str, payload: dict) -> None:
+        """Append one JSON-safe structured event under ``name`` (cold-path
+        provenance that fits neither a counter nor a histogram: watchdog
+        stack dumps, straggler records). Bounded: the newest
+        :data:`EVENTS_PER_NAME` per name are kept; each carries a
+        monotonically increasing ``seq`` so readers can tell how many were
+        dropped between snapshots."""
+        with self._lock:
+            q = self._events.get(name)
+            if q is None:
+                q = self._events[name] = deque(maxlen=self.EVENTS_PER_NAME)
+            self._event_seq += 1
+            q.append({"seq": self._event_seq, "payload": payload})
+
+    def events(self, name: Optional[str] = None):
+        """Retained events: ``{name: [event, ...]}``, or one name's list."""
+        with self._lock:
+            if name is not None:
+                return list(self._events.get(name, ()))
+            return {k: list(v) for k, v in sorted(self._events.items())}
+
     # ------------------------------------------------------------ readout
     def snapshot(self) -> dict:
-        """JSON-safe point-in-time view of every registered metric."""
+        """JSON-safe point-in-time view of every registered metric. The
+        ``events`` key is present only when events were recorded (the
+        common no-events snapshot keeps the original documented schema)."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
-        return {
+        snap = {
             "schema_version": SNAPSHOT_SCHEMA_VERSION,
             "counters": {k: round(c.value, 6)
                          for k, c in sorted(counters.items())},
@@ -150,6 +181,10 @@ class TelemetryRegistry:
                            for k, h in sorted(histograms.items())},
             "spans": self.recorder.aggregate(),
         }
+        events = self.events()
+        if events:
+            snap["events"] = events
+        return snap
 
     def reset(self) -> dict:
         """Zero counters/histograms and drain spans, returning the pre-reset
@@ -163,7 +198,9 @@ class TelemetryRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
-        return {
+            events = {k: list(v) for k, v in sorted(self._events.items())}
+            self._events.clear()
+        out = {
             "schema_version": SNAPSHOT_SCHEMA_VERSION,
             "counters": {k: round(c.reset(), 6)
                          for k, c in sorted(counters.items())},
@@ -172,3 +209,6 @@ class TelemetryRegistry:
                            for k, h in sorted(histograms.items())},
             "spans": SpanRecorder.aggregate_spans(self.recorder.drain()),
         }
+        if events:
+            out["events"] = events
+        return out

@@ -28,8 +28,16 @@ class DummyPool:
         self._worker = None
         self._ventilator = None
         self._stopped = False
+        self._abort_exc = None
         self._ventilated = 0
         self._processed = 0
+        #: Liveness stamp (item boundaries) for the pipeline watchdog. One
+        #: inline "worker": a single slot.
+        self.heartbeats = [0.0]
+        #: Optional StageDeadline (assigned by the Reader before start());
+        #: item-level soft overruns are counted around the inline decode.
+        self.stage_deadline = None
+        self._straggler = None
         # Pipeline telemetry registry (assigned by the owning Reader before
         # start()); decode runs inline so it is timed right here. The decode
         # histogram is resolved once and cached — per-item registry lookups
@@ -53,6 +61,12 @@ class DummyPool:
         if self._worker is not None:
             raise RuntimeError("DummyPool already started")
         self._worker = worker_class(0, self._publish, worker_args)
+        if self.stage_deadline is not None:
+            from petastorm_tpu.resilience.deadline import StragglerMonitor
+            self._straggler = StragglerMonitor(self.stage_deadline,
+                                               telemetry=self.telemetry,
+                                               scope="item",
+                                               site="pool.item")
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
@@ -66,6 +80,10 @@ class DummyPool:
 
     def get_results(self):
         while True:
+            # Watchdog abort outranks the stop poison pill: the consumer
+            # sees the hang diagnosis, not a silent end-of-data.
+            if self._abort_exc is not None:
+                raise self._abort_exc
             # stop() is a poison pill: consumers see end-of-data promptly.
             if self._stopped:
                 raise EmptyResultError()
@@ -83,11 +101,12 @@ class DummyPool:
                 return result
             if self._pending:
                 args, kwargs = self._pending.popleft()
+                self.heartbeats[0] = time.monotonic()
+                t0 = time.perf_counter()
                 if self.telemetry is not None:
                     if self._decode_hist is None:
                         self._decode_hist = self.telemetry.histogram(
                             "worker.decode_s")
-                    t0 = time.perf_counter()
                     with self.telemetry.span("petastorm_tpu.worker_decode"):
                         self._process_item(args, kwargs)
                     dt = time.perf_counter() - t0
@@ -97,6 +116,10 @@ class DummyPool:
                     self._process_item(args, kwargs)
                 self._results.append(VentilatedItemProcessedMessage(
                     kwargs.get(ITEM_CONTEXT_KWARG)))
+                self.heartbeats[0] = time.monotonic()
+                if self._straggler is not None:
+                    self._straggler.observe(time.perf_counter() - t0,
+                                            worker_id=0)
                 continue
             if self._ventilator is None or self._ventilator.completed():
                 raise EmptyResultError()
@@ -115,6 +138,13 @@ class DummyPool:
         if self._ventilator:
             self._ventilator.stop()
         self._stopped = True
+
+    def abort(self, exc: BaseException):
+        """Watchdog escalation endpoint (limited reach here: work runs
+        inline in the consumer's own thread, so an in-flight wedged decode
+        only sees the abort once it returns to the poll loop)."""
+        self._abort_exc = exc
+        self.stop()
 
     def join(self):
         if self._worker is not None:

@@ -132,6 +132,7 @@ class ProcessPool:
         self._ventilated = 0
         self._processed = 0
         self._stopped = False
+        self._abort_exc = None
         # Pipeline telemetry registry (assigned by the owning Reader before
         # start()). Spawned workers cannot share it, so in-worker decode
         # time is not observable here — the consumer-side pool wait recorded
@@ -230,6 +231,10 @@ class ProcessPool:
     def get_results(self, timeout: float = None):
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            # Watchdog abort outranks the stop poison pill: the consumer
+            # sees the hang diagnosis, not a silent end-of-data.
+            if self._abort_exc is not None:
+                raise self._abort_exc
             # stop() is a poison pill: blocked consumers unblock promptly.
             if self._stopped:
                 raise EmptyResultError()
@@ -272,6 +277,29 @@ class ProcessPool:
             if isinstance(msg, _WorkerReady):
                 continue
             return msg
+
+    def abort(self, exc: BaseException):
+        """Watchdog escalation endpoint: fail the pipeline with ``exc`` —
+        a consumer blocked in :meth:`get_results` raises it promptly."""
+        self._abort_exc = exc
+        self.stop()
+
+    def kill_worker(self, worker_id: int) -> bool:
+        """Watchdog escalation: SIGKILL one stuck worker process. The
+        normal dead-PID sweep (:meth:`_check_processes_alive`) then treats
+        it exactly like an organic crash — with a recovery ledger attached,
+        its claimed row groups re-ventilate onto the survivors (the PR 2
+        claim protocol); without one, the pool fails fast. Returns whether
+        a live process was actually signalled."""
+        if not 0 <= worker_id < len(self._processes) or self._stopped:
+            return False
+        p = self._processes[worker_id]
+        if p.poll() is not None:
+            return False  # already dead
+        logger.warning("Killing stuck worker process %d (watchdog "
+                       "escalation)", worker_id)
+        p.kill()
+        return True
 
     def stop(self):
         if self._ventilator:

@@ -117,10 +117,17 @@ class ConcurrencyGate:
             self._cv.notify_all()
             return True
 
+    def nudge(self) -> None:
+        """Watchdog hook: wake every parked waiter in case the stall is a
+        lost wakeup (harmless when it isn't — waiters re-check and park)."""
+        with self._cv:
+            self._cv.notify_all()
+
 
 class _WorkerThread(threading.Thread):
     def __init__(self, worker_impl, input_queue, result_queue, stop_event,
-                 put_fn, prof=None, telemetry=None, gate=None):
+                 put_fn, prof=None, telemetry=None, gate=None,
+                 heartbeats=None, straggler=None):
         super().__init__(name=f"pt-worker-{worker_impl.worker_id}", daemon=True)
         self._worker_impl = worker_impl
         self._input_queue = input_queue
@@ -128,12 +135,24 @@ class _WorkerThread(threading.Thread):
         self._stop_event = stop_event
         self._put = put_fn
         self._gate = gate
+        # Liveness signal for the pipeline watchdog: stamped when this
+        # worker takes an item and when it completes one, so "no heartbeat
+        # motion anywhere" distinguishes a wedged decode from an idle pool.
+        self._heartbeats = heartbeats
+        # Pool-level (whole-item) soft-deadline accounting — covers decode
+        # PLUS result-queue backpressure, complementing the worker impl's
+        # per-attempt enforcement.
+        self._straggler = straggler
         self.prof = prof  # per-worker cProfile; pre-3.12 only (see ThreadPool)
         # Shared pipeline registry (set by the reader through the pool):
         # in-worker decode time is only observable from inside the worker.
         self._decode_hist = (telemetry.histogram("worker.decode_s")
                              if telemetry is not None else None)
         self._telemetry = telemetry
+
+    def _beat(self):
+        if self._heartbeats is not None:
+            self._heartbeats[self._worker_impl.worker_id] = time.monotonic()
 
     def run(self):
         # ANY exit path that isn't an explicit stop must surface to the
@@ -170,9 +189,10 @@ class _WorkerThread(threading.Thread):
             # holds; a stop while parked drops the item like any other stop.
             if self._gate is not None and not self._gate.acquire(self._stop_event):
                 return
+            self._beat()
+            t0 = time.perf_counter()
             try:
                 if self._decode_hist is not None:
-                    t0 = time.perf_counter()
                     with self._telemetry.span("petastorm_tpu.worker_decode"):
                         self._process_item(args, kwargs)
                     self._decode_hist.observe(time.perf_counter() - t0)
@@ -183,6 +203,10 @@ class _WorkerThread(threading.Thread):
                     self._gate.release()
             self._put(VentilatedItemProcessedMessage(
                 kwargs.get(ITEM_CONTEXT_KWARG)))
+            self._beat()
+            if self._straggler is not None:
+                self._straggler.observe(time.perf_counter() - t0,
+                                        worker_id=self._worker_impl.worker_id)
 
     def _process_item(self, args, kwargs):
         try:
@@ -220,6 +244,7 @@ class ThreadPool:
         self._prof = None
         self._strict_order = not (shuffle_rows and seed is None)
         self._stop_event = threading.Event()
+        self._abort_exc = None
         self._workers = []
         self._input_queues = []
         self._result_queues = []
@@ -239,6 +264,13 @@ class ThreadPool:
         #: round-trip per row group, noise next to a decode), actuated only
         #: when the owning Reader enables autotune.
         self.concurrency_gate = ConcurrencyGate(workers_count)
+        #: Per-worker liveness stamps (monotonic seconds, updated at item
+        #: boundaries) — the watchdog's progress/attribution signal.
+        self.heartbeats = [0.0] * workers_count
+        #: Optional :class:`~petastorm_tpu.resilience.StageDeadline`
+        #: (assigned by the Reader before start()): item-level soft-overrun
+        #: accounting happens in the worker loop.
+        self.stage_deadline = None
 
     # ------------------------------------------------------------------ api
     def start(self, worker_class, worker_args=None, ventilator=None):
@@ -246,6 +278,12 @@ class ThreadPool:
             raise RuntimeError("A ThreadPool cannot be restarted after stop()")
         if self._workers:
             raise RuntimeError("ThreadPool already started")
+        straggler = None
+        if self.stage_deadline is not None:
+            from petastorm_tpu.resilience.deadline import StragglerMonitor
+            straggler = StragglerMonitor(self.stage_deadline,
+                                         telemetry=self.telemetry,
+                                         scope="item", site="pool.item")
         for i in range(self.workers_count):
             in_q = queue.Queue()
             out_q = queue.Queue(maxsize=self._results_queue_size)
@@ -257,7 +295,9 @@ class ThreadPool:
             self._workers.append(_WorkerThread(worker, in_q, out_q, self._stop_event,
                                                self._make_put(i), per_worker_prof,
                                                telemetry=self.telemetry,
-                                               gate=self.concurrency_gate))
+                                               gate=self.concurrency_gate,
+                                               heartbeats=self.heartbeats,
+                                               straggler=straggler))
         if self._profiling_enabled and sys.version_info >= (3, 12):
             self._prof = cProfile.Profile()
             try:
@@ -320,6 +360,8 @@ class ThreadPool:
         """
         empty_sweeps = 0
         while True:
+            if self._abort_exc is not None:
+                raise self._abort_exc
             if self._stop_event.is_set():
                 raise EmptyResultError()
             if all(self._worker_drained(i) for i in range(self.workers_count)):
@@ -370,10 +412,32 @@ class ThreadPool:
             self._ventilator.stop()
         self._stop_event.set()
 
+    def abort(self, exc: BaseException):
+        """Watchdog escalation endpoint: fail the pipeline with ``exc`` —
+        a consumer blocked in :meth:`get_results` raises it promptly
+        instead of EmptyResultError, and teardown proceeds as a stop."""
+        self._abort_exc = exc
+        self.stop()
+
+    def nudge(self):
+        """Watchdog hook: wake any lost-wakeup parkers (admission gate)."""
+        self.concurrency_gate.nudge()
+
     def join(self):
         for w in self._workers:
             if w.is_alive():
-                w.join()
+                if self._abort_exc is not None:
+                    # The pipeline was declared hung: a wedged worker thread
+                    # may never exit — bound the wait so "never blocks
+                    # indefinitely" extends to teardown (daemon threads die
+                    # with the process).
+                    w.join(timeout=5.0)
+                    if w.is_alive():
+                        logger.warning(
+                            "Worker thread %s still wedged after abort; "
+                            "abandoning it (daemon)", w.name)
+                else:
+                    w.join()
         if self._prof is not None:  # 3.12+: one pool-level profile
             self._prof.disable()
             pstats.Stats(self._prof).sort_stats("cumulative").print_stats()

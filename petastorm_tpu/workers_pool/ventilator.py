@@ -179,6 +179,13 @@ class ConcurrentVentilator(Ventilator):
             self._max_inflight = max(1, int(n))
             self._inflight_cv.notify_all()
 
+    def nudge(self) -> None:
+        """Watchdog hook: wake the ventilation thread in case its stall is
+        a lost backpressure wakeup (harmless otherwise — it re-checks the
+        in-flight cap and parks again)."""
+        with self._inflight_cv:
+            self._inflight_cv.notify_all()
+
     def completed(self) -> bool:
         # A stopped ventilator will never ventilate again: report completed
         # so consumers drain and raise EmptyResultError instead of spinning

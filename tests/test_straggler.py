@@ -1,0 +1,728 @@
+"""Straggler & hang defense tests: per-stage deadlines, hedged row-group
+reads, the pipeline watchdog, seeded latency jitter, the timeout lint, and
+the e2e acceptance scenarios — a hedged read wins a race against an
+injected straggler with a byte-identical seeded epoch, and a deliberately
+wedged worker is detected, stack-dumped, and surfaced as
+``PipelineHungError`` (or recovered via the claim protocol) instead of
+blocking forever."""
+import importlib.util
+import os
+import pickle
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.jax.loader import _get_staged
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.resilience import (CancellationToken, ExponentialBackoff,
+                                      FaultPlan, FaultSpec,
+                                      HedgedReadExecutor, HedgePolicy,
+                                      PipelineHungError, PipelineWatchdog,
+                                      RetryPolicy, StageDeadline,
+                                      StageDeadlineExceeded, StragglerMonitor,
+                                      TRANSIENT, default_io_classifier,
+                                      dump_thread_stacks)
+from petastorm_tpu.telemetry import TelemetryRegistry
+from petastorm_tpu.transform import TransformSpec
+
+pytestmark = pytest.mark.straggler
+
+#: Zero-delay retry policy: full retry semantics, no wall-clock sleeps.
+FAST = RetryPolicy(max_attempts=3,
+                   backoff=ExponentialBackoff(base=0.0, multiplier=1.0,
+                                              cap=0.0),
+                   jitter="none", seed=0)
+
+
+def _wait_until(cond, timeout_s=5.0, interval_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# StageDeadline / DeadlineTimer / StragglerMonitor
+# ---------------------------------------------------------------------------
+class TestStageDeadline:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="soft_s"):
+            StageDeadline(soft_s=-1)
+        with pytest.raises(ValueError, match="must not exceed"):
+            StageDeadline(soft_s=2.0, hard_s=1.0)
+        with pytest.raises(ValueError, match="soft_s and/or hard_s"):
+            StageDeadline()
+
+    def test_from_arg_shapes(self):
+        assert StageDeadline.from_arg(None) is None
+        d = StageDeadline.from_arg(1.0)
+        assert d.soft_s == 0.5 and d.hard_s == 1.0
+        explicit = StageDeadline(soft_s=0.1, hard_s=3.0)
+        assert StageDeadline.from_arg(explicit) is explicit
+
+    def test_is_picklable(self):
+        d = pickle.loads(pickle.dumps(StageDeadline(soft_s=0.5, hard_s=2.0)))
+        assert d.soft_s == 0.5 and d.hard_s == 2.0
+
+    def test_fast_attempt_passes(self):
+        timer = StageDeadline(soft_s=1.0, hard_s=5.0).start()
+        elapsed = timer.finish()
+        assert elapsed < 1.0 and not timer.soft_exceeded
+
+    def test_hard_overrun_cancels_attempt(self):
+        timer = StageDeadline(hard_s=0.005).start()
+        time.sleep(0.02)
+        with pytest.raises(StageDeadlineExceeded, match="hard stage deadline"):
+            timer.finish()
+
+    def test_exceeded_is_transient(self):
+        # The cancelled attempt must reach the retry/quarantine machinery.
+        assert default_io_classifier(StageDeadlineExceeded("x")) == TRANSIENT
+
+    def test_cancel_token_checkpoint_is_edge_triggered(self):
+        token = CancellationToken()
+        timer = StageDeadline(hard_s=60.0).start(token)
+        timer.check()                     # armed, no request: fine
+        token.request("test hang")
+        with pytest.raises(StageDeadlineExceeded, match="watchdog"):
+            timer.check()
+        # A retry armed AFTER the request gets a clean slate — a single
+        # cancel request must not insta-fail every subsequent attempt.
+        retry_timer = StageDeadline(hard_s=60.0).start(token)
+        retry_timer.check()
+        token.request("second hang")      # a NEWER request cancels it
+        with pytest.raises(StageDeadlineExceeded):
+            retry_timer.check()
+
+    def test_cancellation_only_timer_without_deadline(self):
+        # hang_timeout_s without stage_deadline_s: checkpoints still
+        # consult the token, with no latency budget attached.
+        from petastorm_tpu.resilience import DeadlineTimer
+        token = CancellationToken()
+        timer = DeadlineTimer(None, token)
+        timer.check()
+        assert not timer.soft_exceeded
+        token.request("hang")
+        with pytest.raises(StageDeadlineExceeded):
+            timer.check()
+
+    def test_straggler_monitor_counts_and_event(self):
+        reg = TelemetryRegistry()
+        mon = StragglerMonitor(StageDeadline(soft_s=0.01),
+                               telemetry=reg, site="worker.attempt")
+        assert not mon.observe(0.005)
+        assert mon.observe(0.03, key="/d/p.parquet", worker_id=2)
+        snap = reg.snapshot()
+        assert snap["counters"]["resilience.stragglers_total"] == 1
+        assert snap["histograms"]["resilience.straggler_overrun_s"]["count"] == 1
+        [event] = snap["events"]["resilience.straggler"]
+        assert event["payload"]["worker_id"] == 2
+        assert event["payload"]["site"] == "worker.attempt"
+
+    def test_item_scope_uses_separate_counter(self):
+        reg = TelemetryRegistry()
+        mon = StragglerMonitor(StageDeadline(soft_s=0.01), telemetry=reg,
+                               scope="item", site="pool.item")
+        mon.observe(1.0)
+        counters = reg.snapshot()["counters"]
+        assert counters["resilience.item_stragglers_total"] == 1
+        assert counters.get("resilience.stragglers_total", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Hedged reads
+# ---------------------------------------------------------------------------
+class TestHedgePolicy:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="quantile"):
+            HedgePolicy(quantile=1.5)
+        with pytest.raises(ValueError, match="fallback_delay_s"):
+            HedgePolicy(fallback_delay_s=0)
+        with pytest.raises(ValueError, match="min_delay_s"):
+            HedgePolicy(min_delay_s=2.0, max_delay_s=1.0)
+        with pytest.raises(ValueError, match="max_concurrent"):
+            HedgePolicy(max_concurrent=-1)
+
+    def test_is_picklable(self):
+        p = pickle.loads(pickle.dumps(HedgePolicy(quantile=0.9)))
+        assert p.quantile == 0.9
+
+
+#: Never-track policy: the static fallback delay applies on every read.
+def _policy(delay_s=0.02, **kw):
+    kw.setdefault("min_samples", 10 ** 9)
+    return HedgePolicy(fallback_delay_s=delay_s, min_delay_s=0.001, **kw)
+
+
+class TestHedgedReadExecutor:
+    def test_fast_primary_wins_without_hedge(self):
+        reg = TelemetryRegistry()
+        ex = HedgedReadExecutor(_policy(), telemetry=reg)
+        assert ex.read(lambda c: "primary", lambda c: "hedge") == "primary"
+        counters = reg.snapshot()["counters"]
+        assert counters["resilience.hedges_launched"] == 0
+        # Un-hedged completion feeds the latency histogram.
+        assert reg.snapshot()["histograms"][
+            "resilience.read_latency_s"]["count"] == 1
+
+    def test_slow_primary_loses_to_hedge(self):
+        reg = TelemetryRegistry()
+        ex = HedgedReadExecutor(_policy(), telemetry=reg)
+        out = ex.read(lambda c: (time.sleep(0.3), "slow")[1],
+                      lambda c: "fast")
+        assert out == "fast"
+        counters = reg.snapshot()["counters"]
+        assert counters["resilience.hedges_launched"] == 1
+        assert counters["resilience.hedge_wins"] == 1
+        # Hedged reads are censored: the histogram must NOT learn from them
+        # (a hedge-everything feedback loop otherwise).
+        assert reg.snapshot()["histograms"][
+            "resilience.read_latency_s"]["count"] == 0
+
+    def test_primary_can_still_win_the_race(self):
+        reg = TelemetryRegistry()
+        ex = HedgedReadExecutor(_policy(0.01), telemetry=reg)
+        out = ex.read(lambda c: (time.sleep(0.05), "primary")[1],
+                      lambda c: (time.sleep(10), "hedge")[1])
+        assert out == "primary"
+        assert reg.snapshot()["counters"]["resilience.primary_wins"] == 1
+
+    def test_winner_sets_loser_cancel_event(self):
+        seen = {}
+
+        def hedge(cancel):
+            seen["cancel"] = cancel
+            return "fast"
+
+        ex = HedgedReadExecutor(_policy())
+        assert ex.read(lambda c: (time.sleep(0.3), "slow")[1], hedge) == "fast"
+        assert seen["cancel"].is_set()  # loser told to stand down
+
+    def test_fast_primary_failure_raises_immediately(self):
+        # Retries belong to the RowGroupGuard, not the hedger.
+        ex = HedgedReadExecutor(_policy())
+        t0 = time.monotonic()
+        with pytest.raises(IOError, match="boom"):
+            ex.read(lambda c: (_ for _ in ()).throw(IOError("boom")),
+                    lambda c: "never")
+        assert time.monotonic() - t0 < 1.0
+        assert ex.local_stats["hedges_launched"] == 0
+
+    def test_slow_failing_primary_defers_to_hedge(self):
+        def slow_fail(_c):
+            time.sleep(0.1)
+            raise IOError("primary died late")
+
+        ex = HedgedReadExecutor(_policy(0.01))
+        assert ex.read(slow_fail, lambda c: "hedge") == "hedge"
+
+    def test_both_failing_raises_first_error(self):
+        def slow_fail(_c):
+            time.sleep(0.05)
+            raise IOError("first")
+
+        def hedge_fail(_c):
+            raise ValueError("second")
+
+        ex = HedgedReadExecutor(_policy(0.01))
+        with pytest.raises((IOError, ValueError)):
+            ex.read(slow_fail, hedge_fail)
+
+    def test_no_spare_slot_skips_hedging(self):
+        ex = HedgedReadExecutor(_policy(0.01, max_concurrent=0))
+        out = ex.read(lambda c: (time.sleep(0.05), "primary")[1],
+                      lambda c: "hedge")
+        assert out == "primary"
+        assert ex.local_stats["hedges_launched"] == 0
+
+    def test_delay_tracks_quantile_with_fallback_and_clamp(self):
+        reg = TelemetryRegistry()
+        policy = HedgePolicy(fallback_delay_s=0.5, min_delay_s=0.01,
+                             max_delay_s=1.0, min_samples=10)
+        ex = HedgedReadExecutor(policy, telemetry=reg)
+        assert ex.current_delay() == 0.5       # no samples: static fallback
+        hist = reg.histogram("resilience.read_latency_s")
+        for _ in range(20):
+            hist.observe(0.002)                # p95 below the clamp floor
+        assert ex.current_delay() == 0.01
+        for _ in range(200):
+            hist.observe(30.0)                 # p95 above the clamp ceiling
+        assert ex.current_delay() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan latency jitter
+# ---------------------------------------------------------------------------
+class TestLatencyJitter:
+    def _sleep_sequence(self, seed, n=6):
+        import petastorm_tpu.resilience.faults as faults_mod
+        plan = FaultPlan([FaultSpec(site="s", kind="latency", rate=1.0,
+                                    latency_s=0.01, latency_jitter_s=0.1)],
+                         seed=seed)
+        slept = []
+        real_sleep = faults_mod.time.sleep
+        faults_mod.time.sleep = slept.append
+        try:
+            for _ in range(n):
+                plan.fire("s")
+        finally:
+            faults_mod.time.sleep = real_sleep
+        return slept
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="latency_jitter_s"):
+            FaultSpec(site="s", kind="latency", at=1, latency_jitter_s=-0.1)
+
+    def test_jitter_is_seeded_and_decorrelated(self):
+        a, b = self._sleep_sequence(seed=1), self._sleep_sequence(seed=1)
+        assert a == b                          # byte-reproducible
+        assert self._sleep_sequence(seed=2) != a
+        assert len(set(a)) > 1                 # actually varies per injection
+        eps = 1e-9
+        assert all(0.01 < d <= 0.11 + eps for d in a)  # latency_s + (0, jit]
+
+    def test_jitter_stream_does_not_shift_rate_decisions(self):
+        def decisions(jitter):
+            plan = FaultPlan([FaultSpec(site="s", kind="latency", rate=0.4,
+                                        latency_s=0.0,
+                                        latency_jitter_s=jitter)], seed=9)
+            fired = []
+            for _ in range(60):
+                before = plan.stats()["specs"][0]["fired"]
+                plan.fire("s")
+                fired.append(plan.stats()["specs"][0]["fired"] - before)
+            return fired
+
+        assert decisions(0.0) == decisions(0.5)
+
+    def test_no_jitter_sleeps_exact_base(self):
+        import petastorm_tpu.resilience.faults as faults_mod
+        plan = FaultPlan([FaultSpec(site="s", kind="latency", at=1,
+                                    latency_s=0.03)])
+        slept = []
+        real_sleep = faults_mod.time.sleep
+        faults_mod.time.sleep = slept.append
+        try:
+            plan.fire("s")
+        finally:
+            faults_mod.time.sleep = real_sleep
+        assert slept == [0.03]
+
+
+# ---------------------------------------------------------------------------
+# Registry events
+# ---------------------------------------------------------------------------
+class TestRegistryEvents:
+    def test_events_appear_in_snapshot_only_when_recorded(self):
+        reg = TelemetryRegistry()
+        assert "events" not in reg.snapshot()  # documented base schema
+        reg.record_event("e", {"k": 1})
+        snap = reg.snapshot()
+        assert snap["events"]["e"][0]["payload"] == {"k": 1}
+
+    def test_per_name_rings_do_not_evict_each_other(self):
+        reg = TelemetryRegistry()
+        reg.record_event("rare", {"important": True})
+        for i in range(5 * TelemetryRegistry.EVENTS_PER_NAME):
+            reg.record_event("chatty", {"i": i})
+        events = reg.events()
+        assert len(events["chatty"]) == TelemetryRegistry.EVENTS_PER_NAME
+        assert len(events["rare"]) == 1        # survived the chatter
+        # seq exposes the drop count between retained events
+        assert events["chatty"][-1]["seq"] > events["chatty"][0]["seq"]
+
+    def test_reset_drains_events(self):
+        reg = TelemetryRegistry()
+        reg.record_event("e", {"k": 1})
+        assert reg.reset()["events"]["e"][0]["payload"] == {"k": 1}
+        assert reg.events() == {}
+
+    def test_dump_thread_stacks_sees_this_thread(self):
+        dump = dump_thread_stacks()
+        assert any("test_dump_thread_stacks" in "".join(frames)
+                   for frames in dump.values())
+
+
+# ---------------------------------------------------------------------------
+# Watchdog unit level (fake pool)
+# ---------------------------------------------------------------------------
+class _FakePool:
+    def __init__(self):
+        self.diagnostics = {"items_ventilated": 4, "items_processed": 2,
+                            "output_queue_size": 0}
+        self.heartbeats = [10.0, 20.0]
+        self.nudged = 0
+        self.killed = []
+        self.aborted = None
+
+    def nudge(self):
+        self.nudged += 1
+
+    def kill_worker(self, wid):
+        self.killed.append(wid)
+        return True
+
+    def abort(self, exc):
+        self.aborted = exc
+
+
+def _watchdog(pool, **kw):
+    kw.setdefault("hang_timeout_s", 0.15)
+    kw.setdefault("interval_s", 0.02)
+    kw.setdefault("escalation_interval_s", 0.04)
+    return PipelineWatchdog(pool, **kw)
+
+
+class TestPipelineWatchdog:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="hang_timeout_s"):
+            PipelineWatchdog(_FakePool(), hang_timeout_s=0)
+
+    def test_full_ladder_ends_in_abort(self):
+        pool = _FakePool()
+        reg = TelemetryRegistry()
+        token = CancellationToken()
+        wd = _watchdog(pool, telemetry=reg, cancel_token=token).start()
+        try:
+            wd.enter_wait()
+            assert _wait_until(lambda: pool.aborted is not None, 3.0)
+        finally:
+            wd.stop()
+        assert isinstance(pool.aborted, PipelineHungError)
+        assert pool.nudged >= 1                       # rung 1
+        assert token.requested                        # rung 2
+        snap = reg.snapshot()
+        assert snap["counters"]["resilience.hangs_detected"] == 1
+        assert snap["counters"]["resilience.watchdog_aborts"] == 1
+        [event] = snap["events"]["resilience.watchdog.stack_dump"]
+        assert "petastorm-tpu-watchdog" in event["payload"]["threads"]
+        report = wd.report()
+        assert report["aborted"] and report["last_stack_dump"]
+
+    def test_not_waiting_consumer_never_trips(self):
+        pool = _FakePool()
+        wd = _watchdog(pool).start()
+        try:
+            time.sleep(0.5)  # static signature, but nobody is starving
+        finally:
+            wd.stop()
+        assert pool.aborted is None
+
+    def test_progress_resets_the_ladder(self):
+        pool = _FakePool()
+        reg = TelemetryRegistry()
+        wd = _watchdog(pool, telemetry=reg).start()
+        try:
+            wd.enter_wait()
+            deadline = time.monotonic() + 0.6
+            while time.monotonic() < deadline:     # keep making "progress"
+                pool.diagnostics["items_processed"] += 1
+                time.sleep(0.02)
+        finally:
+            wd.stop()
+        assert pool.aborted is None
+        assert reg.snapshot()["counters"].get(
+            "resilience.hangs_detected", 0) == 0
+
+    def test_exit_wait_disarms(self):
+        pool = _FakePool()
+        wd = _watchdog(pool).start()
+        try:
+            wd.enter_wait()
+            time.sleep(0.05)
+            wd.exit_wait()                         # result delivered
+            time.sleep(0.4)
+        finally:
+            wd.stop()
+        assert pool.aborted is None
+
+    def test_recovery_kill_rung_targets_claimed_workers(self):
+        pool = _FakePool()
+        recovery = SimpleNamespace(claimed_workers=lambda: {0, 1},
+                                   dead_workers={0})
+        reg = TelemetryRegistry()
+        wd = _watchdog(pool, telemetry=reg, recovery=recovery).start()
+        try:
+            wd.enter_wait()
+            assert _wait_until(lambda: pool.killed, 3.0)
+        finally:
+            wd.stop()
+        assert pool.killed == [1]                  # dead worker 0 skipped
+        assert reg.snapshot()["counters"]["resilience.watchdog_kills"] == 1
+
+    def test_recovery_after_detection_counts(self):
+        pool = _FakePool()
+        reg = TelemetryRegistry()
+        wd = _watchdog(pool, telemetry=reg).start()
+        try:
+            wd.enter_wait()
+            assert _wait_until(
+                lambda: reg.snapshot()["counters"].get(
+                    "resilience.hangs_detected", 0) >= 1, 3.0)
+            pool.diagnostics["items_processed"] += 1   # pipeline revives
+            assert _wait_until(
+                lambda: reg.snapshot()["counters"].get(
+                    "resilience.hang_recoveries", 0) >= 1, 3.0)
+        finally:
+            wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loader staged-queue liveness (the unbounded q.get() fix)
+# ---------------------------------------------------------------------------
+class TestLoaderStagedGet:
+    def test_returns_items_and_outlives_slow_producer(self):
+        import queue
+        q = queue.Queue()
+        t = threading.Thread(
+            target=lambda: (time.sleep(0.05), q.put("item")), daemon=True)
+        t.start()
+        assert _get_staged(q, t, poll_s=0.01) == "item"
+        t.join()
+
+    def test_dead_thread_with_empty_queue_raises(self):
+        import queue
+        q = queue.Queue()
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()                                   # died without a sentinel
+        with pytest.raises(PipelineHungError, match="staging thread died"):
+            _get_staged(q, t, poll_s=0.01)
+
+    def test_dead_thread_with_queued_item_still_drains(self):
+        import queue
+        q = queue.Queue()
+        q.put("last")
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        assert _get_staged(q, t, poll_s=0.01) == "last"
+
+
+# ---------------------------------------------------------------------------
+# tools/check_timeouts.py lint
+# ---------------------------------------------------------------------------
+def _load_check_timeouts():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "check_timeouts.py")
+    spec = importlib.util.spec_from_file_location("check_timeouts", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCheckTimeoutsLint:
+    @pytest.fixture(scope="class")
+    def lint(self):
+        return _load_check_timeouts()
+
+    def _violations(self, lint, tmp_path, code):
+        f = tmp_path / "mod.py"
+        f.write_text(code)
+        return lint.check_file(str(f))
+
+    @pytest.mark.parametrize("code", [
+        "q.get()\n",
+        "q.get(True)\n",
+        "q.get(block=True)\n",
+        "sock.recv()\n",
+        "event.wait()\n",
+    ])
+    def test_flags_unbounded_waits(self, lint, tmp_path, code):
+        assert len(self._violations(lint, tmp_path, code)) == 1
+
+    @pytest.mark.parametrize("code", [
+        "d.get('key')\n",                      # dict.get
+        "d.get('key', None)\n",
+        "q.get(timeout=1.0)\n",
+        "q.get(True, 0.5)\n",                  # positional timeout
+        "q.get_nowait()\n",
+        "q.get(block=False)\n",
+        "event.wait(0.1)\n",
+        "event.wait(timeout=0.1)\n",
+        "sock.recv(1024)\n",
+        "proc.wait(10)\n",
+        "get()\n",                             # bare call: not a queue
+    ])
+    def test_ignores_bounded_and_nonblocking_shapes(self, lint, tmp_path,
+                                                    code):
+        assert self._violations(lint, tmp_path, code) == []
+
+    def test_waiver_comment(self, lint, tmp_path):
+        code = "q.get()  # timeout-ok: producer liveness checked upstream\n"
+        assert self._violations(lint, tmp_path, code) == []
+
+    def test_repo_is_clean(self, lint):
+        assert lint.main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end acceptance scenarios
+# ---------------------------------------------------------------------------
+_FIELDS = ["id", "matrix", "image_png"]
+
+
+def _collect(reader):
+    """Delivered samples in delivery order, as comparable tuples."""
+    return [tuple(np.asarray(getattr(s, f)).tobytes() for f in _FIELDS)
+            for s in reader]
+
+
+class TestEndToEndStraggler:
+    def test_hedged_read_wins_and_epoch_is_byte_identical(self,
+                                                          synthetic_dataset):
+        """An injected 0.5s straggler on the first row-group read: the
+        hedged duplicate (launched after 20ms) wins the race, and the
+        seeded epoch's sample stream is byte-identical to the unhedged
+        run — straggler masking may not perturb determinism."""
+        kwargs = dict(schema_fields=_FIELDS, reader_pool_type="thread",
+                      workers_count=2, shuffle_row_groups=True, seed=3,
+                      num_epochs=1)
+        with make_reader(synthetic_dataset.url, **kwargs) as reader:
+            baseline = _collect(reader)
+
+        plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="latency",
+                                    at=1, latency_s=0.5)], seed=0)
+        hedge = HedgePolicy(fallback_delay_s=0.02, min_delay_s=0.005,
+                            min_samples=10 ** 9)
+        t0 = time.monotonic()
+        with make_reader(synthetic_dataset.url, fault_plan=plan,
+                         hedge_policy=hedge, **kwargs) as reader:
+            hedged = _collect(reader)
+            counters = reader.telemetry.snapshot()["counters"]
+        elapsed = time.monotonic() - t0
+
+        assert hedged == baseline              # byte-identical seeded epoch
+        assert counters["resilience.hedges_launched"] >= 1
+        assert counters["resilience.hedge_wins"] >= 1
+        # The hedge masked the 0.5s injected straggler; without it the
+        # epoch serializes behind the sleep. Generous bound: the epoch
+        # only has to beat the full injected latency by a wide margin.
+        assert elapsed < 10.0
+
+    def test_soft_deadline_counts_stragglers_losslessly(self,
+                                                        synthetic_dataset):
+        """Soft-only budget: injected 30ms stragglers are counted (worker
+        attempts AND pool items) but every row still arrives."""
+        plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="latency",
+                                    rate=1.0, latency_s=0.03)], seed=0)
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         reader_pool_type="thread", workers_count=2,
+                         shuffle_row_groups=False, fault_plan=plan,
+                         stage_deadline_s=StageDeadline(soft_s=0.005)
+                         ) as reader:
+            ids = sorted(int(s.id) for s in reader)
+            counters = reader.telemetry.snapshot()["counters"]
+        assert ids == list(range(100))
+        assert counters["resilience.stragglers_total"] >= 10
+        assert counters["resilience.item_stragglers_total"] >= 10
+        assert reader.quarantine_report()["quarantined"] == 0
+
+    def test_hard_deadline_quarantines_permanently_slow_rowgroups(
+            self, synthetic_dataset):
+        """One file's reads always straggle past the hard budget: each
+        attempt is cancelled (StageDeadlineExceeded), retries exhaust, and
+        degraded mode quarantines exactly that file's row groups — the
+        epoch's latency is bounded and the rest arrives intact."""
+        import glob
+        slow = os.path.basename(sorted(glob.glob(
+            os.path.join(synthetic_dataset.path, "*.parquet")))[0])
+        plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="latency",
+                                    rate=1.0, latency_s=0.05,
+                                    key_substring=slow)], seed=0)
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         reader_pool_type="thread", workers_count=2,
+                         shuffle_row_groups=False, retry_policy=FAST,
+                         degraded_mode=True, fault_plan=plan,
+                         stage_deadline_s=StageDeadline(hard_s=0.01)
+                         ) as reader:
+            ids = sorted(int(s.id) for s in reader)
+            report = reader.quarantine_report()
+        assert len(ids) == 80 and len(set(ids)) == 80
+        assert report["quarantined"] == 2      # both row groups of the file
+        assert all(slow in p["path"] for p in report["pieces"])
+        assert all(p["error_type"] == "StageDeadlineExceeded"
+                   and p["attempts"] == FAST.max_attempts
+                   for p in report["pieces"])
+
+    def test_wedged_worker_raises_pipeline_hung_error(self,
+                                                      synthetic_dataset):
+        """A decode worker wedges on a lock (transform blocked on an
+        Event): the watchdog detects the starved consumer, records a
+        stack snapshot, and raises PipelineHungError instead of blocking
+        the training loop forever."""
+        unwedge = threading.Event()
+
+        def wedge(row):
+            if row["id"] == 0:
+                unwedge.wait(30)  # bounded so CI can never truly hang
+            return row
+
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(PipelineHungError, match="no progress"):
+                with make_reader(synthetic_dataset.url,
+                                 schema_fields=["id"],
+                                 reader_pool_type="thread", workers_count=2,
+                                 shuffle_row_groups=False,
+                                 transform_spec=TransformSpec(wedge),
+                                 hang_timeout_s=0.4) as reader:
+                    try:
+                        for _ in reader:
+                            pass
+                    finally:
+                        elapsed = time.monotonic() - t0
+                        report = reader.watchdog_report()
+                        events = reader.telemetry.events(
+                            "resilience.watchdog.stack_dump")
+        finally:
+            unwedge.set()                      # free the wedged thread
+        assert elapsed < 15.0                  # raised, not blocked
+        assert report["hangs_detected"] >= 1
+        assert report["last_stack_dump"]
+        assert events and "threads" in events[0]["payload"]
+
+    def test_watchdog_report_empty_when_disabled(self, synthetic_dataset):
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         reader_pool_type="dummy",
+                         shuffle_row_groups=False) as reader:
+            next(reader)
+            assert reader.watchdog_report() == {}
+            assert reader.watchdog is None
+
+    @pytest.mark.process_pool
+    def test_watchdog_kills_stuck_process_worker_and_epoch_recovers(
+            self, synthetic_dataset):
+        """A spawned worker wedges for 600s on its first item: the
+        watchdog's kill rung SIGKILLs it, the PR 2 claim protocol
+        re-ventilates its row groups onto the survivor, and the epoch
+        completes losslessly — recovery, not abort."""
+        plan = FaultPlan([FaultSpec(site="worker.item", kind="latency",
+                                    at=1, worker=0, latency_s=600.0)],
+                         seed=0)
+        with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                         reader_pool_type="process", workers_count=2,
+                         shuffle_row_groups=False, fault_plan=plan,
+                         worker_crash_budget=1,
+                         hang_timeout_s=3.0) as reader:
+            ids = sorted(int(s.id) for s in reader)
+            counters = reader.telemetry.snapshot()["counters"]
+        assert ids == list(range(100))         # lossless AND duplicate-free
+        assert counters["resilience.watchdog_kills"] >= 1
+        assert counters["resilience.worker_crashes"] == 1
+        assert counters["resilience.reventilated_items"] >= 1
+
+
+class TestReaderKwargValidation:
+    def test_bad_hedge_policy_type(self, synthetic_dataset):
+        with pytest.raises(TypeError, match="HedgePolicy"):
+            make_reader(synthetic_dataset.url, hedge_policy=object())
+
+    def test_bad_hang_timeout(self, synthetic_dataset):
+        with pytest.raises(ValueError, match="hang_timeout_s"):
+            make_reader(synthetic_dataset.url, hang_timeout_s=-1)

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Lint guard: no unbounded blocking waits outside ``workers_pool/``.
+
+The hang post-mortems all share one AST shape: a ``Queue.get()``, pipe/
+socket ``.recv()``, or ``Event``/``Condition`` ``.wait()`` with **no
+timeout** — a call that blocks forever when its producer dies or wedges
+(the ``q.get()`` that could hang a training step in jax/loader.py was
+exactly this). The straggler-defense layer (docs/resilience.md) makes
+"slow" a bounded, recoverable failure; an untimed wait re-opens the hole,
+so this check fails CI when any module outside
+``petastorm_tpu/workers_pool/`` (the pool runtime owns its own
+disciplined poll loops) contains one.
+
+Flagged call shapes (attribute calls only — a bare ``get(...)`` is not a
+queue):
+
+* ``x.get()`` with no arguments, or ``x.get(True)`` / ``x.get(block=True)``
+  with no ``timeout=`` — ``dict.get(key)`` and ``q.get(timeout=...)`` and
+  ``q.get_nowait()`` never match;
+* ``x.recv()`` with no arguments (ZMQ/multiprocessing pipes block forever);
+* ``x.wait()`` with no arguments and no ``timeout=`` (``Event``/
+  ``Condition``/process waits).
+
+A wait that is genuinely unbounded *by design* (e.g. it is itself
+liveness-checked some other way) may opt out with a ``timeout-ok`` comment
+on the call line, stating why it cannot hang.
+
+Usage::
+
+    python tools/check_timeouts.py            # scan petastorm_tpu/ (minus workers_pool/)
+    python tools/check_timeouts.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATHS = ("petastorm_tpu",)
+#: The pool runtime is the one place allowed to own raw blocking waits:
+#: every one of its loops is stop-event-aware by construction (reviewed
+#: there, not lintable by shape).
+EXEMPT_DIRS = (os.path.join("petastorm_tpu", "workers_pool"),)
+
+WAIVER = "timeout-ok"
+
+_BLOCKING_ATTRS = ("get", "recv", "wait")
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _is_true_const(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _unbounded_blocking_call(node: ast.Call):
+    """Return the offending attr name when ``node`` is an unbounded
+    blocking wait per the module docstring's shapes, else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _BLOCKING_ATTRS:
+        return None
+    kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+    if "timeout" in kwargs:
+        return None
+    if fn.attr == "get":
+        # Blocking shapes only: get(), get(True), get(block=True).
+        # dict.get(key[, default]) carries a non-True first argument and
+        # never matches.
+        if not node.args and not kwargs:
+            return fn.attr
+        if (len(node.args) == 1 and _is_true_const(node.args[0])):
+            return fn.attr  # get(True): blocks; get(True, t) has a timeout
+        block = next((kw.value for kw in node.keywords
+                      if kw.arg == "block"), None)
+        if block is not None and _is_true_const(block) and not node.args:
+            return fn.attr
+        return None
+    # recv() / wait(): any positional argument is a timeout/bufsize — only
+    # the bare zero-argument call blocks unboundedly.
+    if not node.args and not kwargs:
+        return fn.attr
+    return None
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived unbounded wait."""
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    if any(rel == d or rel.startswith(d + os.sep) for d in EXEMPT_DIRS):
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _unbounded_blocking_call(node)
+        if attr is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{node.lineno}: unbounded blocking .{attr}() — a dead "
+            f"or wedged producer hangs this call forever. Pass a timeout "
+            f"and check liveness/stop state on expiry (docs/resilience.md "
+            f"§ watchdog), or add '# {WAIVER}: <why this cannot hang>'")
+    return sorted(violations)
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    checked = 0
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+        checked += 1
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_timeouts: {len(all_violations)} violation(s) in "
+              f"{checked} file(s)", file=sys.stderr)
+        return 1
+    print(f"check_timeouts: {checked} file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
