@@ -1,7 +1,7 @@
 # Developer entry points. The image has no sphinx/mkdocs (and no network
 # installs), so `docs` runs the vendored zero-dep generator instead.
 
-.PHONY: docs smoke test slow ci ci-lint ci-adapters ci-pools
+.PHONY: docs smoke test slow ci ci-lint ci-adapters ci-pools bench-compare
 
 docs:
 	python tools/gen_api_docs.py
@@ -44,6 +44,12 @@ ci-lint:
 	python tools/check_backoff.py
 	python tools/check_knobs.py
 	python tools/check_timeouts.py
+	python tools/check_columns.py
+
+# Diff the two newest committed round artifacts; fails on a >20% drop in
+# any shared bench phase (tools/bench_compare.py for the phase-key rules).
+bench-compare:
+	python tools/bench_compare.py
 
 ci-adapters:
 	timeout 1200 python -m pytest tests/test_torch_loader_depth.py \

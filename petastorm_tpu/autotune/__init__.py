@@ -26,6 +26,7 @@ and ``cache.mem.*`` telemetry on the pipeline registry.
 """
 from petastorm_tpu.autotune.actuators import (Actuator,
                                               PrefetchDepthActuator,
+                                              ReadaheadDepthActuator,
                                               ShuffleTargetActuator,
                                               VentilatorDepthActuator,
                                               WorkerConcurrencyActuator)
@@ -37,6 +38,7 @@ from petastorm_tpu.autotune.mem_cache import InMemoryRowGroupCache
 __all__ = [
     "Actuator", "AutotuneConfig", "AutotuneController",
     "InMemoryRowGroupCache", "MemoryBudget", "PrefetchDepthActuator",
-    "ShuffleTargetActuator", "VentilatorDepthActuator",
-    "WorkerConcurrencyActuator", "payload_nbytes",
+    "ReadaheadDepthActuator", "ShuffleTargetActuator",
+    "VentilatorDepthActuator", "WorkerConcurrencyActuator",
+    "payload_nbytes",
 ]

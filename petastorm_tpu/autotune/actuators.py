@@ -24,7 +24,8 @@ import threading
 from typing import Optional
 
 __all__ = ["Actuator", "WorkerConcurrencyActuator", "VentilatorDepthActuator",
-           "ShuffleTargetActuator", "PrefetchDepthActuator"]
+           "ShuffleTargetActuator", "PrefetchDepthActuator",
+           "ReadaheadDepthActuator"]
 
 
 class Actuator:
@@ -148,6 +149,26 @@ class ShuffleTargetActuator(Actuator):
 
     def _apply(self, value: int) -> None:
         self._buf.set_target_capacity(value)
+
+
+class ReadaheadDepthActuator(Actuator):
+    """Row-group readahead depth on the
+    :class:`~petastorm_tpu.reader_impl.readahead.ReadaheadFetcher`. Floor
+    1 (the stage still overlaps one fetch with decode); ceiling defaults
+    to 4x the configured depth — each unit pins one whole fetched Arrow
+    table, and the fetcher's byte budget is the real memory bound, so the
+    ceiling just keeps a producer-bound ladder from queueing tables decode
+    can never catch up to."""
+
+    def __init__(self, fetcher, hi: Optional[int] = None, telemetry=None):
+        self._fetcher = fetcher
+        initial = fetcher.depth
+        super().__init__("readahead_depth", 1,
+                         hi if hi is not None else max(2, initial * 4),
+                         initial, telemetry=telemetry)
+
+    def _apply(self, value: int) -> None:
+        self._fetcher.set_readahead_depth(value)
 
 
 class PrefetchDepthActuator(Actuator):

@@ -234,9 +234,12 @@ class AutotuneController:
         moved = False
         if verdict == "producer_bound":
             # Escalation ladder: concurrency feeds decode directly; depth
-            # knobs only help once the workers themselves are saturated.
+            # knobs only help once the workers themselves are saturated
+            # (readahead before prefetch: resident row-group tables unblock
+            # EVERY decode worker, a staged batch only the consumer).
             for name, delta in (("worker_concurrency", 1),
                                 ("ventilate_ahead", 2),
+                                ("readahead_depth", 1),
                                 ("prefetch_depth", 1)):
                 moved = self._nudge(acts.get(name), delta, verdict)
                 if moved:
@@ -254,6 +257,7 @@ class AutotuneController:
         elif verdict == "memory_pressure":
             for name, delta in (("shuffle_target", None),
                                 ("prefetch_depth", -1),
+                                ("readahead_depth", -1),
                                 ("ventilate_ahead", -2)):
                 if delta is None:
                     act = acts.get(name)

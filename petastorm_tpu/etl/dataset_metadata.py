@@ -37,6 +37,7 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.errors import MetadataError, MetadataGenerationError
 from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+from petastorm_tpu.predicates import _is_nan
 from petastorm_tpu.unischema import Unischema
 
 logger = logging.getLogger(__name__)
@@ -63,6 +64,108 @@ class RowGroupRef:
     @property
     def partition_dict(self) -> dict:
         return dict(self.partition_values)
+
+
+@dataclass(frozen=True)
+class ColumnStats:
+    """Per-row-group statistics of ONE column, as Parquet footers record
+    them (logical values, pyarrow-converted). ``has_min_max=False`` marks
+    bounds as absent/unusable (stats disabled at write time, or NaN bounds
+    in float columns) — the pruner treats such a column as admitting
+    anything. ``None`` counts mean "not recorded", never zero."""
+    min: object = None
+    max: object = None
+    null_count: Optional[int] = None
+    num_rows: Optional[int] = None
+    has_min_max: bool = False
+
+
+def _usable_bound(v) -> bool:
+    """NaN min/max bounds (some writers emit them for all-NaN float pages)
+    order against nothing; a bound must be usable or the pair is dropped.
+    Shares the pruner's one NaN rule (:func:`petastorm_tpu.predicates._is_nan`)
+    so the two safety checks can never drift apart."""
+    return not _is_nan(v)
+
+
+def _column_stats_for_row_group(rg_meta, columns: set) -> Dict[str, ColumnStats]:
+    """``{column: ColumnStats}`` of one ``pq.FileMetaData`` row group,
+    restricted to top-level ``columns`` (nested element paths like
+    ``col.list.element`` carry element- not row-level bounds and are
+    skipped)."""
+    out: Dict[str, ColumnStats] = {}
+    num_rows = rg_meta.num_rows
+    for j in range(rg_meta.num_columns):
+        cc = rg_meta.column(j)
+        name = cc.path_in_schema
+        if "." in name or name not in columns or name in out:
+            continue
+        if not cc.is_stats_set or cc.statistics is None:
+            out[name] = ColumnStats(num_rows=num_rows)
+            continue
+        st = cc.statistics
+        null_count = st.null_count if st.has_null_count else None
+        has_min_max = bool(st.has_min_max) \
+            and _usable_bound(st.min) and _usable_bound(st.max)
+        out[name] = ColumnStats(
+            min=st.min if has_min_max else None,
+            max=st.max if has_min_max else None,
+            null_count=null_count, num_rows=num_rows,
+            has_min_max=has_min_max)
+    return out
+
+
+def load_row_group_stats(ctx: DatasetContext, row_groups, columns) \
+        -> Dict[tuple, Dict[str, ColumnStats]]:
+    """Per-row-group column statistics for the given
+    :class:`RowGroupRef` list — ``{(path, ordinal): {column: ColumnStats}}``
+    restricted to ``columns``. Used by the Reader's plan-time pruning
+    (docs/io.md).
+
+    Source order mirrors :func:`load_row_groups`: the summary ``_metadata``
+    sidecar when it exists (ONE read covers every file), else a
+    ThreadPool footer scan over just the files the refs touch. Files whose
+    footers cannot be read contribute no stats (their groups are simply
+    never pruned — planning must not fail on what is only an optimization).
+    """
+    columns = set(columns)
+    wanted_paths = {rg.path for rg in row_groups}
+    out: Dict[tuple, Dict[str, ColumnStats]] = {}
+
+    md = _read_summary_metadata(ctx)
+    if md is not None:
+        seen_per_file: Dict[str, int] = {}
+        for i in range(md.num_row_groups):
+            rg = md.row_group(i)
+            rel = rg.column(0).file_path
+            if not rel:
+                out.clear()
+                break  # malformed summary; degrade to footer scan
+            path = posixpath.join(ctx.root_path, rel)
+            ordinal = seen_per_file.get(path, 0)
+            seen_per_file[path] = ordinal + 1
+            if path in wanted_paths:
+                out[(path, ordinal)] = _column_stats_for_row_group(rg, columns)
+
+    missing_paths = sorted(
+        {rg.path for rg in row_groups if (rg.path, rg.row_group) not in out})
+    if missing_paths:
+        def _scan(path):
+            try:
+                with ctx.filesystem.open(path, "rb") as f:
+                    md = pq.ParquetFile(f).metadata
+            except (OSError, IOError, ValueError):
+                return path, None  # unreadable footer: no stats, no pruning
+            return path, [_column_stats_for_row_group(md.row_group(i), columns)
+                          for i in range(md.num_row_groups)]
+
+        with ThreadPoolExecutor(max_workers=10) as pool:
+            for path, per_group in pool.map(_scan, missing_paths):
+                if per_group is None:
+                    continue
+                for ordinal, stats in enumerate(per_group):
+                    out[(path, ordinal)] = stats
+    return out
 
 
 class DatasetContext:

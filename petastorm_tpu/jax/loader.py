@@ -840,6 +840,10 @@ class DataLoader(LoaderBase):
     :param shuffling_queue_capacity: >0 enables a row shuffling buffer
     :param min_after_retrieve: shuffle-quality floor for the buffer
     :param seed: buffer RNG seed
+    :param shuffle_fast_rng: opt-in vectorized index draws for the buffer's
+        per-row pop (block ``rng.integers`` refills instead of one bounded
+        draw per row). Seeded-deterministic but a different sequence than
+        the default, which stays byte-identical to prior releases.
     """
 
     #: Rows between flushes of locally-accumulated shuffle seconds into the
@@ -850,7 +854,8 @@ class DataLoader(LoaderBase):
     def __init__(self, reader, batch_size: int,
                  shuffling_queue_capacity: int = 0,
                  min_after_retrieve: Optional[int] = None,
-                 seed: Optional[int] = None, **kwargs):
+                 seed: Optional[int] = None,
+                 shuffle_fast_rng: bool = False, **kwargs):
         kwargs.setdefault("telemetry", getattr(reader, "telemetry", None))
         super().__init__(batch_size, **kwargs)
         if reader.batched_output:
@@ -861,6 +866,10 @@ class DataLoader(LoaderBase):
         self._shuffling_capacity = shuffling_queue_capacity
         self._min_after = min_after_retrieve
         self._seed = seed
+        #: Opt-in vectorized shuffle-buffer index draws (a DIFFERENT seeded
+        #: sequence than the default per-pop draws; see
+        #: RandomShufflingBuffer.batched_rng).
+        self._shuffle_fast_rng = bool(shuffle_fast_rng)
         if shuffling_queue_capacity and shuffling_queue_capacity > 1:
             self._ckpt_hazard = (
                 "shuffling_queue_capacity buffers a random sample of rows "
@@ -878,7 +887,8 @@ class DataLoader(LoaderBase):
                                     if self._min_after is not None
                                     else self._shuffling_capacity // 2),
                 extra_capacity=max(1000, self._shuffling_capacity),
-                seed=self._seed)
+                seed=self._seed,
+                batched_rng=self._shuffle_fast_rng)
             gauge_fns = self._register_shuffle_gauges(buf)
             shuffle_actuator = self._register_shuffle_actuator(buf)
             shuffle_time = self._shuffle_time

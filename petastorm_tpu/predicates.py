@@ -7,15 +7,149 @@ without any query engine (reference py_dict_reader_worker predicate-first
 loading). When every predicate field is a partition key, the Reader evaluates
 it at planning time and skips whole row groups.
 
+Statistics pruning (docs/io.md): a predicate may additionally describe the
+values it can ever accept via :meth:`PredicateBase.intervals` — a
+conjunction of per-field :class:`FieldDomain` constraints. The Reader
+evaluates those against Parquet per-row-group column statistics (min/max/
+null-count) at plan time and drops row groups no row of which can possibly
+match, so provably-empty groups are never fetched or decoded. The protocol
+is strictly an over-approximation: returning ``None`` (the base default,
+and the only honest answer for ``in_lambda``) disables pruning for that
+predicate with zero behavior change.
+
 Parity: reference petastorm/predicates.py — ``PredicateBase`` (:27),
 ``in_set`` (:44), ``in_intersection`` (:58), ``in_lambda`` (:74),
 ``in_negate`` (:103), ``in_reduce`` (:119), ``in_pseudorandom_split`` (:144,
-md5 bucketing :39).
+md5 bucketing :39). ``in_range`` and the ``intervals()``/:class:`FieldDomain`
+protocol have no reference equivalent.
 """
 from __future__ import annotations
 
 import hashlib
-from typing import Callable, Sequence
+import math
+from typing import Callable, Optional, Sequence
+
+
+def _is_nan(v) -> bool:
+    try:
+        return isinstance(v, float) and math.isnan(v)
+    except TypeError:  # pragma: no cover - defensive
+        return False
+
+
+def _lt(a, b) -> Optional[bool]:
+    """``a < b`` with three-valued logic: ``None`` when the comparison is
+    meaningless (mixed types, NaN) — callers treat ``None`` as "cannot
+    prove", never as an exclusion."""
+    if _is_nan(a) or _is_nan(b):
+        return None
+    try:
+        return bool(a < b)
+    except TypeError:
+        return None
+
+
+class FieldDomain:
+    """Over-approximation of the values one field may take in any row a
+    predicate accepts. Either (or both) of:
+
+    * ``values`` — a discrete set of accepted non-null values;
+    * ``intervals`` — ``((lo, hi, include_lo, include_hi), ...)`` accepted
+      ranges, ``None`` bounds meaning unbounded;
+
+    plus ``include_null`` — whether a null cell may be accepted.
+
+    The only consumer-facing question is :meth:`admits_stats`: given one
+    row group's column statistics, *might* any row match? Every unprovable
+    comparison (missing stats, NaN bounds, cross-type ordering) answers
+    "yes" — pruning must never be wrong, only incomplete.
+    """
+
+    __slots__ = ("values", "intervals", "include_null")
+
+    def __init__(self, values=None, intervals=(), include_null=False):
+        self.values = None if values is None else frozenset(values)
+        self.intervals = tuple(intervals)
+        self.include_null = bool(include_null)
+
+    def __repr__(self):
+        return (f"FieldDomain(values={self.values}, "
+                f"intervals={self.intervals}, "
+                f"include_null={self.include_null})")
+
+    @property
+    def unconstrained(self) -> bool:
+        """No non-null constraint at all: this domain admits any value
+        (the :meth:`admits_stats` fallback)."""
+        return self.values is None and not self.intervals
+
+    def union(self, other: "FieldDomain") -> "FieldDomain":
+        """Domain accepting anything either side accepts (for OR-composed
+        predicates). An unconstrained side makes the union unconstrained —
+        merging its (absent) value set with the other side's would
+        under-approximate and let the pruner drop matching rows."""
+        include_null = self.include_null or other.include_null
+        if self.unconstrained or other.unconstrained:
+            return FieldDomain(include_null=include_null)
+        if self.values is None or other.values is None:
+            values = self.values if other.values is None else other.values
+        else:
+            values = self.values | other.values
+        return FieldDomain(values=values,
+                           intervals=self.intervals + other.intervals,
+                           include_null=include_null)
+
+    # ------------------------------------------------------------- pruning
+    def _value_possible(self, v, stats) -> bool:
+        """Could any row of a group with ``stats`` hold value ``v``?"""
+        if not stats.has_min_max:
+            return True
+        below = _lt(v, stats.min)
+        above = _lt(stats.max, v)
+        if below is None or above is None:
+            return True  # unprovable comparison: assume possible
+        return not (below or above)
+
+    def _interval_possible(self, interval, stats) -> bool:
+        lo, hi, inc_lo, inc_hi = interval
+        if not stats.has_min_max:
+            return True
+        if hi is not None:
+            below = _lt(hi, stats.min)
+            if below is None:
+                return True
+            if below or (not inc_hi and hi == stats.min):
+                return False
+        if lo is not None:
+            above = _lt(stats.max, lo)
+            if above is None:
+                return True
+            if above or (not inc_lo and lo == stats.max):
+                return False
+        return True
+
+    def admits_stats(self, stats) -> bool:
+        """True when a row group with these column ``stats`` (a
+        :class:`petastorm_tpu.etl.dataset_metadata.ColumnStats`) might
+        contain a matching row; False only when provably empty."""
+        if self.include_null and (stats.null_count is None
+                                  or stats.null_count > 0):
+            return True
+        all_null = (stats.null_count is not None and stats.num_rows is not None
+                    and stats.null_count >= stats.num_rows
+                    and stats.num_rows > 0)
+        if all_null:
+            # Every cell is null and nulls are not accepted.
+            return False
+        if self.values is not None \
+                and any(self._value_possible(v, stats) for v in self.values):
+            return True
+        if any(self._interval_possible(iv, stats) for iv in self.intervals):
+            return True
+        if self.values is None and not self.intervals:
+            # No non-null constraint recorded: anything may match.
+            return True
+        return False
 
 
 class PredicateBase:
@@ -26,6 +160,18 @@ class PredicateBase:
     def do_include(self, values: dict) -> bool:
         """Decide inclusion given ``{field_name: value}`` for one row."""
         raise NotImplementedError
+
+    def intervals(self) -> Optional[list]:
+        """Conjunctive ``[(field_name, FieldDomain), ...]`` constraints
+        over-approximating the rows ``do_include`` can accept — a row can
+        only match if EVERY listed constraint admits its field value. Used
+        by the Reader's plan-time statistics pruning (docs/io.md).
+
+        ``None`` (the default) means "unknown": the predicate falls back to
+        fetch-then-filter with zero behavior change. Subclasses overriding
+        this MUST keep it an over-approximation — claiming a value
+        impossible that ``do_include`` would accept silently drops data."""
+        return None
 
 
 class in_set(PredicateBase):
@@ -40,6 +186,57 @@ class in_set(PredicateBase):
 
     def do_include(self, values):
         return values[self._field] in self._values
+
+    def intervals(self):
+        return [(self._field,
+                 FieldDomain(values={v for v in self._values if v is not None},
+                             include_null=None in self._values))]
+
+
+class in_range(PredicateBase):
+    """Include rows whose ``predicate_field`` value lies in
+    ``[lower, upper)`` (half-open by default, matching slicing convention;
+    both bounds optional and inclusivity overridable). Null cells never
+    match. Prunable at plan time through :meth:`intervals` — the canonical
+    range predicate the statistics pruner proves row groups empty against
+    (docs/io.md)."""
+
+    def __init__(self, predicate_field: str, lower=None, upper=None,
+                 include_lower: bool = True, include_upper: bool = False):
+        if lower is None and upper is None:
+            raise ValueError("in_range needs at least one bound")
+        if lower is not None and upper is not None:
+            if _lt(upper, lower) or (upper == lower and
+                                     not (include_lower and include_upper)):
+                raise ValueError(f"empty range: [{lower!r}, {upper!r}] with "
+                                 f"include_lower={include_lower}, "
+                                 f"include_upper={include_upper}")
+        self._field = predicate_field
+        self._lower, self._upper = lower, upper
+        self._include_lower, self._include_upper = include_lower, include_upper
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        v = values[self._field]
+        if v is None or _is_nan(v):
+            return False
+        if self._lower is not None:
+            if v < self._lower or (v == self._lower
+                                   and not self._include_lower):
+                return False
+        if self._upper is not None:
+            if v > self._upper or (v == self._upper
+                                   and not self._include_upper):
+                return False
+        return True
+
+    def intervals(self):
+        return [(self._field,
+                 FieldDomain(intervals=((self._lower, self._upper,
+                                         self._include_lower,
+                                         self._include_upper),)))]
 
 
 class in_intersection(PredicateBase):
@@ -102,6 +299,44 @@ class in_reduce(PredicateBase):
 
     def do_include(self, values):
         return self._reduce([p.do_include(values) for p in self._predicates])
+
+    def intervals(self):
+        """AND-composition (``reduce_func is all``) concatenates member
+        constraints — every member must pass, so each member's constraints
+        hold independently (members without ``intervals()`` simply
+        contribute none). OR-composition (``reduce_func is any``) unions
+        per-field domains, valid only when EVERY member constrains that
+        field. Any other reduce function is opaque: no pruning."""
+        if self._reduce is all:
+            out = []
+            for p in self._predicates:
+                out.extend(p.intervals() or [])
+            return out or None
+        if self._reduce is any:
+            if not self._predicates:
+                return None
+            per_member = []
+            for p in self._predicates:
+                ivs = p.intervals()
+                if ivs is None:
+                    return None  # an unconstrained alternative admits anything
+                per_member.append(ivs)
+            # Fields constrained by every alternative: union their domains.
+            common = set.intersection(*[{f for f, _ in ivs}
+                                        for ivs in per_member])
+            out = []
+            for field in sorted(common):
+                domain = None
+                for ivs in per_member:
+                    # AND-conjunct within one member: any one constraint is a
+                    # valid over-approximation of that member; unioning every
+                    # conjunct keeps it one for the disjunction.
+                    for f, d in ivs:
+                        if f == field:
+                            domain = d if domain is None else domain.union(d)
+                out.append((field, domain))
+            return out or None
+        return None
 
 
 def _hash_bucket(value, num_buckets: int) -> int:

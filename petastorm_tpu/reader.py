@@ -297,7 +297,10 @@ def make_reader(dataset_url,
                 memory_cache_size_bytes: Optional[int] = None,
                 stage_deadline_s=None,
                 hedge_policy=None,
-                hang_timeout_s: Optional[float] = None):
+                hang_timeout_s: Optional[float] = None,
+                rowgroup_pruning: bool = True,
+                readahead_depth: Optional[int] = None,
+                readahead_max_bytes: Optional[int] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -383,6 +386,27 @@ def make_reader(dataset_url,
         progress anywhere in the pipeline, thread stacks are dumped to
         telemetry and the watchdog escalates nudge -> cancel/kill ->
         ``PipelineHungError`` — the reader never blocks indefinitely.
+    :param rowgroup_pruning: (default True) when ``predicate`` describes
+        its acceptable values via :meth:`PredicateBase.intervals` (the
+        built-in equality/in-set/range predicates do), evaluate Parquet
+        per-row-group column min/max/null-count statistics at plan time
+        and drop row groups **no row of which can possibly match** —
+        skipped groups are never fetched or decoded
+        (``io.rowgroups_pruned`` telemetry; :meth:`Reader.pruning_report`).
+        Predicates without ``intervals()`` fall back to fetch-then-filter
+        with zero behavior change. See docs/io.md.
+    :param readahead_depth: enable the async readahead fetch stage
+        (docs/io.md): a small pool of fetcher threads reads up to this
+        many row groups' Arrow tables ahead of the decode workers, so
+        decode pops resident tables instead of blocking on the
+        filesystem. In-process pools only (ignored with a warning for
+        ``reader_pool_type='process'``); an autotune actuator when
+        ``autotune=True``; composes with retry/quarantine (a failed
+        prefetch is discarded and re-read inline under the RetryPolicy)
+        and hedging (the fetch is the hedged unit). ``None``/0 = off.
+    :param readahead_max_bytes: byte allowance for fetched-ahead tables
+        (default 256 MiB); with ``autotune_config.memory_budget_bytes``
+        the PR 3 shared ledger is charged instead.
 
     Parity: reference reader.py:60.
     """
@@ -440,7 +464,10 @@ def make_reader(dataset_url,
                   autotune_config=autotune_config,
                   stage_deadline_s=stage_deadline_s,
                   hedge_policy=hedge_policy,
-                  hang_timeout_s=hang_timeout_s)
+                  hang_timeout_s=hang_timeout_s,
+                  rowgroup_pruning=rowgroup_pruning,
+                  readahead_depth=readahead_depth,
+                  readahead_max_bytes=readahead_max_bytes)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -482,7 +509,10 @@ def make_batch_reader(dataset_url_or_urls,
                       memory_cache_size_bytes: Optional[int] = None,
                       stage_deadline_s=None,
                       hedge_policy=None,
-                      hang_timeout_s: Optional[float] = None):
+                      hang_timeout_s: Optional[float] = None,
+                      rowgroup_pruning: bool = True,
+                      readahead_depth: Optional[int] = None,
+                      readahead_max_bytes: Optional[int] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -507,6 +537,10 @@ def make_batch_reader(dataset_url_or_urls,
     ``stage_deadline_s`` / ``hedge_policy`` / ``hang_timeout_s`` behave
     exactly as in :func:`make_reader` (docs/resilience.md § "Deadlines,
     hedging, and the watchdog").
+    ``rowgroup_pruning`` / ``readahead_depth`` / ``readahead_max_bytes``
+    behave exactly as in :func:`make_reader` (docs/io.md) — plain Parquet
+    stores usually carry the richest column statistics, so this is the
+    path pruning pays off most on.
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -566,7 +600,10 @@ def make_batch_reader(dataset_url_or_urls,
                   autotune_config=autotune_config,
                   stage_deadline_s=stage_deadline_s,
                   hedge_policy=hedge_policy,
-                  hang_timeout_s=hang_timeout_s)
+                  hang_timeout_s=hang_timeout_s,
+                  rowgroup_pruning=rowgroup_pruning,
+                  readahead_depth=readahead_depth,
+                  readahead_max_bytes=readahead_max_bytes)
 
 
 class Reader:
@@ -584,7 +621,9 @@ class Reader:
                  rowgroup_coalescing=1, filters=None, retry_policy=None,
                  degraded_mode=False, fault_plan=None, worker_crash_budget=0,
                  autotune=False, autotune_config=None, stage_deadline_s=None,
-                 hedge_policy=None, hang_timeout_s=None):
+                 hedge_policy=None, hang_timeout_s=None,
+                 rowgroup_pruning=True, readahead_depth=None,
+                 readahead_max_bytes=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -627,6 +666,9 @@ class Reader:
             self.schema = view_schema
 
         # ---------------- row-group planning
+        #: Plan-time pruning provenance — filled by the selector pass and
+        #: the statistics pruner below; see :meth:`pruning_report`.
+        self._pruning_report = {"enabled": False}
         all_row_groups = load_row_groups(ctx)
         filtered = self._filter_row_groups(all_row_groups, predicate,
                                            rowgroup_selector, cur_shard,
@@ -638,6 +680,21 @@ class Reader:
                 f"(dataset has {len(all_row_groups)} row groups; "
                 f"cur_shard={cur_shard}, shard_count={shard_count})")
         logger.debug("Reading %d/%d row groups", len(filtered), len(all_row_groups))
+
+        # ---------------- statistics pruning (docs/io.md). AFTER sharding,
+        # so shard membership — and therefore which host owns which
+        # surviving rows — is identical with pruning on or off, and each
+        # shard only reads statistics for its own files. Pruning to an
+        # EMPTY plan is legal (the predicate provably matches nothing):
+        # that is an empty epoch, exactly what fetch-then-filter would
+        # have yielded, not a configuration error.
+        self._pruning_report.update({"row_groups_planned": len(filtered),
+                                     "row_groups_pruned": 0,
+                                     "row_groups_kept": len(filtered)})
+        if rowgroup_pruning and predicate is not None:
+            filtered = self._prune_row_groups_with_statistics(filtered,
+                                                              predicate)
+
         if rowgroup_coalescing > 1:
             filtered = _coalesce_row_groups(filtered, rowgroup_coalescing)
 
@@ -678,6 +735,49 @@ class Reader:
                 # worker: hits/misses/evictions land on the pipeline
                 # registry.
                 cache.attach_telemetry(self.telemetry)
+
+        # ---------------- async readahead (docs/io.md)
+        #: Background :class:`~petastorm_tpu.reader_impl.readahead.
+        #: ReadaheadFetcher` when ``readahead_depth`` is set (else None):
+        #: fetches row-group Arrow tables ahead of the decode workers.
+        self.readahead = None
+        if readahead_depth:
+            if readahead_depth < 0:
+                raise ValueError(f"readahead_depth must be >= 1, "
+                                 f"got {readahead_depth}")
+            if isinstance(self._pool, ProcessPool):
+                # The fetched-table store is shared memory; it cannot cross
+                # the spawn boundary (spawned workers already overlap IO
+                # against their sibling processes).
+                warnings.warn("readahead_depth only applies to in-process "
+                              "pools (reader_pool_type='thread'/'dummy'); "
+                              "ignored for the process pool")
+            else:
+                from petastorm_tpu.autotune import MemoryBudget
+                from petastorm_tpu.reader_impl.readahead import \
+                    ReadaheadFetcher
+                # One fetch covers every column any worker request will
+                # slice: the schema view (all NGram timesteps when
+                # windowed) plus the predicate's fields.
+                if self.ngram is not None:
+                    fetch_columns = set(
+                        self.ngram.get_field_names_at_all_timesteps())
+                else:
+                    fetch_columns = set(view_schema.fields.keys())
+                if predicate is not None:
+                    fetch_columns |= set(predicate.get_fields())
+                self.readahead = ReadaheadFetcher(
+                    ctx.filesystem, fetch_columns,
+                    depth=int(readahead_depth),
+                    budget=MemoryBudget(readahead_max_bytes or (256 << 20)),
+                    fault_plan=fault_plan, hedge_policy=hedge_policy,
+                    telemetry=self.telemetry,
+                    # Announcement backstop: normal flow is bounded by the
+                    # ventilator's in-flight cap; size for its autotuned
+                    # ceiling (4x) so a consumer that stops popping (warm
+                    # cache epochs) can't accumulate submissions forever.
+                    max_queue=4 * self._pool.workers_count
+                    * (1 + _VENTILATE_EXTRA_ROWGROUPS))
 
         # ---------------- resilience wiring (docs/resilience.md)
         from petastorm_tpu.resilience import (CancellationToken, HedgePolicy,
@@ -745,6 +845,9 @@ class Reader:
             "stage_deadline": stage_deadline,
             "hedge_policy": hedge_policy,
             "cancel_token": self._cancel_token,
+            # In-process-only shared fetch stage (None for spawned
+            # workers; see the readahead block above).
+            "readahead": self.readahead,
             # The shared registry cannot cross the spawn boundary (same
             # limitation as the worker decode histogram): spawned workers
             # retry without exporting per-retry counters; quarantine and
@@ -782,8 +885,19 @@ class Reader:
                 raise ValueError(f"resume offset {start_offset} >= {len(items)} work items "
                                  "(did the dataset or its filtering change?)")
         self._num_items = len(items)
+        ventilate_fn = self._pool.ventilate
+        if self.readahead is not None:
+            # Ventilation announces each work item to the fetch stage the
+            # moment it is admitted: fetchers run ahead in ventilation
+            # order, bounded by their depth/byte budget (the ventilator's
+            # in-flight cap already bounds the announcement queue).
+            pool_ventilate, readahead = self._pool.ventilate, self.readahead
+
+            def ventilate_fn(**kwargs):
+                readahead.submit(kwargs["rowgroup"])
+                pool_ventilate(**kwargs)
         self._ventilator = ConcurrentVentilator(
-            self._pool.ventilate, items,
+            ventilate_fn, items,
             iterations=num_epochs,
             randomize_item_order=shuffle_row_groups,
             random_seed=seed,
@@ -850,6 +964,11 @@ class Reader:
                     # Before any fill: repoint the cache's accounting at
                     # the shared ledger (its size_limit still caps it).
                     cache.budget = budget
+                if self.readahead is not None:
+                    # Same move for the fetch stage: before any fetch,
+                    # charge the one shared ledger so readahead backs off
+                    # when the PIPELINE is eating into host headroom.
+                    self.readahead.budget = budget
             self.autotune = AutotuneController(self.telemetry,
                                                autotune_config,
                                                budget=budget)
@@ -858,8 +977,13 @@ class Reader:
                 self.autotune.register(WorkerConcurrencyActuator(
                     gate, self._pool.workers_count))
             self.autotune.register(VentilatorDepthActuator(self._ventilator))
+            if self.readahead is not None:
+                from petastorm_tpu.autotune import ReadaheadDepthActuator
+                self.autotune.register(ReadaheadDepthActuator(self.readahead))
             self.autotune.start()
 
+        if self.readahead is not None:
+            self.readahead.start()
         self._pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         # ---------------- watchdog (docs/resilience.md)
@@ -958,7 +1082,13 @@ class Reader:
         # Ordinals refer to the unfiltered, deterministic row-group order.
         selected = {id(all_row_groups[i]) for i in selected_ordinals
                     if i < len(all_row_groups)}
-        return [rg for rg in filtered if id(rg) in selected]
+        kept = [rg for rg in filtered if id(rg) in selected]
+        # Same provenance surface as the statistics pruner: the report says
+        # which selector dropped how many groups at plan time.
+        self._pruning_report["selector"] = selector.describe() \
+            if hasattr(selector, "describe") else type(selector).__name__
+        self._pruning_report["selector_pruned"] = len(filtered) - len(kept)
+        return kept
 
     @staticmethod
     def _partition_row_groups(row_groups, cur_shard, shard_count, shard_seed):
@@ -975,6 +1105,55 @@ class Reader:
                 f"Shard {cur_shard}/{shard_count} received zero row groups "
                 f"({len(row_groups)} total). Use fewer shards or larger datasets.")
         return shard
+
+    def _prune_row_groups_with_statistics(self, row_groups, predicate):
+        """Statistics-driven pruning (docs/io.md): drop row groups the
+        predicate's :meth:`~petastorm_tpu.predicates.PredicateBase.intervals`
+        constraints prove empty against per-row-group column min/max/
+        null-count statistics. Strictly an optimization: any unusable
+        signal — predicate without ``intervals()``, missing/disabled
+        statistics, NaN bounds, cross-type comparisons — keeps the group,
+        and the worker-side evaluation decides as before. Hive partition
+        keys prune too: a constant per-group value is a ``min == max``
+        statistic."""
+        report = self._pruning_report
+        constraints = predicate.intervals()
+        if not constraints:
+            report["reason"] = "predicate declares no intervals()"
+            return row_groups
+        report["enabled"] = True
+        fields = sorted({f for f, _ in constraints})
+        report["fields"] = fields
+
+        from petastorm_tpu.etl.dataset_metadata import (ColumnStats,
+                                                        load_row_group_stats)
+        stats = load_row_group_stats(self._ctx, row_groups, fields)
+        kept, pruned_per_file = [], {}
+        for rg in row_groups:
+            group_stats = dict(stats.get((rg.path, rg.row_group), {}))
+            for key, value in rg.partition_values:
+                if key in fields and key not in group_stats:
+                    group_stats[key] = ColumnStats(min=value, max=value,
+                                                   null_count=0,
+                                                   has_min_max=True)
+            admits = all(
+                domain.admits_stats(group_stats[field])
+                for field, domain in constraints
+                if field in group_stats)
+            if admits:
+                kept.append(rg)
+            else:
+                pruned_per_file[rg.path] = pruned_per_file.get(rg.path, 0) + 1
+        pruned = len(row_groups) - len(kept)
+        report.update({"row_groups_pruned": pruned,
+                       "row_groups_kept": len(kept),
+                       "pruned_per_file": pruned_per_file})
+        self.telemetry.counter("io.rowgroups_pruned").add(pruned)
+        self.telemetry.counter("io.rowgroups_planned").add(len(kept))
+        if pruned:
+            logger.debug("Statistics pruning dropped %d/%d row groups "
+                         "(fields: %s)", pruned, len(row_groups), fields)
+        return kept
 
     # ------------------------------------------------------------ iteration
     def __iter__(self):
@@ -1026,6 +1205,11 @@ class Reader:
             self._telemetry_exporter.stop()
             self._telemetry_exporter = None
         self._pool.stop()
+        if self.readahead is not None:
+            # After the pool: a worker blocked in a readahead pop sees the
+            # stop flag and falls back to a miss; close() then drops every
+            # resident table and releases its budget charge.
+            self.readahead.close()
 
     def join(self):
         self._pool.join()
@@ -1063,6 +1247,21 @@ class Reader:
         Empty report when ``degraded_mode`` is off or nothing failed. See
         docs/resilience.md for the schema."""
         return self.quarantine.report()
+
+    def pruning_report(self) -> dict:
+        """Plan-time statistics-pruning outcome, applied identically to
+        every epoch this reader runs: whether pruning engaged, the
+        constrained fields, planned/pruned/kept row-group counts, and a
+        per-file breakdown of what was dropped (``enabled=False`` with a
+        ``reason`` when the predicate declares no ``intervals()``; see
+        docs/io.md for the schema)."""
+        return dict(self._pruning_report)
+
+    def readahead_report(self) -> dict:
+        """Fetch-stage readout: depth/fetchers plus live hit/miss/
+        fetch-error/bytes-in-flight counts. Empty dict when
+        ``readahead_depth`` is off."""
+        return {} if self.readahead is None else self.readahead.stats()
 
     def autotune_report(self) -> dict:
         """Controller readout: tick count, per-actuator current values and

@@ -20,8 +20,8 @@ import pyarrow as pa
 
 from petastorm_tpu.reader_impl.row_reader_worker import (
     _ParquetFileLRU, _init_latency_defense, deadline_checkpoint,
-    item_shuffle_rng, read_row_group_maybe_hedged, run_guarded_attempt,
-    select_drop_partition)
+    item_shuffle_rng, read_row_group_maybe_hedged, readahead_clear,
+    run_guarded_attempt, select_drop_partition)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -65,12 +65,18 @@ class BatchReaderWorker(WorkerBase):
                                   worker_id=self.worker_id)
         # The whole load+transform is the retry unit; publish stays OUTSIDE
         # the guard so a retried item can never publish twice. Each attempt
-        # runs under the stage deadline (when configured).
-        result = run_guarded_attempt(
-            self, rowgroup,
-            lambda: self._build_result(rowgroup, shuffle_row_drop_partition,
-                                       shuffle_context),
-            on_retry=lambda _a, _e, _d: self._files.evict(rowgroup.path))
+        # runs under the stage deadline (when configured). Retry and item
+        # boundaries release the popped readahead table like the row worker.
+        try:
+            result = run_guarded_attempt(
+                self, rowgroup,
+                lambda: self._build_result(rowgroup,
+                                           shuffle_row_drop_partition,
+                                           shuffle_context),
+                on_retry=lambda _a, _e, _d: (self._files.evict(rowgroup.path),
+                                             readahead_clear(self)))
+        finally:
+            readahead_clear(self)
         if result is not None:
             self.publish_func(result)
 

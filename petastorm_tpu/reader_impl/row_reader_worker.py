@@ -18,6 +18,7 @@ Parity: reference petastorm/py_dict_reader_worker.py — ``PyDictReaderWorker``
 from __future__ import annotations
 
 import hashlib
+import threading
 from typing import List, Optional
 
 import numpy as np
@@ -60,6 +61,10 @@ class _ParquetFileLRU:
             self._names[path] = frozenset(self.get(path).schema_arrow.names)
         return self._names[path]
 
+    def close_all(self) -> None:
+        for path in list(self._files):
+            self.evict(path)
+
     def _open(self, path: str):
         # Plain local files: memory-map instead of going through fsspec's
         # buffered file object — zero-copy page access, ~40% faster row-group
@@ -73,6 +78,41 @@ class _ParquetFileLRU:
             except Exception:  # noqa: BLE001 - fall back to the fs handle
                 pass
         return self._fs.open(path, "rb")
+
+
+class _HedgeHandlePool:
+    """Free-list of PRIVATE single-file handle caches for racing read
+    attempts.
+
+    Hedged attempts must never share the worker's ``_files`` LRU (it is
+    neither thread-safe nor safe to evict under a concurrent reader, and a
+    losing attempt is abandoned mid-read), but rebuilding a fresh
+    ``_ParquetFileLRU`` per attempt re-opened the file on EVERY hedge. The
+    pool keeps abandonment safety — checkout is exclusive, so no two live
+    attempts ever touch the same cache, and a straggling loser simply
+    returns its cache late — while steady-state hedging reuses warm
+    handles instead of re-opening. Bounded: idle caches beyond
+    ``max_idle`` close their handles on release (the pool can only grow
+    past it while that many attempts are genuinely in flight at once)."""
+
+    def __init__(self, filesystem, max_idle: int = 4):
+        self._fs = filesystem
+        self._max_idle = max_idle
+        self._idle: list = []
+        self._lock = threading.Lock()
+
+    def acquire(self) -> _ParquetFileLRU:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return _ParquetFileLRU(self._fs, capacity=1)
+
+    def release(self, lru: _ParquetFileLRU) -> None:
+        with self._lock:
+            if len(self._idle) < self._max_idle:
+                self._idle.append(lru)
+                return
+        lru.close_all()
 
 
 def _read_row_group(files: "_ParquetFileLRU", rowgroup, columns,
@@ -98,38 +138,77 @@ def _read_row_group(files: "_ParquetFileLRU", rowgroup, columns,
 
 
 def read_row_group_maybe_hedged(worker, rowgroup, columns):
-    """The row-group IO call both workers share, with optional hedging.
+    """The row-group IO call both workers share: readahead hit, else a
+    (possibly hedged) inline read.
 
-    Without a hedger this is exactly :func:`_read_row_group` over the
-    worker's shared handle LRU. With one (``hedge_policy=`` on the
-    reader), a straggling primary races a duplicate read — see
-    :mod:`petastorm_tpu.resilience.hedging` — and BOTH attempts open
-    **private** file handles, closed by the attempt itself: a losing
-    attempt is abandoned mid-read, and the shared ``worker._files`` LRU
-    is neither thread-safe nor safe to evict (close) under a concurrent
-    reader, so abandoned threads must never touch it. The per-read open
-    is the price of abandonment safety — noise against the remote,
-    ms-scale reads hedging exists for (hedge_policy=None, the default,
-    keeps the zero-overhead shared-LRU path). Both attempts read the
-    same immutable row group, so the winner's bytes are identical either
-    way and seeded epochs stay reproducible. Fault-plan sites fire per
-    attempt, exactly as real storage would misbehave per request."""
+    **Readahead** (``readahead_depth=`` on the reader, docs/io.md): the
+    fetch stage reads whole row groups — every column any request will
+    need — ahead of decode; a resident table is popped and column-sliced
+    here with zero IO. Predicate-first loading's two calls (predicate
+    columns, then survivors' columns) both slice the SAME popped table,
+    held on the worker until the item completes; a retry drops it
+    (:func:`readahead_clear`) so retried attempts read fresh bytes
+    through the guard like any other failure.
+
+    **Hedging** (``hedge_policy=``): a straggling inline read races a
+    duplicate — see :mod:`petastorm_tpu.resilience.hedging` — and BOTH
+    attempts use private handle caches checked out of the worker's
+    :class:`_HedgeHandlePool`: checkout is exclusive (abandonment safety —
+    a loser abandoned mid-read can never have its handle closed under it,
+    and the shared ``worker._files`` LRU is never touched), while release
+    back to the free-list lets later hedges reuse warm handles instead of
+    re-opening the file per attempt. Both attempts read the same immutable
+    row group, so the winner's bytes are identical either way and seeded
+    epochs stay reproducible. Fault-plan sites fire per attempt, exactly
+    as real storage would misbehave per request."""
+    ra = worker._readahead
+    if ra is not None:
+        key = (rowgroup.path, rowgroup.row_group)
+        if worker._ra_key != key and worker._ra_miss_key != key:
+            table = ra.pop(rowgroup,
+                           checkpoint=lambda: deadline_checkpoint(worker))
+            if table is not None:
+                worker._ra_key, worker._ra_table = key, table
+            else:
+                # Remember the miss for this item: the predicate path's
+                # second column request must not pop (and count) again.
+                worker._ra_miss_key = key
+        if worker._ra_key == key and worker._ra_table is not None:
+            names = set(worker._ra_table.column_names)
+            return worker._ra_table.select(
+                [c for c in sorted(columns) if c in names])
+
     if worker._hedger is None:
-        return _read_row_group(worker._files, rowgroup, columns,
-                               fault_plan=worker._fault_plan,
-                               worker_id=worker.worker_id)
+        table = _read_row_group(worker._files, rowgroup, columns,
+                                fault_plan=worker._fault_plan,
+                                worker_id=worker.worker_id)
+    else:
+        if worker._hedge_files is None:
+            worker._hedge_files = _HedgeHandlePool(worker._ctx.filesystem)
 
-    def attempt(_cancel):
-        private = _ParquetFileLRU(worker._ctx.filesystem, capacity=1)
-        try:
-            return _read_row_group(private, rowgroup, columns,
-                                   fault_plan=worker._fault_plan,
-                                   worker_id=worker.worker_id)
-        finally:
-            private.evict(rowgroup.path)
+        def attempt(_cancel):
+            private = worker._hedge_files.acquire()
+            try:
+                return _read_row_group(private, rowgroup, columns,
+                                       fault_plan=worker._fault_plan,
+                                       worker_id=worker.worker_id)
+            finally:
+                worker._hedge_files.release(private)
 
-    return worker._hedger.read(attempt, attempt,
-                               key=str(rowgroup.path))
+        table = worker._hedger.read(attempt, attempt,
+                                    key=str(rowgroup.path))
+    if worker._io_bytes is not None:
+        worker._io_bytes.add(int(table.nbytes))
+        worker._io_rowgroups.add(1)
+    return table
+
+
+def readahead_clear(worker) -> None:
+    """Drop the worker's hold on a popped readahead table (item completed
+    or retrying — a retried attempt must read fresh bytes)."""
+    worker._ra_key = None
+    worker._ra_table = None
+    worker._ra_miss_key = None
 
 
 def _column_values(col, zero_copy: bool = True):
@@ -172,12 +251,15 @@ def _inject_partition_values(table_dict, num_rows, rowgroup, wanted_columns):
 
 
 def _init_latency_defense(worker, args):
-    """Shared straggler-defense wiring for both reader workers: a
-    per-attempt :class:`~petastorm_tpu.resilience.StageDeadline` (soft
-    overruns -> straggler telemetry; hard overruns cancel the attempt into
-    the retry/quarantine machinery) and an optional
+    """Shared straggler-defense and IO-plane wiring for both reader
+    workers: a per-attempt :class:`~petastorm_tpu.resilience.StageDeadline`
+    (soft overruns -> straggler telemetry; hard overruns cancel the attempt
+    into the retry/quarantine machinery), an optional
     :class:`~petastorm_tpu.resilience.HedgedReadExecutor` for the
-    row-group IO call. Both default off (no hot-path cost)."""
+    row-group IO call, the shared
+    :class:`~petastorm_tpu.reader_impl.readahead.ReadaheadFetcher` (when
+    the reader enabled readahead), and the ``io.*`` read counters. All
+    default off (no hot-path cost)."""
     from petastorm_tpu.resilience import HedgedReadExecutor, StragglerMonitor
     telemetry = args.get("resilience_telemetry")
     worker._deadline = args.get("stage_deadline")
@@ -192,6 +274,19 @@ def _init_latency_defense(worker, args):
         HedgedReadExecutor(policy, telemetry=telemetry,
                            worker_id=worker.worker_id)
         if policy is not None else None)
+    worker._hedge_files = None  # lazily-built _HedgeHandlePool
+    # Async readahead (docs/io.md): the shared fetch stage, in-process
+    # pools only (the Reader passes None for spawned workers). The worker
+    # holds at most one popped table — the current item's — released at
+    # the item boundary and on retry.
+    worker._readahead = args.get("readahead")
+    worker._ra_key = None
+    worker._ra_table = None
+    worker._ra_miss_key = None
+    worker._io_bytes = (telemetry.counter("io.bytes_read")
+                        if telemetry is not None else None)
+    worker._io_rowgroups = (telemetry.counter("io.rowgroups_read")
+                            if telemetry is not None else None)
 
 
 def run_guarded_attempt(worker, rowgroup, build, on_retry):
@@ -323,12 +418,20 @@ class RowReaderWorker(WorkerBase):
         # The whole load+decode is the retry unit (decode failures on corrupt
         # bytes quarantine too, not just IO); publish stays OUTSIDE the guard
         # so a retried item can never publish twice. Each attempt runs under
-        # the stage deadline (when configured).
-        result = run_guarded_attempt(
-            self, rowgroup,
-            lambda: self._build_result(rowgroup, shuffle_row_drop_partition,
-                                       shuffle_context),
-            on_retry=lambda _a, _e, _d: self._files.evict(rowgroup.path))
+        # the stage deadline (when configured). A retry drops the popped
+        # readahead table along with the stale handle — retried attempts
+        # must read fresh bytes; the item boundary releases the hold either
+        # way.
+        try:
+            result = run_guarded_attempt(
+                self, rowgroup,
+                lambda: self._build_result(rowgroup,
+                                           shuffle_row_drop_partition,
+                                           shuffle_context),
+                on_retry=lambda _a, _e, _d: (self._files.evict(rowgroup.path),
+                                             readahead_clear(self)))
+        finally:
+            readahead_clear(self)
         if result:
             self.publish_func(result)
 

@@ -19,6 +19,12 @@ class RowGroupSelectorBase:
         ``{index_name: RowGroupIndexBase}``."""
         raise NotImplementedError
 
+    def describe(self) -> str:
+        """Human-readable one-liner for plan provenance
+        (:meth:`Reader.pruning_report` records which selector dropped the
+        groups it dropped)."""
+        return type(self).__name__
+
 
 class SingleIndexSelector(RowGroupSelectorBase):
     """Row groups containing any of ``values_list`` in the named index."""
@@ -37,6 +43,9 @@ class SingleIndexSelector(RowGroupSelectorBase):
             selected |= set(indexer.get_row_group_indexes(v))
         return selected
 
+    def describe(self):
+        return f"{self._index_name} in {len(self._values)} value(s)"
+
 
 class IntersectIndexSelector(RowGroupSelectorBase):
     """Row groups selected by *all* member selectors."""
@@ -53,6 +62,9 @@ class IntersectIndexSelector(RowGroupSelectorBase):
     def select_row_groups(self, index_dict):
         sets = [s.select_row_groups(index_dict) for s in self._selectors]
         return set.intersection(*sets) if sets else set()
+
+    def describe(self):
+        return " AND ".join(s.describe() for s in self._selectors) or "(empty)"
 
 
 class UnionIndexSelector(RowGroupSelectorBase):
@@ -72,3 +84,6 @@ class UnionIndexSelector(RowGroupSelectorBase):
         for s in self._selectors:
             result |= s.select_row_groups(index_dict)
         return result
+
+    def describe(self):
+        return " OR ".join(s.describe() for s in self._selectors) or "(empty)"

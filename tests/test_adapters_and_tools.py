@@ -220,3 +220,108 @@ def test_copy_dataset_refuses_nested_paths(synthetic_dataset, tmp_path):
     ok_target = f"file://{tmp_path}/copy_sib"
     assert copy_dataset(synthetic_dataset.url, ok_target,
                         field_regex=["id"]) == 100
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_compare.py — cross-round regression diff (docs/io.md round 7)
+# ---------------------------------------------------------------------------
+def _load_tool(name):
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.io
+class TestBenchCompare:
+    @pytest.fixture(scope="class")
+    def tool(self):
+        return _load_tool("bench_compare")
+
+    def _write(self, tmp_path, name, doc):
+        import json
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_ok_within_threshold(self, tool, tmp_path, capsys):
+        old = self._write(tmp_path, "old.json",
+                          {"value": 100.0, "x_samples_per_sec": 50.0})
+        new = self._write(tmp_path, "new.json",
+                          {"value": 90.0, "x_samples_per_sec": 55.0})
+        assert tool.main([old, new]) == 0
+
+    def test_regression_fails(self, tool, tmp_path):
+        old = self._write(tmp_path, "old.json", {"value": 100.0})
+        new = self._write(tmp_path, "new.json", {"value": 70.0})
+        assert tool.main([old, new]) == 1
+        assert tool.main([old, new, "--threshold", "0.5"]) == 0
+
+    def test_nested_phases_and_p50_preference(self, tool, tmp_path):
+        old = self._write(tmp_path, "old.json", {
+            "value": 100.0, "value_p50": 100.0,
+            "mem": {"epoch2_speedup": 10.0}})
+        new = self._write(tmp_path, "new.json", {
+            "value": 200.0, "value_p50": 60.0,   # p50 regressed: must fail
+            "mem": {"epoch2_speedup": 9.5}})
+        assert tool.main([old, new]) == 1
+
+    def test_added_and_removed_phases_never_fail(self, tool, tmp_path):
+        old = self._write(tmp_path, "old.json",
+                          {"value": 100.0, "gone_samples_per_sec": 5.0})
+        new = self._write(tmp_path, "new.json",
+                          {"value": 100.0, "new_samples_per_sec": 5.0})
+        assert tool.main([old, new]) == 0
+
+    def test_driver_wrapper_unwrapped(self, tool, tmp_path):
+        old = self._write(tmp_path, "old.json",
+                          {"rc": 0, "parsed": {"value": 100.0}})
+        new = self._write(tmp_path, "new.json", {"value": 50.0})
+        assert tool.main([old, new]) == 1
+
+    def test_unreadable_input(self, tool, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        ok = self._write(tmp_path, "ok.json", {"value": 1.0})
+        assert tool.main([str(bad), ok]) == 2
+
+
+# ---------------------------------------------------------------------------
+# tools/check_columns.py — explicit columns= lint (docs/io.md)
+# ---------------------------------------------------------------------------
+@pytest.mark.io
+class TestCheckColumnsLint:
+    @pytest.fixture(scope="class")
+    def lint(self):
+        return _load_tool("check_columns")
+
+    def _violations(self, lint, tmp_path, code):
+        f = tmp_path / "mod.py"
+        f.write_text(code)
+        return lint.check_file(str(f))
+
+    @pytest.mark.parametrize("code", [
+        "pf.read_row_group(0)\n",
+        "pf.read_row_groups([0, 1])\n",
+        "pf.read_row_group(i, use_threads=False)\n",
+    ])
+    def test_flags_full_width_reads(self, lint, tmp_path, code):
+        assert len(self._violations(lint, tmp_path, code)) == 1
+
+    @pytest.mark.parametrize("code", [
+        "pf.read_row_group(0, columns=['a'])\n",
+        "pf.read_row_groups([0], columns=cols, use_threads=False)\n",
+        "pf.read_row_group(0)  # columns-ok: metadata tool, full width\n",
+        "read_row_group(0)\n",           # bare call, not a method
+        "pf.read()\n",
+    ])
+    def test_allows_explicit_columns_and_waivers(self, lint, tmp_path, code):
+        assert self._violations(lint, tmp_path, code) == []
+
+    def test_package_is_clean(self, lint):
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "petastorm_tpu")
+        assert lint.main([root]) == 0

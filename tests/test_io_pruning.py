@@ -1,0 +1,407 @@
+"""Statistics-driven row-group pruning (docs/io.md): the ``intervals()``
+predicate protocol, footer/summary statistics collection, and the Reader's
+plan-time pruning — including every edge the pruner must refuse to prune on
+(missing/disabled statistics, all-null groups, NaN bounds, cross-type
+comparisons) and the seeded-epoch equivalence guarantee."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.etl.dataset_metadata import (ColumnStats, DatasetContext,
+                                                load_row_group_stats,
+                                                load_row_groups)
+from petastorm_tpu.predicates import (FieldDomain, in_lambda, in_negate,
+                                      in_pseudorandom_split, in_range,
+                                      in_reduce, in_set)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+pytestmark = pytest.mark.io
+
+
+# ---------------------------------------------------------------------------
+# FieldDomain.admits_stats
+# ---------------------------------------------------------------------------
+def _stats(lo, hi, nulls=0, rows=10):
+    return ColumnStats(min=lo, max=hi, null_count=nulls, num_rows=rows,
+                       has_min_max=True)
+
+
+class TestFieldDomain:
+    def test_discrete_values_outside_bounds_prune(self):
+        d = FieldDomain(values={30, 70})
+        assert not d.admits_stats(_stats(0, 10))
+        assert d.admits_stats(_stats(0, 40))
+
+    def test_interval_exclusion_and_open_bounds(self):
+        # [20, 30) against max=20: only admitted because 20 is inclusive.
+        d = FieldDomain(intervals=((20, 30, True, False),))
+        assert d.admits_stats(_stats(0, 20))
+        # (20, 30): min==max==20 excluded by the open lower bound.
+        d_open = FieldDomain(intervals=((20, 30, False, False),))
+        assert not d_open.admits_stats(_stats(0, 20))
+        assert not d.admits_stats(_stats(31, 50))
+        assert not d.admits_stats(_stats(0, 19))
+
+    def test_unbounded_interval_sides(self):
+        d = FieldDomain(intervals=((None, 5, True, True),))
+        assert not d.admits_stats(_stats(6, 9))
+        assert d.admits_stats(_stats(0, 9))
+        d_lo = FieldDomain(intervals=((100, None, True, True),))
+        assert not d_lo.admits_stats(_stats(0, 99))
+
+    def test_missing_stats_always_admit(self):
+        d = FieldDomain(values={999})
+        assert d.admits_stats(ColumnStats(num_rows=10))
+
+    def test_nan_bounds_never_prove_exclusion(self):
+        d = FieldDomain(intervals=((0.0, 1.0, True, True),))
+        nan_stats = ColumnStats(min=float("nan"), max=float("nan"),
+                                null_count=0, num_rows=5, has_min_max=True)
+        assert d.admits_stats(nan_stats)
+
+    def test_nan_domain_value_never_proves_exclusion(self):
+        d = FieldDomain(values={float("nan")})
+        assert d.admits_stats(_stats(0.0, 1.0))
+
+    def test_cross_type_comparison_admits(self):
+        # Numeric domain against string statistics: unprovable, keep.
+        d = FieldDomain(values={5})
+        assert d.admits_stats(_stats("a", "z"))
+
+    def test_all_null_group_pruned_unless_nulls_accepted(self):
+        all_null = ColumnStats(null_count=10, num_rows=10)
+        assert not FieldDomain(values={1}).admits_stats(all_null)
+        assert FieldDomain(values={1},
+                           include_null=True).admits_stats(all_null)
+
+    def test_nulls_present_and_accepted_admit(self):
+        d = FieldDomain(values={999}, include_null=True)
+        assert d.admits_stats(_stats(0, 10, nulls=1))
+        # Unknown null count with include_null: must admit.
+        assert d.admits_stats(ColumnStats(min=0, max=10, num_rows=10,
+                                          has_min_max=True))
+
+    def test_unconstrained_domain_admits(self):
+        assert FieldDomain().admits_stats(_stats(0, 1))
+
+    def test_union(self):
+        u = FieldDomain(values={1}).union(
+            FieldDomain(intervals=((50, 60, True, True),)))
+        assert u.admits_stats(_stats(0, 2))
+        assert u.admits_stats(_stats(55, 58))
+        assert not u.admits_stats(_stats(10, 40))
+
+    def test_union_with_unconstrained_side_admits_everything(self):
+        """An unconstrained member of an OR admits any value; the union
+        must too — anything narrower would let the pruner drop rows that
+        member accepts."""
+        u = FieldDomain(values={5}).union(FieldDomain())
+        assert u.unconstrained
+        assert u.admits_stats(_stats(100, 200))
+        # and symmetrically, with include_null carried through
+        u2 = FieldDomain(include_null=True).union(FieldDomain(values={5}))
+        assert u2.unconstrained and u2.include_null
+
+
+# ---------------------------------------------------------------------------
+# intervals() protocol on the built-ins
+# ---------------------------------------------------------------------------
+class TestPredicateIntervals:
+    def test_in_set(self):
+        (field, d), = in_set({3, 7, None}, "id").intervals()
+        assert field == "id"
+        assert d.values == {3, 7}
+        assert d.include_null
+
+    def test_in_range_validation_and_do_include(self):
+        with pytest.raises(ValueError, match="at least one bound"):
+            in_range("id")
+        with pytest.raises(ValueError, match="empty range"):
+            in_range("id", 10, 5)
+        p = in_range("id", 5, 10)            # [5, 10)
+        assert p.do_include({"id": 5})
+        assert not p.do_include({"id": 10})
+        assert not p.do_include({"id": None})
+        assert not p.do_include({"id": float("nan")})
+        closed = in_range("id", 5, 10, include_upper=True)
+        assert closed.do_include({"id": 10})
+        lo_only = in_range("id", lower=100)
+        assert lo_only.do_include({"id": 1000})
+        assert not lo_only.do_include({"id": 99})
+
+    def test_unknown_predicates_return_none(self):
+        assert in_lambda(["id"], lambda v: True).intervals() is None
+        assert in_negate(in_set({1}, "id")).intervals() is None
+        assert in_pseudorandom_split([0.5, 0.5], 0, "id").intervals() is None
+
+    def test_in_reduce_all_concatenates(self):
+        p = in_reduce([in_range("id", 0, 50), in_set({7}, "id"),
+                       in_lambda(["x"], lambda v: True)], all)
+        constraints = p.intervals()
+        assert len(constraints) == 2  # the lambda contributes none
+
+    def test_in_reduce_any_unions_common_fields(self):
+        p = in_reduce([in_range("id", 0, 10), in_set({50}, "id")], any)
+        (field, d), = p.intervals()
+        assert field == "id"
+        assert d.admits_stats(_stats(2, 5))
+        assert d.admits_stats(_stats(45, 55))
+        assert not d.admits_stats(_stats(20, 30))
+
+    def test_in_reduce_any_with_unconstrained_member_is_none(self):
+        p = in_reduce([in_range("id", 0, 10),
+                       in_lambda(["id"], lambda v: True)], any)
+        assert p.intervals() is None
+
+    def test_in_reduce_any_disjoint_fields_is_none(self):
+        p = in_reduce([in_range("a", 0, 10), in_range("b", 0, 10)], any)
+        assert p.intervals() is None
+
+    def test_in_reduce_custom_reducer_is_none(self):
+        p = in_reduce([in_set({1}, "id")], lambda xs: sum(xs) % 2 == 1)
+        assert p.intervals() is None
+
+
+# ---------------------------------------------------------------------------
+# load_row_group_stats
+# ---------------------------------------------------------------------------
+def _write_store(path, table, row_group_size=10, **kw):
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, os.path.join(path, "part0.parquet"),
+                   row_group_size=row_group_size, **kw)
+    return f"file://{path}"
+
+
+class TestLoadRowGroupStats:
+    def test_footer_stats(self, tmp_path):
+        url = _write_store(str(tmp_path / "ds"), pa.table({
+            "id": np.arange(40, dtype=np.int64),
+            "s": [f"k{i:03d}" for i in range(40)]}))
+        ctx = DatasetContext(url)
+        rgs = load_row_groups(ctx)
+        stats = load_row_group_stats(ctx, rgs, {"id", "s"})
+        assert len(stats) == 4
+        first = stats[(rgs[0].path, 0)]
+        assert first["id"].min == 0 and first["id"].max == 9
+        assert first["id"].null_count == 0
+        assert first["id"].num_rows == 10
+        assert first["s"].has_min_max
+        last = stats[(rgs[3].path, 3)]
+        assert last["id"].min == 30 and last["id"].max == 39
+
+    def test_disabled_statistics(self, tmp_path):
+        url = _write_store(str(tmp_path / "ds"), pa.table({
+            "id": np.arange(20, dtype=np.int64)}), write_statistics=False)
+        ctx = DatasetContext(url)
+        rgs = load_row_groups(ctx)
+        stats = load_row_group_stats(ctx, rgs, {"id"})
+        assert all(not s["id"].has_min_max for s in stats.values())
+
+    def test_null_counts_and_all_null_group(self, tmp_path):
+        vals = [None] * 10 + list(range(10))
+        url = _write_store(str(tmp_path / "ds"),
+                           pa.table({"v": pa.array(vals, type=pa.int64())}))
+        ctx = DatasetContext(url)
+        rgs = load_row_groups(ctx)
+        stats = load_row_group_stats(ctx, rgs, {"v"})
+        g0 = stats[(rgs[0].path, 0)]
+        assert g0["v"].null_count == 10 and g0["v"].num_rows == 10
+        g1 = stats[(rgs[1].path, 1)]
+        assert g1["v"].null_count == 0 and g1["v"].has_min_max
+
+    def test_nested_columns_skipped(self, tmp_path):
+        url = _write_store(str(tmp_path / "ds"), pa.table({
+            "id": np.arange(10, dtype=np.int64),
+            "vec": pa.array([[1.0, 2.0]] * 10, type=pa.list_(pa.float32()))}))
+        ctx = DatasetContext(url)
+        rgs = load_row_groups(ctx)
+        stats = load_row_group_stats(ctx, rgs, {"id", "vec"})
+        assert "vec" not in stats[(rgs[0].path, 0)]
+        assert "id" in stats[(rgs[0].path, 0)]
+
+    def test_summary_metadata_source(self, tmp_path):
+        from petastorm_tpu.etl.dataset_metadata import write_summary_metadata
+        url = _write_store(str(tmp_path / "ds"), pa.table({
+            "id": np.arange(30, dtype=np.int64)}))
+        write_summary_metadata(url)
+        ctx = DatasetContext(url)
+        rgs = load_row_groups(ctx)
+        stats = load_row_group_stats(ctx, rgs, {"id"})
+        assert stats[(rgs[2].path, 2)]["id"].min == 20
+
+
+# ---------------------------------------------------------------------------
+# Reader-level pruning
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def monotonic_store(tmp_path_factory):
+    """100 rows / 10 row groups, monotonic id, plus a float column whose
+    group 3 is all-NaN and a nullable column whose group 4 is all-null."""
+    path = str(tmp_path_factory.mktemp("prune") / "ds")
+    n = 100
+    f = np.linspace(0.0, 1.0, n)
+    f[30:40] = np.nan
+    v = pa.array([None if 40 <= i < 50 else i for i in range(n)],
+                 type=pa.int64())
+    url = _write_store(path, pa.table({
+        "id": np.arange(n, dtype=np.int64), "f": f, "v": v}))
+    return url
+
+
+def _batch_ids(reader):
+    out = []
+    for b in reader:
+        out.extend(int(x) for x in b.id)
+    return out
+
+
+class TestReaderPruning:
+    def test_prunes_and_rows_identical(self, monotonic_store):
+        kw = dict(shuffle_row_groups=False, reader_pool_type="thread",
+                  workers_count=2, predicate=in_range("id", 0, 25))
+        with make_batch_reader(monotonic_store, **kw) as r:
+            on = _batch_ids(r)
+            rep = r.pruning_report()
+            counters = r.telemetry.snapshot()["counters"]
+        with make_batch_reader(monotonic_store, rowgroup_pruning=False,
+                               **kw) as r:
+            off = _batch_ids(r)
+            rep_off = r.pruning_report()
+        assert on == off == list(range(25))
+        assert rep["enabled"] and rep["row_groups_pruned"] == 7
+        assert rep["row_groups_kept"] == 3
+        assert rep["fields"] == ["id"]
+        assert counters["io.rowgroups_pruned"] == 7
+        assert not rep_off["enabled"]
+
+    def test_seeded_shuffle_equivalent_row_set_and_deterministic(
+            self, monotonic_store):
+        kw = dict(shuffle_row_groups=True, seed=11, reader_pool_type="thread",
+                  workers_count=2, predicate=in_range("id", 0, 25))
+        with make_batch_reader(monotonic_store, **kw) as r:
+            on1 = _batch_ids(r)
+        with make_batch_reader(monotonic_store, **kw) as r:
+            on2 = _batch_ids(r)
+        with make_batch_reader(monotonic_store, rowgroup_pruning=False,
+                               **kw) as r:
+            off = _batch_ids(r)
+        assert on1 == on2                      # seeded determinism holds
+        assert sorted(on1) == sorted(off)      # identical surviving rows
+
+    def test_predicate_without_intervals_zero_behavior_change(
+            self, monotonic_store):
+        pred = in_lambda(["id"], lambda v: v["id"] < 25)
+        with make_batch_reader(monotonic_store, shuffle_row_groups=False,
+                               predicate=pred, workers_count=2) as r:
+            ids = _batch_ids(r)
+            rep = r.pruning_report()
+            counters = r.telemetry.snapshot()["counters"]
+        assert ids == list(range(25))
+        assert not rep["enabled"]
+        assert "no intervals" in rep["reason"]
+        assert "io.rowgroups_pruned" not in counters
+
+    def test_nan_bound_group_never_wrongly_pruned(self, monotonic_store):
+        # Group 3's f column is all-NaN; its id stats still prune by id,
+        # but an f-range predicate must keep every group with usable or
+        # NaN bounds and drop only provably-disjoint ones.
+        pred = in_range("f", 0.5, 0.65)
+        kw = dict(shuffle_row_groups=False, workers_count=2, predicate=pred)
+        with make_batch_reader(monotonic_store, **kw) as r:
+            on = _batch_ids(r)
+            rep = r.pruning_report()
+        with make_batch_reader(monotonic_store, rowgroup_pruning=False,
+                               **kw) as r:
+            off = _batch_ids(r)
+        assert on == off
+        # the all-NaN group must be among the kept ones (unprovable)
+        assert rep["row_groups_pruned"] < 9
+
+    def test_all_null_group_pruned_by_non_null_domain(self, monotonic_store):
+        # v is all-null in group 4 (ids 40-49) and equals id elsewhere:
+        # in_set({44}) can only match in group 4 — which is all null, so
+        # EVERY group is provably empty and the epoch is empty.
+        with make_batch_reader(monotonic_store, shuffle_row_groups=False,
+                               predicate=in_set({44}, "v"),
+                               workers_count=2) as r:
+            ids = _batch_ids(r)
+            rep = r.pruning_report()
+        assert ids == []
+        assert rep["row_groups_kept"] == 0
+
+    def test_disabled_statistics_zero_behavior_change(self, tmp_path):
+        url = _write_store(str(tmp_path / "nostats"), pa.table({
+            "id": np.arange(50, dtype=np.int64)}), write_statistics=False)
+        kw = dict(shuffle_row_groups=False, workers_count=2,
+                  predicate=in_range("id", 0, 10))
+        with make_batch_reader(url, **kw) as r:
+            ids = _batch_ids(r)
+            rep = r.pruning_report()
+        assert ids == list(range(10))
+        assert rep["enabled"] and rep["row_groups_pruned"] == 0
+
+    def test_partition_key_predicate_prunes(self, tmp_path):
+        """A MIXED predicate (partition key AND data column): the legacy
+        all-partition-keys plan pruning cannot engage, so the statistics
+        pruner must — synthesizing ``min == max`` statistics from the hive
+        partition value — while a partition-key-only predicate keeps its
+        legacy pruning with identical rows either way."""
+        root = str(tmp_path / "hive")
+        for year, base in (("2023", 0), ("2024", 100)):
+            _write_store(os.path.join(root, f"year={year}"), pa.table({
+                "id": np.arange(base, base + 20, dtype=np.int64)}))
+        url = f"file://{root}"
+        mixed = in_reduce([in_set({"2024"}, "year"),
+                           in_range("id", 0, 1000)], all)
+        with make_batch_reader(url, schema_fields=["id"],
+                               shuffle_row_groups=False, workers_count=2,
+                               predicate=mixed) as r:
+            ids = _batch_ids(r)
+            rep = r.pruning_report()
+        assert ids == list(range(100, 120))
+        assert rep["row_groups_pruned"] == 2  # both year=2023 groups
+
+        # Partition-key-only predicate: legacy plan pruning already drops
+        # the groups before statistics run; rows identical, nothing left
+        # for the stats pruner.
+        with make_batch_reader(url, schema_fields=["id"],
+                               shuffle_row_groups=False, workers_count=2,
+                               predicate=in_set({"2024"}, "year")) as r:
+            assert _batch_ids(r) == list(range(100, 120))
+            assert r.pruning_report()["row_groups_pruned"] == 0
+
+    def test_row_reader_pruning_identical_rows(self, synthetic_dataset):
+        kw = dict(shuffle_row_groups=False, reader_pool_type="thread",
+                  workers_count=2, predicate=in_range("id", 0, 30))
+        with make_reader(synthetic_dataset.url, **kw) as r:
+            on = sorted(row.id for row in r)
+            rep = r.pruning_report()
+        with make_reader(synthetic_dataset.url, rowgroup_pruning=False,
+                         **kw) as r:
+            off = sorted(row.id for row in r)
+        assert on == off == list(range(30))
+        assert rep["row_groups_pruned"] == 7  # 10 groups of 10 ids
+
+    def test_empty_plan_is_empty_epoch_not_error(self, monotonic_store):
+        with make_batch_reader(monotonic_store, shuffle_row_groups=False,
+                               predicate=in_set({-1}, "id"),
+                               workers_count=2) as r:
+            assert _batch_ids(r) == []
+            assert r.pruning_report()["row_groups_kept"] == 0
+
+    def test_pruning_respects_sharding(self, monotonic_store):
+        """Shard membership is computed before pruning, so each shard's
+        surviving rows are identical pruning on/off."""
+        for shard in (0, 1):
+            kw = dict(shuffle_row_groups=False, workers_count=2,
+                      cur_shard=shard, shard_count=2,
+                      predicate=in_range("id", 0, 45))
+            with make_batch_reader(monotonic_store, **kw) as r:
+                on = _batch_ids(r)
+            with make_batch_reader(monotonic_store, rowgroup_pruning=False,
+                                   **kw) as r:
+                off = _batch_ids(r)
+            assert on == off

@@ -153,3 +153,29 @@ def test_reference_compat_kwargs_warn_not_raise(synthetic_dataset):
                          shuffle_row_groups=False, schema_fields=["id"],
                          pyarrow_serialize=True) as r:
             next(iter(r))
+
+
+@pytest.mark.io
+def test_selector_provenance_in_pruning_report(tmp_path):
+    """A rowgroup_selector's plan-time drops land in the same provenance
+    surface as statistics pruning (Reader.pruning_report, docs/io.md)."""
+    from dataset_utils import TestSchema, make_test_row
+    from petastorm_tpu.etl.writer import materialize_dataset_local
+    url = f"file://{tmp_path}/ds"
+    rng = np.random.default_rng(0)
+    rows = [make_test_row(i, rng) for i in range(100)]
+    for r in rows:
+        r["partition_key"] = f"p_{r['id'] // 25}"
+    with materialize_dataset_local(url, TestSchema, rows_per_row_group=25,
+                                   rows_per_file=50) as w:
+        w.write_rows(rows)
+    build_rowgroup_index(url, [SingleFieldIndexer("by_pk", "partition_key")])
+
+    selector = SingleIndexSelector("by_pk", ["p_2"])
+    assert selector.describe() == "by_pk in 1 value(s)"
+    with make_reader(url, rowgroup_selector=selector, shuffle_row_groups=False,
+                     reader_pool_type="dummy",
+                     schema_fields=["id"]) as r:
+        rep = r.pruning_report()
+    assert rep["selector"] == "by_pk in 1 value(s)"
+    assert rep["selector_pruned"] == 3  # 4 groups of 25, one kept

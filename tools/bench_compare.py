@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Cross-round benchmark diff: fail CI on a real regression.
+
+Compares two round artifacts (``BENCH_r*.json`` — either bench.py's raw
+JSON line or the driver's ``{"parsed": {...}, "tail": ...}`` wrapper) and
+exits 1 when any **shared** phase regressed by more than ``--threshold``
+(default 20%).
+
+What counts as a phase: every numeric key — at top level or one dict
+level deep (``mem_cache_epoch.epoch2_speedup``) — whose name marks it as
+a higher-is-better measurement: ``*_samples_per_sec``, ``*_per_sec``,
+``*_speedup``, ``*_improvement``, or the headline ``value``. Keys present
+in only one artifact are reported as added/removed, never failed — new
+phases must not brick the first round that introduces them. Medians are
+preferred over best-of-N when the artifact carries them (``<key>_p50``),
+the same discipline bench.py's own ``vs_prior_round`` guard uses.
+
+Usage::
+
+    python tools/bench_compare.py OLD.json NEW.json [--threshold 0.2]
+    make bench-compare        # newest two committed BENCH_r*.json
+
+Exit codes: 0 ok / no overlap, 1 regression, 2 unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Higher-is-better phase keys (suffix match), plus the headline "value".
+_PHASE_RE = re.compile(
+    r"(_samples_per_sec|_per_sec|_speedup|_improvement)$")
+
+
+def load_round(path: str) -> dict:
+    """The bench JSON line of one round artifact, unwrapping the driver's
+    ``{"parsed": ..., "tail": ...}`` format when present."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data.get("parsed"), dict) and "value" in data["parsed"]:
+        return data["parsed"]
+    if "value" not in data and "tail" in data:
+        for line in reversed(str(data["tail"]).splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+    return data
+
+
+def phase_values(doc: dict) -> dict:
+    """``{phase_key: value}`` of every higher-is-better metric, p50 medians
+    preferred over best-of-N, nested one level (``block.key``)."""
+    out = {}
+
+    def visit(prefix: str, d: dict):
+        for k, v in d.items():
+            if k.endswith("_p50") or k.endswith("_spread_pct"):
+                continue
+            name = f"{prefix}{k}"
+            if isinstance(v, dict) and not prefix:  # one level deep only
+                visit(f"{k}.", v)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and (_PHASE_RE.search(k) or (not prefix and k == "value")):
+                p50 = d.get(f"{k}_p50")
+                out[name] = float(p50 if isinstance(p50, (int, float))
+                                  else v)
+
+    visit("", doc)
+    return out
+
+
+def compare(old: dict, new: dict, threshold: float) -> tuple:
+    """``(report_rows, regressions)`` over the shared phase keys."""
+    old_phases, new_phases = phase_values(old), phase_values(new)
+    rows, regressions = [], []
+    for key in sorted(set(old_phases) | set(new_phases)):
+        o, n = old_phases.get(key), new_phases.get(key)
+        if o is None:
+            rows.append((key, "added", None, n, None))
+            continue
+        if n is None:
+            rows.append((key, "removed", o, None, None))
+            continue
+        if o <= 0:
+            rows.append((key, "skipped (non-positive baseline)", o, n, None))
+            continue
+        delta = (n - o) / o
+        status = "ok"
+        if delta < -threshold:
+            status = "REGRESSION"
+            regressions.append(key)
+        rows.append((key, status, o, n, delta))
+    return rows, regressions
+
+
+def _newest_artifacts() -> list:
+    paths = []
+    for path in glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            paths.append((int(m.group(1)), path))
+    return [p for _, p in sorted(paths)]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("old", nargs="?", help="baseline round artifact")
+    parser.add_argument("new", nargs="?", help="candidate round artifact")
+    parser.add_argument("--threshold", type=float, default=0.20,
+                        help="max tolerated fractional drop (default 0.20)")
+    args = parser.parse_args(argv)
+
+    old_path, new_path = args.old, args.new
+    if old_path is None or new_path is None:
+        artifacts = _newest_artifacts()
+        if len(artifacts) < 2:
+            print("bench_compare: fewer than two BENCH_r*.json artifacts; "
+                  "nothing to compare")
+            return 0
+        old_path, new_path = artifacts[-2], artifacts[-1]
+
+    try:
+        old, new = load_round(old_path), load_round(new_path)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: cannot read artifacts: {e}", file=sys.stderr)
+        return 2
+
+    rows, regressions = compare(old, new, args.threshold)
+    print(f"bench_compare: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} (threshold "
+          f"{args.threshold:.0%})")
+    for key, status, o, n, delta in rows:
+        detail = "" if delta is None else f" ({delta:+.1%})"
+        print(f"  {status:>10}  {key}: {o} -> {n}{detail}")
+    if not any(status in ("ok", "REGRESSION") for _, status, *_ in rows):
+        print("bench_compare: no shared phases between the artifacts")
+        return 0
+    if regressions:
+        print(f"bench_compare: {len(regressions)} phase(s) regressed "
+              f"beyond {args.threshold:.0%}: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1
+    print("bench_compare: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

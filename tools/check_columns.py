@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Lint guard: no accidental full-width row-group reads.
+
+``pq.ParquetFile.read_row_group(i)`` / ``read_row_groups(ids)`` with no
+``columns=`` deserializes EVERY column of the group — on a wide store
+that silently multiplies IO and decode by the column count, which is
+exactly the waste the statistics pruner and readahead stage exist to
+eliminate (docs/io.md). Every call site in the package must pass an
+explicit ``columns=`` list; a site that genuinely wants the full width
+(a metadata tool enumerating a store, a test asserting raw contents)
+says so with a ``columns-ok`` comment on the call line.
+
+Scope: ``petastorm_tpu/`` (tests may read whole groups to assert raw
+file contents; they are not on any hot path).
+
+Usage::
+
+    python tools/check_columns.py            # scan petastorm_tpu/
+    python tools/check_columns.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATHS = ("petastorm_tpu",)
+
+WAIVER = "columns-ok"
+
+READ_METHODS = frozenset({"read_row_group", "read_row_groups"})
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _violating_calls(tree: ast.AST):
+    """Yield every ``<expr>.read_row_group(s)(...)`` call with no
+    ``columns=`` keyword."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in READ_METHODS
+                and not any(kw.arg == "columns" for kw in node.keywords)):
+            yield node
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived full-width read."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    for call in sorted(_violating_calls(tree), key=lambda c: c.lineno):
+        line = lines[call.lineno - 1] if call.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{call.lineno}: {call.func.attr}() without an explicit "
+            f"columns= list reads EVERY column of the row group; pass the "
+            f"needed columns (docs/io.md), or add "
+            f"'# {WAIVER}: <why full width is intended>'")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+    for violation in all_violations:
+        print(violation, file=sys.stderr)
+    if all_violations:
+        print(f"check_columns: {len(all_violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_columns: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ one of the knob setters:
 * ``set_max_inflight``   (ConcurrentVentilator — ventilation depth)
 * ``set_target_capacity``(shuffling buffers — target row count)
 * ``set_prefetch_depth`` (JAX LoaderBase — staged-batch queue depth)
+* ``set_readahead_depth``(ReadaheadFetcher — row-group fetch-ahead depth)
 
 A definition of these methods is fine anywhere (the components OWN their
 knobs); only *calls* are restricted. A legitimate out-of-band call (e.g. a
@@ -48,6 +49,7 @@ KNOB_SETTERS = frozenset({
     "set_max_inflight",
     "set_target_capacity",
     "set_prefetch_depth",
+    "set_readahead_depth",
 })
 
 
