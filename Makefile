@@ -45,6 +45,7 @@ ci-lint:
 	python tools/check_knobs.py
 	python tools/check_timeouts.py
 	python tools/check_columns.py
+	python tools/check_copies.py
 
 # Diff the two newest committed round artifacts; fails on a >20% drop in
 # any shared bench phase (tools/bench_compare.py for the phase-key rules).

@@ -123,7 +123,8 @@ def _prior_round_artifact() -> tuple[str, dict] | tuple[None, None]:
 # Phases compared round-over-round: (current-artifact p50 key | best key).
 _REGRESSION_PHASES = ("value", "hello_world_10k_samples_per_sec",
                       "best_config_samples_per_sec",
-                      "scalar_batched_samples_per_sec")
+                      "scalar_batched_samples_per_sec",
+                      "scalar_batched_process_samples_per_sec")
 
 
 def _regression_guard(out: dict) -> None:
@@ -314,6 +315,71 @@ def main():
         scalar_sps = None
         # (recorded below only when measured)
         print(f"scalar_batched failed: {e!r}", file=sys.stderr)
+
+    # ---- 4a2. process_pool_decode_epoch (docs/zero_copy.md): the columnar
+    # decode pipeline (make_batch_reader -> BatchedDataLoader) over
+    # identical thread and process pools — the head-to-head ROADMAP item 3
+    # is judged on. Round 8 gave the process pool a zero-copy shm Arrow
+    # plane (no pickle round-trip for batch readers, S/P/D preallocated
+    # chunk reassembly, segment claims, dlpack staging), so the backend
+    # that scales past the GIL no longer pays 3.4x in serialization. Two
+    # stores: the 20-column scalar store (the decode plane's headline) and
+    # a heavier one with 64-dim embedding columns (~5x bytes/row) where the
+    # transport still moves real volume — on starved hosts threads may win
+    # the heavy store, which is exactly why placement is an autotune
+    # actuator and not an assumption.
+    decode_epoch_child = (
+        "import json, os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import pyarrow as pa\n"
+        "import pyarrow.parquet as pq\n"
+        "from petastorm_tpu.benchmark.scalar_bench import batched_loader_throughput\n"
+        "scalar_url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'tensor_50k')\n"
+        "if not os.path.exists(os.path.join(store, 'part0.parquet')):\n"
+        "    os.makedirs(store, exist_ok=True)\n"
+        "    n, rng = 50_000, np.random.default_rng(0)\n"
+        "    cols = {'id': np.arange(n, dtype=np.int64)}\n"
+        "    cols.update({'f%d' % i: rng.standard_normal(n).astype(np.float32)\n"
+        "                 for i in range(8)})\n"
+        "    for j in range(2):\n"
+        "        flat = rng.standard_normal(n * 64).astype(np.float32)\n"
+        "        cols['emb%d' % j] = pa.FixedSizeListArray.from_arrays(\n"
+        "            pa.array(flat), 64)\n"
+        "    pq.write_table(pa.table(cols), os.path.join(store, 'part0.parquet'),\n"
+        "                   row_group_size=2048)\n"
+        "tensor_url = 'file://' + store\n"
+        "def sweep(url, pool, workers, batches):\n"
+        "    return [batched_loader_throughput(url, pool_type=pool,\n"
+        "                                      workers_count=workers,\n"
+        "                                      measure_batches=batches)\n"
+        "            for _ in range(2)]\n"
+        "out = {'scalar_thread': sweep(scalar_url, 'thread', 3, 300),\n"
+        "       'scalar_process': sweep(scalar_url, 'process', 2, 300),\n"
+        "       'tensor_thread': sweep(tensor_url, 'thread', 3, 200),\n"
+        "       'tensor_process': sweep(tensor_url, 'process', 2, 200)}\n"
+        "print('BENCHJSON:' + json.dumps(out))\n")
+    try:
+        decode_epoch = _cpu_subprocess(decode_epoch_child, data_dir,
+                                       timeout_s=1500.0)
+        p50 = {k: statistics.median(v) for k, v in decode_epoch.items()}
+        out["process_pool_decode_epoch"] = {
+            f"{k}_samples_per_sec": round(v, 2) for k, v in p50.items()}
+        out["process_pool_decode_epoch"].update({
+            "scalar_process_vs_thread": round(
+                p50["scalar_process"] / max(p50["scalar_thread"], 1e-9), 3),
+            "tensor_process_vs_thread": round(
+                p50["tensor_process"] / max(p50["tensor_thread"], 1e-9), 3),
+            "runs": {k: [round(s, 1) for s in v]
+                     for k, v in decode_epoch.items()},
+        })
+        # The per-round regression surface for the process-pool transport.
+        out["scalar_batched_process_samples_per_sec"] = round(
+            p50["scalar_process"], 2)
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"process_pool_decode_epoch failed: {e!r}", file=sys.stderr)
 
     # ---- 4b. input-stall sweep vs an emulated device step (round-4
     # verdict item 2): the pipeline's own headline contract — "reader

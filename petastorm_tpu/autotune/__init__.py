@@ -18,7 +18,12 @@ reporting it (docs/autotune.md):
 * :mod:`~petastorm_tpu.autotune.mem_cache` — the in-memory *decoded*
   row-group LRU :class:`InMemoryRowGroupCache` with cost-aware admission,
   so multi-epoch training reads Parquet once and serves epochs >= 2 from
-  RAM.
+  RAM;
+* :mod:`~petastorm_tpu.autotune.placement` — the cedar-style
+  :class:`PlacementActuator`: with ``AutotuneConfig(placement=True)`` the
+  controller migrates the decode stage thread<->process when every
+  conventional knob is maxed, measures, and pins the winner
+  (docs/zero_copy.md).
 
 Enable via ``make_reader(..., autotune=True,
 memory_cache_size_bytes=2 << 30)``; every decision lands in ``autotune.*``
@@ -34,11 +39,12 @@ from petastorm_tpu.autotune.budget import MemoryBudget, payload_nbytes
 from petastorm_tpu.autotune.controller import (AutotuneConfig,
                                                AutotuneController)
 from petastorm_tpu.autotune.mem_cache import InMemoryRowGroupCache
+from petastorm_tpu.autotune.placement import PlacementActuator
 
 __all__ = [
     "Actuator", "AutotuneConfig", "AutotuneController",
-    "InMemoryRowGroupCache", "MemoryBudget", "PrefetchDepthActuator",
-    "ReadaheadDepthActuator", "ShuffleTargetActuator",
-    "VentilatorDepthActuator", "WorkerConcurrencyActuator",
-    "payload_nbytes",
+    "InMemoryRowGroupCache", "MemoryBudget", "PlacementActuator",
+    "PrefetchDepthActuator", "ReadaheadDepthActuator",
+    "ShuffleTargetActuator", "VentilatorDepthActuator",
+    "WorkerConcurrencyActuator", "payload_nbytes",
 ]

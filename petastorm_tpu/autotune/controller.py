@@ -81,6 +81,18 @@ class AutotuneConfig:
     memory_budget_bytes: Optional[int] = None
     queue_empty_frac: float = 0.1
     queue_full_frac: float = 0.9
+    #: Opt-in cedar-style placement tuning (docs/zero_copy.md): when the
+    #: pipeline stays producer-bound with every conventional knob maxed,
+    #: migrate the decode stage to the other pool backend
+    #: (thread<->process), measure, keep the winner, pin. The owning Reader
+    #: only registers the placement actuator when this is True (and the
+    #: reader configuration is migratable — no readahead/watchdog).
+    placement: bool = False
+    #: Ticks to wait after a completed migration before judging it (the new
+    #: pool's spawn + warmup must not count against it).
+    placement_settle_ticks: int = 12
+    #: Relative rows/sec loss that reverts a placement trial.
+    placement_tolerance: float = 0.15
 
     def __post_init__(self):
         if self.hysteresis < 1:
@@ -95,6 +107,12 @@ class AutotuneConfig:
                 and self.memory_budget_bytes <= 0:
             raise ValueError(f"memory_budget_bytes must be > 0, "
                              f"got {self.memory_budget_bytes}")
+        if self.placement_settle_ticks < 1:
+            raise ValueError(f"placement_settle_ticks must be >= 1, "
+                             f"got {self.placement_settle_ticks}")
+        if not 0.0 < self.placement_tolerance < 1.0:
+            raise ValueError(f"placement_tolerance must be in (0, 1), "
+                             f"got {self.placement_tolerance}")
 
 
 class AutotuneController:
@@ -126,6 +144,14 @@ class AutotuneController:
         self._streak = 0
         self._cooldown = 0
         self._tick_count = 0
+        # Placement-trial state (docs/zero_copy.md): a rolling rows/tick
+        # window feeds the before/after comparison; one trial per reader
+        # lifetime, then the knob pins to the measured winner.
+        from collections import deque
+        self._rate_window: deque = deque(maxlen=8)
+        self._placement_trial: Optional[dict] = None
+        self._placement_pinned = False
+        self._placement_apply_failures = 0
         #: ``(tick, actuator, old, new, verdict)`` rows, append-only.
         self.history: List[tuple] = []
         self._thread: Optional[threading.Thread] = None
@@ -169,6 +195,8 @@ class AutotuneController:
         self._prev_counters = dict(counters)
         self._tick_count += 1
         self._ticks_total.add(1)
+        self._rate_window.append(deltas.get("reader.rows", 0.0))
+        self._placement_trial_tick()
 
         verdict = self._diagnose(deltas, gauges)
         self._verdict_counters[verdict].add(1)
@@ -226,6 +254,77 @@ class AutotuneController:
                 return "consumer_bound"
         return "balanced"
 
+    # ------------------------------------------------- placement (cedar)
+    def _placement_trial_tick(self) -> None:
+        """Advance the one-shot placement trial: wait for the migration to
+        apply, let ``placement_settle_ticks`` pass, then keep or revert by
+        measured rows/tick and PIN the knob (docs/zero_copy.md)."""
+        trial = self._placement_trial
+        if trial is None:
+            return
+        act = self.actuator("placement")
+        if act is None:  # actuator unregistered mid-trial (teardown)
+            self._placement_trial = None
+            return
+        if not act.applied:
+            return  # migration still in flight; settle starts at apply
+        if getattr(act, "last_apply_failed", False):
+            # The migration never happened (quiesce/drain timeout, pool
+            # start failure): cancel the trial instead of measuring the
+            # unchanged backend against its own baseline. Retry is allowed
+            # — but repeated failures pin, so a permanently-unquiesceable
+            # pipeline doesn't pay a pause attempt per hysteresis window.
+            self._placement_trial = None
+            self._placement_apply_failures += 1
+            if self._placement_apply_failures >= 2:
+                self._placement_pinned = True
+            return
+        if trial.get("reverting"):
+            # The revert migration landed: trial over, loser measured.
+            self._placement_pinned = True
+            self._placement_trial = None
+            return
+        if "settle_left" not in trial:
+            trial["settle_left"] = self.config.placement_settle_ticks
+            self._rate_window.clear()
+            return
+        trial["settle_left"] -= 1
+        if trial["settle_left"] > 0:
+            return
+        baseline = trial["baseline"]
+        current = (sum(self._rate_window) / len(self._rate_window)
+                   if self._rate_window else 0.0)
+        if baseline > 0 and current < baseline * (
+                1.0 - self.config.placement_tolerance):
+            # The new backend measurably lost: flip back and pin there.
+            old = act.value
+            act.set(1 - old)
+            self.history.append((self._tick_count, "placement", old,
+                                 act.value, "placement_revert"))
+            trial.clear()
+            trial["reverting"] = True
+        else:
+            # Winner (or wash — migration cost is sunk, stay put): pin.
+            self._placement_pinned = True
+            self._placement_trial = None
+
+    def _try_placement(self, acts, verdict: str) -> bool:
+        """Last rung of the producer-bound ladder: start the one-shot
+        placement trial (thread<->process toggle) once every conventional
+        knob is maxed out."""
+        act = acts.get("placement")
+        if act is None or self._placement_pinned \
+                or self._placement_trial is not None or not act.applied:
+            return False
+        baseline = (sum(self._rate_window) / len(self._rate_window)
+                    if self._rate_window else 0.0)
+        old = act.value
+        act.set(1 - old)
+        self.history.append((self._tick_count, "placement", old, act.value,
+                             verdict))
+        self._placement_trial = {"baseline": baseline}
+        return True
+
     def _act(self, verdict: str) -> bool:
         """Apply one step of adjustment for the verdict; True if any
         actuator actually moved."""
@@ -244,6 +343,10 @@ class AutotuneController:
                 moved = self._nudge(acts.get(name), delta, verdict)
                 if moved:
                     break
+            if not moved:
+                # Every knob maxed and still producer-bound: placement is
+                # the remaining degree of freedom (one measured trial).
+                moved = self._try_placement(acts, verdict)
         elif verdict == "consumer_bound":
             # Prefetch first (idle staged batches only cost memory); once
             # it is floored, shed decode concurrency — parked workers stop

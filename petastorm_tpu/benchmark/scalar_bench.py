@@ -39,16 +39,20 @@ def generate_scalar_dataset(output_url: str, rows: int = 100_000,
 def batched_loader_throughput(dataset_url: str, batch_size: int = 1024,
                               workers_count: int = 3,
                               warmup_batches: int = 10,
-                              measure_batches: int = 300) -> float:
+                              measure_batches: int = 300,
+                              pool_type: str = "thread") -> float:
     """Samples/sec through ``make_batch_reader`` -> ``BatchedDataLoader``
     (host batches; staging thread included, no device in the loop so the
-    number is comparable across hosts with and without an accelerator)."""
+    number is comparable across hosts with and without an accelerator).
+    ``pool_type='process'`` runs the same pipeline over spawned workers +
+    the zero-copy shm Arrow transport — the pair of numbers round 8's
+    transport work is judged against (docs/zero_copy.md)."""
     from petastorm_tpu.jax import BatchedDataLoader
     from petastorm_tpu.reader import make_batch_reader
 
     with make_batch_reader(dataset_url, num_epochs=None,
                            shuffle_row_groups=False,
-                           reader_pool_type="thread",
+                           reader_pool_type=pool_type,
                            workers_count=workers_count) as reader:
         with BatchedDataLoader(reader, batch_size=batch_size) as loader:
             it = iter(loader)

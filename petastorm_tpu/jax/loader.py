@@ -150,6 +150,9 @@ class LoaderBase:
         # stage_breakdown(), so remember where this loader started.
         self._shuffle_base = self._shuffle_time.value
         self._last_staged_bytes = 0
+        # Lazily-resolved: does staging target a CPU device (=> dlpack
+        # buffer adoption instead of a device_put host copy)?
+        self._cpu_dlpack: Optional[bool] = None
         self._skipped_warned: set = set()
         # Per-column sticky conversion: "drop" or (kind, row_shape, dtype).
         self._object_column_mode: Dict[str, object] = {}
@@ -278,6 +281,48 @@ class LoaderBase:
                 "row reader) to keep them.")
 
     # ------------------------------------------------------------ staging
+    def _cpu_dlpack_target(self) -> bool:
+        """True when staging lands on a CPU device, where ``jax.dlpack``
+        can adopt the host array's buffer outright — ``device_put``'s
+        host->host memcpy disappears (docs/zero_copy.md). Resolved once:
+        the target backend cannot change mid-loader."""
+        if self._cpu_dlpack is None:
+            try:
+                import jax
+                platform = (self._device.platform if self._device is not None
+                            else jax.default_backend())
+                self._cpu_dlpack = (platform == "cpu"
+                                    and self._sharding is None)
+            except Exception:  # noqa: BLE001 - backend probe failed
+                self._cpu_dlpack = False
+        return self._cpu_dlpack
+
+    #: Columns below this size stay on the ONE batched ``device_put`` call:
+    #: dlpack adoption saves the memcpy but pays a per-array dispatch, and
+    #: measured on the bench host the crossover sits near 1 MiB (649 us for
+    #: a 20-column batched put vs ~1.5 ms for 20 per-column adoptions; at
+    #: 4 MiB a single adoption wins 349 us vs 632 us).
+    _DLPACK_MIN_BYTES = 1 << 20
+
+    @staticmethod
+    def _dlpack_adoptable(value: np.ndarray) -> bool:
+        """C-contiguous, writeable (numpy refuses to export read-only
+        buffers pre-DLPack-1.0), natively-typed, and big enough that
+        skipping the memcpy beats the per-array dispatch.
+
+        Ownership invariant (why adoption is safe): every column reaching
+        ``_stage`` is a per-batch allocation — a shuffle-buffer
+        ``retrieve()`` copy, a collate ``np.stack``/``np.pad``, a sanitize
+        ``astype``, or an InMem fancy-index — or a read-only zero-copy
+        Arrow view, which this check excludes. Nothing in the pipeline
+        REUSES a writeable staged buffer for a later batch (a TransformSpec
+        output is re-tabled/re-collated before it gets here), so the
+        adopted jax array can never be mutated underneath the training
+        step. Anyone adding a buffer-pooling producer must revisit this."""
+        return (value.nbytes >= LoaderBase._DLPACK_MIN_BYTES
+                and value.flags.c_contiguous and value.flags.writeable
+                and value.dtype.kind in "biufc" and value.size > 0)
+
     def _stage(self, host_batch: Dict[str, np.ndarray]) -> dict:
         import jax
         device_cols, host_cols = sanitize_batch(host_batch, self._policy)
@@ -287,6 +332,27 @@ class LoaderBase:
                 k: jax.make_array_from_process_local_data(self._sharding, v)
                 for k, v in device_cols.items()
             }
+        elif self._cpu_dlpack_target():
+            # CPU backend: adopt big host buffers via dlpack — zero-copy
+            # from collate (or straight from the shm ring's Arrow views)
+            # into jax.Arrays, no intermediate host copy. The jax array
+            # holds the numpy buffer through the dlpack capsule, so a batch
+            # staged from shm views keeps its segment claim pinned exactly
+            # as long as the device batch lives. Small/read-only columns
+            # ride ONE batched device_put as before.
+            staged, rest = {}, {}
+            for k, v in device_cols.items():
+                if self._dlpack_adoptable(v):
+                    try:
+                        staged[k] = jax.dlpack.from_dlpack(v)
+                        continue
+                    except Exception:  # noqa: BLE001 - odd layout: copy path
+                        pass
+                rest[k] = v
+            if rest:
+                staged.update(jax.device_put(rest, self._device)
+                              if self._device is not None
+                              else jax.device_put(rest))
         elif self._device is not None:
             staged = jax.device_put(device_cols, self._device)
         else:
@@ -840,10 +906,12 @@ class DataLoader(LoaderBase):
     :param shuffling_queue_capacity: >0 enables a row shuffling buffer
     :param min_after_retrieve: shuffle-quality floor for the buffer
     :param seed: buffer RNG seed
-    :param shuffle_fast_rng: opt-in vectorized index draws for the buffer's
-        per-row pop (block ``rng.integers`` refills instead of one bounded
-        draw per row). Seeded-deterministic but a different sequence than
-        the default, which stays byte-identical to prior releases.
+    :param shuffle_fast_rng: (default **True** since round 8) vectorized
+        index draws for the buffer's per-row pop (block ``rng.integers``
+        refills instead of one bounded draw per row). Seeded-deterministic;
+        a different sequence than the legacy per-pop draws — pass ``False``
+        to replay epochs recorded before round 8 byte-identically
+        (docs/zero_copy.md, byte-parity waiver).
     """
 
     #: Rows between flushes of locally-accumulated shuffle seconds into the
@@ -855,7 +923,7 @@ class DataLoader(LoaderBase):
                  shuffling_queue_capacity: int = 0,
                  min_after_retrieve: Optional[int] = None,
                  seed: Optional[int] = None,
-                 shuffle_fast_rng: bool = False, **kwargs):
+                 shuffle_fast_rng: bool = True, **kwargs):
         kwargs.setdefault("telemetry", getattr(reader, "telemetry", None))
         super().__init__(batch_size, **kwargs)
         if reader.batched_output:
@@ -866,9 +934,10 @@ class DataLoader(LoaderBase):
         self._shuffling_capacity = shuffling_queue_capacity
         self._min_after = min_after_retrieve
         self._seed = seed
-        #: Opt-in vectorized shuffle-buffer index draws (a DIFFERENT seeded
-        #: sequence than the default per-pop draws; see
-        #: RandomShufflingBuffer.batched_rng).
+        #: Vectorized shuffle-buffer index draws, default on since round 8
+        #: (a DIFFERENT seeded sequence than the legacy per-pop draws —
+        #: False replays pre-round-8 epochs; see
+        #: RandomShufflingBuffer.batched_rng and docs/zero_copy.md).
         self._shuffle_fast_rng = bool(shuffle_fast_rng)
         if shuffling_queue_capacity and shuffling_queue_capacity > 1:
             self._ckpt_hazard = (

@@ -86,6 +86,16 @@ def _load():
             lib.pt_ring_close_producer.argtypes = [ctypes.c_void_p]
             lib.pt_ring_free.restype = None
             lib.pt_ring_free.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pt_ring_unlink.restype = ctypes.c_int
+            lib.pt_ring_unlink.argtypes = [ctypes.c_char_p]
+            lib.pt_ring_head.restype = ctypes.c_uint64
+            lib.pt_ring_head.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_tail.restype = ctypes.c_uint64
+            lib.pt_ring_tail.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_set_tail.restype = None
+            lib.pt_ring_set_tail.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.pt_ring_closed.restype = ctypes.c_int
+            lib.pt_ring_closed.argtypes = [ctypes.c_void_p]
             _LIB = lib
         except Exception as e:  # noqa: BLE001 - record, degrade gracefully
             logger.warning("Native ring buffer unavailable (%s); "
@@ -96,6 +106,37 @@ def _load():
 
 def ring_available() -> bool:
     return _load() is not None
+
+
+def make_ring(name: str, capacity: int = 64 << 20, create: bool = True,
+              impl: str = "auto"):
+    """Ring factory shared by the process pool's consumer and worker sides:
+    ``impl='native'`` -> :class:`ShmRing` (C++ ring, real atomics),
+    ``impl='py'`` -> :class:`~petastorm_tpu.reader_impl.shm_ring.PyShmRing`
+    (pure-Python ``multiprocessing.shared_memory`` fallback, no compiler
+    needed), ``'auto'`` -> native when buildable else the fallback. Both
+    sides of one ring MUST resolve the same impl, which is why the pool
+    pins the choice at start() and ships it to the spawned workers."""
+    if impl == "auto":
+        impl = "native" if ring_available() else "py"
+    if impl == "native":
+        return ShmRing(name, capacity=capacity, create=create)
+    if impl == "py":
+        from petastorm_tpu.reader_impl.shm_ring import PyShmRing
+        return PyShmRing(name, capacity=capacity, create=create)
+    raise ValueError(f"unknown ring impl {impl!r} (expected 'auto', "
+                     f"'native' or 'py')")
+
+
+def resolve_ring_impl() -> str:
+    """The impl :func:`make_ring` would pick for ``'auto'`` right now."""
+    return "native" if ring_available() else "py"
+
+
+#: Native rings intentionally leaked at close because zero-copy views into
+#: the mapping are still live (munmap under a live numpy array is a
+#: SIGSEGV); holding the objects keeps __del__ from freeing them.
+_LEAKED_RINGS: list = []
 
 
 class TimeoutError_(Exception):
@@ -126,11 +167,29 @@ class ShmRing:
             raise OSError(f"could not {'create' if create else 'attach'} ring {name!r}")
         self._owner = create
         cap = lib.pt_ring_capacity(self._handle)
+        #: Data-region byte capacity (same attribute on the PyShmRing
+        #: fallback, so frame-size math is impl-agnostic).
+        self.capacity = cap
         ptr = lib.pt_ring_data_ptr(self._handle)
         self._data = (ctypes.c_char * cap).from_address(ptr)
 
     # ------------------------------------------------------------- producer
-    def write(self, payload: bytes, timeout_ms: int = -1) -> None:
+    def write(self, payload, timeout_ms: int = -1) -> None:
+        """Write one record; ``payload`` is bytes or any buffer-protocol
+        object (memoryview, pa.Buffer) — non-bytes go through the raw
+        pointer, zero python-side copies."""
+        if not isinstance(payload, bytes):
+            import numpy as np
+            view = memoryview(payload)
+            if view.ndim != 1 or view.itemsize != 1:
+                view = view.cast("B")
+            arr = np.frombuffer(view, dtype=np.uint8)
+            ptr = ctypes.cast(ctypes.c_void_p(arr.ctypes.data),
+                              ctypes.c_char_p)
+            rc = self._lib.pt_ring_write(self._handle, ptr, arr.nbytes,
+                                         timeout_ms)
+            self._check_write_rc(rc, arr.nbytes)
+            return
         rc = self._lib.pt_ring_write(self._handle, payload, len(payload), timeout_ms)
         self._check_write_rc(rc, len(payload))
 
@@ -171,6 +230,7 @@ class ShmRing:
             raise TimeoutError_(f"ring {self.name} read timed out")
         if rc == -3:
             raise RingClosed(f"ring {self.name} drained")
+        # copy-ok: read() is the copying convenience API by contract.
         data = bytes(memoryview(self._data)[offset.value:offset.value + length.value])
         self._lib.pt_ring_advance(self._handle)
         return data
@@ -188,6 +248,7 @@ class ShmRing:
             raise RingClosed(f"ring {self.name} drained")
         mv = memoryview(self._data).cast("B")[offset.value:offset.value + length.value]
         kind = mv[0]
+        # copy-ok: read_tagged() is the copying convenience API by contract.
         payload = bytes(mv[1:])
         mv.release()
         self._lib.pt_ring_advance(self._handle)
@@ -248,11 +309,54 @@ class ShmRing:
                                     ctypes.byref(length), timeout_ms)
         return rc == 0
 
-    def close(self) -> None:
-        if self._handle:
-            self._data = None
-            self._lib.pt_ring_free(self._handle, 1 if self._owner else 0)
+    def data_view(self):
+        """Zero-copy memoryview of the whole mapped data region (the
+        consumer's alias-detection probe and the RingReader's record
+        walker)."""
+        return memoryview(self._data)
+
+    # Raw cursor access for the consumer-side multi-record RingReader
+    # (reader_impl/shm_ring.py): real C++11 atomics underneath.
+    def head(self) -> int:
+        return int(self._lib.pt_ring_head(self._handle))
+
+    def tail(self) -> int:
+        return int(self._lib.pt_ring_tail(self._handle))
+
+    def set_tail(self, value: int) -> None:
+        self._lib.pt_ring_set_tail(self._handle, value)
+
+    @property
+    def producer_closed(self) -> bool:
+        return bool(self._lib.pt_ring_closed(self._handle))
+
+    def discard_unread(self) -> int:
+        """Crash reclamation: consume-and-drop every pending record (a dead
+        worker's leftovers); returns how many were discarded."""
+        n = 0
+        while self.poll(0):
+            self._lib.pt_ring_advance(self._handle)
+            n += 1
+        return n
+
+    def close(self, leak_mapping: bool = False) -> None:
+        if not self._handle:
+            return
+        if leak_mapping:
+            # Zero-copy views into the mapping are still live (e.g. the
+            # consumer kept a deserialized batch past reader teardown):
+            # munmap would turn them into SIGSEGVs. Unlink the shm name so
+            # the kernel object dies with the last mapping, but keep THIS
+            # process's mapping alive for its lifetime.
+            if self._owner:
+                self._lib.pt_ring_unlink(self.name.encode())
+            _LEAKED_RINGS.append((self._handle, self._data))
             self._handle = None
+            self._data = None
+            return
+        self._data = None
+        self._lib.pt_ring_free(self._handle, 1 if self._owner else 0)
+        self._handle = None
 
     def __del__(self):
         try:

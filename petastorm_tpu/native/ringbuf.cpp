@@ -281,4 +281,33 @@ void pt_ring_free(void* handle, int unlink) {
     delete r;
 }
 
+// Unlink the shm name WITHOUT unmapping: used when the consumer must leak a
+// mapping because zero-copy views into it are still live (the kernel object
+// is then freed with the last mapping, not before).
+int pt_ring_unlink(const char* name) {
+    return shm_unlink(name);
+}
+
+// Raw cursor access for the consumer-side multi-record reader
+// (reader_impl/shm_ring.py RingReader): the consumer walks records FORWARD
+// of the release point with its own cursor and publishes the release point
+// itself via pt_ring_set_tail — which lets several records be outstanding
+// (each pinned by a zero-copy segment claim) while memory is still released
+// strictly in order.
+uint64_t pt_ring_head(void* handle) {
+    return reinterpret_cast<Ring*>(handle)->hdr->head.load(std::memory_order_acquire);
+}
+
+uint64_t pt_ring_tail(void* handle) {
+    return reinterpret_cast<Ring*>(handle)->hdr->tail.load(std::memory_order_relaxed);
+}
+
+void pt_ring_set_tail(void* handle, uint64_t tail) {
+    reinterpret_cast<Ring*>(handle)->hdr->tail.store(tail, std::memory_order_release);
+}
+
+int pt_ring_closed(void* handle) {
+    return (int)reinterpret_cast<Ring*>(handle)->hdr->closed.load(std::memory_order_acquire);
+}
+
 }  // extern "C"

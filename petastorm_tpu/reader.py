@@ -193,9 +193,35 @@ def _resolve_shard(cur_shard, shard_count):
     return cur_shard, shard_count
 
 
+#: Give-up deadline for a placement migration's old-pool drain: past this,
+#: the migration aborts and the reader stays on the live pool (migratable
+#: configurations run without the watchdog, so this bound is what keeps a
+#: wedged worker from hanging the consumer in the swap).
+_MIGRATION_DRAIN_TIMEOUT_S = 120.0
+
+#: Default per-worker shm ring capacity, and the clamp range applied when
+#: the PR 3 MemoryBudget ledger sizes the rings instead (docs/zero_copy.md).
+_DEFAULT_RING_CAPACITY = 128 << 20
+_RING_CAPACITY_MIN = 16 << 20
+_RING_CAPACITY_MAX = 512 << 20
+
+
+def _ring_capacity_from_budget(autotune_config, workers_count: int) -> int:
+    """Per-worker shm ring bytes: an even split of the autotune
+    ``memory_budget_bytes`` ledger across workers (clamped so one worker
+    can still carry a multi-MB row group and a huge budget doesn't map
+    gigabytes of shm per worker); the documented default otherwise."""
+    budget = getattr(autotune_config, "memory_budget_bytes", None)
+    if not budget:
+        return _DEFAULT_RING_CAPACITY
+    per_worker = int(budget) // max(1, workers_count)
+    return max(_RING_CAPACITY_MIN, min(_RING_CAPACITY_MAX, per_worker))
+
+
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
                shuffle_rows, seed, zmq_copy_buffers=True,
-               pool_profiling_enabled=False):
+               pool_profiling_enabled=False,
+               ring_capacity=_DEFAULT_RING_CAPACITY):
     if reader_pool_type == "thread":
         return ThreadPool(workers_count, results_queue_size=results_queue_size,
                           profiling_enabled=pool_profiling_enabled,
@@ -211,7 +237,8 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer,
     if reader_pool_type == "process":
         return ProcessPool(workers_count, serializer=serializer,
                            zmq_copy_buffers=zmq_copy_buffers,
-                           results_queue_size=results_queue_size)
+                           results_queue_size=results_queue_size,
+                           ring_capacity=ring_capacity)
     if reader_pool_type == "dummy":
         return DummyPool()
     raise ValueError(f"Unknown reader_pool_type {reader_pool_type!r} "
@@ -429,11 +456,19 @@ def make_reader(dataset_url,
                         memory_cache_size_bytes=memory_cache_size_bytes)
 
     from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      PickleSerializer(), shuffle_rows, seed, zmq_copy_buffers,
-                      pool_profiling_enabled)
+
+    def pool_factory(target):
+        return _make_pool(target, workers_count, results_queue_size,
+                          PickleSerializer(), shuffle_rows, seed,
+                          zmq_copy_buffers, pool_profiling_enabled,
+                          ring_capacity=_ring_capacity_from_budget(
+                              autotune_config, workers_count))
+    # ONE construction path: the initial pool and any pool a placement
+    # migration later builds go through the same factory.
+    pool = pool_factory(reader_pool_type)
 
     return Reader(ctx, stored_schema,
+                  pool_factory=pool_factory,
                   dataset_url_or_urls=dataset_url,
                   schema_fields=schema_fields,
                   worker_class=RowReaderWorker,
@@ -512,7 +547,8 @@ def make_batch_reader(dataset_url_or_urls,
                       hang_timeout_s: Optional[float] = None,
                       rowgroup_pruning: bool = True,
                       readahead_depth: Optional[int] = None,
-                      readahead_max_bytes: Optional[int] = None):
+                      readahead_max_bytes: Optional[int] = None,
+                      serializer=None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -541,6 +577,16 @@ def make_batch_reader(dataset_url_or_urls,
     behave exactly as in :func:`make_reader` (docs/io.md) — plain Parquet
     stores usually carry the richest column statistics, so this is the
     path pruning pays off most on.
+    ``serializer`` is the escape hatch over the process-pool payload
+    transport (docs/zero_copy.md): the default is
+    :class:`~petastorm_tpu.reader_impl.arrow_table_serializer.
+    ArrowTableSerializer` — columnar Arrow IPC the shm transport
+    deserializes zero-copy — except with ``convert_early_to_numpy`` (numpy
+    dicts need pickle). Pass
+    :class:`~petastorm_tpu.reader_impl.pickle_serializer.PickleSerializer`
+    to force the bytes round-trip (e.g. to A/B the transports, or for a
+    custom worker payload Arrow IPC cannot carry); thread/dummy pools
+    ignore it (nothing is serialized in-process).
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -557,18 +603,32 @@ def make_batch_reader(dataset_url_or_urls,
                         retry_policy=retry_policy, fault_plan=fault_plan,
                         memory_cache_size_bytes=memory_cache_size_bytes)
 
-    if convert_early_to_numpy:
-        # Workers publish numpy dicts, which Arrow IPC cannot carry.
-        from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
-        serializer = PickleSerializer()
-    else:
-        from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
-        serializer = ArrowTableSerializer()
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      serializer, shuffle_rows, seed, zmq_copy_buffers,
-                      pool_profiling_enabled)
+    from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+    if serializer is None:
+        if convert_early_to_numpy:
+            # Workers publish numpy dicts, which Arrow IPC cannot carry.
+            serializer = PickleSerializer()
+        else:
+            from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+            serializer = ArrowTableSerializer()
+    elif convert_early_to_numpy and not isinstance(serializer,
+                                                   PickleSerializer):
+        raise ValueError(
+            "convert_early_to_numpy publishes numpy dicts, which only the "
+            "PickleSerializer can carry; drop serializer= or "
+            "convert_early_to_numpy")
+    def pool_factory(target):
+        return _make_pool(target, workers_count, results_queue_size,
+                          serializer, shuffle_rows, seed, zmq_copy_buffers,
+                          pool_profiling_enabled,
+                          ring_capacity=_ring_capacity_from_budget(
+                              autotune_config, workers_count))
+    # ONE construction path: the initial pool and any pool a placement
+    # migration later builds go through the same factory.
+    pool = pool_factory(reader_pool_type)
 
     return Reader(ctx, schema,
+                  pool_factory=pool_factory,
                   dataset_url_or_urls=dataset_url_or_urls,
                   schema_fields=schema_fields,
                   worker_class=BatchReaderWorker,
@@ -623,12 +683,27 @@ class Reader:
                  autotune=False, autotune_config=None, stage_deadline_s=None,
                  hedge_policy=None, hang_timeout_s=None,
                  rowgroup_pruning=True, readahead_depth=None,
-                 readahead_max_bytes=None):
+                 readahead_max_bytes=None, pool_factory=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
         self.last_row_consumed = False
         self._error = None
+        # Placement-migration plumbing (docs/zero_copy.md): the factory
+        # rebuilds a pool of either flavor with this reader's construction
+        # parameters; the pending target is flipped by the autotune
+        # placement actuator and honored at the consumer-thread safe point
+        # in __next__.
+        self._pool_factory = pool_factory
+        self._worker_class = worker_class
+        self._worker_crash_budget = worker_crash_budget
+        self._convert_early_to_numpy = convert_early_to_numpy
+        self._pending_pool_target = None
+        self._placement_actuator = None
+        # A hard mid-migration failure poisons the reader: __next__
+        # re-raises it instead of letting a stopped pool read as a clean,
+        # silently-truncated epoch.
+        self._migration_error = None
         # One registry covers the whole pipeline: the pool's worker decode
         # timings, the ventilator backlog gauge, this reader's pool-wait
         # histogram, and (when a JAX loader consumes this reader) the
@@ -708,9 +783,9 @@ class Reader:
         # A live filesystem handle is only shared with in-process workers;
         # spawned process workers rebuild from URL + storage_options (live
         # connections/locks don't survive the boundary — factory semantics,
-        # like the reference's filesystem_factory).
-        worker_fs = filesystem if not isinstance(self._pool, ProcessPool) else None
-        if filesystem is not None and worker_fs is None:
+        # like the reference's filesystem_factory; the nulling itself
+        # happens in _spawnable_worker_args).
+        if filesystem is not None and isinstance(self._pool, ProcessPool):
             warnings.warn("reader_pool_type='process' workers reconnect from the "
                           "dataset URL; the custom filesystem object is used for "
                           "planning only. Pass storage_options for credentials.")
@@ -800,6 +875,7 @@ class Reader:
 
         # ---------------- straggler & hang defense (docs/resilience.md)
         stage_deadline = StageDeadline.from_arg(stage_deadline_s)
+        self._stage_deadline = stage_deadline
         if hedge_policy is not None and not isinstance(hedge_policy,
                                                        HedgePolicy):
             raise TypeError(
@@ -822,10 +898,14 @@ class Reader:
             # per-attempt enforcement.
             self._pool.stage_deadline = stage_deadline
 
-        worker_args = {
+        # Built as the IN-PROCESS variant; _spawnable_worker_args derives
+        # the process-pool copy (live handles nulled). Both kept on self so
+        # a placement migration (docs/zero_copy.md) can stand up either
+        # pool flavor mid-flight.
+        self._worker_args_inproc = {
             "dataset_url_or_urls": dataset_url_or_urls,
             "storage_options": storage_options,
-            "filesystem": worker_fs,
+            "filesystem": filesystem,
             "schema": stored_schema,
             "view_schema": view_schema,
             "output_schema": self.schema,
@@ -848,22 +928,23 @@ class Reader:
             # In-process-only shared fetch stage (None for spawned
             # workers; see the readahead block above).
             "readahead": self.readahead,
-            # The shared registry cannot cross the spawn boundary (same
-            # limitation as the worker decode histogram): spawned workers
-            # retry without exporting per-retry counters; quarantine and
-            # recovery events are counted consumer-side for every pool.
-            "resilience_telemetry": (None if isinstance(self._pool, ProcessPool)
-                                     else self.telemetry),
+            "resilience_telemetry": self.telemetry,
         }
+        worker_args = (self._spawnable_worker_args()
+                       if isinstance(self._pool, ProcessPool)
+                       else self._worker_args_inproc)
 
         if is_batched_reader and not convert_early_to_numpy \
                 and hasattr(self._pool, "result_transform"):
-            # Process pool: convert Arrow -> numpy inside the poll, while the
-            # shm transport's zero-copy view is still valid.
+            # Process pool: convert Arrow -> numpy inside the poll, as VIEWS
+            # over the transport's Arrow buffers (no defensive copy). On the
+            # shm ring the pool's segment-claim protocol pins the record
+            # until the consumer drops its last view; on ZMQ the frame's own
+            # refcount keeps the buffer alive (docs/zero_copy.md).
             from functools import partial as _partial
             self._pool.result_transform = _partial(arrow_table_to_numpy_dict,
                                                    schema=self.schema,
-                                                   force_copy=True)
+                                                   force_copy=False)
 
         start_epoch, start_offset = 0, 0
         if resume_state is not None:
@@ -980,6 +1061,29 @@ class Reader:
             if self.readahead is not None:
                 from petastorm_tpu.autotune import ReadaheadDepthActuator
                 self.autotune.register(ReadaheadDepthActuator(self.readahead))
+            if getattr(autotune_config, "placement", False):
+                # Cedar-style placement tuning (docs/zero_copy.md): only
+                # when a migration can actually be performed — a factory
+                # exists, the pool is a migratable flavor, and no
+                # in-process-only machinery (readahead fetch stage,
+                # watchdog) is welded to the current pool.
+                migratable = (
+                    self._pool_factory is not None
+                    and isinstance(self._pool, (ThreadPool, ProcessPool))
+                    and self.readahead is None
+                    and hang_timeout_s is None)
+                if migratable:
+                    from petastorm_tpu.autotune import PlacementActuator
+                    self._placement_actuator = self.autotune.register(
+                        PlacementActuator(
+                            self._request_pool_migration,
+                            "process" if isinstance(self._pool, ProcessPool)
+                            else "thread"))
+                else:
+                    warnings.warn(
+                        "autotune_config.placement=True ignored: placement "
+                        "migration needs a thread/process pool without "
+                        "readahead_depth or hang_timeout_s")
             self.autotune.start()
 
         if self.readahead is not None:
@@ -1155,11 +1259,186 @@ class Reader:
                          "(fields: %s)", pruned, len(row_groups), fields)
         return kept
 
+    # ----------------------------------------------- placement migration
+    def _spawnable_worker_args(self) -> dict:
+        """The worker-args variant a SPAWNED worker can receive: live
+        in-process handles nulled — spawned workers rebuild a filesystem
+        from the URL, retry without the shared registry, read inline
+        instead of popping the shared readahead store, and have no
+        cross-process cancel flag to consult."""
+        return {**self._worker_args_inproc,
+                "filesystem": None,
+                "resilience_telemetry": None,
+                "cancel_token": None,
+                "readahead": None}
+
+    def _request_pool_migration(self, backend: str) -> None:
+        """Placement-actuator endpoint (any thread): schedule a decode-pool
+        migration; the swap happens at the next ``__next__`` boundary on
+        the consumer thread (docs/zero_copy.md)."""
+        self._pending_pool_target = backend
+
+    def _perform_pool_migration(self) -> None:
+        """Swap the decode stage thread<->process at a consumer-thread safe
+        point: park the ventilator before its next item, drain the old
+        pool's in-flight work (buffering drained results for in-order
+        delivery), stand up the new pool, repoint ventilation, and swap
+        the results reader. Row groups are neither lost nor duplicated:
+        everything ventilated into the old pool is consumed from it, and
+        the parked ventilator resumes into the new one."""
+        target, self._pending_pool_target = self._pending_pool_target, None
+        current = ("process" if isinstance(self._pool, ProcessPool)
+                   else "thread")
+        if target == current or self._pool_factory is None:
+            if self._placement_actuator is not None:
+                self._placement_actuator.mark_applied()
+            return
+        logger.info("Migrating decode stage: %s pool -> %s pool", current,
+                    target)
+        t0 = time.perf_counter()
+        old_pool = self._pool
+        if not self._ventilator.pause():
+            warnings.warn("placement migration skipped: ventilator did not "
+                          "quiesce in time")
+            self._ventilator.resume()
+            if self._placement_actuator is not None:
+                # The actuator must not report a backend that never went
+                # live; re-sync it to the pool actually running.
+                self._placement_actuator.mark_failed(current)
+            return
+        buffered = []
+        migrated = False
+        aborted = False
+        try:
+            from petastorm_tpu.workers_pool import \
+                TimeoutWaitingForResultError
+            # Bounded drain: a wedged worker must not turn a migration into
+            # a permanent hang (migratable configs have the watchdog off by
+            # construction, so the deadline here IS the escape hatch).
+            drain_deadline = time.monotonic() + _MIGRATION_DRAIN_TIMEOUT_S
+            while True:
+                d = old_pool.diagnostics
+                if d["items_inprocess"] <= 0 and d["output_queue_size"] <= 0:
+                    break
+                if time.monotonic() > drain_deadline:
+                    warnings.warn(
+                        f"placement migration aborted: the {current} pool "
+                        f"did not drain within "
+                        f"{_MIGRATION_DRAIN_TIMEOUT_S:.0f}s "
+                        f"({d['items_inprocess']} item(s) still in flight); "
+                        f"staying on the {current} pool")
+                    aborted = True
+                    return
+                try:
+                    # Bounded waits: trailing processed-markers are consumed
+                    # inside get_results without yielding a result, so the
+                    # drain must re-check the accounting between attempts.
+                    buffered.append(old_pool.get_results(timeout=0.25))
+                except TimeoutWaitingForResultError:
+                    continue
+                except EmptyResultError:
+                    break
+            # Detach the ventilator BEFORE stopping: pool.stop() would
+            # otherwise stop ventilation for good.
+            old_pool._ventilator = None
+            old_pool.stop()
+            old_pool.join()
+
+            new_pool = self._pool_factory(target)
+            new_pool.telemetry = self.telemetry
+            new_pool.quarantine = self.quarantine
+            if target == "process" and self._worker_crash_budget:
+                from petastorm_tpu.resilience import WorkerCrashRecovery
+                new_pool.recovery = WorkerCrashRecovery(
+                    self._worker_crash_budget, telemetry=self.telemetry)
+            if hasattr(new_pool, "stage_deadline"):
+                new_pool.stage_deadline = self._stage_deadline
+            if self.is_batched_reader and not self._convert_early_to_numpy \
+                    and hasattr(new_pool, "result_transform"):
+                from functools import partial as _partial
+                new_pool.result_transform = _partial(
+                    arrow_table_to_numpy_dict, schema=self.schema,
+                    force_copy=False)
+            worker_args = (self._spawnable_worker_args()
+                           if target == "process"
+                           else self._worker_args_inproc)
+            new_pool.start(self._worker_class, worker_args, ventilator=None)
+            # The (already running) ventilator belongs to the new pool now:
+            # completion checks and processed-item credits flow to it, and
+            # the parked ventilation thread re-reads the fn on resume.
+            new_pool._ventilator = self._ventilator
+            self._ventilator.set_ventilate_fn(new_pool.ventilate)
+
+            # Queue gauges follow the pool (the process flavor's depth is
+            # unobservable and must not read as forever-producer_bound —
+            # same rule as construction).
+            depth_gauge = self.telemetry.gauge("pool.results_queue_depth")
+            cap_gauge = self.telemetry.gauge("pool.results_queue_capacity")
+            if target == "process":
+                depth_gauge.set_function(None)
+                depth_gauge.set(0)
+                cap_gauge.set(0)
+            else:
+                depth_gauge.set_function(new_pool.results_qsize)
+                cap_gauge.set(
+                    new_pool.diagnostics["results_queue_capacity"]
+                    * max(1, new_pool.workers_count))
+            if self.autotune is not None:
+                self.autotune.unregister("worker_concurrency")
+                gate = getattr(new_pool, "concurrency_gate", None)
+                if gate is not None:
+                    from petastorm_tpu.autotune import \
+                        WorkerConcurrencyActuator
+                    self.autotune.register(WorkerConcurrencyActuator(
+                        gate, new_pool.workers_count))
+
+            self._pool = new_pool
+            self._results_reader.swap_pool(new_pool, buffered)
+            buffered = []
+            migrated = True
+        except BaseException as exc:
+            # Hard failure mid-swap (pool start, spawn, ...): the old pool
+            # may already be stopped, so the pipeline is broken — remember
+            # the error so every later __next__ re-raises it instead of a
+            # stopped pool's EmptyResultError masquerading as a clean,
+            # silently-truncated epoch.
+            self._migration_error = exc
+            raise
+        finally:
+            if buffered:
+                # The drained results must still reach the consumer before
+                # whatever error surfaces next.
+                self._results_reader.push_pending(buffered)
+            if self._placement_actuator is not None:
+                if migrated:
+                    self._placement_actuator.mark_applied()
+                else:
+                    # The actuator must not report a backend that never
+                    # went live (the controller cancels its trial on this).
+                    self._placement_actuator.mark_failed(current)
+            if migrated or aborted:
+                # aborted: the old pool is untouched and stays live. A hard
+                # failure leaves the ventilator parked — resuming it would
+                # feed items into a stopped pool and lose them.
+                self._ventilator.resume()
+        self.telemetry.counter("autotune.placement_migrations").add(1)
+        logger.info("Decode stage now on the %s pool (migration took "
+                    "%.2fs)", target, time.perf_counter() - t0)
+
     # ------------------------------------------------------------ iteration
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._migration_error is not None \
+                and not self._results_reader.has_buffered():
+            # Results drained before the migration failed are served first
+            # (they are real, fully-read row groups); once they run out the
+            # broken pipeline surfaces as the original error, never as a
+            # clean-looking truncated epoch.
+            raise self._migration_error
+        if self._pending_pool_target is not None:
+            self._perform_pool_migration()
         try:
             sample = self._results_reader.read_next()
             return sample
@@ -1297,6 +1576,9 @@ class _PoolWaitTimer:
     def __init__(self, pool, telemetry, watchdog=None):
         self._pool = pool
         self._telemetry = telemetry
+        # Results drained from a pool being migrated away from: served
+        # FIRST, in drain order, before the new pool is consulted.
+        self._pending = deque()
         # The pipeline watchdog (when enabled) learns here whether the
         # consumer is actually starving: a hang is only a hang while
         # someone is blocked waiting on the pipeline.
@@ -1309,7 +1591,25 @@ class _PoolWaitTimer:
         self._inline_decode_pool = (
             pool if hasattr(pool, "inline_decode_s") else None)
 
+    def swap_pool(self, pool, buffered=None) -> None:
+        """Placement migration: read from ``pool`` from now on, after the
+        results drained from the old pool (``buffered``) are served."""
+        if buffered:
+            self._pending.extend(buffered)
+        self._pool = pool
+        self._inline_decode_pool = (
+            pool if hasattr(pool, "inline_decode_s") else None)
+
+    def push_pending(self, results) -> None:
+        self._pending.extend(results)
+
+    def has_buffered(self) -> bool:
+        """Undelivered results that do not require the live pool."""
+        return bool(self._pending)
+
     def get_results(self):
+        if self._pending:
+            return self._pending.popleft()
         if self._watchdog is not None:
             self._watchdog.enter_wait()
         try:
@@ -1344,6 +1644,9 @@ class _RowResultsReader(_PoolWaitTimer):
         self._buffer = deque()
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)
+
+    def has_buffered(self) -> bool:
+        return bool(self._buffer) or super().has_buffered()
 
     def read_next(self):
         while not self._buffer:

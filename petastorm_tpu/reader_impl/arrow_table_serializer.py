@@ -1,17 +1,29 @@
-"""Arrow IPC stream serializer: zero-copy-friendly transport of pyarrow
-Tables between worker processes and the consumer.
+"""Arrow IPC stream serializer: zero-copy transport of pyarrow Tables
+between worker processes and the consumer.
 
-Parity: reference petastorm/reader_impl/arrow_table_serializer.py:19.
+Parity: reference petastorm/reader_impl/arrow_table_serializer.py:19 — but
+where the reference round-trips through bytes, this one stays buffer-shaped
+on both ends: ``serialize`` returns the Arrow output stream's own buffer
+(no ``to_pybytes`` copy; ring and ZMQ transports write any buffer-protocol
+object), and ``deserialize`` reads the record batches as views over the
+input buffer (``aliases_input = True`` tells the process pool that results
+may alias transport memory, engaging its segment-claim protocol on the shm
+ring — see docs/zero_copy.md).
 """
 import pyarrow as pa
 
 
 class ArrowTableSerializer:
-    def serialize(self, table: pa.Table) -> bytes:
+    #: Deserialized tables VIEW the input buffer (Arrow IPC is zero-copy):
+    #: transports that recycle memory must hold the buffer until the
+    #: consumer drops its last view (the shm ring's _SegmentClaim).
+    aliases_input = True
+
+    def serialize(self, table: pa.Table):
         sink = pa.BufferOutputStream()
         with pa.ipc.new_stream(sink, table.schema) as writer:
             writer.write_table(table)
-        return sink.getvalue().to_pybytes()
+        return sink.getvalue()  # pa.Buffer: buffer protocol, no bytes copy
 
     def deserialize(self, serialized) -> pa.Table:
         # Accepts bytes or a zero-copy buffer (memoryview / pa.Buffer).

@@ -653,10 +653,20 @@ class RowReaderWorker(WorkerBase):
 
     def _decode_columns(self, data: dict, indices) -> dict:
         """Codec-decode the selected rows of every needed column; returns
-        ``{name: per-row decoded values}`` (list, or ndarray from the
-        native image batch decoder). Shared by the row path above and the
-        dense NGram path (which stacks these instead of building rows)."""
+        ``{name: per-row decoded values}`` (list, or ndarray from one of
+        the batched column decoders). Shared by the row path above and the
+        dense NGram path (which stacks these instead of building rows).
+
+        Batched fast paths (docs/zero_copy.md "one decode per column, not
+        per cell"): scalar numeric columns decode as ONE vectorized dtype
+        cast; homogeneous ``.npy`` columns as one header parse + per-cell
+        memcpy into a single ``(n, *shape)`` allocation; image columns
+        through the GIL-free native batch decoder. Each falls through to
+        the per-cell loop when its preconditions fail, and user codecs
+        always take the per-cell path with the documented bytes contract."""
         from petastorm_tpu.utils.decode import (batch_decode_images,
+                                                batch_decode_ndarrays,
+                                                batch_decode_scalars,
                                                 is_memoryview_safe,
                                                 native_image_eligible)
         cols = {}
@@ -665,7 +675,15 @@ class RowReaderWorker(WorkerBase):
             if src is None:
                 continue
             dec = codec.decode
+            batched = batch_decode_scalars(field, codec, src, indices)
+            if batched is not None:
+                cols[name] = batched
+                continue
             if is_memoryview_safe(codec):
+                batched = batch_decode_ndarrays(field, codec, src, indices)
+                if batched is not None:
+                    cols[name] = batched
+                    continue
                 # Image columns: one GIL-free native call (libjpeg/libpng)
                 # decodes the whole column into independently-allocated
                 # per-row arrays (so a retained row never pins its row

@@ -1,0 +1,440 @@
+"""Pure-Python shared-memory SPSC ring: the process pool's fallback data
+plane when the native ``ringbuf.cpp`` library cannot be built (no g++ on the
+host). Built on :mod:`multiprocessing.shared_memory`, API-compatible with
+:class:`petastorm_tpu.native.ShmRing` so the pool's zero-copy consumer path
+(``read_tagged_view`` + deferred ``advance``) works identically on both.
+
+Layout (mirrors ringbuf.cpp so the framing semantics — and the tests that
+prove wraparound/torn-frame behavior — describe one protocol):
+
+```
+[header 64B: head u64 | tail u64 | capacity u64 | closed u32 | pad]
+[data region of `capacity` bytes]
+```
+
+Records are ``[u32 len][payload]``, 8-byte aligned; ``len == 0xFFFFFFFF`` is
+a wrap marker. **Torn-frame defense is pure store ordering**: the producer
+writes the payload first, the record length second, and publishes ``head``
+last — so a producer that dies mid-write leaves ``head`` unmoved and at
+worst a partially-filled region no consumer can ever observe (a record
+only exists once ``head`` covers it). Consumer-side reclamation after a
+worker crash is therefore just :meth:`discard_unread` (drop whatever
+complete records the dead worker left) + unlink; no record can be
+half-delivered.
+
+Synchronization caveat: Python cannot issue memory fences, so this ring
+relies on x86-class total-store-order plus the GIL's implicit barriers for
+the head/tail publishes (aligned 8-byte stores via memcpy). The native ring
+uses real C++11 atomics; this fallback trades that rigor for working on
+hosts with no compiler. Latency is row-group scale (ms), polling is 50us.
+"""
+from __future__ import annotations
+
+import struct
+import time
+
+from petastorm_tpu.native import RingClosed, TimeoutError_
+
+_WRAP = 0xFFFFFFFF
+_ALIGN = 8
+_HDR = 64
+_HEAD_OFF = 0
+_TAIL_OFF = 8
+_CAP_OFF = 16
+_CLOSED_OFF = 24
+_POLL_S = 50e-6
+
+#: Rings intentionally leaked at close because the consumer still holds
+#: zero-copy views into the mapping (see :meth:`PyShmRing.close`); keeping
+#: the objects referenced stops SharedMemory.__del__ from unmapping them
+#: under live numpy arrays.
+_LEAKED: list = []
+
+
+def _align_up(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class PyShmRing:
+    """One SPSC ring over a named ``multiprocessing.shared_memory`` segment.
+
+    Producer API: ``write_tagged(kind, payload)``, ``close_producer()``.
+    Consumer API: ``poll``, ``read_tagged_view`` (zero-copy, does NOT
+    advance), ``advance``, ``read_tagged`` (copying), ``discard_unread``,
+    ``close``.
+    """
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        from multiprocessing import shared_memory
+        # multiprocessing.shared_memory rejects leading slashes on some
+        # platforms; normalize the POSIX-style names the pool generates.
+        self.name = name
+        self._owner = create
+        smname = name.lstrip("/")
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                smname, create=True, size=_HDR + capacity)
+            self._buf = self._shm.buf
+            struct.pack_into("<QQQII", self._buf, 0, 0, 0, capacity, 0, 0)
+        else:
+            self._shm = shared_memory.SharedMemory(smname)
+            self._buf = self._shm.buf
+        # Lifecycle is explicit — the owner unlinks in close() — so drop
+        # the segment from BOTH sides' resource trackers: the attach-side
+        # tracker would otherwise unlink the segment when a worker process
+        # exits (yanking it from under the consumer), and the owner-side
+        # entry would double-unlink noisily after our own unlink. Same
+        # semantics as the native ring, which has no tracker at all.
+        try:  # pragma: no cover - CPython implementation detail
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(self._shm._name, "shared_memory")
+        except Exception:  # noqa: BLE001 - tracker layout changed
+            pass
+        self.capacity = struct.unpack_from("<Q", self._buf, _CAP_OFF)[0]
+        self._data_off = _HDR
+
+    # ------------------------------------------------------------- header io
+    def _load(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._buf, off)[0]
+
+    def _store(self, off: int, value: int) -> None:
+        struct.pack_into("<Q", self._buf, off, value)
+
+    @property
+    def closed(self) -> bool:
+        return struct.unpack_from("<I", self._buf, _CLOSED_OFF)[0] != 0
+
+    # Raw cursor access for the consumer-side multi-record RingReader.
+    def head(self) -> int:
+        return self._load(_HEAD_OFF)
+
+    def tail(self) -> int:
+        return self._load(_TAIL_OFF)
+
+    def set_tail(self, value: int) -> None:
+        self._store(_TAIL_OFF, value)
+
+    @property
+    def producer_closed(self) -> bool:
+        return self.closed
+
+    # ------------------------------------------------------------- producer
+    def write_tagged(self, kind: int, payload, timeout_ms: int = -1) -> None:
+        view = memoryview(payload)
+        if view.ndim != 1 or view.format != "B":
+            # Unsigned-byte normalization: shm slice assignment requires
+            # matching structures, and e.g. Arrow buffers export as 'b'.
+            view = view.cast("B")
+        msg_len = 1 + len(view)
+        need = _align_up(4 + msg_len)
+        cap = self.capacity
+        if need * 2 > cap:
+            raise ValueError(f"payload of {len(view)} bytes exceeds ring "
+                             f"capacity {cap}")
+        deadline = None if timeout_ms < 0 else \
+            time.monotonic() + timeout_ms / 1000.0
+        while True:
+            if self.closed:
+                raise RingClosed(f"ring {self.name} is closed")
+            head = self._load(_HEAD_OFF)
+            tail = self._load(_TAIL_OFF)
+            used = head - tail
+            pos = head % cap
+            contiguous = cap - pos
+            total = need if contiguous >= need else contiguous + need
+            if cap - used >= total:
+                if contiguous < need:
+                    if contiguous >= 4:
+                        struct.pack_into("<I", self._buf,
+                                         self._data_off + pos, _WRAP)
+                    head += contiguous
+                    pos = 0
+                base = self._data_off + pos
+                # Torn-frame ordering: payload first, length last, head
+                # after — a crash at any point leaves head unmoved and the
+                # length slot unwritten, so the consumer never sees a
+                # partial record.
+                self._buf[base + 4] = kind
+                self._buf[base + 5:base + 5 + len(view)] = view
+                struct.pack_into("<I", self._buf, base, msg_len)
+                self._store(_HEAD_OFF, head + need)
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError_(f"ring {self.name} write timed out")
+            time.sleep(_POLL_S)  # backoff-ok: ring backpressure, not a retry
+
+    def close_producer(self) -> None:
+        struct.pack_into("<I", self._buf, _CLOSED_OFF, 1)
+
+    # ------------------------------------------------------------- consumer
+    def _peek(self, timeout_ms: int):
+        """-> (pos, msg_len) of the next record, advancing past wrap
+        markers; raises like the native peek."""
+        cap = self.capacity
+        deadline = None if timeout_ms < 0 else \
+            time.monotonic() + timeout_ms / 1000.0
+        while True:
+            tail = self._load(_TAIL_OFF)
+            head = self._load(_HEAD_OFF)
+            if head != tail:
+                pos = tail % cap
+                contiguous = cap - pos
+                if contiguous < 4:
+                    self._store(_TAIL_OFF, tail + contiguous)
+                    continue
+                msg_len = struct.unpack_from(
+                    "<I", self._buf, self._data_off + pos)[0]
+                if msg_len == _WRAP:
+                    self._store(_TAIL_OFF, tail + contiguous)
+                    continue
+                return pos, msg_len
+            if self.closed:
+                raise RingClosed(f"ring {self.name} drained")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError_(f"ring {self.name} read timed out")
+            time.sleep(_POLL_S)  # backoff-ok: ring poll yield, not a retry
+
+    def poll(self, timeout_ms: int = 0) -> bool:
+        try:
+            self._peek(timeout_ms)
+            return True
+        except (TimeoutError_, RingClosed):
+            return False
+
+    def read_tagged_view(self, timeout_ms: int = -1):
+        """(kind, zero-copy memoryview) of the next record WITHOUT
+        advancing; call :meth:`advance` once every view derived from it has
+        been dropped."""
+        pos, msg_len = self._peek(timeout_ms)
+        base = self._data_off + pos
+        mv = self._buf[base + 4:base + 4 + msg_len]
+        return mv[0], mv[1:]
+
+    def read_tagged(self, timeout_ms: int = -1):
+        kind, view = self.read_tagged_view(timeout_ms)
+        payload = bytes(view)  # copy-ok: the copying convenience reader
+        view.release()
+        self.advance()
+        return kind, payload
+
+    def data_view(self):
+        """Zero-copy memoryview of the whole data region (the consumer's
+        alias-detection probe; see ProcessPool._maybe_claim)."""
+        return self._buf[self._data_off:]
+
+    def advance(self) -> None:
+        tail = self._load(_TAIL_OFF)
+        pos = tail % self.capacity
+        msg_len = struct.unpack_from("<I", self._buf,
+                                     self._data_off + pos)[0]
+        self._store(_TAIL_OFF, tail + _align_up(4 + msg_len))
+
+    def discard_unread(self) -> int:
+        """Crash reclamation: drop every complete-but-unread record (a dead
+        worker's leftovers) so the segment can be recycled or closed.
+        Returns the number of records discarded."""
+        n = 0
+        while True:
+            try:
+                self._peek(0)
+            except (TimeoutError_, RingClosed):
+                return n
+            self.advance()
+            n += 1
+
+    # ------------------------------------------------------------- lifetime
+    def close(self, leak_mapping: bool = False) -> None:
+        if self._shm is None:
+            return
+        shm, self._shm, self._buf = self._shm, None, None
+        if leak_mapping:
+            # Zero-copy views into the mapping are still live: unmapping
+            # would turn them into SIGSEGVs. Unlink the name (owner) but
+            # keep the mapping for the life of the process.
+            if self._owner:
+                try:
+                    shm.unlink()
+                except FileNotFoundError:  # pragma: no cover
+                    pass
+            _LEAKED.append(shm)
+            return
+        try:
+            shm.close()
+        except BufferError:
+            # Something still references the buffer after all: leak instead
+            # of crashing whoever holds the view.
+            _LEAKED.append(shm)
+            return
+        if self._owner:
+            try:
+                shm.unlink()
+            except FileNotFoundError:  # pragma: no cover - already gone
+                pass
+
+    def __del__(self):  # pragma: no cover - GC ordering dependent
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class RingReader:
+    """Consumer-side multi-record reader over one SPSC ring (native or
+    pure-Python — anything exposing ``head/tail/set_tail/data_view/
+    capacity/producer_closed``).
+
+    The ring's own peek/advance can only expose the record AT the tail, so
+    a zero-copy view that pins the tail record would block every record
+    behind it — one outstanding batch per worker, a deadlock the moment a
+    shuffle buffer holds two. This reader decouples *reading* from
+    *releasing*: a private ``cursor`` walks records forward up to the
+    producer's ``head`` (each handed out as a zero-copy view), while the
+    ring ``tail`` — the producer's free-space signal — advances only as the
+    OLDEST outstanding records complete, in order. Several records can thus
+    be pinned by live segment claims at once; backpressure begins only when
+    the pinned span approaches the ring capacity (size rings via the
+    MemoryBudget, docs/zero_copy.md).
+
+    Single consumer thread assumed (the process pool's poll loop); claim
+    ``released`` flags may flip from any thread, but ``reap`` — the only
+    tail writer — runs on the consumer thread.
+    """
+
+    def __init__(self, ring):
+        self.ring = ring
+        self._mem = ring.data_view()
+        if not isinstance(self._mem, memoryview):  # pragma: no cover
+            self._mem = memoryview(self._mem)
+        if self._mem.format != "B":
+            self._mem = self._mem.cast("B")
+        self._cap = ring.capacity
+        self._cursor = ring.tail()
+        #: [record_end_cursor, claim_or_None] in read order; a None claim
+        #: is releasable immediately.
+        self._outstanding = []
+
+    # ---------------------------------------------------------------- read
+    def try_read(self):
+        """-> ``(kind, zero-copy payload view)`` of the next unread record,
+        or None when the producer has published nothing new. The record is
+        registered as outstanding; the caller must follow up with
+        :meth:`complete` (no live views) or :meth:`claim` (views pinned
+        until the claim's ``released`` flips)."""
+        head = self.ring.head()
+        cursor = self._cursor
+        while True:
+            if cursor >= head:
+                return None
+            pos = cursor % self._cap
+            contiguous = self._cap - pos
+            if contiguous < 4:
+                cursor += contiguous
+                continue
+            msg_len = struct.unpack_from("<I", self._mem, pos)[0]
+            if msg_len == _WRAP:
+                cursor += contiguous
+                continue
+            break
+        view = self._mem[pos + 4:pos + 4 + msg_len]
+        self._cursor = cursor + _align_up(4 + msg_len)
+        self._outstanding.append([self._cursor, None, False])
+        return view[0], view[1:]
+
+    def complete(self) -> None:
+        """The just-read record has no live views: releasable in order."""
+        self._outstanding[-1][2] = True
+
+    def claim(self, claim) -> None:
+        """Pin the just-read record until ``claim.released``."""
+        self._outstanding[-1][1] = claim
+
+    def has_pending(self) -> bool:
+        """A complete unread record exists (wrap markers don't count).
+        Non-consuming: the crash path uses this to defer worker-death
+        recovery until the dead producer's ring is fully drained."""
+        head = self.ring.head()
+        cursor = self._cursor
+        while cursor < head:
+            pos = cursor % self._cap
+            contiguous = self._cap - pos
+            if contiguous < 4:
+                cursor += contiguous
+                continue
+            msg_len = struct.unpack_from("<I", self._mem, pos)[0]
+            if msg_len == _WRAP:
+                cursor += contiguous
+                continue
+            return True
+        return False
+
+    @property
+    def outstanding(self) -> int:
+        """Records read but not yet released to the producer."""
+        return len(self._outstanding)
+
+    @property
+    def pinned(self) -> int:
+        """Outstanding records still pinned by an unreleased claim."""
+        return sum(1 for _, c, done in self._outstanding
+                   if not done and c is not None and not c.released)
+
+    def drained(self) -> bool:
+        """Producer closed and every published record consumed."""
+        return (self.ring.producer_closed
+                and self._cursor >= self.ring.head())
+
+    # ------------------------------------------------------------- release
+    def reap(self) -> int:
+        """Advance the ring tail past the longest released prefix of
+        outstanding records; returns how many were released."""
+        n = 0
+        release_to = None
+        while self._outstanding:
+            end, claim, done = self._outstanding[0]
+            if not done and (claim is None or not claim.released):
+                break
+            self._outstanding.pop(0)
+            release_to = end
+            n += 1
+        if release_to is not None:
+            self.ring.set_tail(release_to)
+        return n
+
+    def discard_pending(self) -> int:
+        """Worker-death reclamation: drop every published-but-unread record
+        (their items re-ventilate via the crash-recovery claim protocol, so
+        delivering them would duplicate row groups) and let the already-read
+        records release through their claims as usual. Safe with a dead
+        producer: nothing can overwrite the pinned span. Returns the number
+        of records discarded."""
+        head = self.ring.head()
+        cursor = self._cursor
+        dropped = 0
+        while cursor < head:
+            pos = cursor % self._cap
+            contiguous = self._cap - pos
+            if contiguous < 4:
+                cursor += contiguous
+                continue
+            msg_len = struct.unpack_from("<I", self._mem, pos)[0]
+            if msg_len == _WRAP:
+                cursor += contiguous
+                continue
+            cursor += _align_up(4 + msg_len)
+            dropped += 1
+        self._cursor = cursor
+        if dropped or cursor > (self._outstanding[-1][0]
+                                if self._outstanding else -1):
+            # Pseudo-record covering the discarded span: reaps once every
+            # real outstanding record ahead of it has released.
+            self._outstanding.append([cursor, None, True])
+        return dropped
+
+    def close(self) -> None:
+        """Drop the reader's hold on the mapping view (before ring.close);
+        outstanding claimed views belong to their claims, not the reader."""
+        try:
+            self._mem.release()
+        except BufferError:  # pragma: no cover - claimed sub-views alive
+            pass

@@ -87,15 +87,16 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     :param extra_capacity: allowance above capacity for bulk ``add_many``
         (a whole row group may arrive at once)
     :param seed: RNG seed for reproducible shuffles
-    :param batched_rng: **opt-in** fast path for the per-row ``retrieve``
-        hot loop: draw random bits in vectorized blocks of
-        ``rng_block_size`` (one ``Generator.integers`` call per block)
-        instead of one bounded draw per pop, and reduce each 63-bit word
-        modulo the live buffer size. Still seeded-deterministic and still
-        uniform to within a negligible (< 2**-50 for any realistic buffer)
-        modulo bias — but a DIFFERENT seeded sequence than the default
-        per-pop draws, which is why it is opt-in: the default path stays
-        byte-identical to every previously recorded epoch.
+    :param batched_rng: (default **True** since round 8) fast path for the
+        per-row ``retrieve`` hot loop: draw random bits in vectorized
+        blocks of ``rng_block_size`` (one ``Generator.integers`` call per
+        block) instead of one bounded draw per pop, and reduce each 63-bit
+        word modulo the live buffer size. Seeded-deterministic and uniform
+        to within a negligible (< 2**-50 for any realistic buffer) modulo
+        bias — but a DIFFERENT seeded sequence than the legacy per-pop
+        draws. **Byte-parity waiver** (docs/zero_copy.md): epochs recorded
+        before round 8 replay only with ``batched_rng=False``, which stays
+        byte-identical to the original per-pop implementation forever.
     :param rng_block_size: draws per refill in batched mode
     """
 
@@ -103,7 +104,7 @@ class RandomShufflingBuffer(ShufflingBufferBase):
                  min_after_retrieve: int = 0,
                  extra_capacity: int = 1000,
                  seed: Optional[int] = None,
-                 batched_rng: bool = False,
+                 batched_rng: bool = True,
                  rng_block_size: int = 1024):
         if min_after_retrieve >= shuffling_buffer_capacity:
             raise ValueError("min_after_retrieve must be smaller than "

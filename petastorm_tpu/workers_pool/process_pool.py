@@ -18,6 +18,14 @@ the consumer a zero-copy view of the receive buffer), ``b"ctrl"`` payloads
 (ready-handshake, item-processed markers, worker exceptions) are always
 pickle.
 
+Zero-copy data plane (docs/zero_copy.md): on the shm transport a data frame
+is deserialized straight from the mapped ring memory, the consumer-side
+``result_transform`` converts it to numpy views over the Arrow buffers
+(no copy), and the ring record is pinned by a :class:`_SegmentClaim` that
+releases — recycling the segment — only when the consumer (or a shuffle
+buffer holding the batch) drops its last view. Decoded columns are written
+once, by the worker, and viewed everywhere after.
+
 Safety: workers watch the parent PID and exit if it dies (no orphans,
 reference :320); worker start blocks on a ready-handshake from every worker
 so no ventilated item is ever lost to a ZMQ slow joiner (reference :292).
@@ -63,6 +71,47 @@ _POLL_MS = 100
 class _WorkerReady:
     def __init__(self, worker_id):
         self.worker_id = worker_id
+
+
+class _SegmentClaim:
+    """Pins one shm ring record while zero-copy numpy views of it are live.
+
+    The poll registers a ``weakref.finalize`` on every result array that
+    aliases the mapped ring region; the record's release is deferred until
+    the last such array is garbage-collected — so the consumer (or a
+    shuffle buffer, or a dlpack-staged device batch holding the host array)
+    can keep a batch as long as it likes and the ring simply backpressures
+    that worker instead of recycling memory under the view. Thread-safe:
+    finalizers fire on whatever thread drops the last reference; the ring
+    tail is only ever advanced from the consumer's poll thread
+    (:meth:`RingReader.reap`)."""
+
+    __slots__ = ("view", "_outstanding", "_lock", "__weakref__")
+
+    def __init__(self, view):
+        self.view = view
+        self._outstanding = 0
+        self._lock = threading.Lock()
+
+    def track(self, arr) -> None:
+        import weakref
+        with self._lock:
+            self._outstanding += 1
+        weakref.finalize(arr, self._drop)
+
+    def _drop(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+        if self._outstanding <= 0:
+            try:
+                self.view.release()
+            except BufferError:  # pragma: no cover - racing release
+                pass
+
+    @property
+    def released(self) -> bool:
+        with self._lock:
+            return self._outstanding <= 0
 
 
 def _resolve_auto_transport() -> str:
@@ -115,9 +164,15 @@ class ProcessPool:
             raise ValueError(f"transport must be 'auto', 'shm' or 'zmq', got {transport!r}")
         self._transport = transport
         self._ring_capacity = ring_capacity
-        self._rings = []           # consumer-side ShmRing per worker (shm mode)
+        self._rings = []           # consumer-side ring per worker (shm mode)
+        self._readers = []         # RingReader per ring (multi-record reads)
+        self._ring_impl = None     # pinned at start(): 'native' or 'py'
         self._ring_poll_idx = 0
-        self._partial = {}         # worker_id -> list of partial chunks
+        # worker_id -> [reassembly bytearray, write offset]: chunked
+        # payloads fill ONE preallocated buffer (sized by the S start
+        # frame) instead of concatenating per-chunk.
+        self._partial = {}
+        self._ring_mem = {}        # worker_id -> numpy view over ring data
         # Optional callable applied to deserialized data results INSIDE the
         # poll. On the shm transport it runs while the zero-copy view is
         # still valid, so the copying conversion (e.g. Arrow -> numpy)
@@ -177,11 +232,18 @@ class ProcessPool:
 
         ring_names = None
         if self._transport == "shm":
-            from petastorm_tpu.native import ShmRing
+            from petastorm_tpu.native import make_ring, resolve_ring_impl
+            # Pin ONE impl for consumer and workers alike: a native consumer
+            # attached to a python-fallback producer (or vice versa) would
+            # disagree on torn-frame semantics.
+            self._ring_impl = resolve_ring_impl()
             token = uuid.uuid4().hex[:10]
             ring_names = [f"/ptring_{token}_{i}" for i in range(self.workers_count)]
-            self._rings = [ShmRing(name, capacity=self._ring_capacity, create=True)
+            from petastorm_tpu.reader_impl.shm_ring import RingReader
+            self._rings = [make_ring(name, capacity=self._ring_capacity,
+                                     create=True, impl=self._ring_impl)
                            for name in ring_names]
+            self._readers = [RingReader(ring) for ring in self._rings]
 
         for worker_id in range(self.workers_count):
             p = exec_in_new_process(
@@ -190,7 +252,8 @@ class ProcessPool:
                 ring_names[worker_id] if ring_names else None,
                 # Claim frames cost a control send per item; only pay when a
                 # crash-recovery ledger is attached to consume them.
-                self.recovery is not None)
+                self.recovery is not None,
+                self._ring_impl)
             self._processes.append(p)
 
         # Ready-handshake: every worker's PUSH is connected before any
@@ -341,9 +404,27 @@ class ProcessPool:
         if self._context is not None:
             self._context.term()
             self._context = None
-        for ring in self._rings:
+        # Drop the alias-probe arrays FIRST: they view ring memory and must
+        # not outlive an unmapped ring.
+        self._ring_mem.clear()
+        for idx, ring in enumerate(self._rings):
+            reader = self._readers[idx] if idx < len(self._readers) else None
+            if reader is not None:
+                reader.reap()
+                pinned = reader.pinned
+                reader.close()
+                if pinned:
+                    # The consumer still holds zero-copy views into this
+                    # ring's mapping (a batch kept past reader teardown):
+                    # unmapping would SIGSEGV those arrays, so unlink the
+                    # name and leak the mapping for the life of the process.
+                    logger.debug("Leaking shm ring %s mapping: consumer "
+                                 "still holds zero-copy views", ring.name)
+                    ring.close(leak_mapping=True)
+                    continue
             ring.close()
         self._rings = []
+        self._readers = []
         import shutil
         shutil.rmtree(self._ipc_dir, ignore_errors=True)
 
@@ -371,81 +452,149 @@ class ProcessPool:
 
     def _poll_result_shm(self, timeout_ms: int):
         """Round-robin over worker rings. Frames: first byte C (pickled
-        control), D (serialized data) or P (partial data chunk; frames
-        accumulate until the terminating D).
+        control), D (serialized data), or — for payloads bigger than half a
+        ring — S (8-byte total length) followed by P chunks and a final D,
+        reassembled into ONE preallocated buffer.
 
-        Data frames are deserialized ZERO-COPY from the mapped ring memory;
-        the ring advances on the next poll, by which time the consumer has
-        converted the previous payload (the Reader converts each batch to
-        numpy before requesting another). Holding returned tables across
-        get_results calls is therefore not allowed on the shm transport."""
-        from petastorm_tpu.native import RingClosed
+        Data frames are deserialized ZERO-COPY from the mapped ring memory
+        and, when the ``result_transform`` yields numpy views over the
+        mapped Arrow buffers, the record is pinned by a
+        :class:`_SegmentClaim`: the :class:`RingReader` keeps reading
+        records FORWARD of it (several batches may be outstanding at once —
+        a shuffle buffer can hold many) while ring memory is recycled
+        strictly in order, only after the consumer drops its last view of
+        the oldest record. Backpressure lands on the producing worker when
+        its pinned span approaches the ring capacity — never on memory
+        safety."""
         deadline = time.monotonic() + timeout_ms / 1000.0
         while True:
             progressed = False
-            for _ in range(len(self._rings)):
+            for _ in range(len(self._readers)):
                 idx = self._ring_poll_idx
-                self._ring_poll_idx = (self._ring_poll_idx + 1) % len(self._rings)
-                ring = self._rings[idx]
-                try:
-                    if not ring.poll(0):
-                        continue
-                    kind, view = ring.read_tagged_view(timeout_ms=0)
-                except RingClosed:
+                self._ring_poll_idx = (self._ring_poll_idx + 1) % len(self._readers)
+                reader = self._readers[idx]
+                reader.reap()
+                rec = reader.try_read()
+                if rec is None:
                     continue
+                kind, view = rec
                 progressed = True
-                # The frame is consumed no matter what: a payload that fails
-                # to deserialize/convert must not be re-peeked forever.
+                claimed = False
+                # The record is consumed no matter what (a payload that
+                # fails to deserialize/convert must not be re-read forever);
+                # only a registered claim defers its release.
                 try:
                     if kind == ord("C"):
+                        # Ctrl frames deserialize straight from the mapped
+                        # view (pickle copies out; no intermediate bytes).
                         return pickle.loads(view)
-                    if kind == ord("P"):
-                        self._partial.setdefault(idx, []).append(bytes(view))
+                    if kind == ord("S"):
+                        # copy-ok: 8-byte length prefix of a chunked payload.
+                        total = int.from_bytes(bytes(view[:8]), "little")
+                        self._partial[idx] = [bytearray(total), 0]
                         continue
-                    if self._partial.get(idx):
-                        payload = b"".join(self._partial.pop(idx) + [bytes(view)])
-                        result = self._serializer.deserialize(payload)
-                    elif (self.result_transform is not None
-                          or not getattr(self._serializer, "aliases_input",
-                                         True)):
+                    if kind == ord("P") or idx in self._partial:
+                        entry = self._partial.get(idx)
+                        if entry is None:  # P without S: unsized frame
+                            entry = self._partial[idx] = [bytearray(), 0]
+                        buf, off = entry
+                        end = off + len(view)
+                        if len(buf) >= end:
+                            buf[off:end] = view  # fill preallocated buffer
+                        else:
+                            buf += view
+                        entry[1] = end
+                        if kind == ord("P"):
+                            continue
+                        del self._partial[idx]
+                        # Reassembled payloads live in consumer-owned
+                        # memory: results may alias `buf` freely (GC keeps
+                        # it alive).
+                        result = self._serializer.deserialize(memoryview(buf))
+                        if self.result_transform is not None:
+                            result = self.result_transform(result)
+                        return result
+                    # Single-record data frame.
+                    if (self.result_transform is not None
+                            or not getattr(self._serializer, "aliases_input",
+                                           True)):
                         # Zero-copy: deserialize straight from mapped memory.
-                        # Safe either because the transform copies before we
-                        # advance, or because deserialization itself copies
-                        # (e.g. pickle) and cannot alias the reused ring.
+                        # Safe because either deserialization itself copies
+                        # (e.g. pickle, which cannot alias the reused ring)
+                        # or the transform's aliasing outputs get a claim.
                         result = self._serializer.deserialize(view)
+                        if self.result_transform is not None:
+                            result = self.result_transform(result)
+                        claimed = self._maybe_claim(reader, idx, view, result)
                     else:
-                        # No copying transform: deserialize from one safe
-                        # copy so the result cannot alias the reused ring.
+                        # One safe copy so the result cannot alias the
+                        # reused ring (no copying transform downstream).
+                        # copy-ok: aliasing-unsafe consumer needs the copy
                         result = self._serializer.deserialize(bytes(view))
-                    if self.result_transform is not None:
-                        result = self.result_transform(result)
                     return result
                 finally:
-                    try:
-                        view.release()
-                    except BufferError:
-                        # Something still references the mapped region (a bug
-                        # or an in-flight exception); advancing regardless is
-                        # required for progress — the error path owns the risk.
-                        pass
-                    ring.advance()
+                    if not claimed:
+                        try:
+                            view.release()
+                        except BufferError:
+                            # Something still references the mapped region (a
+                            # bug or an in-flight exception); releasing the
+                            # record regardless is required for progress —
+                            # the error path owns the risk.
+                            pass
+                        reader.complete()
+                        reader.reap()
             if not progressed:
                 if time.monotonic() >= deadline:
                     return None
                 time.sleep(0.0001)  # backoff-ok: ring poll yield, not a retry
+
+    def _maybe_claim(self, reader, idx: int, view, result) -> bool:
+        """Register a :class:`_SegmentClaim` when ``result`` carries numpy
+        arrays that alias the mapped ring region (the zero-copy Arrow →
+        numpy transform path); returns whether the record was claimed —
+        the caller releases it immediately otherwise."""
+        if not isinstance(result, dict):
+            return False
+        import numpy as np
+        mem = self._ring_mem.get(idx)
+        if mem is None:
+            mem = self._ring_mem[idx] = np.frombuffer(
+                self._rings[idx].data_view(), dtype=np.uint8)
+        aliasing = [v for v in result.values()
+                    if isinstance(v, np.ndarray) and v.size
+                    and np.may_share_memory(v, mem)]
+        if not aliasing:
+            return False
+        claim = _SegmentClaim(view)
+        for arr in aliasing:
+            claim.track(arr)
+        reader.claim(claim)
+        if self.telemetry is not None:
+            self.telemetry.counter("transport.zero_copy_batches").add(1)
+            self.telemetry.counter("transport.zero_copy_bytes").add(
+                sum(int(a.nbytes) for a in aliasing))
+        return True
 
     def _poll_result_zmq(self, timeout_ms: int):
         import zmq
         if not self._results_socket.poll(timeout_ms, zmq.POLLIN):
             return None
         kind, payload = self._results_socket.recv_multipart(copy=self._zmq_copy)
+        # copy-ok: the 4-byte kind tag, not the payload.
         kind = bytes(memoryview(kind)) if not isinstance(kind, bytes) else kind
         if kind == _KIND_CTRL:
-            payload = payload if isinstance(payload, bytes) else bytes(memoryview(payload))
-            return pickle.loads(payload)
+            # pickle.loads accepts any buffer and copies out of it: the ctrl
+            # frame deserializes straight from the zmq receive buffer.
+            return pickle.loads(payload if isinstance(payload, bytes)
+                                else memoryview(payload))
         if isinstance(payload, bytes):
             result = self._serializer.deserialize(payload)
         else:
+            # Zero-copy: the zmq frame owns its buffer and anything aliasing
+            # it (Arrow buffers -> numpy views) keeps it alive through
+            # ordinary refcounting — unlike the shm ring, nothing recycles
+            # this memory, so no claim protocol is needed here.
             result = self._serializer.deserialize(memoryview(payload))
         if self.result_transform is not None:
             result = self.result_transform(result)
@@ -468,11 +617,24 @@ class ProcessPool:
             if self.recovery is not None:
                 if i in self.recovery.dead_workers:
                     continue  # already recovered
+                if self._transport == "shm" and i < len(self._readers) \
+                        and self._readers[i].has_pending():
+                    # The dead worker's ring still holds published records
+                    # — data the consumer must deliver and claim/marker
+                    # frames the recovery books need. A worker that
+                    # publishes and dies between the poll sweep and this
+                    # aliveness check would otherwise have its item BOTH
+                    # delivered from the ring and re-ventilated (duplicate
+                    # row group). The producer is dead, so normal polls
+                    # drain the ring to a fixed point; recovery proceeds on
+                    # a later sweep with exact books.
+                    continue
                 try:
                     lost = self.recovery.on_worker_death(i, rc)
                 except CrashBudgetExceededError:
                     self.stop(); self.join()
                     raise
+                self._reclaim_ring(i)
                 logger.warning(
                     "Worker process %d died with exit code %s; re-ventilating "
                     "%d claimed item(s) onto the %d surviving worker(s)",
@@ -485,11 +647,40 @@ class ProcessPool:
             raise RuntimeError(
                 f"Worker process {i} died unexpectedly with exit code {rc}")
 
+    def _reclaim_ring(self, idx: int) -> None:
+        """Worker-crash segment reclamation sweep for the dead worker's
+        ring. Death is only ever acted on from the poll's no-message branch,
+        i.e. AFTER every record the worker managed to publish — data,
+        claim frames, processed markers — was consumed (the PR 2 books
+        depend on those markers; this is why the sweep must NOT discard
+        records wholesale). What can still be held: a stale chunk-reassembly
+        buffer (S/P consumed, the final D died with the worker — its item is
+        claimed-but-unprocessed and re-ventilates onto a survivor) and any
+        not-yet-released segment claims (released by GC as usual; the
+        producer being dead just means no backpressure ever builds). Torn
+        mid-write frames cannot surface at all — both ring impls publish
+        the record length and head only after the payload is fully
+        written, so a crash mid-write leaves the record invisible
+        (``RingReader.discard_pending`` exists for transports that detect
+        death earlier; this pool's quiesce-point detection never needs
+        it)."""
+        if self._transport != "shm" or idx >= len(self._readers):
+            return
+        reader = self._readers[idx]
+        reader.reap()
+        stale_partial = self._partial.pop(idx, None) is not None
+        if self.telemetry is not None:
+            self.telemetry.counter("transport.rings_reclaimed").add(1)
+        logger.info("Reclaimed dead worker %d's shm ring (%d record(s) "
+                    "still pinned by consumer views%s)", idx, reader.pinned,
+                    "; dropped a stale partial payload" if stale_partial
+                    else "")
+
 
 # ------------------------------------------------------------- worker side
 def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
                       endpoints, parent_pid, ring_name=None,
-                      send_claims=False):
+                      send_claims=False, ring_impl="native"):
     """Entry function of a spawned worker process (reference :330)."""
     import zmq
 
@@ -512,22 +703,27 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
     ring = None
     _RING_CLOSED_ERRORS: tuple = ()
     if ring_name is not None:
-        from petastorm_tpu.native import RingClosed, ShmRing
+        from petastorm_tpu.native import RingClosed, make_ring
         _RING_CLOSED_ERRORS = (RingClosed,)
-        ring = ShmRing(ring_name, create=False)
-        max_frame = max(4096, int(ring._lib.pt_ring_capacity(ring._handle)) // 2 - 4096)
+        ring = make_ring(ring_name, create=False, impl=ring_impl)
+        max_frame = max(4096, int(ring.capacity) // 2 - 4096)
 
         def send_ctrl(obj):
             ring.write_tagged(ord("C"), pickle.dumps(obj))
 
         def publish(data):
             payload = memoryview(serializer.serialize(data))
-            # Chunk payloads bigger than a quarter of the ring so one giant
-            # row group can never deadlock against its own backpressure;
-            # memoryview slices keep chunking copy-free.
-            while len(payload) > max_frame:
-                ring.write_tagged(ord("P"), payload[:max_frame])
-                payload = payload[max_frame:]
+            # Chunk payloads bigger than half the ring so one giant row
+            # group can never deadlock against its own backpressure;
+            # memoryview slices keep chunking copy-free, and the S start
+            # frame announces the total so the consumer preallocates ONE
+            # reassembly buffer instead of concatenating per-chunk.
+            if len(payload) > max_frame:
+                ring.write_tagged(ord("S"),
+                                  len(payload).to_bytes(8, "little"))
+                while len(payload) > max_frame:
+                    ring.write_tagged(ord("P"), payload[:max_frame])
+                    payload = payload[max_frame:]
             ring.write_tagged(ord("D"), payload)
     else:
         def send_ctrl(obj):

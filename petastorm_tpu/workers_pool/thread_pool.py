@@ -33,6 +33,7 @@ from petastorm_tpu.resilience.quarantine import (RowGroupSkipped,
                                                  RowGroupSkippedMessage)
 from petastorm_tpu.workers_pool import (EmptyResultError,
                                         ITEM_CONTEXT_KWARG,
+                                        TimeoutWaitingForResultError,
                                         VentilatedItemProcessedMessage,
                                         WorkerFailure)
 
@@ -349,17 +350,22 @@ class ThreadPool:
         return (self._processed[wid] == self._assigned[wid]
                 and self._result_queues[wid].empty())
 
-    def get_results(self):
+    def get_results(self, timeout: float = None):
         """Next published result, in deterministic round-robin order.
 
         Raises :class:`EmptyResultError` when all ventilated work is done and
         drained; re-raises worker exceptions. ``stop()`` acts as a poison
         pill: a consumer blocked here (e.g. a loader staging thread) sees
         :class:`EmptyResultError` promptly instead of polling forever while
-        teardown proceeds under it.
+        teardown proceeds under it. With ``timeout``, raises
+        :class:`TimeoutWaitingForResultError` once that many seconds pass
+        without a result (the migration drain's bounded re-check).
         """
+        deadline = None if timeout is None else time.monotonic() + timeout
         empty_sweeps = 0
         while True:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutWaitingForResultError()
             if self._abort_exc is not None:
                 raise self._abort_exc
             if self._stop_event.is_set():

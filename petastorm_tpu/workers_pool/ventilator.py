@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 _VENTILATION_INTERVAL_S = 0.01
@@ -96,6 +97,8 @@ class ConcurrentVentilator(Ventilator):
 
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        self._paused = False
+        self._pause_parked = threading.Event()
         self._stop_event = threading.Event()
         self._completed_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -186,6 +189,38 @@ class ConcurrentVentilator(Ventilator):
         with self._inflight_cv:
             self._inflight_cv.notify_all()
 
+    def pause(self, timeout: float = 30.0) -> bool:
+        """Park the ventilation thread before its next ``ventilate_fn``
+        call (the pool-migration quiesce point): returns once the thread is
+        provably parked — or already finished — so no in-flight call can
+        land on a pool that is about to be torn down. Returns whether the
+        park was confirmed within ``timeout``."""
+        with self._inflight_cv:
+            self._paused = True
+            self._pause_parked.clear()
+            self._inflight_cv.notify_all()
+        if self._thread is None or not self._thread.is_alive() \
+                or self.completed():
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._pause_parked.is_set() or self.completed() \
+                    or not self._thread.is_alive():
+                return True
+            time.sleep(0.005)  # backoff-ok: park-ack poll, not a retry
+        return False
+
+    def resume(self) -> None:
+        with self._inflight_cv:
+            self._paused = False
+            self._inflight_cv.notify_all()
+
+    def set_ventilate_fn(self, fn) -> None:
+        """Repoint ventilation at another pool's ``ventilate`` (the
+        placement migration swap). Only safe while :meth:`pause` holds the
+        thread parked — the loop re-reads the fn each item."""
+        self._ventilate_fn = fn
+
     def completed(self) -> bool:
         # A stopped ventilator will never ventilate again: report completed
         # so consumers drain and raise EmptyResultError instead of spinning
@@ -248,17 +283,26 @@ class ConcurrentVentilator(Ventilator):
             epoch_offset, skip = skip, 0
             for pos, item in enumerate(epoch_items, start=epoch_offset):
                 with self._inflight_cv:
-                    while (self._inflight >= self._max_inflight
+                    while ((self._inflight >= self._max_inflight
+                            or self._paused)
                            and not self._stop_event.is_set()):
+                        if self._paused:
+                            # Park acknowledged: pause() may now safely
+                            # swap the ventilate target — no call is in
+                            # flight, and this loop re-checks _paused on
+                            # every wakeup until resume().
+                            self._pause_parked.set()
                         self._inflight_cv.wait(self._interval)
                     if self._stop_event.is_set():
                         return
                     self._inflight += 1
+                # Re-read per item: a paused swap repoints it mid-epoch.
+                ventilate_fn = self._ventilate_fn
                 if self._context_key is not None:
-                    self._ventilate_fn(**item,
-                                       **{self._context_key: (self._epoch, pos)})
+                    ventilate_fn(**item,
+                                 **{self._context_key: (self._epoch, pos)})
                 else:
-                    self._ventilate_fn(**item)
+                    ventilate_fn(**item)
             self._epoch += 1
             if iterations_left is not None:
                 iterations_left -= 1

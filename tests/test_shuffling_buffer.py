@@ -97,12 +97,13 @@ def test_shuffle_quality_decorrelates_order():
 
 
 # --------------------------------------------------------------- batched RNG
-def test_default_draws_stay_byte_identical():
-    """The per-pop draw sequence is a compatibility surface: recorded
-    epochs replay against it. This pins the default path to the exact
-    pre-batched-RNG implementation (one bounded ``integers`` call per
-    pop, swap-with-last)."""
-    b = RandomShufflingBuffer(10, seed=7)
+def test_legacy_draws_stay_byte_identical():
+    """The legacy per-pop draw sequence is a compatibility surface:
+    epochs recorded before round 8 replay against it. ``batched_rng=False``
+    pins it to the exact pre-batched-RNG implementation (one bounded
+    ``integers`` call per pop, swap-with-last) — the byte-parity waiver
+    (docs/zero_copy.md) flipped only the DEFAULT, not this path."""
+    b = RandomShufflingBuffer(10, seed=7, batched_rng=False)
     b.add_many(range(10))
     b.finish()
     got = [b.retrieve() for _ in range(10)]
@@ -111,6 +112,27 @@ def test_default_draws_stay_byte_identical():
     ref = []
     for _ in range(10):
         i = int(rng.integers(0, len(items)))
+        items[i], items[-1] = items[-1], items[i]
+        ref.append(items.pop())
+    assert got == ref
+
+
+@pytest.mark.zerocopy
+def test_default_is_batched_and_pinned():
+    """Round 8 flips ``batched_rng`` to the default. The default sequence
+    is itself a new compatibility surface: pin it to the exact block-draw
+    implementation (63-bit block words reduced modulo the live size) so a
+    future refactor can't silently reshuffle seeded epochs again."""
+    b = RandomShufflingBuffer(10, seed=7)
+    b.add_many(range(10))
+    b.finish()
+    got = [b.retrieve() for _ in range(10)]
+    rng = np.random.default_rng(7)
+    block = rng.integers(0, 1 << 63, size=1024, dtype=np.uint64)
+    items = list(range(10))
+    ref = []
+    for k in range(10):
+        i = int(block[k]) % len(items)
         items[i], items[-1] = items[-1], items[i]
         ref.append(items.pop())
     assert got == ref
