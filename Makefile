@@ -46,11 +46,26 @@ ci-lint:
 	python tools/check_timeouts.py
 	python tools/check_columns.py
 	python tools/check_copies.py
+	python tools/check_hostlocal.py
 
-# Diff the two newest committed round artifacts; fails on a >20% drop in
-# any shared bench phase (tools/bench_compare.py for the phase-key rules).
+# Diff the two newest committed round artifacts — both the CPU-bench
+# BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and
+# fail on a >20% drop in any shared bench phase (tools/bench_compare.py
+# for the phase-key rules). Override the pair under comparison with
+# `make bench-compare OLD=a.json NEW=b.json`.
 bench-compare:
+ifdef OLD
+ifndef NEW
+	$(error bench-compare: OLD is set but NEW is not — pass both, e.g. `make bench-compare OLD=a.json NEW=b.json`)
+endif
+	python tools/bench_compare.py $(OLD) $(NEW)
+else
+ifdef NEW
+	$(error bench-compare: NEW is set but OLD is not — pass both, e.g. `make bench-compare OLD=a.json NEW=b.json`)
+endif
 	python tools/bench_compare.py
+	python tools/bench_compare.py --prefix MULTICHIP
+endif
 
 ci-adapters:
 	timeout 1200 python -m pytest tests/test_torch_loader_depth.py \

@@ -754,6 +754,59 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"readahead phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4f4. multi-host mesh ingestion (docs/mesh.md): one logical
+    # dataset -> one globally sharded jax.Array per step, on the 8-device
+    # CPU simulation (XLA_FLAGS=--xla_force_host_platform_device_count=8,
+    # 8 simulated hosts each reading a disjoint row-group shard through
+    # its own reader). Reports aggregate samples/sec, the consumer-side
+    # input_stall_pct derived gauge, and the per-host stall fractions +
+    # fastest-vs-slowest skew from mesh_report() — the <1%-stall
+    # acceptance surface for ROADMAP item 1, measurable without hardware.
+    mesh_child = (
+        "import json, os, time\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +\n"
+        "    ' --xla_force_host_platform_device_count=8')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "factory = MeshReaderFactory(url, batched=True)\n"
+        "def epoch(step_s):\n"
+        "    rows, t0 = 0, time.perf_counter()\n"
+        "    with MeshDataLoader(factory, batch_size=2048, seed=0,\n"
+        "                        num_epochs=1) as loader:\n"
+        "        for batch in loader:\n"
+        "            rows += next(iter(batch.values())).shape[0]\n"
+        "            if step_s:\n"
+        "                time.sleep(step_s)\n"
+        "        rep = loader.mesh_report()\n"
+        "        stall_gauge = loader.telemetry.snapshot()['gauges'].get(\n"
+        "            'loader.input_stall_pct')\n"
+        "    return rows, time.perf_counter() - t0, rep, stall_gauge\n"
+        "epoch(0)  # warm-up pays import + per-host fs metadata costs\n"
+        "rows, elapsed, rep, _ = epoch(0)  # max-rate drain: throughput\n"
+        "# Stall is only meaningful against a device step (a drain loop is\n"
+        "# 100% wait by construction): re-run against a 10ms emulated step,\n"
+        "# same spirit as the 4b stall sweep's wall-clock-calibrated steps.\n"
+        "_, _, rep_step, stall_gauge = epoch(0.01)\n"
+        "print('BENCHJSON:' + json.dumps({'mesh_ingest_epoch': {\n"
+        "    'mesh_ingest_samples_per_sec': round(rows / elapsed, 1),\n"
+        "    'rows': rows,\n"
+        "    'devices': 8,\n"
+        "    'hosts': rep['hosts'],\n"
+        "    'emulated_step_ms': 10,\n"
+        "    'input_stall_pct': stall_gauge,\n"
+        "    'per_host_input_stall_pct': {h: v['input_stall_pct']\n"
+        "                                 for h, v\n"
+        "                                 in rep_step['per_host'].items()},\n"
+        "    'host_skew_s': rep_step['host_skew_s'],\n"
+        "    'reshard_events': rep['reshard_events']\n"
+        "                      + rep_step['reshard_events']}}))\n")
+    try:
+        out.update(_cpu_subprocess(mesh_child, data_dir, timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"mesh ingest phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4g. autotune feedback loop (docs/autotune.md): the columnar
     # loader epoch from 4d, with the controller live on a fast tick.
     # Reports the tick/verdict counters, every adjustment it made, and the

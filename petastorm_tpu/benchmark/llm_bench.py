@@ -50,7 +50,9 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
                   resident_steps: int = 0, dense: bool = True,
                   flash: bool = False, xent_chunk: int | None = None,
                   remat_layers: bool = False,
-                  model_kwargs: dict | None = None) -> dict:
+                  model_kwargs: dict | None = None,
+                  mesh_ingest: bool = False,
+                  mesh_hosts: int | None = None) -> dict:
     """Token windows through the full reader stack into a real llama
     train step; returns ``{tokens_per_sec, input_stall_pct,
     step_time_ms, loss_first, loss_last[, *_resident], ...}``.
@@ -59,6 +61,16 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
     :func:`.imagenet_bench.run_imagenet_bench` (pipelined window, single
     readback sync, per-step host-side stall split, wait/compute-overlap
     caveat and all).
+
+    ``mesh_ingest=True`` swaps the single-reader ``DataLoader`` for the
+    multi-host :class:`~petastorm_tpu.jax.mesh_loader.MeshDataLoader`
+    (docs/mesh.md): ``mesh_hosts`` per-host readers each decode a
+    disjoint row-group shard and every step assembles one global
+    ``(batch, window)`` token array across the whole slice — the
+    ctx32k/ctx64k single-chip baselines scaled out. The result then
+    carries the loader's ``mesh_report`` (per-host stall/skew/reshard).
+    Requires ``dense=True`` (windows need the fixed-shape layout) and
+    ``batch_size`` divisible by the data-axis size.
     """
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -103,13 +115,31 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
     ngram = NGram({o: ["ts", "token"] for o in range(window)},
                   delta_threshold=1, timestamp_field="ts",
                   timestamp_overlap=False, dense=dense)
-    with make_reader(url, schema_fields=ngram, num_epochs=None,
-                     shuffle_row_groups=True, seed=0,
-                     reader_pool_type=pool_type,
-                     workers_count=workers_count) as reader:
-        loader = DataLoader(reader, batch_size=batch_size,
-                            sharding=NamedSharding(mesh, P("data")),
-                            echo=echo)
+    if mesh_ingest:
+        if not dense:
+            raise ValueError("mesh_ingest requires dense=True NGram readout")
+        from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+        factory = MeshReaderFactory(url, batched=False, schema_fields=ngram,
+                                    reader_pool_type=pool_type)
+        loader = MeshDataLoader(factory, batch_size=batch_size, mesh=mesh,
+                                partition_spec=P("data"),
+                                num_hosts=mesh_hosts, num_epochs=None,
+                                seed=0, echo=echo)
+    else:
+        reader = make_reader(url, schema_fields=ngram, num_epochs=None,
+                             shuffle_row_groups=True, seed=0,
+                             reader_pool_type=pool_type,
+                             workers_count=workers_count)
+        try:
+            loader = DataLoader(reader, batch_size=batch_size,
+                                sharding=NamedSharding(mesh, P("data")),
+                                echo=echo)
+        except BaseException:
+            # The loader owns reader shutdown only once constructed.
+            reader.stop()
+            reader.join()
+            raise
+    with loader:  # closes the underlying reader(s) on exit
         it = iter(loader)
         tokens = next(it)["token"]
         assert tokens.shape == (batch_size, window), tokens.shape
@@ -125,6 +155,7 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         loss_first, loss_last, wait_s, total_wall, resident_s = (
             pipelined_window(run_step, lambda: next(it)["token"], steps,
                              resident_steps, warm_loss=loss))
+        mesh_report = loader.mesh_report() if mesh_ingest else None
 
     tokens_per_step = batch_size * window
     step_time_s = (total_wall - wait_s) / steps
@@ -149,6 +180,127 @@ def run_llm_bench(url: str, steps: int = 20, batch_size: int = 8,
         result["tokens_per_sec_resident"] = tokens_per_step / resident_s
         result["tokens_per_sec_per_chip_resident"] = (
             tokens_per_step / resident_s / len(devices))
+    if mesh_report is not None:
+        result["mesh_ingest"] = True
+        result["mesh_hosts"] = mesh_report["hosts"]
+        result["mesh_report"] = mesh_report
     utilization_metrics(result, flops_per_step, step_time_s, resident_s,
                         devices[0].device_kind)
     return result
+
+
+def _ctx_label(window: int) -> str:
+    """32768 -> "32k" (the BENCH_TPU_EVIDENCE key convention)."""
+    return f"{window // 1024}k" if window % 1024 == 0 else str(window)
+
+
+def main(argv=None) -> int:
+    """Long-context llama phase CLI — the ctx32k/ctx64k capture, now with
+    ``--mesh`` scaling ingestion from one chip to the whole slice::
+
+        python -m petastorm_tpu.benchmark.llm_bench --ctx 32768 --mesh \
+            --flash --xent-chunk 2048 --out MULTICHIP_r06.json
+
+    ``--out`` writes MULTICHIP_r0*.json-shape evidence: the driver wrapper
+    keys (``n_devices``/``rc``/``ok``/``tail``) plus ``parsed`` carrying
+    ``ctx<N>k_``-prefixed metrics — the same keys bench.py's
+    ``tpu_evidence`` block and ``tools/bench_compare.py --prefix
+    MULTICHIP`` consume.
+    """
+    import argparse
+    import json
+    import os
+    import sys
+
+    parser = argparse.ArgumentParser(
+        description="llama train-step pipeline benchmark (ctx32k/ctx64k "
+                    "phases; --mesh = multi-host GSPMD mesh ingestion)")
+    parser.add_argument("--ctx", type=int, default=32768,
+                        help="context window (tokens per row group)")
+    parser.add_argument("--mesh", action="store_true",
+                        help="ingest through MeshDataLoader across every "
+                             "device (docs/mesh.md) instead of the "
+                             "single-reader DataLoader")
+    parser.add_argument("--hosts", type=int, default=None,
+                        help="feeding hosts for --mesh (default: JAX "
+                             "process count, or one per device in a "
+                             "single-process simulation)")
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=None,
+                        help="GLOBAL batch; default 1 per data-axis shard")
+    parser.add_argument("--windows", type=int, default=None,
+                        help="windows in the token store (default: enough "
+                             "for warmup+steps at the chosen batch)")
+    parser.add_argument("--flash", action="store_true",
+                        help="Pallas flash attention (the >=8k-context "
+                             "config; TPU-only in practice)")
+    parser.add_argument("--xent-chunk", type=int, default=None)
+    parser.add_argument("--remat-layers", action="store_true")
+    parser.add_argument("--tiny-model", action="store_true",
+                        help="4-layer dim-256 config for CPU-simulation "
+                             "dry runs; full BASELINE llama otherwise")
+    parser.add_argument("--data-dir",
+                        default=os.environ.get("BENCH_DATA_DIR",
+                                               "/tmp/pt_bench"))
+    parser.add_argument("--out", default=None,
+                        help="write MULTICHIP-shape evidence JSON here")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    n_devices = jax.device_count()
+    batch = args.batch_size
+    if batch is None:
+        from petastorm_tpu.parallel.mesh import batch_shard_count, make_mesh
+        from jax.sharding import PartitionSpec
+        batch = batch_shard_count(make_mesh([-1], ["data"]),
+                                  PartitionSpec("data"))
+    label = _ctx_label(args.ctx)
+    windows = args.windows or max(4 * batch, batch * (args.steps + 2))
+    store = os.path.join(args.data_dir, f"tokens_ctx{label}_w{windows}")
+    url = f"file://{store}"
+    if not os.path.exists(os.path.join(store, "_common_metadata")):
+        write_token_store(url, windows=windows, window=args.ctx)
+
+    model_kwargs = ({"dim": 256, "n_layers": 4, "n_heads": 4,
+                     "n_kv_heads": 2, "hidden": 704} if args.tiny_model
+                    else None)
+    result = run_llm_bench(url, steps=args.steps, batch_size=batch,
+                           window=args.ctx, flash=args.flash,
+                           xent_chunk=args.xent_chunk,
+                           remat_layers=args.remat_layers,
+                           model_kwargs=model_kwargs,
+                           mesh_ingest=args.mesh, mesh_hosts=args.hosts)
+
+    parsed = {f"ctx{label}_{k}": v for k, v in result.items()
+              if not isinstance(v, dict)}
+    parsed[f"ctx{label}_mesh"] = bool(args.mesh)
+    if "mesh_report" in result:
+        rep = result["mesh_report"]
+        parsed[f"ctx{label}_mesh_hosts"] = rep["hosts"]
+        parsed[f"ctx{label}_mesh_host_skew_s"] = rep["host_skew_s"]
+        parsed[f"ctx{label}_mesh_reshard_events"] = rep["reshard_events"]
+        parsed[f"ctx{label}_mesh_max_host_stall_pct"] = max(
+            (h["input_stall_pct"] for h in rep["per_host"].values()),
+            default=0.0)
+    tail = (f"llm ctx{label} {'mesh' if args.mesh else 'single-reader'} "
+            f"ingestion on {n_devices} device(s): "
+            f"{result['tokens_per_sec']:.1f} tok/s, input stall "
+            f"{result['input_stall_pct']:.2f}%, step "
+            f"{result['step_time_ms']:.1f} ms, loss "
+            f"{result['loss_first']:.4f} -> {result['loss_last']:.4f}")
+    print(tail)
+    print(json.dumps(parsed))
+    if args.out:
+        evidence = {"n_devices": n_devices, "rc": 0, "ok": True,
+                    "device_kind": result.get("device_kind"),
+                    "parsed": parsed, "tail": tail + "\n"}
+        with open(args.out, "w") as f:
+            json.dump(evidence, f, indent=1)
+        print(f"evidence -> {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+    _sys.exit(main())

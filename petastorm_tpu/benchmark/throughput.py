@@ -160,7 +160,7 @@ def make_synthetic_device_step(target_ms: float):
 
     target_s = target_ms / 1000.0
 
-    if jax.devices()[0].platform == "cpu":
+    if jax.devices()[0].platform == "cpu":  # hostlocal-ok: single-process bench harness calibrating an emulated device step
         def sleep_step():
             time.sleep(target_s)
         return sleep_step

@@ -5,3 +5,6 @@ from petastorm_tpu.jax.dtypes import DTypePolicy, DEFAULT_POLICY  # noqa: F401
 from petastorm_tpu.jax.loader import (DataLoader, BatchedDataLoader,  # noqa: F401
                                       InMemBatchedDataLoader,
                                       aligned_steps_per_epoch)
+from petastorm_tpu.jax.mesh_loader import (MeshDataLoader,  # noqa: F401
+                                           MeshHostLostError,
+                                           MeshReaderFactory)

@@ -525,17 +525,21 @@ class LoaderBase:
                     "Staging thread still busy after stop (reader stalled "
                     "mid-batch?); it will exit when the reader stops.")
 
-    def _finalize_tail(self, cols: Dict[str, np.ndarray], count: int):
-        """Handle the ragged last batch: drop, pad+mask, or emit as-is."""
+    def _finalize_tail(self, cols: Dict[str, np.ndarray], count: int,
+                       target_rows: Optional[int] = None):
+        """Handle the ragged last batch: drop, pad+mask, or emit as-is.
+        ``target_rows`` overrides the pad target (the mesh loader pads to
+        the per-host step quota, not the global batch)."""
+        target = self._batch_size if target_rows is None else target_rows
         if count == 0:
             return None
-        if count == self._batch_size:
+        if count == target:
             return cols
         if self._drop_last:
             return None
         if self._pad_last:
             out = {}
-            pad = self._batch_size - count
+            pad = target - count
             for k, v in cols.items():
                 pad_width = [(0, pad)] + [(0, 0)] * (v.ndim - 1)
                 out[k] = np.pad(v, pad_width)

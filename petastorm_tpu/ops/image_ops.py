@@ -65,7 +65,7 @@ def normalize_images(images, mean: tuple = (0.485, 0.456, 0.406),
     period_rows = int(np.lcm(channels, _LANES)) // _LANES
     block_rows = _pick_block_rows(rows, period_rows) if rows else None
 
-    platform = jax.devices()[0].platform if jax.devices() else "cpu"
+    platform = jax.devices()[0].platform if jax.devices() else "cpu"  # hostlocal-ok: platform (not topology) probe; same verdict on every host of a homogeneous slice
     if use_pallas is None:
         # Measured on v5e: XLA's automatic fusion wins for this purely
         # memory-bound elementwise op (~0.9ms vs ~1.4ms per 8x224x224x3

@@ -61,6 +61,45 @@ def reader_shard_for_mesh(mesh=None, data_axis: str = "data") -> Tuple[int, int]
     return jax.process_index(), jax.process_count()
 
 
+def mesh_feed_topology(mesh, num_hosts: Optional[int] = None) -> Tuple[int, Optional[int], bool]:
+    """``(num_hosts, local_host_index, multiprocess)`` for feeding ``mesh``.
+
+    On a real multi-host slice every JAX process feeds its own addressable
+    devices — one host IS one process, so ``num_hosts`` is pinned to
+    ``jax.process_count()`` and ``local_host_index`` is this process. In a
+    single-process simulation (``XLA_FLAGS=--xla_force_host_platform_
+    device_count=N``) there is no process boundary: default to one
+    simulated host per mesh device, so the mesh ingestion path
+    (:class:`petastorm_tpu.jax.mesh_loader.MeshDataLoader`) exercises the
+    same per-host-shard -> global-assembly code on CPU that a pod slice
+    runs on TPU; ``local_host_index`` is then ``None`` (every simulated
+    host lives here).
+    """
+    import jax
+    procs = jax.process_count()
+    if procs > 1:
+        if num_hosts is not None and num_hosts != procs:
+            raise ValueError(
+                f"num_hosts={num_hosts} conflicts with the JAX runtime's "
+                f"{procs} processes: on a multi-host slice one host is one "
+                f"process")
+        return procs, jax.process_index(), True
+    n = int(num_hosts) if num_hosts is not None else int(mesh.devices.size)
+    if n < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {n}")
+    return n, None, False
+
+
+def batch_shard_count(mesh, partition_spec) -> int:
+    """How many ways ``partition_spec`` splits dim 0 (the batch dim) across
+    ``mesh`` — the divisibility requirement for a global batch."""
+    if len(partition_spec) == 0 or partition_spec[0] is None:
+        return 1
+    first = partition_spec[0]
+    names = (first,) if isinstance(first, str) else tuple(first)
+    return int(np.prod([mesh.shape[name] for name in names]))
+
+
 def global_batch_size(per_device_batch: int, mesh, data_axis: str = "data") -> int:
     return per_device_batch * mesh.shape[data_axis]
 

@@ -29,7 +29,7 @@ import os
 import time
 import warnings
 from collections import deque
-from typing import Optional
+from typing import Optional, Sequence
 
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.errors import MetadataError, NoDataAvailableError
@@ -185,6 +185,30 @@ def _warn_compat_kwargs(hdfs_driver, pyarrow_serialize):
                       "is a no-op here", DeprecationWarning, stacklevel=3)
 
 
+#: Per-process memo for one-shot configuration warnings, keyed by the
+#: kwarg name that triggered them. The ``warnings`` module dedupes by
+#: source location, but each Reader construction re-derives the message in
+#: a fresh call context, so pre-mesh these caveats fired once per READER —
+#: a mesh ingestion epoch builds one reader per (simulated) host per epoch
+#: and would repeat a process-wide fact H x epochs times (docs/mesh.md).
+_ONE_SHOT_WARNED: set = set()
+
+
+def _warn_once(kwarg: str, message: str, stacklevel: int = 2) -> None:
+    """Emit ``message`` at most once per process for ``kwarg``. The caveat
+    depends only on process-wide configuration (kwarg x pool flavor), so
+    the first reader that hits it speaks for every later one."""
+    if kwarg in _ONE_SHOT_WARNED:
+        return
+    _ONE_SHOT_WARNED.add(kwarg)
+    warnings.warn(message, stacklevel=stacklevel)
+
+
+def _reset_one_shot_warnings() -> None:
+    """Test hook: forget which one-shot warnings already fired."""
+    _ONE_SHOT_WARNED.clear()
+
+
 def _resolve_shard(cur_shard, shard_count):
     """``cur_shard="auto"`` -> this JAX process's (index, count)."""
     if cur_shard == "auto":
@@ -327,7 +351,8 @@ def make_reader(dataset_url,
                 hang_timeout_s: Optional[float] = None,
                 rowgroup_pruning: bool = True,
                 readahead_depth: Optional[int] = None,
-                readahead_max_bytes: Optional[int] = None):
+                readahead_max_bytes: Optional[int] = None,
+                rowgroup_subset: Optional[Sequence[int]] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -434,6 +459,14 @@ def make_reader(dataset_url,
     :param readahead_max_bytes: byte allowance for fetched-ahead tables
         (default 256 MiB); with ``autotune_config.memory_budget_bytes``
         the PR 3 shared ledger is charged instead.
+    :param rowgroup_subset: explicit plan restriction — ordinals into the
+        dataset's deterministic row-group order (``load_row_groups``),
+        read in exactly the given order. This is how the mesh ingestion
+        layer (docs/mesh.md) expresses per-host shard plans and
+        reassigns a lost host's remaining range to survivors; the
+        ordinals compose with predicate/selector/statistics pruning
+        (which still run after the restriction) and are mutually
+        exclusive with ``cur_shard`` — a subset IS a shard assignment.
 
     Parity: reference reader.py:60.
     """
@@ -502,7 +535,8 @@ def make_reader(dataset_url,
                   hang_timeout_s=hang_timeout_s,
                   rowgroup_pruning=rowgroup_pruning,
                   readahead_depth=readahead_depth,
-                  readahead_max_bytes=readahead_max_bytes)
+                  readahead_max_bytes=readahead_max_bytes,
+                  rowgroup_subset=rowgroup_subset)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -548,7 +582,8 @@ def make_batch_reader(dataset_url_or_urls,
                       rowgroup_pruning: bool = True,
                       readahead_depth: Optional[int] = None,
                       readahead_max_bytes: Optional[int] = None,
-                      serializer=None):
+                      serializer=None,
+                      rowgroup_subset: Optional[Sequence[int]] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -587,6 +622,9 @@ def make_batch_reader(dataset_url_or_urls,
     to force the bytes round-trip (e.g. to A/B the transports, or for a
     custom worker payload Arrow IPC cannot carry); thread/dummy pools
     ignore it (nothing is serialized in-process).
+    ``rowgroup_subset`` restricts the plan to explicit row-group ordinals
+    in the given order, exactly as in :func:`make_reader` — the mesh
+    ingestion layer's shard-plan/reshard mechanism (docs/mesh.md).
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -663,7 +701,8 @@ def make_batch_reader(dataset_url_or_urls,
                   hang_timeout_s=hang_timeout_s,
                   rowgroup_pruning=rowgroup_pruning,
                   readahead_depth=readahead_depth,
-                  readahead_max_bytes=readahead_max_bytes)
+                  readahead_max_bytes=readahead_max_bytes,
+                  rowgroup_subset=rowgroup_subset)
 
 
 class Reader:
@@ -683,7 +722,8 @@ class Reader:
                  autotune=False, autotune_config=None, stage_deadline_s=None,
                  hedge_policy=None, hang_timeout_s=None,
                  rowgroup_pruning=True, readahead_depth=None,
-                 readahead_max_bytes=None, pool_factory=None):
+                 readahead_max_bytes=None, pool_factory=None,
+                 rowgroup_subset=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -717,6 +757,20 @@ class Reader:
             raise ValueError("cur_shard and shard_count must be used together")
         if cur_shard is not None and not (0 <= cur_shard < shard_count):
             raise ValueError(f"cur_shard {cur_shard} out of range [0, {shard_count})")
+        if rowgroup_subset is not None and cur_shard is not None:
+            raise ValueError(
+                "rowgroup_subset and cur_shard/shard_count are mutually "
+                "exclusive: an explicit ordinal subset IS a shard "
+                "assignment (the mesh layer computes it with the same "
+                "index %% shard_count arithmetic; docs/mesh.md)")
+        if rowgroup_subset is not None and shuffle_row_groups:
+            # The subset's ORDER is its contract (delivery watermarks map
+            # back to plan positions through it); a seeded ventilation
+            # shuffle would silently reorder underneath that arithmetic.
+            raise ValueError(
+                "rowgroup_subset delivers row groups in exactly the given "
+                "order; pass shuffle_row_groups=False and shuffle the "
+                "ordinal list itself instead (docs/mesh.md)")
 
         # ---------------- schema views
         self.ngram: Optional[NGram] = None
@@ -748,7 +802,8 @@ class Reader:
         filtered = self._filter_row_groups(all_row_groups, predicate,
                                            rowgroup_selector, cur_shard,
                                            shard_count, shard_seed,
-                                           filters=filters)
+                                           filters=filters,
+                                           rowgroup_subset=rowgroup_subset)
         if not filtered:
             raise NoDataAvailableError(
                 "No row groups left after predicate/selector/shard filtering. "
@@ -799,7 +854,8 @@ class Reader:
                 # entries and telemetry cannot cross the spawn boundary), so
                 # each spawned worker holds a private budget of the full
                 # configured size over its own round-robin item subset.
-                warnings.warn(
+                _warn_once(
+                    "memory_cache_size_bytes",
                     "memory_cache_size_bytes with reader_pool_type='process' "
                     "keeps a PRIVATE cache of that size in every spawned "
                     f"worker: up to {self._pool.workers_count}x the "
@@ -824,9 +880,10 @@ class Reader:
                 # The fetched-table store is shared memory; it cannot cross
                 # the spawn boundary (spawned workers already overlap IO
                 # against their sibling processes).
-                warnings.warn("readahead_depth only applies to in-process "
-                              "pools (reader_pool_type='thread'/'dummy'); "
-                              "ignored for the process pool")
+                _warn_once("readahead_depth",
+                           "readahead_depth only applies to in-process "
+                           "pools (reader_pool_type='thread'/'dummy'); "
+                           "ignored for the process pool")
             else:
                 from petastorm_tpu.autotune import MemoryBudget
                 from petastorm_tpu.reader_impl.readahead import \
@@ -1123,7 +1180,8 @@ class Reader:
 
     # ------------------------------------------------------------- planning
     def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
-                           cur_shard, shard_count, shard_seed, filters=None):
+                           cur_shard, shard_count, shard_seed, filters=None,
+                           rowgroup_subset=None):
         filtered = list(row_groups)
         if filters:
             filtered = self._apply_filters(filtered, filters)
@@ -1134,7 +1192,34 @@ class Reader:
         if cur_shard is not None:
             filtered = self._partition_row_groups(filtered, cur_shard, shard_count,
                                                   shard_seed)
+        if rowgroup_subset is not None:
+            filtered = self._apply_rowgroup_subset(row_groups, filtered,
+                                                   rowgroup_subset)
         return filtered
+
+    @staticmethod
+    def _apply_rowgroup_subset(all_row_groups, filtered, rowgroup_subset):
+        """Restrict the plan to explicit ordinals into the deterministic
+        unfiltered row-group order — IN THE SUBSET'S ORDER. The subset
+        stands in for the shard partition (the mesh layer pre-computes and
+        possibly pre-shuffles it), so ventilation order follows the caller's
+        list, which is what makes per-host delivery watermarks map back to
+        plan positions (docs/mesh.md). Groups the earlier filter stages
+        dropped stay dropped; an out-of-range or duplicate ordinal is a
+        caller bug and raises."""
+        seen = set()
+        for ordinal in rowgroup_subset:
+            if not 0 <= ordinal < len(all_row_groups):
+                raise ValueError(
+                    f"rowgroup_subset ordinal {ordinal} out of range "
+                    f"[0, {len(all_row_groups)}) for this dataset")
+            if ordinal in seen:
+                raise ValueError(
+                    f"rowgroup_subset contains duplicate ordinal {ordinal}")
+            seen.add(ordinal)
+        kept_ids = {id(rg) for rg in filtered}
+        return [all_row_groups[i] for i in rowgroup_subset
+                if id(all_row_groups[i]) in kept_ids]
 
     @staticmethod
     def _apply_filters(row_groups, filters):

@@ -55,6 +55,12 @@ class StallAttributor:
                 registry.counter(f"loader.next_{cls}")
             registry.counter("loader.delivery_wait_s")
             registry.counter("loader.consumer_busy_s")
+            # Derived gauge: stall time as a percentage of step wall time
+            # (delivery wait / (wait + busy)). The <1% multi-host ingestion
+            # acceptance target (docs/mesh.md) read straight off a snapshot
+            # — `python -m petastorm_tpu.telemetry dump` and the bench JSON
+            # surface it without re-deriving from two counters.
+            registry.gauge("loader.input_stall_pct", self._stall_pct)
 
     def observe(self, wait_s: float, busy_s: float) -> str:
         """Record one delivered batch; returns its classification."""
@@ -78,6 +84,12 @@ class StallAttributor:
             self._registry.counter("loader.delivery_wait_s").add(wait_s)
             self._registry.counter("loader.consumer_busy_s").add(busy_s)
         return cls
+
+    def _stall_pct(self) -> float:
+        with self._lock:
+            total = self._wait_s + self._busy_s
+            return (round(100.0 * self._wait_s / total, 4) if total
+                    else 0.0)
 
     # ------------------------------------------------------------ readout
     @property
@@ -107,6 +119,8 @@ class StallAttributor:
             "delivery_wait_s": round(wait_s, 6),
             "consumer_busy_s": round(busy_s, 6),
             "wait_fraction": round(wait_s / total, 4) if total else 0.0,
+            "input_stall_pct": (round(100.0 * wait_s / total, 4) if total
+                                else 0.0),
             "verdict": verdict,
             "last": last,
             "thresholds": {"device_bound_below": self._low,

@@ -226,6 +226,8 @@ class TestReaderIntegration:
         assert stats["fetched_total"] + stats["misses"] == 10
 
     def test_process_pool_warns_and_ignores(self, store):
+        from petastorm_tpu.reader import _reset_one_shot_warnings
+        _reset_one_shot_warnings()  # the caveat fires once per process
         with pytest.warns(UserWarning, match="readahead_depth"):
             reader = make_batch_reader(store, reader_pool_type="process",
                                        workers_count=1, readahead_depth=4,
@@ -306,6 +308,8 @@ class TestReaderIntegration:
         epoch is lossless and duplicate-free."""
         plan = FaultPlan([FaultSpec(site="worker.item", kind="worker_kill",
                                     at=2, worker=0)], seed=7)
+        from petastorm_tpu.reader import _reset_one_shot_warnings
+        _reset_one_shot_warnings()  # the caveat fires once per process
         with pytest.warns(UserWarning, match="readahead_depth"):
             reader = make_reader(synthetic_dataset.url,
                                  reader_pool_type="process", workers_count=2,

@@ -567,3 +567,86 @@ def test_resume_no_loss_property_random_interrupt_points(synthetic_dataset,
         # seeded determinism: the resumed stream replays the SAME order the
         # uninterrupted run had (not merely the same set)
         assert part1 == full[:k * batch], (seed, pool, k)
+
+
+# ---------------------------------------------------------------------------
+# Mesh ingestion x checkpoint x device cache (docs/mesh.md)
+
+@pytest.mark.mesh
+def test_checkpoint_manager_restores_mesh_loader_cursor(tmp_path,
+                                                        scalar_dataset):
+    """The satellite acceptance: a MeshDataLoader cursor rides the orbax
+    sidecar like a reader cursor does, and a rebuilt loader (the simulated
+    host restart: every per-host reader torn down and reconstructed)
+    resumes at the saved per-host shard positions and epoch index."""
+    import jax.numpy as jnp
+
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    factory = MeshReaderFactory(scalar_dataset.url, batched=True)
+    train_state = {"w": jnp.arange(4.0)}
+    first = []
+    # batch 40 over 4 hosts = whole 10-row groups per host per step, so the
+    # cursor is group-aligned and the resumed stream is exactly-once.
+    with MeshDataLoader(factory, batch_size=40, num_hosts=4, seed=13,
+                        num_epochs=2) as loader:
+        it = iter(loader)
+        for _ in range(3):
+            first.extend(int(v) for v in np.asarray(next(it)["id"]))
+        with CheckpointManager(str(tmp_path / "mesh_ckpt")) as mgr:
+            assert mgr.save(1, train_state, loader=loader)
+
+    with CheckpointManager(str(tmp_path / "mesh_ckpt")) as mgr:
+        restored, input_state = mgr.restore(abstract=train_state)
+    assert float(restored["w"].sum()) == 6.0
+    assert input_state is not None and input_state.get("mesh") is True
+    assert input_state["epoch"] == 1  # 3 batches = epoch 0 (2 full) + 1
+    assert input_state["num_hosts"] == 4
+
+    rest = []
+    with MeshDataLoader(factory, batch_size=40, num_hosts=4, seed=13,
+                        num_epochs=1, resume_state=input_state,
+                        drop_last=False, pad_last=True) as loader2:
+        for batch in loader2:
+            arr = np.asarray(batch["id"])
+            if "__valid__" in batch:
+                arr = arr[np.asarray(batch["__valid__"])]
+            rest.extend(int(v) for v in arr)
+    # epoch 0 delivered fully in `first` (2 batches) + 1 batch of epoch 1;
+    # the resumed run must complete epoch 1 exactly — no loss, and with
+    # group-aligned batches no duplication either.
+    epoch1_delivered = first[80:] + rest
+    assert len(first[:80]) == len(set(first[:80])) == 80  # epoch-0 batches
+    assert sorted(epoch1_delivered) == list(range(100))
+
+
+@pytest.mark.mesh
+def test_device_cache_composes_with_mesh_shard_plan(scalar_dataset):
+    """DeviceCachedDataset built from a mesh-planned rowgroup_subset
+    reader serves globally-sharded batches over the same mesh the loader
+    feeds — the resident-data counterpart of mesh ingestion (epoch-2
+    serving from HBM while checkpoint/resume still describe epoch 1)."""
+    import jax
+
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    from petastorm_tpu.jax.device_cache import DeviceCachedDataset
+    from petastorm_tpu.parallel.mesh import data_sharding, make_mesh
+
+    mesh = make_mesh([-1], ["data"])
+    factory = MeshReaderFactory(scalar_dataset.url, batched=True)
+    plan = MeshDataLoader(factory, batch_size=40, num_hosts=2,
+                          seed=21).epoch_plan(0)
+    # Host 0's shard, read through the same subset mechanism the mesh
+    # loader (and its reshard path) uses, cached resident and re-served
+    # sharded across all 8 simulated devices.
+    with factory(plan[0]) as reader:
+        cached = DeviceCachedDataset(reader, sharding=data_sharding(mesh))
+    served = []
+    for batch in cached.batches(batch_size=16, num_epochs=2, seed=0,
+                                drop_last=False):
+        assert isinstance(batch["id"], jax.Array)
+        served.extend(int(v) for v in np.asarray(batch["id"]))
+    with factory(plan[0]) as reader:
+        direct = sorted(int(v) for b in reader for v in b.id)
+    assert sorted(served) == sorted(direct * 2)

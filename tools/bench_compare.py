@@ -15,10 +15,20 @@ phases must not brick the first round that introduces them. Medians are
 preferred over best-of-N when the artifact carries them (``<key>_p50``),
 the same discipline bench.py's own ``vs_prior_round`` guard uses.
 
+Besides the CPU-bench ``BENCH_r*.json`` series this also understands the
+multi-chip evidence series (``--prefix MULTICHIP`` ->
+``MULTICHIP_r*.json``): those artifacts wrap their numeric phases (the
+``--mesh`` llama ctx32k/ctx64k tokens/sec, ``mesh_ingest`` samples/sec)
+in the same ``{"parsed": {...}}`` driver format, and rounds that predate
+numeric multi-chip phases simply report "no shared phases" — new
+evidence never bricks the round that introduces it.
+
 Usage::
 
     python tools/bench_compare.py OLD.json NEW.json [--threshold 0.2]
-    make bench-compare        # newest two committed BENCH_r*.json
+    python tools/bench_compare.py --prefix MULTICHIP
+    make bench-compare        # newest two of BENCH_r* and MULTICHIP_r*
+    make bench-compare OLD=a.json NEW=b.json
 
 Exit codes: 0 ok / no overlap, 1 regression, 2 unreadable input.
 """
@@ -43,7 +53,10 @@ def load_round(path: str) -> dict:
     ``{"parsed": ..., "tail": ...}`` format when present."""
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
-    if isinstance(data.get("parsed"), dict) and "value" in data["parsed"]:
+    if isinstance(data.get("parsed"), dict) and (
+            "value" in data["parsed"] or phase_values(data["parsed"])):
+        # BENCH artifacts always carry the headline "value"; MULTICHIP
+        # artifacts qualify by carrying any higher-is-better phase key.
         return data["parsed"]
     if "value" not in data and "tail" in data:
         for line in reversed(str(data["tail"]).splitlines()):
@@ -102,10 +115,10 @@ def compare(old: dict, new: dict, threshold: float) -> tuple:
     return rows, regressions
 
 
-def _newest_artifacts() -> list:
+def _newest_artifacts(prefix: str = "BENCH") -> list:
     paths = []
-    for path in glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", path)
+    for path in glob.glob(os.path.join(REPO_ROOT, f"{prefix}_r*.json")):
+        m = re.search(rf"{re.escape(prefix)}_r(\d+)\.json$", path)
         if m:
             paths.append((int(m.group(1)), path))
     return [p for _, p in sorted(paths)]
@@ -117,14 +130,18 @@ def main(argv=None) -> int:
     parser.add_argument("new", nargs="?", help="candidate round artifact")
     parser.add_argument("--threshold", type=float, default=0.20,
                         help="max tolerated fractional drop (default 0.20)")
+    parser.add_argument("--prefix", default="BENCH",
+                        help="round-artifact series to auto-pick when no "
+                             "file pair is given: BENCH (default) or "
+                             "MULTICHIP")
     args = parser.parse_args(argv)
 
     old_path, new_path = args.old, args.new
     if old_path is None or new_path is None:
-        artifacts = _newest_artifacts()
+        artifacts = _newest_artifacts(args.prefix)
         if len(artifacts) < 2:
-            print("bench_compare: fewer than two BENCH_r*.json artifacts; "
-                  "nothing to compare")
+            print(f"bench_compare: fewer than two {args.prefix}_r*.json "
+                  f"artifacts; nothing to compare")
             return 0
         old_path, new_path = artifacts[-2], artifacts[-1]
 

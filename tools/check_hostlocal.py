@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Lint guard: host-local topology branching lives in ``jax/`` + ``parallel/``.
+
+Multi-host correctness (docs/mesh.md, docs/multihost.md) rests on every
+process executing the SAME plan from static arithmetic; code that branches
+on *this process's* view of the device topology — ``jax.devices()``,
+``jax.local_devices()``, ``jax.process_count()``, ``jax.process_index()``,
+``jax.device_count()``, ``jax.local_device_count()`` — diverges hosts the
+moment topologies differ (a 4-chip host next to an 8-device CPU
+simulation, a degraded slice, a host that lost its accelerator). The two
+layers that legitimately reason about topology are
+``petastorm_tpu/jax/`` (staging + mesh ingestion) and
+``petastorm_tpu/parallel/`` (mesh construction); everywhere else must take
+shard/host facts as explicit arguments so they are decided once, at the
+mesh layer, for the whole slice.
+
+This check fails CI when, outside those two packages, one of the calls
+above appears inside the *condition* of an ``if``/``while``/ternary/
+``assert`` or a comprehension's ``if`` clause. Plain (non-branching) calls
+— logging the device count, building a default argument — are allowed;
+it is control flow that forks per-host behavior. A legitimate exception
+(e.g. a CLI entry point that only ever runs single-process) may opt out
+with a ``hostlocal-ok`` comment on the branching line, stating why the
+branch cannot diverge hosts.
+
+Usage::
+
+    python tools/check_hostlocal.py            # scan petastorm_tpu/
+    python tools/check_hostlocal.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATHS = ("petastorm_tpu",)
+EXEMPT_DIRS = (os.path.join("petastorm_tpu", "jax"),
+               os.path.join("petastorm_tpu", "parallel"))
+
+WAIVER = "hostlocal-ok"
+
+TOPOLOGY_CALLS = frozenset({
+    "devices",
+    "local_devices",
+    "device_count",
+    "local_device_count",
+    "process_count",
+    "process_index",
+})
+
+
+def _topology_calls_in(node: ast.AST):
+    """Yield topology-probing ``jax.<name>()`` / bare ``<name>()`` calls
+    (the bare form catches ``from jax import process_count``)."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute) and func.attr in TOPOLOGY_CALLS:
+            yield sub, func.attr
+        elif isinstance(func, ast.Name) and func.id in TOPOLOGY_CALLS:
+            yield sub, func.id
+
+
+def _condition_nodes(tree: ast.AST):
+    """Yield ``(condition_expr, lineno)`` for every branching construct."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            yield node.test, node.test.lineno
+        elif isinstance(node, ast.Assert):
+            yield node.test, node.test.lineno
+        elif isinstance(node, ast.comprehension):
+            for cond in node.ifs:
+                yield cond, cond.lineno
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived topology branch."""
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    if any(rel == d or rel.startswith(d + os.sep) for d in EXEMPT_DIRS):
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    seen = set()
+    for cond, lineno in _condition_nodes(tree):
+        for _call, name in _topology_calls_in(cond):
+            if lineno in seen:
+                continue
+            seen.add(lineno)
+            line = lines[lineno - 1] if lineno <= len(lines) else ""
+            if WAIVER in line:
+                continue
+            violations.append(
+                f"{path}:{lineno}: branching on jax.{name}() outside "
+                f"petastorm_tpu/jax/ and petastorm_tpu/parallel/ — "
+                f"host-local topology forks per-host behavior; take the "
+                f"shard/host facts as arguments decided at the mesh layer "
+                f"(docs/mesh.md), or add '# {WAIVER}: <why this branch "
+                f"cannot diverge hosts>'")
+    return sorted(violations)
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+    for violation in all_violations:
+        print(violation, file=sys.stderr)
+    if all_violations:
+        print(f"check_hostlocal: {len(all_violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_hostlocal: ok")
+    return 0
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+if __name__ == "__main__":
+    sys.exit(main())
