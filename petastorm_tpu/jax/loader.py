@@ -144,6 +144,12 @@ class LoaderBase:
         #: Per-``__next__`` host-bound / device-bound / balanced classifier;
         #: see :meth:`stall_report`.
         self.stall = StallAttributor(registry=self.telemetry)
+        #: Per-delivered-batch critical-path classifier (fetch vs decode vs
+        #: transport vs shuffle vs stage vs assemble) over the registry's
+        #: per-stage self-time counters; see :meth:`critical_path_report`
+        #: and docs/observability.md "Critical-path attribution".
+        from petastorm_tpu.telemetry import CriticalPathAttributor
+        self.critical_path = CriticalPathAttributor(self.telemetry)
         self._shuffle_time = self.telemetry.counter("loader.shuffle_s")
         # The registry is pipeline-cumulative; a second loader over the same
         # reader must not inherit the first one's shuffle seconds in ITS
@@ -423,10 +429,14 @@ class LoaderBase:
         def _produce():
             try:
                 it = iter(host_batches)
+                batch_seq = 0
                 while not stop.is_set():
+                    batch_seq += 1
+                    batch_trace = f"b{batch_seq}"
                     t0 = time.perf_counter()
                     with traced_span("petastorm_tpu.host_batch",
-                                     self.telemetry):
+                                     self.telemetry, trace=batch_trace,
+                                     track="stager"):
                         try:
                             hb = next(it)
                         except StopIteration:
@@ -439,7 +449,9 @@ class LoaderBase:
                     # them: data loss on resume).
                     snap = self._snapshot_input_state()
                     t1 = time.perf_counter()
-                    with traced_span("petastorm_tpu.stage", self.telemetry):
+                    with traced_span("petastorm_tpu.stage", self.telemetry,
+                                     trace=batch_trace, stage="stage",
+                                     track="stager"):
                         staged = self._stage(hb)
                     t2 = time.perf_counter()
                     n = len(next(iter(hb.values()))) if hb else 0
@@ -489,6 +501,10 @@ class LoaderBase:
                 if last_resume is not None:
                     self.stall.observe(wait_s=t1 - t0,
                                        busy_s=t0 - last_resume)
+                # Critical-path attribution per delivered batch: which
+                # producer edge accrued the most self-time since the last
+                # delivery (a handful of counter reads — always on).
+                self.critical_path.observe_batch()
                 self._last_input_state = snap
                 # Timestamp BEFORE yielding: the consumer's device step runs
                 # while this generator is suspended in the yields below, so
@@ -686,6 +702,31 @@ class LoaderBase:
         ``host_wait_s``/``stage_s`` sub-attribution (production vs staging).
         """
         return self.stall.report(self.metrics)
+
+    def export_trace(self, path: str) -> int:
+        """Write the registry's retained trace spans as Chrome-trace JSON
+        (open in ``ui.perfetto.dev``); returns the span count exported.
+        Requires trace mode (``PETASTORM_TPU_TELEMETRY_TRACE=1`` or
+        ``loader.telemetry.recorder.enable_trace()``) — raises otherwise,
+        because an empty trace would silently read as "nothing happened"."""
+        rec = self.telemetry.recorder
+        if not rec.trace_enabled:
+            raise RuntimeError(
+                "trace mode is off: set PETASTORM_TPU_TELEMETRY_TRACE=1 "
+                "(or call telemetry.recorder.enable_trace()) before the "
+                "epoch you want to export")
+        from petastorm_tpu.telemetry import write_chrome_trace
+        spans = [sp.as_dict() for sp in rec.spans()]
+        write_chrome_trace(path, spans, metadata={
+            "critical_path": self.critical_path.report()["counts"]})
+        return len(spans)
+
+    def critical_path_report(self) -> dict:
+        """Per-batch critical-path attribution: winner counts per stage
+        (``fetch``/``decode``/``transport``/``shuffle``/``stage``/
+        ``assemble``), the dominant edge, and the recent per-batch
+        self-time records. See docs/observability.md."""
+        return self.critical_path.report()
 
     def stage_breakdown(self) -> dict:
         """Cumulative seconds per pipeline stage (the ``stage_breakdown``
@@ -1184,14 +1225,20 @@ class BatchedDataLoader(LoaderBase):
                         if cols:
                             buffered_rows += len(next(iter(cols.values())))
                             t0 = time.perf_counter()
-                            buf.add_many(cols)
+                            with self.telemetry.span(
+                                    "petastorm_tpu.shuffle_add",
+                                    stage="shuffle", track="shuffler"):
+                                buf.add_many(cols)
                             shuffle_time.add(time.perf_counter() - t0)
                     except StopIteration:
                         exhausted = True
                         buf.finish()
                 if buf.can_retrieve:
                     t0 = time.perf_counter()
-                    batch = buf.retrieve()
+                    with self.telemetry.span("petastorm_tpu.shuffle_retrieve",
+                                             stage="shuffle",
+                                             track="shuffler"):
+                        batch = buf.retrieve()
                     shuffle_time.add(time.perf_counter() - t0)
                     n = len(next(iter(batch.values())))
                     buffered_rows = max(0, buffered_rows - n)

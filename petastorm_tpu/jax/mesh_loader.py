@@ -378,6 +378,19 @@ class MeshDataLoader(LoaderBase):
         self._c_wall = self.telemetry.counter("mesh.ingest_wall_s")
         self._c_assemble_stall = self.telemetry.counter(
             "mesh.assemble_stall_s")
+        #: Global-batch assembly self-time (slice + concatenate across host
+        #: parts) — the "assemble" edge the critical-path attributor reads.
+        self._c_assemble = self.telemetry.counter("mesh.assemble_s")
+        # Per-host stage self-times live in each reader's OWN registry;
+        # pullers sync per-pull deltas into these mesh-level counters so
+        # the critical-path attributor sees decode/fetch/transport too
+        # (decode lands on mesh.host_decode_s — the reader-side source is
+        # a histogram, and this registry's worker.decode_s must stay a
+        # faithful in-process distribution).
+        self._c_stage_sync = {
+            "decode": self.telemetry.counter("mesh.host_decode_s"),
+            "fetch": self.telemetry.counter("io.readahead.fetch_s"),
+            "transport": self.telemetry.counter("transport.deserialize_s")}
         self._host_ids = ([self._local_host] if self._multiprocess
                           else list(range(self._H)))
         self._c_host_stall = {h: self.telemetry.counter(
@@ -497,15 +510,49 @@ class MeshDataLoader(LoaderBase):
         reader = self._factory(src.ordinals)
         src.reader = reader
         src.fifo = self._fifo and bool(reader.batched_output)
+        rec = self.telemetry.recorder
+        if rec.trace_enabled:
+            # Propagate trace mode into the per-host reader's own registry
+            # (already on when PETASTORM_TPU_TELEMETRY_TRACE is set — this
+            # covers programmatic enable_trace() on the mesh registry; a
+            # few construction-time ventilations may predate the flip).
+            reader.telemetry.recorder.enable_trace()
+        stage_base = {"decode": 0.0, "fetch": 0.0, "transport": 0.0,
+                      "groups": -1}
         try:
             it = iter(reader)
             while True:
                 if feed.killed.is_set():
                     raise _HostKilled(f"host {feed.idx} killed")
                 try:
-                    item = next(it)
+                    if rec.enabled:
+                        # Per-host pull span: per-host reader epochs are
+                        # single-epoch (e0), so the lineage id matches the
+                        # reader's own spans for this global ordinal.
+                        # Indexed by the GROUP watermark (src.counted),
+                        # not the item count (src.pulled): row/windowed
+                        # readers deliver many items per row group, and
+                        # pulled would race past the ordinal list after
+                        # the first group. Batched sources keep the two
+                        # equal, so the common mesh config stays exact;
+                        # other flavors are group-granular approximations.
+                        ordinal = src.ordinals[min(src.counted,
+                                                   len(src.ordinals) - 1)]
+                        with self.telemetry.span(
+                                "petastorm_tpu.mesh_pull",
+                                trace=f"e0:g{ordinal}", stage="pull",
+                                track=f"h{feed.idx}:pull"):
+                            item = next(it)
+                    else:
+                        item = next(it)
                 except StopIteration:
                     break
+                # Sync at GROUP granularity: src.counted advances once per
+                # delivered row group, so row/windowed sources (many items
+                # per group) don't pay the registry peeks per row.
+                if src.counted != stage_base["groups"]:
+                    stage_base["groups"] = src.counted
+                    self._sync_host_stage_times(reader, stage_base)
                 part = self._part_from_item(feed, src, item)
                 if part is None:
                     # Empty after column selection: the group is delivered
@@ -535,6 +582,9 @@ class MeshDataLoader(LoaderBase):
                             part.delivered_after - src.counted)
                         src.counted = part.delivered_after
                     self._cond.notify_all()
+            # Final stage-time sync: the last group's decode lands after
+            # the loop's last boundary check.
+            self._sync_host_stage_times(reader, stage_base)
             # Clean completion: every group of this source was delivered —
             # top up past any watermark lag (row readers confirm the last
             # group only after its final row is pulled).
@@ -545,12 +595,58 @@ class MeshDataLoader(LoaderBase):
             with self._cond:
                 self._source_done(1)
         finally:
+            self._rollup_host_trace(feed.idx, reader)
             try:
                 reader.stop()
                 reader.join()
             except Exception as e:  # noqa: BLE001 - teardown best-effort
                 logger.warning("mesh host %d reader teardown failed: %s",
                                feed.idx, e)
+
+    def _sync_host_stage_times(self, reader, base: Dict[str, float]) -> None:
+        """Mirror one pull's worth of the host reader's stage self-times
+        (decode / fetch / transport) into the mesh registry, so per-batch
+        critical-path attribution can arbitrate the host plane against
+        staging/assembly. Called once per delivered row group (the caller
+        gates on the ``src.counted`` watermark) — noise next to a
+        group-sized read+decode."""
+        rt = getattr(reader, "telemetry", None)
+        if rt is None:
+            return
+        # Decode has two same-work sources (max, never sum): the
+        # in-process pools' histogram and — process-pool host readers in
+        # trace mode — the spawned workers' piggybacked spans accruing
+        # trace.span.decode_s (mirrors CriticalPathAttributor._cumulative).
+        cur = {"decode": max(rt.peek_histogram_sum("worker.decode_s"),
+                             rt.peek_counter("trace.span.decode_s")),
+               "fetch": rt.peek_counter("io.readahead.fetch_s"),
+               "transport": rt.peek_counter("transport.deserialize_s")}
+        for key, value in cur.items():
+            delta = value - base[key]
+            if delta > 0:
+                self._c_stage_sync[key].add(delta)
+            base[key] = value
+
+    def _rollup_host_trace(self, host: int, reader) -> None:
+        """Cross-host(-boundary) trace rollup: drain the per-host reader's
+        span ring into the mesh registry BEFORE the reader is torn down,
+        re-tracked under an ``h{host}:`` prefix so the Chrome-trace export
+        shows one process lane per host (docs/observability.md). Simulated
+        hosts share this process's clock, so timestamps carry over; on a
+        real slice each process exports its own snapshot and the trace CLI
+        merges them."""
+        rec = self.telemetry.recorder
+        if not rec.trace_enabled:
+            return
+        src_rec = getattr(getattr(reader, "telemetry", None), "recorder",
+                          None)
+        if src_rec is None or not src_rec.enabled:
+            return
+        import dataclasses
+        prefix = f"h{host}:"
+        rec.ingest([
+            dataclasses.replace(sp, track=prefix + (sp.track or sp.thread))
+            for sp in src_rec.drain()])
 
     def _source_done(self, n: int) -> None:
         """Caller holds ``self._cond``."""
@@ -793,7 +889,14 @@ class MeshDataLoader(LoaderBase):
                         self._update_skew()
                         continue
                 while pool_rows >= self._step_rows:
-                    batch = self._assemble(pool, self._step_rows, epoch)
+                    self._batch_seq += 1
+                    t0 = time.perf_counter()
+                    with self.telemetry.span("petastorm_tpu.mesh_assemble",
+                                             trace=f"b{self._batch_seq}",
+                                             stage="assemble",
+                                             track="assemble"):
+                        batch = self._assemble(pool, self._step_rows, epoch)
+                    self._c_assemble.add(time.perf_counter() - t0)
                     pool_rows -= self._step_rows
                     yield batch
             if pool_rows:
@@ -1027,4 +1130,8 @@ class MeshDataLoader(LoaderBase):
             "host_skew_s": round(max(stalls) - min(stalls), 6) if stalls
             else 0.0,
             "per_host": per_host,
+            # Per-batch critical-path attribution over the whole mesh
+            # pipeline (fetch/decode/transport/shuffle/stage/assemble) —
+            # the rollup the data-service dispatcher will export.
+            "critical_path": self.critical_path.report(),
         }

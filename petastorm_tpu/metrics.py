@@ -160,25 +160,28 @@ def trace(name: str):
         yield
 
 
-def traced_span(name: str, telemetry=None):
+def traced_span(name: str, telemetry=None, **span_kw):
     """Context manager pairing a ``jax.profiler`` trace annotation with a
     telemetry recorder span of the SAME name, so the profiler timeline and
-    the telemetry snapshot attribute time to identical labels."""
+    the telemetry snapshot attribute time to identical labels. Extra
+    keyword args (``trace=``/``stage=``/``track=``) pass through to the
+    recorder span — lineage provenance in trace mode."""
     if telemetry is None:
         return trace(name)
-    return _TracedSpan(name, telemetry)
+    return _TracedSpan(name, telemetry, span_kw)
 
 
 class _TracedSpan:
-    __slots__ = ("_name", "_telemetry", "_trace_cm", "_span_cm")
+    __slots__ = ("_name", "_telemetry", "_span_kw", "_trace_cm", "_span_cm")
 
-    def __init__(self, name: str, telemetry):
+    def __init__(self, name: str, telemetry, span_kw=None):
         self._name = name
         self._telemetry = telemetry
+        self._span_kw = span_kw or {}
 
     def __enter__(self):
         self._trace_cm = trace(self._name)
-        self._span_cm = self._telemetry.span(self._name)
+        self._span_cm = self._telemetry.span(self._name, **self._span_kw)
         self._trace_cm.__enter__()
         self._span_cm.__enter__()
         return self

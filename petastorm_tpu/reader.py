@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 import warnings
 from collections import deque
@@ -40,8 +41,8 @@ from petastorm_tpu.ngram import NGram
 from petastorm_tpu.reader_impl.batch_reader_worker import (BatchReaderWorker,
                                                            arrow_table_to_numpy_dict)
 from petastorm_tpu.reader_impl.row_reader_worker import RowReaderWorker
-from petastorm_tpu.telemetry import (PeriodicExporter, TELEMETRY_EXPORT_ENV,
-                                     make_registry)
+from petastorm_tpu.telemetry import (PeriodicExporter, SLO_WATCH_ENV,
+                                     TELEMETRY_EXPORT_ENV, make_registry)
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import Unischema, UnischemaField
 from petastorm_tpu.workers_pool import EmptyResultError, ITEM_CONTEXT_KWARG
@@ -798,6 +799,7 @@ class Reader:
         #: Plan-time pruning provenance — filled by the selector pass and
         #: the statistics pruner below; see :meth:`pruning_report`.
         self._pruning_report = {"enabled": False}
+        self._subset_kept_ordinals = None
         all_row_groups = load_row_groups(ctx)
         filtered = self._filter_row_groups(all_row_groups, predicate,
                                            rowgroup_selector, cur_shard,
@@ -810,6 +812,19 @@ class Reader:
                 f"(dataset has {len(all_row_groups)} row groups; "
                 f"cur_shard={cur_shard}, shard_count={shard_count})")
         logger.debug("Reading %d/%d row groups", len(filtered), len(all_row_groups))
+
+        # Trace identities (docs/observability.md "Trace plane"): every
+        # planned row group gets a stable lineage ordinal — the
+        # dataset-global ordinal when the plan came from rowgroup_subset
+        # (so mesh pull spans and per-host reader spans agree), the plan
+        # position otherwise. Keyed by (path, row_group) because the
+        # ventilator shuffles item ORDER per epoch; coalesced work items
+        # (tuple row_group keys) fall back to their epoch position.
+        self._trace_ordinal_by_key = {
+            (rg.path, rg.row_group):
+                (self._subset_kept_ordinals[i]
+                 if self._subset_kept_ordinals is not None else i)
+            for i, rg in enumerate(filtered)}
 
         # ---------------- statistics pruning (docs/io.md). AFTER sharding,
         # so shard membership — and therefore which host owns which
@@ -1023,19 +1038,8 @@ class Reader:
                 raise ValueError(f"resume offset {start_offset} >= {len(items)} work items "
                                  "(did the dataset or its filtering change?)")
         self._num_items = len(items)
-        ventilate_fn = self._pool.ventilate
-        if self.readahead is not None:
-            # Ventilation announces each work item to the fetch stage the
-            # moment it is admitted: fetchers run ahead in ventilation
-            # order, bounded by their depth/byte budget (the ventilator's
-            # in-flight cap already bounds the announcement queue).
-            pool_ventilate, readahead = self._pool.ventilate, self.readahead
-
-            def ventilate_fn(**kwargs):
-                readahead.submit(kwargs["rowgroup"])
-                pool_ventilate(**kwargs)
         self._ventilator = ConcurrentVentilator(
-            ventilate_fn, items,
+            self._make_ventilate_fn(self._pool), items,
             iterations=num_epochs,
             randomize_item_order=shuffle_row_groups,
             random_seed=seed,
@@ -1054,22 +1058,19 @@ class Reader:
                              lambda: self._ventilator.inflight)
         self.telemetry.gauge("ventilator.max_inflight",
                              lambda: self._ventilator.max_inflight)
-        self.telemetry.gauge("pool.results_queue_depth",
-                             self._pool.results_qsize)
-        # Fixed for the pool's lifetime; the autotune controller's fallback
-        # bottleneck diagnosis reads depth/capacity as a fill fraction.
-        # NOT registered for the process pool: its results_qsize() is a
-        # constant 0 (queued results live in ZMQ/ring buffers, unobservable
-        # across the socket), and a permanently-empty-looking queue would
-        # read as producer_bound forever, ratcheting the ventilation knob
-        # to its ceiling. Without the gauge the controller holds instead.
-        if not isinstance(self._pool, ProcessPool):
-            # Aggregate bound: results_qsize() sums every per-worker queue,
-            # so the fill fraction's denominator must scale the per-queue
-            # capacity by the worker count or a 1/N-full pool reads full.
-            self.telemetry.gauge("pool.results_queue_capacity").set(
-                self._pool.diagnostics["results_queue_capacity"]
-                * max(1, self._pool.workers_count))
+        # Item-accounting carried across placement migrations: pool
+        # counters restart from zero in a freshly built pool, so
+        # ``Reader.diagnostics`` adds the retired pools' final tallies —
+        # a dashboard's ventilated/processed series must stay monotonic
+        # through a mid-epoch backend swap (docs/zero_copy.md).
+        self._pool_items_base = {"items_ventilated": 0,
+                                 "items_processed": 0}
+        # Guards the (base, live pool) pair: a migration retires the old
+        # pool's tallies into the base and swaps self._pool under this
+        # lock, so a concurrent diagnostics() poll can never see the same
+        # items counted in both (or in neither).
+        self._diag_lock = threading.Lock()
+        self._sync_pool_gauges(self._pool)
         self.telemetry.counter("reader.rows")
         self._pool.telemetry = self.telemetry
 
@@ -1178,6 +1179,22 @@ class Reader:
                 fmt=("prometheus" if export_path.endswith(".prom")
                      else "json")).start()
 
+        # ---------------- SLO watch (docs/observability.md "SLO watch")
+        #: Background :class:`~petastorm_tpu.telemetry.slo.SloWatcher`
+        #: when :data:`~petastorm_tpu.telemetry.SLO_WATCH_ENV` is set
+        #: (``1`` = default rules, else a ``parse_rules`` spec); rolling
+        #: detectors over this pipeline's registry, violations recorded as
+        #: ``slo.violation`` events. Stops with the reader.
+        self.slo_watcher = None
+        slo_spec = os.environ.get(SLO_WATCH_ENV, "").strip()
+        if slo_spec:
+            from petastorm_tpu.telemetry.slo import (SloWatcher,
+                                                     default_rules,
+                                                     parse_rules)
+            rules = (default_rules() if slo_spec in ("1", "default")
+                     else parse_rules(slo_spec))
+            self.slo_watcher = SloWatcher(self.telemetry, rules).start()
+
     # ------------------------------------------------------------- planning
     def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
                            cur_shard, shard_count, shard_seed, filters=None,
@@ -1197,8 +1214,7 @@ class Reader:
                                                    rowgroup_subset)
         return filtered
 
-    @staticmethod
-    def _apply_rowgroup_subset(all_row_groups, filtered, rowgroup_subset):
+    def _apply_rowgroup_subset(self, all_row_groups, filtered, rowgroup_subset):
         """Restrict the plan to explicit ordinals into the deterministic
         unfiltered row-group order — IN THE SUBSET'S ORDER. The subset
         stands in for the shard partition (the mesh layer pre-computes and
@@ -1206,7 +1222,10 @@ class Reader:
         list, which is what makes per-host delivery watermarks map back to
         plan positions (docs/mesh.md). Groups the earlier filter stages
         dropped stay dropped; an out-of-range or duplicate ordinal is a
-        caller bug and raises."""
+        caller bug and raises. The kept ordinals also become the plan's
+        TRACE identities — lineage ids in mesh mode name the dataset-global
+        ordinal, so per-host reader spans and the mesh loader's pull spans
+        agree (docs/observability.md "Trace plane")."""
         seen = set()
         for ordinal in rowgroup_subset:
             if not 0 <= ordinal < len(all_row_groups):
@@ -1218,8 +1237,10 @@ class Reader:
                     f"rowgroup_subset contains duplicate ordinal {ordinal}")
             seen.add(ordinal)
         kept_ids = {id(rg) for rg in filtered}
-        return [all_row_groups[i] for i in rowgroup_subset
+        kept = [i for i in rowgroup_subset
                 if id(all_row_groups[i]) in kept_ids]
+        self._subset_kept_ordinals = kept
+        return [all_row_groups[i] for i in kept]
 
     @staticmethod
     def _apply_filters(row_groups, filters):
@@ -1344,6 +1365,49 @@ class Reader:
                          "(fields: %s)", pruned, len(row_groups), fields)
         return kept
 
+    def _make_ventilate_fn(self, pool):
+        """The ventilation entry point for ``pool``: announces each work
+        item to the readahead fetch stage (when enabled) and — in trace
+        mode — mints the item's lineage id (``e{epoch}:g{ordinal}``),
+        records the instant ``ventilate`` span, and injects the id as a
+        ``trace_context`` kwarg the pools pop before the worker impl sees
+        the item. One construction path: the initial ventilator and any
+        pool a placement migration later repoints both go through here, so
+        a migration never silently drops tracing or readahead."""
+        pool_ventilate = pool.ventilate
+        # Spawned workers cannot pop the in-process fetched-table store
+        # (they receive readahead=None): announcing to the fetchers for a
+        # process-pool target would read every row group from storage
+        # TWICE and pin fetched tables to the byte budget with no consumer
+        # — relevant on the migration path, where the live pool's flavor
+        # can differ from construction's.
+        readahead = (None if isinstance(pool, ProcessPool)
+                     else self.readahead)
+        recorder = self.telemetry.recorder
+        trace_ordinals = self._trace_ordinal_by_key
+
+        def ventilate_fn(**kwargs):
+            trace = None
+            if recorder.trace_enabled:
+                ctx = kwargs.get(ITEM_CONTEXT_KWARG)
+                if ctx is not None:
+                    epoch, pos = ctx
+                    rg = kwargs["rowgroup"]
+                    ordinal = trace_ordinals.get((rg.path, rg.row_group),
+                                                 pos)
+                    trace = f"e{epoch}:g{ordinal}"
+                    kwargs["trace_context"] = trace
+                    recorder.record_event("petastorm_tpu.ventilate",
+                                          trace=trace, stage="ventilate",
+                                          track="ventilator")
+            if readahead is not None:
+                # Ventilation announces each work item to the fetch stage
+                # the moment it is admitted: fetchers run ahead in
+                # ventilation order, bounded by their depth/byte budget.
+                readahead.submit(kwargs["rowgroup"], trace=trace)
+            pool_ventilate(**kwargs)
+        return ventilate_fn
+
     # ----------------------------------------------- placement migration
     def _spawnable_worker_args(self) -> dict:
         """The worker-args variant a SPAWNED worker can receive: live
@@ -1356,6 +1420,38 @@ class Reader:
                 "resilience_telemetry": None,
                 "cancel_token": None,
                 "readahead": None}
+
+    def _sync_pool_gauges(self, pool) -> None:
+        """Point every pool-derived telemetry gauge at ``pool`` — one sync
+        routine shared by construction and the migration safe point, so a
+        post-migration snapshot can never mix the old backend's queue
+        shape with the new backend's counters (the PR 6 drift: readers
+        kept reporting the retired pool's keys until the next snapshot
+        happened to re-register them).
+
+        ``pool.results_queue_depth``/``capacity`` are zeroed for the
+        process pool: its results_qsize() is a constant 0 (queued results
+        live in ZMQ/ring buffers, unobservable across the socket), and a
+        permanently-empty-looking queue would read as producer_bound
+        forever in the autotune fallback diagnosis — capacity 0 disables
+        the fill-fraction path there instead. ``pool.backend`` mirrors the
+        live flavor (0 = thread/dummy, 1 = process) so exported snapshots
+        name the backend they describe."""
+        depth_gauge = self.telemetry.gauge("pool.results_queue_depth")
+        cap_gauge = self.telemetry.gauge("pool.results_queue_capacity")
+        is_process = isinstance(pool, ProcessPool)
+        self.telemetry.gauge("pool.backend").set(1.0 if is_process else 0.0)
+        if is_process:
+            depth_gauge.set_function(None)
+            depth_gauge.set(0)
+            cap_gauge.set(0)
+        else:
+            # Aggregate bound: results_qsize() sums every per-worker queue,
+            # so the fill fraction's denominator must scale the per-queue
+            # capacity by the worker count or a 1/N-full pool reads full.
+            depth_gauge.set_function(pool.results_qsize)
+            cap_gauge.set(pool.diagnostics["results_queue_capacity"]
+                          * max(1, pool.workers_count))
 
     def _request_pool_migration(self, backend: str) -> None:
         """Placement-actuator endpoint (any thread): schedule a decode-pool
@@ -1424,8 +1520,14 @@ class Reader:
                 except EmptyResultError:
                     break
             # Detach the ventilator BEFORE stopping: pool.stop() would
-            # otherwise stop ventilation for good.
+            # otherwise stop ventilation for good. The old pool's final
+            # item tallies are captured here but retired into the
+            # cumulative base only WITH the pool swap below — doing it now
+            # would double-count them for any diagnostics() poll landing
+            # during the (seconds-long, spawn-including) window where
+            # self._pool is still the old pool.
             old_pool._ventilator = None
+            final = old_pool.diagnostics
             old_pool.stop()
             old_pool.join()
 
@@ -1450,24 +1552,17 @@ class Reader:
             new_pool.start(self._worker_class, worker_args, ventilator=None)
             # The (already running) ventilator belongs to the new pool now:
             # completion checks and processed-item credits flow to it, and
-            # the parked ventilation thread re-reads the fn on resume.
+            # the parked ventilation thread re-reads the fn on resume —
+            # through _make_ventilate_fn, so trace-mode lineage injection
+            # survives the swap.
             new_pool._ventilator = self._ventilator
-            self._ventilator.set_ventilate_fn(new_pool.ventilate)
+            self._ventilator.set_ventilate_fn(
+                self._make_ventilate_fn(new_pool))
 
-            # Queue gauges follow the pool (the process flavor's depth is
-            # unobservable and must not read as forever-producer_bound —
-            # same rule as construction).
-            depth_gauge = self.telemetry.gauge("pool.results_queue_depth")
-            cap_gauge = self.telemetry.gauge("pool.results_queue_capacity")
-            if target == "process":
-                depth_gauge.set_function(None)
-                depth_gauge.set(0)
-                cap_gauge.set(0)
-            else:
-                depth_gauge.set_function(new_pool.results_qsize)
-                cap_gauge.set(
-                    new_pool.diagnostics["results_queue_capacity"]
-                    * max(1, new_pool.workers_count))
+            # Gauges follow the pool through the ONE sync routine
+            # construction used — done at the safe point, before the swap
+            # is visible, so no snapshot can mix backends.
+            self._sync_pool_gauges(new_pool)
             if self.autotune is not None:
                 self.autotune.unregister("worker_concurrency")
                 gate = getattr(new_pool, "concurrency_gate", None)
@@ -1477,7 +1572,15 @@ class Reader:
                     self.autotune.register(WorkerConcurrencyActuator(
                         gate, new_pool.workers_count))
 
-            self._pool = new_pool
+            # Retire the old pool's tallies and swap the live pool as ONE
+            # step (diagnostics stays monotonic: a fresh pool restarts its
+            # own counters from zero, the base carries the history).
+            with self._diag_lock:
+                self._pool_items_base["items_ventilated"] += \
+                    final["items_ventilated"]
+                self._pool_items_base["items_processed"] += \
+                    final["items_processed"]
+                self._pool = new_pool
             self._results_reader.swap_pool(new_pool, buffered)
             buffered = []
             migrated = True
@@ -1563,6 +1666,8 @@ class Reader:
     def stop(self):
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.slo_watcher is not None:
+            self.slo_watcher.stop()
         if self.autotune is not None:
             self.autotune.stop()
         if self._telemetry_exporter is not None:
@@ -1598,8 +1703,22 @@ class Reader:
         """Pipeline health view: the pool's unified queue/item counters
         (same keys for every pool type), the ventilator backlog, and the
         full telemetry snapshot (counters/gauges/histograms/spans) under
-        ``"telemetry"`` — one dict a dashboard can serialize as-is."""
-        d = dict(self._pool.diagnostics)
+        ``"telemetry"`` — one dict a dashboard can serialize as-is.
+
+        Stable across placement migrations: ``items_ventilated`` /
+        ``items_processed`` include every retired pool's final tally (a
+        freshly built pool restarts its own counters from zero — without
+        the base a dashboard would see the series jump backwards at the
+        swap), and ``pool_type`` names the live backend."""
+        with self._diag_lock:
+            pool = self._pool
+            d = dict(pool.diagnostics)
+            d["items_ventilated"] += \
+                self._pool_items_base["items_ventilated"]
+            d["items_processed"] += self._pool_items_base["items_processed"]
+        d["pool_type"] = ("process" if isinstance(pool, ProcessPool)
+                          else "dummy" if isinstance(pool, DummyPool)
+                          else "thread")
         d["ventilator_backlog"] = self._ventilator.inflight
         d["telemetry"] = self.telemetry.snapshot()
         return d
@@ -1633,6 +1752,13 @@ class Reader:
         verdict). Empty dict when ``autotune`` is off. See docs/autotune.md
         for the schema."""
         return {} if self.autotune is None else self.autotune.report()
+
+    def slo_report(self) -> dict:
+        """SLO watcher readout: the rule set, violation tallies per rule,
+        and what is violating right now. Empty dict when
+        :data:`~petastorm_tpu.telemetry.SLO_WATCH_ENV` is unset. See
+        docs/observability.md "SLO watch"."""
+        return {} if self.slo_watcher is None else self.slo_watcher.report()
 
     def watchdog_report(self) -> dict:
         """Watchdog readout: hang detections/recoveries, the current
@@ -1709,7 +1835,8 @@ class _PoolWaitTimer:
         inline0 = (self._inline_decode_pool.inline_decode_s
                    if self._inline_decode_pool is not None else 0.0)
         t0 = time.perf_counter()
-        with self._telemetry.span("petastorm_tpu.pool_wait"):
+        with self._telemetry.span("petastorm_tpu.pool_wait",
+                                  stage="deliver", track="consumer"):
             result = self._pool.get_results()
         wait = time.perf_counter() - t0
         if self._inline_decode_pool is not None:

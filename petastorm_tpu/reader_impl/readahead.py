@@ -31,12 +31,19 @@ Bounds and composition (docs/io.md):
   group, and a transient prefetch error never burns a retry budget;
 * **fault injection (PR 2)** — fetcher reads consult the plan's
   ``rowgroup.read`` site like any other read attempt (``worker_id`` =
-  ``1000 + fetcher index``, so worker-pinned specs never fire here).
+  ``1000 + fetcher index``, so worker-pinned specs never fire here — a
+  fault-plan keying detail ONLY: telemetry and traces identify fetchers
+  first-class as ``stage="fetch"`` / ``fetch:{idx}``, never as phantom
+  workers).
 
 Telemetry (pipeline registry): ``io.readahead.hits`` / ``misses`` /
-``fetch_errors`` / ``fetched_total`` counters, ``io.readahead.depth`` /
+``fetch_errors`` / ``fetched_total`` counters, the cumulative
+``io.readahead.fetch_s`` seconds counter (the "fetch" edge the
+critical-path attributor arbitrates), ``io.readahead.depth`` /
 ``bytes_in_flight`` / ``ahead`` gauges, plus the shared ``io.bytes_read``
-/ ``io.rowgroups_read`` counters the inline path also feeds.
+/ ``io.rowgroups_read`` counters the inline path also feeds. In trace
+mode each fetch records a ``petastorm_tpu.fetch`` span with the work
+item's lineage id on track ``fetch:{idx}`` (docs/observability.md).
 
 In-process pools only: the fetched-table store cannot cross a spawn
 boundary, so ``reader_pool_type='process'`` ignores readahead with a
@@ -46,6 +53,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -118,11 +126,13 @@ class ReadaheadFetcher:
         self._local = threading.local()     # per-fetcher file handles/hedger
 
         self._counters = None
+        self._fetch_s = None
         if telemetry is not None:
             self._counters = {
                 name: telemetry.counter(f"io.readahead.{name}")
                 for name in ("hits", "misses", "fetch_errors",
                              "fetched_total", "submit_dropped")}
+            self._fetch_s = telemetry.counter("io.readahead.fetch_s")
             self._bytes_read = telemetry.counter("io.bytes_read")
             self._rowgroups_read = telemetry.counter("io.rowgroups_read")
             telemetry.gauge("io.readahead.depth", lambda: self._depth)
@@ -153,19 +163,20 @@ class ReadaheadFetcher:
             t.start()
         return self
 
-    def submit(self, rowgroup) -> None:
+    def submit(self, rowgroup, trace: Optional[str] = None) -> None:
         """Announce one ventilated work item (called from the ventilation
         thread, never blocks): fetchers pick it up in submission order. In
         normal flow the ventilator's in-flight cap bounds this queue;
         ``max_queue`` is the backstop for consumers that stop popping (a
         warm cache) — an over-cap submit is dropped and that item simply
-        reads inline."""
+        reads inline. ``trace`` carries the item's lineage id so fetch
+        spans join the ventilate → decode chain."""
         with self._cv:
             if len(self._queue) >= self._max_queue:
                 self._count("submit_dropped")
                 return
             key = rowgroup_key(rowgroup)
-            self._queue.append((key, rowgroup))
+            self._queue.append((key, rowgroup, trace))
             self._queued[key] = self._queued.get(key, 0) + 1
             self._cv.notify_all()
 
@@ -223,13 +234,20 @@ class ReadaheadFetcher:
             return self._depth
 
     def stats(self) -> dict:
-        """JSON-safe snapshot for reports and tests."""
+        """JSON-safe snapshot for reports and tests. Fetcher threads are
+        first-class pipeline citizens: ``provenance`` names the stage and
+        its thread lanes (``fetch:{idx}``) — the identity traces and
+        diagnostics display, never the synthetic fault-plan worker ids."""
         with self._cv:
             return {"depth": self._depth,
                     "fetchers": self._fetchers_count,
                     "ahead": self._ahead,
                     "bytes_in_flight": self._bytes,
                     "queued": len(self._queue),
+                    "provenance": {
+                        "stage": "fetch",
+                        "tracks": [f"fetch:{i}"
+                                   for i in range(self._fetchers_count)]},
                     **dict(self.local_stats)}
 
     def close(self) -> None:
@@ -263,11 +281,11 @@ class ReadaheadFetcher:
         return True
 
     def _next_request(self):
-        """Next unclaimed ``(key, rowgroup)`` off the queue, discarding
-        entries an inline read already claimed back (O(1) per entry);
-        ``None`` when the queue drained. Called under the lock."""
+        """Next unclaimed ``(key, rowgroup, trace)`` off the queue,
+        discarding entries an inline read already claimed back (O(1) per
+        entry); ``None`` when the queue drained. Called under the lock."""
         while self._queue:
-            key, rowgroup = self._queue.popleft()
+            key, rowgroup, trace = self._queue.popleft()
             n = self._queued.get(key, 1) - 1
             if n:
                 self._queued[key] = n
@@ -280,7 +298,7 @@ class ReadaheadFetcher:
                 else:
                     self._claimed[key] = c - 1
                 continue  # inline read won this item: nothing to fetch
-            return key, rowgroup
+            return key, rowgroup, trace
         return None
 
     def _fetch_loop(self, idx: int) -> None:
@@ -294,16 +312,27 @@ class ReadaheadFetcher:
                 request = self._next_request()
                 if request is None:
                     continue  # every queued entry had been claimed back
-                key, rowgroup = request
+                key, rowgroup, trace = request
                 self._inflight[key] = self._inflight.get(key, 0) + 1
                 self._ahead += 1
             table = None
+            t0 = time.perf_counter()
             try:
-                table = self._fetch(rowgroup, idx)
+                if self._telemetry is not None:
+                    # First-class fetch provenance: stage="fetch" on the
+                    # fetcher's own track, carrying the item's lineage id.
+                    with self._telemetry.span("petastorm_tpu.fetch",
+                                              trace=trace, stage="fetch",
+                                              track=f"fetch:{idx}"):
+                        table = self._fetch(rowgroup, idx)
+                else:
+                    table = self._fetch(rowgroup, idx)
             except Exception as e:  # noqa: BLE001 - inline read owns retries
                 self._count("fetch_errors")
                 logger.debug("readahead fetch of %s failed (inline read "
                              "will retry): %s", key, e)
+            if self._fetch_s is not None:
+                self._fetch_s.add(time.perf_counter() - t0)
             nbytes = int(table.nbytes) if table is not None else 0
             with self._cv:
                 self._inflight[key] -= 1

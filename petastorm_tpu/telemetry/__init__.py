@@ -60,19 +60,46 @@ TELEMETRY_EXPORT_ENV = "PETASTORM_TPU_TELEMETRY_EXPORT"
 #: on, they are cheap).
 TELEMETRY_SPANS_ENV = "PETASTORM_TPU_TELEMETRY_SPANS"
 
+#: Environment variable: any non-empty value puts every new registry in
+#: TRACE mode — spans on, lineage (trace/stage/track) fields recorded, ring
+#: capacity grown so a whole epoch survives for ``python -m
+#: petastorm_tpu.telemetry trace`` export. Implies TELEMETRY_SPANS_ENV.
+TELEMETRY_TRACE_ENV = "PETASTORM_TPU_TELEMETRY_TRACE"
+
+#: Environment variable: start an :class:`~petastorm_tpu.telemetry.slo.
+#: SloWatcher` on every Reader's pipeline registry. ``1`` = the default
+#: rule set; any other value is a ``parse_rules`` spec, e.g.
+#: ``input_stall_pct<=1,batch_p99_s<=0.5``.
+SLO_WATCH_ENV = "PETASTORM_TPU_SLO_WATCH"
+
 
 def make_registry() -> TelemetryRegistry:
-    """A registry honoring :data:`TELEMETRY_SPANS_ENV`."""
+    """A registry honoring :data:`TELEMETRY_SPANS_ENV` and
+    :data:`TELEMETRY_TRACE_ENV`."""
     import os
-    return TelemetryRegistry(
+    registry = TelemetryRegistry(
         spans_enabled=bool(os.environ.get(TELEMETRY_SPANS_ENV)))
+    if os.environ.get(TELEMETRY_TRACE_ENV):
+        registry.recorder.enable_trace()
+    return registry
 
+
+from petastorm_tpu.telemetry.slo import (DEFAULT_RULES, SloRule,  # noqa: E402
+                                         SloWatcher, evaluate_rules,
+                                         parse_rules)
+from petastorm_tpu.telemetry.trace import (CriticalPathAttributor,  # noqa: E402
+                                           TraceContext, complete_lineages,
+                                           lineage_index, to_chrome_trace,
+                                           write_chrome_trace)
 
 __all__ = [
-    "Counter", "Gauge", "LATENCY_BOUNDS_S", "PeriodicExporter",
-    "SIZE_BOUNDS", "SNAPSHOT_SCHEMA_VERSION", "Span", "SpanRecorder",
-    "StallAttributor", "StreamingHistogram", "TELEMETRY_EXPORT_ENV",
-    "TELEMETRY_SPANS_ENV", "TelemetryRegistry", "from_json", "make_registry",
-    "parse_prometheus_text", "to_json", "to_prometheus_text",
-    "write_snapshot",
+    "Counter", "CriticalPathAttributor", "DEFAULT_RULES", "Gauge",
+    "LATENCY_BOUNDS_S", "PeriodicExporter", "SIZE_BOUNDS",
+    "SLO_WATCH_ENV", "SNAPSHOT_SCHEMA_VERSION", "SloRule", "SloWatcher",
+    "Span", "SpanRecorder", "StallAttributor", "StreamingHistogram",
+    "TELEMETRY_EXPORT_ENV", "TELEMETRY_SPANS_ENV", "TELEMETRY_TRACE_ENV",
+    "TelemetryRegistry", "TraceContext", "complete_lineages",
+    "evaluate_rules", "from_json", "lineage_index", "make_registry",
+    "parse_prometheus_text", "parse_rules", "to_chrome_trace", "to_json",
+    "to_prometheus_text", "write_chrome_trace", "write_snapshot",
 ]

@@ -7,10 +7,20 @@ fresh JSON snapshot on disk; this tool renders it:
     python -m petastorm_tpu.telemetry dump /tmp/pt.json
     python -m petastorm_tpu.telemetry dump /tmp/pt.json --format prometheus
     python -m petastorm_tpu.telemetry watch /tmp/pt.json --interval 2
+    python -m petastorm_tpu.telemetry trace /tmp/pt.json --out trace.json
+    python -m petastorm_tpu.telemetry check /tmp/pt.json --slo input_stall_pct<=1
 
 ``dump`` prints one rendering and exits; ``watch`` re-renders every
 ``--interval`` seconds until interrupted (or ``--count`` iterations, for
-scripting). Exit code 1 when the snapshot file is missing/unreadable.
+scripting) — including the per-name event rings (straggler / host-lost /
+reshard / SLO events) and a ``mesh.*`` per-host table when present.
+``trace`` converts one or more trace-mode snapshots (run the pipeline with
+``PETASTORM_TPU_TELEMETRY_TRACE=1``) into Chrome-trace JSON for
+``ui.perfetto.dev``, with a lineage + critical-path summary on stdout.
+``check`` evaluates SLO rules against a snapshot and exits non-zero on any
+violation — the CI/bench gate. Exit codes: 1 when a snapshot file is
+missing/unreadable (every subcommand), 2 when ``check`` finds violations,
+1 when ``trace`` finds no trace events.
 """
 from __future__ import annotations
 
@@ -61,7 +71,63 @@ def _render_pretty(snap: dict) -> str:
         lines.append("per-stage seconds:")
         for name, total in stage.items():
             lines.append(f"  {name:<32} {total:.6g}")
+    mesh = _render_mesh(snap)
+    if mesh:
+        lines.extend(mesh)
+    events = _render_events(snap)
+    if events:
+        lines.extend(events)
     return "\n".join(lines)
+
+
+def _render_mesh(snap: dict) -> list:
+    """Per-host mesh ingestion table from the ``mesh.*`` metric family
+    (PR 7) — ``dump``/``watch`` render it whenever a mesh pipeline wrote
+    the snapshot."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    mesh_counters = {k: v for k, v in counters.items()
+                     if k.startswith("mesh.")}
+    mesh_gauges = {k: v for k, v in gauges.items() if k.startswith("mesh.")}
+    if not mesh_counters and not mesh_gauges:
+        return []
+    lines = ["mesh:"]
+    for name in ("mesh.hosts", "mesh.host_skew_s"):
+        if name in mesh_gauges:
+            lines.append(f"  {name:<32} {mesh_gauges[name]}")
+    for name in ("mesh.ingest_wall_s", "mesh.assemble_stall_s",
+                 "mesh.assemble_s", "mesh.reshard_events",
+                 "mesh.hosts_lost"):
+        if name in mesh_counters:
+            lines.append(f"  {name:<32} {mesh_counters[name]}")
+    hosts = sorted({k.split(".")[1] for k in mesh_counters
+                    if k.startswith("mesh.host") and k.count(".") == 2})
+    if hosts:
+        lines.append("  per-host (rows / rowgroups / input_stall_s):")
+        for h in hosts:
+            rows = mesh_counters.get(f"mesh.{h}.rows", 0)
+            groups = mesh_counters.get(f"mesh.{h}.rowgroups", 0)
+            stall = mesh_counters.get(f"mesh.{h}.input_stall_s", 0)
+            lines.append(f"    {h:<10} {rows:>10} / {groups:>6} / {stall}")
+    return lines
+
+
+def _render_events(snap: dict) -> list:
+    """The bounded per-name event rings (PR 4): straggler records, host
+    losses, reshards, watchdog dumps, SLO violations."""
+    events = snap.get("events")
+    if not events:
+        return []
+    lines = ["events (newest last; seq gaps = evicted):"]
+    for name, ring in events.items():
+        lines.append(f"  {name}:")
+        for entry in ring:
+            payload = json.dumps(entry.get("payload", {}), sort_keys=True,
+                                 default=str)
+            if len(payload) > 120:
+                payload = payload[:117] + "..."
+            lines.append(f"    #{entry.get('seq', '?'):<6} {payload}")
+    return lines
 
 
 def _stage_breakdown(snap: dict) -> dict:
@@ -86,10 +152,121 @@ def _render(snap: dict, fmt: str) -> str:
     return _render_pretty(snap)
 
 
+def _cmd_trace(args) -> int:
+    """Merge trace-mode snapshots into one Chrome-trace JSON file."""
+    from petastorm_tpu.telemetry.trace import (complete_lineages,
+                                               lineage_index,
+                                               write_chrome_trace)
+    per_file = []
+    critical = {}
+    for path in args.paths:
+        try:
+            snap = _load(path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot {path}: {e}", file=sys.stderr)
+            return 1
+        per_file.append(snap.get("trace_events", []))
+        for name, value in snap.get("counters", {}).items():
+            if name.startswith("trace.critical_path."):
+                stage = name.rsplit(".", 1)[1]
+                critical[stage] = critical.get(stage, 0) + int(value)
+    if len(per_file) > 1:
+        # Multi-snapshot merge = one file per host process, and
+        # perf_counter is per-machine (boot-relative): without
+        # re-anchoring, hosts land hours apart on the merged timeline.
+        # Align each file's earliest span to t=0 — host epochs start
+        # near-simultaneously, so lanes line up to within real skew while
+        # within-file timing is untouched.
+        for file_spans in per_file:
+            if not file_spans:
+                continue
+            base = min(sp.get("start_s", 0.0) for sp in file_spans)
+            for sp in file_spans:
+                sp["start_s"] = sp.get("start_s", 0.0) - base
+    spans = [sp for file_spans in per_file for sp in file_spans]
+    if not spans:
+        print("no trace events in the given snapshot(s); run the pipeline "
+              "with PETASTORM_TPU_TELEMETRY_TRACE=1", file=sys.stderr)
+        return 1
+    lineages = lineage_index(spans)
+    complete = complete_lineages(spans)
+    write_chrome_trace(args.out, spans, metadata={
+        "critical_path": critical,
+        "lineages": len(lineages),
+        "complete_lineages": len(complete)})
+    print(f"wrote {args.out}: {len(spans)} spans, {len(lineages)} "
+          f"row-group lineages ({len(complete)} complete), open in "
+          f"ui.perfetto.dev")
+    if critical:
+        total = sum(critical.values()) or 1
+        summary = ", ".join(
+            f"{stage}={count} ({100 * count // total}%)"
+            for stage, count in sorted(critical.items(),
+                                       key=lambda kv: -kv[1]) if count)
+        print(f"critical path (per delivered batch): {summary}")
+    return 0
+
+
+def _cmd_check(args) -> int:
+    """Evaluate SLO rules against a snapshot; exit 2 on any violation.
+    Rules that cannot be evaluated (rate rules without ``--prev``, metrics
+    absent from the snapshot, dead gauges) are reported as ``skip`` —
+    never as a passing ``ok``: a CI gate that silently skips what it
+    claims to check is worse than no gate."""
+    from petastorm_tpu.telemetry.slo import (default_rules, parse_rules,
+                                             rule_value)
+    try:
+        snap = _load(args.path)
+    except (OSError, ValueError) as e:
+        print(f"cannot read snapshot {args.path}: {e}", file=sys.stderr)
+        return 1
+    prev = None
+    dt = None
+    if args.prev:
+        try:
+            prev = _load(args.prev)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot {args.prev}: {e}", file=sys.stderr)
+            return 1
+        if not args.window_s or args.window_s <= 0:
+            print("--prev needs --window-s > 0 (seconds between the two "
+                  "snapshots) to evaluate rate rules", file=sys.stderr)
+            return 1
+        dt = args.window_s
+    if args.slo:
+        rules = []
+        for spec in args.slo:
+            rules.extend(parse_rules(spec))
+    else:
+        # Default set: rate rules join only when a window exists to
+        # evaluate them over.
+        rules = [r for r in default_rules()
+                 if r.kind != "rate" or prev is not None]
+    violations = []
+    for rule in rules:
+        value = rule_value(rule, snap, prev=prev, dt_s=dt)
+        if value is None:
+            why = ("needs --prev/--window-s" if rule.kind == "rate"
+                   and prev is None else "metric absent from snapshot")
+            print(f"skip {rule.name}: {rule.metric} not evaluable ({why})")
+        elif value > rule.max_value:
+            violations.append(rule.name)
+            print(f"FAIL {rule.name}: {rule.metric} = {round(value, 6)} "
+                  f"(max {rule.max_value})")
+        else:
+            print(f"ok   {rule.name}: {rule.metric} = {round(value, 6)} "
+                  f"<= {rule.max_value}")
+    if violations:
+        print(f"{len(violations)} SLO violation(s)", file=sys.stderr)
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m petastorm_tpu.telemetry",
-        description="Dump or watch a pipeline telemetry snapshot file.")
+        description="Dump, watch, trace-export, or SLO-check a pipeline "
+                    "telemetry snapshot file.")
     sub = parser.add_subparsers(dest="cmd", required=True)
     for name in ("dump", "watch"):
         p = sub.add_parser(name)
@@ -102,7 +279,35 @@ def main(argv=None) -> int:
     watch.add_argument("--interval", type=float, default=2.0)
     watch.add_argument("--count", type=int, default=0,
                        help="stop after N renders (0 = forever)")
+
+    trace_p = sub.add_parser(
+        "trace", help="merge trace-mode snapshot(s) into Chrome-trace JSON")
+    trace_p.add_argument("paths", nargs="+",
+                         help="trace-mode snapshot file(s) (one per host "
+                              "process on a real slice)")
+    trace_p.add_argument("--out", required=True,
+                         help="Chrome-trace JSON output path "
+                              "(ui.perfetto.dev)")
+
+    check_p = sub.add_parser(
+        "check", help="evaluate SLO rules; exit 2 on violation (CI gate)")
+    check_p.add_argument("path")
+    check_p.add_argument("--slo", action="append", default=[],
+                         help="rule spec, e.g. 'input_stall_pct<=1' or "
+                              "'counter:resilience.worker_crashes<=0' "
+                              "(repeatable; default: the documented "
+                              "default rules minus rate rules)")
+    check_p.add_argument("--prev", default=None,
+                         help="earlier snapshot enabling rate rules")
+    check_p.add_argument("--window-s", type=float, default=None,
+                         help="seconds between --prev and the snapshot "
+                              "(required with --prev for rate rules)")
     args = parser.parse_args(argv)
+
+    if args.cmd == "trace":
+        return _cmd_trace(args)
+    if args.cmd == "check":
+        return _cmd_check(args)
 
     renders = 0
     while True:

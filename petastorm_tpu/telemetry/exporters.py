@@ -37,6 +37,15 @@ def _prom_name(name: str, prefix: str) -> str:
     return _NAME_SANITIZE.sub("_", f"{prefix}_{name}")
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping per the text-exposition spec:
+    backslash, double-quote, AND newline (a raw newline inside a label —
+    e.g. a pathological dataset path in a span name — would split the
+    sample across lines and corrupt the whole exposition)."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(value: float) -> str:
     if value != value:  # NaN
         return "NaN"
@@ -76,7 +85,7 @@ def to_prometheus_text(snapshot: dict,
         lines.append(f"# TYPE {total} counter")
         lines.append(f"# TYPE {count} counter")
         for name, agg in spans.items():
-            label = name.replace("\\", "\\\\").replace('"', '\\"')
+            label = _escape_label(name)
             lines.append(f'{total}{{name="{label}"}} '
                          f'{_fmt(agg["total_s"])}')
             lines.append(f'{count}{{name="{label}"}} {agg["count"]}')
@@ -159,11 +168,18 @@ class PeriodicExporter:
 
     def _run(self):
         while not self._stop.wait(self._interval):
-            self._write_once()
+            self._write_once(final=False)
 
-    def _write_once(self):
+    def _write_once(self, final: bool = True):
+        # Periodic ticks skip the raw trace_events payload: in trace mode
+        # that is up to a 65536-span ring re-serialized every interval —
+        # CPU stolen from the data plane for a file nobody reads
+        # mid-flight. The final flush (reader stop) carries the full
+        # payload the `telemetry trace` CLI consumes.
         try:
-            write_snapshot(self._path, self._registry.snapshot(), self._fmt)
+            snap = (self._registry.snapshot() if final
+                    else self._registry.snapshot(include_trace=False))
+            write_snapshot(self._path, snap, self._fmt)
         except OSError:
             pass  # a transiently unwritable path must not kill the pipeline
 

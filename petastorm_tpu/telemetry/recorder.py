@@ -6,26 +6,63 @@ pipeline never grows without bound). The disabled hot path is a single
 attribute check returning a shared no-op context manager — cheap enough to
 leave ``recorder.span(...)`` permanently inlined on per-batch paths.
 
+Trace mode (docs/observability.md "Trace plane") layers batch lineage on
+top: spans may carry a ``trace`` id (``e{epoch}:g{ordinal}`` — the work
+item's epoch/row-group-ordinal lineage), a ``stage`` name (``ventilate``,
+``fetch``, ``decode``, ``transport``, ``shuffle``, ``stage``, ``pull``,
+``assemble``), and a ``track`` (the display lane — ``worker:2``,
+``fetch:0``, ``h3:pull``). :meth:`enable_trace` turns retention up so a
+whole epoch's raw spans survive for Chrome-trace export
+(:mod:`petastorm_tpu.telemetry.trace`); spans recorded in other processes
+cross the boundary as compact tuples via :meth:`record_remote`.
+
 Clock discipline: spans use ``time.perf_counter()`` exclusively.
 ``time.time()`` is wall-clock and can step backwards under NTP slew — it is
 banned from hot paths repo-wide (enforced by ``tools/check_monotonic.py``).
 """
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Sequence
 
-__all__ = ["Span", "SpanRecorder"]
+__all__ = ["Span", "SpanRecorder", "TRACE_SPAN_CAPACITY"]
+
+#: Ring capacity :meth:`SpanRecorder.enable_trace` grows to: large enough
+#: that an 8-host simulated mesh epoch (hundreds of row groups x ~6 stages)
+#: retains every lineage span, small enough to stay a bounded buffer.
+TRACE_SPAN_CAPACITY = 65536
+
+#: Process-wide span-id allocator (``itertools.count.__next__`` is atomic
+#: on CPython); 0 means "no id assigned".
+_SPAN_IDS = itertools.count(1)
+
+#: Cached pid for span provenance: ``os.getpid()`` is a real syscall and
+#: under seccomp-filtered sandboxes costs tens of microseconds — per-record
+#: that dwarfed the whole recording path. The pid only changes across
+#: fork(), so refresh it in fork children; spawned workers (this repo's
+#: process pools) re-import the module and cache their own.
+_PID = os.getpid()
+
+
+def _refresh_pid() -> None:
+    global _PID
+    _PID = os.getpid()
+
+
+if hasattr(os, "register_at_fork"):  # pragma: no branch
+    os.register_at_fork(after_in_child=_refresh_pid)
 
 
 @dataclass(frozen=True)
 class Span:
     """One completed span. ``start_s`` is a ``perf_counter`` timestamp —
-    meaningful only relative to other spans from the same process."""
+    meaningful only relative to other spans from the same process (remote
+    spans are re-anchored to the consumer's clock on ingest)."""
     name: str
     start_s: float
     duration_s: float
@@ -33,6 +70,15 @@ class Span:
     thread_id: int
     pid: int
     extra: Optional[dict] = field(default=None)
+    #: Lineage id (``e{epoch}:g{ordinal}`` for row-group work items,
+    #: ``b{n}`` for assembled batches); None outside trace mode.
+    trace: Optional[str] = field(default=None)
+    #: Pipeline stage this span's time belongs to (critical-path edge).
+    stage: Optional[str] = field(default=None)
+    #: Display lane for trace export (one track per host/worker/stage).
+    track: Optional[str] = field(default=None)
+    span_id: int = 0
+    parent_id: int = 0
 
     def as_dict(self) -> dict:
         d = {"name": self.name, "start_s": round(self.start_s, 6),
@@ -40,6 +86,16 @@ class Span:
              "thread_id": self.thread_id, "pid": self.pid}
         if self.extra:
             d["extra"] = dict(self.extra)
+        if self.trace is not None:
+            d["trace"] = self.trace
+        if self.stage is not None:
+            d["stage"] = self.stage
+        if self.track is not None:
+            d["track"] = self.track
+        if self.span_id:
+            d["span_id"] = self.span_id
+        if self.parent_id:
+            d["parent_id"] = self.parent_id
         return d
 
 
@@ -58,21 +114,33 @@ _NOOP_SPAN = _NoopSpan()
 
 
 class _LiveSpan:
-    __slots__ = ("_recorder", "_name", "_extra", "_t0")
+    __slots__ = ("_recorder", "_name", "_extra", "_t0", "_trace", "_stage",
+                 "_track", "_parent_id", "span_id")
 
-    def __init__(self, recorder, name, extra):
+    def __init__(self, recorder, name, extra, trace=None, stage=None,
+                 track=None, parent_id=0):
         self._recorder = recorder
         self._name = name
         self._extra = extra
+        self._trace = trace
+        self._stage = stage
+        self._track = track
+        self._parent_id = parent_id
+        self.span_id = 0
 
     def __enter__(self):
+        if self._trace is not None or self._stage is not None:
+            self.span_id = next(_SPAN_IDS)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
         self._recorder.record(self._name, self._t0, t1 - self._t0,
-                              extra=self._extra)
+                              extra=self._extra, trace=self._trace,
+                              stage=self._stage, track=self._track,
+                              span_id=self.span_id,
+                              parent_id=self._parent_id)
         return False
 
 
@@ -91,7 +159,14 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._dropped = 0
         self.enabled = bool(enabled)
+        #: Trace mode: raw spans (with lineage fields) are retained for
+        #: Chrome-trace export and included in registry snapshots.
+        self.trace_enabled = False
         self.capacity = capacity
+        #: Optional callback ``(stage, duration_s)`` invoked for every
+        #: recorded span carrying a stage — the registry wires it to the
+        #: ``trace.span.{stage}_s`` self-time counters.
+        self.on_stage = None
 
     def enable(self) -> None:
         self.enabled = True
@@ -99,27 +174,85 @@ class SpanRecorder:
     def disable(self) -> None:
         self.enabled = False
 
-    def span(self, name: str, extra: Optional[dict] = None):
-        """Context manager timing one span; no-op while disabled."""
+    def enable_trace(self, capacity: Optional[int] = None) -> None:
+        """Turn on trace retention: spans on, lineage fields recorded, and
+        the ring grown to ``capacity`` (default
+        :data:`TRACE_SPAN_CAPACITY`) so a whole epoch's spans survive for
+        export. Growing preserves already-recorded spans."""
+        cap = int(capacity) if capacity else TRACE_SPAN_CAPACITY
+        with self._lock:
+            if cap > (self._spans.maxlen or 0):
+                self._spans = deque(self._spans, maxlen=cap)
+                self.capacity = cap
+        self.enabled = True
+        self.trace_enabled = True
+
+    def span(self, name: str, extra: Optional[dict] = None, *,
+             trace: Optional[str] = None, stage: Optional[str] = None,
+             track: Optional[str] = None, parent_id: int = 0):
+        """Context manager timing one span; no-op while disabled. ``trace``
+        / ``stage`` / ``track`` attach lineage provenance (trace mode)."""
         if not self.enabled:
             return _NOOP_SPAN
-        return _LiveSpan(self, name, extra)
+        return _LiveSpan(self, name, extra, trace, stage, track, parent_id)
 
     def record(self, name: str, start_s: float, duration_s: float,
-               extra: Optional[dict] = None) -> None:
+               extra: Optional[dict] = None, trace: Optional[str] = None,
+               stage: Optional[str] = None, track: Optional[str] = None,
+               span_id: int = 0, parent_id: int = 0) -> None:
         if not self.enabled:
             return
         t = threading.current_thread()
         sp = Span(name, start_s, duration_s, t.name, t.ident or 0,
-                  os.getpid(), extra)
-        with self._lock:
-            if len(self._spans) == self._spans.maxlen:
-                self._dropped += 1
-            self._spans.append(sp)
+                  _PID, extra, trace, stage, track, span_id,
+                  parent_id)
+        self._append((sp,))
+        if stage is not None and self.on_stage is not None:
+            self.on_stage(stage, duration_s)
 
-    def record_event(self, name: str, extra: Optional[dict] = None) -> None:
+    def record_event(self, name: str, extra: Optional[dict] = None, *,
+                     trace: Optional[str] = None,
+                     stage: Optional[str] = None,
+                     track: Optional[str] = None) -> None:
         """Zero-duration marker (e.g. 'epoch_end', 'worker_failure')."""
-        self.record(name, time.perf_counter(), 0.0, extra=extra)
+        self.record(name, time.perf_counter(), 0.0, extra=extra,
+                    trace=trace, stage=stage, track=track)
+
+    def record_remote(self, compact_spans: Sequence, pid: int = 0,
+                      anchor_s: Optional[float] = None) -> None:
+        """Ingest spans recorded in ANOTHER process, shipped as compact
+        ``(name, stage, duration_s, trace, track)`` tuples (the ctrl-frame
+        piggyback — see docs/observability.md "Cross-process propagation").
+        Remote ``perf_counter`` clocks are not comparable to ours, so each
+        span is re-anchored: it *ends* at ``anchor_s`` (default: now, i.e.
+        the moment its processed marker arrived)."""
+        if not self.enabled or not compact_spans:
+            return
+        end = time.perf_counter() if anchor_s is None else anchor_s
+        spans = [Span(name, end - duration_s, duration_s, "remote", 0,
+                      pid, None, trace, stage, track, 0, 0)
+                 for name, stage, duration_s, trace, track in compact_spans]
+        self._append(spans)
+        if self.on_stage is not None:
+            for sp in spans:
+                if sp.stage is not None:
+                    self.on_stage(sp.stage, sp.duration_s)
+
+    def ingest(self, spans: Sequence[Span]) -> None:
+        """Bulk-append already-built :class:`Span` objects (the mesh
+        loader's per-host registry rollup; same-process clocks, so
+        timestamps carry over unchanged)."""
+        self._append(spans)
+
+    def _append(self, spans) -> None:
+        """The single ring-append path (one lock hold for the whole
+        sequence): capacity eviction and the dropped count live here and
+        nowhere else."""
+        with self._lock:
+            for sp in spans:
+                if len(self._spans) == self._spans.maxlen:
+                    self._dropped += 1
+                self._spans.append(sp)
 
     # ------------------------------------------------------------ readout
     def spans(self) -> list:

@@ -111,6 +111,19 @@ class TelemetryRegistry:
         self._event_seq = 0
         self.recorder = SpanRecorder(capacity=span_capacity,
                                      enabled=spans_enabled)
+        # Every recorded span carrying a stage also accrues the stage's
+        # span-time counter (trace.span.{stage}_s) — the span-derived view
+        # next to the always-on counters the critical-path attributor reads.
+        self._stage_counters: Dict[str, Counter] = {}
+        self.recorder.on_stage = self._observe_stage
+
+    def _observe_stage(self, stage: str, duration_s: float) -> None:
+        c = self._stage_counters.get(stage)
+        if c is None:
+            c = self._stage_counters[stage] = self.counter(
+                f"trace.span.{stage}_s")
+        if duration_s > 0:
+            c.add(duration_s)
 
     # ------------------------------------------------------------ create
     def counter(self, name: str) -> Counter:
@@ -138,9 +151,26 @@ class TelemetryRegistry:
                 h = self._histograms[name] = StreamingHistogram(bounds)
             return h
 
-    def span(self, name: str, extra: Optional[dict] = None):
-        """Shortcut for ``registry.recorder.span(...)``."""
-        return self.recorder.span(name, extra)
+    def span(self, name: str, extra: Optional[dict] = None, **kw):
+        """Shortcut for ``registry.recorder.span(...)`` (``trace=`` /
+        ``stage=`` / ``track=`` attach lineage provenance in trace mode)."""
+        return self.recorder.span(name, extra, **kw)
+
+    # ------------------------------------------------------------- peeking
+    def peek_counter(self, name: str) -> float:
+        """A counter's value WITHOUT creating it (0.0 when absent) — for
+        readers like the critical-path attributor that must not add empty
+        series to pipelines that never exercise a stage."""
+        with self._lock:
+            c = self._counters.get(name)
+        return 0.0 if c is None else c.value
+
+    def peek_histogram_sum(self, name: str) -> float:
+        """A histogram's cumulative sum without creating it (0.0 when
+        absent); see :meth:`peek_counter`."""
+        with self._lock:
+            h = self._histograms.get(name)
+        return 0.0 if h is None else h.sum
 
     def record_event(self, name: str, payload: dict) -> None:
         """Append one JSON-safe structured event under ``name`` (cold-path
@@ -164,10 +194,31 @@ class TelemetryRegistry:
             return {k: list(v) for k, v in sorted(self._events.items())}
 
     # ------------------------------------------------------------ readout
-    def snapshot(self) -> dict:
+    def metrics_view(self) -> dict:
+        """Counters/gauges/histograms only — no span aggregation, no raw
+        trace events, no event rings. The cheap periodic read for pollers
+        (the SLO watcher) that must not pay trace mode's 65536-span ring
+        serialization per tick."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "counters": {k: round(c.value, 6)
+                         for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
+            "histograms": {k: h.as_dict()
+                           for k, h in sorted(histograms.items())},
+        }
+
+    def snapshot(self, include_trace: bool = True) -> dict:
         """JSON-safe point-in-time view of every registered metric. The
         ``events`` key is present only when events were recorded (the
-        common no-events snapshot keeps the original documented schema)."""
+        common no-events snapshot keeps the original documented schema).
+        ``include_trace=False`` omits the raw ``trace_events`` payload in
+        trace mode — for periodic writers that would otherwise serialize
+        the whole span ring every tick (the final flush includes it)."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
@@ -184,6 +235,12 @@ class TelemetryRegistry:
         events = self.events()
         if events:
             snap["events"] = events
+        if include_trace and self.recorder.trace_enabled:
+            # Trace mode: raw lineage spans ride the snapshot so exported
+            # files feed `python -m petastorm_tpu.telemetry trace`.
+            trace_spans = [sp.as_dict() for sp in self.recorder.spans()]
+            if trace_spans:
+                snap["trace_events"] = trace_spans
         return snap
 
     def reset(self) -> dict:
@@ -200,6 +257,7 @@ class TelemetryRegistry:
             histograms = dict(self._histograms)
             events = {k: list(v) for k, v in sorted(self._events.items())}
             self._events.clear()
+        drained_spans = self.recorder.drain()
         out = {
             "schema_version": SNAPSHOT_SCHEMA_VERSION,
             "counters": {k: round(c.reset(), 6)
@@ -207,8 +265,10 @@ class TelemetryRegistry:
             "gauges": {k: g.value for k, g in sorted(gauges.items())},
             "histograms": {k: h.drain()
                            for k, h in sorted(histograms.items())},
-            "spans": SpanRecorder.aggregate_spans(self.recorder.drain()),
+            "spans": SpanRecorder.aggregate_spans(drained_spans),
         }
         if events:
             out["events"] = events
+        if self.recorder.trace_enabled and drained_spans:
+            out["trace_events"] = [sp.as_dict() for sp in drained_spans]
         return out

@@ -26,10 +26,18 @@ class VentilatedItemProcessedMessage:
     ``item_context`` echoes the ventilator's ``(epoch, position)`` for the
     item when the work kwargs carried one (the reader's ``shuffle_context``);
     the ventilator uses it to advance an exact resume watermark even when
-    multi-worker pools complete items out of ventilation order."""
+    multi-worker pools complete items out of ventilation order.
 
-    def __init__(self, item_context=None):
+    ``spans``: optional compact trace spans — ``(name, stage, duration_s,
+    trace, track)`` tuples — piggybacked by SPAWNED workers so the
+    consumer-side registry sees their decode time with lineage intact
+    (trace mode only; the marker already crosses the ctrl-frame transport,
+    so the piggyback costs no extra frame). In-process pools leave it
+    None — their workers record into the shared registry directly."""
+
+    def __init__(self, item_context=None, spans=None):
         self.item_context = item_context
+        self.spans = spans
 
 
 class WorkerFailure:

@@ -101,13 +101,18 @@ class DummyPool:
                 return result
             if self._pending:
                 args, kwargs = self._pending.popleft()
+                # Lineage id from the reader's ventilate wrapper (trace
+                # mode); popped before the worker impl sees the kwargs.
+                trace = kwargs.pop("trace_context", None)
                 self.heartbeats[0] = time.monotonic()
                 t0 = time.perf_counter()
                 if self.telemetry is not None:
                     if self._decode_hist is None:
                         self._decode_hist = self.telemetry.histogram(
                             "worker.decode_s")
-                    with self.telemetry.span("petastorm_tpu.worker_decode"):
+                    with self.telemetry.span("petastorm_tpu.worker_decode",
+                                             trace=trace, stage="decode",
+                                             track="worker:0"):
                         self._process_item(args, kwargs)
                     dt = time.perf_counter() - t0
                     self._decode_hist.observe(dt)

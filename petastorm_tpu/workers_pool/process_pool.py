@@ -207,6 +207,9 @@ class ProcessPool:
         #: producer-side knob is the ventilator's in-flight cap instead
         #: (docs/autotune.md).
         self.concurrency_gate = None
+        # Lazily-resolved transport.deserialize_s counter (telemetry is
+        # assigned by the Reader after construction).
+        self._c_deser = None
         ipc_dir = tempfile.mkdtemp(prefix="pt_pool_")
         token = uuid.uuid4().hex[:8]
         self._endpoints = {
@@ -317,6 +320,12 @@ class ProcessPool:
                 continue
             if isinstance(msg, VentilatedItemProcessedMessage):
                 self._processed += 1
+                spans = getattr(msg, "spans", None)
+                if spans and self.telemetry is not None:
+                    # Spawned-worker trace spans, piggybacked on the ctrl
+                    # frame: re-anchored to OUR clock at arrival (remote
+                    # perf_counter bases are not comparable).
+                    self.telemetry.recorder.record_remote(spans)
                 if self.recovery is not None:
                     self.recovery.on_processed(msg.item_context)
                 if self._ventilator:
@@ -450,6 +459,33 @@ class ProcessPool:
             return self._poll_result_shm(timeout_ms)
         return self._poll_result_zmq(timeout_ms)
 
+    def _deserialize_timed(self, buf, idx=None):
+        """Deserialize one data payload (+ the consumer-side
+        ``result_transform``), accounting the time as the pipeline's
+        **transport** stage: the ``transport.deserialize_s`` counter
+        always, plus a ``petastorm_tpu.transport`` span in trace mode
+        (per-item lineage is unknown here — data frames precede their
+        context-bearing processed marker — so transport spans carry track
+        provenance only)."""
+        tele = self.telemetry
+        if tele is None:
+            result = self._serializer.deserialize(buf)
+            if self.result_transform is not None:
+                result = self.result_transform(result)
+            return result
+        c = self._c_deser
+        if c is None:
+            c = self._c_deser = tele.counter("transport.deserialize_s")
+        track = "transport" if idx is None else f"transport:{idx}"
+        t0 = time.perf_counter()
+        with tele.span("petastorm_tpu.transport", stage="transport",
+                       track=track):
+            result = self._serializer.deserialize(buf)
+            if self.result_transform is not None:
+                result = self.result_transform(result)
+        c.add(time.perf_counter() - t0)
+        return result
+
     def _poll_result_shm(self, timeout_ms: int):
         """Round-robin over worker rings. Frames: first byte C (pickled
         control), D (serialized data), or — for payloads bigger than half a
@@ -510,10 +546,7 @@ class ProcessPool:
                         # Reassembled payloads live in consumer-owned
                         # memory: results may alias `buf` freely (GC keeps
                         # it alive).
-                        result = self._serializer.deserialize(memoryview(buf))
-                        if self.result_transform is not None:
-                            result = self.result_transform(result)
-                        return result
+                        return self._deserialize_timed(memoryview(buf), idx)
                     # Single-record data frame.
                     if (self.result_transform is not None
                             or not getattr(self._serializer, "aliases_input",
@@ -522,15 +555,13 @@ class ProcessPool:
                         # Safe because either deserialization itself copies
                         # (e.g. pickle, which cannot alias the reused ring)
                         # or the transform's aliasing outputs get a claim.
-                        result = self._serializer.deserialize(view)
-                        if self.result_transform is not None:
-                            result = self.result_transform(result)
+                        result = self._deserialize_timed(view, idx)
                         claimed = self._maybe_claim(reader, idx, view, result)
                     else:
                         # One safe copy so the result cannot alias the
                         # reused ring (no copying transform downstream).
                         # copy-ok: aliasing-unsafe consumer needs the copy
-                        result = self._serializer.deserialize(bytes(view))
+                        result = self._deserialize_timed(bytes(view), idx)
                     return result
                 finally:
                     if not claimed:
@@ -589,16 +620,12 @@ class ProcessPool:
             return pickle.loads(payload if isinstance(payload, bytes)
                                 else memoryview(payload))
         if isinstance(payload, bytes):
-            result = self._serializer.deserialize(payload)
-        else:
-            # Zero-copy: the zmq frame owns its buffer and anything aliasing
-            # it (Arrow buffers -> numpy views) keeps it alive through
-            # ordinary refcounting — unlike the shm ring, nothing recycles
-            # this memory, so no claim protocol is needed here.
-            result = self._serializer.deserialize(memoryview(payload))
-        if self.result_transform is not None:
-            result = self.result_transform(result)
-        return result
+            return self._deserialize_timed(payload)
+        # Zero-copy: the zmq frame owns its buffer and anything aliasing
+        # it (Arrow buffers -> numpy views) keeps it alive through
+        # ordinary refcounting — unlike the shm ring, nothing recycles
+        # this memory, so no claim protocol is needed here.
+        return self._deserialize_timed(memoryview(payload))
 
     def _resend(self, item):
         """Re-ventilate a lost work item WITHOUT bumping ``_ventilated``:
@@ -747,6 +774,7 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
 
     worker = worker_class(worker_id, publish, worker_args)
     send_ctrl(_WorkerReady(worker_id))
+    worker_track = f"worker:{worker_id}"
 
     poller = zmq.Poller()
     poller.register(work_socket, zmq.POLLIN)
@@ -759,6 +787,7 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
                     break
             if work_socket in events:
                 args, kwargs = work_socket.recv_pyobj()
+                trace = kwargs.pop("trace_context", None)
                 try:
                     # Claim frame BEFORE processing: on a hard crash the
                     # consumer's recovery ledger knows exactly which item
@@ -770,14 +799,27 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
                     if send_claims:
                         send_ctrl(ItemStartedMessage(
                             worker_id, kwargs.get(ITEM_CONTEXT_KWARG)))
+                    t0 = time.perf_counter()
                     try:
                         worker.process(*args, **kwargs)
                     except RowGroupSkipped as skip:
                         # Degraded mode: ship the quarantine record; the
                         # processed marker below completes the item.
                         send_ctrl(RowGroupSkippedMessage(skip.record))
+                    # Trace mode rides the injected trace_context kwarg
+                    # itself — a LIVE per-item signal, so tracing enabled
+                    # after this pool started (programmatic enable_trace,
+                    # the mesh rollup path) still propagates: each item's
+                    # decode is timed here and shipped as a compact span
+                    # tuple on the processed marker (the consumer
+                    # re-anchors it; perf_counter does not cross process
+                    # boundaries).
+                    spans = ([("petastorm_tpu.worker_decode", "decode",
+                               time.perf_counter() - t0, trace,
+                               worker_track)] if trace is not None
+                             else None)
                     send_ctrl(VentilatedItemProcessedMessage(
-                        kwargs.get(ITEM_CONTEXT_KWARG)))
+                        kwargs.get(ITEM_CONTEXT_KWARG), spans=spans))
                 except _RING_CLOSED_ERRORS:
                     # The consumer stopped and closed our ring mid-publish
                     # (early reader shutdown): a clean exit, not a failure.

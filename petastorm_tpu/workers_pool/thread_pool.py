@@ -180,11 +180,15 @@ class _WorkerThread(threading.Thread):
                 self.prof.disable()
 
     def _loop(self):
+        wid = self._worker_impl.worker_id
         while not self._stop_event.is_set():
             try:
                 args, kwargs = self._input_queue.get(block=True, timeout=_IO_TIMEOUT_S)
             except queue.Empty:
                 continue
+            # Lineage id the reader's ventilate wrapper injected (trace
+            # mode); popped so the worker impl's signature never sees it.
+            trace = kwargs.pop("trace_context", None)
             # Admission gate: park until a processing slot frees. The item
             # stays ours (round-robin assignment is fixed), so determinism
             # holds; a stop while parked drops the item like any other stop.
@@ -194,7 +198,9 @@ class _WorkerThread(threading.Thread):
             t0 = time.perf_counter()
             try:
                 if self._decode_hist is not None:
-                    with self._telemetry.span("petastorm_tpu.worker_decode"):
+                    with self._telemetry.span("petastorm_tpu.worker_decode",
+                                              trace=trace, stage="decode",
+                                              track=f"worker:{wid}"):
                         self._process_item(args, kwargs)
                     self._decode_hist.observe(time.perf_counter() - t0)
                 else:

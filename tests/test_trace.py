@@ -1,0 +1,585 @@
+"""Trace plane (docs/observability.md): cross-process batch lineage,
+Chrome-trace export, critical-path attribution, SLO watch, and the
+exporter edge cases that ride along (PR 8)."""
+import json
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.telemetry import (CriticalPathAttributor, SloWatcher,
+                                     TelemetryRegistry, TraceContext,
+                                     complete_lineages, evaluate_rules,
+                                     lineage_index, parse_prometheus_text,
+                                     parse_rules, to_chrome_trace,
+                                     to_prometheus_text)
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(scope="module")
+def scalar_store(tmp_path_factory):
+    """Plain Parquet store: 200 rows / 10 row groups of 20 rows."""
+    path = tmp_path_factory.mktemp("trace_scalar")
+    n = 200
+    pq.write_table(
+        pa.table({"id": np.arange(n, dtype=np.int64),
+                  "x": (np.arange(n) * 0.5).astype(np.float32)}),
+        str(path / "part0.parquet"), row_group_size=20)
+    return f"file://{path}"
+
+
+# --------------------------------------------------------------- identity
+def test_trace_context_roundtrip():
+    ctx = TraceContext(epoch=3, ordinal=17)
+    assert ctx.id == "e3:g17"
+    assert TraceContext.parse("e3:g17") == ctx
+    assert TraceContext.parse("b12") is None
+    assert TraceContext.parse("garbage") is None
+
+
+def test_recorder_trace_fields_ride_snapshot():
+    reg = TelemetryRegistry()
+    reg.recorder.enable_trace()
+    with reg.span("petastorm_tpu.worker_decode", trace="e0:g1",
+                  stage="decode", track="worker:1"):
+        pass
+    snap = reg.snapshot()
+    [span] = snap["trace_events"]
+    assert span["trace"] == "e0:g1"
+    assert span["stage"] == "decode"
+    assert span["track"] == "worker:1"
+    assert span["span_id"] > 0
+    # stage self-time mirrors into the span-fed counter
+    assert snap["counters"]["trace.span.decode_s"] > 0
+    # periodic writers can skip the raw-span payload (PeriodicExporter's
+    # per-tick path); the metrics themselves are unaffected
+    slim = reg.snapshot(include_trace=False)
+    assert "trace_events" not in slim
+    assert slim["counters"] == snap["counters"]
+    # reset drains the raw spans too
+    out = reg.reset()
+    assert len(out["trace_events"]) == 1
+    assert "trace_events" not in reg.snapshot()
+
+
+def test_enable_trace_grows_ring_preserving():
+    from petastorm_tpu.telemetry.recorder import (SpanRecorder,
+                                                  TRACE_SPAN_CAPACITY)
+    rec = SpanRecorder(capacity=4, enabled=True)
+    for i in range(3):
+        rec.record(f"s{i}", 0.0, 0.001)
+    rec.enable_trace()
+    assert rec.capacity == TRACE_SPAN_CAPACITY
+    assert [sp.name for sp in rec.spans()] == ["s0", "s1", "s2"]
+    assert rec.trace_enabled
+
+
+def test_record_remote_anchors_to_local_clock():
+    import time
+    rec_reg = TelemetryRegistry(spans_enabled=True)
+    rec = rec_reg.recorder
+    now = time.perf_counter()
+    rec.record_remote([("petastorm_tpu.worker_decode", "decode", 0.25,
+                        "e0:g4", "worker:2")], pid=4242)
+    [span] = rec.spans()
+    assert span.trace == "e0:g4" and span.pid == 4242
+    assert span.duration_s == 0.25
+    # ends ~now on OUR clock
+    assert abs((span.start_s + span.duration_s) - now) < 1.0
+    assert rec_reg.peek_counter("trace.span.decode_s") == 0.25
+
+
+# --------------------------------------------------------------- exporter
+def test_chrome_trace_tracks_and_instants():
+    spans = [
+        {"name": "petastorm_tpu.ventilate", "start_s": 1.0,
+         "duration_s": 0.0, "thread": "vent", "thread_id": 1, "pid": 9,
+         "trace": "e0:g0", "stage": "ventilate", "track": "ventilator"},
+        {"name": "petastorm_tpu.worker_decode", "start_s": 1.1,
+         "duration_s": 0.2, "thread": "w", "thread_id": 2, "pid": 9,
+         "trace": "e0:g0", "stage": "decode", "track": "h3:worker:0"},
+    ]
+    ct = to_chrome_trace(spans, metadata={"k": 1})
+    events = ct["traceEvents"]
+    procs = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    threads = {e["args"]["name"] for e in events
+               if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert procs == {"pid9", "host3"}
+    assert threads == {"ventilator", "worker:0"}
+    kinds = {e["ph"] for e in events}
+    assert "i" in kinds and "X" in kinds  # instant + complete events
+    x = next(e for e in events if e["ph"] == "X")
+    assert x["dur"] == pytest.approx(0.2e6)
+    assert x["args"]["trace"] == "e0:g0"
+    assert ct["otherData"] == {"k": 1}
+    json.dumps(ct)  # must be JSON-serializable as-is
+
+
+def test_lineage_helpers():
+    spans = [
+        {"name": "v", "trace": "e0:g0", "stage": "ventilate"},
+        {"name": "d", "trace": "e0:g0", "stage": "decode"},
+        {"name": "v", "trace": "e0:g1", "stage": "ventilate"},
+        {"name": "s", "trace": "b1", "stage": "stage"},  # batch-scoped
+        {"name": "x"},                                   # no lineage
+    ]
+    idx = lineage_index(spans)
+    assert set(idx) == {"e0:g0", "e0:g1"}
+    assert complete_lineages(spans) == ["e0:g0"]
+
+
+# ---------------------------------------------------------- critical path
+def test_critical_path_names_longest_edge():
+    reg = TelemetryRegistry()
+    cp = CriticalPathAttributor(reg)
+    reg.counter("loader.stage_s").add(0.1)
+    reg.counter("loader.shuffle_s").add(0.4)
+    assert cp.observe_batch() == "shuffle"
+    reg.histogram("worker.decode_s").observe(0.9)
+    reg.counter("loader.stage_s").add(0.2)
+    assert cp.observe_batch() == "decode"
+    # mesh host-plane decode sync counts into the same edge
+    reg.counter("mesh.host_decode_s").add(5.0)
+    assert cp.observe_batch() == "decode"
+    assert cp.observe_batch() is None  # nothing moved between deliveries
+    rep = cp.report()
+    assert rep["batches"] == 4 and rep["attributed"] == 3
+    assert rep["counts"]["decode"] == 2 and rep["dominant"] == "decode"
+    assert rep["recent"][-1]["critical"] is None
+    # per-batch self-times landed as histograms
+    snap = reg.snapshot()
+    assert snap["histograms"]["trace.self.decode_s"]["count"] == 2
+
+
+def test_critical_path_is_lazy_about_metric_creation():
+    reg = TelemetryRegistry()
+    CriticalPathAttributor(reg)
+    snap = reg.snapshot()
+    assert not any(k.startswith("trace.") for k in snap["counters"])
+    assert not any(k.startswith(("io.", "transport.", "mesh.", "loader."))
+                   for k in snap["counters"])
+
+
+# ------------------------------------------------------------- end-to-end
+def test_reader_epoch_traces_every_rowgroup(scalar_store, monkeypatch):
+    monkeypatch.setenv("PETASTORM_TPU_TELEMETRY_TRACE", "1")
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=True, seed=7,
+                           reader_pool_type="thread", workers_count=2,
+                           readahead_depth=3) as r:
+        rows = sum(len(b.id) for b in r)
+        spans = [sp.as_dict() for sp in r.telemetry.recorder.spans()]
+        ra = r.readahead_report()
+    assert rows == 200
+    # one complete ventilate->decode lineage per row group
+    assert len(complete_lineages(spans)) == 10
+    # trace ordinals are plan-stable even under the seeded epoch shuffle
+    assert set(lineage_index(spans)) == {f"e0:g{i}" for i in range(10)}
+    # fetcher provenance is first-class: fetch spans on fetch:{idx} tracks,
+    # never phantom worker ids (satellite: worker_id 1000+i is fault-plan
+    # keying only)
+    fetch_tracks = {sp["track"] for sp in spans
+                    if sp.get("stage") == "fetch"}
+    assert fetch_tracks and all(t.startswith("fetch:")
+                                for t in fetch_tracks)
+    assert ra["provenance"]["stage"] == "fetch"
+    assert ra["provenance"]["tracks"][0] == "fetch:0"
+
+
+def test_dummy_pool_epoch_traces(scalar_store, monkeypatch):
+    monkeypatch.setenv("PETASTORM_TPU_TELEMETRY_TRACE", "1")
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        rows = sum(len(b.id) for b in r)
+        spans = [sp.as_dict() for sp in r.telemetry.recorder.spans()]
+    assert rows == 200
+    assert len(complete_lineages(spans)) == 10
+    decode_tracks = {sp["track"] for sp in spans
+                     if sp.get("stage") == "decode"}
+    assert decode_tracks == {"worker:0"}
+
+
+@pytest.mark.process_pool
+def test_process_pool_trace_crosses_the_boundary(scalar_store, monkeypatch):
+    """Spawned workers piggyback decode spans on the processed-marker ctrl
+    frame; the consumer re-anchors them with lineage intact, and the
+    transport stage is accounted consumer-side."""
+    monkeypatch.setenv("PETASTORM_TPU_TELEMETRY_TRACE", "1")
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="process",
+                           workers_count=2) as r:
+        rows = sum(len(b.id) for b in r)
+        spans = [sp.as_dict() for sp in r.telemetry.recorder.spans()]
+        counters = r.telemetry.snapshot()["counters"]
+    assert rows == 200
+    remote = [sp for sp in spans if sp.get("stage") == "decode"]
+    assert len(remote) == 10
+    assert {sp["trace"] for sp in remote} == {f"e0:g{i}" for i in range(10)}
+    assert all(sp["thread"] == "remote" for sp in remote)
+    assert {sp["track"] for sp in remote} <= {"worker:0", "worker:1"}
+    assert counters.get("transport.deserialize_s", 0) > 0
+    assert len(complete_lineages(spans)) == 10
+
+
+@pytest.mark.process_pool
+def test_process_pool_trace_enabled_after_start(scalar_store):
+    """The injected trace_context kwarg is a LIVE per-item signal: trace
+    mode enabled after the pool spawned (the mesh rollup path, or
+    enable_trace() before export_trace) still yields remote decode spans
+    for items ventilated after the flip."""
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(scalar_store, num_epochs=2,
+                           shuffle_row_groups=False,
+                           reader_pool_type="process",
+                           workers_count=1) as r:
+        it = iter(r)
+        next(it)  # pool is up and working, tracing off
+        r.telemetry.recorder.enable_trace()
+        rows = 20 + sum(len(b.id) for b in it)
+        spans = [sp.as_dict() for sp in r.telemetry.recorder.spans()]
+    assert rows == 400
+    remote = [sp for sp in spans if sp.get("stage") == "decode"]
+    # items ventilated before the flip have no trace_context (and some may
+    # already be in flight at flip time) — but the stream after it does
+    assert remote and all(sp["trace"].startswith("e") for sp in remote)
+
+
+@pytest.mark.process_pool
+def test_migration_diagnostics_stay_monotonic(scalar_store):
+    """Satellite bug fix: a placement migration must not make
+    Reader.diagnostics jump backwards (the fresh pool restarts its item
+    counters from zero) or keep reporting the old backend."""
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(scalar_store, num_epochs=2, seed=0,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread",
+                           workers_count=2) as r:
+        it = iter(r)
+        got = [next(it) for _ in range(3)]
+        pre = r.diagnostics
+        assert pre["pool_type"] == "thread"
+        pre_ventilated = pre["items_ventilated"]
+        assert pre_ventilated >= 3
+        r._request_pool_migration("process")
+        got.extend(it)
+        post = r.diagnostics
+    assert post["pool_type"] == "process"
+    assert post["items_ventilated"] >= pre_ventilated
+    assert post["items_ventilated"] == post["items_processed"] == 20
+    # gauges re-synced at the safe point: the process backend reports
+    # backend=1 and a disabled queue-shape pair
+    gauges = post["telemetry"]["gauges"]
+    assert gauges["pool.backend"] == 1.0
+    assert gauges["pool.results_queue_capacity"] == 0
+    rows = sorted(int(v) for g in got for v in np.asarray(g.id).tolist())
+    assert rows == sorted(list(range(200)) * 2)
+
+
+# -------------------------------------------------------------------- CLI
+def _traced_snapshot_file(tmp_path, name="snap.json"):
+    reg = TelemetryRegistry()
+    reg.recorder.enable_trace()
+    with reg.span("petastorm_tpu.worker_decode", trace="e0:g0",
+                  stage="decode", track="worker:0"):
+        pass
+    reg.recorder.record_event("petastorm_tpu.ventilate", trace="e0:g0",
+                              stage="ventilate", track="ventilator")
+    reg.counter("trace.critical_path.decode").add(3)
+    path = tmp_path / name
+    path.write_text(json.dumps(reg.snapshot()))
+    return str(path)
+
+
+def test_cli_trace_exports_chrome_json(tmp_path, capsys):
+    from petastorm_tpu.telemetry.__main__ import main
+    snap = _traced_snapshot_file(tmp_path)
+    out = str(tmp_path / "trace.json")
+    assert main(["trace", snap, "--out", out]) == 0
+    printed = capsys.readouterr().out
+    assert "critical path" in printed and "decode=3" in printed
+    ct = json.loads(open(out).read())
+    assert ct["traceEvents"]
+    assert ct["otherData"]["critical_path"] == {"decode": 3}
+    assert ct["otherData"]["complete_lineages"] == 1
+
+
+def test_cli_trace_re_anchors_multi_host_snapshots(tmp_path, capsys):
+    """Merging per-host snapshot files re-anchors each file's earliest
+    span to t=0: perf_counter is per-machine, and without alignment hosts
+    land arbitrarily far apart on the merged timeline."""
+    from petastorm_tpu.telemetry.__main__ import main
+
+    def snap_file(name, base_s):
+        span = {"name": "petastorm_tpu.worker_decode", "start_s": base_s,
+                "duration_s": 0.5, "thread": "w", "thread_id": 1, "pid": 1,
+                "trace": "e0:g0", "stage": "decode", "track": "worker:0"}
+        path = tmp_path / name
+        path.write_text(json.dumps({"counters": {}, "gauges": {},
+                                    "histograms": {}, "spans": {},
+                                    "trace_events": [span]}))
+        return str(path)
+
+    a = snap_file("host_a.json", 17.0)         # host A booted recently
+    b = snap_file("host_b.json", 9_000_000.0)  # host B up for months
+    out = str(tmp_path / "merged.json")
+    assert main(["trace", a, b, "--out", out]) == 0
+    capsys.readouterr()
+    ct = json.loads(open(out).read())
+    ts = [e["ts"] for e in ct["traceEvents"] if e["ph"] != "M"]
+    assert len(ts) == 2 and all(t == 0.0 for t in ts)
+
+
+def test_cli_trace_refuses_traceless_snapshot(tmp_path, capsys):
+    from petastorm_tpu.telemetry.__main__ import main
+    path = tmp_path / "plain.json"
+    path.write_text(json.dumps(TelemetryRegistry().snapshot()))
+    assert main(["trace", str(path),
+                 "--out", str(tmp_path / "t.json")]) == 1
+    assert "PETASTORM_TPU_TELEMETRY_TRACE" in capsys.readouterr().err
+
+
+def test_cli_check_pass_and_fail(tmp_path, capsys):
+    from petastorm_tpu.telemetry.__main__ import main
+    ok = {"gauges": {"loader.input_stall_pct": 0.4}, "counters": {},
+          "histograms": {}}
+    bad = {"gauges": {"loader.input_stall_pct": 42.0},
+           "counters": {"resilience.quarantined_rowgroups": 2},
+           "histograms": {}}
+    ok_p, bad_p = tmp_path / "ok.json", tmp_path / "bad.json"
+    ok_p.write_text(json.dumps(ok))
+    bad_p.write_text(json.dumps(bad))
+    assert main(["check", str(ok_p)]) == 0
+    assert main(["check", str(bad_p)]) == 2
+    err = capsys.readouterr()
+    assert "FAIL input_stall_pct" in err.out
+    # explicit rule specs override defaults; absent metrics report as
+    # "skip", never as a passing "ok"
+    assert main(["check", str(bad_p), "--slo",
+                 "input_stall_pct<=50,counter:foo.bar<=1"]) == 0
+    out = capsys.readouterr().out
+    assert "skip foo.bar" in out and "ok   input_stall_pct" in out
+    assert main(["check", str(tmp_path / "missing.json")]) == 1
+    # rate rules engage once a --prev window exists
+    prev = {"gauges": {}, "counters": {"resilience.hedges_launched": 0},
+            "histograms": {}}
+    cur = {"gauges": {}, "counters": {"resilience.hedges_launched": 300},
+           "histograms": {}}
+    prev_p, cur_p = tmp_path / "prev.json", tmp_path / "cur.json"
+    prev_p.write_text(json.dumps(prev))
+    cur_p.write_text(json.dumps(cur))
+    capsys.readouterr()
+    assert main(["check", str(cur_p), "--prev", str(prev_p),
+                 "--window-s", "10"]) == 2
+    assert "FAIL hedge_rate" in capsys.readouterr().out
+    # --prev without a window is an error, not a silent skip
+    assert main(["check", str(cur_p), "--prev", str(prev_p)]) == 1
+
+
+def test_dump_renders_events_and_mesh(tmp_path, capsys):
+    """Satellite: watch/dump surface the PR 4 event rings and the PR 7
+    mesh.* family (the same pretty renderer serves both subcommands)."""
+    from petastorm_tpu.telemetry.__main__ import main
+    reg = TelemetryRegistry()
+    reg.record_event("mesh.host_lost", {"host": 3, "error": "boom"})
+    reg.counter("mesh.host3.rows").add(100)
+    reg.counter("mesh.host3.rowgroups").add(5)
+    reg.counter("mesh.reshard_events").add(1)
+    reg.gauge("mesh.hosts").set(8)
+    path = tmp_path / "mesh.json"
+    path.write_text(json.dumps(reg.snapshot()))
+    assert main(["dump", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "mesh:" in out and "per-host" in out and "host3" in out
+    assert "events" in out and "mesh.host_lost" in out and "boom" in out
+
+
+# -------------------------------------------------------------- SLO watch
+def test_slo_rule_parsing_and_evaluation():
+    rules = parse_rules("input_stall_pct<=1,counter:resilience.worker_crashes<=0")
+    assert [r.max_value for r in rules] == [1.0, 0.0]
+    snap = {"gauges": {"loader.input_stall_pct": 3.0},
+            "counters": {"resilience.worker_crashes": 0.0},
+            "histograms": {}}
+    violations = evaluate_rules(snap, rules)
+    assert [v["rule"] for v in violations] == ["input_stall_pct"]
+    with pytest.raises(ValueError):
+        parse_rules("unknown_rule<=2")
+    # rate rules need a window
+    rate = parse_rules("hedge_rate<=1")
+    prev = {"counters": {"resilience.hedges_launched": 0.0}}
+    cur = {"counters": {"resilience.hedges_launched": 30.0}, "gauges": {},
+           "histograms": {}}
+    assert evaluate_rules(cur, rate) == []  # no window: not evaluable
+    [v] = evaluate_rules(cur, rate, prev=prev, dt_s=10.0)
+    assert v["value"] == pytest.approx(3.0)
+
+
+def test_slo_watcher_records_events_and_counts():
+    reg = TelemetryRegistry()
+    reg.gauge("loader.input_stall_pct").set(50.0)
+    watcher = SloWatcher(reg, rules=parse_rules("input_stall_pct<=5"),
+                         interval_s=60.0)
+    [v] = watcher.check_once()
+    assert v["rule"] == "input_stall_pct"
+    assert reg.peek_counter("slo.violations_total") == 1
+    events = reg.events("slo.violation")
+    assert events and events[0]["payload"]["value"] == 50.0
+    rep = watcher.report()
+    assert rep["currently_violating"] == ["input_stall_pct"]
+    assert rep["violations_by_rule"] == {"input_stall_pct": 1}
+    reg.gauge("loader.input_stall_pct").set(0.0)
+    assert watcher.check_once() == []
+    assert watcher.report()["currently_violating"] == []
+    watcher.stop()
+
+
+def test_reader_slo_env_wiring(scalar_store, monkeypatch):
+    monkeypatch.setenv("PETASTORM_TPU_SLO_WATCH", "input_stall_pct<=5")
+    from petastorm_tpu.reader import make_batch_reader
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        assert r.slo_watcher is not None
+        assert [x.name for x in r.slo_watcher.rules] == ["input_stall_pct"]
+        list(r)
+        rep = r.slo_report()
+        assert rep["rules"][0]["metric"] == "loader.input_stall_pct"
+    # stopped with the reader
+    assert r.slo_watcher._thread is None
+
+
+# --------------------------------------------------- exporter edge cases
+def test_prometheus_label_escaping_survives_hostile_span_names():
+    """Satellite: quotes/backslashes/newlines (a pathological dataset path
+    in a span name) must not corrupt the exposition format."""
+    reg = TelemetryRegistry(spans_enabled=True)
+    evil = 'read "/data/ds\\v1\nshard"'
+    reg.recorder.record(evil, 0.0, 0.5)
+    text = to_prometheus_text(reg.snapshot())
+    parsed = parse_prometheus_text(text)  # raises on malformed lines
+    labels = next(iter(
+        parsed["petastorm_tpu_span_seconds_total"].keys()))
+    assert "\\n" in labels and '\\"' in labels and "\\\\" in labels
+    assert "\n" not in labels
+
+
+def test_histogram_bucket_boundary_values():
+    """A value equal to a bucket's upper bound counts in that bucket
+    (Prometheus ``le`` = less-or-equal semantics)."""
+    from petastorm_tpu.telemetry import StreamingHistogram
+    h = StreamingHistogram(bounds=[1.0, 2.0, 4.0])
+    h.observe(2.0)   # exactly on a bound
+    h.observe(4.0)   # exactly on the last bound
+    h.observe(4.0000001)  # just past it: +Inf bucket
+    buckets = dict((b if b is not None else "inf", c)
+                   for b, c in h.buckets())
+    assert buckets[2.0] == 1     # le=2 includes the 2.0 observation
+    assert buckets[4.0] == 2     # le=4 includes both
+    assert buckets["inf"] == 3
+    # Prometheus rendering keeps the same cumulative counts
+    reg = TelemetryRegistry()
+    reg.histogram("b", bounds=[1.0, 2.0, 4.0]).observe(2.0)
+    parsed = parse_prometheus_text(to_prometheus_text(reg.snapshot()))
+    assert parsed["petastorm_tpu_b_bucket"]['le="2.0"'] == 1
+
+
+def test_snapshot_during_reset_loses_nothing():
+    """Satellite: concurrent add() during a reset() storm lands either in
+    a returned snapshot or in the final state — never in neither."""
+    reg = TelemetryRegistry()
+    counter = reg.counter("x")
+    hist = reg.histogram("h")
+    n = 20000
+    done = threading.Event()
+
+    def hammer():
+        for _ in range(n):
+            counter.add(1)
+            hist.observe(1.0)
+        done.set()
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    captured = 0.0
+    captured_h = 0
+    while not done.is_set():
+        snap = reg.reset()
+        captured += snap["counters"].get("x", 0.0)
+        captured_h += snap["histograms"].get("h", {}).get("count", 0)
+    t.join()
+    final = reg.reset()
+    captured += final["counters"].get("x", 0.0)
+    captured_h += final["histograms"].get("h", {}).get("count", 0)
+    assert captured == n
+    assert captured_h == n
+
+
+# --------------------------------------------------- mesh acceptance e2e
+@pytest.mark.mesh
+def test_mesh_trace_acceptance(tmp_path, monkeypatch, capsys):
+    """The PR acceptance surface: an 8-simulated-host mesh epoch in trace
+    mode yields valid Chrome-trace JSON with one process per host, one
+    track per stage, >= 1 complete lineage per row group, and a per-batch
+    critical-path attribution summary."""
+    monkeypatch.setenv("PETASTORM_TPU_TELEMETRY_TRACE", "1")
+    store = tmp_path / "mesh_store"
+    store.mkdir()
+    n = 800
+    pq.write_table(
+        pa.table({"id": np.arange(n, dtype=np.int64),
+                  "x": (np.arange(n) * 0.5).astype(np.float32)}),
+        str(store / "part0.parquet"), row_group_size=20)
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    from petastorm_tpu.telemetry import write_snapshot
+    factory = MeshReaderFactory(f"file://{store}", batched=True)
+    with MeshDataLoader(factory, batch_size=80, seed=3,
+                        num_epochs=1) as loader:
+        rows = sum(len(b["id"]) for b in loader)
+        rep = loader.mesh_report()
+        snap = loader.telemetry.snapshot()
+    assert rows == 800
+
+    # ≥1 complete lineage per row group, through the mesh pull plane
+    spans = snap["trace_events"]
+    lineages = lineage_index(spans)
+    assert len(lineages) == 40  # 800 rows / 20-row groups
+    assert len(complete_lineages(
+        spans, required=("ventilate", "decode", "pull"))) == 40
+
+    # per-batch critical-path attribution exists and sums to the batches
+    cp = rep["critical_path"]
+    assert cp["batches"] == 10
+    assert cp["attributed"] >= 1
+    assert sum(cp["counts"].values()) == cp["attributed"]
+
+    # the CLI converts the exported snapshot into valid Chrome-trace JSON
+    from petastorm_tpu.telemetry.__main__ import main
+    snap_path = str(tmp_path / "mesh_snap.json")
+    write_snapshot(snap_path, snap)
+    out = str(tmp_path / "mesh_trace.json")
+    assert main(["trace", snap_path, "--out", out]) == 0
+    printed = capsys.readouterr().out
+    assert "critical path" in printed
+    ct = json.loads(open(out).read())
+    procs = {e["args"]["name"] for e in ct["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {f"host{h}" for h in range(8)} <= procs
+    threads = {e["args"]["name"] for e in ct["traceEvents"]
+               if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"pull", "ventilator", "worker:0", "assemble",
+            "stager"} <= threads
+    # every non-metadata event is well-formed
+    for e in ct["traceEvents"]:
+        assert e["ph"] in ("M", "X", "i")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and "ts" in e

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Lint guard: hot-path stage entry points must run under a named span.
+
+The trace plane (docs/observability.md "Trace plane") only works if every
+pipeline stage's entry point records a named recorder span — a stage that
+silently stops spanning disappears from Chrome-trace exports and from the
+per-stage self-time counters the critical-path attributor reads, and
+nothing else fails. This AST check pins the contract: each registered
+entry-point function must contain at least one ``*.span(...)`` /
+``traced_span(...)`` call (directly, not via some helper the check cannot
+see), and the registry below must stay in sync with the code — a missing
+FILE or FUNCTION fails the lint loudly instead of rotting silently.
+
+A function may opt out with a ``span-ok`` comment on its ``def`` line when
+spanning genuinely moved elsewhere (say why in the comment).
+
+Usage::
+
+    python tools/check_spans.py            # check the registered set
+    python tools/check_spans.py --list     # print the registry
+
+Exit code 1 on any violation (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: file -> qualified function names whose bodies must contain a span call.
+#: These are the trace plane's stage entry points: ventilation, fetch,
+#: decode (thread + inline pools), transport (both process-pool polls),
+#: consumer delivery, loader staging, and the mesh pull/assemble plane.
+ENTRY_POINTS = {
+    "petastorm_tpu/reader.py": [
+        "Reader._make_ventilate_fn",            # stage: ventilate
+        "_PoolWaitTimer._timed_get_results",    # stage: deliver
+    ],
+    "petastorm_tpu/reader_impl/readahead.py": [
+        "ReadaheadFetcher._fetch_loop",         # stage: fetch
+    ],
+    "petastorm_tpu/workers_pool/thread_pool.py": [
+        "_WorkerThread._loop",                  # stage: decode
+    ],
+    "petastorm_tpu/workers_pool/dummy_pool.py": [
+        "DummyPool.get_results",                # stage: decode (inline)
+    ],
+    "petastorm_tpu/workers_pool/process_pool.py": [
+        "ProcessPool._deserialize_timed",       # stage: transport
+    ],
+    "petastorm_tpu/jax/loader.py": [
+        "LoaderBase._prefetched",               # stage: stage (staging)
+    ],
+    "petastorm_tpu/jax/mesh_loader.py": [
+        "MeshDataLoader._run_source",           # stage: pull
+        "MeshDataLoader._epoch_batches",        # stage: assemble
+    ],
+}
+
+WAIVER = "span-ok"
+_SPAN_CALL_NAMES = {"span", "traced_span", "record_event"}
+
+
+def _qualified_functions(tree: ast.AST):
+    """Yield (qualname, node) for every function, including methods and
+    functions nested one level down (closures like ventilate_fn count as
+    part of their enclosing factory's body, which is what we scan)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{item.name}", item
+        elif isinstance(node, ast.Module):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield item.name, item
+
+
+def _has_span_call(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SPAN_CALL_NAMES:
+            return True
+        if isinstance(fn, ast.Name) and fn.id in _SPAN_CALL_NAMES:
+            return True
+    return False
+
+
+def check_file(path: str, required: list, repo_root: str) -> list:
+    full = os.path.join(repo_root, path)
+    try:
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return [f"{path}: registered in check_spans but unreadable ({e}) — "
+                f"update ENTRY_POINTS"]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: "
+                f"{e.msg}"]
+    lines = source.splitlines()
+    functions = dict(_qualified_functions(tree))
+    violations = []
+    for qualname in required:
+        node = functions.get(qualname)
+        if node is None:
+            violations.append(
+                f"{path}: entry point {qualname} not found — the trace "
+                f"plane's stage registry (tools/check_spans.py) is out of "
+                f"sync with the code")
+            continue
+        def_line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if WAIVER in def_line:
+            continue
+        if not _has_span_call(node):
+            violations.append(
+                f"{path}:{node.lineno}: {qualname} is a pipeline stage "
+                f"entry point but records no named span — wrap the stage "
+                f"in registry.span(...)/traced_span(...) (or waive with "
+                f"'# {WAIVER}: <why>' on the def line)")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if argv and argv[0] == "--list":
+        for path, fns in ENTRY_POINTS.items():
+            for fn in fns:
+                print(f"{path}: {fn}")
+        return 0
+    all_violations = []
+    checked = 0
+    for path, required in ENTRY_POINTS.items():
+        all_violations.extend(check_file(path, required, repo_root))
+        checked += len(required)
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_spans: {len(all_violations)} violation(s) across "
+              f"{checked} entry point(s)", file=sys.stderr)
+        return 1
+    print(f"check_spans: {checked} stage entry point(s) spanned")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
