@@ -754,6 +754,76 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"readahead phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4f3a2. batch-native epoch plane (docs/io.md "Batch-native
+    # plane"): the make_reader ROW pipeline, eager vs lazy materialization,
+    # on a petastorm-written scalar store. Eager builds one dict + one
+    # namedtuple per sample and shuffles row objects one at a time; lazy
+    # publishes one ColumnarBatch per row group, shuffles permuted SLICES
+    # (BatchShufflingBuffer), and collates concat-of-slices — the
+    # per-sample Python loops this round retired. Reported as absolute
+    # rates (auto-joining the bench_compare regression surface via the
+    # _samples_per_sec suffix) plus the lazy/eager ratio; the shuffled
+    # variant exercises the mixing-radius path end to end.
+    batch_native_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from petastorm_tpu.codecs import ScalarCodec\n"
+        "from petastorm_tpu.etl.writer import materialize_dataset_local\n"
+        "from petastorm_tpu.jax import DataLoader\n"
+        "from petastorm_tpu.reader import make_reader\n"
+        "from petastorm_tpu.unischema import Unischema, UnischemaField\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'rowplane_50k')\n"
+        "url = 'file://' + store\n"
+        "if not os.path.exists(os.path.join(store, '_common_metadata')):\n"
+        "    fields = [UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False)]\n"
+        "    fields += [UnischemaField('f%d' % i, np.float32, (),\n"
+        "                              ScalarCodec(np.float32), False)\n"
+        "               for i in range(8)]\n"
+        "    schema = Unischema('RowPlane', fields)\n"
+        "    n, rng = 50_000, np.random.default_rng(0)\n"
+        "    rows = [dict({'id': i},\n"
+        "                 **{'f%d' % j: np.float32(rng.standard_normal())\n"
+        "                    for j in range(8)}) for i in range(n)]\n"
+        "    with materialize_dataset_local(url, schema,\n"
+        "                                   rows_per_row_group=2048,\n"
+        "                                   rows_per_file=16384) as w:\n"
+        "        w.write_rows(rows)\n"
+        "def epoch(mode, shuffle_cap, batches=120):\n"
+        "    with make_reader(url, num_epochs=None, shuffle_row_groups=False,\n"
+        "                     reader_pool_type='thread', workers_count=3,\n"
+        "                     row_materialization=mode) as r:\n"
+        "        with DataLoader(r, batch_size=1024, seed=0,\n"
+        "                        shuffling_queue_capacity=shuffle_cap) as dl:\n"
+        "            it = iter(dl)\n"
+        "            for _ in range(10):\n"
+        "                next(it)\n"
+        "            t0 = time.perf_counter()\n"
+        "            for _ in range(batches):\n"
+        "                next(it)\n"
+        "            return batches * 1024 / (time.perf_counter() - t0)\n"
+        "epoch('eager', 0, batches=30)  # warm-up pays import + fs costs\n"
+        "eager, lazy, lazy_shuf = [], [], []\n"
+        "for _ in range(2):  # interleaved so host drift hits both modes\n"
+        "    eager.append(epoch('eager', 0))\n"
+        "    lazy.append(epoch('lazy', 0))\n"
+        "    lazy_shuf.append(epoch('lazy', 8192))\n"
+        "e, l, ls = max(eager), max(lazy), max(lazy_shuf)\n"
+        "print('BENCHJSON:' + json.dumps({'batch_native_epoch': {\n"
+        "    'batch_native_eager_samples_per_sec': round(e, 1),\n"
+        "    'batch_native_lazy_samples_per_sec': round(l, 1),\n"
+        "    'batch_native_lazy_shuffled_samples_per_sec': round(ls, 1),\n"
+        "    'lazy_vs_eager': round(l / max(e, 1e-9), 2),\n"
+        "    'runs': {'eager': [round(x, 1) for x in eager],\n"
+        "             'lazy': [round(x, 1) for x in lazy],\n"
+        "             'lazy_shuffled': [round(x, 1) for x in lazy_shuf]}}}))\n")
+    try:
+        out.update(_cpu_subprocess(batch_native_child, data_dir,
+                                   timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"batch_native phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4f3b. trace-plane overhead (docs/observability.md "Trace
     # plane"): the headline scalar columnar epoch with trace mode OFF vs
     # ON (lineage spans minted at ventilation, decode/fetch spans per row

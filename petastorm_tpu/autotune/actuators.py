@@ -132,10 +132,16 @@ class VentilatorDepthActuator(Actuator):
 
 
 class ShuffleTargetActuator(Actuator):
-    """Shuffling-buffer target size. Floor keeps shuffle quality above the
-    buffer's ``min_after_retrieve``; ceiling is the construction-time
-    capacity (the batched buffer's store is pre-allocated at that size, so
-    growth beyond it would force a reallocation mid-epoch)."""
+    """Shuffling-buffer target size, counted in ROWS for every buffer
+    flavor. Floor keeps shuffle quality above the buffer's
+    ``min_after_retrieve``; ceiling is the construction-time capacity (the
+    batched buffer's store is pre-allocated at that size, so growth beyond
+    it would force a reallocation mid-epoch). The batch-native
+    :class:`~petastorm_tpu.reader_impl.shuffling_buffer.
+    BatchShufflingBuffer` admits whole batches, so its LIVE occupancy
+    quantizes up to the row target by at most one row group — the
+    controller's ladder arithmetic stays in rows and composes unchanged
+    (docs/io.md "Batch-native plane")."""
 
     def __init__(self, buf, telemetry=None):
         self._buf = buf

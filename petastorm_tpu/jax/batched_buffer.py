@@ -25,6 +25,7 @@ class BatchedNoopShufflingBuffer:
     def __init__(self, batch_size: int):
         self._batch_size = batch_size
         self._chunks = deque()
+        self._head_off = 0  # rows of chunks[0] already served
         self._size = 0
         self._done = False
 
@@ -41,13 +42,19 @@ class BatchedNoopShufflingBuffer:
         got = 0
         while got < need:
             chunk = self._chunks[0]
-            n = len(next(iter(chunk.values())))
+            off = self._head_off
+            n = len(next(iter(chunk.values()))) - off
             take = min(n, need - got)
             if take == n:
-                parts.append(self._chunks.popleft())
+                parts.append(chunk if off == 0
+                             else {k: v[off:] for k, v in chunk.items()})
+                self._chunks.popleft()
+                self._head_off = 0
             else:
-                parts.append({k: v[:take] for k, v in chunk.items()})
-                self._chunks[0] = {k: v[take:] for k, v in chunk.items()}
+                # Served rows tracked by offset — no remainder-dict rebuild
+                # per split (one dict per PART, not two).
+                parts.append({k: v[off:off + take] for k, v in chunk.items()})
+                self._head_off = off + take
             got += take
         self._size -= need
         if len(parts) == 1:

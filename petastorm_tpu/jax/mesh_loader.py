@@ -65,6 +65,7 @@ import numpy as np
 from petastorm_tpu.errors import NoDataAvailableError
 from petastorm_tpu.jax.dtypes import sanitize_batch
 from petastorm_tpu.jax.loader import LoaderBase
+from petastorm_tpu.reader_impl.batch_plane import ColumnarBatch
 
 logger = logging.getLogger(__name__)
 
@@ -520,7 +521,20 @@ class MeshDataLoader(LoaderBase):
         stage_base = {"decode": 0.0, "fetch": 0.0, "transport": 0.0,
                       "groups": -1}
         try:
-            it = iter(reader)
+            if getattr(reader, "row_materialization", "eager") == "lazy":
+                # Batch-native pulls (docs/io.md): one ColumnarBatch per
+                # row group off next_batch() — N-row parts instead of N
+                # 1-row parts, same delivery-watermark semantics as any
+                # non-FIFO row source (never-loss / bounded-dup).
+                def _batches():
+                    while True:
+                        try:
+                            yield reader.next_batch()
+                        except StopIteration:
+                            return
+                it = _batches()
+            else:
+                it = iter(reader)
             while True:
                 if feed.killed.is_set():
                     raise _HostKilled(f"host {feed.idx} killed")
@@ -729,7 +743,12 @@ class MeshDataLoader(LoaderBase):
                         item) -> Optional[_Part]:
         try:
             with self._collate_lock:
-                if hasattr(item, "_fields"):
+                if isinstance(item, ColumnarBatch):
+                    # Batch-native plane (docs/io.md): lazy row readers
+                    # hand whole decoded row groups over as columns — the
+                    # per-host pull moves one batch, not N 1-row parts.
+                    cols = self._lazy_batch_columns(item)
+                elif hasattr(item, "_fields"):
                     if src.reader.batched_output:
                         cols = self._batchable_columns(item)
                     else:
@@ -739,8 +758,8 @@ class MeshDataLoader(LoaderBase):
                 else:
                     raise TypeError(
                         f"mesh host reader yielded {type(item).__name__}; "
-                        f"expected a namedtuple or an NGram dense window "
-                        f"dict")
+                        f"expected a namedtuple, a ColumnarBatch, or an "
+                        f"NGram dense window dict")
                 if not cols:
                     return None
                 rows = len(next(iter(cols.values())))
@@ -761,6 +780,34 @@ class MeshDataLoader(LoaderBase):
             # on every survivor (observed as a reshard storm otherwise).
             raise _ConfigError(e) from e
         return _Part(feed.idx, cols, rows, src)
+
+    def _lazy_batch_columns(self, batch: ColumnarBatch) -> Dict[str, np.ndarray]:
+        """One ColumnarBatch -> batchable columns, vectorized: ndarray
+        columns pass straight through; list columns stack once (skipped
+        with the standard warning when null/ragged/non-numeric, like the
+        row path)."""
+        cols, skipped = {}, []
+        for name, col in batch.columns.items():
+            if isinstance(col, np.ndarray):
+                if col.dtype == object or col.dtype.kind in "US":
+                    skipped.append(name)
+                else:
+                    cols[name] = col
+                continue
+            try:
+                if any(v is None for v in col):
+                    skipped.append(name)
+                    continue
+                arr = np.stack([np.asarray(v) for v in col])
+            except (TypeError, ValueError):
+                skipped.append(name)
+                continue
+            if arr.dtype == object or arr.dtype.kind in "US":
+                skipped.append(name)
+            else:
+                cols[name] = arr
+        self._warn_skipped_fields(skipped)
+        return cols
 
     def _row_columns(self, row) -> Dict[str, np.ndarray]:
         """One row-reader namedtuple -> 1-row column dict (strings/objects

@@ -29,6 +29,8 @@ import hashlib
 import math
 from typing import Callable, Optional, Sequence
 
+import numpy as np
+
 
 def _is_nan(v) -> bool:
     try:
@@ -152,6 +154,20 @@ class FieldDomain:
         return False
 
 
+_NUMERIC_SCALARS = (bool, int, float, np.bool_, np.integer, np.floating)
+
+
+def _batch_column(values):
+    """Normalize one predicate column to an ndarray the vectorized mask
+    kernels can reason about; ``None`` when it cannot be vectorized with
+    semantics identical to the per-row path (object dtype — mixed types,
+    ``None`` cells, memoryviews — keeps the exact scalar ``do_include``)."""
+    arr = values if isinstance(values, np.ndarray) else np.asarray(values)
+    if arr.dtype == object or arr.ndim != 1:
+        return None
+    return arr
+
+
 class PredicateBase:
     def get_fields(self) -> set:
         """Names of the fields ``do_include`` reads."""
@@ -160,6 +176,19 @@ class PredicateBase:
     def do_include(self, values: dict) -> bool:
         """Decide inclusion given ``{field_name: value}`` for one row."""
         raise NotImplementedError
+
+    def do_include_batch(self, columns: dict) -> Optional[np.ndarray]:
+        """Vectorized row mask over whole columns — the batch-native plane's
+        L2 kernel (docs/io.md "Batch-native plane"). ``columns`` maps each
+        ``get_fields()`` name to a per-row sequence (decoded values, one
+        entry per row); the return is a boolean ndarray with ``mask[i] ==
+        do_include(row_i)`` for EVERY row, or ``None`` when no vectorized
+        evaluation with exactly those semantics exists (the base default,
+        and the only honest answer for ``in_lambda``). ``None`` falls back
+        to the per-row loop with zero behavior change — a kernel that is
+        ever *almost* right silently changes which rows a seeded epoch
+        delivers, so subclasses must return ``None`` on any doubt."""
+        return None
 
     def intervals(self) -> Optional[list]:
         """Conjunctive ``[(field_name, FieldDomain), ...]`` constraints
@@ -186,6 +215,54 @@ class in_set(PredicateBase):
 
     def do_include(self, values):
         return values[self._field] in self._values
+
+    def do_include_batch(self, columns):
+        col = _batch_column(columns[self._field])
+        if col is None:
+            return None
+        # Only same-kind reference values can ever match a typed column
+        # (set membership hashes across int/float/bool but never across
+        # numeric/string), so cross-kind values drop from the reference —
+        # exactly the rows-never-match outcome of the scalar path.
+        vals = [v for v in self._values if v is not None]
+        try:
+            if col.dtype.kind in "biuf":
+                vals = [v for v in vals if isinstance(v, _NUMERIC_SCALARS)]
+                if not vals:
+                    return np.zeros(len(col), dtype=bool)
+                ref = np.asarray(vals)
+                if col.dtype.kind in "iu" and ref.dtype.kind == "f":
+                    # Exactness guard: int-column cells compare equal only
+                    # to integral floats, and routing through float64 would
+                    # alias ints past 2**53 — compare in int64 instead.
+                    vals = [int(v) for v in vals if float(v).is_integer()]
+                    if not vals:
+                        return np.zeros(len(col), dtype=bool)
+                    ref = np.asarray(vals, dtype=np.int64)
+                elif col.dtype.kind == "f" and ref.dtype.kind in "iu":
+                    # Symmetric exactness guard: a float cell can only
+                    # equal an int reference the float type represents
+                    # EXACTLY — np.isin's int->float64 promotion would
+                    # alias refs past 2**53 and wrongly match. Keep the
+                    # exactly-representable refs (as float64, lossless);
+                    # the rest can never equal any float64 cell.
+                    vals = [v for v in vals if float(v) == v]
+                    if not vals:
+                        return np.zeros(len(col), dtype=bool)
+                    ref = np.asarray(vals, dtype=np.float64)
+                if ref.dtype == object or ref.dtype.kind not in "biuf":
+                    return None
+                return np.isin(col, ref)
+            if col.dtype.kind == "U":
+                vals = [v for v in vals if isinstance(v, (str, np.str_))]
+                if not vals:
+                    return np.zeros(len(col), dtype=bool)
+                return np.isin(col, np.asarray(vals))
+        except (TypeError, ValueError, OverflowError):
+            return None
+        # datetimes/bytes/...: per-row semantics are subtler (an S-dtype
+        # array even strips trailing NULs, so bytes can't ride np.isin).
+        return None
 
     def intervals(self):
         return [(self._field,
@@ -231,6 +308,46 @@ class in_range(PredicateBase):
                                    and not self._include_upper):
                 return False
         return True
+
+    def do_include_batch(self, columns):
+        col = _batch_column(columns[self._field])
+        if col is None:
+            return None
+        kind = col.dtype.kind
+        bounds = [b for b in (self._lower, self._upper) if b is not None]
+        if kind in "biuf":
+            if not all(isinstance(b, _NUMERIC_SCALARS) for b in bounds):
+                return None
+        elif kind == "U":
+            if not all(isinstance(b, (str, np.str_)) for b in bounds):
+                return None
+        else:
+            # bytes ('S') columns excluded like datetimes: numpy S-arrays
+            # strip trailing NULs and cross-compare with str differently
+            # than the scalar path would.
+            return None
+        mask = np.ones(len(col), dtype=bool)
+        # Mirror the scalar path as NEGATED EXCLUSIONS, not inclusions:
+        # do_include tests ``v < lower`` etc. and a NaN cell fails every
+        # comparison, so the scalar path KEEPS non-float64 NaNs (np.float32
+        # is not a ``float`` subclass, so _is_nan never fires for it) —
+        # ``mask &= col >= lo`` would silently drop them instead.
+        try:
+            if self._lower is not None:
+                mask &= ~(col < self._lower if self._include_lower
+                          else col <= self._lower)
+            if self._upper is not None:
+                mask &= ~(col > self._upper if self._include_upper
+                          else col >= self._upper)
+        except TypeError:
+            return None
+        if col.dtype == np.float64:
+            # Only float64 cells reach the scalar _is_nan exclusion
+            # (np.float64 subclasses float); narrower floats keep NaNs
+            # through the negated comparisons above, exactly like the
+            # scalar path.
+            mask &= ~np.isnan(col)
+        return mask
 
     def intervals(self):
         return [(self._field,
@@ -282,6 +399,10 @@ class in_negate(PredicateBase):
     def do_include(self, values):
         return not self._predicate.do_include(values)
 
+    def do_include_batch(self, columns):
+        mask = self._predicate.do_include_batch(columns)
+        return None if mask is None else ~mask
+
 
 class in_reduce(PredicateBase):
     """Combine predicates with a reduce function (e.g. ``all``/``any`` over
@@ -299,6 +420,23 @@ class in_reduce(PredicateBase):
 
     def do_include(self, values):
         return self._reduce([p.do_include(values) for p in self._predicates])
+
+    def do_include_batch(self, columns):
+        """``all``/``any`` compose member masks with vectorized and/or
+        (the reduce sees a full member-decision list per row either way, so
+        the composition is exact); any member without a kernel — or an
+        opaque reduce function — falls the whole predicate back."""
+        if self._reduce not in (all, any) or not self._predicates:
+            return None
+        masks = []
+        for p in self._predicates:
+            m = p.do_include_batch(columns)
+            if m is None:
+                return None
+            masks.append(m)
+        if self._reduce is all:
+            return np.logical_and.reduce(masks)
+        return np.logical_or.reduce(masks)
 
     def intervals(self):
         """AND-composition (``reduce_func is all``) concatenates member

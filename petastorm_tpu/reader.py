@@ -38,6 +38,7 @@ from petastorm_tpu.etl.dataset_metadata import (DatasetContext, get_schema,
                                                 infer_or_load_unischema,
                                                 load_row_groups)
 from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader_impl.batch_plane import ColumnarBatch
 from petastorm_tpu.reader_impl.batch_reader_worker import (BatchReaderWorker,
                                                            arrow_table_to_numpy_dict)
 from petastorm_tpu.reader_impl.row_reader_worker import RowReaderWorker
@@ -353,7 +354,8 @@ def make_reader(dataset_url,
                 rowgroup_pruning: bool = True,
                 readahead_depth: Optional[int] = None,
                 readahead_max_bytes: Optional[int] = None,
-                rowgroup_subset: Optional[Sequence[int]] = None):
+                rowgroup_subset: Optional[Sequence[int]] = None,
+                row_materialization: str = "eager"):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -468,6 +470,18 @@ def make_reader(dataset_url,
         ordinals compose with predicate/selector/statistics pruning
         (which still run after the restriction) and are mutually
         exclusive with ``cur_shard`` — a subset IS a shard assignment.
+    :param row_materialization: ``'eager'`` (default — per-row dicts are
+        built inside the workers, byte-identical to every earlier round)
+        or ``'lazy'`` — the batch-native epoch plane (docs/io.md): workers
+        publish ONE columnar batch per row group, ``__next__`` yields rows
+        as *views* into the shared batch (cells index the batch's column
+        stacks — holding a row pins its batch, writing a cell writes the
+        batch), and :meth:`Reader.next_batch` exposes whole batches so the
+        JAX loaders collate by slicing columns instead of looping rows.
+        Same rows, same per-epoch multiset under a seed; the per-sample
+        Python loops just never run. Falls back to eager (with a warning)
+        for NGram readers and per-row ``TransformSpec`` funcs
+        (``TransformSpec(batched=True)`` composes with lazy).
 
     Parity: reference reader.py:60.
     """
@@ -537,7 +551,8 @@ def make_reader(dataset_url,
                   rowgroup_pruning=rowgroup_pruning,
                   readahead_depth=readahead_depth,
                   readahead_max_bytes=readahead_max_bytes,
-                  rowgroup_subset=rowgroup_subset)
+                  rowgroup_subset=rowgroup_subset,
+                  row_materialization=row_materialization)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -724,7 +739,7 @@ class Reader:
                  hedge_policy=None, hang_timeout_s=None,
                  rowgroup_pruning=True, readahead_depth=None,
                  readahead_max_bytes=None, pool_factory=None,
-                 rowgroup_subset=None):
+                 rowgroup_subset=None, row_materialization="eager"):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -794,6 +809,34 @@ class Reader:
             self.schema = transform_schema(view_schema, transform_spec)
         else:
             self.schema = view_schema
+
+        # ---------------- batch-native plane (docs/io.md)
+        #: ``'lazy'`` when workers publish columnar batches and rows are
+        #: views (``make_reader(row_materialization=...)``); always
+        #: ``'eager'`` for batched readers (their payload is already a
+        #: whole columnar row group — :meth:`next_batch` works either way).
+        self.row_materialization = "eager"
+        if not is_batched_reader:
+            if row_materialization not in ("eager", "lazy"):
+                raise ValueError(
+                    f"row_materialization must be 'eager' or 'lazy', got "
+                    f"{row_materialization!r}")
+            if row_materialization == "lazy":
+                if self.ngram is not None:
+                    warnings.warn(
+                        "row_materialization='lazy' does not apply to NGram "
+                        "readers (windows are assembled per sample); "
+                        "falling back to eager")
+                elif (transform_spec is not None
+                      and transform_spec.func is not None
+                      and not getattr(transform_spec, "batched", False)):
+                    warnings.warn(
+                        "row_materialization='lazy' needs a batch-native "
+                        "TransformSpec (batched=True, columns in/columns "
+                        "out) — a per-row func forces per-row "
+                        "materialization; falling back to eager")
+                else:
+                    self.row_materialization = "lazy"
 
         # ---------------- row-group planning
         #: Plan-time pruning provenance — filled by the selector pass and
@@ -1001,6 +1044,9 @@ class Reader:
             # workers; see the readahead block above).
             "readahead": self.readahead,
             "resilience_telemetry": self.telemetry,
+            # Batch-native plane: lazy workers publish ColumnarBatch
+            # payloads (docs/io.md); validated above.
+            "row_materialization": self.row_materialization,
         }
         worker_args = (self._spawnable_worker_args()
                        if isinstance(self._pool, ProcessPool)
@@ -1634,6 +1680,29 @@ class Reader:
             self.last_row_consumed = True
             raise StopIteration
 
+    def next_batch(self):
+        """Next whole decoded unit as COLUMNS — the batch-native consumer
+        API (docs/io.md "Batch-native plane"). For batched readers: the
+        row group's ``{column: ndarray}`` dict (the same arrays
+        ``__next__`` would wrap in a namedtuple). For
+        ``row_materialization='lazy'`` row readers: the worker's
+        :class:`~petastorm_tpu.reader_impl.batch_plane.ColumnarBatch`
+        (a partially row-iterated batch yields its remainder, so mixing
+        ``__next__`` and ``next_batch`` never duplicates rows). Raises
+        ``StopIteration`` at end of stream like ``__next__``; eager row
+        readers raise ``TypeError`` — there is no batch payload to
+        expose."""
+        if self._migration_error is not None \
+                and not self._results_reader.has_buffered():
+            raise self._migration_error
+        if self._pending_pool_target is not None:
+            self._perform_pool_migration()
+        try:
+            return self._results_reader.read_next_batch()
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration
+
     def next(self):
         return self.__next__()
 
@@ -1847,7 +1916,14 @@ class _PoolWaitTimer:
 
 class _RowResultsReader(_PoolWaitTimer):
     """Buffers published row lists; yields one namedtuple (or ngram dict of
-    namedtuples) per ``read_next`` (parity: py_dict_reader_worker.py:64-97)."""
+    namedtuples) per ``read_next`` (parity: py_dict_reader_worker.py:64-97).
+
+    Lazy-mode payloads (:class:`~petastorm_tpu.reader_impl.batch_plane.
+    ColumnarBatch`, docs/io.md) are held WHOLE: ``read_next`` serves rows
+    as namedtuples of views into the shared columns (one cursor advance,
+    no per-row dict), and ``read_next_batch`` hands the batch over
+    untouched. Rows-counter credit for a batch lands once, at adoption —
+    batch-granular accounting instead of a locked add per row."""
 
     def __init__(self, pool, schema, ngram, telemetry=None, watchdog=None):
         super().__init__(pool, telemetry, watchdog=watchdog)
@@ -1856,19 +1932,88 @@ class _RowResultsReader(_PoolWaitTimer):
         self._buffer = deque()
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)
+        self._telemetry_reg = telemetry
+        self._rows_per_op = None
+        # Lazy-mode cursor state: the adopted batch, its per-field column
+        # list (aligned with the namedtuple fields; None for fields the
+        # batch lacks), and the next row to serve.
+        self._batch = None
+        self._batch_cols = None
+        self._batch_pos = 0
 
     def has_buffered(self) -> bool:
-        return bool(self._buffer) or super().has_buffered()
+        return (bool(self._buffer) or self._batch is not None
+                or super().has_buffered())
+
+    def _adopt(self, batch) -> None:
+        tt = self._schema.namedtuple
+        self._batch = batch
+        self._batch_cols = [batch.columns.get(name) for name in tt._fields]
+        self._batch_pos = 0
+        if self._rows is not None:
+            self._rows.add(batch.num_rows)
+        if self._telemetry_reg is not None:
+            if self._rows_per_op is None:
+                self._rows_per_op = self._telemetry_reg.histogram(
+                    "batch.rows_per_op")
+            self._rows_per_op.observe(batch.num_rows)
+
+    def _batch_remainder(self):
+        """The adopted batch's unserved rows as a ColumnarBatch (views of
+        the column storage when partially row-iterated)."""
+        from petastorm_tpu.reader_impl.batch_plane import ColumnarBatch
+        batch, pos = self._batch, self._batch_pos
+        self._batch = None
+        self._batch_cols = None
+        if pos == 0:
+            return batch
+        return ColumnarBatch({name: col[pos:]
+                              for name, col in batch.columns.items()},
+                             batch.num_rows - pos)
 
     def read_next(self):
-        while not self._buffer:
-            self._buffer.extend(self.get_results())
-        item = self._buffer.popleft()
-        if self._rows is not None:
-            self._rows.add(1)
-        if self._ngram is not None:
-            return item  # already {offset: namedtuple}
-        return self._schema.make_namedtuple_from_dict(item)
+        while True:
+            if self._batch is not None:
+                i = self._batch_pos
+                tt = self._schema.namedtuple
+                row = tt(*[None if c is None else c[i]
+                           for c in self._batch_cols])
+                self._batch_pos = i + 1
+                if self._batch_pos >= self._batch.num_rows:
+                    self._batch = None
+                    self._batch_cols = None
+                return row
+            if self._buffer:
+                item = self._buffer.popleft()
+                if self._rows is not None:
+                    self._rows.add(1)
+                if self._ngram is not None:
+                    return item  # already {offset: namedtuple}
+                return self._schema.make_namedtuple_from_dict(item)
+            result = self.get_results()
+            if isinstance(result, ColumnarBatch):
+                if result.num_rows:
+                    self._adopt(result)
+            else:
+                self._buffer.extend(result)
+
+    def read_next_batch(self):
+        """Next whole ColumnarBatch (lazy mode); a batch partially served
+        through ``read_next`` yields its remainder first."""
+        while True:
+            if self._batch is not None:
+                return self._batch_remainder()
+            if self._buffer:
+                raise TypeError(
+                    "next_batch() needs "
+                    "make_reader(row_materialization='lazy'); this reader's "
+                    "workers publish per-row payloads")
+            result = self.get_results()
+            if isinstance(result, ColumnarBatch):
+                if result.num_rows:
+                    self._adopt(result)
+            elif result:
+                self._buffer.extend(result)
 
 
 class _BatchResultsReader(_PoolWaitTimer):
@@ -1880,8 +2025,10 @@ class _BatchResultsReader(_PoolWaitTimer):
         self._schema = schema
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)
+        self._telemetry_reg = telemetry
+        self._rows_per_op = None
 
-    def read_next(self):
+    def _next_columns(self) -> dict:
         result = self.get_results()
         if not isinstance(result, dict):
             # Payload shape depends on convert_early_to_numpy, not pool type:
@@ -1891,4 +2038,19 @@ class _BatchResultsReader(_PoolWaitTimer):
             result = arrow_table_to_numpy_dict(result, self._schema)
         if self._rows is not None and result:
             self._rows.add(len(next(iter(result.values()))))
-        return self._schema.make_namedtuple_from_dict(result)
+        return result
+
+    def read_next(self):
+        return self._schema.make_namedtuple_from_dict(self._next_columns())
+
+    def read_next_batch(self) -> dict:
+        """The next row group's raw column dict — the batch-native consumer
+        path (docs/io.md): no namedtuple wrap, no per-field getattr walk in
+        the loaders."""
+        result = self._next_columns()
+        if self._telemetry_reg is not None and result:
+            if self._rows_per_op is None:
+                self._rows_per_op = self._telemetry_reg.histogram(
+                    "batch.rows_per_op")
+            self._rows_per_op.observe(len(next(iter(result.values()))))
+        return result

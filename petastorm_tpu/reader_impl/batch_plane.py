@@ -1,0 +1,112 @@
+"""Batch-native epoch plane primitives (docs/io.md "Batch-native plane").
+
+The plane's unit of motion is a :class:`ColumnarBatch`: one decoded row
+group's columns, kept columnar from the worker all the way to device
+staging. ``make_reader(row_materialization='lazy')`` publishes these
+instead of per-row dicts; consumers that understand batches (the JAX
+loaders, the mesh ingestion plane) move whole columns with vectorized
+slice/take/concat ops, and consumers that want rows get *views* into the
+shared columns — a namedtuple whose array cells index into the batch's
+``(n, *shape)`` stacks, built only at the moment a row is actually asked
+for.
+
+Lifetime rule (documented in docs/io.md): a lazy row's array cells alias
+the batch's column storage, so holding any one row pins the whole batch's
+columns in memory, and writing through a cell writes the batch. Consumers
+that retain or mutate rows long-term should copy (``np.copy(cell)``) —
+exactly the contract the zero-copy shm transport already set for batched
+readers (docs/zero_copy.md).
+
+:func:`evaluate_predicate_mask` is the L2 entry point both reader workers
+share: one vectorized mask per row group through
+:meth:`~petastorm_tpu.predicates.PredicateBase.do_include_batch`, with a
+per-row fallback (identical semantics) for predicates that declare no
+kernel.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+class ColumnarBatch:
+    """One decoded row group as ``{column: per-row values}``.
+
+    Columns are numpy arrays on the fast paths (scalar casts, stacked
+    ndarray/image decodes) and plain lists for per-cell codec fallbacks
+    (strings, Decimals, user codecs) — the same cell types the eager row
+    path produces, just not exploded into per-row dicts. Picklable, so a
+    lazy reader works over the process pool too (the columns cross the
+    boundary once, as whole arrays, instead of as N row dicts)."""
+
+    __slots__ = ("columns", "num_rows")
+
+    def __init__(self, columns: Dict[str, object],
+                 num_rows: Optional[int] = None):
+        if num_rows is None:
+            num_rows = len(next(iter(columns.values()))) if columns else 0
+        self.columns = columns
+        self.num_rows = int(num_rows)
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __reduce__(self):
+        return (ColumnarBatch, (self.columns, self.num_rows))
+
+    def row_dict(self, i: int) -> dict:
+        """One row as a dict (the eager payload shape) — cells are views/
+        items of the column storage, not copies."""
+        return {name: col[i] for name, col in self.columns.items()}
+
+    def take(self, indices) -> "ColumnarBatch":
+        """Vectorized row selection: one fancy-index per ndarray column
+        (which copies, detaching the result from this batch's storage);
+        list columns select per cell."""
+        idx = np.asarray(indices, dtype=np.intp)
+        cols = {}
+        for name, col in self.columns.items():
+            if isinstance(col, np.ndarray):
+                cols[name] = col[idx]
+            else:
+                cols[name] = [col[i] for i in idx]
+        return ColumnarBatch(cols, len(idx))
+
+
+def evaluate_predicate_mask(predicate, columns: Dict[str, object],
+                            num_rows: int) -> np.ndarray:
+    """Boolean inclusion mask for ``num_rows`` rows of decoded predicate
+    ``columns`` — ONE vectorized kernel call when the predicate provides
+    one (``do_include_batch``), else a per-row ``do_include`` loop with
+    identical semantics. The mask is positionally aligned with the
+    columns; callers intersect it with their drop-partition/shuffle index
+    selection."""
+    mask = predicate.do_include_batch(columns)
+    if mask is not None:
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (num_rows,):
+            raise ValueError(
+                f"{type(predicate).__name__}.do_include_batch returned a "
+                f"mask of shape {mask.shape} for {num_rows} rows — the "
+                f"kernel must answer for every row")
+        return mask
+    names = list(columns)
+    out = np.empty(num_rows, dtype=bool)
+    for i in range(num_rows):
+        row = {n: columns[n][i] for n in names}  # rowloop-ok: kernel-less predicate fallback
+        out[i] = bool(predicate.do_include(row))
+    return out
+
+
+def concat_column_slices(parts: Sequence[Dict[str, np.ndarray]]
+                         ) -> Dict[str, np.ndarray]:
+    """Concat-of-slices collate: assemble one batch dict from column-dict
+    slices — ONE ``np.concatenate`` per column, no per-row loop. A single
+    part passes through as-is (its slices stay views into their source
+    batch; see the lifetime rule in the module docstring)."""
+    if len(parts) == 1:
+        return parts[0]
+    first = parts[0]
+    return {name: np.concatenate([p[name] for p in parts])
+            for name in first}

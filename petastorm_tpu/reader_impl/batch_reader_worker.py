@@ -19,9 +19,9 @@ import numpy as np
 import pyarrow as pa
 
 from petastorm_tpu.reader_impl.row_reader_worker import (
-    _ParquetFileLRU, _init_latency_defense, deadline_checkpoint,
-    item_shuffle_rng, read_row_group_maybe_hedged, readahead_clear,
-    run_guarded_attempt, select_drop_partition)
+    _ParquetFileLRU, _init_latency_defense, apply_batched_transform,
+    deadline_checkpoint, item_shuffle_rng, read_row_group_maybe_hedged,
+    readahead_clear, run_guarded_attempt, select_drop_partition)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -104,19 +104,31 @@ class BatchReaderWorker(WorkerBase):
             return None
 
         if transform_spec is not None and transform_spec.func is not None:
-            df = table.to_pandas()
-            df = transform_spec.func(df)
-            # Arrow has no multi-dim cell type: ravel tensor cells into flat
-            # lists here; the output conversion reshapes them back via the
-            # schema's declared shape (arrow_table_to_numpy_dict — parity
-            # with reference arrow_reader_worker.py:72-75).
-            for col in df.columns:
-                vals = df[col].values
-                probe = next((v for v in vals if isinstance(v, np.ndarray)), None)
-                if probe is not None and probe.ndim > 1:
-                    df[col] = [v.ravel() if isinstance(v, np.ndarray) else v
-                               for v in vals]
-            table = pa.Table.from_pandas(df, preserve_index=False)
+            if getattr(transform_spec, "batched", False):
+                # Batch-native transform (docs/io.md): columns in, columns
+                # out — no pandas DataFrame round-trip. The func sees the
+                # same numpy columns the consumer would (declared shapes
+                # reassembled), and the output re-tables through the same
+                # ravel rule the DataFrame path uses.
+                cols = apply_batched_transform(
+                    transform_spec,
+                    arrow_table_to_numpy_dict(table, view_schema))
+                table = _table_from_columns(cols)
+            else:
+                df = table.to_pandas()
+                df = transform_spec.func(df)
+                # Arrow has no multi-dim cell type: ravel tensor cells into
+                # flat lists here; the output conversion reshapes them back
+                # via the schema's declared shape (arrow_table_to_numpy_dict
+                # — parity with reference arrow_reader_worker.py:72-75).
+                for col in df.columns:
+                    vals = df[col].values
+                    probe = next((v for v in vals if isinstance(v, np.ndarray)),
+                                 None)
+                    if probe is not None and probe.ndim > 1:
+                        df[col] = [v.ravel() if isinstance(v, np.ndarray) else v
+                                   for v in vals]
+                table = pa.Table.from_pandas(df, preserve_index=False)
 
         # Narrow to the output view (post-transform schema).
         out_schema = self.args.get("output_schema", view_schema)
@@ -146,6 +158,32 @@ class BatchReaderWorker(WorkerBase):
                     key, pa.array([value] * table.num_rows))
         return table
 
+    @staticmethod
+    def _predicate_mask(pred_table: pa.Table, predicate) -> np.ndarray:
+        """Vectorized predicate evaluation on the columnar path (the same
+        L2 mask kernels the row worker uses, docs/io.md): each predicate
+        column converts to numpy ONCE and ``do_include_batch`` answers for
+        the whole row group. Predicates without a kernel keep the exact
+        legacy semantics — a pandas row walk whose cells are the same
+        pandas scalars ``do_include`` always saw here."""
+        if pred_table.num_rows == 0:
+            return np.array([], dtype=bool)
+        columns = {}
+        for name in pred_table.column_names:
+            try:
+                columns[name] = pred_table.column(name).to_numpy(
+                    zero_copy_only=False)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                columns = None
+                break
+        if columns is not None:
+            mask = predicate.do_include_batch(columns)
+            if mask is not None:
+                return np.asarray(mask, dtype=bool)
+        df = pred_table.to_pandas()
+        return df.apply(  # rowloop-ok: kernel-less predicate fallback
+            lambda r: predicate.do_include(r.to_dict()), axis=1).values
+
     def _maybe_cached_table(self, rowgroup, columns, cache):
         # Raw table only — shuffle/slice applied after retrieval so cache
         # hits never freeze or leak shuffle order.
@@ -160,9 +198,7 @@ class BatchReaderWorker(WorkerBase):
         if predicate is not None:
             pred_fields = sorted(predicate.get_fields())
             pred_table = self._read_table(rowgroup, set(pred_fields))
-            df = pred_table.to_pandas()
-            mask = df.apply(lambda r: predicate.do_include(r.to_dict()), axis=1).values \
-                if len(df) else np.array([], dtype=bool)
+            mask = self._predicate_mask(pred_table, predicate)
             if not mask.any():
                 return None
             rest = needed - set(pred_fields)
@@ -182,6 +218,39 @@ class BatchReaderWorker(WorkerBase):
         return table
 
 
+def _table_from_columns(cols: dict) -> pa.Table:
+    """Rebuild an Arrow table from transformed numpy columns: multi-dim
+    tensors ravel per row — whether the column is one stacked ``(n, ...)``
+    array or a list/object column of per-row arrays (Arrow has no
+    multi-dim cell type; the output conversion reshapes them back via the
+    schema's declared shape — same per-cell rule as the DataFrame path)."""
+    arrays = {}
+    for name, v in cols.items():
+        if isinstance(v, np.ndarray):
+            if v.ndim > 1:
+                # Explicit row width instead of -1: a transform that
+                # filtered a group to 0 rows still re-tables (reshape
+                # cannot infer -1 for size-0 arrays).
+                width = int(np.prod(v.shape[1:], dtype=np.int64))
+                arrays[name] = pa.array(list(v.reshape(len(v), width)))
+                continue
+            if v.dtype != object:
+                arrays[name] = pa.array(v)
+                continue
+        # List / object column: ravel multi-dim ndarray CELLS per row,
+        # exactly as the DataFrame path probed and raveled.
+        cells = v
+        probe = next((c for c in cells
+                      if isinstance(c, np.ndarray) and c.ndim > 1), None)
+        if probe is not None:
+            cells = [c.ravel() if isinstance(c, np.ndarray) else c
+                     for c in cells]
+        elif isinstance(cells, np.ndarray):
+            cells = list(cells)
+        arrays[name] = pa.array(cells)
+    return pa.table(arrays)
+
+
 def _numeric_dtype(field):
     """The field's numpy dtype, or None for non-numeric declarations
     (str/bytes/Decimal). Note ``np.float32`` etc. are classes, so a plain
@@ -189,6 +258,23 @@ def _numeric_dtype(field):
     if field.numpy_dtype in (str, bytes, Decimal, np.str_, np.bytes_, np.object_):
         return None
     return np.dtype(field.numpy_dtype)
+
+
+#: Arrow-type -> conversion-kind memo for the converter's hot loop: the
+#: ``pa.types.is_*`` dispatch walk costs several Python calls per column
+#: per row group, and a pipeline sees the same handful of types forever.
+_ARROW_KIND_CACHE: dict = {}
+
+
+def _arrow_column_kind(t) -> str:
+    kind = _ARROW_KIND_CACHE.get(t)
+    if kind is None:
+        kind = ("fsl" if pa.types.is_fixed_size_list(t)
+                else "list" if (pa.types.is_list(t)
+                                or pa.types.is_large_list(t))
+                else "plain")
+        _ARROW_KIND_CACHE[t] = kind
+    return kind
 
 
 def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False) -> dict:
@@ -204,7 +290,7 @@ def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False)
         col = table.column(name)
         field = schema.fields.get(name)
         combined = None
-        if pa.types.is_fixed_size_list(col.type):
+        if _arrow_column_kind(col.type) == "fsl":
             # chunk(0) for the single-chunk case: combine_chunks would copy a
             # sliced chunk to compact it; the raw chunk is zero-copy (its
             # slice offset, if any, routes to the per-row path below).
@@ -227,8 +313,7 @@ def arrow_table_to_numpy_dict(table: pa.Table, schema, force_copy: bool = False)
             if force_copy and arr.base is not None:
                 arr = arr.copy()
             out[name] = arr
-        elif (pa.types.is_list(col.type) or pa.types.is_large_list(col.type)
-              or combined is not None):
+        elif _arrow_column_kind(col.type) == "list" or combined is not None:
             # Variable lists, or fixed-size lists containing nulls (the
             # per-row path tolerates None rows/elements).
             rows = col.to_pylist()

@@ -24,7 +24,8 @@ from typing import List, Optional
 import numpy as np
 import pyarrow.parquet as pq
 
-from petastorm_tpu.utils import decode_row
+from petastorm_tpu.reader_impl.batch_plane import (ColumnarBatch,
+                                                   evaluate_predicate_mask)
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -209,6 +210,27 @@ def readahead_clear(worker) -> None:
     worker._ra_key = None
     worker._ra_table = None
     worker._ra_miss_key = None
+
+
+def apply_batched_transform(transform_spec, cols: dict) -> dict:
+    """Apply a ``TransformSpec(batched=True)`` func to one row group's
+    columns — ONE call per group, columns in, columns out (docs/io.md
+    "Batch-native plane"). Shared by both reader workers. The output must
+    be a dict of equal-length columns; the row count may differ from the
+    input (a batched transform may filter, exactly as the DataFrame path
+    always could)."""
+    out = transform_spec.func(dict(cols))
+    if not isinstance(out, dict):
+        raise TypeError(
+            f"TransformSpec(batched=True) func must return a "
+            f"{{column: values}} dict, got {type(out).__name__}")
+    lengths = {len(v) for v in out.values()}
+    if len(lengths) > 1:
+        raise ValueError(
+            f"TransformSpec(batched=True) func returned ragged columns "
+            f"(lengths {sorted(lengths)}); every column must keep one "
+            f"entry per row")
+    return out
 
 
 def _column_values(col, zero_copy: bool = True):
@@ -397,6 +419,11 @@ class RowReaderWorker(WorkerBase):
             worker_id=worker_id,
             telemetry=args.get("resilience_telemetry"))
         self._fault_plan = args.get("fault_plan")
+        # Batch-native epoch plane (docs/io.md): in lazy mode the worker
+        # publishes ONE ColumnarBatch per row group instead of a list of
+        # per-row dicts; the Reader validated the configuration (no NGram,
+        # no per-row TransformSpec func) at construction.
+        self._lazy = args.get("row_materialization", "eager") == "lazy"
         _init_latency_defense(self, args)
 
     # Lazily build per-process handles (cheap for threads, required for processes).
@@ -455,6 +482,29 @@ class RowReaderWorker(WorkerBase):
         # watchdog-cancelled attempt stops here instead of paying the
         # decode too.
         deadline_checkpoint(self)
+
+        batched_transform = (transform_spec is not None
+                             and transform_spec.func is not None
+                             and getattr(transform_spec, "batched", False))
+        if ngram is None and (self._lazy or batched_transform):
+            # Batch-native assembly (docs/io.md): columns stay columnar
+            # through decode and the batched transform; per-row dicts are
+            # built only for an eager consumer, and a lazy reader skips
+            # them entirely (the consumer indexes the shared columns).
+            if decoded_cache:
+                cols = self._cols_from_decoded(data, indices)
+            else:
+                cols = self._decode_columns(data, indices)
+            if batched_transform:
+                cols = apply_batched_transform(transform_spec, cols)
+            if self._lazy:
+                n = (len(next(iter(cols.values()))) if cols
+                     else 0)
+                return ColumnarBatch(cols, num_rows=n)
+            names = list(cols)
+            n = len(next(iter(cols.values()))) if cols else 0
+            return [{k: cols[k][j] for k in names} for j in range(n)]
+
         if decoded_cache:
             # Memory-tier hit/fill: ``data`` is already post-codec columns
             # over the WHOLE row group — assemble rows by index selection
@@ -616,6 +666,21 @@ class RowReaderWorker(WorkerBase):
         num_rows = len(next(iter(data.values()))) if data else 0
         return self._decode_columns(data, range(num_rows))
 
+    def _cols_from_decoded(self, cols: dict, indices) -> dict:
+        """Select ``indices`` out of cached full-row-group decoded columns,
+        COPYING cells out of the cache (the columnar analog of
+        :meth:`_rows_from_decoded`, same mutation-isolation contract:
+        ndarray fancy-indexing copies by construction; container cells
+        from user codecs deep-copy)."""
+        idx = np.asarray(indices, dtype=np.intp)
+        out = {}
+        for name, col in cols.items():
+            if isinstance(col, np.ndarray):
+                out[name] = col[idx]
+            else:
+                out[name] = [self._copy_cell(col[i]) for i in idx]
+        return out
+
     def _rows_from_decoded(self, cols: dict, indices) -> List[dict]:
         """Assemble row dicts from cached full-row-group decoded columns —
         the hit-path analog of :meth:`_decode_columns_to_rows` (which
@@ -651,11 +716,14 @@ class RowReaderWorker(WorkerBase):
         names = list(cols.keys())
         return [{n: cols[n][j] for n in names} for j in range(len(indices))]
 
-    def _decode_columns(self, data: dict, indices) -> dict:
+    def _decode_columns(self, data: dict, indices, schema=None) -> dict:
         """Codec-decode the selected rows of every needed column; returns
         ``{name: per-row decoded values}`` (list, or ndarray from one of
-        the batched column decoders). Shared by the row path above and the
-        dense NGram path (which stacks these instead of building rows).
+        the batched column decoders). Shared by the row path above, the
+        dense NGram path (which stacks these instead of building rows),
+        and the vectorized predicate path (which passes its own
+        ``schema`` — the predicate fields are not necessarily in the
+        output view).
 
         Batched fast paths (docs/zero_copy.md "one decode per column, not
         per cell"): scalar numeric columns decode as ONE vectorized dtype
@@ -670,7 +738,8 @@ class RowReaderWorker(WorkerBase):
                                                 is_memoryview_safe,
                                                 native_image_eligible)
         cols = {}
-        for name, field, codec in self._decode_schema.decode_plan:
+        plan = (self._decode_schema if schema is None else schema).decode_plan
+        for name, field, codec in plan:
             src = data.get(name)
             if src is None:
                 continue
@@ -725,7 +794,14 @@ class RowReaderWorker(WorkerBase):
                                      drop_part, rng):
         """Load predicate columns first; early-exit if nothing matches
         (parity: reference :197). Returns ``(columns, surviving indices)``
-        so the caller can decode column-major like the no-predicate path."""
+        so the caller can decode column-major like the no-predicate path.
+
+        Evaluation is batch-native (docs/io.md): the predicate columns
+        decode COLUMN-MAJOR (the same batched codec kernels as the output
+        path) and the predicate answers with ONE vectorized mask per row
+        group (``do_include_batch``); predicates without a kernel fall
+        back to per-row ``do_include`` over the same decoded columns —
+        identical decisions, no per-row codec walk either way."""
         schema = self.args["schema"]
         predicate_fields = set(predicate.get_fields())
         unknown = predicate_fields - set(schema.fields.keys()) - {
@@ -735,22 +811,24 @@ class RowReaderWorker(WorkerBase):
 
         pred_data = self._read_columns(rowgroup, predicate_fields)
         num_rows = len(next(iter(pred_data.values()))) if pred_data else 0
-        # Predicates run on *decoded* values.
+        # Predicates run on *decoded* values; partition keys and other
+        # non-schema fields pass through raw, exactly as before.
         pred_schema = schema.create_schema_view(
             [n for n in sorted(predicate_fields) if n in schema.fields])
-        mask = []
-        for i in range(num_rows):
-            row = {n: pred_data[n][i] for n in pred_data}
-            mask.append(predicate.do_include(decode_row(row, pred_schema) |
-                                             {k: v for k, v in row.items()
-                                              if k not in pred_schema.fields}))
-        if not any(mask):
+        decoded = self._decode_columns(pred_data, range(num_rows),
+                                       schema=pred_schema)
+        passthrough = {k: v for k, v in pred_data.items()
+                       if k not in pred_schema.fields}
+        mask = evaluate_predicate_mask(predicate,
+                                       {**passthrough, **decoded}, num_rows)
+        if not mask.any():
             return pred_data, []
 
         part_index, num_parts = drop_part
         indices = select_drop_partition(num_rows, part_index, num_parts,
                                         self.args.get("shuffle_rows", False), rng)
-        indices = [i for i in indices if mask[i]]
+        indices = np.asarray(indices, dtype=np.intp)
+        indices = indices[mask[indices]]
 
         other_fields = needed - predicate_fields
         if other_fields:

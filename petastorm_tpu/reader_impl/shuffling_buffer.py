@@ -127,7 +127,14 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     def add_many(self, items):
         if self._done_adding:
             raise RuntimeError("Cannot add to a finished shuffling buffer")
-        items = list(items)
+        # ONE bulk extend per call: list/tuple inputs (every caller — a
+        # whole row group's rows, or the loader's single-row adds) skip
+        # the defensive copy that made this a second O(n) pass per call,
+        # and generators materialize once. The store grows once per call
+        # (list.extend pre-reserves), not per row — profiled hot on the
+        # scalar bench's per-row add path.
+        if not isinstance(items, (list, tuple)):
+            items = list(items)
         # Guard against the CONFIGURED bound, not the live tuned target: a
         # controller-thread shrink may interleave between the producer's
         # can_add check and this bulk add, and the bulk-add slack contract
@@ -197,5 +204,162 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         sized for the configured bound, so growth past it could overfill.
         Shrinking below the current fill just pauses admission until
         retrieval drains the excess; no buffered row is dropped."""
+        self._capacity = max(self.min_target,
+                             min(int(n), self._configured_capacity))
+
+
+class BatchShufflingBuffer(ShufflingBufferBase):
+    """Batch-native shuffling buffer: holds WHOLE columnar batches and
+    serves shuffled *slices* (docs/io.md "Batch-native plane").
+
+    Where :class:`RandomShufflingBuffer` moves one Python row per
+    ``add``/``retrieve`` (an RNG draw, a swap, and a pop per row), this
+    buffer's unit of work is a column dict: ``add_many`` appends a whole
+    batch (one list append), and a *refill* merges every pending batch
+    into one column pool with a SINGLE vectorized permutation — one
+    ``rng.permutation`` + one fancy-index per column per refill, after
+    which ``retrieve_batch`` is pure zero-copy slicing until the pool
+    drains.
+
+    **Mixing-radius contract** (seeded, documented): a refill permutes
+    exactly the rows buffered at that moment, so a row can land anywhere
+    inside its refill window but never outside it — two rows mix if and
+    only if they are co-resident in one refill. The radius is therefore
+    bounded by ``capacity`` plus one in-flight batch (the bulk-add slack),
+    and *guaranteed* to reach ``min_after_retrieve`` rows: retrieval (and
+    with it the next refill) is gated until that many rows are buffered,
+    exactly the quality floor the per-row buffer enforces. Identical
+    ``(seed, add order)`` always yields the identical output stream —
+    epoch reproducibility survives the vectorization, though the sequence
+    differs from :class:`RandomShufflingBuffer`'s per-row draws (the
+    batch-native plane is multiset-equivalent, not byte-identical, to the
+    eager plane; docs/io.md).
+
+    :param shuffling_buffer_capacity: target resident rows (admission
+        pauses at or above it; one whole batch may land past it)
+    :param min_after_retrieve: minimum rows a refill must mix (until
+        ``finish``) — the shuffle-quality floor
+    :param seed: RNG seed for reproducible permutations
+    """
+
+    def __init__(self, shuffling_buffer_capacity: int,
+                 min_after_retrieve: int = 0,
+                 seed: Optional[int] = None):
+        if min_after_retrieve >= shuffling_buffer_capacity:
+            raise ValueError("min_after_retrieve must be smaller than "
+                             "shuffling_buffer_capacity")
+        self._configured_capacity = int(shuffling_buffer_capacity)
+        self._capacity = int(shuffling_buffer_capacity)
+        self._min_after = int(min_after_retrieve)
+        self._rng = np.random.default_rng(seed)
+        self._pending: list = []          # whole batches awaiting a refill
+        self._pending_rows = 0
+        self._pool: Optional[dict] = None  # permuted columns being served
+        self._pool_pos = 0
+        self._pool_rows = 0
+        self._done_adding = False
+
+    # ------------------------------------------------------------- contract
+    def add_many(self, batch) -> None:
+        """Append one whole batch: a ``{column: ndarray}`` dict or a
+        :class:`~petastorm_tpu.reader_impl.batch_plane.ColumnarBatch`."""
+        if self._done_adding:
+            raise RuntimeError("Cannot add to a finished shuffling buffer")
+        columns = getattr(batch, "columns", batch)
+        n = len(next(iter(columns.values()))) if columns else 0
+        if n == 0:
+            return
+        self._pending.append(columns)
+        self._pending_rows += n
+
+    def retrieve_batch(self, max_rows: int) -> dict:
+        """Up to ``max_rows`` shuffled rows as a column-dict SLICE (views
+        into the permuted pool — zero copies; see the batch-plane lifetime
+        rule). Refills when the pool is drained. Callers assemble exact
+        batch sizes by concatenating successive slices
+        (:func:`~petastorm_tpu.reader_impl.batch_plane.
+        concat_column_slices`)."""
+        if not self.can_retrieve:
+            raise RuntimeError("Cannot retrieve: buffer below "
+                               "min_after_retrieve and not finished, or empty")
+        if self._pool_pos >= self._pool_rows:
+            self._refill()
+        take = min(int(max_rows), self._pool_rows - self._pool_pos)
+        lo, hi = self._pool_pos, self._pool_pos + take
+        self._pool_pos = hi
+        out = {name: col[lo:hi] for name, col in self._pool.items()}
+        if self._pool_pos >= self._pool_rows:
+            # Fully served: drop the pool reference so its memory releases
+            # as soon as the consumer drops the slices.
+            self._pool = None
+            self._pool_rows = self._pool_pos = 0
+        return out
+
+    def retrieve(self):
+        """Single-row retrieval for :class:`ShufflingBufferBase` contract
+        compatibility: a 1-row slice dict. Batch consumers should call
+        :meth:`retrieve_batch`."""
+        return self.retrieve_batch(1)
+
+    def _refill(self) -> None:
+        """Merge every pending batch into one pool and permute it ONCE:
+        one ``np.concatenate`` + one fancy-index per column. This is the
+        mixing window — everything resident right now shuffles together."""
+        if not self._pending:
+            raise RuntimeError("refill with no pending batches")
+        first = self._pending[0]
+        if len(self._pending) == 1:
+            merged = first
+            n = len(next(iter(first.values())))
+        else:
+            merged = {name: np.concatenate([p[name] for p in self._pending])
+                      for name in first}
+            n = len(next(iter(merged.values())))
+        self._pending = []
+        self._pending_rows = 0
+        perm = self._rng.permutation(n)
+        self._pool = {name: np.asarray(col)[perm]
+                      for name, col in merged.items()}
+        self._pool_rows = n
+        self._pool_pos = 0
+
+    def finish(self) -> None:
+        self._done_adding = True
+
+    @property
+    def can_add(self) -> bool:
+        return self.size < self._capacity and not self._done_adding
+
+    @property
+    def can_retrieve(self) -> bool:
+        size = self.size
+        if self._done_adding:
+            return size > 0
+        return size > self._min_after
+
+    @property
+    def size(self) -> int:
+        return self._pending_rows + (self._pool_rows - self._pool_pos)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def min_target(self) -> int:
+        """Smallest target the autotune actuator may set: the mixing
+        quality floor plus one retrievable row."""
+        return self._min_after + 1
+
+    def set_target_capacity(self, n: int) -> None:
+        """Runtime knob over the target resident-row count (autotune's
+        ``shuffle_target`` actuator — the capacity is counted in ROWS even
+        though admission is batch-granular, so the controller's ladder
+        composes unchanged; the live bound quantizes up by at most one
+        batch). Clamped to [min_target, configured capacity]; shrinking
+        below the current fill pauses admission until slicing drains the
+        excess — no buffered row is dropped, and the already-permuted pool
+        keeps serving (a shrink narrows the NEXT mixing window, never an
+        emitted one)."""
         self._capacity = max(self.min_target,
                              min(int(n), self._configured_capacity))

@@ -172,6 +172,20 @@ class TelemetryRegistry:
             h = self._histograms.get(name)
         return 0.0 if h is None else h.sum
 
+    def find_counter(self, name: str):
+        """The live :class:`Counter` object WITHOUT creating it (``None``
+        when absent) — lets per-batch readers like the critical-path
+        attributor cache the object and read ``.value`` lock-free instead
+        of paying a registry-lock ``peek`` per name per batch."""
+        with self._lock:
+            return self._counters.get(name)
+
+    def find_histogram(self, name: str):
+        """The live histogram object without creating it (``None`` when
+        absent); see :meth:`find_counter`."""
+        with self._lock:
+            return self._histograms.get(name)
+
     def record_event(self, name: str, payload: dict) -> None:
         """Append one JSON-safe structured event under ``name`` (cold-path
         provenance that fits neither a counter nor a histogram: watchdog

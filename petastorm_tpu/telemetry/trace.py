@@ -220,6 +220,12 @@ class CriticalPathAttributor:
         # pipeline snapshot.
         self._winners: Dict[str, object] = {}
         self._self_hists: Dict[str, object] = {}
+        # Per-source live metric objects, cached once resolved: the
+        # attributor runs per DELIVERED batch on the consumer path, and a
+        # registry-lock peek per source name per batch is measurable at
+        # batch-native rates (counters/histograms are append-only in the
+        # registry, so a resolved object stays valid forever).
+        self._src_cache: Dict[str, object] = {}
         self._last = self._cumulative()
         self._batches = 0
         self._history: deque = deque(maxlen=max(1, history))
@@ -232,8 +238,25 @@ class CriticalPathAttributor:
             s: registry.peek_counter(f"trace.critical_path.{s}")
             for s in CRITICAL_STAGES}
 
+    def _counter_value(self, name: str) -> float:
+        c = self._src_cache.get(name)
+        if c is None:
+            c = self._registry.find_counter(name)
+            if c is None:
+                return 0.0
+            self._src_cache[name] = c
+        return c.value
+
+    def _histogram_sum(self, name: str) -> float:
+        h = self._src_cache.get(name)
+        if h is None:
+            h = self._registry.find_histogram(name)
+            if h is None:
+                return 0.0
+            self._src_cache[name] = h
+        return h.sum
+
     def _cumulative(self) -> Dict[str, float]:
-        reg = self._registry
         out = {}
         for stage in CRITICAL_STAGES:
             cname = _STAGE_COUNTERS[stage]
@@ -246,11 +269,11 @@ class CriticalPathAttributor:
                 # pools with spans on — plus the mesh loader's per-host
                 # sync (host readers keep private registries). Max of
                 # monotonic counters stays monotonic, so deltas are sound.
-                out[stage] = (max(reg.peek_histogram_sum("worker.decode_s"),
-                                  reg.peek_counter("trace.span.decode_s"))
-                              + reg.peek_counter("mesh.host_decode_s"))
+                out[stage] = (max(self._histogram_sum("worker.decode_s"),
+                                  self._counter_value("trace.span.decode_s"))
+                              + self._counter_value("mesh.host_decode_s"))
             else:
-                out[stage] = reg.peek_counter(cname)
+                out[stage] = self._counter_value(cname)
         return out
 
     def observe_batch(self) -> Optional[str]:

@@ -27,14 +27,28 @@ class TransformSpec:
     :param removed_fields: names deleted by ``func``.
     :param selected_fields: if set, the output schema is exactly these names
         (applied after edits/removals).
+    :param batched: batch-native apply path (docs/io.md "Batch-native
+        plane"): ``func`` receives ONE ``{column_name: per-row values}``
+        dict covering the whole row group — numpy arrays on the vectorized
+        decode paths, lists for per-cell codec fallbacks — and returns the
+        same shape, applied once per row group instead of once per row.
+        On the ``make_batch_reader`` path the dict replaces the pandas
+        DataFrame round-trip entirely (Arrow columns in, columns out).
+        Every transformed column must keep one entry per row; the schema
+        mutation declarations (``edit_fields``/``removed_fields``/
+        ``selected_fields``) apply unchanged. Required (or ``func=None``)
+        for ``make_reader(row_materialization='lazy')`` — a per-row func
+        would force the worker back to per-row materialization.
     """
 
     def __init__(self,
                  func: Optional[Callable] = None,
                  edit_fields: Optional[Sequence] = None,
                  removed_fields: Optional[Sequence[str]] = None,
-                 selected_fields: Optional[Sequence[str]] = None):
+                 selected_fields: Optional[Sequence[str]] = None,
+                 batched: bool = False):
         self.func = func
+        self.batched = bool(batched)
         self.edit_fields: List[UnischemaField] = [
             f if isinstance(f, UnischemaField) else self._field_from_tuple(f)
             for f in (edit_fields or [])

