@@ -824,6 +824,55 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"batch_native phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4f3a3. deterministic epoch plane (docs/determinism.md): the
+    # headline scalar columnar epoch with sample_order='deterministic'
+    # (canonical plan + consumer-side reorder gate) vs the default free
+    # order, on the thread pool AND the process pool (whose arrival order
+    # genuinely differs, so the gate actually re-sequences there).
+    # Interleaved best-of-3 per mode; the acceptance bar is ordered-mode
+    # overhead <= 15% vs free order on this phase. The absolute rates
+    # join tools/bench_compare.py's regression surface via the
+    # _samples_per_sec suffix.
+    determinism_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "def epoch(pool, order, workers):\n"
+        "    t0 = time.perf_counter()\n"
+        "    with make_batch_reader(url, num_epochs=1,\n"
+        "                           shuffle_row_groups=True, seed=0,\n"
+        "                           reader_pool_type=pool,\n"
+        "                           workers_count=workers,\n"
+        "                           sample_order=order) as r:\n"
+        "        rows = sum(len(b[0]) for b in r)\n"
+        "    return rows / (time.perf_counter() - t0)\n"
+        "epoch('thread', 'free', 3)  # warm-up pays import + fs costs\n"
+        "rates = {('thread', 'free'): [], ('thread', 'deterministic'): [],\n"
+        "         ('process', 'free'): [], ('process', 'deterministic'): []}\n"
+        "for _ in range(3):  # interleaved so host drift hits both modes\n"
+        "    for pool, workers in (('thread', 3), ('process', 2)):\n"
+        "        for order in ('free', 'deterministic'):\n"
+        "            rates[(pool, order)].append(epoch(pool, order, workers))\n"
+        "result = {}\n"
+        "for pool in ('thread', 'process'):\n"
+        "    free = max(rates[(pool, 'free')])\n"
+        "    ordered = max(rates[(pool, 'deterministic')])\n"
+        "    result['free_%s_samples_per_sec' % pool] = round(free, 1)\n"
+        "    result['deterministic_%s_samples_per_sec' % pool] = round(ordered, 1)\n"
+        "    result['ordered_overhead_pct_%s' % pool] = round(\n"
+        "        100.0 * (free - ordered) / max(free, 1e-9), 2)\n"
+        "result['within_15pct'] = bool(\n"
+        "    result['ordered_overhead_pct_thread'] <= 15.0\n"
+        "    and result['ordered_overhead_pct_process'] <= 15.0)\n"
+        "print('BENCHJSON:' + json.dumps({'deterministic_epoch': result}))\n")
+    try:
+        out.update(_cpu_subprocess(determinism_child, data_dir,
+                                   timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"deterministic epoch phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4f3b. trace-plane overhead (docs/observability.md "Trace
     # plane"): the headline scalar columnar epoch with trace mode OFF vs
     # ON (lineage spans minted at ventilation, decode/fetch spans per row

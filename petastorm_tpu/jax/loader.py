@@ -661,7 +661,11 @@ class LoaderBase:
         buffer retains a random sample of rows indefinitely, so no reader
         cursor can describe the delivered stream without loss. Use the
         reader's own seeded shuffling (``shuffle_row_groups`` + ``seed``,
-        which IS resume-exact) for checkpointable runs."""
+        which IS resume-exact) — or, for a byte-identical stream with
+        extra row mixing, ``sample_order='deterministic'`` +
+        ``shuffle_window=`` on the reader, whose cursor-indexed window
+        shuffle checkpoints exactly (docs/determinism.md) — for
+        checkpointable runs."""
         if self._ckpt_hazard is not None:
             raise ValueError(
                 f"state_dict() would lose data with this loader "

@@ -160,9 +160,10 @@ class _Source:
     order. ``pulled`` counts items enqueued to the assembler."""
 
     __slots__ = ("ordinals", "reader", "pulled", "recovery", "plan_base",
-                 "fifo", "counted", "safe_delivered")
+                 "fifo", "counted", "safe_delivered", "plan_positions")
 
-    def __init__(self, ordinals, recovery: bool = False, plan_base: int = 0):
+    def __init__(self, ordinals, recovery: bool = False, plan_base: int = 0,
+                 plan_positions=None):
         self.ordinals = list(ordinals)
         self.reader = None
         self.pulled = 0
@@ -171,6 +172,14 @@ class _Source:
         #: lets a consumed watermark map back to a plan position for the
         #: resume cursor (primary sources only).
         self.plan_base = plan_base
+        #: Full-plan position of each ordinal when the list has HOLES (a
+        #: resume excluded ordinals already delivered through recovery
+        #: sources): ``None`` means contiguous from ``plan_base``. The
+        #: watermark arithmetic maps delivered counts back through this,
+        #: and the skipped holes stay covered by the cursor's
+        #: ``recovered`` set (docs/mesh.md "Cursors after a reshard").
+        self.plan_positions = (None if plan_positions is None
+                               else list(plan_positions))
         #: Effective count-exact accounting for THIS source: the factory's
         #: fifo_delivery claim re-validated against the live reader
         #: (one item == one row group only holds for batched output — a
@@ -185,6 +194,16 @@ class _Source:
         #: yet enqueued (the reader confirms on pull); slicing past it
         #: would drop that in-hand group from the epoch entirely.
         self.safe_delivered = 0
+
+    def plan_watermark(self, delivered: int) -> int:
+        """Full-plan position watermark after ``delivered`` groups of THIS
+        source reached the stream (primary sources only)."""
+        if self.plan_positions is None:
+            return self.plan_base + delivered
+        if delivered <= 0:
+            return self.plan_base
+        return self.plan_positions[min(delivered, len(self.plan_positions))
+                                   - 1] + 1
 
     def delivered_groups(self) -> int:
         """Lower bound on row groups delivered to the assembler. FIFO
@@ -342,6 +361,7 @@ class MeshDataLoader(LoaderBase):
 
         self._resume_epoch = 0
         self._resume_offsets: Optional[List[int]] = None
+        self._resume_recovered: List[int] = []
         if resume_state is not None:
             self._load_resume_state(resume_state)
 
@@ -354,9 +374,15 @@ class MeshDataLoader(LoaderBase):
         self._collate_lock = threading.Lock()
         self._canonical_keys: Optional[frozenset] = None
         self._batch_seq = 0
-        #: Did the CURRENT epoch reshard? Poisons its remaining cursors
-        #: (see _cursor/state_dict); reset at each epoch's setup.
+        #: Did the CURRENT epoch reshard? Provenance on the cursor (a
+        #: resumed run knows its stream crossed a reshard); reset at each
+        #: epoch's setup.
         self._epoch_resharded = False
+        #: Global ordinals delivered through RECOVERY sources this epoch:
+        #: folded into the cursor's ``recovered`` set so a post-reshard
+        #: checkpoint stays valid — resume excludes them from every host's
+        #: remaining plan instead of refusing (docs/mesh.md).
+        self._recovered_live: set = set()
         #: The live epoch's stop event while one is running — close() sets
         #: it so an assembler blocked waiting for parts exits promptly.
         self._live_stop: Optional[threading.Event] = None
@@ -453,6 +479,11 @@ class MeshDataLoader(LoaderBase):
             raise ValueError(f"resume_state carries {len(offsets)} host "
                              f"cursors, need {self._H}")
         self._resume_offsets = offsets
+        # Post-reshard cursors (docs/mesh.md): global ordinals already
+        # delivered through RECOVERY sources; the resumed epoch excludes
+        # them from every host's remaining plan instead of refusing.
+        self._resume_recovered = sorted(
+            int(o) for o in state.get("recovered", ()))
 
     # ------------------------------------------------------------ host side
     def kill_host(self, host: int) -> None:
@@ -712,11 +743,11 @@ class MeshDataLoader(LoaderBase):
                 self._source_done(abandoned)
                 return
             # Elastic degradation: round-robin the range to survivors.
-            # Cursors taken from the REST OF THIS EPOCH are poisoned (the
-            # static plan no longer describes the stream); the flag rides
-            # the cursor itself, so the next epoch's checkpoints are clean
-            # again — a transient host blip must not disable checkpointing
-            # for the loader's remaining lifetime.
+            # Cursors for the rest of this epoch stay VALID: recovery
+            # deliveries fold into the cursor's ``recovered`` ordinal set
+            # as they are consumed (_mark_consumed), so a checkpoint
+            # describes the stream exactly — the flag below is provenance
+            # only (docs/mesh.md "Cursors after a reshard").
             self._epoch_resharded = True
             buckets: List[List[int]] = [[] for _ in survivors]
             for i, o in enumerate(undelivered):
@@ -849,21 +880,25 @@ class MeshDataLoader(LoaderBase):
     def _host_batches(self):
         epoch = self._resume_epoch
         offsets = self._resume_offsets
+        recovered = self._resume_recovered
         passes = 0
         while self._num_epochs is None or passes < self._num_epochs:
-            yield from self._epoch_batches(epoch, offsets)
+            yield from self._epoch_batches(epoch, offsets, recovered)
             if self._closing:
                 # close() abandoned the epoch above; starting the next
                 # one's readers mid-teardown would race interpreter exit.
                 return
             offsets = None
+            recovered = ()
             passes += 1
             epoch += 1
 
-    def _epoch_batches(self, epoch: int, offsets: Optional[List[int]]):
+    def _epoch_batches(self, epoch: int, offsets: Optional[List[int]],
+                       recovered=()):
         plan = self.epoch_plan(epoch)
         stop = threading.Event()
-        self._epoch_resharded = False
+        self._epoch_resharded = bool(recovered)
+        self._recovered_live = set(int(o) for o in recovered)
         self._live_stop = stop
         feeds = [_HostFeed(h, stop) for h in range(self._H)]
         active = ([feeds[self._local_host]] if self._multiprocess else feeds)
@@ -875,9 +910,24 @@ class MeshDataLoader(LoaderBase):
             for feed in active:
                 base = offsets[feed.idx] if offsets else 0
                 feed.primary_consumed = base
-                ordinals = plan[feed.idx][base:]
-                if ordinals:
-                    feed.sources.append(_Source(ordinals, plan_base=base))
+                if self._recovered_live:
+                    # Post-reshard resume (docs/mesh.md): ordinals already
+                    # delivered through recovery sources are excluded; the
+                    # explicit position list keeps the plan watermark
+                    # arithmetic exact across the holes.
+                    positions = [i for i in range(base, len(plan[feed.idx]))
+                                 if plan[feed.idx][i]
+                                 not in self._recovered_live]
+                    ordinals = [plan[feed.idx][i] for i in positions]
+                    src = (_Source(ordinals, plan_base=base,
+                                   plan_positions=positions)
+                           if ordinals else None)
+                else:
+                    ordinals = plan[feed.idx][base:]
+                    src = _Source(ordinals, plan_base=base) if ordinals \
+                        else None
+                if src is not None:
+                    feed.sources.append(src)
                     self._outstanding += 1
             if self._outstanding == 0:
                 self._epoch_done = True
@@ -991,14 +1041,22 @@ class MeshDataLoader(LoaderBase):
         return cols
 
     def _mark_consumed(self, consumed_parts, epoch: int) -> None:
-        """Advance resume watermarks for fully consumed primary parts and
+        """Advance resume watermarks for fully consumed primary parts,
+        fold recovery deliveries into the epoch's ``recovered`` set, and
         refresh the loss-safe cursor the staging thread snapshots."""
         for part in consumed_parts:
-            if not part.source.recovery:
+            src = part.source
+            if src.recovery:
+                # A reassigned range's delivered prefix is irrevocably in
+                # the stream: record the global ordinals so the cursor
+                # stays valid after the reshard (resume excludes them).
+                self._recovered_live.update(
+                    src.ordinals[:part.delivered_after])
+            else:
                 feed = self._feeds[part.host]
                 feed.primary_consumed = max(
                     feed.primary_consumed,
-                    part.source.plan_base + part.delivered_after)
+                    src.plan_watermark(part.delivered_after))
         self._pending_safe_state = self._cursor(epoch)
 
     def _cursor(self, epoch: int, fresh: bool = False) -> dict:
@@ -1007,24 +1065,28 @@ class MeshDataLoader(LoaderBase):
                            else [self._feeds[self._local_host]])}
         state = {"mesh": True, "epoch": epoch, "hosts": hosts,
                  "num_rowgroups": self._G, "num_hosts": self._H}
+        if not fresh and self._recovered_live:
+            # Reshard fold-in (docs/mesh.md): these global ordinals were
+            # delivered by recovery sources; together with the per-host
+            # plan positions they describe the stream exactly, so the
+            # cursor stays checkpointable mid-epoch after a host loss.
+            state["recovered"] = sorted(int(o)
+                                        for o in self._recovered_live)
         if self._epoch_resharded and not fresh:
-            state["resharded"] = True
+            state["resharded"] = True  # provenance, no longer a poison
         return state
 
     def state_dict(self):
         """Resume cursor of the delivered stream (see
-        :meth:`LoaderBase.state_dict`). A cursor taken after a mid-epoch
-        reshard refuses: per-host plan positions no longer describe who
-        read what. The refusal is per-CURSOR, not per-loader — the next
-        epoch boundary installs a clean one and checkpointing resumes."""
-        state = super().state_dict()
-        if state is not None and state.get("resharded"):
-            raise ValueError(
-                "state_dict() after a mid-epoch mesh reshard: a lost "
-                "host's row groups were reassigned, so the per-host "
-                "cursors no longer map to the static shard plan. "
-                "Checkpoint again at the next epoch boundary.")
-        return state
+        :meth:`LoaderBase.state_dict`). Valid **after a mid-epoch reshard
+        too** (PR 7 refused these per-cursor): a lost host's reassigned
+        row groups fold into the cursor as a ``recovered`` ordinal set —
+        resume excludes them from every host's remaining plan, so the
+        stream completes with no loss (bounded duplication at worst: a
+        recovery range's non-FIFO watermark is conservative, exactly the
+        contract single-reader resume has always had; docs/mesh.md
+        "Cursors after a reshard")."""
+        return super().state_dict()
 
     def _update_skew(self) -> None:
         stalls = [c.value for c in self._c_host_stall.values()]

@@ -219,6 +219,27 @@ def _resolve_shard(cur_shard, shard_count):
     return cur_shard, shard_count
 
 
+def _resolve_seed(seed, resume_state, shuffle_row_groups, shuffle_rows,
+                  sample_order):
+    """Seeded-by-default (docs/determinism.md): when any ordering decision
+    is randomized and no seed was given, mint one at plan time and record
+    it in ``state_dict`` — an unseeded shuffle is statistically identical
+    but unresumable. A ``resume_state`` supplies its recorded seed instead
+    (the offsets index THAT permutation); a restored state that lacks one
+    (saved before seeds were recorded, or hand-built) stays ``None`` so
+    the resume-requires-seed check below can refuse honestly rather than
+    silently repositioning a fresh random order."""
+    if seed is not None:
+        return seed
+    if resume_state is not None:
+        saved = resume_state.get("seed")
+        return None if saved is None else int(saved)
+    if shuffle_row_groups or shuffle_rows or sample_order != "free":
+        from petastorm_tpu.reader_impl.epoch_plan import mint_seed
+        return mint_seed()
+    return None
+
+
 #: Give-up deadline for a placement migration's old-pool drain: past this,
 #: the migration aborts and the reader stays on the live pool (migratable
 #: configurations run without the watchdog, so this bound is what keeps a
@@ -355,7 +376,9 @@ def make_reader(dataset_url,
                 readahead_depth: Optional[int] = None,
                 readahead_max_bytes: Optional[int] = None,
                 rowgroup_subset: Optional[Sequence[int]] = None,
-                row_materialization: str = "eager"):
+                row_materialization: str = "eager",
+                sample_order: str = "free",
+                shuffle_window: int = 0):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -482,11 +505,35 @@ def make_reader(dataset_url,
         Python loops just never run. Falls back to eager (with a warning)
         for NGram readers and per-row ``TransformSpec`` funcs
         (``TransformSpec(batched=True)`` composes with lazy).
+    :param sample_order: ``'free'`` (default — delivery order depends on
+        pool type, worker count and timing, today's behavior) or
+        ``'deterministic'`` — the **deterministic epoch plane**
+        (docs/determinism.md): the delivered stream is a pure function of
+        ``(seed, epoch_idx, shard_plan)``, byte-identical across
+        thread/process/dummy pools, worker counts, autotune actuation,
+        readahead depth, hedging, placement migration, crash
+        re-ventilation, and mid-epoch resume. A consumer-side reorder
+        stage re-sequences out-of-order completions; quarantine skips
+        advance the watermark deterministically and ride the checkpoint
+        cursor. Seeded-by-default: with no ``seed`` one is minted at plan
+        time and recorded in :meth:`Reader.state_dict`.
+    :param shuffle_window: with ``sample_order='deterministic'``, shuffle
+        the ordered stream inside consecutive windows of this many work
+        items via a seeded, position-indexed block permutation — a
+        function of the cursor, not of arrival timing, so it is exactly
+        resumable and has a **provable mixing radius** (a row group is
+        delivered within ``shuffle_window`` plan positions of its slot;
+        docs/determinism.md for the math). ``0`` = exact plan order.
 
     Parity: reference reader.py:60.
     """
     _warn_compat_kwargs(hdfs_driver, pyarrow_serialize)
     del convert_early_to_numpy  # row workers always decode early
+    # Resolve the seed BEFORE the pool factory closes over it: a minted
+    # seed must reach worker RNGs and the thread pool's readout-order
+    # choice, not just the ventilator (docs/determinism.md).
+    seed = _resolve_seed(seed, resume_state, shuffle_row_groups,
+                         shuffle_rows, sample_order)
     ctx = DatasetContext(dataset_url, storage_options=storage_options,
                          filesystem=filesystem)
     try:
@@ -552,7 +599,9 @@ def make_reader(dataset_url,
                   readahead_depth=readahead_depth,
                   readahead_max_bytes=readahead_max_bytes,
                   rowgroup_subset=rowgroup_subset,
-                  row_materialization=row_materialization)
+                  row_materialization=row_materialization,
+                  sample_order=sample_order,
+                  shuffle_window=shuffle_window)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -599,7 +648,9 @@ def make_batch_reader(dataset_url_or_urls,
                       readahead_depth: Optional[int] = None,
                       readahead_max_bytes: Optional[int] = None,
                       serializer=None,
-                      rowgroup_subset: Optional[Sequence[int]] = None):
+                      rowgroup_subset: Optional[Sequence[int]] = None,
+                      sample_order: str = "free",
+                      shuffle_window: int = 0):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -641,9 +692,15 @@ def make_batch_reader(dataset_url_or_urls,
     ``rowgroup_subset`` restricts the plan to explicit row-group ordinals
     in the given order, exactly as in :func:`make_reader` — the mesh
     ingestion layer's shard-plan/reshard mechanism (docs/mesh.md).
+    ``sample_order`` / ``shuffle_window`` behave exactly as in
+    :func:`make_reader` (docs/determinism.md): ``'deterministic'`` pins
+    the delivered batch stream to ``f(seed, epoch_idx, shard_plan)``
+    across every pool type, knob, fault, and resume point.
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
+    seed = _resolve_seed(seed, resume_state, shuffle_row_groups,
+                         shuffle_rows, sample_order)
     ctx = DatasetContext(dataset_url_or_urls, storage_options=storage_options,
                          filesystem=filesystem)
     schema = infer_or_load_unischema(ctx)
@@ -718,7 +775,9 @@ def make_batch_reader(dataset_url_or_urls,
                   rowgroup_pruning=rowgroup_pruning,
                   readahead_depth=readahead_depth,
                   readahead_max_bytes=readahead_max_bytes,
-                  rowgroup_subset=rowgroup_subset)
+                  rowgroup_subset=rowgroup_subset,
+                  sample_order=sample_order,
+                  shuffle_window=shuffle_window)
 
 
 class Reader:
@@ -739,7 +798,8 @@ class Reader:
                  hedge_policy=None, hang_timeout_s=None,
                  rowgroup_pruning=True, readahead_depth=None,
                  readahead_max_bytes=None, pool_factory=None,
-                 rowgroup_subset=None, row_materialization="eager"):
+                 rowgroup_subset=None, row_materialization="eager",
+                 sample_order="free", shuffle_window=0):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -767,6 +827,30 @@ class Reader:
         # docs/observability.md for the metric schema.
         self.telemetry = make_registry()
         self._telemetry_exporter = None
+
+        # ---------------- deterministic epoch plane (docs/determinism.md)
+        if sample_order not in ("free", "deterministic"):
+            raise ValueError(f"sample_order must be 'free' or "
+                             f"'deterministic', got {sample_order!r}")
+        shuffle_window = int(shuffle_window or 0)
+        if shuffle_window < 0:
+            raise ValueError(f"shuffle_window must be >= 0, "
+                             f"got {shuffle_window}")
+        if shuffle_window and sample_order != "deterministic":
+            raise ValueError(
+                "shuffle_window is the deterministic plane's window-shuffle "
+                "mode; pass sample_order='deterministic' with it "
+                "(docs/determinism.md)")
+        #: ``'free'`` or ``'deterministic'`` — the delivery-order contract
+        #: this reader runs under (docs/determinism.md).
+        self.sample_order = sample_order
+        self._shuffle_window = shuffle_window
+        # Defensive re-resolution for direct Reader(...) constructions (the
+        # make_* entry points already resolved before building the pool).
+        if seed is None:
+            seed = _resolve_seed(seed, resume_state, shuffle_row_groups,
+                                 shuffle_rows, sample_order)
+        self._seed = seed
 
         cur_shard, shard_count = _resolve_shard(cur_shard, shard_count)
         if (cur_shard is None) != (shard_count is None):
@@ -1047,6 +1131,9 @@ class Reader:
             # Batch-native plane: lazy workers publish ColumnarBatch
             # payloads (docs/io.md); validated above.
             "row_materialization": self.row_materialization,
+            # Deterministic plane: workers publish one OrderedUnit envelope
+            # per work item (docs/determinism.md).
+            "sample_order": sample_order,
         }
         worker_args = (self._spawnable_worker_args()
                        if isinstance(self._pool, ProcessPool)
@@ -1065,11 +1152,51 @@ class Reader:
                                                    force_copy=False)
 
         start_epoch, start_offset = 0, 0
+        resume_window_k, resume_skips = 0, ()
         if resume_state is not None:
             if shuffle_row_groups and seed is None:
+                # Reached only when the RESTORED state lacks a recorded
+                # seed (pre-seeded-by-default checkpoints, hand-built
+                # dicts): a fresh reader auto-mints and records one, so
+                # resume-by-default holds for every state_dict() saved
+                # since (docs/determinism.md).
                 raise ValueError(
                     "Exact resume requires a seed when shuffle_row_groups is on "
-                    "(the epoch permutation must be reproducible)")
+                    "(the epoch permutation must be reproducible) — this "
+                    "resume_state records none. States saved by "
+                    "state_dict() carry their auto-minted seed.")
+            saved_seed = resume_state.get("seed")
+            if saved_seed is not None and seed is not None \
+                    and int(saved_seed) != int(seed) \
+                    and (shuffle_row_groups or shuffle_rows
+                         or sample_order == "deterministic"):
+                raise ValueError(
+                    f"resume_state was saved under seed {saved_seed} but "
+                    f"this reader shuffles with seed {seed} — the offset "
+                    f"would point into a different permutation")
+            saved_order = resume_state.get("sample_order")
+            if saved_order is not None and saved_order != sample_order:
+                raise ValueError(
+                    f"resume_state was saved with sample_order="
+                    f"{saved_order!r} but this reader runs "
+                    f"{sample_order!r}; the cursors do not transfer")
+            saved_window = resume_state.get("window")
+            if saved_window is not None \
+                    and int(saved_window) != shuffle_window:
+                raise ValueError(
+                    f"resume_state was saved with shuffle_window="
+                    f"{saved_window} but this reader uses {shuffle_window}; "
+                    f"the in-window position would index a different "
+                    f"block permutation")
+            saved_plan = resume_state.get("plan")
+            if saved_plan is not None \
+                    and bool(saved_plan.get("shuffled")) \
+                    != bool(shuffle_row_groups):
+                raise ValueError(
+                    f"resume_state was saved with shuffle_row_groups="
+                    f"{bool(saved_plan.get('shuffled'))} but this reader "
+                    f"uses {bool(shuffle_row_groups)} — the offset would "
+                    f"index a different permutation")
             saved_items = resume_state.get("items")
             if saved_items is not None and int(saved_items) != len(items):
                 raise ValueError(
@@ -1080,10 +1207,42 @@ class Reader:
                     "rowgroup_coalescing as the saved run.")
             start_epoch = int(resume_state.get("epoch", 0))
             start_offset = int(resume_state.get("offset", 0))
+            resume_window_k = int(resume_state.get("window_delivered", 0))
+            resume_skips = resume_state.get("skipped_ordinals", ())
             if start_offset >= len(items):
                 raise ValueError(f"resume offset {start_offset} >= {len(items)} work items "
                                  "(did the dataset or its filtering change?)")
+            if shuffle_window > 1 and start_offset % shuffle_window:
+                # Windowed cursors always record block starts; a misaligned
+                # offset (a free-mode or hand-built cursor) would make the
+                # gate demand plan positions BEFORE the ventilation restart
+                # — an unfillable wait, not a resumable stream.
+                raise ValueError(
+                    f"resume offset {start_offset} is not aligned to "
+                    f"shuffle_window={shuffle_window}: windowed cursors "
+                    f"record window-block starts; this state was not saved "
+                    f"by a shuffle_window={shuffle_window} reader")
         self._num_items = len(items)
+
+        #: The canonical epoch plan + order-restoring gate (deterministic
+        #: mode only; docs/determinism.md). The gate sits between
+        #: ``pool.get_results()`` and the results reader; its cursor — not
+        #: the ventilator watermark — is this reader's checkpoint.
+        self._epoch_plan = None
+        self._gate = None
+        if sample_order == "deterministic":
+            from petastorm_tpu.reader_impl.epoch_plan import (
+                EpochPlan, OrderedDeliveryGate)
+            self._epoch_plan = EpochPlan(seed=seed, num_items=len(items),
+                                         shuffled=shuffle_row_groups,
+                                         window=shuffle_window)
+            self._gate = OrderedDeliveryGate(
+                self._epoch_plan, start_epoch=start_epoch,
+                start_offset=start_offset,
+                window_delivered=resume_window_k, skipped=resume_skips,
+                telemetry=self.telemetry)
+            self.telemetry.gauge("order.buffer_depth",
+                                 lambda: self._gate.buffered_count)
         self._ventilator = ConcurrentVentilator(
             self._make_ventilate_fn(self._pool), items,
             iterations=num_epochs,
@@ -1211,12 +1370,14 @@ class Reader:
         if is_batched_reader:
             self._results_reader = _BatchResultsReader(self._pool, self.schema,
                                                        telemetry=self.telemetry,
-                                                       watchdog=self.watchdog)
+                                                       watchdog=self.watchdog,
+                                                       gate=self._gate)
         else:
             self._results_reader = _RowResultsReader(self._pool, self.schema,
                                                      self.ngram,
                                                      telemetry=self.telemetry,
-                                                     watchdog=self.watchdog)
+                                                     watchdog=self.watchdog,
+                                                     gate=self._gate)
 
         export_path = os.environ.get(TELEMETRY_EXPORT_ENV)
         if export_path:
@@ -1709,18 +1870,40 @@ class Reader:
     def state_dict(self) -> dict:
         """Checkpoint of the read position at row-group granularity: pass it
         back as ``resume_state=`` to a new reader (same dataset, filters,
-        sharding, seed) to continue the stream. The cursor is a watermark
-        over confirmed-consumed work items, exact even when multi-worker
-        pools complete row groups out of ventilation order: groups at or
-        after the cursor that were partially delivered are re-read on
-        resume — bounded duplication, never loss. The reference has no
-        resume at all (its reset() is epoch-end only, reader.py:503)."""
+        sharding, seed) to continue the stream. The recorded ``seed`` is
+        the (possibly auto-minted) shuffle seed, so a resumed reader needs
+        no explicit seed of its own.
+
+        Free mode: the cursor is a watermark over confirmed-consumed work
+        items, exact even when multi-worker pools complete row groups out
+        of ventilation order: groups at or after the cursor that were
+        partially delivered are re-read on resume — bounded duplication,
+        never loss. The reference has no resume at all (its reset() is
+        epoch-end only, reader.py:503).
+
+        Deterministic mode (docs/determinism.md): the cursor is the
+        **delivery** position — ``(epoch, plan offset, window_delivered,
+        skipped_ordinals)`` plus the plan record — and the resumed stream
+        is byte-identical to the uninterrupted one's remainder. A
+        partially row-iterated work item backs the cursor up one unit, so
+        resume re-reads that unit whole (the resumed stream is then an
+        exact suffix of the full stream: bounded duplication, still
+        byte-identical order)."""
+        if self._gate is not None:
+            cur = self._gate.cursor(
+                back_up=self._results_reader.has_partial_unit())
+            cur.update({"items": self._num_items, "seed": self._seed,
+                        "sample_order": "deterministic",
+                        "window": self._shuffle_window,
+                        "plan": self._epoch_plan.describe()})
+            return cur
         s = self._ventilator.state
         return {"epoch": s["epoch"], "offset": s["offset"],
                 # Work-item count: lets resume reject a plan whose offsets
                 # mean different data (changed filters, sharding,
                 # shuffle_row_drop_partitions, or rowgroup_coalescing).
-                "items": self._num_items}
+                "items": self._num_items,
+                "seed": self._seed}
 
     def reset(self):
         """Start another pass. Only legal after the current pass finished
@@ -1729,6 +1912,10 @@ class Reader:
             raise RuntimeError(
                 "reset() is only supported after the previous pass was fully consumed")
         self._ventilator.reset()
+        if self._gate is not None:
+            # Another pass replays the exact same canonical order from the
+            # stream's origin (the ventilator reset restarts at epoch 0).
+            self._gate.reset()
         self.last_row_consumed = False
 
     # ------------------------------------------------------------- lifetime
@@ -1851,9 +2038,14 @@ class Reader:
 class _PoolWaitTimer:
     """Times consumer blocking in ``pool.get_results()`` into the pipeline
     registry (``reader.pool_wait_s`` histogram + a recorder span) — the
-    "pool-queue" stage of the per-stage breakdown."""
+    "pool-queue" stage of the per-stage breakdown.
 
-    def __init__(self, pool, telemetry, watchdog=None):
+    With an :class:`~petastorm_tpu.reader_impl.epoch_plan.
+    OrderedDeliveryGate` (deterministic mode, docs/determinism.md), every
+    read routes through the gate, which drains the raw pool stream and
+    releases payloads in canonical plan order."""
+
+    def __init__(self, pool, telemetry, watchdog=None, gate=None):
         self._pool = pool
         self._telemetry = telemetry
         # Results drained from a pool being migrated away from: served
@@ -1863,6 +2055,7 @@ class _PoolWaitTimer:
         # consumer is actually starving: a hang is only a hang while
         # someone is blocked waiting on the pipeline.
         self._watchdog = watchdog
+        self._gate = gate
         self._wait_hist = (telemetry.histogram("reader.pool_wait_s")
                            if telemetry is not None else None)
         # DummyPool decodes INLINE inside get_results; subtract that growth
@@ -1887,7 +2080,18 @@ class _PoolWaitTimer:
         """Undelivered results that do not require the live pool."""
         return bool(self._pending)
 
+    def has_partial_unit(self) -> bool:
+        """Whether the most recently delivered work item is only partially
+        served to the consumer (deterministic checkpoints back up one unit
+        over it — bounded duplication instead of row loss)."""
+        return False
+
     def get_results(self):
+        if self._gate is not None:
+            return self._gate.pull(self._fetch_once)
+        return self._fetch_once()
+
+    def _fetch_once(self):
         if self._pending:
             return self._pending.popleft()
         if self._watchdog is not None:
@@ -1925,8 +2129,9 @@ class _RowResultsReader(_PoolWaitTimer):
     untouched. Rows-counter credit for a batch lands once, at adoption —
     batch-granular accounting instead of a locked add per row."""
 
-    def __init__(self, pool, schema, ngram, telemetry=None, watchdog=None):
-        super().__init__(pool, telemetry, watchdog=watchdog)
+    def __init__(self, pool, schema, ngram, telemetry=None, watchdog=None,
+                 gate=None):
+        super().__init__(pool, telemetry, watchdog=watchdog, gate=gate)
         self._schema = schema
         self._ngram = ngram
         self._buffer = deque()
@@ -1944,6 +2149,12 @@ class _RowResultsReader(_PoolWaitTimer):
     def has_buffered(self) -> bool:
         return (bool(self._buffer) or self._batch is not None
                 or super().has_buffered())
+
+    def has_partial_unit(self) -> bool:
+        """Rows of the last delivered unit still sit in the row buffer (or
+        a lazy batch cursor is mid-batch): the deterministic cursor must
+        re-read that unit whole on resume."""
+        return bool(self._buffer) or self._batch is not None
 
     def _adopt(self, batch) -> None:
         tt = self._schema.namedtuple
@@ -2020,8 +2231,9 @@ class _BatchResultsReader(_PoolWaitTimer):
     """Yields one namedtuple-of-numpy-arrays per row group
     (parity: arrow_reader_worker.py:89-111, batched_output=True)."""
 
-    def __init__(self, pool, schema, telemetry=None, watchdog=None):
-        super().__init__(pool, telemetry, watchdog=watchdog)
+    def __init__(self, pool, schema, telemetry=None, watchdog=None,
+                 gate=None):
+        super().__init__(pool, telemetry, watchdog=watchdog, gate=gate)
         self._schema = schema
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)

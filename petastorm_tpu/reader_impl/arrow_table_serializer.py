@@ -9,8 +9,20 @@ object), and ``deserialize`` reads the record batches as views over the
 input buffer (``aliases_input = True`` tells the process pool that results
 may alias transport memory, engaging its segment-claim protocol on the shm
 ring — see docs/zero_copy.md).
+
+Deterministic mode (docs/determinism.md): workers publish
+:class:`~petastorm_tpu.reader_impl.epoch_plan.OrderedUnit` envelopes. The
+ordinal rides as **schema metadata** on the table itself (an ``empty`` /
+``skip`` unit becomes a zero-column table), so the payload stays a plain
+Arrow stream and the zero-copy deserialize path is byte-for-byte the same —
+the envelope costs one metadata key, never a copy.
 """
 import pyarrow as pa
+
+from petastorm_tpu.reader_impl.epoch_plan import OrderedUnit
+
+#: Schema-metadata key carrying ``b"{epoch}:{position}:{kind}"``.
+_ORDERED_META_KEY = b"petastorm_tpu.ordered"
 
 
 class ArrowTableSerializer:
@@ -19,12 +31,28 @@ class ArrowTableSerializer:
     #: consumer drops its last view (the shm ring's _SegmentClaim).
     aliases_input = True
 
-    def serialize(self, table: pa.Table):
+    def serialize(self, payload):
+        if isinstance(payload, OrderedUnit):
+            table = (payload.payload if payload.kind == "data"
+                     else pa.table({}))
+            meta = dict(table.schema.metadata or {})
+            meta[_ORDERED_META_KEY] = (
+                f"{payload.context[0]}:{payload.context[1]}:"
+                f"{payload.kind}".encode())
+            table = table.replace_schema_metadata(meta)  # metadata-only op
+        else:
+            table = payload
         sink = pa.BufferOutputStream()
         with pa.ipc.new_stream(sink, table.schema) as writer:
             writer.write_table(table)
         return sink.getvalue()  # pa.Buffer: buffer protocol, no bytes copy
 
-    def deserialize(self, serialized) -> pa.Table:
+    def deserialize(self, serialized):
         # Accepts bytes or a zero-copy buffer (memoryview / pa.Buffer).
-        return pa.ipc.open_stream(pa.py_buffer(serialized)).read_all()
+        table = pa.ipc.open_stream(pa.py_buffer(serialized)).read_all()
+        meta = table.schema.metadata
+        if meta and _ORDERED_META_KEY in meta:
+            epoch, pos, kind = meta[_ORDERED_META_KEY].decode().split(":")
+            return OrderedUnit((int(epoch), int(pos)), kind=kind,
+                               payload=(table if kind == "data" else None))
+        return table

@@ -18,10 +18,13 @@ from decimal import Decimal
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.reader_impl.epoch_plan import OrderedUnit
 from petastorm_tpu.reader_impl.row_reader_worker import (
     _ParquetFileLRU, _init_latency_defense, apply_batched_transform,
-    deadline_checkpoint, item_shuffle_rng, read_row_group_maybe_hedged,
-    readahead_clear, run_guarded_attempt, select_drop_partition)
+    deadline_checkpoint, item_shuffle_rng, publish_ordered_skip,
+    read_row_group_maybe_hedged, readahead_clear, run_guarded_attempt,
+    select_drop_partition)
+from petastorm_tpu.resilience.quarantine import RowGroupSkipped
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -46,6 +49,9 @@ class BatchReaderWorker(WorkerBase):
             worker_id=worker_id,
             telemetry=args.get("resilience_telemetry"))
         self._fault_plan = args.get("fault_plan")
+        # Deterministic epoch plane (docs/determinism.md): one OrderedUnit
+        # envelope per work item, exactly as in RowReaderWorker.
+        self._ordered = args.get("sample_order", "free") == "deterministic"
         _init_latency_defense(self, args)
 
     def _ensure_open(self):
@@ -75,9 +81,20 @@ class BatchReaderWorker(WorkerBase):
                                            shuffle_context),
                 on_retry=lambda _a, _e, _d: (self._files.evict(rowgroup.path),
                                              readahead_clear(self)))
+        except RowGroupSkipped:
+            # Quarantine give-up: ship the skip ordinal on the data stream
+            # for the reorder gate, then let the pool's quarantine
+            # bookkeeping proceed (docs/determinism.md).
+            publish_ordered_skip(self, shuffle_context)
+            raise
         finally:
             readahead_clear(self)
-        if result is not None:
+        if self._ordered and shuffle_context is not None:
+            self.publish_func(OrderedUnit(
+                shuffle_context,
+                kind="data" if result is not None else "empty",
+                payload=result))
+        elif result is not None:
             self.publish_func(result)
 
     def _build_result(self, rowgroup, shuffle_row_drop_partition,

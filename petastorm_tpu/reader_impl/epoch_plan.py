@@ -1,0 +1,360 @@
+"""Deterministic epoch plane (docs/determinism.md): the canonical sample
+order and the machinery that pins delivery to it.
+
+With ``make_reader(sample_order='deterministic')`` the delivered stream is a
+pure function of ``(seed, epoch_idx, shard_plan)`` — independent of pool
+type, worker count, autotune actuation, readahead depth, hedging, placement
+migration, crash re-ventilation, and mid-epoch resume. Three pieces:
+
+* :class:`EpochPlan` — the canonical order, minted once at plan time and
+  recorded in ``Reader.state_dict()``: a seeded row-group permutation per
+  epoch (the exact ``random.Random(seed + epoch).shuffle`` the ventilator
+  applies) plus the per-group intra-order (workers key their row shuffle by
+  ``(seed, epoch, position)``, so intra-group order is part of the same
+  function). With ``window > 1`` the plan additionally defines a seeded,
+  position-indexed **block permutation** over consecutive windows of work
+  items — the checkpointable window-shuffle mode whose mixing radius is
+  provable (a unit at plan position ``p`` is delivered within its block of
+  ``window`` positions; see docs/determinism.md for the math).
+
+* :class:`OrderedUnit` — the one-envelope-per-work-item protocol the reader
+  workers publish in deterministic mode: exactly one unit per ventilated
+  item, carrying the ventilator's ``(epoch, position)`` context and a kind
+  (``data`` / ``empty`` / ``skip``). Every completion path produces one —
+  a filtered-to-nothing group publishes ``empty``, a quarantined group
+  publishes ``skip`` before the guard's :class:`RowGroupSkipped` unwinds —
+  so the consumer can always account for every plan position.
+
+* :class:`OrderedDeliveryGate` — the order-restoring reorder stage between
+  pool results and the consumer: a bounded sequence buffer keyed by
+  ventilate ordinal with a watermark. Out-of-order completions (process
+  pools, hedging, crash re-ventilation, readahead) are re-sequenced;
+  duplicate units (a worker that published and died before its marker) are
+  dropped by ordinal; quarantine skips advance the watermark
+  deterministically and are recorded in the cursor so a resumed run drops
+  them even when the underlying fault does not re-fire. The buffer is
+  bounded by the ventilator's in-flight cap (plus one window): completed
+  items ahead of the watermark can never outnumber what the ventilator
+  admits.
+
+No reference counterpart — the reference's determinism ends where
+concurrency begins (ROADMAP item 4; "Reproducible DL at scale", PAPERS.md).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from petastorm_tpu.workers_pool import EmptyResultError
+
+logger = logging.getLogger(__name__)
+
+#: Seed entropy mask: numpy SeedSequence wants non-negative 32-bit words.
+_SEED_MASK = 0xFFFFFFFF
+
+#: Sentinel for a plan position whose work item completed with no rows
+#: (predicate filtered everything): the watermark advances, nothing is
+#: delivered, and — unlike a quarantine skip — nothing is recorded in the
+#: cursor (a re-read reproduces the same empty unit).
+_EMPTY = object()
+
+
+def mint_seed() -> int:
+    """A fresh 32-bit seed, minted once at plan time (seeded-by-default:
+    an unseeded shuffle is statistically identical but unresumable; the
+    minted value is recorded in ``state_dict`` so resume works without the
+    caller ever choosing a seed)."""
+    return int.from_bytes(os.urandom(4), "little")
+
+
+class OrderedUnit:
+    """One work item's delivery envelope in deterministic mode.
+
+    ``context`` is the ventilator's ``(epoch, position)`` for the item;
+    ``kind`` is ``'data'`` (``payload`` holds the worker's published
+    result), ``'empty'`` (completed, no rows) or ``'skip'`` (quarantined).
+    Picklable — crosses the process-pool boundary; the Arrow-IPC serializer
+    carries it as schema metadata instead so the zero-copy transport is
+    preserved (:mod:`petastorm_tpu.reader_impl.arrow_table_serializer`)."""
+
+    __slots__ = ("context", "kind", "payload")
+
+    def __init__(self, context: Tuple[int, int], kind: str = "data",
+                 payload=None):
+        self.context = (int(context[0]), int(context[1]))
+        self.kind = kind
+        self.payload = payload
+
+    def __repr__(self):
+        return (f"OrderedUnit(e{self.context[0]}:p{self.context[1]}, "
+                f"{self.kind})")
+
+
+class EpochPlan:
+    """The canonical epoch order: ``f(seed, epoch_idx, shard_plan)``.
+
+    ``num_items`` is the planned work-item count (the shard plan's side of
+    the function: row groups after filter/shard/prune/coalesce, times
+    ``shuffle_row_drop_partitions``). ``shuffled`` records whether the
+    ventilator applies the seeded per-epoch permutation; ``window`` the
+    block size of the window-shuffle mode (``<= 1`` = exact plan order).
+
+    Positions are **linearized** as ``epoch * num_items + position`` so one
+    integer cursor orders the whole multi-epoch stream.
+    """
+
+    def __init__(self, seed: int, num_items: int, shuffled: bool = False,
+                 window: int = 0):
+        if seed is None:
+            raise ValueError("EpochPlan requires a concrete seed (mint one "
+                             "at plan time; deterministic mode is "
+                             "seeded-by-default)")
+        self.seed = int(seed)
+        self.num_items = int(num_items)
+        self.shuffled = bool(shuffled)
+        self.window = int(window)
+        self._block_cache: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+
+    def describe(self) -> dict:
+        """JSON-safe plan record for ``state_dict``. Resume validates the
+        restored ``shuffled`` flag against the live plan here; ``seed`` /
+        ``items`` / ``window`` are validated through the cursor's own
+        top-level keys (they must match for the offsets to mean the same
+        data)."""
+        return {"version": 1, "seed": self.seed, "items": self.num_items,
+                "shuffled": self.shuffled, "window": self.window}
+
+    def permutation(self, epoch: int) -> List[int]:
+        """Item order of ``epoch``: position ``p`` holds original item
+        ``permutation(epoch)[p]`` — byte-for-byte the ventilator's
+        ``random.Random(seed + epoch).shuffle`` (identity when the plan is
+        unshuffled)."""
+        order = list(range(self.num_items))
+        if self.shuffled:
+            random.Random(self.seed + epoch).shuffle(order)
+        return order
+
+    def block_permutation(self, epoch: int, block_start: int) -> Tuple[int, ...]:
+        """Window-shuffle permutation of the block starting at plan
+        position ``block_start`` of ``epoch`` — a pure function of
+        ``(seed, epoch, block_start)``, NOT of arrival timing (the PR 9
+        ``BatchShufflingBuffer`` refill order depends on when refills
+        happen; this one is indexable from the cursor alone)."""
+        import numpy as np
+        length = min(self.window, self.num_items - block_start)
+        key = (epoch, block_start)
+        perm = self._block_cache.get(key)
+        if perm is None:
+            rng = np.random.default_rng(
+                [self.seed & _SEED_MASK, epoch & _SEED_MASK,
+                 block_start & _SEED_MASK, 0x0EDE])
+            perm = tuple(int(i) for i in rng.permutation(length))
+            if len(self._block_cache) > 8:
+                self._block_cache.clear()
+            self._block_cache[key] = perm
+        return perm
+
+    # ------------------------------------------------- cursor arithmetic
+    def needed_linear(self, consumed: int) -> int:
+        """Linear ordinal of the unit delivered at consumption slot
+        ``consumed`` (0-based count of units consumed since epoch 0)."""
+        n = self.num_items
+        epoch, r = divmod(consumed, n)
+        if self.window <= 1:
+            return consumed
+        block_start = (r // self.window) * self.window
+        perm = self.block_permutation(epoch, block_start)
+        return epoch * n + block_start + perm[r - block_start]
+
+    def cursor_fields(self, consumed: int) -> Tuple[int, int, int]:
+        """``(epoch, offset, window_delivered)`` for consumption slot
+        ``consumed``: ``offset`` is where ventilation must restart (the
+        watermark position, or the current window block's start), and
+        ``window_delivered`` how many of that block's units are already in
+        the delivered stream."""
+        n = self.num_items
+        epoch, r = divmod(consumed, n)
+        if self.window <= 1:
+            return epoch, r, 0
+        block_start = (r // self.window) * self.window
+        return epoch, block_start, r - block_start
+
+    def consumed_from_cursor(self, epoch: int, offset: int,
+                             window_delivered: int) -> int:
+        return epoch * self.num_items + offset + window_delivered
+
+
+class OrderedDeliveryGate:
+    """Order-restoring reorder stage between ``pool.get_results()`` and the
+    consumer (docs/determinism.md).
+
+    ``pull(fetch)`` returns the next payload in canonical order: it drains
+    ``fetch()`` (the pool's result stream, any arrival order) into a
+    sequence buffer keyed by linear ventilate ordinal and releases the
+    watermark unit as soon as it is present. ``skip`` units advance the
+    watermark and are logged for the cursor; ``empty`` units advance it
+    silently; duplicates (crash re-ventilation racing a published-but-
+    unmarked item) are dropped by ordinal.
+
+    The cursor (:meth:`cursor`) is the global checkpointable position:
+    ``(epoch_idx, plan_position, window_delivered, skipped_ordinals)``. It
+    advances on **delivery to the consumer**, not on pool completion — a
+    checkpoint never skips buffered-but-undelivered units. ``back_up=True``
+    rewinds to the state before the most recent data delivery (the caller
+    holds a partially-consumed unit: resume re-reads it whole — bounded
+    duplication, never loss).
+    """
+
+    def __init__(self, plan: EpochPlan, start_epoch: int = 0,
+                 start_offset: int = 0, window_delivered: int = 0,
+                 skipped: Iterable[int] = (), telemetry=None):
+        self._plan = plan
+        n = plan.num_items
+        self._c = plan.consumed_from_cursor(start_epoch, start_offset,
+                                            window_delivered)
+        #: Consumption slot at entry of the pull that produced the most
+        #: recent data delivery — the ``back_up`` cursor.
+        self._c_entry = self._c
+        self._buffered: dict = {}
+        #: Skip ordinals reported but not yet consumed by the watermark.
+        self._skips = {int(s) for s in skipped}
+        #: Every skip ordinal ever reported (cursor provenance: a restored
+        #: run must drop them even if the fault does not re-fire).
+        self._skip_log = set(self._skips)
+        #: Linear ordinals consumed within the CURRENT window block (dup
+        #: detection; pre-seeded on resume with the block prefix already
+        #: delivered before the checkpoint).
+        self._consumed_in_block: set = set()
+        if plan.window > 1 and window_delivered:
+            perm = plan.block_permutation(start_epoch, start_offset)
+            base = start_epoch * n + start_offset
+            self._consumed_in_block = {base + perm[j]
+                                       for j in range(window_delivered)}
+        self._c_reordered = (telemetry.counter("order.units_reordered")
+                             if telemetry is not None else None)
+        self._c_skips = (telemetry.counter("order.skips_recorded")
+                         if telemetry is not None else None)
+        self._c_dups = (telemetry.counter("order.duplicates_dropped")
+                        if telemetry is not None else None)
+
+    # ---------------------------------------------------------------- api
+    @property
+    def buffered_count(self) -> int:
+        return len(self._buffered)
+
+    def pull(self, fetch):
+        """Next payload in canonical order; ``fetch`` is called to drain
+        the underlying pool whenever the watermark unit has not arrived
+        yet. Raises whatever ``fetch`` raises (EmptyResultError at end of
+        stream, worker failures, watchdog aborts)."""
+        c_entry = self._c
+        while True:
+            needed = self._plan.needed_linear(self._c)
+            if needed in self._skips:
+                self._skips.discard(needed)
+                self._advance(needed)
+                continue
+            unit = self._buffered.pop(needed, None)
+            if unit is _EMPTY:
+                self._advance(needed)
+                continue
+            if unit is not None:
+                self._advance(needed)
+                self._c_entry = c_entry
+                return unit
+            try:
+                result = fetch()
+            except EmptyResultError:
+                if self._buffered:
+                    # End-of-stream with re-sequenced units still waiting:
+                    # a stop()/abort mid-epoch (the pool's poison pill
+                    # outranks the gate). Surface as end-of-data exactly
+                    # like the free-order path would.
+                    logger.debug(
+                        "ordered gate: stream ended with %d buffered "
+                        "unit(s) undelivered (mid-epoch stop)",
+                        len(self._buffered))
+                raise
+            self._feed(result)
+
+    def cursor(self, back_up: bool = False) -> dict:
+        """The global cursor: ``{"epoch", "offset", "window_delivered",
+        "skipped_ordinals"}`` (all JSON-safe). ``skipped_ordinals`` lists
+        every known skip at or after the cursor's ventilation restart
+        point — a resumed gate drops them deterministically, keeping the
+        tail byte-identical even when the quarantined fault was
+        transient."""
+        c = self._c_entry if back_up else self._c
+        epoch, offset, k = self._plan.cursor_fields(c)
+        base = epoch * self._plan.num_items + offset
+        pending = sorted(s for s in (self._skip_log | self._skips)
+                         if s >= base)
+        return {"epoch": int(epoch), "offset": int(offset),
+                "window_delivered": int(k),
+                "skipped_ordinals": [int(s) for s in pending]}
+
+    def reset(self) -> None:
+        """Back to the stream's origin (``Reader.reset()``: another pass
+        replays the exact same canonical order)."""
+        self._c = 0
+        self._c_entry = 0
+        self._buffered.clear()
+        self._skips.clear()
+        self._skip_log.clear()
+        self._consumed_in_block.clear()
+
+    # ---------------------------------------------------------- internals
+    def _advance(self, consumed_linear: int) -> None:
+        plan = self._plan
+        if plan.window > 1:
+            self._consumed_in_block.add(consumed_linear)
+        self._c += 1
+        if plan.window > 1:
+            r = self._c % plan.num_items
+            if r % plan.window == 0 or r == 0:
+                # Crossed a block (or epoch) boundary: the finished block's
+                # dup-detection set is subsumed by the watermark.
+                self._consumed_in_block.clear()
+
+    def _already_consumed(self, linear: int) -> bool:
+        plan = self._plan
+        if plan.window <= 1:
+            return linear < self._c
+        epoch, offset, _k = plan.cursor_fields(self._c)
+        block_base = epoch * plan.num_items + offset
+        return linear < block_base or linear in self._consumed_in_block
+
+    def _feed(self, result) -> None:
+        if not isinstance(result, OrderedUnit):
+            raise TypeError(
+                f"deterministic mode expected OrderedUnit payloads from the "
+                f"pool, got {type(result).__name__} (a worker missing the "
+                f"sample_order wiring?)")
+        epoch, pos = result.context
+        linear = epoch * self._plan.num_items + pos
+        if result.kind == "skip":
+            if linear not in self._skip_log and not self._already_consumed(
+                    linear):
+                self._skips.add(linear)
+                self._skip_log.add(linear)
+                if self._c_skips is not None:
+                    self._c_skips.add(1)
+            return
+        if self._already_consumed(linear) or linear in self._buffered \
+                or linear in self._skip_log:
+            # Duplicate (crash re-ventilation racing a published unit, or a
+            # resume re-reading already-delivered window members).
+            if self._c_dups is not None:
+                self._c_dups.add(1)
+            return
+        if result.kind == "empty" or result.payload is None:
+            # (payload None guards the buffered-vs-missing distinction in
+            # pull(): a missing entry means "not arrived", never "empty".)
+            self._buffered[linear] = _EMPTY
+            return
+        if linear != self._plan.needed_linear(self._c) \
+                and self._c_reordered is not None:
+            self._c_reordered.add(1)
+        self._buffered[linear] = result.payload

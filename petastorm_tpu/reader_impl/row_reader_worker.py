@@ -26,7 +26,18 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.reader_impl.batch_plane import (ColumnarBatch,
                                                    evaluate_predicate_mask)
+from petastorm_tpu.reader_impl.epoch_plan import OrderedUnit
+from petastorm_tpu.resilience.quarantine import RowGroupSkipped
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
+
+
+def publish_ordered_skip(worker, shuffle_context) -> None:
+    """Deterministic-mode skip envelope, shared by both reader workers:
+    published on the data stream BEFORE the :class:`RowGroupSkipped`
+    unwind reaches the pool, so per-worker FIFO guarantees the reorder
+    gate learns the skipped ordinal no later than any neighboring unit."""
+    if worker._ordered and shuffle_context is not None:
+        worker.publish_func(OrderedUnit(shuffle_context, kind="skip"))
 
 
 class _ParquetFileLRU:
@@ -424,6 +435,11 @@ class RowReaderWorker(WorkerBase):
         # per-row dicts; the Reader validated the configuration (no NGram,
         # no per-row TransformSpec func) at construction.
         self._lazy = args.get("row_materialization", "eager") == "lazy"
+        # Deterministic epoch plane (docs/determinism.md): publish exactly
+        # one OrderedUnit envelope per work item — data, empty, or skip —
+        # so the consumer-side reorder gate can account for every plan
+        # position regardless of completion order.
+        self._ordered = args.get("sample_order", "free") == "deterministic"
         _init_latency_defense(self, args)
 
     # Lazily build per-process handles (cheap for threads, required for processes).
@@ -457,9 +473,21 @@ class RowReaderWorker(WorkerBase):
                                            shuffle_context),
                 on_retry=lambda _a, _e, _d: (self._files.evict(rowgroup.path),
                                              readahead_clear(self)))
+        except RowGroupSkipped:
+            # Quarantine give-up: the skip unit rides the DATA stream ahead
+            # of the quarantine record, so the reorder gate advances its
+            # watermark deterministically and records the ordinal in the
+            # cursor (docs/determinism.md). The re-raise still drives the
+            # pool's quarantine bookkeeping.
+            publish_ordered_skip(self, shuffle_context)
+            raise
         finally:
             readahead_clear(self)
-        if result:
+        if self._ordered and shuffle_context is not None:
+            self.publish_func(OrderedUnit(
+                shuffle_context, kind="data" if result else "empty",
+                payload=result if result else None))
+        elif result:
             self.publish_func(result)
 
     def _build_result(self, rowgroup, shuffle_row_drop_partition,

@@ -3,6 +3,12 @@
 Parity: reference petastorm/weighted_sampling_reader.py —
 ``WeightedSamplingReader`` (:20), cumulative normalized probabilities (:62),
 per-``next`` reader pick (:89), compatibility checks (:64-77).
+
+Deterministic mixing (docs/determinism.md): every reader pick is drawn from
+an RNG keyed by ``(seed, step_idx)`` — a pure function of the mix position,
+not of a mutable stream — so a resumed mixture replays the exact same pick
+sequence from its recorded ``step``. Seeded-by-default: with no seed one is
+minted and recorded in ``state_dict``.
 """
 from __future__ import annotations
 
@@ -10,15 +16,30 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+#: Fixed extra entropy word so the mixer's (seed, step) streams can never
+#: collide with the readers' own (seed, epoch, position) shuffle streams.
+_MIX_STREAM = 0x301C
+
+#: Draws are generated one BLOCK at a time (one seeded generator per
+#: ``step // _DRAW_BLOCK`` producing ``_DRAW_BLOCK`` floats): the sequence
+#: stays a pure indexable function of ``(seed, step)`` — resume at any step
+#: regenerates its block — while the per-draw hot-path cost amortizes to a
+#: vector index instead of a full Generator construction per sample.
+_DRAW_BLOCK = 256
+
 
 class WeightedSamplingReader:
     """:param readers: readers to mix (must agree on schema/ngram/batched)
     :param probabilities: relative weights, normalized internally
-    :param seed: RNG seed for reproducible mixing
+    :param seed: RNG seed for reproducible mixing (auto-minted when None,
+        recorded in :meth:`state_dict` — the mix is always replayable)
+    :param start_step: resume position of the pick sequence (a previous
+        :meth:`state_dict`'s ``step``); member readers resume through their
+        own ``resume_state`` (see :meth:`resume_states`)
     """
 
     def __init__(self, readers: Sequence, probabilities: Sequence[float],
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, start_step: int = 0):
         if len(readers) != len(probabilities):
             raise ValueError("readers and probabilities must have equal length")
         if not readers:
@@ -28,7 +49,13 @@ class WeightedSamplingReader:
         if total <= 0:
             raise ValueError("probabilities must sum to a positive value")
         self._cum = np.cumsum([p / total for p in probabilities])
-        self._rng = np.random.default_rng(seed)
+        if seed is None:
+            from petastorm_tpu.reader_impl.epoch_plan import mint_seed
+            seed = mint_seed()
+        self._seed = int(seed)
+        self._step = int(start_step)
+        self._draw_block_idx = -1
+        self._draws = None
 
         first = readers[0]
         for other in readers[1:]:
@@ -44,9 +71,25 @@ class WeightedSamplingReader:
                     "namedtuple})")
             if other.batched_output != first.batched_output:
                 raise ValueError("Cannot mix batched and row readers")
+        orders = {getattr(r, "sample_order", "free") for r in readers}
+        if "deterministic" in orders and orders != {"deterministic"}:
+            # A half-deterministic ensemble is worse than either mode: the
+            # free members reorder under load, so the mixture can never be
+            # replayed even though the deterministic members promised it.
+            raise ValueError(
+                "Cannot mix sample_order='deterministic' members with "
+                "free-order ones: the free members' delivery order depends "
+                "on pool timing, so the mixture stream would not be "
+                "reproducible. Open every member with "
+                "sample_order='deterministic' (or none) — "
+                "docs/determinism.md.")
         self.schema = first.schema
         self.ngram = getattr(first, "ngram", None)
         self.batched_output = first.batched_output
+        #: ``'deterministic'`` when every member is (the mixture is then a
+        #: pure function of member seeds + the mixer's (seed, step) draws).
+        self.sample_order = ("deterministic" if orders == {"deterministic"}
+                             else "free")
         #: Batch-plane compatibility (docs/io.md): the mix is lazy only
         #: when EVERY member is — a mixed-mode ensemble would hand
         #: consumers alternating payload shapes.
@@ -59,7 +102,21 @@ class WeightedSamplingReader:
         return self
 
     def _pick(self) -> int:
-        draw = float(self._rng.random())
+        # Draw ``step`` of the (seed, step)-indexed sequence: the block
+        # holding it is generated by a generator keyed (seed, step//block)
+        # — resume at step k regenerates block k//block and replays draw k
+        # exactly, regardless of how the previous process interleaved
+        # members (docs/determinism.md; ROADMAP item 5's mixture curricula
+        # need the same property). Blocked generation keeps the hot path
+        # at one vector index per sample.
+        block, offset = divmod(self._step, _DRAW_BLOCK)
+        if block != self._draw_block_idx:
+            rng = np.random.default_rng(
+                [self._seed & 0xFFFFFFFF, block, _MIX_STREAM])
+            self._draws = rng.random(_DRAW_BLOCK)
+            self._draw_block_idx = block
+        self._step += 1
+        draw = float(self._draws[offset])
         idx = int(np.searchsorted(self._cum, draw, side="right"))
         return min(idx, len(self._readers) - 1)
 
@@ -87,18 +144,28 @@ class WeightedSamplingReader:
             raise
 
     def reset(self):
-        """Start another pass: resets exhausted member readers."""
+        """Start another pass: resets exhausted member readers and the
+        pick sequence (another pass replays the same draws)."""
         for r in self._readers:
             if r.last_row_consumed:
                 r.reset()
+        self._step = 0
         self.last_row_consumed = False
 
     def state_dict(self) -> dict:
-        """Composite checkpoint: each member reader's cursor (in order).
-        The mixing RNG is not captured — a resumed mix re-draws reader
-        picks, but every member stream continues from its own watermark
-        (no row loss, bounded duplication, same as Reader.state_dict)."""
-        return {"readers": [r.state_dict() for r in self._readers]}
+        """Composite checkpoint: each member reader's cursor (in order),
+        plus the mixer's own ``seed`` and ``step`` — the pick sequence is
+        keyed ``(seed, step_idx)``, so a resumed mix
+        (``seed=state['seed'], start_step=state['step']``) replays the
+        exact remaining draw sequence while every member stream continues
+        from its own cursor. With deterministic members mixed at BATCH
+        granularity (:meth:`next_batch`) the checkpoint always lands on
+        member unit boundaries and the resumed mixture is byte-identical;
+        row-granularity mixing keeps the reader contract — a member's
+        partially consumed unit replays whole on resume (bounded
+        duplication, never loss, same as :meth:`Reader.state_dict`)."""
+        return {"readers": [r.state_dict() for r in self._readers],
+                "seed": self._seed, "step": self._step}
 
     @staticmethod
     def resume_states(state: dict) -> List[dict]:

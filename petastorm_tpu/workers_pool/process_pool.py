@@ -46,6 +46,7 @@ import time
 import uuid
 from traceback import format_exc
 
+from petastorm_tpu.reader_impl.epoch_plan import OrderedUnit
 from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
 from petastorm_tpu.resilience.quarantine import (RowGroupSkipped,
                                                  RowGroupSkippedMessage)
@@ -470,9 +471,7 @@ class ProcessPool:
         tele = self.telemetry
         if tele is None:
             result = self._serializer.deserialize(buf)
-            if self.result_transform is not None:
-                result = self.result_transform(result)
-            return result
+            return self._apply_transform(result)
         c = self._c_deser
         if c is None:
             c = self._c_deser = tele.counter("transport.deserialize_s")
@@ -481,10 +480,22 @@ class ProcessPool:
         with tele.span("petastorm_tpu.transport", stage="transport",
                        track=track):
             result = self._serializer.deserialize(buf)
-            if self.result_transform is not None:
-                result = self.result_transform(result)
+            result = self._apply_transform(result)
         c.add(time.perf_counter() - t0)
         return result
+
+    def _apply_transform(self, result):
+        """Consumer-side ``result_transform``, applied INSIDE an
+        OrderedUnit envelope (deterministic mode, docs/determinism.md): the
+        ordinal wrapper must reach the reorder gate intact while the
+        payload still converts zero-copy."""
+        if self.result_transform is None:
+            return result
+        if isinstance(result, OrderedUnit):
+            if result.payload is not None:
+                result.payload = self.result_transform(result.payload)
+            return result
+        return self.result_transform(result)
 
     def _poll_result_shm(self, timeout_ms: int):
         """Round-robin over worker rings. Frames: first byte C (pickled
@@ -585,6 +596,11 @@ class ProcessPool:
         arrays that alias the mapped ring region (the zero-copy Arrow →
         numpy transform path); returns whether the record was claimed —
         the caller releases it immediately otherwise."""
+        if isinstance(result, OrderedUnit):
+            # Deterministic-mode envelope: the aliasing arrays live on the
+            # payload; the claim pins the record for them exactly as for a
+            # bare dict.
+            result = result.payload
         if not isinstance(result, dict):
             return False
         import numpy as np

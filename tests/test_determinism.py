@@ -1,0 +1,624 @@
+"""Deterministic epoch plane tests (docs/determinism.md): the canonical
+order contract — epoch = f(seed, epoch_idx, shard_plan) — across pool
+types, knobs, faults, and resume points; the reorder gate; the window
+shuffle's mixing radius; the checkpoint cursor; the weighted mixer's
+(seed, step) pinning; and the ``check_determinism`` lint."""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.reader_impl.epoch_plan import (EpochPlan,
+                                                  OrderedDeliveryGate,
+                                                  OrderedUnit, mint_seed)
+from petastorm_tpu.workers_pool import EmptyResultError
+
+pytestmark = pytest.mark.determinism
+
+
+# --------------------------------------------------------------- helpers
+def _fast_retry():
+    from petastorm_tpu.resilience import ExponentialBackoff, RetryPolicy
+    return RetryPolicy(max_attempts=2,
+                       backoff=ExponentialBackoff(base=0.0, multiplier=1.0,
+                                                  cap=0.0),
+                       jitter="none", seed=0)
+
+
+def _fault_plan(corrupt_substring, kill=False):
+    """Corruption on one file (-> two quarantined groups), latency on 30%
+    of reads, and — process pools only — one worker kill."""
+    from petastorm_tpu.resilience import FaultPlan, FaultSpec
+    specs = [
+        FaultSpec(site="rowgroup.read", kind="corruption", rate=1.0,
+                  key_substring=corrupt_substring),
+        FaultSpec(site="rowgroup.read", kind="latency", rate=0.3,
+                  latency_s=0.002),
+    ]
+    if kill:
+        specs.append(FaultSpec(site="worker.item", kind="worker_kill",
+                               at=3, times=1, worker=1))
+    return FaultPlan(specs, seed=5)
+
+
+def _corrupt_file(synthetic_dataset):
+    return os.path.basename(sorted(glob.glob(
+        os.path.join(synthetic_dataset.path, "*.parquet")))[0])
+
+
+def _det_kwargs(synthetic_dataset, pool, kill=False, **kw):
+    from petastorm_tpu.resilience import HedgePolicy
+    kwargs = dict(schema_fields=["id"], reader_pool_type=pool,
+                  workers_count=2, shuffle_row_groups=True, seed=7,
+                  num_epochs=1, sample_order="deterministic",
+                  degraded_mode=True, retry_policy=_fast_retry(),
+                  fault_plan=_fault_plan(_corrupt_file(synthetic_dataset),
+                                         kill=kill),
+                  hedge_policy=HedgePolicy(fallback_delay_s=0.05,
+                                           min_samples=3))
+    kwargs.update(kw)
+    return kwargs
+
+
+def _stream(synthetic_dataset, pool, kill=False, **kw):
+    with make_reader(synthetic_dataset.url,
+                     **_det_kwargs(synthetic_dataset, pool, kill=kill,
+                                   **kw)) as r:
+        ids = [int(s.id) for s in r]
+        quarantined = r.quarantine_report()["quarantined"]
+    return ids, quarantined
+
+
+# ------------------------------------------------- EpochPlan / gate units
+class TestEpochPlan:
+    def test_permutation_matches_ventilator_order(self):
+        """The plan's permutation IS the ventilator's seeded shuffle: the
+        canonical order is minted once, not derived twice."""
+        import random
+        plan = EpochPlan(seed=123, num_items=17, shuffled=True)
+        for epoch in (0, 1, 5):
+            expect = list(range(17))
+            random.Random(123 + epoch).shuffle(expect)
+            assert plan.permutation(epoch) == expect
+
+    def test_unshuffled_permutation_is_identity(self):
+        plan = EpochPlan(seed=0, num_items=5, shuffled=False)
+        assert plan.permutation(3) == list(range(5))
+
+    def test_block_permutation_pure_function(self):
+        a = EpochPlan(seed=9, num_items=20, shuffled=True, window=8)
+        b = EpochPlan(seed=9, num_items=20, shuffled=True, window=8)
+        assert a.block_permutation(2, 8) == b.block_permutation(2, 8)
+        assert sorted(a.block_permutation(0, 16)) == [0, 1, 2, 3]  # short tail
+        assert a.block_permutation(0, 0) != a.block_permutation(1, 0) or \
+            a.block_permutation(0, 0) != a.block_permutation(0, 8)
+
+    def test_cursor_arithmetic_round_trips(self):
+        plan = EpochPlan(seed=1, num_items=10, shuffled=True, window=4)
+        for consumed in range(35):
+            epoch, offset, k = plan.cursor_fields(consumed)
+            assert plan.consumed_from_cursor(epoch, offset, k) == consumed
+            assert offset % 4 == 0 and k < 4
+
+    def test_needed_linear_covers_every_slot_once(self):
+        plan = EpochPlan(seed=2, num_items=10, shuffled=True, window=4)
+        two_epochs = [plan.needed_linear(c) for c in range(20)]
+        assert sorted(two_epochs) == list(range(20))
+        # within-block displacement < window (the mixing radius)
+        for c, linear in enumerate(two_epochs):
+            assert abs(linear - c) < 4
+
+    def test_requires_seed(self):
+        with pytest.raises(ValueError, match="seed"):
+            EpochPlan(seed=None, num_items=3)
+
+    def test_mint_seed_is_32bit(self):
+        s = mint_seed()
+        assert 0 <= s < 2 ** 32
+
+
+class TestOrderedDeliveryGate:
+    @staticmethod
+    def _fetcher(units):
+        """fetch() yielding ``units`` then EmptyResultError forever."""
+        it = iter(units)
+
+        def fetch():
+            try:
+                return next(it)
+            except StopIteration:
+                raise EmptyResultError()
+        return fetch
+
+    def test_reorders_out_of_order_arrivals(self):
+        plan = EpochPlan(seed=0, num_items=4)
+        gate = OrderedDeliveryGate(plan)
+        units = [OrderedUnit((0, 2), payload="c"),
+                 OrderedUnit((0, 0), payload="a"),
+                 OrderedUnit((0, 3), payload="d"),
+                 OrderedUnit((0, 1), payload="b")]
+        fetch = self._fetcher(units)
+        got = [gate.pull(fetch) for _ in range(4)]
+        assert got == ["a", "b", "c", "d"]
+        with pytest.raises(EmptyResultError):
+            gate.pull(fetch)
+
+    def test_duplicates_dropped(self):
+        """Crash re-ventilation can deliver a published-but-unmarked item
+        twice; the gate dedups by ordinal."""
+        plan = EpochPlan(seed=0, num_items=2)
+        gate = OrderedDeliveryGate(plan)
+        fetch = self._fetcher([OrderedUnit((0, 0), payload="a"),
+                               OrderedUnit((0, 0), payload="a-dup"),
+                               OrderedUnit((0, 1), payload="b")])
+        assert [gate.pull(fetch), gate.pull(fetch)] == ["a", "b"]
+
+    def test_skip_advances_watermark_and_rides_cursor(self):
+        plan = EpochPlan(seed=0, num_items=3)
+        gate = OrderedDeliveryGate(plan)
+        fetch = self._fetcher([OrderedUnit((0, 1), kind="skip"),
+                               OrderedUnit((0, 0), payload="a"),
+                               OrderedUnit((0, 2), payload="c")])
+        assert gate.pull(fetch) == "a"
+        cur = gate.cursor()
+        assert cur == {"epoch": 0, "offset": 1, "window_delivered": 0,
+                       "skipped_ordinals": [1]}
+        assert gate.pull(fetch) == "c"
+        # all three slots consumed: the cursor is the next epoch's start,
+        # and the consumed skip is behind it (no longer recorded)
+        assert gate.cursor() == {"epoch": 1, "offset": 0,
+                                 "window_delivered": 0,
+                                 "skipped_ordinals": []}
+
+    def test_resumed_gate_drops_recorded_skips_even_when_data_arrives(self):
+        """A transient fault that does NOT re-fire on resume must not
+        resurrect the skipped unit: byte-identical tails."""
+        plan = EpochPlan(seed=0, num_items=3)
+        gate = OrderedDeliveryGate(plan, start_epoch=0, start_offset=1,
+                                   skipped=[1])
+        fetch = self._fetcher([OrderedUnit((0, 1), payload="ghost"),
+                               OrderedUnit((0, 2), payload="c")])
+        assert gate.pull(fetch) == "c"
+
+    def test_empty_units_advance_silently(self):
+        plan = EpochPlan(seed=0, num_items=2)
+        gate = OrderedDeliveryGate(plan)
+        fetch = self._fetcher([OrderedUnit((0, 0), kind="empty"),
+                               OrderedUnit((0, 1), payload="b")])
+        assert gate.pull(fetch) == "b"
+        assert gate.cursor()["skipped_ordinals"] == []
+
+    def test_back_up_cursor_re_reads_partial_unit(self):
+        plan = EpochPlan(seed=0, num_items=3)
+        gate = OrderedDeliveryGate(plan)
+        fetch = self._fetcher([OrderedUnit((0, 0), payload="a"),
+                               OrderedUnit((0, 1), payload="b")])
+        gate.pull(fetch)
+        gate.pull(fetch)
+        assert gate.cursor()["offset"] == 2
+        assert gate.cursor(back_up=True)["offset"] == 1
+
+    def test_windowed_delivery_and_resume_identity(self):
+        plan = EpochPlan(seed=4, num_items=8, shuffled=False, window=4)
+        units = [OrderedUnit((0, p), payload=p) for p in range(8)]
+        gate = OrderedDeliveryGate(plan)
+        fetch = self._fetcher(list(units))
+        full = [gate.pull(fetch) for _ in range(8)]
+        assert sorted(full) == list(range(8))
+        assert full != list(range(8))  # the window actually shuffles
+        # resume mid-window: slots 0..2 delivered, cursor (0, 0, 3)
+        gate2 = OrderedDeliveryGate(plan, start_epoch=0, start_offset=0,
+                                    window_delivered=3)
+        fetch2 = self._fetcher(list(units))  # ventilator re-reads the block
+        tail = [gate2.pull(fetch2) for _ in range(5)]
+        assert tail == full[3:]
+
+    def test_non_unit_payload_raises(self):
+        gate = OrderedDeliveryGate(EpochPlan(seed=0, num_items=1))
+        with pytest.raises(TypeError, match="OrderedUnit"):
+            gate.pull(self._fetcher(["bare"]))
+
+
+def test_arrow_serializer_round_trips_ordered_units():
+    """The ordinal rides Arrow schema metadata: zero-copy transport keeps
+    its shape, and skip/empty units survive with no table payload."""
+    import pyarrow as pa
+
+    from petastorm_tpu.reader_impl.arrow_table_serializer import \
+        ArrowTableSerializer
+    s = ArrowTableSerializer()
+    table = pa.table({"x": [1, 2, 3]})
+    unit = s.deserialize(s.serialize(OrderedUnit((2, 5), payload=table)))
+    assert isinstance(unit, OrderedUnit)
+    assert unit.context == (2, 5) and unit.kind == "data"
+    assert unit.payload.column("x").to_pylist() == [1, 2, 3]
+    skip = s.deserialize(s.serialize(OrderedUnit((0, 1), kind="skip")))
+    assert skip.kind == "skip" and skip.payload is None
+    # plain tables stay plain
+    assert s.deserialize(s.serialize(table)).equals(table)
+
+
+# ------------------------------------------------------------- validation
+def test_sample_order_validation(synthetic_dataset):
+    with pytest.raises(ValueError, match="sample_order"):
+        make_reader(synthetic_dataset.url, sample_order="chaotic")
+    with pytest.raises(ValueError, match="shuffle_window"):
+        make_reader(synthetic_dataset.url, shuffle_window=8)
+
+
+def test_resume_rejects_mode_and_window_mismatch(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     num_epochs=1, sample_order="deterministic",
+                     seed=3) as r:
+        next(r)
+        state = r.state_dict()
+    with pytest.raises(ValueError, match="sample_order"):
+        make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                    resume_state=state)
+    with pytest.raises(ValueError, match="shuffle_window"):
+        make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                    sample_order="deterministic", shuffle_window=4,
+                    resume_state=state)
+
+
+def test_resume_rejects_shuffle_flag_flip(synthetic_dataset):
+    """The plan record guards the shuffled flag: a cursor saved under the
+    seeded permutation must not silently resume into identity order (the
+    offset would index different data — row loss)."""
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     num_epochs=1, sample_order="deterministic",
+                     shuffle_row_groups=True, seed=3) as r:
+        next(r)
+        state = r.state_dict()
+    with pytest.raises(ValueError, match="shuffle_row_groups"):
+        make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                    sample_order="deterministic",
+                    shuffle_row_groups=False, resume_state=state)
+
+
+def test_windowed_resume_rejects_misaligned_offset(synthetic_dataset):
+    """A free-mode (or hand-built) cursor whose offset is not a window
+    block start must refuse: the gate would demand plan positions before
+    the ventilation restart — an unfillable wait, not a resume."""
+    with pytest.raises(ValueError, match="aligned"):
+        make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                    sample_order="deterministic", shuffle_window=4,
+                    seed=3, resume_state={"epoch": 0, "offset": 3,
+                                          "seed": 3})
+
+
+def test_state_dict_records_plan_and_seed(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     num_epochs=1, sample_order="deterministic") as r:
+        next(r)
+        state = r.state_dict()
+    assert state["sample_order"] == "deterministic"
+    assert state["seed"] is not None  # auto-minted
+    assert state["plan"]["items"] == 10 and state["plan"]["version"] == 1
+    assert "window_delivered" in state and "skipped_ordinals" in state
+
+
+# ---------------------------------------- keystone e2e (tier-1, in-process)
+def test_byte_identical_across_inprocess_pools_under_faults(
+        synthetic_dataset):
+    """The keystone contract on the in-process pools: thread and dummy —
+    with autotune on, readahead on, hedging on, under an injected fault
+    plan (latency + a fully quarantined file) — deliver byte-identical
+    epoch streams. (The process-pool leg, plus a worker kill, runs in
+    test_byte_identical_process_pool_with_worker_kill.)"""
+    dummy, q_dummy = _stream(synthetic_dataset, "dummy",
+                             readahead_depth=2, autotune=True)
+    thread, q_thread = _stream(synthetic_dataset, "thread",
+                               readahead_depth=2, autotune=True)
+    assert q_dummy == q_thread == 2  # the corrupt file's two groups
+    assert len(dummy) == 80
+    assert thread == dummy  # byte-identical, not just same multiset
+
+
+def test_mid_epoch_resume_reproduces_identical_tail(synthetic_dataset):
+    """Keystone, resume half: a mid-epoch cursor under the same fault
+    plan resumes to a stream that is an EXACT SUFFIX of the full one
+    (byte-identical tail; the partially-consumed unit replays whole)."""
+    full, _ = _stream(synthetic_dataset, "dummy", readahead_depth=2)
+    with make_reader(synthetic_dataset.url,
+                     **_det_kwargs(synthetic_dataset, "thread",
+                                   readahead_depth=2)) as r:
+        it = iter(r)
+        first = [int(next(it).id) for _ in range(33)]
+        state = r.state_dict()
+    with make_reader(synthetic_dataset.url,
+                     **{**_det_kwargs(synthetic_dataset, "thread"),
+                        "seed": None, "resume_state": state}) as r2:
+        rest = [int(s.id) for s in r2]
+    assert rest == full[len(full) - len(rest):]
+    assert first == full[:33]
+    # never loss; duplication bounded by the one re-read unit
+    assert set(first) | set(rest) == set(full)
+    assert len(first) + len(rest) - len(set(first) | set(rest)) <= 10
+
+
+@pytest.mark.process_pool
+def test_byte_identical_process_pool_with_worker_kill(synthetic_dataset):
+    """Keystone, process leg: the spawned pool — same fault plan PLUS one
+    worker kill absorbed by crash recovery — delivers the byte-identical
+    stream the in-process pools produce."""
+    dummy, q_dummy = _stream(synthetic_dataset, "dummy")
+    proc, q_proc = _stream(synthetic_dataset, "process", kill=True,
+                           worker_crash_budget=2)
+    assert proc == dummy
+    assert q_proc == q_dummy == 2
+
+
+# -------------------------------------------- property test (satellite)
+@pytest.mark.parametrize("pool", ["dummy", "thread"])
+def test_resume_byte_identical_property_random_interrupt_points(
+        synthetic_dataset, pool):
+    """Extends test_resume_no_loss_property_random_interrupt_points: in
+    deterministic mode, RANDOM interrupt points must yield byte-identical
+    remainders — the resumed stream is an exact suffix of the full one —
+    including an interrupt landing exactly at a quarantine skip (row 20
+    with the first two groups of the shuffled plan healthy is inside the
+    skip window for this seed/plan). The process-pool flavor runs in
+    test_resume_byte_identical_property_process_pool."""
+    import random
+
+    full, _ = _stream(synthetic_dataset, pool)
+    assert len(full) == 80
+
+    rng = random.Random(4242)
+    points = sorted(rng.sample(range(5, len(full) - 5), 3))
+    # One interrupt pinned where the delivered count crosses the
+    # quarantined groups' plan slots: the cursor there must carry or
+    # cross the recorded skip ordinals.
+    points.append(20)
+    for k in sorted(set(points)):
+        with make_reader(synthetic_dataset.url,
+                         **_det_kwargs(synthetic_dataset, pool)) as r:
+            it = iter(r)
+            first = [int(next(it).id) for _ in range(k)]
+            state = r.state_dict()
+        with make_reader(synthetic_dataset.url,
+                         **{**_det_kwargs(synthetic_dataset, pool),
+                            "seed": None, "resume_state": state}) as r2:
+            rest = [int(s.id) for s in r2]
+        assert first == full[:k], (pool, k)
+        assert rest == full[len(full) - len(rest):], (pool, k)
+        assert set(first) | set(rest) == set(full), (pool, k)
+
+
+@pytest.mark.process_pool
+def test_resume_byte_identical_property_process_pool(synthetic_dataset):
+    full, _ = _stream(synthetic_dataset, "dummy")
+    for k in (17, 20):
+        with make_reader(synthetic_dataset.url,
+                         **_det_kwargs(synthetic_dataset, "process")) as r:
+            it = iter(r)
+            first = [int(next(it).id) for _ in range(k)]
+            state = r.state_dict()
+        with make_reader(synthetic_dataset.url,
+                         **{**_det_kwargs(synthetic_dataset, "process"),
+                            "seed": None, "resume_state": state}) as r2:
+            rest = [int(s.id) for s in r2]
+        assert first == full[:k], k
+        assert rest == full[len(full) - len(rest):], k
+
+
+# ------------------------------------------------------- window shuffle
+def test_window_shuffle_identical_across_pools(synthetic_dataset):
+    kw = dict(schema_fields=["id"], workers_count=3,
+              shuffle_row_groups=True, seed=11, num_epochs=1,
+              sample_order="deterministic", shuffle_window=4)
+    streams = {}
+    for pool in ("dummy", "thread"):
+        with make_reader(synthetic_dataset.url, reader_pool_type=pool,
+                         **kw) as r:
+            streams[pool] = [int(s.id) for s in r]
+    assert streams["dummy"] == streams["thread"]
+    # same multiset as the unwindowed stream, different order
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     **{**kw, "shuffle_window": 0}) as r:
+        plain = [int(s.id) for s in r]
+    assert sorted(streams["dummy"]) == sorted(plain)
+    assert streams["dummy"] != plain
+
+
+def test_window_shuffle_mixing_radius(synthetic_dataset):
+    """The documented bound: a work item delivered in windowed mode lands
+    within ``shuffle_window`` plan positions of its canonical slot — rows
+    move at most (window - 1) * rows_per_group + (group_size - 1) rows."""
+    W = 4
+    kw = dict(schema_fields=["id"], reader_pool_type="dummy",
+              shuffle_row_groups=True, seed=11, num_epochs=1)
+    with make_reader(synthetic_dataset.url,
+                     sample_order="deterministic", shuffle_window=W,
+                     **kw) as r:
+        windowed = [int(s.id) for s in r]
+    with make_reader(synthetic_dataset.url,
+                     sample_order="deterministic", **kw) as r:
+        ordered = [int(s.id) for s in r]
+    # group index of each row in both streams (10 rows per group)
+    slot_of = {v: i // 10 for i, v in enumerate(ordered)}
+    for i, v in enumerate(windowed):
+        assert abs(slot_of[v] - i // 10) < W
+
+
+def test_window_shuffle_resume_mid_window_byte_identical(synthetic_dataset):
+    kw = dict(schema_fields=["id"], reader_pool_type="thread",
+              workers_count=2, shuffle_row_groups=True, seed=11,
+              num_epochs=1, sample_order="deterministic", shuffle_window=4)
+    with make_reader(synthetic_dataset.url, **kw) as r:
+        full = [int(s.id) for s in r]
+    with make_reader(synthetic_dataset.url, **kw) as r:
+        it = iter(r)
+        first = [int(next(it).id) for _ in range(25)]  # mid-window, mid-unit
+        state = r.state_dict()
+    assert state["window"] == 4
+    with make_reader(synthetic_dataset.url,
+                     **{**kw, "seed": None, "resume_state": state}) as r2:
+        rest = [int(s.id) for s in r2]
+    assert first == full[:25]
+    assert rest == full[len(full) - len(rest):]
+    assert set(first) | set(rest) == set(full)
+
+
+# ------------------------------------------------ multi-epoch + reset
+def test_multi_epoch_stream_and_reset_replay(synthetic_dataset):
+    kw = dict(schema_fields=["id"], reader_pool_type="thread",
+              workers_count=2, shuffle_row_groups=True, seed=3,
+              sample_order="deterministic")
+    with make_reader(synthetic_dataset.url, num_epochs=2, **kw) as r:
+        two = [int(s.id) for s in r]
+    assert len(two) == 200
+    assert two[:100] != two[100:]  # per-epoch reseed shuffles differently
+    with make_reader(synthetic_dataset.url, num_epochs=2, **kw) as r:
+        again = [int(s.id) for s in r]
+    assert again == two
+    with make_reader(synthetic_dataset.url, num_epochs=1, **kw) as r:
+        first_pass = [int(s.id) for s in r]
+        r.reset()
+        second_pass = [int(s.id) for s in r]
+    assert first_pass == two[:100]
+    assert second_pass == first_pass  # reset replays the SAME pass
+
+
+def test_batch_reader_deterministic_stream(scalar_dataset):
+    streams = {}
+    for pool in ("dummy", "thread"):
+        out = []
+        with make_batch_reader(scalar_dataset.url, schema_fields=["id"],
+                               reader_pool_type=pool, workers_count=3,
+                               shuffle_row_groups=True, seed=5,
+                               num_epochs=1,
+                               sample_order="deterministic") as r:
+            for b in r:
+                out.extend(int(v) for v in b.id)
+        streams[pool] = out
+    assert streams["dummy"] == streams["thread"]
+    assert sorted(streams["dummy"]) == list(range(100))
+
+
+def test_lazy_row_materialization_composes(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     reader_pool_type="thread", workers_count=2,
+                     shuffle_row_groups=True, seed=5, num_epochs=1,
+                     sample_order="deterministic",
+                     row_materialization="lazy") as r:
+        lazy = [int(s.id) for s in r]
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     reader_pool_type="dummy", shuffle_row_groups=True,
+                     seed=5, num_epochs=1,
+                     sample_order="deterministic") as r:
+        eager = [int(s.id) for s in r]
+    assert lazy == eager
+
+
+# ------------------------------------------------------- weighted mixer
+def test_mixer_rejects_mixed_order_members(synthetic_dataset):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     num_epochs=1, sample_order="deterministic", seed=1)
+    r2 = make_reader(synthetic_dataset.url, reader_pool_type="dummy",
+                     num_epochs=1, seed=1, shuffle_row_groups=False)
+    try:
+        with pytest.raises(ValueError, match="deterministic"):
+            WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0)
+    finally:
+        for r in (r1, r2):
+            r.stop(); r.join()
+
+
+def test_mixer_pick_sequence_pinned_to_seed_and_step(scalar_dataset):
+    """The pick sequence is f(seed, step): a mix restarted at
+    ``start_step=k`` replays exactly the draws the uninterrupted mix made
+    from step k. Batch-granularity mixing checkpoints at member unit
+    boundaries, so the resumed mixture is EXACTLY the remainder (row
+    granularity keeps the reader contract instead: a member's partially
+    consumed unit replays whole — bounded duplication, never loss)."""
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    def member():
+        return make_batch_reader(scalar_dataset.url, schema_fields=["id"],
+                                 reader_pool_type="dummy", num_epochs=2,
+                                 sample_order="deterministic", seed=9)
+
+    def take_batches(mix, n):
+        return [[int(v) for v in mix.next_batch()["id"]] for _ in range(n)]
+
+    with WeightedSamplingReader([member(), member()], [0.6, 0.4],
+                                seed=21) as mix:
+        full = take_batches(mix, 16)
+        assert mix.sample_order == "deterministic"
+
+    with WeightedSamplingReader([member(), member()], [0.6, 0.4],
+                                seed=21) as mix2:
+        first = take_batches(mix2, 7)
+        state = mix2.state_dict()
+    assert state["step"] == 7 and state["seed"] == 21
+    parts = WeightedSamplingReader.resume_states(state)
+    resumed_members = [
+        make_batch_reader(scalar_dataset.url, schema_fields=["id"],
+                          reader_pool_type="dummy", num_epochs=2,
+                          sample_order="deterministic", resume_state=p)
+        for p in parts]
+    with WeightedSamplingReader(resumed_members, [0.6, 0.4],
+                                seed=state["seed"],
+                                start_step=state["step"]) as mix3:
+        rest = take_batches(mix3, 9)
+    assert first == full[:7]
+    assert rest == full[7:]
+
+    # unseeded mixes mint and record a seed
+    with WeightedSamplingReader([member(), member()], [1, 1]) as mix4:
+        mix4.next_batch()
+        assert mix4.state_dict()["seed"] is not None
+
+
+# ------------------------------------------------- tools/check_determinism
+def test_check_determinism_flags_and_waives(tmp_path):
+    from tools.check_determinism import check_file, main as lint_main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import random\n"
+        "import numpy as np\n"
+        "def order(items):\n"
+        "    random.shuffle(items)\n"                      # default RNG
+        "    x = np.random.rand()\n"                       # global state
+        "    rng = np.random.default_rng()\n"              # unseeded
+        "    for v in set(items):\n"                       # set iteration
+        "        pass\n"
+        "    return [v for v in {1, 2}]\n")                # set literal
+    violations = check_file(str(bad))
+    assert len(violations) == 5
+    assert any("random.shuffle" in v for v in violations)
+    assert any("default_rng" in v for v in violations)
+    assert any("iterating a set" in v for v in violations)
+
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import random\n"
+        "import numpy as np\n"
+        "def order(items, seed):\n"
+        "    rng = random.Random(seed)\n"
+        "    g = np.random.default_rng([seed, 1])\n"
+        "    s = mint()  # determinism-ok: plan-time seed minting\n"
+        "    for v in sorted(set(items)):\n"
+        "        pass\n")
+    assert check_file(str(good)) == []
+
+    waived = tmp_path / "waived.py"
+    waived.write_text("import random\n"
+                      "x = random.random()  # determinism-ok: jitter\n")
+    assert check_file(str(waived)) == []
+
+    assert lint_main([str(bad)]) == 1
+    assert lint_main([str(good)]) == 0
+
+
+def test_check_determinism_default_set_clean():
+    from tools.check_determinism import DEFAULT_PATHS, check_file
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in DEFAULT_PATHS:
+        assert check_file(os.path.join(root, rel)) == [], rel

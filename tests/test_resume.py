@@ -193,9 +193,36 @@ def test_resume_after_degraded_skips_accounts_for_quarantined_groups(
 
 
 def test_resume_requires_seed_with_shuffle(synthetic_dataset):
+    """Only a RESTORED state that records no seed refuses (hand-built
+    dicts, pre-PR-10 checkpoints); fresh shuffled readers auto-mint one
+    and record it, so state_dict() output always resumes."""
     with pytest.raises(ValueError, match="seed"):
         make_reader(synthetic_dataset.url, shuffle_row_groups=True,
                     resume_state={"epoch": 0, "offset": 1})
+
+
+def test_shuffled_resume_is_seeded_by_default(synthetic_dataset):
+    """Satellite (docs/determinism.md): shuffle_row_groups=True with no
+    explicit seed mints one at plan time and records it in state_dict —
+    resume works without the caller ever choosing a seed, and the resumed
+    run replays the recorded permutation."""
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     reader_pool_type="dummy", shuffle_row_groups=True,
+                     num_epochs=1) as r:
+        it = iter(r)
+        first = [int(next(it).id) for _ in range(30)]
+        state = r.state_dict()
+    assert state["seed"] is not None
+    with make_reader(synthetic_dataset.url, schema_fields=["id"],
+                     reader_pool_type="dummy", shuffle_row_groups=True,
+                     num_epochs=1, resume_state=state) as r2:
+        rest = [int(s.id) for s in r2]
+    assert set(first) | set(rest) == set(range(100))
+    assert len(set(first) & set(rest)) <= 10  # one re-read group at most
+    # a mismatching explicit seed refuses instead of silently repositioning
+    with pytest.raises(ValueError, match="seed"):
+        make_reader(synthetic_dataset.url, shuffle_row_groups=True,
+                    seed=int(state["seed"]) + 1, resume_state=state)
 
 
 def test_resume_offset_out_of_range(synthetic_dataset):
@@ -619,6 +646,65 @@ def test_checkpoint_manager_restores_mesh_loader_cursor(tmp_path,
     epoch1_delivered = first[80:] + rest
     assert len(first[:80]) == len(set(first[:80])) == 80  # epoch-0 batches
     assert sorted(epoch1_delivered) == list(range(100))
+
+
+@pytest.mark.mesh
+def test_checkpoint_manager_restores_post_reshard_mesh_cursor(
+        tmp_path, scalar_dataset):
+    """Acceptance (PR 10): a cursor taken AFTER a mid-epoch reshard —
+    which PR 7 refused per-cursor — round-trips through CheckpointManager
+    and resumes without loss: the lost host's reassigned row groups fold
+    into the cursor's ``recovered`` ordinal set, resume excludes them,
+    and the union is complete with bounded duplication at worst
+    (docs/mesh.md "Cursors after a reshard")."""
+    import jax.numpy as jnp
+
+    from dataset_utils import create_test_scalar_dataset
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    from petastorm_tpu.jax.checkpoint import CheckpointManager
+
+    # A store big enough that the killed host still owns undelivered
+    # groups when the kill lands (5 groups per host, queue depth 2).
+    url = f"file://{tmp_path}/mesh_reshard_store"
+    create_test_scalar_dataset(url, num_rows=200, row_group_size=10)
+
+    def drain(batch, out):
+        arr = np.asarray(batch["id"])
+        if "__valid__" in batch:
+            arr = arr[np.asarray(batch["__valid__"])]
+        out.extend(int(v) for v in arr)
+
+    factory = MeshReaderFactory(url, batched=True)
+    train_state = {"w": jnp.arange(4.0)}
+    first = []
+    with MeshDataLoader(factory, batch_size=16, num_hosts=4, seed=13,
+                        num_epochs=1, drop_last=False,
+                        pad_last=True) as loader:
+        it = iter(loader)
+        drain(next(it), first)
+        loader.kill_host(2)
+        for _ in range(10):
+            drain(next(it), first)
+        with CheckpointManager(str(tmp_path / "reshard_ckpt")) as mgr:
+            assert mgr.save(1, train_state, loader=loader)
+        report = loader.mesh_report()
+    assert report["reshard_events"] == 1
+
+    with CheckpointManager(str(tmp_path / "reshard_ckpt")) as mgr:
+        _restored, input_state = mgr.restore(abstract=train_state)
+    assert input_state is not None and input_state.get("mesh") is True
+    assert input_state.get("resharded") is True  # provenance, not poison
+
+    rest = []
+    with MeshDataLoader(factory, batch_size=16, num_hosts=4, seed=13,
+                        num_epochs=1, resume_state=input_state,
+                        drop_last=False, pad_last=True) as loader2:
+        for batch in loader2:
+            drain(batch, rest)
+    union = set(first) | set(rest)
+    assert union == set(range(200))  # no loss across the reshard + resume
+    # duplication bounded: at most the in-flight parts re-read on resume
+    assert len(first) + len(rest) - len(union) <= 40
 
 
 @pytest.mark.mesh

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Lint guard: the deterministic epoch plane's hot-path modules must stay
+seeded and order-stable (docs/determinism.md).
+
+Two classes of violation in the ordered-plane modules:
+
+1. **Unseeded default-RNG use** — module-level ``random.<fn>()`` calls
+   (``random.random``, ``random.shuffle``, ...) draw from the process-wide
+   default generator, whose stream depends on every other caller;
+   ``np.random.<fn>()`` legacy calls share the global RandomState; and a
+   zero-argument ``np.random.default_rng()`` is OS-entropy-seeded. Any of
+   these feeding an ordering or sampling decision silently breaks
+   ``epoch = f(seed, epoch_idx, shard_plan)``. Seeded constructions —
+   ``random.Random(seed)``, ``np.random.default_rng(seed_material)``,
+   ``SeedSequence`` / ``Generator`` — are fine.
+
+2. **Set/dict-ordering iteration** — ``for x in set(...)`` /
+   ``frozenset(...)`` / a set literal (and the same as a comprehension
+   source). Python set iteration order varies with insertion history and
+   hash seeding; if it feeds delivery order the stream differs run to run.
+   Wrap in ``sorted(...)`` to make the order canonical.
+
+A line may opt out with a ``determinism-ok`` comment when the randomness or
+set walk provably never reaches delivery order (e.g. plan-time seed
+MINTING, which exists precisely to be recorded).
+
+Usage::
+
+    python tools/check_determinism.py            # scan the ordered-plane set
+    python tools/check_determinism.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: The ordered-plane hot path: every module whose code can influence the
+#: deterministic mode's delivered order — the plan/gate itself, the
+#: ventilator that realizes the permutation, both workers (intra-group
+#: order + publish), the shuffling buffers, the mixer, and the reader's
+#: planning/delivery layer.
+DEFAULT_PATHS = (
+    "petastorm_tpu/reader.py",
+    "petastorm_tpu/reader_impl/epoch_plan.py",
+    "petastorm_tpu/reader_impl/row_reader_worker.py",
+    "petastorm_tpu/reader_impl/batch_reader_worker.py",
+    "petastorm_tpu/reader_impl/shuffling_buffer.py",
+    "petastorm_tpu/weighted_sampling_reader.py",
+    "petastorm_tpu/workers_pool/ventilator.py",
+)
+
+WAIVER = "determinism-ok"
+
+#: ``random.<name>`` / ``np.random.<name>`` attributes that CONSTRUCT a
+#: seeded generator rather than drawing from a shared default stream.
+_SEEDED_CONSTRUCTORS = {"Random", "SystemRandom", "default_rng",
+                        "Generator", "SeedSequence", "PCG64", "Philox",
+                        "RandomState", "BitGenerator"}
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _is_np_random(node: ast.AST) -> bool:
+    """``np.random`` / ``numpy.random`` attribute chains."""
+    return (isinstance(node, ast.Attribute) and node.attr == "random"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("np", "numpy"))
+
+
+def _rng_violations(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        # random.<fn>(...) — the module-level default RNG.
+        if isinstance(fn.value, ast.Name) and fn.value.id == "random" \
+                and fn.attr not in _SEEDED_CONSTRUCTORS:
+            yield (node, f"random.{fn.attr}() draws from the process-wide "
+                         f"default RNG")
+        # np.random.<fn>(...) — legacy global RandomState, or an unseeded
+        # default_rng().
+        elif _is_np_random(fn.value):
+            if fn.attr == "default_rng":
+                if not node.args and not node.keywords:
+                    yield (node, "np.random.default_rng() without seed "
+                                 "material is OS-entropy seeded")
+            elif fn.attr not in _SEEDED_CONSTRUCTORS:
+                yield (node, f"np.random.{fn.attr}() draws from the global "
+                             f"numpy RandomState")
+
+
+def _set_iter_violations(tree: ast.AST):
+    def _is_set_expr(expr: ast.AST) -> bool:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        return (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Name)
+                and expr.func.id in ("set", "frozenset"))
+
+    for node in ast.walk(tree):
+        iters = []
+        if isinstance(node, ast.For):
+            iters = [node.iter]
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters = [gen.iter for gen in node.generators]
+        for it in iters:
+            if _is_set_expr(it):
+                yield (it, "iterating a set: the order depends on hash "
+                           "seeding and insertion history — sorted(...) it")
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived violation."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    found = sorted(list(_rng_violations(tree))
+                   + list(_set_iter_violations(tree)),
+                   key=lambda pair: pair[0].lineno)
+    for node, why in found:
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{node.lineno}: {why} — delivery order in the "
+            f"deterministic plane must be a function of (seed, epoch, "
+            f"plan); seed it or add '# {WAIVER}' if it provably never "
+            f"feeds delivery order")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), p)
+        for p in DEFAULT_PATHS]
+    all_violations = []
+    checked = 0
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+        checked += 1
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_determinism: {len(all_violations)} violation(s) in "
+              f"{checked} file(s)", file=sys.stderr)
+        return 1
+    print(f"check_determinism: {checked} ordered-plane file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
