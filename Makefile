@@ -50,6 +50,7 @@ ci-lint:
 	python tools/check_spans.py
 	python tools/check_rowloops.py
 	python tools/check_determinism.py
+	python tools/check_listing.py
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

@@ -1019,6 +1019,75 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"autotune phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4h. live appending dataset (docs/live_data.md): one static +
+    # one growing source. A writer thread appends parquet files while the
+    # reader serves with refresh_interval_s polling under an injected
+    # 10ms-latency fault on every listing; reports steady samples/sec,
+    # files appended vs admitted, and the freshness numbers — the
+    # acceptance bar is max per-file admission lag <= 2 poll intervals.
+    livedata_child = (
+        "import json, os, shutil, threading, time\n"
+        "import numpy as np, pyarrow as pa, pyarrow.parquet as pq\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "from petastorm_tpu.resilience import FaultPlan, FaultSpec\n"
+        "root = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'live_append')\n"
+        "shutil.rmtree(root, ignore_errors=True)\n"
+        "os.makedirs(root)\n"
+        "def write_file(idx, rows=20000):\n"
+        "    start = idx * rows\n"
+        "    pq.write_table(pa.table({\n"
+        "        'id': pa.array(np.arange(start, start + rows)),\n"
+        "        'val': pa.array(np.arange(rows, dtype=np.float64))}),\n"
+        "        os.path.join(root, f'part-{idx:05d}.parquet'),\n"
+        "        row_group_size=2000)\n"
+        "write_file(0); write_file(1)\n"
+        "POLL_S, APPEND_S, APPENDS, RUN_S = 0.25, 0.4, 8, 8.0\n"
+        "stop = threading.Event()\n"
+        "def producer():\n"
+        "    for i in range(2, 2 + APPENDS):\n"
+        "        if stop.wait(APPEND_S):\n"
+        "            return\n"
+        "        write_file(i)\n"
+        "threading.Thread(target=producer, daemon=True).start()\n"
+        "plan = FaultPlan([FaultSpec('discovery.list', 'latency', rate=1.0,\n"
+        "                            latency_s=0.010, times=None)], seed=0)\n"
+        "rows, t0 = 0, time.perf_counter()\n"
+        "with make_batch_reader('file://' + root, reader_pool_type='thread',\n"
+        "                       workers_count=3, num_epochs=None,\n"
+        "                       shuffle_row_groups=False, fault_plan=plan,\n"
+        "                       refresh_interval_s=POLL_S) as reader:\n"
+        "    for batch in reader:\n"
+        "        rows += len(batch.id)\n"
+        "        if time.perf_counter() - t0 > RUN_S:\n"
+        "            break\n"
+        "    elapsed = time.perf_counter() - t0\n"
+        "    rep = reader.dataset_growth_report()\n"
+        "    snap = reader.telemetry.snapshot()\n"
+        "stop.set()\n"
+        "disc = rep['discovery']\n"
+        "lag = disc['max_admission_lag_s']\n"
+        "print('BENCHJSON:' + json.dumps({'appending_epoch': {\n"
+        "    'appending_epoch_samples_per_sec': round(rows / elapsed, 1),\n"
+        "    'rows': rows,\n"
+        "    'poll_interval_s': POLL_S,\n"
+        "    'files_appended': APPENDS,\n"
+        "    'files_admitted': len(disc['admissions']),\n"
+        "    'growth_batches_applied': len(rep['applied']),\n"
+        "    'list_latency_fault_ms': 10,\n"
+        "    'list_retries_total': snap['counters'].get(\n"
+        "        'discovery.list_retries_total', 0),\n"
+        "    'ingest_lag_s': round(snap['gauges'].get(\n"
+        "        'discovery.ingest_lag_s', 0.0), 3),\n"
+        "    'max_admission_lag_s': lag,\n"
+        "    'lag_bound_s': 2 * POLL_S,\n"
+        "    'lag_ok': bool(lag <= 2 * POLL_S)}}))\n")
+    try:
+        out.update(_cpu_subprocess(livedata_child, data_dir, timeout_s=300.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"appending-epoch phase failed: {e!r}", file=sys.stderr)
+
     # ---- assemble the line ---------------------------------------------
     out.update({
         "metric": "hello_world reader throughput",

@@ -1,0 +1,30 @@
+"""Live appending datasets: fault-tolerant incremental discovery
+(docs/live_data.md).
+
+The discovery plane turns "the dataset grew while the job runs" from a
+stale-metadata hazard into a first-class, observable pipeline stage:
+
+* :mod:`~petastorm_tpu.discovery.listing` — the ONE raw-listing path
+  (retried, deadline-bounded, fault-injectable, telemetered;
+  ``tools/check_listing.py`` lints that nothing else lists);
+* :mod:`~petastorm_tpu.discovery.snapshot` — atomic, admission-ordered
+  :class:`DatasetSnapshot` views whose ordinals extend monotonically;
+* :mod:`~petastorm_tpu.discovery.admission` — per-file validation:
+  torn footers quarantine ``pending_retry``, schema drift is classified
+  compatible (admit + warn) vs incompatible (refuse loudly, keep serving);
+* :mod:`~petastorm_tpu.discovery.watcher` — the :class:`DatasetWatcher`
+  polling loop that stages admitted growth for the reader's epoch-boundary
+  plan extension (``make_reader(refresh_interval_s=...)``).
+"""
+from petastorm_tpu.discovery.admission import (AdmittedFile, FileAdmission,
+                                               classify_schema_drift,
+                                               read_new_file_footer)
+from petastorm_tpu.discovery.listing import is_data_file, list_data_files
+from petastorm_tpu.discovery.snapshot import DatasetSnapshot, FileEntry
+from petastorm_tpu.discovery.watcher import DatasetWatcher
+
+__all__ = [
+    "AdmittedFile", "DatasetSnapshot", "DatasetWatcher", "FileAdmission",
+    "FileEntry", "classify_schema_drift", "is_data_file", "list_data_files",
+    "read_new_file_footer",
+]

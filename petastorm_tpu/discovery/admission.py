@@ -1,0 +1,149 @@
+"""File admission: validate every *new* file before it can extend a plan.
+
+Appending datasets fail in characteristic ways — a file is listed while
+still being written (torn/absent footer), uploaded corrupt, or written by
+a producer whose schema drifted. Admission turns each of those into an
+explicit, observable state instead of a mid-epoch crash
+(docs/live_data.md "Admission state machine"):
+
+``discovered`` -> ``pending_retry`` -> ``admitted`` | ``refused``
+
+* **pending_retry** — the footer is unreadable (torn write in progress,
+  transient IO). The file is quarantined through the PR 2
+  :class:`~petastorm_tpu.resilience.RowGroupQuarantine` with
+  ``state='pending_retry'`` and re-validated on every later poll: a file
+  still being written is *retried*, never permanently banned.
+* **admitted** — footer reads, schema matches (or drifts compatibly:
+  added columns are admitted with a warning — the reader only ever
+  projects its planned columns). A previously pending file flips its
+  quarantine record to ``admitted_after_retry``.
+* **refused** — the schema drifted incompatibly (a planned column's type
+  changed or disappeared): refused loudly, and the reader keeps serving
+  from the last good snapshot — graceful degradation, never a crash.
+  Re-validated only when the file's bytes change (size/mtime), so a bad
+  producer doesn't burn a footer read per poll forever.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = ["FileAdmission", "AdmittedFile", "classify_schema_drift",
+           "read_new_file_footer", "DRIFT_IDENTICAL", "DRIFT_COMPATIBLE",
+           "DRIFT_INCOMPATIBLE", "STATE_PENDING", "STATE_ADMITTED",
+           "STATE_REFUSED"]
+
+DRIFT_IDENTICAL = "identical"
+DRIFT_COMPATIBLE = "compatible"
+DRIFT_INCOMPATIBLE = "incompatible"
+
+STATE_PENDING = "pending_retry"
+STATE_ADMITTED = "admitted"
+STATE_REFUSED = "refused"
+
+
+@dataclasses.dataclass
+class FileAdmission:
+    """Mutable per-file admission record (watcher-internal; JSON-safe via
+    :meth:`as_dict`)."""
+
+    path: str
+    state: str = STATE_PENDING
+    attempts: int = 0
+    detail: str = ""
+    drift: Optional[str] = None
+    num_row_groups: int = 0
+    size: int = -1
+    mtime: float = 0.0
+    first_seen_wall: float = 0.0
+    last_checked_wall: float = 0.0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmittedFile:
+    """One validated, admitted file staged for plan extension. ``stats``
+    carries per-row-group :class:`~petastorm_tpu.etl.dataset_metadata.
+    ColumnStats` for the pruner's fields, harvested from the SAME footer
+    read that validated the file — incremental statistics pruning costs
+    zero extra IO (docs/io.md)."""
+
+    path: str
+    num_row_groups: int
+    mtime: float
+    size: int
+    drift: str
+    detail: str = ""
+    stats: Tuple[Dict[str, object], ...] = ()   # one dict per row group
+
+
+def classify_schema_drift(reference_schema, candidate_schema
+                          ) -> Tuple[str, str]:
+    """Classify a new file's Arrow schema against the dataset's.
+
+    Returns ``(kind, detail)`` where ``kind`` is:
+
+    * :data:`DRIFT_IDENTICAL` — same fields, same types;
+    * :data:`DRIFT_COMPATIBLE` — every reference column is present with
+      its type unchanged; the file merely ADDS columns (the classic
+      nullable-column addition). Readers project their planned columns,
+      so the addition is admissible — with a warning, because mixed-file
+      schemas deserve an operator's eyes;
+    * :data:`DRIFT_INCOMPATIBLE` — a reference column is missing or
+      changed type: reads of the planned columns would fail or silently
+      reinterpret bytes, so the file must be refused.
+    """
+    ref = {f.name: f for f in reference_schema}
+    cand = {f.name: f for f in candidate_schema}
+    missing = sorted(set(ref) - set(cand))
+    if missing:
+        return DRIFT_INCOMPATIBLE, f"missing column(s): {', '.join(missing)}"
+    changed = []
+    for name, ref_field in ref.items():
+        if not cand[name].type.equals(ref_field.type):
+            changed.append(f"{name}: {ref_field.type} -> {cand[name].type}")
+    if changed:
+        return DRIFT_INCOMPATIBLE, f"type change(s): {'; '.join(changed)}"
+    added = sorted(set(cand) - set(ref))
+    if added:
+        nullability = "nullable" if all(cand[n].nullable for n in added) \
+            else "NON-nullable"
+        return (DRIFT_COMPATIBLE,
+                f"added {nullability} column(s): {', '.join(added)}")
+    return DRIFT_IDENTICAL, ""
+
+
+def read_new_file_footer(filesystem, path: str, stats_columns=(),
+                         fault_plan=None, worker_id: int = 0):
+    """One validation read of ``path``'s Parquet footer.
+
+    Returns ``(num_row_groups, arrow_schema, per_group_stats)`` where
+    ``per_group_stats`` is a tuple of ``{column: ColumnStats}`` dicts
+    restricted to ``stats_columns`` (empty dicts when no columns are
+    constrained). Raises on unreadable footers — ``OSError`` for IO,
+    ``pyarrow.ArrowInvalid`` (a ``ValueError``) for torn/corrupt bytes —
+    and the caller decides pending-vs-refused. Fires the
+    ``discovery.footer`` fault site per attempt.
+    """
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.etl.dataset_metadata import \
+        _column_stats_for_row_group
+
+    if fault_plan is not None:
+        fault_plan.fire("discovery.footer", key=str(path),
+                        worker_id=worker_id)
+    with filesystem.open(path, "rb") as f:
+        pf = pq.ParquetFile(f)
+        md = pf.metadata
+        schema = pf.schema_arrow
+        columns = set(stats_columns)
+        if columns:
+            stats = tuple(
+                _column_stats_for_row_group(md.row_group(i), columns)
+                for i in range(md.num_row_groups))
+        else:
+            stats = tuple({} for _ in range(md.num_row_groups))
+    return md.num_row_groups, schema, stats

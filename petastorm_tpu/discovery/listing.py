@@ -1,0 +1,147 @@
+"""The one raw-listing path: every directory listing the framework takes
+goes through :func:`list_data_files`, where it is retried under a
+:class:`~petastorm_tpu.resilience.RetryPolicy` (fault site
+``discovery.list``), bounded by a :class:`~petastorm_tpu.resilience.
+StageDeadline`, and timed into ``discovery.list_s`` telemetry.
+
+``tools/check_listing.py`` lints that no module outside
+``petastorm_tpu/discovery/`` calls ``fs.ls``/``find``/``listdir``/``glob``
+directly — a raw listing is an unretried, unobservable IO call on what the
+live-data plane treats as a first-class pipeline stage (docs/live_data.md).
+"""
+from __future__ import annotations
+
+import posixpath
+from typing import List, Optional, Sequence, Union
+
+__all__ = ["list_data_files", "is_data_file", "DEFAULT_LIST_POLICY",
+           "DEFAULT_LIST_DEADLINE"]
+
+
+def _default_list_policy():
+    """Listing default: a couple of quick retries — a flaky list should
+    self-heal, a dead store should fail fast enough that the poll loop's
+    next tick (or planning's caller) sees the error promptly."""
+    from petastorm_tpu.resilience import ExponentialBackoff, RetryPolicy
+    return RetryPolicy(max_attempts=3,
+                       backoff=ExponentialBackoff(base=0.05, multiplier=2.0,
+                                                  cap=1.0),
+                       jitter="none", seed=0)
+
+
+#: Lazily-built module default (import-light: resilience is only pulled in
+#: when a listing actually runs).
+DEFAULT_LIST_POLICY = None
+
+
+def _make_default_deadline():
+    """Listing deadline default: a listing that takes >30s is indistin-
+    guishable from a hung store — discard the attempt (transient) and let
+    the retry/poll machinery own it. Cooperative like every deadline here:
+    a blocked C call cannot be interrupted, but the watcher runs listings
+    off the consumer thread, so planning is never wedged either way."""
+    from petastorm_tpu.resilience import StageDeadline
+    return StageDeadline(soft_s=15.0, hard_s=30.0)
+
+
+class _LazyDeadline:
+    """Module-default placeholder that builds the real StageDeadline on
+    first use (keeps `import petastorm_tpu.discovery` resilience-free)."""
+
+    _real = None
+
+    def start(self, *args, **kwargs):
+        if _LazyDeadline._real is None:
+            _LazyDeadline._real = _make_default_deadline()
+        return _LazyDeadline._real.start(*args, **kwargs)
+
+
+DEFAULT_LIST_DEADLINE = _LazyDeadline()
+
+
+def is_data_file(path: str) -> bool:
+    """The dataset-file filter shared with planning: hidden files and
+    sidecars (``_metadata``/``_common_metadata``/dotfiles) are never data;
+    everything ``*.parquet``/``*.parq`` or extension-less is."""
+    base = posixpath.basename(path)
+    if base.startswith(("_", ".")):
+        return False
+    return (base.endswith(".parquet") or base.endswith(".parq")
+            or "." not in base)
+
+
+def list_data_files(filesystem,
+                    path_or_paths: Union[str, Sequence[str]],
+                    *,
+                    retry_policy=None,
+                    deadline=None,
+                    fault_plan=None,
+                    telemetry=None,
+                    worker_id: int = 0) -> List[str]:
+    """All data-file paths under ``path_or_paths``, sorted for
+    deterministic planning.
+
+    Each attempt fires the ``discovery.list`` fault site, runs under an
+    optional per-attempt :class:`StageDeadline` (an attempt that finishes
+    but overran its hard budget is discarded and retried — a hung or
+    crawling filesystem becomes a classified failure instead of a wedge),
+    and is retried per ``retry_policy`` (default: 3 attempts with a short
+    backoff). Telemetry (when given a registry): ``discovery.list_s``
+    latency histogram, ``discovery.list_retries_total`` and
+    ``discovery.list_failures_total`` counters. Raises the final exception
+    when the policy gives up — callers decide whether that fails planning
+    or just skips a poll.
+    """
+    global DEFAULT_LIST_POLICY
+    if retry_policy is None:
+        if DEFAULT_LIST_POLICY is None:
+            DEFAULT_LIST_POLICY = _default_list_policy()
+        retry_policy = DEFAULT_LIST_POLICY
+    paths = (list(path_or_paths) if isinstance(path_or_paths, (list, tuple))
+             else [path_or_paths])
+    hist = (telemetry.histogram("discovery.list_s")
+            if telemetry is not None else None)
+    retries = (telemetry.counter("discovery.list_retries_total")
+               if telemetry is not None else None)
+    failures = (telemetry.counter("discovery.list_failures_total")
+                if telemetry is not None else None)
+
+    def _attempt() -> List[str]:
+        import time as _time
+        timer = deadline.start() if deadline is not None else None
+        t0 = _time.perf_counter()
+        if fault_plan is not None:
+            fault_plan.fire("discovery.list", key=str(paths[0]),
+                            worker_id=worker_id)
+        found: List[str] = []
+        for p in paths:
+            if filesystem.isdir(p):
+                listed = filesystem.find(p)
+                if timer is not None:
+                    # Cooperative checkpoint between roots: a multi-URL
+                    # view with one crawling member cancels here instead of
+                    # compounding across roots.
+                    timer.check()
+                found.extend(f for f in listed if is_data_file(f))
+            else:
+                found.append(p)
+        if timer is not None:
+            # A slow-but-completed listing past the hard budget is
+            # DISCARDED (StageDeadlineExceeded is IOError -> transient ->
+            # retried): the stream's planning latency stays bounded by
+            # hard_s * max_attempts, never by one pathological list.
+            timer.finish()
+        if hist is not None:
+            hist.observe(_time.perf_counter() - t0)
+        return sorted(found)
+
+    def _on_retry(attempt, exc, delay):
+        if retries is not None:
+            retries.add(1)
+
+    def _on_give_up(attempts, exc):
+        if failures is not None:
+            failures.add(1)
+
+    return retry_policy.call(_attempt, on_retry=_on_retry,
+                             on_give_up=_on_give_up)

@@ -1,0 +1,153 @@
+"""Atomic dataset snapshots for the live-data plane (docs/live_data.md).
+
+A :class:`DatasetSnapshot` is an immutable view of the dataset's admitted
+files in **admission order** — the order that assigns every row group its
+global ordinal. Ordinals are the currency the whole stack trades in
+(epoch plans, mesh shard plans, trace lineage, cursors), and admission
+order is what makes growth *monotonic*: a new file's row groups always get
+ordinals **after** every previously admitted group, so plans extend
+instead of reshuffling and every pre-growth ordinal keeps meaning the same
+bytes forever.
+
+(The sorted listing ``load_row_groups`` plans from is NOT growth-stable —
+an appended file can sort into the middle — which is exactly why the
+snapshot keeps its own order.)
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["FileEntry", "DatasetSnapshot"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FileEntry:
+    """One admitted data file: its row-group count and the global ordinal
+    of its first row group (groups are consecutive within a file)."""
+
+    path: str
+    num_row_groups: int
+    first_ordinal: int
+    mtime: float = 0.0           # wall seconds; 0 = unknown
+    size: int = -1               # bytes; -1 = unknown
+
+    @property
+    def ordinals(self) -> range:
+        return range(self.first_ordinal,
+                     self.first_ordinal + self.num_row_groups)
+
+
+class DatasetSnapshot:
+    """Immutable admitted-file set; see the module docstring.
+
+    ``extended()`` returns a NEW snapshot with more files appended — the
+    watcher swaps whole snapshots atomically, so a reader never observes a
+    half-applied poll.
+    """
+
+    __slots__ = ("files", "snapshot_id", "_paths")
+
+    def __init__(self, files: Sequence[FileEntry], snapshot_id: int = 0):
+        self.files: Tuple[FileEntry, ...] = tuple(files)
+        self.snapshot_id = int(snapshot_id)
+        expected = 0
+        for f in self.files:
+            if f.first_ordinal != expected:
+                raise ValueError(
+                    f"snapshot ordinals must be contiguous in admission "
+                    f"order: {f.path} starts at {f.first_ordinal}, "
+                    f"expected {expected}")
+            expected += f.num_row_groups
+        self._paths = frozenset(f.path for f in self.files)
+
+    # ------------------------------------------------------------- queries
+    @property
+    def total_row_groups(self) -> int:
+        if not self.files:
+            return 0
+        last = self.files[-1]
+        return last.first_ordinal + last.num_row_groups
+
+    @property
+    def paths(self) -> frozenset:
+        return self._paths
+
+    def __contains__(self, path: str) -> bool:
+        return path in self._paths
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    # ------------------------------------------------------------ deriving
+    def extended(self, new_files: Sequence[Tuple[str, int, float, int]],
+                 ) -> "DatasetSnapshot":
+        """A new snapshot with ``(path, num_row_groups, mtime, size)``
+        entries appended after the current ordinal range."""
+        files = list(self.files)
+        next_ordinal = self.total_row_groups
+        for path, n_groups, mtime, size in new_files:
+            if path in self._paths:
+                raise ValueError(f"{path} is already in the snapshot")
+            files.append(FileEntry(path, int(n_groups), next_ordinal,
+                                   mtime=mtime, size=size))
+            next_ordinal += int(n_groups)
+        return DatasetSnapshot(files, snapshot_id=self.snapshot_id + 1)
+
+    def row_group_refs(self, ctx) -> list:
+        """The snapshot as :class:`~petastorm_tpu.etl.dataset_metadata.
+        RowGroupRef` list in ordinal order (hive partition values parsed
+        per file, exactly like planning)."""
+        from petastorm_tpu.etl.dataset_metadata import RowGroupRef
+        out = []
+        for f in self.files:
+            pv = ctx.partition_values_for(f.path)
+            out.extend(RowGroupRef(f.path, i, pv)
+                       for i in range(f.num_row_groups))
+        return out
+
+    # ------------------------------------------------------- (de)serialize
+    def manifest(self, root_path: str) -> List[List[object]]:
+        """JSON-safe ``[[relative_path, num_row_groups], ...]`` in
+        admission order — the cursor-side record that lets a resumed
+        reader rebuild this exact ordinal assignment."""
+        return [[os.path.relpath(f.path, root_path), f.num_row_groups]
+                for f in self.files]
+
+    @staticmethod
+    def from_manifest(manifest: Sequence[Sequence[object]],
+                      root_path: str) -> "DatasetSnapshot":
+        """Rebuild a snapshot from :meth:`manifest` output (paths
+        re-anchored under ``root_path``)."""
+        files: List[FileEntry] = []
+        ordinal = 0
+        for rel, n_groups in manifest:
+            files.append(FileEntry(os.path.join(root_path, rel),
+                                   int(n_groups), ordinal))
+            ordinal += int(n_groups)
+        return DatasetSnapshot(files)
+
+    @staticmethod
+    def from_row_groups(row_groups) -> "DatasetSnapshot":
+        """The base snapshot from a planned ``load_row_groups`` list: files
+        in plan order (each file's groups are consecutive there), ordinals
+        = plan positions."""
+        files: List[FileEntry] = []
+        counts: Dict[str, int] = {}
+        order: List[str] = []
+        for rg in row_groups:
+            if rg.path not in counts:
+                counts[rg.path] = 0
+                order.append(rg.path)
+            counts[rg.path] += 1
+        ordinal = 0
+        for path in order:
+            files.append(FileEntry(path, counts[path], ordinal))
+            ordinal += counts[path]
+        return DatasetSnapshot(files)
+
+    def __repr__(self):
+        return (f"DatasetSnapshot(id={self.snapshot_id}, "
+                f"files={len(self.files)}, "
+                f"row_groups={self.total_row_groups})")

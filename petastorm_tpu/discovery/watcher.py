@@ -1,0 +1,402 @@
+"""DatasetWatcher: source discovery as a first-class pipeline stage.
+
+Re-lists the store (between epochs, or from a background poll thread),
+diffs the listing against the current :class:`~petastorm_tpu.discovery.
+snapshot.DatasetSnapshot`, validates every new file through the admission
+state machine (:mod:`petastorm_tpu.discovery.admission`), and stages
+admitted growth for the reader to fold into its plan at a safe point.
+tf.data's service design treats discovery the same way — an observable
+stage, not a one-shot plan-time event (PAPERS.md).
+
+Robustness posture (docs/live_data.md):
+
+* listings run through :func:`~petastorm_tpu.discovery.listing.
+  list_data_files` — retried under the PR 2 :class:`RetryPolicy` (fault
+  site ``discovery.list``), bounded by a PR 4 :class:`StageDeadline`;
+* a failed poll (retries exhausted) KEEPS the last good snapshot: the
+  reader never sees a half-listing, and serving is never interrupted by a
+  flaky store — the failure is counted, evented, and retried next poll;
+* torn/corrupt new footers quarantine ``pending_retry`` (re-validated
+  every poll — a file still being written is not banned); incompatible
+  schema drift is refused loudly while serving continues.
+
+Telemetry (``discovery.*``, docs/observability.md): files_discovered /
+files_admitted / files_quarantined / files_refused / rowgroups_admitted
+counters, files_pending gauge, ``list_s`` latency histogram,
+``list_retries_total`` / ``list_failures_total``, ``snapshot_age_s`` (time
+since the last successful poll) and ``ingest_lag_s`` (now minus the
+newest admitted file's mtime — the freshness number an SLO rule gates on:
+``telemetry check --slo "ingest_lag_s<=30"``).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional
+
+from petastorm_tpu.discovery.admission import (AdmittedFile, DRIFT_COMPATIBLE,
+                                               DRIFT_INCOMPATIBLE,
+                                               FileAdmission, STATE_ADMITTED,
+                                               STATE_PENDING, STATE_REFUSED,
+                                               classify_schema_drift,
+                                               read_new_file_footer)
+from petastorm_tpu.discovery.listing import list_data_files
+from petastorm_tpu.discovery.snapshot import DatasetSnapshot
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DatasetWatcher"]
+
+
+class DatasetWatcher:
+    """Incremental file discovery over one dataset.
+
+    :param ctx: the reader's :class:`~petastorm_tpu.etl.dataset_metadata.
+        DatasetContext` (filesystem + roots)
+    :param base_snapshot: the construction-time
+        :class:`DatasetSnapshot` — files already in the plan
+    :param reference_schema: the dataset's Arrow schema; new files are
+        drift-classified against it (``None`` skips the check)
+    :param poll_interval_s: background poll period; ``None``/0 = no
+        thread, polls happen only when :meth:`poll_once` is called (the
+        reader's between-epochs mode)
+    :param retry_policy: listing retry policy (default: the listing
+        module's 3-attempt policy)
+    :param deadline: per-attempt :class:`StageDeadline` on listings
+    :param fault_plan: PR 2 fault plan — sites ``discovery.list`` and
+        ``discovery.footer`` fire here
+    :param telemetry: the pipeline registry (``discovery.*`` metrics)
+    :param quarantine: the reader's :class:`RowGroupQuarantine`; torn new
+        files land there with ``state='pending_retry'``
+    :param stats_columns: columns whose per-row-group statistics to
+        harvest from validation footers (the pruner's constrained fields)
+    """
+
+    def __init__(self, ctx, *, base_snapshot: DatasetSnapshot,
+                 reference_schema=None, poll_interval_s: Optional[float] = None,
+                 retry_policy=None, deadline=None, fault_plan=None,
+                 telemetry=None, quarantine=None, stats_columns=()):
+        self._ctx = ctx
+        self._reference_schema = reference_schema
+        self._poll_interval_s = (float(poll_interval_s)
+                                 if poll_interval_s else None)
+        self._retry_policy = retry_policy
+        self._deadline = deadline
+        self._fault_plan = fault_plan
+        self._telemetry = telemetry
+        self._quarantine = quarantine
+        self._stats_columns = tuple(stats_columns)
+
+        self._lock = threading.Lock()
+        #: Serializes whole discovery passes: the background poll thread
+        #: and a consumer-side ``refresh_dataset()``/``reset()`` poll can
+        #: otherwise validate the same new file concurrently and stage it
+        #: twice (``drain_staged`` would then crash extending the
+        #: snapshot with a duplicate path).
+        self._poll_lock = threading.Lock()
+        self._snapshot = base_snapshot
+        self._pending: Dict[str, FileAdmission] = {}
+        self._refused: Dict[str, FileAdmission] = {}
+        #: Validated files staged for plan extension, admission order.
+        self._staged: List[AdmittedFile] = []
+        #: Lock-free fast-path flag the reader polls per __next__.
+        self.has_growth = False
+
+        self._polls = 0
+        self._failed_polls = 0
+        self._last_poll_mono: Optional[float] = None
+        self._newest_admitted_mtime = 0.0
+        #: Max (admission wall time - file mtime) seen — the per-file
+        #: freshness the bench bounds against the poll interval.
+        self._max_admission_lag_s = 0.0
+        self._admission_log: List[dict] = []
+
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        if telemetry is not None:
+            self._c_discovered = telemetry.counter("discovery.files_discovered")
+            self._c_admitted = telemetry.counter("discovery.files_admitted")
+            self._c_quarantined = telemetry.counter(
+                "discovery.files_quarantined")
+            self._c_refused = telemetry.counter("discovery.files_refused")
+            self._c_groups = telemetry.counter("discovery.rowgroups_admitted")
+            self._c_drift = telemetry.counter("discovery.schema_drift_total")
+            self._c_polls = telemetry.counter("discovery.polls_total")
+            telemetry.gauge("discovery.files_pending",
+                            lambda: float(len(self._pending)))
+            telemetry.gauge("discovery.snapshot_age_s", self._snapshot_age_s)
+            telemetry.gauge("discovery.ingest_lag_s", self._ingest_lag_s)
+        else:
+            self._c_discovered = self._c_admitted = self._c_quarantined = \
+                self._c_refused = self._c_groups = self._c_drift = \
+                self._c_polls = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "DatasetWatcher":
+        """Start the background poll thread (``poll_interval_s`` mode)."""
+        if self._poll_interval_s is None:
+            raise ValueError("start() needs poll_interval_s > 0; "
+                             "epoch-boundary mode calls poll_once() instead")
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        name="pt-discovery", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _poll_loop(self) -> None:
+        while not self._stop_event.wait(self._poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the watcher must outlive polls
+                logger.warning("discovery poll failed; keeping the last "
+                               "good snapshot", exc_info=True)
+
+    # --------------------------------------------------------------- gauges
+    def _snapshot_age_s(self) -> float:
+        if self._last_poll_mono is None:
+            return 0.0
+        return time.monotonic() - self._last_poll_mono
+
+    def _ingest_lag_s(self) -> float:
+        if not self._newest_admitted_mtime:
+            return 0.0
+        # wall-clock-ok: lag is (now - file mtime), both wall-clock by
+        # nature; sampled lazily at snapshot time, never on the hot path.
+        return max(0.0, time.time() - self._newest_admitted_mtime)
+
+    # ----------------------------------------------------------------- poll
+    def poll_once(self) -> dict:
+        """One discovery pass: list, diff, validate. Returns a summary
+        dict. A listing failure (retries exhausted) is swallowed into the
+        summary — the last good snapshot stays authoritative and the next
+        poll tries again; validation errors park files ``pending_retry``.
+        Whole passes are serialized (a background tick and an explicit
+        ``refresh_dataset()`` must not double-admit a file)."""
+        with self._poll_lock:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> dict:
+        if self._c_polls is not None:
+            self._c_polls.add(1)
+        with self._lock:
+            self._polls += 1
+        try:
+            listed = list_data_files(
+                self._ctx.filesystem, self._ctx.path_or_paths,
+                retry_policy=self._retry_policy, deadline=self._deadline,
+                fault_plan=self._fault_plan, telemetry=self._telemetry)
+        except Exception as e:  # noqa: BLE001 - degrade, don't interrupt
+            with self._lock:
+                self._failed_polls += 1
+            if self._telemetry is not None:
+                self._telemetry.record_event(
+                    "discovery.list_failed", {"error": repr(e)[:200]})
+            logger.warning("dataset listing failed after retries (%r); "
+                           "keeping the last good snapshot", e)
+            return {"ok": False, "error": repr(e)}
+        self._last_poll_mono = time.monotonic()
+
+        with self._lock:
+            known = set(self._snapshot.paths)
+            known.update(a.path for a in self._staged)
+            pending_now = list(self._pending.values())
+            refused_now = dict(self._refused)
+        new_paths = [p for p in listed
+                     if p not in known and p not in self._pending
+                     and p not in refused_now]
+        summary = {"ok": True, "listed": len(listed),
+                   "new": len(new_paths), "admitted": 0, "pending": 0,
+                   "refused": 0}
+        now_wall = time.time()  # wall-clock-ok: admission provenance
+        for path in new_paths:
+            if self._c_discovered is not None:
+                self._c_discovered.add(1)
+            adm = FileAdmission(path=path, first_seen_wall=now_wall)
+            self._validate(adm, summary)
+        for adm in pending_now:
+            self._validate(adm, summary)
+        # Refused files are re-validated only when their bytes changed —
+        # a bad producer must not cost a footer read per poll forever.
+        for path, adm in refused_now.items():
+            info = self._safe_info(path)
+            if info is None:
+                continue
+            size, mtime = info
+            if size != adm.size or mtime != adm.mtime:
+                with self._lock:
+                    self._refused.pop(path, None)
+                self._validate(adm, summary)
+        with self._lock:
+            summary["pending_total"] = len(self._pending)
+            summary["staged_total"] = len(self._staged)
+        return summary
+
+    def _safe_info(self, path: str):
+        try:
+            info = self._ctx.filesystem.info(path)
+            return int(info.get("size", -1)), float(info.get("mtime", 0.0))
+        except (OSError, IOError, ValueError, KeyError):
+            return None
+
+    def _validate(self, adm: FileAdmission, summary: dict) -> None:
+        adm.attempts += 1
+        adm.last_checked_wall = time.time()  # wall-clock-ok: provenance
+        info = self._safe_info(adm.path)
+        if info is not None:
+            adm.size, adm.mtime = info
+        try:
+            n_groups, schema, stats = read_new_file_footer(
+                self._ctx.filesystem, adm.path,
+                stats_columns=self._stats_columns,
+                fault_plan=self._fault_plan)
+        except (OSError, IOError, ValueError) as e:
+            # Torn footer / transient IO: a file still being written reads
+            # exactly like a corrupt one — park it pending_retry and look
+            # again next poll. Never a permanent ban, never a crash.
+            first_time = adm.state != STATE_PENDING or adm.attempts == 1
+            adm.state = STATE_PENDING
+            adm.detail = repr(e)[:300]
+            with self._lock:
+                self._pending[adm.path] = adm
+            if first_time:
+                if self._c_quarantined is not None:
+                    self._c_quarantined.add(1)
+                self._record_quarantine(adm, e)
+            summary["pending"] += 1
+            return
+
+        if self._reference_schema is not None:
+            drift, detail = classify_schema_drift(self._reference_schema,
+                                                  schema)
+        else:
+            drift, detail = "identical", ""
+        adm.drift, adm.num_row_groups = drift, n_groups
+        if drift == DRIFT_INCOMPATIBLE:
+            adm.state = STATE_REFUSED
+            adm.detail = detail
+            with self._lock:
+                self._pending.pop(adm.path, None)
+                self._refused[adm.path] = adm
+            if self._c_refused is not None:
+                self._c_refused.add(1)
+            if self._c_drift is not None:
+                self._c_drift.add(1)
+            if self._telemetry is not None:
+                self._telemetry.record_event(
+                    "discovery.schema_refused",
+                    {"path": adm.path, "detail": detail[:200]})
+            # Loud by contract: an incompatible producer is an operator
+            # problem TODAY, even though serving continues on the last
+            # good snapshot.
+            warnings.warn(
+                f"live discovery refused {adm.path}: incompatible schema "
+                f"drift ({detail}). The reader continues on the last good "
+                f"snapshot; fix the producer (docs/live_data.md).")
+            logger.error("discovery refused %s: %s", adm.path, detail)
+            summary["refused"] += 1
+            return
+
+        was_pending = adm.state == STATE_PENDING and adm.attempts > 1
+        adm.state = STATE_ADMITTED
+        adm.detail = detail
+        staged = AdmittedFile(path=adm.path, num_row_groups=n_groups,
+                              mtime=adm.mtime, size=adm.size, drift=drift,
+                              detail=detail, stats=stats)
+        now_wall = time.time()  # wall-clock-ok: ingest-lag arithmetic
+        with self._lock:
+            self._pending.pop(adm.path, None)
+            self._staged.append(staged)
+            self.has_growth = True
+            if adm.mtime:
+                self._newest_admitted_mtime = max(
+                    self._newest_admitted_mtime, adm.mtime)
+                self._max_admission_lag_s = max(
+                    self._max_admission_lag_s,
+                    max(0.0, now_wall - adm.mtime))
+            self._admission_log.append(
+                {"path": adm.path, "row_groups": n_groups, "drift": drift,
+                 "attempts": adm.attempts, "wall_time": now_wall})
+        if self._c_admitted is not None:
+            self._c_admitted.add(1)
+        if self._c_groups is not None:
+            self._c_groups.add(n_groups)
+        if drift == DRIFT_COMPATIBLE:
+            if self._c_drift is not None:
+                self._c_drift.add(1)
+            warnings.warn(
+                f"live discovery admitted {adm.path} with compatible "
+                f"schema drift ({detail}); readers project their planned "
+                f"columns, but mixed-file schemas deserve a look "
+                f"(docs/live_data.md)")
+        if was_pending and self._quarantine is not None:
+            self._quarantine.mark_admitted(adm.path)
+        if self._telemetry is not None:
+            self._telemetry.record_event(
+                "discovery.file_admitted",
+                {"path": adm.path, "row_groups": n_groups, "drift": drift,
+                 "attempts": adm.attempts})
+        summary["admitted"] += 1
+
+    def _record_quarantine(self, adm: FileAdmission, exc: Exception) -> None:
+        if self._quarantine is None:
+            return
+        from petastorm_tpu.resilience.faults import InjectedFault
+        from petastorm_tpu.resilience.quarantine import QuarantineRecord
+        self._quarantine.add(QuarantineRecord(
+            path=adm.path, row_group=None,
+            error_type=type(exc).__name__,
+            error_message=str(exc)[:500],
+            attempts=adm.attempts,
+            injected=isinstance(exc, InjectedFault),
+            state=STATE_PENDING,
+            wall_time=adm.last_checked_wall))
+
+    # ------------------------------------------------------------- draining
+    def drain_staged(self) -> List[AdmittedFile]:
+        """Atomically take every staged admitted file (admission order) and
+        advance the snapshot over them. The caller (the reader's growth
+        safe point) extends its plan with exactly this list."""
+        with self._lock:
+            staged, self._staged = self._staged, []
+            self.has_growth = False
+            if staged:
+                self._snapshot = self._snapshot.extended(
+                    [(a.path, a.num_row_groups, a.mtime, a.size)
+                     for a in staged])
+        return staged
+
+    @property
+    def snapshot(self) -> DatasetSnapshot:
+        with self._lock:
+            return self._snapshot
+
+    # --------------------------------------------------------------- report
+    def report(self) -> dict:
+        """JSON-safe admission state readout (``Reader.
+        dataset_growth_report()`` merges this with the reader's applied
+        growth log; schema in docs/live_data.md)."""
+        with self._lock:
+            return {
+                "polls": self._polls,
+                "failed_polls": self._failed_polls,
+                "snapshot_id": self._snapshot.snapshot_id,
+                "files_known": len(self._snapshot),
+                "row_groups_known": self._snapshot.total_row_groups,
+                "staged": [a.path for a in self._staged],
+                "pending": [a.as_dict() for a in self._pending.values()],
+                "refused": [a.as_dict() for a in self._refused.values()],
+                "admissions": list(self._admission_log),
+                "snapshot_age_s": round(self._snapshot_age_s(), 3),
+                "ingest_lag_s": round(self._ingest_lag_s(), 3),
+                "max_admission_lag_s": round(self._max_admission_lag_s, 3),
+            }

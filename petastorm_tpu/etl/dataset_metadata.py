@@ -115,7 +115,8 @@ def _column_stats_for_row_group(rg_meta, columns: set) -> Dict[str, ColumnStats]
     return out
 
 
-def load_row_group_stats(ctx: DatasetContext, row_groups, columns) \
+def load_row_group_stats(ctx: DatasetContext, row_groups, columns,
+                         telemetry=None, retry_policy=None) \
         -> Dict[tuple, Dict[str, ColumnStats]]:
     """Per-row-group column statistics for the given
     :class:`RowGroupRef` list — ``{(path, ordinal): {column: ColumnStats}}``
@@ -126,7 +127,13 @@ def load_row_group_stats(ctx: DatasetContext, row_groups, columns) \
     sidecar when it exists (ONE read covers every file), else a
     ThreadPool footer scan over just the files the refs touch. Files whose
     footers cannot be read contribute no stats (their groups are simply
-    never pruned — planning must not fail on what is only an optimization).
+    never pruned — planning must not fail on what is only an optimization)
+    — but the failures are **classified and counted**, never silently
+    swallowed: transient IO errors are retried under ``retry_policy``
+    (default: the reader workers' read policy) before giving up, every
+    give-up bumps ``io.stats_footer_errors_total`` and logs a warning with
+    the classifier's verdict, so a flaky store that quietly disables
+    pruning is visible in telemetry instead of just slow.
     """
     columns = set(columns)
     wanted_paths = {rg.path for rg in row_groups}
@@ -150,12 +157,36 @@ def load_row_group_stats(ctx: DatasetContext, row_groups, columns) \
     missing_paths = sorted(
         {rg.path for rg in row_groups if (rg.path, rg.row_group) not in out})
     if missing_paths:
+        from petastorm_tpu.resilience import (DEFAULT_READ_POLICY, PERMANENT,
+                                              default_io_classifier)
+        policy = retry_policy if retry_policy is not None \
+            else DEFAULT_READ_POLICY
+        errors = (telemetry.counter("io.stats_footer_errors_total")
+                  if telemetry is not None else None)
+
+        def _read_footer(path):
+            with ctx.filesystem.open(path, "rb") as f:
+                return pq.ParquetFile(f).metadata
+
         def _scan(path):
             try:
-                with ctx.filesystem.open(path, "rb") as f:
-                    md = pq.ParquetFile(f).metadata
-            except (OSError, IOError, ValueError):
-                return path, None  # unreadable footer: no stats, no pruning
+                md = policy.call(_read_footer, path)
+            except (OSError, IOError, ValueError) as e:
+                # Unreadable footer: no stats, no pruning for this file's
+                # groups — an optimization loss, not a planning failure.
+                # Classified + counted so a flaky store can't silently
+                # disable pruning (the old bare skip read as "no stats
+                # recorded" forever).
+                verdict = default_io_classifier(e)
+                if errors is not None:
+                    errors.add(1)
+                logger.warning(
+                    "statistics footer scan failed for %s (%s, %s%s); its "
+                    "row groups will not be pruned", path,
+                    type(e).__name__, verdict,
+                    "" if verdict == PERMANENT
+                    else f" after {policy.max_attempts} attempt(s)")
+                return path, None
             return path, [_column_stats_for_row_group(md.row_group(i), columns)
                           for i in range(md.num_row_groups)]
 
@@ -191,24 +222,18 @@ class DatasetContext:
         return self.path_or_paths[0] if self.is_multi_path else self.path_or_paths
 
     def file_paths(self) -> List[str]:
-        """All data file paths (metadata sidecars and hidden files excluded),
-        sorted for deterministic planning."""
+        """All data file paths (metadata sidecars and hidden files
+        excluded), sorted for deterministic planning. Routed through the
+        discovery plane's single listing path (docs/live_data.md) under
+        its default retry policy — plan-time contexts predate the
+        reader's fault plan/telemetry/deadline, so only the watcher's
+        polls additionally carry those. ``tools/check_listing.py`` lints
+        that no raw ``fs.ls``/``find`` listing exists outside
+        ``petastorm_tpu/discovery/``."""
         if self._file_paths is None:
-            paths = self.path_or_paths if self.is_multi_path else [self.path_or_paths]
-            found = []
-            for p in paths:
-                if self.filesystem.isdir(p):
-                    for f in self.filesystem.find(p):
-                        base = posixpath.basename(f)
-                        if base.startswith(("_", ".")):
-                            continue
-                        if not (base.endswith(".parquet") or base.endswith(".parq")
-                                or "." not in base):
-                            continue
-                        found.append(f)
-                else:
-                    found.append(p)
-            self._file_paths = sorted(found)
+            from petastorm_tpu.discovery.listing import list_data_files
+            self._file_paths = list_data_files(self.filesystem,
+                                               self.path_or_paths)
         return self._file_paths
 
     def arrow_schema(self):

@@ -351,6 +351,15 @@ class MeshDataLoader(LoaderBase):
         if num_rowgroups < 1:
             raise ValueError(f"dataset has no row groups ({num_rowgroups})")
         self._G = num_rowgroups
+        from petastorm_tpu.utils.growth import GrowthSchedule
+        #: Live-growth schedule (docs/live_data.md): epoch e plans over
+        #: ``_g_at(e)`` ordinals, so growth admitted mid-run extends
+        #: FUTURE epochs monotonically while every already-planned epoch
+        #: keeps its exact shard plans.
+        self._g_schedule = GrowthSchedule.base(self._G)
+        #: Latest epoch whose per-host plan has been minted (None before
+        #: the first); growth lands at ``_planned_through + 1``.
+        self._planned_through: Optional[int] = None
         self._fifo = bool(getattr(reader_factory, "fifo_delivery", False))
         self._seed = seed
         if num_epochs is not None and num_epochs < 1:
@@ -438,15 +447,102 @@ class MeshDataLoader(LoaderBase):
             "num_rowgroups": self._G, "num_hosts": self._H}
 
     # ------------------------------------------------------------- planning
+    def _g_at(self, epoch: int) -> int:
+        """Row-group count of ``epoch`` under the growth schedule."""
+        return self._g_schedule.size_at(epoch)
+
+    def admit_growth(self, num_rowgroups: int,
+                     fold_into_live_epoch: bool = False) -> dict:
+        """Live appending datasets (docs/live_data.md): the dataset now
+        has ``num_rowgroups`` total row groups (monotonic — ordinals
+        ``[old_G, num_rowgroups)`` are NEW, appended after the existing
+        range, e.g. by a :class:`~petastorm_tpu.discovery.DatasetWatcher`
+        whose snapshot grew).
+
+        Default: growth takes effect at the next not-yet-planned epoch —
+        every future ``epoch_plan`` shards the extended ordinal range with
+        the same seeded arithmetic, so determinism and cursors survive
+        exactly like the single-reader plane. With
+        ``fold_into_live_epoch=True`` the new ordinals ALSO join the epoch
+        currently running, round-robined to live hosts as recovery sources
+        — the PR 7 reshard machinery — and their deliveries fold into the
+        cursor's ``recovered`` set, so mid-epoch checkpoints stay valid.
+        Returns ``{"admitted", "effective_epoch", "folded"}``."""
+        with self._cond:
+            new_g = int(num_rowgroups)
+            if new_g < self._G:
+                raise ValueError(
+                    f"mesh growth is monotonic: {new_g} row groups < "
+                    f"current {self._G} (a live dataset only appends)")
+            if new_g == self._G:
+                return {"admitted": 0, "effective_epoch": None, "folded": 0}
+            new_ordinals = list(range(self._G, new_g))
+            self._G = new_g
+            if self._planned_through is not None:
+                proposed = self._planned_through + 1
+            elif self._resume_offsets is not None:
+                # Resumed but not yet running: the cursor's epoch was
+                # planned by the PREVIOUS run (its per-host offsets index
+                # that plan), so growth must not rewrite it — same rule
+                # the while-down path in _load_resume_state applies.
+                proposed = self._resume_epoch + 1
+            else:
+                proposed = self._resume_epoch
+            effective = self._g_schedule.extend(proposed, new_g)
+            folded = 0
+            if fold_into_live_epoch and self._feeds and not self._epoch_done \
+                    and self._fatal is None:
+                if self._multiprocess:
+                    # Each process folds only ITS shard of the new range
+                    # (the same i % H rule epoch_plan uses): every process
+                    # runs this method, and handing the full range to the
+                    # one local feed would deliver every new group H times
+                    # across the mesh.
+                    fold_ordinals = [o for i, o in enumerate(new_ordinals)
+                                     if i % self._H == self._local_host]
+                    active = [self._feeds[self._local_host]]
+                else:
+                    fold_ordinals = new_ordinals
+                    active = self._feeds
+                live = [f for f in active
+                        if f.lost is None and not f.exhausted
+                        and not f.killed.is_set()]
+                if live and fold_ordinals:
+                    buckets: List[List[int]] = [[] for _ in live]
+                    for i, o in enumerate(fold_ordinals):
+                        buckets[i % len(live)].append(o)
+                    added = 0
+                    for f, bucket in zip(live, buckets):
+                        if bucket:
+                            f.sources.append(_Source(bucket, recovery=True))
+                            added += 1
+                    self._outstanding += added
+                    folded = len(fold_ordinals)
+            self.telemetry.counter("mesh.growth_admitted").add(
+                len(new_ordinals))
+            self.telemetry.record_event(
+                "mesh.growth", {"new_rowgroups": len(new_ordinals),
+                                "effective_epoch": effective,
+                                "folded": folded})
+            self._cond.notify_all()
+        logger.info("mesh growth admitted: %d new row group(s), effective "
+                    "from epoch %d%s", len(new_ordinals), effective,
+                    f" ({folded} folded into the live epoch)" if folded
+                    else "")
+        return {"admitted": len(new_ordinals), "effective_epoch": effective,
+                "folded": folded}
+
     def epoch_plan(self, epoch: int) -> List[List[int]]:
         """Per-host row-group ordinal lists for ``epoch`` — the reader's
         own ``index % shard_count`` arithmetic (with the seeded
         pre-shuffle) applied to ordinals, so host h's list is exactly what
         a ``cur_shard=h, shard_count=H`` reader would plan. Hosts may come
         up empty on tiny datasets; unlike a standalone reader that is not
-        an error here (the host simply feeds nothing this epoch)."""
+        an error here (the host simply feeds nothing this epoch). Under
+        live growth the ordinal range is ``_g_at(epoch)`` — the count in
+        force when the epoch was (or will be) planned."""
         from petastorm_tpu.reader import Reader
-        ordinals = list(range(self._G))
+        ordinals = list(range(self._g_at(epoch)))
         shard_seed = (None if self._seed is None
                       else int(self._seed) + int(epoch))
         plan: List[List[int]] = []
@@ -461,14 +557,57 @@ class MeshDataLoader(LoaderBase):
     def _load_resume_state(self, state: dict) -> None:
         if not isinstance(state, dict) or "hosts" not in state:
             raise ValueError(f"not a MeshDataLoader state_dict: {state!r}")
-        if state.get("num_rowgroups") != self._G \
-                or state.get("num_hosts") != self._H:
+        if state.get("num_hosts") != self._H:
             raise ValueError(
-                f"resume_state was saved over {state.get('num_rowgroups')} "
-                f"row groups / {state.get('num_hosts')} hosts but this "
-                f"loader plans {self._G} / {self._H}; the per-host shard "
-                f"cursors do not transfer")
+                f"resume_state was saved over {state.get('num_hosts')} "
+                f"hosts but this loader plans {self._H}; the per-host "
+                f"shard cursors do not transfer")
         self._resume_epoch = int(state.get("epoch", 0))
+        recorded = int(state.get("num_rowgroups", -1))
+        growth = [(int(e), int(g)) for e, g in state.get("growth", [])]
+        if growth:
+            # Growth-aware cursor (docs/live_data.md): adopt the recorded
+            # schedule so the resumed epoch replans over the range its
+            # offsets indexed; groups that appeared while the job was down
+            # join from the NEXT epoch.
+            if growth[0][0] != 0 or growth[-1][1] != recorded:
+                raise ValueError(f"malformed growth table in resume_state: "
+                                 f"{growth} (final size must equal "
+                                 f"num_rowgroups={recorded})")
+            if self._G < recorded:
+                raise ValueError(
+                    f"resume_state records {recorded} row groups but the "
+                    f"dataset now has {self._G}: live datasets only "
+                    f"append — is this the right dataset?")
+            from petastorm_tpu.utils.growth import GrowthSchedule
+            probed = self._G
+            self._g_schedule = GrowthSchedule(growth)
+            self._G = recorded
+            if probed > recorded:
+                # While-down growth: extend from the first epoch past both
+                # the cursor and the recorded schedule (the schedule
+                # clamps) — nothing at or before it has been planned by
+                # this loader.
+                self._g_schedule.extend(self._resume_epoch + 1, probed)
+                self._G = probed
+        elif recorded >= 0 and self._G > recorded:
+            # While-down growth on a cursor saved BEFORE the first
+            # admission (no growth table yet): adopt it exactly like the
+            # growth-aware branch — the resumed epoch replans over the
+            # recorded range, the extra groups join from the next epoch.
+            from petastorm_tpu.utils.growth import GrowthSchedule
+            probed = self._G
+            self._g_schedule = GrowthSchedule.base(recorded)
+            self._g_schedule.extend(self._resume_epoch + 1, probed)
+            logger.info(
+                "mesh resume: dataset grew %d -> %d row groups while the "
+                "job was down; the new ordinals join from epoch %d",
+                recorded, probed, self._resume_epoch + 1)
+        elif recorded != self._G:
+            raise ValueError(
+                f"resume_state was saved over {recorded} row groups but "
+                f"this loader plans {self._G}; live datasets only append "
+                f"— is this the right dataset? (docs/live_data.md)")
         hosts = state["hosts"]
         if isinstance(hosts, dict):
             offsets = [int(hosts.get(str(h), hosts.get(h, 0)))
@@ -895,6 +1034,10 @@ class MeshDataLoader(LoaderBase):
 
     def _epoch_batches(self, epoch: int, offsets: Optional[List[int]],
                        recovered=()):
+        with self._cond:
+            # Growth admitted from here on lands at epoch + 1: this
+            # epoch's per-host plans are being minted NOW.
+            self._planned_through = epoch
         plan = self.epoch_plan(epoch)
         stop = threading.Event()
         self._epoch_resharded = bool(recovered)
@@ -1065,6 +1208,12 @@ class MeshDataLoader(LoaderBase):
                            else [self._feeds[self._local_host]])}
         state = {"mesh": True, "epoch": epoch, "hosts": hosts,
                  "num_rowgroups": self._G, "num_hosts": self._H}
+        if self._g_schedule.grown:
+            # Live growth (docs/live_data.md): the segment table pins
+            # which ordinal range each epoch's shard plans covered, so a
+            # resumed loader replans the cursor's epoch over the SAME
+            # range even though the dataset kept growing.
+            state["growth"] = [[e, g] for e, g in self._g_schedule.segments]
         if not fresh and self._recovered_live:
             # Reshard fold-in (docs/mesh.md): these global ordinals were
             # delivered by recovery sources; together with the per-host

@@ -378,7 +378,8 @@ def make_reader(dataset_url,
                 rowgroup_subset: Optional[Sequence[int]] = None,
                 row_materialization: str = "eager",
                 sample_order: str = "free",
-                shuffle_window: int = 0):
+                shuffle_window: int = 0,
+                refresh_interval_s: Optional[float] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -524,6 +525,25 @@ def make_reader(dataset_url,
         resumable and has a **provable mixing radius** (a row group is
         delivered within ``shuffle_window`` plan positions of its slot;
         docs/determinism.md for the math). ``0`` = exact plan order.
+    :param refresh_interval_s: **live appending datasets**
+        (docs/live_data.md): start a :class:`~petastorm_tpu.discovery.
+        DatasetWatcher` that re-lists the store — every
+        ``refresh_interval_s`` seconds from a background thread, or (with
+        ``0``) synchronously at each ``reset()``/:meth:`Reader.
+        refresh_dataset` call — validates every new file (torn footers
+        quarantine ``pending_retry`` and are re-tried next poll;
+        incompatible schema drift is refused loudly while serving
+        continues on the last good snapshot) and extends the plan
+        **monotonically**: new row groups get ordinals after the existing
+        range, effective from a not-yet-planned epoch, so deterministic
+        mode, already-planned epochs, mid-epoch cursors, and statistics
+        pruning (run incrementally on just the new footers) all survive
+        growth. Surfaces: :meth:`Reader.dataset_growth_report`,
+        ``discovery.*`` telemetry, and the ``ingest_lag_s`` SLO rule.
+        Typically combined with ``num_epochs=None``. Mutually exclusive
+        with ``rowgroup_subset`` (the mesh layer folds growth itself,
+        docs/mesh.md) and ``shard_seed`` (a pre-shuffled shard stream
+        cannot extend monotonically). ``None`` = today's static snapshot.
 
     Parity: reference reader.py:60.
     """
@@ -601,7 +621,8 @@ def make_reader(dataset_url,
                   rowgroup_subset=rowgroup_subset,
                   row_materialization=row_materialization,
                   sample_order=sample_order,
-                  shuffle_window=shuffle_window)
+                  shuffle_window=shuffle_window,
+                  refresh_interval_s=refresh_interval_s)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -650,7 +671,8 @@ def make_batch_reader(dataset_url_or_urls,
                       serializer=None,
                       rowgroup_subset: Optional[Sequence[int]] = None,
                       sample_order: str = "free",
-                      shuffle_window: int = 0):
+                      shuffle_window: int = 0,
+                      refresh_interval_s: Optional[float] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -696,6 +718,10 @@ def make_batch_reader(dataset_url_or_urls,
     :func:`make_reader` (docs/determinism.md): ``'deterministic'`` pins
     the delivered batch stream to ``f(seed, epoch_idx, shard_plan)``
     across every pool type, knob, fault, and resume point.
+    ``refresh_interval_s`` enables live appending-dataset discovery
+    exactly as in :func:`make_reader` (docs/live_data.md) — plain Parquet
+    stores that other producers append to are the primary live-data
+    shape.
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -777,7 +803,8 @@ def make_batch_reader(dataset_url_or_urls,
                   readahead_max_bytes=readahead_max_bytes,
                   rowgroup_subset=rowgroup_subset,
                   sample_order=sample_order,
-                  shuffle_window=shuffle_window)
+                  shuffle_window=shuffle_window,
+                  refresh_interval_s=refresh_interval_s)
 
 
 class Reader:
@@ -799,7 +826,8 @@ class Reader:
                  rowgroup_pruning=True, readahead_depth=None,
                  readahead_max_bytes=None, pool_factory=None,
                  rowgroup_subset=None, row_materialization="eager",
-                 sample_order="free", shuffle_window=0):
+                 sample_order="free", shuffle_window=0,
+                 refresh_interval_s=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -922,12 +950,63 @@ class Reader:
                 else:
                     self.row_materialization = "lazy"
 
+        # ---------------- live appending datasets (docs/live_data.md)
+        if refresh_interval_s is not None:
+            if refresh_interval_s < 0:
+                raise ValueError(f"refresh_interval_s must be >= 0, "
+                                 f"got {refresh_interval_s}")
+            if rowgroup_subset is not None:
+                raise ValueError(
+                    "refresh_interval_s and rowgroup_subset are mutually "
+                    "exclusive: an explicit ordinal plan is frozen by "
+                    "construction — the mesh layer folds growth into its "
+                    "own shard plans (MeshDataLoader.admit_growth, "
+                    "docs/mesh.md)")
+            if shard_seed is not None:
+                raise ValueError(
+                    "refresh_interval_s cannot compose with shard_seed: a "
+                    "pre-shuffled shard partition reorders on every new "
+                    "file, so growth could not extend monotonically "
+                    "(docs/live_data.md)")
+            if ctx.is_multi_path:
+                raise ValueError(
+                    "refresh_interval_s needs a single dataset root to "
+                    "watch; multi-URL views enumerate a fixed file list")
+        self._refresh_interval_s = refresh_interval_s
+        #: Background :class:`~petastorm_tpu.discovery.DatasetWatcher`
+        #: when ``refresh_interval_s`` is set (built after the resilience
+        #: wiring below — admission shares the reader's quarantine).
+        self._discovery = None
+        #: Applied growth batches: {"epoch", "files", "items", ...} each.
+        self._growth_batches: list = []
+        self._base_manifest = None
+        self._live_plan = None
+
         # ---------------- row-group planning
         #: Plan-time pruning provenance — filled by the selector pass and
         #: the statistics pruner below; see :meth:`pruning_report`.
         self._pruning_report = {"enabled": False}
         self._subset_kept_ordinals = None
-        all_row_groups = load_row_groups(ctx)
+        resume_manifest = (resume_state.get("manifest")
+                           if isinstance(resume_state, dict) else None)
+        if resume_manifest:
+            # Live-data resume (docs/live_data.md): the cursor's manifest —
+            # not the (sorted, growth-unstable) listing — defines the base
+            # ordinal assignment; growth batches are replayed below at
+            # their recorded epochs, so the restored plan is the exact plan
+            # the cursor indexed.
+            if shard_seed is not None:
+                raise ValueError(
+                    "a live-data manifest cursor cannot resume with "
+                    "shard_seed (the shard stream must extend "
+                    "monotonically; docs/live_data.md)")
+            from petastorm_tpu.discovery import DatasetSnapshot
+            base_snapshot = DatasetSnapshot.from_manifest(
+                resume_manifest["base"], ctx.root_path)
+            all_row_groups = base_snapshot.row_group_refs(ctx)
+        else:
+            base_snapshot = None
+            all_row_groups = load_row_groups(ctx)
         filtered = self._filter_row_groups(all_row_groups, predicate,
                                            rowgroup_selector, cur_shard,
                                            shard_count, shard_seed,
@@ -952,6 +1031,8 @@ class Reader:
                 (self._subset_kept_ordinals[i]
                  if self._subset_kept_ordinals is not None else i)
             for i, rg in enumerate(filtered)}
+        #: Next trace/lineage ordinal a growth batch's groups start at.
+        self._trace_next_ordinal = len(filtered)
 
         # ---------------- statistics pruning (docs/io.md). AFTER sharding,
         # so shard membership — and therefore which host owns which
@@ -976,6 +1057,62 @@ class Reader:
             for part in range(shuffle_row_drop_partitions):
                 items.append({"rowgroup": rg,
                               "shuffle_row_drop_partition": (part, shuffle_row_drop_partitions)})
+
+        # ---------------- live growth plan state (docs/live_data.md)
+        # Captured whenever discovery (or a manifest resume) is in play:
+        # growth batches replay the SAME filter/shard/prune/coalesce
+        # pipeline the base plan went through, with the shard stream
+        # continuing where the base left off.
+        base_items_count = len(items)
+        growth_segments = None
+        if refresh_interval_s is not None or resume_manifest:
+            from petastorm_tpu.discovery import DatasetSnapshot
+            if base_snapshot is None:
+                base_snapshot = DatasetSnapshot.from_row_groups(
+                    all_row_groups)
+            self._base_snapshot = base_snapshot
+            self._base_manifest = base_snapshot.manifest(ctx.root_path)
+            self._live_plan = {
+                "filters": filters, "predicate": predicate,
+                "cur_shard": cur_shard, "shard_count": shard_count,
+                "pruning": rowgroup_pruning,
+                "coalescing": rowgroup_coalescing,
+                "drop_partitions": shuffle_row_drop_partitions,
+                "selector": rowgroup_selector,
+            }
+            if rowgroup_selector is not None:
+                _warn_once(
+                    "refresh_selector",
+                    "rowgroup_selector indexes are stored at write time "
+                    "and cannot cover appended files; discovery admits "
+                    "new files WITHOUT selector pruning "
+                    "(docs/live_data.md)")
+            if resume_manifest and resume_manifest.get("growth"):
+                segments = [(0, base_items_count)]
+                for batch in resume_manifest["growth"]:
+                    files = [(os.path.join(ctx.root_path, rel), int(n), None)
+                             for rel, n in batch["files"]]
+                    new_items, info = self._plan_growth_batch(files)
+                    if len(new_items) != int(batch["items"]):
+                        raise ValueError(
+                            f"live-data resume planned {len(new_items)} "
+                            f"work item(s) for the growth batch at epoch "
+                            f"{batch['epoch']} but the cursor recorded "
+                            f"{batch['items']} — the appended files (or "
+                            f"predicate/filters) changed since the "
+                            f"checkpoint")
+                    items.extend(new_items)
+                    epoch_from = int(batch["epoch"])
+                    if segments[-1][0] == epoch_from:
+                        segments[-1] = (epoch_from, len(items))
+                    else:
+                        segments.append((epoch_from, len(items)))
+                    self._growth_batches.append(
+                        {"epoch": epoch_from,
+                         "files": [[r, int(n)] for r, n in batch["files"]],
+                         "items": len(new_items), **info})
+                if len(segments) > 1:
+                    growth_segments = segments
 
         # A live filesystem handle is only shared with in-process workers;
         # spawned process workers rebuild from URL + storage_options (live
@@ -1096,6 +1233,46 @@ class Reader:
             # (decode + publish backpressure) on top of the workers'
             # per-attempt enforcement.
             self._pool.stage_deadline = stage_deadline
+
+        # ---------------- live discovery wiring (docs/live_data.md)
+        if refresh_interval_s is not None:
+            from petastorm_tpu.discovery import DatasetWatcher
+            from petastorm_tpu.discovery.listing import \
+                DEFAULT_LIST_DEADLINE
+            # The watcher's snapshot must cover everything already in the
+            # plan: the base files plus any growth batches a manifest
+            # resume replayed above.
+            watch_snapshot = self._base_snapshot
+            for batch in self._growth_batches:
+                watch_snapshot = watch_snapshot.extended(
+                    [(os.path.join(ctx.root_path, rel), n, 0.0, -1)
+                     for rel, n in batch["files"]])
+            try:
+                reference_schema = ctx.arrow_schema()
+            except Exception as e:  # noqa: BLE001 - drift check is best-effort
+                reference_schema = None
+                warnings.warn(f"live discovery could not resolve the "
+                              f"dataset's Arrow schema ({e!r}); appended "
+                              f"files will be admitted without schema-"
+                              f"drift classification")
+            stats_cols = ()
+            if rowgroup_pruning and predicate is not None \
+                    and hasattr(predicate, "intervals"):
+                constraints = predicate.intervals()
+                if constraints:
+                    stats_cols = sorted({f for f, _ in constraints})
+            self._discovery = DatasetWatcher(
+                ctx, base_snapshot=watch_snapshot,
+                reference_schema=reference_schema,
+                poll_interval_s=(refresh_interval_s
+                                 if refresh_interval_s > 0 else None),
+                retry_policy=retry_policy,
+                deadline=(stage_deadline if stage_deadline is not None
+                          else DEFAULT_LIST_DEADLINE),
+                fault_plan=fault_plan, telemetry=self.telemetry,
+                quarantine=self.quarantine, stats_columns=stats_cols)
+            if refresh_interval_s > 0:
+                self._discovery.start()
 
         # Built as the IN-PROCESS variant; _spawnable_worker_args derives
         # the process-pool copy (live handles nulled). Both kept on self so
@@ -1233,9 +1410,12 @@ class Reader:
         if sample_order == "deterministic":
             from petastorm_tpu.reader_impl.epoch_plan import (
                 EpochPlan, OrderedDeliveryGate)
-            self._epoch_plan = EpochPlan(seed=seed, num_items=len(items),
+            self._epoch_plan = EpochPlan(seed=seed,
+                                         num_items=base_items_count,
                                          shuffled=shuffle_row_groups,
-                                         window=shuffle_window)
+                                         window=shuffle_window,
+                                         growth=(growth_segments[1:]
+                                                 if growth_segments else ()))
             self._gate = OrderedDeliveryGate(
                 self._epoch_plan, start_epoch=start_epoch,
                 start_offset=start_offset,
@@ -1251,6 +1431,7 @@ class Reader:
             max_ventilation_queue_size=self._pool.workers_count * (1 + _VENTILATE_EXTRA_ROWGROUPS),
             start_epoch=start_epoch,
             start_offset=start_offset,
+            growth_segments=growth_segments,
             # Workers key intra-row-group shuffle RNG by (seed, epoch,
             # position) so a resumed run replays the same row order inside
             # each group as an uninterrupted one; pools echo the same context
@@ -1413,6 +1594,9 @@ class Reader:
             filtered = self._apply_partition_predicate(filtered, predicate)
         if rowgroup_selector is not None:
             filtered = self._apply_selector(row_groups, filtered, rowgroup_selector)
+        # Live growth (docs/live_data.md) continues the shard stream where
+        # the base plan's ``index % shard_count`` walk stopped.
+        self._shard_stream_index = len(filtered)
         if cur_shard is not None:
             filtered = self._partition_row_groups(filtered, cur_shard, shard_count,
                                                   shard_seed)
@@ -1542,9 +1726,29 @@ class Reader:
         fields = sorted({f for f, _ in constraints})
         report["fields"] = fields
 
-        from petastorm_tpu.etl.dataset_metadata import (ColumnStats,
-                                                        load_row_group_stats)
-        stats = load_row_group_stats(self._ctx, row_groups, fields)
+        from petastorm_tpu.etl.dataset_metadata import load_row_group_stats
+        stats = load_row_group_stats(self._ctx, row_groups, fields,
+                                     telemetry=self.telemetry)
+        kept, pruned_per_file = self._prune_with_stats(row_groups,
+                                                       constraints, stats)
+        pruned = len(row_groups) - len(kept)
+        report.update({"row_groups_pruned": pruned,
+                       "row_groups_kept": len(kept),
+                       "pruned_per_file": pruned_per_file})
+        self.telemetry.counter("io.rowgroups_pruned").add(pruned)
+        self.telemetry.counter("io.rowgroups_planned").add(len(kept))
+        if pruned:
+            logger.debug("Statistics pruning dropped %d/%d row groups "
+                         "(fields: %s)", pruned, len(row_groups), fields)
+        return kept
+
+    @staticmethod
+    def _prune_with_stats(row_groups, constraints, stats):
+        """The statistics-admission core shared by plan-time pruning and
+        incremental live-growth pruning (docs/live_data.md): returns
+        ``(kept, pruned_per_file)`` given pre-loaded per-group stats."""
+        from petastorm_tpu.etl.dataset_metadata import ColumnStats
+        fields = {f for f, _ in constraints}
         kept, pruned_per_file = [], {}
         for rg in row_groups:
             group_stats = dict(stats.get((rg.path, rg.row_group), {}))
@@ -1561,16 +1765,153 @@ class Reader:
                 kept.append(rg)
             else:
                 pruned_per_file[rg.path] = pruned_per_file.get(rg.path, 0) + 1
-        pruned = len(row_groups) - len(kept)
-        report.update({"row_groups_pruned": pruned,
-                       "row_groups_kept": len(kept),
-                       "pruned_per_file": pruned_per_file})
-        self.telemetry.counter("io.rowgroups_pruned").add(pruned)
-        self.telemetry.counter("io.rowgroups_planned").add(len(kept))
-        if pruned:
-            logger.debug("Statistics pruning dropped %d/%d row groups "
-                         "(fields: %s)", pruned, len(row_groups), fields)
-        return kept
+        return kept, pruned_per_file
+
+    # ------------------------------------------------- live growth plane
+    def _plan_growth_batch(self, files):
+        """Plan one admitted-growth batch (docs/live_data.md): the same
+        filter -> shard -> statistics-prune -> coalesce pipeline the base
+        plan ran, continuing the shard stream and lineage ordinals where
+        the plan left off. ``files`` is ``[(abs_path, num_row_groups,
+        per_group_stats_or_None), ...]`` in admission order; stats come
+        from the watcher's validation footers (zero extra IO) or — on a
+        manifest resume, where only file names are recorded — from a
+        footer scan of just those files. Returns ``(new_items, info)``."""
+        from petastorm_tpu.etl.dataset_metadata import (RowGroupRef,
+                                                        load_row_group_stats)
+        plan = self._live_plan
+        refs = []
+        stats_by_key = {}
+        have_stats = True
+        for path, n_groups, stats in files:
+            pv = self._ctx.partition_values_for(path)
+            for i in range(n_groups):
+                refs.append(RowGroupRef(path, i, pv))
+                if stats is not None and i < len(stats):
+                    stats_by_key[(path, i)] = stats[i]
+            if stats is None:
+                have_stats = False
+        total = len(refs)
+        kept = refs
+        if plan["filters"]:
+            kept = self._apply_filters(kept, plan["filters"])
+        if plan["predicate"] is not None:
+            kept = self._apply_partition_predicate(kept, plan["predicate"])
+        if plan["cur_shard"] is not None:
+            start = self._shard_stream_index
+            self._shard_stream_index = start + len(kept)
+            kept = [rg for i, rg in enumerate(kept, start=start)
+                    if i % plan["shard_count"] == plan["cur_shard"]]
+        # Lineage ordinals continue after everything already planned, so
+        # trace ids stay unique and monotonic across growth.
+        for rg in kept:
+            self._trace_ordinal_by_key[(rg.path, rg.row_group)] = \
+                self._trace_next_ordinal
+            self._trace_next_ordinal += 1
+        pruned = 0
+        predicate = plan["predicate"]
+        if plan["pruning"] and predicate is not None:
+            constraints = predicate.intervals()
+            if constraints:
+                fields = sorted({f for f, _ in constraints})
+                stats = (stats_by_key if have_stats
+                         else load_row_group_stats(self._ctx, kept, fields,
+                                                   telemetry=self.telemetry))
+                kept2, pruned_per_file = self._prune_with_stats(
+                    kept, constraints, stats)
+                pruned = len(kept) - len(kept2)
+                kept = kept2
+                if self._pruning_report.get("enabled"):
+                    self._pruning_report["row_groups_pruned"] = \
+                        self._pruning_report.get("row_groups_pruned", 0) \
+                        + pruned
+                    self._pruning_report["row_groups_kept"] = \
+                        self._pruning_report.get("row_groups_kept", 0) \
+                        + len(kept)
+                self.telemetry.counter("io.rowgroups_pruned").add(pruned)
+                self.telemetry.counter("io.rowgroups_planned").add(len(kept))
+        if plan["coalescing"] > 1:
+            kept = _coalesce_row_groups(kept, plan["coalescing"])
+        drop_parts = plan["drop_partitions"]
+        new_items = [{"rowgroup": rg,
+                      "shuffle_row_drop_partition": (part, drop_parts)}
+                     for rg in kept for part in range(drop_parts)]
+        return new_items, {"row_groups": total, "pruned": pruned}
+
+    def _apply_dataset_growth(self) -> None:
+        """Fold staged admitted files into the live plan at the consumer
+        safe point (docs/live_data.md). The extension is **monotonic**:
+        new work items land after the existing range, effective from the
+        first epoch the ventilator has not planned yet — every
+        already-planned epoch (including the one being consumed) is
+        byte-identical with or without the growth, deterministic cursors
+        stay valid, and the epoch after admission is a pure function of
+        ``(seed, epoch, extended plan)``."""
+        staged = self._discovery.drain_staged()
+        if not staged:
+            return
+        new_items, info = self._plan_growth_batch(
+            [(a.path, a.num_row_groups, a.stats) for a in staged])
+        effective = self._ventilator.extend_items(new_items)
+        if self._epoch_plan is not None and new_items:
+            self._epoch_plan.extend(effective,
+                                    self._num_items + len(new_items))
+        self._num_items += len(new_items)
+        batch = {"epoch": effective,
+                 "files": [[os.path.relpath(a.path, self._ctx.root_path),
+                            a.num_row_groups] for a in staged],
+                 "items": len(new_items), **info}
+        self._growth_batches.append(batch)
+        self.telemetry.counter("discovery.items_extended").add(
+            len(new_items))
+        self.telemetry.record_event(
+            "discovery.growth_applied",
+            {"epoch": effective, "files": len(staged),
+             "row_groups": info["row_groups"], "items": len(new_items),
+             "pruned": info["pruned"]})
+        logger.info(
+            "live growth applied: %d file(s), %d row group(s) -> %d work "
+            "item(s) (%d pruned), effective from epoch %d",
+            len(staged), info["row_groups"], len(new_items),
+            info["pruned"], effective)
+
+    def refresh_dataset(self) -> dict:
+        """Synchronous discovery pass: poll the store once, fold any
+        admitted growth into the plan, and return
+        :meth:`dataset_growth_report`. The explicit companion to the
+        background ``refresh_interval_s > 0`` mode (with ``0``, this and
+        :meth:`reset` are the only polling points)."""
+        if self._discovery is None:
+            raise RuntimeError(
+                "refresh_dataset() needs make_reader(refresh_interval_s=...) "
+                "(docs/live_data.md)")
+        self._discovery.poll_once()
+        self._apply_dataset_growth()
+        return self.dataset_growth_report()
+
+    def dataset_growth_report(self) -> dict:
+        """Live-data readout (docs/live_data.md): the watcher's admission
+        state machine (pending / refused / admitted files, poll and
+        freshness stats) plus every growth batch applied to this reader's
+        plan. ``{"enabled": False}`` when ``refresh_interval_s`` is off."""
+        if self._discovery is None and not self._growth_batches:
+            return {"enabled": False}
+        report = {"enabled": self._discovery is not None,
+                  "refresh_interval_s": self._refresh_interval_s,
+                  "items": self._num_items,
+                  "applied": [dict(b) for b in self._growth_batches]}
+        if self._discovery is not None:
+            report["discovery"] = self._discovery.report()
+        return report
+
+    def _current_manifest(self) -> dict:
+        """The cursor-side plan manifest: base files plus applied growth
+        batches, in admission order (docs/live_data.md)."""
+        return {"base": [list(entry) for entry in self._base_manifest],
+                "growth": [{"epoch": b["epoch"],
+                            "files": [list(f) for f in b["files"]],
+                            "items": b["items"]}
+                           for b in self._growth_batches]}
 
     def _make_ventilate_fn(self, pool):
         """The ventilation entry point for ``pool``: announces each work
@@ -1834,6 +2175,11 @@ class Reader:
             raise self._migration_error
         if self._pending_pool_target is not None:
             self._perform_pool_migration()
+        if self._discovery is not None and self._discovery.has_growth:
+            # Consumer-thread safe point, like migrations: the extension
+            # only affects not-yet-planned epochs, so folding it here is
+            # invisible to the epoch being consumed (docs/live_data.md).
+            self._apply_dataset_growth()
         try:
             sample = self._results_reader.read_next()
             return sample
@@ -1858,6 +2204,8 @@ class Reader:
             raise self._migration_error
         if self._pending_pool_target is not None:
             self._perform_pool_migration()
+        if self._discovery is not None and self._discovery.has_growth:
+            self._apply_dataset_growth()
         try:
             return self._results_reader.read_next_batch()
         except EmptyResultError:
@@ -1896,21 +2244,56 @@ class Reader:
                         "sample_order": "deterministic",
                         "window": self._shuffle_window,
                         "plan": self._epoch_plan.describe()})
+            if self._base_manifest is not None:
+                # Live-data cursor (docs/live_data.md): the manifest pins
+                # the admission-ordered file set so resume rebuilds this
+                # exact ordinal assignment — the sorted listing would
+                # interleave appended files into the middle.
+                cur["manifest"] = self._current_manifest()
             return cur
         s = self._ventilator.state
-        return {"epoch": s["epoch"], "offset": s["offset"],
-                # Work-item count: lets resume reject a plan whose offsets
-                # mean different data (changed filters, sharding,
-                # shuffle_row_drop_partitions, or rowgroup_coalescing).
-                "items": self._num_items,
-                "seed": self._seed}
+        cur = {"epoch": s["epoch"], "offset": s["offset"],
+               # Work-item count: lets resume reject a plan whose offsets
+               # mean different data (changed filters, sharding,
+               # shuffle_row_drop_partitions, or rowgroup_coalescing).
+               "items": self._num_items,
+               "seed": self._seed}
+        if self._base_manifest is not None:
+            cur["manifest"] = self._current_manifest()
+        return cur
 
     def reset(self):
         """Start another pass. Only legal after the current pass finished
-        (parity: reference reader.py:503-527)."""
+        (parity: reference reader.py:503-527).
+
+        With live discovery (docs/live_data.md) a reset is a **plan
+        rebase**: the store is polled (synchronously in the
+        ``refresh_interval_s=0`` between-epochs mode), staged growth is
+        folded in, and the new pass plans every admitted item from its
+        epoch 0 — a fresh pass over the grown dataset, rather than a
+        replay of the previous pass's admission schedule."""
         if not self.last_row_consumed:
             raise RuntimeError(
                 "reset() is only supported after the previous pass was fully consumed")
+        if self._discovery is not None:
+            if not self._refresh_interval_s:
+                self._discovery.poll_once()
+            if self._discovery.has_growth:
+                self._apply_dataset_growth()
+        if self._growth_batches:
+            # Rebase: collapse the growth schedule so the NEW pass covers
+            # the full admitted plan from its first epoch. Keyed on growth
+            # having been applied — a manifest-resumed reader carries
+            # growth batches even with discovery off, and its restarted
+            # epoch counter must not be read against the previous run's
+            # absolute effective epochs.
+            self._ventilator.rebase_growth()
+            if self._epoch_plan is not None:
+                self._epoch_plan.rebase()
+            for batch in self._growth_batches:
+                self._base_manifest.extend([list(f)
+                                            for f in batch["files"]])
+            self._growth_batches = []
         self._ventilator.reset()
         if self._gate is not None:
             # Another pass replays the exact same canonical order from the
@@ -1920,6 +2303,8 @@ class Reader:
 
     # ------------------------------------------------------------- lifetime
     def stop(self):
+        if self._discovery is not None:
+            self._discovery.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.slo_watcher is not None:

@@ -95,18 +95,30 @@ class OrderedUnit:
 class EpochPlan:
     """The canonical epoch order: ``f(seed, epoch_idx, shard_plan)``.
 
-    ``num_items`` is the planned work-item count (the shard plan's side of
-    the function: row groups after filter/shard/prune/coalesce, times
-    ``shuffle_row_drop_partitions``). ``shuffled`` records whether the
-    ventilator applies the seeded per-epoch permutation; ``window`` the
-    block size of the window-shuffle mode (``<= 1`` = exact plan order).
+    ``num_items`` is the planned work-item count **at epoch 0** (the shard
+    plan's side of the function: row groups after filter/shard/prune/
+    coalesce, times ``shuffle_row_drop_partitions``). ``shuffled`` records
+    whether the ventilator applies the seeded per-epoch permutation;
+    ``window`` the block size of the window-shuffle mode (``<= 1`` = exact
+    plan order).
 
-    Positions are **linearized** as ``epoch * num_items + position`` so one
-    integer cursor orders the whole multi-epoch stream.
+    **Monotonic growth** (docs/live_data.md): a live appending dataset
+    extends the plan through :meth:`extend` — ``num_items`` becomes a step
+    function of the epoch, recorded as ``(first_epoch, num_items)``
+    segments. New work items get plan positions appended AFTER the
+    existing range, effective from a not-yet-planned epoch, so every
+    already-planned epoch stays byte-identical (its permutation is over
+    the item count that was live when it was planned) and the epoch after
+    admission is a pure function of ``(seed, epoch, extended plan)``.
+
+    Positions are **linearized** as ``cum_items(epoch) + position`` so one
+    integer cursor orders the whole multi-epoch stream even as epochs
+    change size (``cum_items`` is the total item count of all earlier
+    epochs; with no growth this reduces to ``epoch * num_items``).
     """
 
     def __init__(self, seed: int, num_items: int, shuffled: bool = False,
-                 window: int = 0):
+                 window: int = 0, growth: Iterable[Tuple[int, int]] = ()):
         if seed is None:
             raise ValueError("EpochPlan requires a concrete seed (mint one "
                              "at plan time; deterministic mode is "
@@ -115,23 +127,78 @@ class EpochPlan:
         self.num_items = int(num_items)
         self.shuffled = bool(shuffled)
         self.window = int(window)
+        from petastorm_tpu.utils.growth import GrowthSchedule
+        #: ``(first_epoch, num_items)`` growth segments; segment i covers
+        #: epochs ``[first_epoch_i, first_epoch_{i+1})`` — the one shared
+        #: step-function helper (docs/live_data.md).
+        self._schedule = GrowthSchedule.base(int(num_items))
         self._block_cache: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+        for first_epoch, n in sorted(growth):
+            self.extend(int(first_epoch), int(n))
 
     def describe(self) -> dict:
         """JSON-safe plan record for ``state_dict``. Resume validates the
         restored ``shuffled`` flag against the live plan here; ``seed`` /
         ``items`` / ``window`` are validated through the cursor's own
         top-level keys (they must match for the offsets to mean the same
-        data)."""
-        return {"version": 1, "seed": self.seed, "items": self.num_items,
-                "shuffled": self.shuffled, "window": self.window}
+        data). ``growth`` (present only when the plan was extended) lists
+        the ``[first_epoch, num_items]`` segments a resumed plan must
+        replay."""
+        d = {"version": 1, "seed": self.seed, "items": self.num_items,
+             "shuffled": self.shuffled, "window": self.window}
+        if self._schedule.grown:
+            d["growth"] = [[e, n] for e, n in self._schedule.segments[1:]]
+        return d
+
+    # ------------------------------------------------------------- growth
+    def extend(self, first_epoch: int, num_items: int) -> None:
+        """Monotonic extension: epochs at or after ``first_epoch`` plan
+        over ``num_items`` items (new positions appended after the
+        existing range). ``first_epoch`` must be a not-yet-planned epoch
+        at or after the last segment's start (strict mode — the reader
+        passes the ventilator's already-normalized effective epoch) —
+        growth never rewrites a minted permutation."""
+        if num_items == self._schedule.final_size:
+            return
+        self._schedule.extend(first_epoch, num_items, strict=True)
+        # Tail-block lengths depend on the epoch's item count.
+        self._block_cache.clear()
+
+    @property
+    def growth_segments(self) -> List[Tuple[int, int]]:
+        """The full segment table ``[(0, base), (e1, n1), ...]``."""
+        return self._schedule.segments
+
+    def rebase(self) -> None:
+        """Collapse the growth schedule into one epoch-0 segment over the
+        full item count — the live-data ``Reader.reset()`` rebase
+        (docs/live_data.md): a NEW pass plans everything admitted so far
+        from its first epoch. Only meaningful alongside a gate/ventilator
+        reset (the cursor arithmetic changes origin)."""
+        self._schedule.rebase()
+        self.num_items = self._schedule.final_size
+        self._block_cache.clear()
+
+    def num_items_at(self, epoch: int) -> int:
+        """Item count of ``epoch`` under the growth schedule."""
+        return self._schedule.size_at(epoch)
+
+    def cum_items(self, epoch: int) -> int:
+        """Total items in epochs ``[0, epoch)`` — the linearization base
+        of ``epoch``'s first position."""
+        return self._schedule.cum_items(epoch)
+
+    def slot_epoch(self, consumed: int) -> Tuple[int, int]:
+        """``(epoch, position_within_epoch)`` of consumption slot
+        ``consumed`` under the growth schedule."""
+        return self._schedule.slot(consumed)
 
     def permutation(self, epoch: int) -> List[int]:
         """Item order of ``epoch``: position ``p`` holds original item
         ``permutation(epoch)[p]`` — byte-for-byte the ventilator's
-        ``random.Random(seed + epoch).shuffle`` (identity when the plan is
-        unshuffled)."""
-        order = list(range(self.num_items))
+        ``random.Random(seed + epoch).shuffle`` over the items live at
+        ``epoch`` (identity when the plan is unshuffled)."""
+        order = list(range(self.num_items_at(epoch)))
         if self.shuffled:
             random.Random(self.seed + epoch).shuffle(order)
         return order
@@ -143,7 +210,7 @@ class EpochPlan:
         ``BatchShufflingBuffer`` refill order depends on when refills
         happen; this one is indexable from the cursor alone)."""
         import numpy as np
-        length = min(self.window, self.num_items - block_start)
+        length = min(self.window, self.num_items_at(epoch) - block_start)
         key = (epoch, block_start)
         perm = self._block_cache.get(key)
         if perm is None:
@@ -160,13 +227,12 @@ class EpochPlan:
     def needed_linear(self, consumed: int) -> int:
         """Linear ordinal of the unit delivered at consumption slot
         ``consumed`` (0-based count of units consumed since epoch 0)."""
-        n = self.num_items
-        epoch, r = divmod(consumed, n)
         if self.window <= 1:
             return consumed
+        epoch, r = self.slot_epoch(consumed)
         block_start = (r // self.window) * self.window
         perm = self.block_permutation(epoch, block_start)
-        return epoch * n + block_start + perm[r - block_start]
+        return self.cum_items(epoch) + block_start + perm[r - block_start]
 
     def cursor_fields(self, consumed: int) -> Tuple[int, int, int]:
         """``(epoch, offset, window_delivered)`` for consumption slot
@@ -174,8 +240,7 @@ class EpochPlan:
         watermark position, or the current window block's start), and
         ``window_delivered`` how many of that block's units are already in
         the delivered stream."""
-        n = self.num_items
-        epoch, r = divmod(consumed, n)
+        epoch, r = self.slot_epoch(consumed)
         if self.window <= 1:
             return epoch, r, 0
         block_start = (r // self.window) * self.window
@@ -183,7 +248,7 @@ class EpochPlan:
 
     def consumed_from_cursor(self, epoch: int, offset: int,
                              window_delivered: int) -> int:
-        return epoch * self.num_items + offset + window_delivered
+        return self.cum_items(epoch) + offset + window_delivered
 
 
 class OrderedDeliveryGate:
@@ -211,7 +276,6 @@ class OrderedDeliveryGate:
                  start_offset: int = 0, window_delivered: int = 0,
                  skipped: Iterable[int] = (), telemetry=None):
         self._plan = plan
-        n = plan.num_items
         self._c = plan.consumed_from_cursor(start_epoch, start_offset,
                                             window_delivered)
         #: Consumption slot at entry of the pull that produced the most
@@ -229,7 +293,7 @@ class OrderedDeliveryGate:
         self._consumed_in_block: set = set()
         if plan.window > 1 and window_delivered:
             perm = plan.block_permutation(start_epoch, start_offset)
-            base = start_epoch * n + start_offset
+            base = plan.cum_items(start_epoch) + start_offset
             self._consumed_in_block = {base + perm[j]
                                        for j in range(window_delivered)}
         self._c_reordered = (telemetry.counter("order.units_reordered")
@@ -288,7 +352,7 @@ class OrderedDeliveryGate:
         transient."""
         c = self._c_entry if back_up else self._c
         epoch, offset, k = self._plan.cursor_fields(c)
-        base = epoch * self._plan.num_items + offset
+        base = self._plan.cum_items(epoch) + offset
         pending = sorted(s for s in (self._skip_log | self._skips)
                          if s >= base)
         return {"epoch": int(epoch), "offset": int(offset),
@@ -312,7 +376,7 @@ class OrderedDeliveryGate:
             self._consumed_in_block.add(consumed_linear)
         self._c += 1
         if plan.window > 1:
-            r = self._c % plan.num_items
+            _epoch, r = plan.slot_epoch(self._c)
             if r % plan.window == 0 or r == 0:
                 # Crossed a block (or epoch) boundary: the finished block's
                 # dup-detection set is subsumed by the watermark.
@@ -323,7 +387,7 @@ class OrderedDeliveryGate:
         if plan.window <= 1:
             return linear < self._c
         epoch, offset, _k = plan.cursor_fields(self._c)
-        block_base = epoch * plan.num_items + offset
+        block_base = plan.cum_items(epoch) + offset
         return linear < block_base or linear in self._consumed_in_block
 
     def _feed(self, result) -> None:
@@ -333,7 +397,7 @@ class OrderedDeliveryGate:
                 f"pool, got {type(result).__name__} (a worker missing the "
                 f"sample_order wiring?)")
         epoch, pos = result.context
-        linear = epoch * self._plan.num_items + pos
+        linear = self._plan.cum_items(epoch) + pos
         if result.kind == "skip":
             if linear not in self._skip_log and not self._already_consumed(
                     linear):

@@ -14,6 +14,19 @@ site                fired
 ``cache.fill``      per LocalDiskCache miss, before the fill runs
                     (``key`` = cache key)
 ``hdfs.call``       per HA-HDFS proxied filesystem call (``key`` = method)
+``discovery.list``  per :class:`~petastorm_tpu.discovery.DatasetWatcher`
+                    store-listing attempt (``key`` = the first dataset
+                    root). Same classifier flavors as ``rowgroup.read``:
+                    ``ioerror`` retries under the listing RetryPolicy,
+                    ``latency`` models a crawling store. Plan-time
+                    ``file_paths()`` listings share the retried code path
+                    but predate the reader's fault plan, so they never
+                    fire.
+``discovery.footer`` per new-file validation footer read (``key`` = file
+                    path): ``ioerror``/``corruption`` park the file
+                    ``pending_retry`` (a torn footer and an injected one
+                    classify identically), ``latency`` models a slow
+                    footer fetch.
 ==================  ========================================================
 
 Determinism: ``at=N`` fires on exactly the Nth matching access *in this

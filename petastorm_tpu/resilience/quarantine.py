@@ -30,7 +30,13 @@ __all__ = ["QuarantineRecord", "RowGroupSkipped", "RowGroupSkippedMessage",
 
 @dataclasses.dataclass
 class QuarantineRecord:
-    """Provenance of one skipped row group (picklable; crosses pools)."""
+    """Provenance of one skipped row group (picklable; crosses pools).
+
+    ``state`` distinguishes the terminal read-path skip (``quarantined``)
+    from the live-data admission states (docs/live_data.md): a torn or
+    still-being-written appended file is quarantined ``pending_retry`` —
+    re-validated on every discovery poll and flipped to
+    ``admitted_after_retry`` once its footer completes — never banned."""
 
     path: str
     row_group: object            # ordinal or tuple of ordinals (coalesced)
@@ -40,6 +46,7 @@ class QuarantineRecord:
     worker_id: Optional[int] = None
     injected: bool = False       # fault-plan-injected vs real failure
     wall_time: float = 0.0       # unix seconds, provenance only
+    state: str = "quarantined"   # | "pending_retry" | "admitted_after_retry"
 
     @property
     def piece(self) -> str:
@@ -97,15 +104,32 @@ class RowGroupQuarantine:
     def paths(self) -> List[str]:
         return sorted({r.path for r in self.records})
 
+    def mark_admitted(self, path: str) -> int:
+        """Live-data resolution (docs/live_data.md): flip every
+        ``pending_retry`` record for ``path`` to ``admitted_after_retry``
+        — the once-torn file completed on a later poll and is now in the
+        plan. Returns how many records flipped; the records stay in the
+        report as provenance of the retry that succeeded."""
+        flipped = 0
+        with self._lock:
+            for r in self._records:
+                if r.path == path and r.state == "pending_retry":
+                    r.state = "admitted_after_retry"
+                    flipped += 1
+        return flipped
+
     def report(self) -> dict:
         """Queryable summary (JSON-safe): count, skipped pieces with full
-        provenance, and per-error-type tallies."""
+        provenance, per-error-type and per-state tallies."""
         records = self.records
         by_error: dict = {}
+        by_state: dict = {}
         for r in records:
             by_error[r.error_type] = by_error.get(r.error_type, 0) + 1
+            by_state[r.state] = by_state.get(r.state, 0) + 1
         return {"quarantined": len(records),
                 "by_error_type": dict(sorted(by_error.items())),
+                "by_state": dict(sorted(by_state.items())),
                 "pieces": [r.as_dict() for r in records]}
 
 

@@ -56,9 +56,18 @@ def default_rules(input_stall_pct: float = 5.0,
                   quarantined: float = 0.0,
                   reshards: float = 0.0,
                   hedges_per_s: float = 2.0,
-                  stragglers_per_s: float = 2.0) -> List[SloRule]:
+                  stragglers_per_s: float = 2.0,
+                  ingest_lag_s: float = 300.0) -> List[SloRule]:
     """The documented default rule set (thresholds per the tuning table in
-    docs/observability.md)."""
+    docs/observability.md). ``ingest_lag_s`` is the live-data freshness
+    contract (docs/live_data.md): now minus the newest admitted file's
+    mtime — the gauge only exists on readers with
+    ``refresh_interval_s=``, so static pipelines skip the rule. NOTE the
+    gauge measures end-to-end data staleness, which includes the
+    PRODUCER's append cadence — the default threshold is deliberately
+    loose (5 min); tune it to your producer (``ingest_lag_s<=30``) and
+    read ``dataset_growth_report()``'s ``max_admission_lag_s`` for the
+    cadence-independent ingestion-health number."""
     return [
         SloRule("input_stall_pct", "gauge", "loader.input_stall_pct",
                 input_stall_pct),
@@ -71,6 +80,8 @@ def default_rules(input_stall_pct: float = 5.0,
                 hedges_per_s),
         SloRule("straggler_rate", "rate", "resilience.stragglers_total",
                 stragglers_per_s),
+        SloRule("ingest_lag_s", "gauge", "discovery.ingest_lag_s",
+                ingest_lag_s),
     ]
 
 

@@ -56,7 +56,7 @@ def copy_dataset(source_url: str, target_url: str, field_regex=None,
                      workers_count=workers_count, filesystem=src_fs) as reader:
         # Remove the target only AFTER the source opened successfully: a
         # typo'd/unreadable source must never cost the existing target.
-        if fs.exists(target_path) and fs.ls(target_path):
+        if fs.exists(target_path) and fs.ls(target_path):  # listing-ok: pre-copy emptiness probe of the TARGET dir, not dataset discovery
             if not overwrite_output:
                 raise ValueError(f"Target {target_url} already exists; pass "
                                  f"overwrite_output=True (--overwrite-output) "

@@ -1,0 +1,141 @@
+"""GrowthSchedule: the ONE step-function-of-epoch the live-data plane
+grows plans with (docs/live_data.md).
+
+Monotonic growth is recorded as ``(first_epoch, size)`` segments —
+segment i covers epochs ``[first_epoch_i, first_epoch_{i+1})``. Three
+layers previously hand-rolled the same table walk (the PR 10
+``EpochPlan``, the ventilator's per-epoch item slices, and the mesh
+loader's per-epoch ordinal ranges) and had already diverged on the
+collapse-vs-append edge; this helper makes the invariants uniform:
+
+* sizes are **monotonic** (a live dataset only appends);
+* segment epochs are **strictly increasing**;
+* :meth:`extend` never rewrites a planned epoch — in clamping mode (the
+  ventilator/mesh flavor) an effective epoch earlier than the schedule's
+  last step is pulled FORWARD to that step (two admissions racing into
+  the same future epoch collapse into one), in ``strict`` mode (the
+  EpochPlan flavor, where the caller passes the ventilator's already-
+  normalized effective epoch) it raises instead.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+__all__ = ["GrowthSchedule"]
+
+
+class GrowthSchedule:
+    """Immutable-prefix step function ``epoch -> size``; see module doc.
+
+    Not thread-safe by itself — callers serialize mutation under their
+    own lock (the ventilator's state lock, the mesh loader's condition).
+    """
+
+    __slots__ = ("_segments",)
+
+    def __init__(self, segments: Iterable[Tuple[int, int]]):
+        segs = [(int(e), int(n)) for e, n in segments]
+        if not segs:
+            raise ValueError("GrowthSchedule needs at least one segment")
+        for (e0, n0), (e1, n1) in zip(segs, segs[1:]):
+            if e1 <= e0:
+                raise ValueError(
+                    f"growth segments must be strictly epoch-increasing, "
+                    f"got {segs}")
+            if n1 < n0:
+                raise ValueError(
+                    f"growth is monotonic (sizes never shrink), got {segs}")
+        self._segments = segs
+
+    @classmethod
+    def base(cls, size: int, first_epoch: int = 0) -> "GrowthSchedule":
+        return cls([(first_epoch, size)])
+
+    # ------------------------------------------------------------- queries
+    @property
+    def segments(self) -> List[Tuple[int, int]]:
+        return list(self._segments)
+
+    @property
+    def final_size(self) -> int:
+        return self._segments[-1][1]
+
+    @property
+    def last_epoch(self) -> int:
+        return self._segments[-1][0]
+
+    @property
+    def grown(self) -> bool:
+        return len(self._segments) > 1
+
+    def size_at(self, epoch: int) -> int:
+        """Size of ``epoch`` under the schedule."""
+        n = self._segments[0][1]
+        for first_epoch, size in self._segments:
+            if first_epoch <= epoch:
+                n = size
+            else:
+                break
+        return n
+
+    def cum_items(self, epoch: int) -> int:
+        """Total items in epochs ``[first segment's epoch, epoch)`` — the
+        linearization base of ``epoch``'s first position."""
+        total = 0
+        segs = self._segments
+        for i, (start, n) in enumerate(segs):
+            end = segs[i + 1][0] if i + 1 < len(segs) else None
+            hi = epoch if end is None else min(end, epoch)
+            if hi > start:
+                total += (hi - start) * n
+            if end is None or end >= epoch:
+                break
+        return total
+
+    def slot(self, linear: int) -> Tuple[int, int]:
+        """``(epoch, position_within_epoch)`` of linear slot ``linear``."""
+        rem = linear
+        segs = self._segments
+        for i, (start, n) in enumerate(segs):
+            end = segs[i + 1][0] if i + 1 < len(segs) else None
+            span = None if end is None else (end - start) * n
+            if span is None or rem < span:
+                return start + rem // max(1, n), rem % max(1, n)
+            rem -= span
+        raise AssertionError("unreachable: final segment is unbounded")
+
+    # ------------------------------------------------------------ mutation
+    def extend(self, first_epoch: int, size: int, strict: bool = False
+               ) -> int:
+        """Grow to ``size`` from ``first_epoch`` on; returns the epoch the
+        step actually landed at. ``first_epoch`` earlier than the
+        schedule's last step is clamped forward to it (that step is, by
+        construction, not planned yet) — or raises when ``strict`` (the
+        caller claims an already-normalized epoch)."""
+        last_epoch, last_size = self._segments[-1]
+        if size < last_size:
+            raise ValueError(
+                f"growth is monotonic: {size} < current {last_size} "
+                f"(a live dataset only ever appends)")
+        if first_epoch < last_epoch:
+            if strict:
+                raise ValueError(
+                    f"growth effective epoch {first_epoch} precedes the "
+                    f"last segment's epoch {last_epoch}: already-planned "
+                    f"epochs are immutable")
+            first_epoch = last_epoch
+        if size == last_size:
+            return max(first_epoch, last_epoch)
+        if first_epoch == last_epoch:
+            self._segments[-1] = (last_epoch, int(size))
+            return last_epoch
+        self._segments.append((int(first_epoch), int(size)))
+        return int(first_epoch)
+
+    def rebase(self) -> None:
+        """Collapse to one epoch-0 segment over the final size (the
+        live-data ``reset()`` rebase, docs/live_data.md)."""
+        self._segments = [(0, self.final_size)]
+
+    def __repr__(self):
+        return f"GrowthSchedule({self._segments})"

@@ -9,9 +9,19 @@ an RNG keyed by ``(seed, step_idx)`` — a pure function of the mix position,
 not of a mutable stream — so a resumed mixture replays the exact same pick
 sequence from its recorded ``step``. Seeded-by-default: with no seed one is
 minted and recorded in ``state_dict``.
+
+Starvation telemetry (docs/live_data.md; ROADMAP 4b): live mixture
+curricula die quietly when one source lags — a growing-but-slow member
+blocks the whole mixture on its turn long before it runs dry. Per member:
+``mixer.m{i}.draws_total`` (picks), ``mixer.m{i}.starved_total`` (picks
+that hit an exhausted member — the draw that ended the mixture), and a
+``mixer.m{i}.lag_s`` gauge (seconds since that member last delivered), all
+on the mixer's own registry and rolled up by :meth:`WeightedSamplingReader.
+report` — a lagging source is visible while training still runs.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -98,6 +108,46 @@ class WeightedSamplingReader:
                           for r in readers) else "eager")
         self.last_row_consumed = False
 
+        # ---------------- starvation telemetry (docs/live_data.md)
+        from petastorm_tpu.telemetry import make_registry
+        #: The mixer's own registry (members keep theirs); ``mixer.*``
+        #: schema in docs/observability.md.
+        self.telemetry = make_registry()
+        n = len(self._readers)
+        self._weights = [float(p) / total for p in probabilities]
+        self._c_draws = [self.telemetry.counter(f"mixer.m{i}.draws_total")
+                         for i in range(n)]
+        self._c_starved = [self.telemetry.counter(f"mixer.m{i}.starved_total")
+                           for i in range(n)]
+        #: Monotonic timestamp of each member's last delivered sample
+        #: (None = never served yet; its lag counts from mixer creation).
+        self._t0 = time.monotonic()
+        self._last_served: List[Optional[float]] = [None] * n
+        for i in range(n):
+            self.telemetry.gauge(f"mixer.m{i}.lag_s",
+                                 (lambda i=i: self._lag_s(i)))
+
+    def _lag_s(self, idx: int) -> float:
+        last = self._last_served[idx]
+        return time.monotonic() - (self._t0 if last is None else last)
+
+    def report(self) -> dict:
+        """Per-member mixing health (docs/live_data.md): draws, starved
+        picks, seconds since the member last delivered, and its normalized
+        weight — the surface that makes a growing-but-lagging source
+        visible before it stalls training."""
+        members = []
+        for i, r in enumerate(self._readers):
+            members.append({
+                "index": i,
+                "weight": round(self._weights[i], 6),
+                "draws": int(self._c_draws[i].value),
+                "starved": int(self._c_starved[i].value),
+                "lag_s": round(self._lag_s(i), 3),
+                "exhausted": bool(getattr(r, "last_row_consumed", False)),
+            })
+        return {"step": self._step, "seed": self._seed, "members": members}
+
     def __iter__(self):
         return self
 
@@ -121,11 +171,16 @@ class WeightedSamplingReader:
         return min(idx, len(self._readers) - 1)
 
     def __next__(self):
+        idx = self._pick()
+        self._c_draws[idx].add(1)
         try:
-            return next(self._readers[self._pick()])
+            sample = next(self._readers[idx])
         except StopIteration:
+            self._c_starved[idx].add(1)
             self.last_row_consumed = True
             raise
+        self._last_served[idx] = time.monotonic()
+        return sample
 
     def next_batch(self):
         """Mix at BATCH granularity: one weighted reader pick serves that
@@ -137,11 +192,16 @@ class WeightedSamplingReader:
         (docs/io.md) at zero per-row cost. Sampling weights consequently
         apply per batch, not per row — with equal row-group sizes the two
         are the same mixture in expectation."""
+        idx = self._pick()
+        self._c_draws[idx].add(1)
         try:
-            return self._readers[self._pick()].next_batch()
+            batch = self._readers[idx].next_batch()
         except StopIteration:
+            self._c_starved[idx].add(1)
             self.last_row_consumed = True
             raise
+        self._last_served[idx] = time.monotonic()
+        return batch
 
     def reset(self):
         """Start another pass: resets exhausted member readers and the

@@ -68,7 +68,8 @@ class ConcurrentVentilator(Ventilator):
                  ventilation_interval: float = _VENTILATION_INTERVAL_S,
                  start_epoch: int = 0,
                  start_offset: int = 0,
-                 item_context_key: Optional[str] = None):
+                 item_context_key: Optional[str] = None,
+                 growth_segments=None):
         """``start_epoch``/``start_offset`` resume ventilation mid-stream:
         epoch ``start_epoch`` begins at item index ``start_offset`` of its
         (seeded) order — the checkpoint/resume mechanism (exact when
@@ -79,7 +80,15 @@ class ConcurrentVentilator(Ventilator):
         position within that epoch's (seeded) order. Workers can key
         per-item RNG off it so results are position-deterministic: a resumed
         run reproduces the exact same per-item randomness as an
-        uninterrupted one."""
+        uninterrupted one.
+
+        ``growth_segments``: live-data resume (docs/live_data.md) — the
+        ``[(first_epoch, num_items), ...]`` table describing how the item
+        list grew over past epochs. Epoch ``e`` ventilates (and shuffles)
+        only the first ``num_items``-at-``e`` items of the list; the final
+        segment's size must equal ``len(items_to_ventilate)``. ``None`` =
+        one segment covering everything (today's behavior). Live growth
+        appends further segments through :meth:`extend_items`."""
         super().__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError(f"iterations must be positive or None, got {iterations}")
@@ -104,15 +113,35 @@ class ConcurrentVentilator(Ventilator):
         self._thread: Optional[threading.Thread] = None
         self._epoch = start_epoch
         self._processed_total = 0
-        # Exact resume watermark (linear index = epoch * n + position): the
-        # first item whose completion has NOT been confirmed. Advanced only
-        # over a contiguous prefix, so out-of-order completions from
-        # multi-worker pools can never skip a still-in-flight item.
-        n = max(1, len(self._items))
-        self._watermark = start_epoch * n + start_offset
+        # Exact resume watermark as an (epoch, position) pair: the first
+        # item whose completion has NOT been confirmed. Advanced only over
+        # a contiguous prefix, so out-of-order completions from
+        # multi-worker pools can never skip a still-in-flight item. A pair
+        # (not a linear index) because epochs change SIZE under live
+        # growth (docs/live_data.md).
+        self._watermark = (start_epoch, start_offset)
         self._completed_positions = set()
         self._context_tracking = False
         self._state_lock = threading.Lock()
+        # Growth schedule (docs/live_data.md): epoch e ventilates the
+        # first _size_at(e) items. Guarded by _state_lock together with
+        # _items and the minted-epoch marker.
+        from petastorm_tpu.utils.growth import GrowthSchedule
+        if growth_segments:
+            growth_segments = list(growth_segments)
+            if growth_segments[0][0] != 0 \
+                    or growth_segments[-1][1] != len(self._items):
+                raise ValueError(
+                    f"growth_segments must start at epoch 0 and end at the "
+                    f"full item count {len(self._items)}, "
+                    f"got {growth_segments}")
+            self._growth = GrowthSchedule(growth_segments)
+        else:
+            self._growth = GrowthSchedule.base(len(self._items))
+        #: Latest epoch whose item order has been (or is being) minted by
+        #: the ventilation loop — growth lands at minted + 1, so an
+        #: already-planned epoch is never rewritten.
+        self._order_minted_epoch = start_epoch - 1
 
     # ------------------------------------------------------------------ api
     def start(self):
@@ -130,16 +159,24 @@ class ConcurrentVentilator(Ventilator):
         with self._inflight_cv:
             self._inflight = max(0, self._inflight - 1)
             self._inflight_cv.notify_all()
-        n = max(1, len(self._items))
         with self._state_lock:
             self._processed_total += 1
             if item_context is not None:
                 self._context_tracking = True
                 epoch, pos = item_context
-                self._completed_positions.add(epoch * n + pos)
+                self._completed_positions.add((epoch, pos))
                 while self._watermark in self._completed_positions:
                     self._completed_positions.remove(self._watermark)
-                    self._watermark += 1
+                    we, wp = self._watermark
+                    wp += 1
+                    if wp >= self._size_at(we):
+                        we, wp = we + 1, 0
+                    self._watermark = (we, wp)
+
+    def _size_at(self, epoch: int) -> int:
+        """Item count of ``epoch`` under the growth schedule (caller holds
+        ``_state_lock`` or runs before the thread starts)."""
+        return max(1, self._growth.size_at(epoch))
 
     @property
     def state(self) -> Dict[str, Any]:
@@ -150,15 +187,48 @@ class ConcurrentVentilator(Ventilator):
         cursor that were already delivered are re-read on resume (bounded
         duplication, never loss — exact even when multi-worker pools
         complete items out of ventilation order)."""
-        n = max(1, len(self._items))
         with self._state_lock:
             if self._context_tracking:
-                linear = self._watermark
+                epoch, offset = self._watermark
             else:
-                linear = (self._start_epoch * n + self._start_offset
-                          + self._processed_total)
-        return {"epoch": linear // n, "offset": linear % n,
+                epoch, offset = self._start_epoch, self._start_offset
+                offset += self._processed_total
+                while offset >= self._size_at(epoch):
+                    offset -= self._size_at(epoch)
+                    epoch += 1
+        return {"epoch": epoch, "offset": offset,
                 "seed": self._seed, "randomized": self._randomize}
+
+    @property
+    def growth_segments(self):
+        """The live ``[(first_epoch, num_items), ...]`` growth table."""
+        with self._state_lock:
+            return self._growth.segments
+
+    def extend_items(self, new_items) -> int:
+        """Monotonic live-data extension (docs/live_data.md): append
+        ``new_items`` to the item list, effective from the first epoch
+        whose order has NOT been minted yet — already-planned epochs keep
+        ventilating exactly the items they were planned over, so seeded
+        orders (and the deterministic plane's permutations) never change
+        retroactively. Returns the effective epoch — which the schedule
+        may clamp FORWARD past the minted marker: a resumed run can carry
+        growth segments ahead of its cursor (the previous run's
+        ventilation outpaced consumption), and a new step must never land
+        before one already recorded. Safe from any thread; with no new
+        items it still returns where growth WOULD land."""
+        with self._state_lock:
+            proposed = self._order_minted_epoch + 1
+            if not new_items:
+                return max(proposed, self._growth.last_epoch)
+            self._items.extend(new_items)
+            effective = self._growth.extend(proposed, len(self._items))
+        with self._inflight_cv:
+            # An idle ventilation loop parked on "all ventilated" re-checks
+            # nothing today (it only parks on backpressure), but a raised
+            # item count deserves the same wakeup as a raised cap.
+            self._inflight_cv.notify_all()
+        return effective
 
     @property
     def inflight(self) -> int:
@@ -253,13 +323,32 @@ class ConcurrentVentilator(Ventilator):
         self._start_offset = 0
         with self._state_lock:
             self._processed_total = 0
-            self._watermark = 0
+            self._watermark = (0, 0)
             self._completed_positions.clear()
+            self._order_minted_epoch = -1
         self.start()
+
+    def rebase_growth(self) -> None:
+        """Collapse the growth table to one epoch-0 segment over the full
+        item list — the live-data ``reset()`` rebase (docs/live_data.md):
+        a NEW pass plans every admitted item from its first epoch, instead
+        of replaying the previous pass's admission schedule. Only legal at
+        the same point ``reset()`` is (ventilation completed)."""
+        if not self.completed():
+            raise RuntimeError("rebase_growth() requires completed "
+                               "ventilation (call it alongside reset())")
+        with self._state_lock:
+            from petastorm_tpu.utils.growth import GrowthSchedule
+            self._growth = GrowthSchedule.base(len(self._items))
 
     # ------------------------------------------------------------ internals
     def _epoch_order(self, epoch: int) -> List[Dict[str, Any]]:
-        items = list(self._items)
+        with self._state_lock:
+            # Epoch e covers exactly the items live at e under the growth
+            # table: items appended mid-epoch never leak into an order that
+            # was already (or is being) minted.
+            self._order_minted_epoch = max(self._order_minted_epoch, epoch)
+            items = list(self._items[:self._size_at(epoch)])
         if self._randomize:
             rng = random.Random(None if self._seed is None else self._seed + epoch)
             rng.shuffle(items)

@@ -1,0 +1,1001 @@
+"""Live appending datasets (docs/live_data.md): discovery watcher,
+admission state machine, monotonic plan extension, growth cursors.
+
+Tier-1 (`livedata` marker). The determinism-under-growth acceptance
+criteria are pinned here: an epoch planned before a refresh is
+byte-identical whether or not files were appended mid-epoch, the epoch
+after admission is a pure function of ``(seed, epoch, extended plan)``
+across pools, and a cursor minted pre-growth restores against the
+extended plan and replays the exact remaining stream.
+"""
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.discovery import (DatasetSnapshot, DatasetWatcher,
+                                     classify_schema_drift, list_data_files)
+from petastorm_tpu.discovery.snapshot import FileEntry
+from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                load_row_group_stats)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.reader_impl.epoch_plan import EpochPlan
+from petastorm_tpu.resilience import FaultPlan, FaultSpec
+from petastorm_tpu.telemetry import make_registry
+from petastorm_tpu.workers_pool.ventilator import ConcurrentVentilator
+
+pytestmark = pytest.mark.livedata
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- helpers
+def write_scalar_file(path, start, rows=20, row_group_size=10,
+                      id_type=None, extra_col=False):
+    cols = {"id": pa.array(np.arange(start, start + rows),
+                           type=id_type or pa.int64()),
+            "val": pa.array(np.arange(start, start + rows,
+                                      dtype=np.float64))}
+    if extra_col:
+        cols["extra"] = pa.array(np.zeros(rows))
+    pq.write_table(pa.table(cols), path, row_group_size=row_group_size)
+
+
+@pytest.fixture()
+def live_store(tmp_path):
+    root = str(tmp_path / "live")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0)
+    write_scalar_file(f"{root}/b.parquet", 20)
+    return root
+
+
+def batch_ids(batch):
+    return tuple(int(x) for x in batch.id)
+
+
+def drain_ids(reader_iter, n=None):
+    out = []
+    for batch in reader_iter:
+        out.append(batch_ids(batch))
+        if n is not None and len(out) >= n:
+            break
+    return out
+
+
+# ------------------------------------------------------ schema drift unit
+def test_classify_schema_drift_cases():
+    base = pa.schema([("id", pa.int64()), ("val", pa.float64())])
+    assert classify_schema_drift(base, base)[0] == "identical"
+    added = pa.schema([("id", pa.int64()), ("val", pa.float64()),
+                       ("extra", pa.float64())])
+    kind, detail = classify_schema_drift(base, added)
+    assert kind == "compatible" and "extra" in detail
+    changed = pa.schema([("id", pa.float32()), ("val", pa.float64())])
+    kind, detail = classify_schema_drift(base, changed)
+    assert kind == "incompatible" and "id" in detail
+    missing = pa.schema([("id", pa.int64())])
+    kind, detail = classify_schema_drift(base, missing)
+    assert kind == "incompatible" and "val" in detail
+
+
+# -------------------------------------------------------- snapshot units
+def test_snapshot_ordinals_and_manifest_roundtrip(tmp_path):
+    snap = DatasetSnapshot([FileEntry("/d/a.parquet", 3, 0),
+                            FileEntry("/d/b.parquet", 2, 3)])
+    assert snap.total_row_groups == 5
+    grown = snap.extended([("/d/c.parquet", 4, 1.0, 100)])
+    assert grown.total_row_groups == 9
+    assert grown.files[-1].first_ordinal == 5
+    assert snap.total_row_groups == 5  # immutable
+    manifest = grown.manifest("/d")
+    assert manifest == [["a.parquet", 3], ["b.parquet", 2],
+                        ["c.parquet", 4]]
+    rebuilt = DatasetSnapshot.from_manifest(manifest, "/d")
+    assert [f.first_ordinal for f in rebuilt.files] == [0, 3, 5]
+
+
+def test_snapshot_rejects_non_contiguous_and_duplicate():
+    with pytest.raises(ValueError, match="contiguous"):
+        DatasetSnapshot([FileEntry("/d/a", 3, 1)])
+    snap = DatasetSnapshot([FileEntry("/d/a", 3, 0)])
+    with pytest.raises(ValueError, match="already"):
+        snap.extended([("/d/a", 1, 0.0, -1)])
+
+
+# ------------------------------------------------------- EpochPlan growth
+def test_epoch_plan_growth_segments():
+    plan = EpochPlan(seed=5, num_items=4, shuffled=True)
+    plan.extend(2, 7)
+    assert plan.num_items_at(0) == 4
+    assert plan.num_items_at(1) == 4
+    assert plan.num_items_at(2) == 7
+    assert plan.num_items_at(9) == 7
+    # cum_items: epochs 0,1 have 4 items; 2+ have 7
+    assert plan.cum_items(0) == 0
+    assert plan.cum_items(2) == 8
+    assert plan.cum_items(3) == 15
+    assert plan.slot_epoch(7) == (1, 3)
+    assert plan.slot_epoch(8) == (2, 0)
+    assert plan.slot_epoch(14) == (2, 6)
+    assert plan.slot_epoch(15) == (3, 0)
+    # permutation over the epoch-local count, byte-equal to the ventilator
+    import random
+    order = list(range(7))
+    random.Random(5 + 2).shuffle(order)
+    assert plan.permutation(2) == order
+    assert len(plan.permutation(1)) == 4
+    # consumed <-> cursor round trip across the growth step
+    for consumed in range(20):
+        e, r, k = plan.cursor_fields(consumed)
+        assert plan.consumed_from_cursor(e, r, k) == consumed
+
+
+def test_epoch_plan_growth_validation_and_describe():
+    plan = EpochPlan(seed=1, num_items=4)
+    with pytest.raises(ValueError, match="monotonic"):
+        plan.extend(1, 3)
+    plan.extend(2, 6)
+    with pytest.raises(ValueError, match="immutable"):
+        plan.extend(1, 8)
+    plan.extend(2, 9)  # same effective epoch collapses into one step
+    assert plan.growth_segments == [(0, 4), (2, 9)]
+    assert plan.describe()["growth"] == [[2, 9]]
+    assert "growth" not in EpochPlan(seed=1, num_items=4).describe()
+    plan.rebase()
+    assert plan.growth_segments == [(0, 9)]
+    assert plan.num_items == 9
+
+
+def test_epoch_plan_window_needed_linear_across_growth():
+    plan = EpochPlan(seed=3, num_items=4, window=2, growth=[(1, 6)])
+    # epoch 0 (4 items): blocks [0,1],[2,3]; epoch 1 (6 items) starts at 4
+    seen = set()
+    for consumed in range(10):
+        linear = plan.needed_linear(consumed)
+        seen.add(linear)
+        epoch, r = plan.slot_epoch(consumed)
+        block_start = (r // 2) * 2
+        base = plan.cum_items(epoch) + block_start
+        assert base <= linear < base + 2 or linear < base + 2 + 1
+    assert seen == set(range(10))  # a permutation of the stream
+
+
+# ------------------------------------------------------- ventilator units
+def test_ventilator_extend_items_effective_epoch():
+    seen = []
+    v = ConcurrentVentilator(lambda **kw: seen.append(kw["i"]),
+                             [{"i": i} for i in range(3)], iterations=3,
+                             item_context_key="ctx")
+    # before the thread starts nothing is minted: growth joins epoch 0
+    assert v.extend_items([{"i": 10}]) == 0
+    assert v.growth_segments == [(0, 4)]
+    v.start()
+    deadline = time.monotonic() + 10
+    while len(seen) < 12 and time.monotonic() < deadline:
+        if seen:
+            v.processed_item()
+        time.sleep(0.002)
+    v.stop()
+    assert seen[:4].count(10) == 1  # grown item in every epoch incl. 0
+    assert len(seen) == 12
+
+
+def test_ventilator_growth_watermark_and_state():
+    v = ConcurrentVentilator(lambda **kw: None,
+                             [{"i": i} for i in range(3)], iterations=None,
+                             item_context_key="ctx",
+                             growth_segments=[(0, 2), (1, 3)])
+    # epoch 0 has 2 items, epoch 1+ has 3
+    v.processed_item(item_context=(0, 0))
+    v.processed_item(item_context=(0, 1))
+    assert v.state["epoch"] == 1 and v.state["offset"] == 0
+    v.processed_item(item_context=(1, 0))
+    v.processed_item(item_context=(1, 2))  # out of order: held
+    assert v.state["offset"] == 1
+    v.processed_item(item_context=(1, 1))
+    assert v.state["epoch"] == 2 and v.state["offset"] == 0
+
+
+def test_ventilator_extend_clamps_past_resumed_growth_segment():
+    """Review finding: a resumed run can carry growth segments AHEAD of
+    its cursor (the previous run's ventilation outpaced consumption); a
+    new admission must clamp forward to the recorded step instead of
+    producing an out-of-order segment (which crashed EpochPlan.extend)."""
+    items = [{"i": i} for i in range(4)]
+    v = ConcurrentVentilator(lambda **kw: None, items, iterations=None,
+                             start_epoch=1, item_context_key="ctx",
+                             growth_segments=[(0, 2), (3, 4)])
+    # minted is start_epoch-1=0, so the naive effective would be 1 < 3
+    effective = v.extend_items([{"i": 99}])
+    assert effective == 3
+    assert v.growth_segments == [(0, 2), (3, 5)]
+    # and the plan accepts the normalized epoch without raising
+    plan = EpochPlan(seed=1, num_items=2, growth=[(3, 4)])
+    plan.extend(effective, 5)
+    assert plan.growth_segments == [(0, 2), (3, 5)]
+
+
+def test_ventilator_growth_segments_validated():
+    items = [{"i": i} for i in range(3)]
+    with pytest.raises(ValueError, match="full item count"):
+        ConcurrentVentilator(lambda **kw: None, items,
+                             growth_segments=[(0, 2), (1, 4)])
+    with pytest.raises(ValueError, match="monotonic"):
+        ConcurrentVentilator(lambda **kw: None, items,
+                             growth_segments=[(0, 4), (1, 3)])
+
+
+# ----------------------------------------------------------- listing path
+def test_list_data_files_retries_injected_ioerrors(live_store):
+    ctx = DatasetContext(f"file://{live_store}")
+    telemetry = make_registry()
+    plan = FaultPlan([FaultSpec("discovery.list", "ioerror", at=1,
+                                times=2)], seed=0)
+    files = list_data_files(ctx.filesystem, ctx.path_or_paths,
+                            fault_plan=plan, telemetry=telemetry)
+    assert [os.path.basename(f) for f in files] == ["a.parquet",
+                                                    "b.parquet"]
+    snap = telemetry.snapshot()
+    assert snap["counters"]["discovery.list_retries_total"] >= 1
+    assert snap["counters"]["discovery.list_failures_total"] == 0
+
+
+def test_list_data_files_gives_up_and_counts(live_store):
+    ctx = DatasetContext(f"file://{live_store}")
+    telemetry = make_registry()
+    plan = FaultPlan([FaultSpec("discovery.list", "ioerror", rate=1.0)],
+                     seed=0)
+    with pytest.raises(IOError):
+        list_data_files(ctx.filesystem, ctx.path_or_paths, fault_plan=plan,
+                        telemetry=telemetry)
+    assert telemetry.snapshot()["counters"][
+        "discovery.list_failures_total"] == 1
+
+
+def test_list_data_files_filters_sidecars(live_store):
+    with open(f"{live_store}/_metadata", "wb") as f:
+        f.write(b"x")
+    with open(f"{live_store}/.hidden", "wb") as f:
+        f.write(b"x")
+    ctx = DatasetContext(f"file://{live_store}")
+    files = list_data_files(ctx.filesystem, ctx.path_or_paths)
+    assert [os.path.basename(f) for f in files] == ["a.parquet",
+                                                    "b.parquet"]
+
+
+# ----------------------------------------------------------- watcher unit
+def _make_watcher(root, **kwargs):
+    ctx = DatasetContext(f"file://{root}")
+    from petastorm_tpu.etl.dataset_metadata import load_row_groups
+    snap = DatasetSnapshot.from_row_groups(load_row_groups(ctx))
+    kwargs.setdefault("reference_schema", ctx.arrow_schema())
+    kwargs.setdefault("telemetry", make_registry())
+    return ctx, DatasetWatcher(ctx, base_snapshot=snap, **kwargs)
+
+
+def test_watcher_torn_footer_pending_then_admitted(live_store):
+    from petastorm_tpu.resilience import RowGroupQuarantine
+    telemetry = make_registry()
+    quarantine = RowGroupQuarantine(telemetry=telemetry)
+    _ctx, watcher = _make_watcher(live_store, telemetry=telemetry,
+                                  quarantine=quarantine)
+    with open(f"{live_store}/new.parquet", "wb") as f:
+        f.write(b"PAR1 torn half-written footer")
+    summary = watcher.poll_once()
+    assert summary["pending"] == 1 and summary["admitted"] == 0
+    assert not watcher.has_growth
+    rep = watcher.report()
+    assert rep["pending"][0]["state"] == "pending_retry"
+    qrep = quarantine.report()
+    assert qrep["by_state"] == {"pending_retry": 1}
+    # the writer finishes the file; the next poll admits it
+    write_scalar_file(f"{live_store}/new.parquet", 100)
+    summary = watcher.poll_once()
+    assert summary["admitted"] == 1
+    assert watcher.has_growth
+    staged = watcher.drain_staged()
+    assert [a.num_row_groups for a in staged] == [2]
+    assert watcher.snapshot.total_row_groups == 6
+    assert quarantine.report()["by_state"] == {"admitted_after_retry": 1}
+    counters = telemetry.snapshot()["counters"]
+    assert counters["discovery.files_quarantined"] == 1
+    assert counters["discovery.files_admitted"] == 1
+
+
+def test_watcher_incompatible_drift_refused_then_revalidated(live_store):
+    _ctx, watcher = _make_watcher(live_store)
+    write_scalar_file(f"{live_store}/drift.parquet", 50,
+                      id_type=pa.float32())
+    with pytest.warns(UserWarning, match="incompatible schema drift"):
+        summary = watcher.poll_once()
+    assert summary["refused"] == 1 and not watcher.has_growth
+    # stable refused file is NOT re-read each poll
+    summary = watcher.poll_once()
+    assert summary["refused"] == 0 and summary["pending"] == 0
+    # the producer fixes the file: revalidated (bytes changed) -> admitted
+    time.sleep(0.02)
+    write_scalar_file(f"{live_store}/drift.parquet", 50, rows=30)
+    summary = watcher.poll_once()
+    assert summary["admitted"] == 1
+    assert not watcher.report()["refused"]
+
+
+def test_watcher_compatible_drift_admitted_with_warning(live_store):
+    _ctx, watcher = _make_watcher(live_store)
+    write_scalar_file(f"{live_store}/extra.parquet", 60, extra_col=True)
+    with pytest.warns(UserWarning, match="compatible schema drift"):
+        summary = watcher.poll_once()
+    assert summary["admitted"] == 1
+    assert watcher.drain_staged()[0].drift == "compatible"
+
+
+def test_watcher_listing_failure_keeps_snapshot(live_store):
+    plan = FaultPlan([FaultSpec("discovery.list", "ioerror", rate=1.0)],
+                     seed=0)
+    telemetry = make_registry()
+    _ctx, watcher = _make_watcher(live_store, fault_plan=plan,
+                                  telemetry=telemetry)
+    write_scalar_file(f"{live_store}/c.parquet", 40)
+    summary = watcher.poll_once()
+    assert summary["ok"] is False
+    assert not watcher.has_growth
+    assert watcher.snapshot.total_row_groups == 4  # last good snapshot
+    assert watcher.report()["failed_polls"] == 1
+
+
+def test_watcher_validation_stats_ride_admission(live_store):
+    _ctx, watcher = _make_watcher(live_store, stats_columns=("id",))
+    write_scalar_file(f"{live_store}/c.parquet", 200)
+    watcher.poll_once()
+    staged = watcher.drain_staged()
+    stats = staged[0].stats
+    assert len(stats) == 2  # one dict per row group
+    assert stats[0]["id"].min == 200 and stats[0]["id"].max == 209
+
+
+# ------------------------------------------- reader kwarg validation
+def test_refresh_kwarg_validation(live_store):
+    url = f"file://{live_store}"
+    with pytest.raises(ValueError, match="rowgroup_subset"):
+        make_batch_reader(url, refresh_interval_s=1.0, rowgroup_subset=[0],
+                          shuffle_row_groups=False)
+    with pytest.raises(ValueError, match="shard_seed"):
+        make_batch_reader(url, refresh_interval_s=1.0, shard_seed=3,
+                          cur_shard=0, shard_count=2)
+    with pytest.raises(ValueError, match=">= 0"):
+        make_batch_reader(url, refresh_interval_s=-1.0)
+    with pytest.raises(ValueError, match="single dataset root"):
+        make_batch_reader([url, url], refresh_interval_s=1.0)
+
+
+# ------------------------------------- determinism under growth (pinned)
+def test_pre_refresh_epoch_byte_identical_with_and_without_growth(
+        live_store, tmp_path):
+    """Acceptance: an epoch planned before a refresh is byte-identical
+    whether or not files were appended mid-epoch."""
+    control_root = str(tmp_path / "control")
+    shutil.copytree(live_store, control_root)
+
+    def epoch0(root, append):
+        with make_batch_reader(f"file://{root}", reader_pool_type="dummy",
+                               num_epochs=2, shuffle_row_groups=True,
+                               seed=11, sample_order="deterministic",
+                               refresh_interval_s=0) as r:
+            it = iter(r)
+            first = [batch_ids(next(it))]
+            if append:
+                write_scalar_file(f"{root}/c.parquet", 40)
+                r.refresh_dataset()
+                assert r.dataset_growth_report()["applied"]
+            first += [batch_ids(next(it)) for _ in range(3)]
+            return first
+
+    grown = epoch0(live_store, append=True)
+    control = epoch0(control_root, append=False)
+    assert grown == control
+
+
+def _manifest_resume_stream(root, pool, growth_epoch, num_epochs=3,
+                            seed=11, workers_count=3):
+    """Full deterministic stream from epoch 0 under a hand-built manifest
+    whose growth batch is effective from ``growth_epoch`` — the
+    timing-free way to pin f(seed, epoch, extended plan)."""
+    manifest = {"base": [["a.parquet", 2], ["b.parquet", 2]],
+                "growth": [{"epoch": growth_epoch,
+                            "files": [["c.parquet", 2]], "items": 2}]}
+    resume = {"epoch": 0, "offset": 0, "items": 6, "seed": seed,
+              "sample_order": "deterministic", "window": 0,
+              "window_delivered": 0, "skipped_ordinals": [],
+              "manifest": manifest,
+              "plan": {"version": 1, "seed": seed, "items": 4,
+                       "shuffled": True, "window": 0,
+                       "growth": [[growth_epoch, 6]]}}
+    with make_batch_reader(f"file://{root}", reader_pool_type=pool,
+                           workers_count=workers_count,
+                           num_epochs=num_epochs, shuffle_row_groups=True,
+                           seed=seed, sample_order="deterministic",
+                           refresh_interval_s=0,
+                           resume_state=resume) as r:
+        return drain_ids(iter(r))
+
+
+def test_growth_epoch_pure_function_of_plan_across_pools(live_store):
+    """Acceptance: the epoch after admission delivers old+new row groups
+    as a pure function of (seed, epoch, extended plan) — identical on the
+    dummy and thread pools (process pool in its own slow test)."""
+    write_scalar_file(f"{live_store}/c.parquet", 40)
+    dummy = _manifest_resume_stream(live_store, "dummy", growth_epoch=1)
+    thread = _manifest_resume_stream(live_store, "thread", growth_epoch=1)
+    assert dummy == thread
+    # epoch 0: 4 batches without the new ids; epochs 1-2: 6 each with them
+    assert len(dummy) == 4 + 6 + 6
+    epoch0_ids = {x for b in dummy[:4] for x in b}
+    assert epoch0_ids == set(range(40))
+    epoch1_ids = {x for b in dummy[4:10] for x in b}
+    assert epoch1_ids == set(range(60))
+    # seeded permutation: same plan, different epoch -> different order,
+    # same multiset
+    assert sorted(dummy[4:10]) == sorted(dummy[10:16])
+
+
+@pytest.mark.process_pool
+def test_growth_epoch_identical_on_process_pool(live_store):
+    write_scalar_file(f"{live_store}/c.parquet", 40)
+    dummy = _manifest_resume_stream(live_store, "dummy", growth_epoch=1)
+    process = _manifest_resume_stream(live_store, "process",
+                                      growth_epoch=1, workers_count=2)
+    assert dummy == process
+
+
+def test_checkpoint_resume_across_refresh_boundary(live_store):
+    """Acceptance: a cursor minted pre-growth restores against the
+    extended plan and replays the exact remaining stream."""
+    url = f"file://{live_store}"
+
+    def mk(resume=None):
+        return make_batch_reader(url, reader_pool_type="dummy",
+                                 num_epochs=3, shuffle_row_groups=True,
+                                 seed=7, sample_order="deterministic",
+                                 refresh_interval_s=0, resume_state=resume)
+
+    with mk() as r:
+        it = iter(r)
+        for _ in range(3):
+            next(it)
+        cursor = r.state_dict()          # minted BEFORE the growth
+        assert cursor["manifest"]["growth"] == []
+        write_scalar_file(f"{live_store}/c.parquet", 40)
+        r.refresh_dataset()
+        applied = r.dataset_growth_report()["applied"]
+        assert applied and applied[0]["items"] == 2
+        remainder_a = drain_ids(it)
+        post_cursor = r.state_dict()
+    assert post_cursor["manifest"]["growth"], "growth must ride the cursor"
+    # the resumed reader re-discovers c.parquet as growth and replays the
+    # exact remaining stream
+    with mk(resume=cursor) as r2:
+        it2 = iter(r2)
+        r2.refresh_dataset()
+        remainder_b = drain_ids(it2)
+    assert remainder_a == remainder_b
+
+
+def test_resume_post_growth_manifest_cursor(live_store):
+    url = f"file://{live_store}"
+
+    def mk(resume=None):
+        return make_batch_reader(url, reader_pool_type="dummy",
+                                 num_epochs=3, shuffle_row_groups=True,
+                                 seed=7, sample_order="deterministic",
+                                 refresh_interval_s=0, resume_state=resume)
+
+    with mk() as r:
+        it = iter(r)
+        next(it)
+        write_scalar_file(f"{live_store}/c.parquet", 40)
+        r.refresh_dataset()
+        for _ in range(4):
+            next(it)
+        cursor = r.state_dict()          # minted AFTER the growth
+        remainder_a = drain_ids(it)
+    assert cursor["manifest"]["growth"]
+    with mk(resume=cursor) as r2:
+        remainder_b = drain_ids(iter(r2))
+    assert remainder_a == remainder_b
+
+
+def test_resume_growth_batch_count_mismatch(live_store):
+    write_scalar_file(f"{live_store}/c.parquet", 40)
+    manifest = {"base": [["a.parquet", 2], ["b.parquet", 2]],
+                "growth": [{"epoch": 1, "files": [["c.parquet", 2]],
+                            # cursor claims 3 planned items; the replayed
+                            # pipeline plans 2 -> the offsets would index
+                            # different data, so resume must refuse
+                            "items": 3}]}
+    resume = {"epoch": 0, "offset": 0, "items": 7, "seed": 11,
+              "sample_order": "deterministic", "window": 0,
+              "window_delivered": 0, "skipped_ordinals": [],
+              "manifest": manifest,
+              "plan": {"version": 1, "seed": 11, "items": 4,
+                       "shuffled": True, "window": 0,
+                       "growth": [[1, 7]]}}
+    with pytest.raises(ValueError, match="growth batch"):
+        make_batch_reader(f"file://{live_store}", reader_pool_type="dummy",
+                          num_epochs=3, shuffle_row_groups=True, seed=11,
+                          sample_order="deterministic",
+                          refresh_interval_s=0, resume_state=resume)
+
+
+# -------------------------------------------------- fault-drill epochs
+def test_appended_corrupt_file_epoch_completes_pending_retry(live_store):
+    """Acceptance: an appended-corrupt-file epoch completes with the file
+    quarantined pending_retry — and the file admits once completed."""
+    url = f"file://{live_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=None,
+                           shuffle_row_groups=False,
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        ids = [batch_ids(next(it)) for _ in range(2)]
+        with open(f"{live_store}/torn.parquet", "wb") as f:
+            f.write(b"PAR1 not parquet")
+        r.refresh_dataset()
+        rep = r.dataset_growth_report()["discovery"]
+        assert rep["pending"][0]["state"] == "pending_retry"
+        assert r.quarantine_report()["by_state"] == {"pending_retry": 1}
+        # the epoch keeps serving old data, no crash
+        ids += [batch_ids(next(it)) for _ in range(4)]
+        assert {x for b in ids for x in b} == set(range(40))
+        # the upload completes -> admitted on a later poll
+        write_scalar_file(f"{live_store}/torn.parquet", 100)
+        r.refresh_dataset()
+        assert not r.dataset_growth_report()["discovery"]["pending"]
+        assert r.quarantine_report()["by_state"] == \
+            {"admitted_after_retry": 1}
+        deadline = time.monotonic() + 10
+        seen_new = False
+        while time.monotonic() < deadline and not seen_new:
+            seen_new = 100 in batch_ids(next(it))
+        assert seen_new
+
+
+def test_incompatible_drift_degrades_to_last_good_snapshot(live_store):
+    """Acceptance: an incompatible schema change degrades to the last
+    good snapshot with a loud warning while the reader keeps serving."""
+    url = f"file://{live_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=None,
+                           shuffle_row_groups=False,
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        next(it)
+        write_scalar_file(f"{live_store}/bad.parquet", 99,
+                          id_type=pa.float32())
+        with pytest.warns(UserWarning, match="incompatible schema drift"):
+            r.refresh_dataset()
+        rep = r.dataset_growth_report()
+        assert len(rep["discovery"]["refused"]) == 1
+        assert not rep["applied"]
+        # still serving the last good snapshot
+        ids = {x for _ in range(6) for x in batch_ids(next(it))}
+        assert ids <= set(range(40))
+        counters = r.telemetry.snapshot()["counters"]
+        assert counters["discovery.files_refused"] == 1
+
+
+def test_listing_ioerrors_retry_no_crash(live_store):
+    """Acceptance: injected listing IOErrors retry with backoff — no
+    crash, discovery.list_retries_total > 0."""
+    # at=1: the watcher's first poll is the first fault-plan-visible
+    # listing (construction's file_paths() predates the plan wiring)
+    plan = FaultPlan([FaultSpec("discovery.list", "ioerror", at=1)],
+                     seed=0)
+    url = f"file://{live_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=None,
+                           shuffle_row_groups=False, fault_plan=plan,
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        next(it)
+        write_scalar_file(f"{live_store}/c.parquet", 40)
+        r.refresh_dataset()
+        assert r.dataset_growth_report()["applied"]
+        counters = r.telemetry.snapshot()["counters"]
+        assert counters["discovery.list_retries_total"] > 0
+        assert counters["discovery.files_admitted"] == 1
+
+
+def test_background_poll_admits_and_tracks_lag(live_store):
+    url = f"file://{live_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=None,
+                           shuffle_row_groups=False,
+                           refresh_interval_s=0.05) as r:
+        it = iter(r)
+        next(it)
+        write_scalar_file(f"{live_store}/c.parquet", 40)
+        deadline = time.monotonic() + 15
+        grew = False
+        while time.monotonic() < deadline and not grew:
+            grew = 40 in batch_ids(next(it))
+        assert grew, "background watcher never admitted the appended file"
+        snap = r.telemetry.snapshot()
+        assert snap["gauges"]["discovery.ingest_lag_s"] < 15
+        assert snap["gauges"]["discovery.snapshot_age_s"] < 15
+        disc = r.dataset_growth_report()["discovery"]
+        assert disc["max_admission_lag_s"] < 15
+
+
+# --------------------------------------------------------- row reader
+def test_make_reader_growth_with_petastorm_store(tmp_path):
+    """Row-reader flavor: append a petastorm-written data file (copied
+    from a sibling store with the same schema) and read it live."""
+    from dataset_utils import create_test_dataset
+    url = f"file://{tmp_path}/ds"
+    create_test_dataset(url, num_rows=40, rows_per_row_group=10)
+    donor_url = f"file://{tmp_path}/donor"
+    # 60 donor rows: the LAST file (rows_per_file=20) carries ids 40-59,
+    # disjoint from the 0-39 base so appended rows are distinguishable
+    create_test_dataset(donor_url, num_rows=60, rows_per_row_group=10,
+                        seed=9)
+    donor_files = sorted(f for f in os.listdir(f"{tmp_path}/donor")
+                         if f.endswith(".parquet"))
+    with make_reader(url, reader_pool_type="dummy", num_epochs=None,
+                     shuffle_row_groups=False, refresh_interval_s=0,
+                     schema_fields=["id"]) as r:
+        it = iter(r)
+        base_ids = {next(it).id for _ in range(10)}
+        assert base_ids <= set(range(40))
+        shutil.copy(f"{tmp_path}/donor/{donor_files[-1]}",
+                    f"{tmp_path}/ds/zz-appended.parquet")
+        r.refresh_dataset()
+        assert r.dataset_growth_report()["applied"]
+        deadline = time.monotonic() + 10
+        seen = set()
+        while time.monotonic() < deadline and not (seen - set(range(40))):
+            seen.add(next(it).id)
+        assert seen - set(range(40)), "appended rows never served"
+
+
+# ------------------------------------------------------- reset rebase
+def test_reset_rebases_growth_into_new_pass(live_store):
+    url = f"file://{live_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=1,
+                           shuffle_row_groups=False, seed=5,
+                           sample_order="deterministic",
+                           refresh_interval_s=0) as r:
+        first_pass = drain_ids(iter(r))
+        assert len(first_pass) == 4
+        write_scalar_file(f"{live_store}/c.parquet", 40)
+        r.reset()  # polls synchronously and rebases the plan
+        second_pass = drain_ids(iter(r))
+        assert len(second_pass) == 6
+        assert {x for b in second_pass for x in b} == set(range(60))
+        # the rebased manifest carries the new file in the base
+        manifest = r.state_dict()["manifest"]
+        assert ["c.parquet", 2] in manifest["base"]
+        assert manifest["growth"] == []
+
+
+# --------------------------------------------- growth composes with knobs
+def test_growth_respects_sharding_stream(live_store):
+    url = f"file://{live_store}"
+    streams = {}
+    for shard in (0, 1):
+        with make_batch_reader(url, reader_pool_type="dummy",
+                               num_epochs=None, shuffle_row_groups=False,
+                               cur_shard=shard, shard_count=2,
+                               refresh_interval_s=0) as r:
+            it = iter(r)
+            ids = [batch_ids(next(it))]
+            if shard == 0:
+                write_scalar_file(f"{live_store}/c.parquet", 40)
+            r.refresh_dataset()
+            rep = r.dataset_growth_report()
+            if rep["applied"]:
+                assert rep["applied"][0]["items"] == 1  # half of 2 groups
+            # enough batches to sail past the ventilator's run-ahead and
+            # reach the growth's effective epoch
+            for _ in range(14):
+                ids.append(batch_ids(next(it)))
+            streams[shard] = {x for b in ids for x in b}
+    # both shards saw disjoint halves of the new file's groups over time
+    assert streams[0] & set(range(40, 60))
+    assert streams[1] & set(range(40, 60))
+    assert not (streams[0] & streams[1] & set(range(40, 60)))
+
+
+def test_growth_prunes_new_footers_incrementally(live_store):
+    from petastorm_tpu.predicates import in_range
+    url = f"file://{live_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=None,
+                           shuffle_row_groups=False,
+                           predicate=in_range("id", 0, 45),
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        next(it)
+        write_scalar_file(f"{live_store}/c.parquet", 40)  # groups 40-49, 50-59
+        pruned_before = r.telemetry.snapshot()["counters"].get(
+            "io.rowgroups_pruned", 0)
+        r.refresh_dataset()
+        applied = r.dataset_growth_report()["applied"][0]
+        # group 50-59 provably empty under id<45: pruned from stats the
+        # validation footer read harvested, zero extra IO
+        assert applied["pruned"] == 1 and applied["items"] == 1
+        pruned_after = r.telemetry.snapshot()["counters"][
+            "io.rowgroups_pruned"]
+        assert pruned_after == pruned_before + 1
+
+
+# ----------------------------------------------- stats footer errors fix
+def test_load_row_group_stats_counts_footer_errors(tmp_path):
+    root = str(tmp_path / "stats")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/good.parquet", 0)
+    with open(f"{root}/bad.parquet", "wb") as f:
+        f.write(b"PAR1 definitely not parquet")
+    ctx = DatasetContext(f"file://{root}")
+    from petastorm_tpu.etl.dataset_metadata import RowGroupRef
+    refs = [RowGroupRef(f"{root}/good.parquet", 0),
+            RowGroupRef(f"{root}/bad.parquet", 0)]
+    telemetry = make_registry()
+    stats = load_row_group_stats(ctx, refs, ["id"], telemetry=telemetry)
+    assert (f"{root}/good.parquet", 0) in stats
+    assert (f"{root}/bad.parquet", 0) not in stats
+    assert telemetry.snapshot()["counters"][
+        "io.stats_footer_errors_total"] == 1
+
+
+# -------------------------------------------------------- mixer telemetry
+def test_mixer_starvation_telemetry(live_store, tmp_path):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    other = str(tmp_path / "other")
+    os.makedirs(other)
+    write_scalar_file(f"{other}/x.parquet", 1000)
+    r1 = make_batch_reader(f"file://{live_store}", reader_pool_type="dummy",
+                           num_epochs=None, shuffle_row_groups=False)
+    r2 = make_batch_reader(f"file://{other}", reader_pool_type="dummy",
+                           num_epochs=1, shuffle_row_groups=False)
+    mixer = WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=3)
+    try:
+        starved = False
+        for _ in range(100):
+            try:
+                next(mixer)
+            except StopIteration:
+                starved = True
+                break
+        rep = mixer.report()
+        assert {m["index"] for m in rep["members"]} == {0, 1}
+        draws = [m["draws"] for m in rep["members"]]
+        assert sum(draws) >= 2 and all(d > 0 for d in draws)
+        assert all(m["lag_s"] >= 0 for m in rep["members"])
+        if starved:  # r2 (finite) ran dry under the seeded mix
+            assert rep["members"][1]["starved"] == 1
+            assert rep["members"][1]["exhausted"]
+        counters = mixer.telemetry.snapshot()["counters"]
+        assert counters["mixer.m0.draws_total"] == draws[0]
+    finally:
+        mixer.stop()
+        mixer.join()
+
+
+# ------------------------------------------------------------- mesh growth
+@pytest.mark.mesh
+def test_mesh_admit_growth_future_epoch(tmp_path):
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "mesh")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0, rows=64, row_group_size=8)
+    factory = MeshReaderFactory(f"file://{root}", batched=True)
+    # num_epochs=3: the loader's prefetch staging can run one epoch ahead
+    # of consumption, so growth admitted "after epoch 0" may land at epoch
+    # 2 — three passes guarantee the effective epoch runs.
+    loader = MeshDataLoader(factory, batch_size=16, num_epochs=3, seed=0,
+                            num_hosts=2)
+    try:
+        it = iter(loader)
+        seen_epoch0 = set()
+        for _ in range(4):  # epoch 0: 64 rows = 4 batches
+            batch = next(it)
+            seen_epoch0.update(int(x) for x in np.asarray(batch["id"]))
+        write_scalar_file(f"{root}/b.parquet", 100, rows=32,
+                          row_group_size=8)
+        result = loader.admit_growth(12)  # 8 + 4 new groups
+        assert result["admitted"] == 4 and result["folded"] == 0
+        assert 1 <= result["effective_epoch"] <= 2
+        seen_rest = set()
+        for batch in it:
+            seen_rest.update(int(x) for x in np.asarray(batch["id"]))
+        assert seen_epoch0 == set(range(64))
+        assert set(range(100, 132)) <= seen_rest
+        state = loader.state_dict()
+        assert state["num_rowgroups"] == 12
+        assert state["growth"][0] == [0, 8]
+    finally:
+        loader.close()
+
+
+@pytest.mark.mesh
+def test_mesh_admit_growth_fold_into_live_epoch(tmp_path):
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "meshfold")
+    os.makedirs(root)
+    # big enough (32 groups) + host_queue_depth=1 backpressure that the
+    # epoch is still live — pullers parked mid-plan — when growth lands
+    write_scalar_file(f"{root}/a.parquet", 0, rows=256, row_group_size=8)
+    factory = MeshReaderFactory(f"file://{root}", batched=True)
+    loader = MeshDataLoader(factory, batch_size=16, num_epochs=1, seed=None,
+                            num_hosts=2, host_queue_depth=1)
+    try:
+        it = iter(loader)
+        next(it)  # the epoch is live now
+        write_scalar_file(f"{root}/b.parquet", 1000, rows=16,
+                          row_group_size=8)
+        result = loader.admit_growth(34, fold_into_live_epoch=True)
+        assert result["admitted"] == 2 and result["folded"] == 2
+        seen = set()
+        for batch in it:
+            seen.update(int(x) for x in np.asarray(batch["id"]))
+        assert set(range(1000, 1016)) <= seen
+        counters = loader.telemetry.snapshot()["counters"]
+        assert counters["mesh.growth_admitted"] == 2
+    finally:
+        loader.close()
+
+
+@pytest.mark.mesh
+def test_mesh_growth_cursor_resume_validation(tmp_path):
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "meshres")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0, rows=64, row_group_size=8)
+    factory = MeshReaderFactory(f"file://{root}", batched=True)
+    state = {"mesh": True, "epoch": 1, "hosts": {"0": 0, "1": 0},
+             "num_rowgroups": 12, "num_hosts": 2,
+             "growth": [[0, 8], [1, 12]]}
+    # dataset grew further while the job was down: 14 groups on disk
+    loader = MeshDataLoader(factory, batch_size=16, num_epochs=1,
+                            num_hosts=2, num_rowgroups=14,
+                            resume_state=state)
+    try:
+        assert loader._g_at(0) == 8
+        assert loader._g_at(1) == 12
+        assert loader._g_at(2) == 14  # the while-down growth joins at e2
+    finally:
+        loader.close()
+    # a shrunken dataset refuses
+    with pytest.raises(ValueError, match="only\\s+append"):
+        MeshDataLoader(factory, batch_size=16, num_hosts=2,
+                       num_rowgroups=8, resume_state=state)
+
+
+@pytest.mark.mesh
+def test_mesh_admit_growth_on_resumed_loader_spares_cursor_epoch(tmp_path):
+    """Review finding: growth admitted on a resumed loader BEFORE the
+    first pull must land past the cursor's epoch — that epoch was planned
+    by the previous run and the saved offsets index its pre-growth plan."""
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "meshres2")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0, rows=64, row_group_size=8)
+    factory = MeshReaderFactory(f"file://{root}", batched=True)
+    state = {"mesh": True, "epoch": 2, "hosts": {"0": 1, "1": 1},
+             "num_rowgroups": 8, "num_hosts": 2}
+    loader = MeshDataLoader(factory, batch_size=16, num_epochs=None,
+                            num_hosts=2, seed=3, resume_state=state)
+    try:
+        result = loader.admit_growth(10)
+        assert result["effective_epoch"] == 3
+        assert loader._g_at(2) == 8   # the resumed epoch's plan unchanged
+        assert loader._g_at(3) == 10
+    finally:
+        loader.close()
+
+
+@pytest.mark.mesh
+def test_mesh_resume_no_growth_table_adopts_while_down_growth(tmp_path):
+    """Review finding: a cursor saved BEFORE the first admission (no
+    growth table) must still resume against a grown dataset — the extra
+    groups join from the next epoch, exactly like the growth-aware
+    branch."""
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "meshng")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0, rows=64, row_group_size=8)
+    factory = MeshReaderFactory(f"file://{root}", batched=True)
+    state = {"mesh": True, "epoch": 1, "hosts": {"0": 0, "1": 0},
+             "num_rowgroups": 8, "num_hosts": 2}
+    loader = MeshDataLoader(factory, batch_size=16, num_epochs=1,
+                            num_hosts=2, num_rowgroups=12,
+                            resume_state=state)
+    try:
+        assert loader._g_at(1) == 8   # the cursor's epoch plan unchanged
+        assert loader._g_at(2) == 12  # while-down growth joins at e2
+    finally:
+        loader.close()
+    # a SHRUNKEN dataset still refuses
+    with pytest.raises(ValueError, match="only append"):
+        MeshDataLoader(factory, batch_size=16, num_hosts=2,
+                       num_rowgroups=4, resume_state=state)
+
+
+def test_reset_rebases_manifest_resume_without_discovery(live_store):
+    """Review finding: a manifest-resumed reader with discovery OFF must
+    still rebase its growth schedule at reset() — the restarted epoch
+    counter must not be read against the previous run's absolute
+    effective epochs (growth items would silently vanish from the new
+    pass's early epochs)."""
+    write_scalar_file(f"{live_store}/c.parquet", 40)
+    manifest = {"base": [["a.parquet", 2], ["b.parquet", 2]],
+                "growth": [{"epoch": 2, "files": [["c.parquet", 2]],
+                            "items": 2}]}
+    resume = {"epoch": 0, "offset": 0, "items": 6, "seed": 11,
+              "sample_order": "deterministic", "window": 0,
+              "window_delivered": 0, "skipped_ordinals": [],
+              "manifest": manifest,
+              "plan": {"version": 1, "seed": 11, "items": 4,
+                       "shuffled": True, "window": 0,
+                       "growth": [[2, 6]]}}
+    # NOTE: no refresh_interval_s — the manifest alone defines the plan
+    with make_batch_reader(f"file://{live_store}", reader_pool_type="dummy",
+                           num_epochs=1, shuffle_row_groups=True, seed=11,
+                           sample_order="deterministic",
+                           resume_state=resume) as r:
+        first_pass = drain_ids(iter(r))
+        assert len(first_pass) == 4   # growth at epoch 2, num_epochs=1
+        r.reset()
+        second_pass = drain_ids(iter(r))
+        # rebased: the new pass's epoch 0 covers ALL admitted items
+        assert len(second_pass) == 6
+        assert {x for b in second_pass for x in b} == set(range(60))
+
+
+# ------------------------------------------------------------ SLO plumbing
+def test_ingest_lag_slo_rule():
+    from petastorm_tpu.telemetry.slo import evaluate_rules, parse_rules
+    rules = parse_rules("ingest_lag_s<=30")
+    assert rules[0].metric == "discovery.ingest_lag_s"
+    stale = {"counters": {}, "gauges": {"discovery.ingest_lag_s": 45.0},
+             "histograms": {}}
+    violations = evaluate_rules(stale, rules)
+    assert violations and violations[0]["rule"] == "ingest_lag_s"
+    fresh = {"counters": {}, "gauges": {"discovery.ingest_lag_s": 2.0},
+             "histograms": {}}
+    assert evaluate_rules(fresh, rules) == []
+    # static pipelines (no discovery gauge) skip the default rule
+    static = {"counters": {}, "gauges": {}, "histograms": {}}
+    from petastorm_tpu.telemetry.slo import default_rules
+    assert evaluate_rules(static, default_rules()) == []
+
+
+# --------------------------------------------------------------- CI lint
+def test_check_listing_lint_clean_and_catches(tmp_path):
+    lint = os.path.join(REPO_ROOT, "tools", "check_listing.py")
+    proc = subprocess.run([sys.executable, lint], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(fs):\n    return fs.ls('/data')\n")
+    proc = subprocess.run([sys.executable, lint, str(bad)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "list_data_files" in proc.stderr
+    waived = tmp_path / "waived.py"
+    waived.write_text(
+        "def f(fs):\n"
+        "    return fs.ls('/data')  # listing-ok: test fixture\n")
+    proc = subprocess.run([sys.executable, lint, str(waived)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0
+    # string .find stays legal
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 'abc'.find('b')\n")
+    proc = subprocess.run([sys.executable, lint, str(ok)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Lint guard: one source of listing truth — ``petastorm_tpu/discovery/``.
+
+A raw directory listing (``fs.ls`` / ``fs.find`` / ``os.listdir`` /
+``glob.glob`` / ``os.walk`` / ``Path.glob``) outside the discovery plane is
+an unretried, deadline-free, unobservable IO call on what the live-data
+plane treats as a first-class pipeline stage (docs/live_data.md): it can
+hang planning on a wedged store, it sees half-written files with no
+admission machinery, and it silently disagrees with the watcher's
+snapshot. Every listing must go through
+:func:`petastorm_tpu.discovery.listing.list_data_files` instead.
+
+The AST heuristic flags:
+
+* attribute calls named ``ls``/``listdir``/``iglob`` on ANY receiver;
+* attribute calls named ``find``/``glob``/``walk`` only when the receiver
+  chain looks filesystem-ish (``fs``, ``filesystem``, ``os``, ``glob``,
+  ``pathlib``/``Path``) — ``"string".find(...)`` and friends stay legal.
+
+A justified exception may opt out with a ``listing-ok`` comment on the
+call line, stating why it is not a dataset listing.
+
+Usage::
+
+    python tools/check_listing.py            # scan petastorm_tpu/ (minus discovery/)
+    python tools/check_listing.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATHS = ("petastorm_tpu",)
+EXEMPT_DIRS = (os.path.join("petastorm_tpu", "discovery"),)
+
+WAIVER = "listing-ok"
+
+#: Flagged on any receiver — these names are listing-specific.
+ALWAYS_SUSPECT = {"ls", "listdir", "iglob"}
+#: Flagged only when the receiver chain suggests a filesystem/glob module.
+FS_SUSPECT = {"find", "glob", "walk"}
+FS_RECEIVER_HINTS = {"fs", "filesystem", "os", "glob", "pathlib", "path"}
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):  # listing-ok: the linter walking its own source tree
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _receiver_names(node: ast.AST):
+    """Dotted-name components of an attribute chain's base, lowercased
+    (``self.filesystem`` -> {"self", "filesystem"})."""
+    names = set()
+    while isinstance(node, ast.Attribute):
+        names.add(node.attr.lower())
+        node = node.value
+    if isinstance(node, ast.Name):
+        names.add(node.id.lower())
+    elif isinstance(node, ast.Call):
+        names.update(_receiver_names(node.func))
+    return names
+
+
+def _violations(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        if attr in ALWAYS_SUSPECT:
+            yield node, attr
+        elif attr in FS_SUSPECT:
+            receivers = _receiver_names(node.func.value)
+            if receivers & FS_RECEIVER_HINTS:
+                yield node, attr
+
+
+def check_file(path: str) -> list:
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    if any(rel == d or rel.startswith(d + os.sep) for d in EXEMPT_DIRS):
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    for call, attr in sorted(_violations(tree), key=lambda v: v[0].lineno):
+        line = lines[call.lineno - 1] if call.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{call.lineno}: raw '.{attr}(' listing — route it "
+            f"through petastorm_tpu.discovery.listing.list_data_files "
+            f"(retried + deadline-bounded + telemetered; "
+            f"docs/live_data.md), or add '# {WAIVER}: <why this is not a "
+            f"dataset listing>'")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    checked = 0
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+        checked += 1
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_listing: {len(all_violations)} violation(s) in "
+              f"{checked} file(s)", file=sys.stderr)
+        return 1
+    print(f"check_listing: {checked} file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
