@@ -51,6 +51,12 @@ ci-lint:
 	python tools/check_rowloops.py
 	python tools/check_determinism.py
 	python tools/check_listing.py
+	python tools/check_metric_docs.py
+	# Shipped SLO rules + anomaly detectors, gated against the committed
+	# known-good bench telemetry snapshots (bench.py refreshes them each
+	# run): a rule/detector regression fails the BUILD, not just the bench.
+	python -m petastorm_tpu.telemetry check bench_snapshots/appending_epoch.json --anomaly
+	python -m petastorm_tpu.telemetry check bench_snapshots/deterministic_epoch.json --anomaly
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

@@ -866,6 +866,23 @@ def main():
         "result['within_15pct'] = bool(\n"
         "    result['ordered_overhead_pct_thread'] <= 15.0\n"
         "    and result['ordered_overhead_pct_process'] <= 15.0)\n"
+        "# Committed ops-plane gate artifact (make ci-lint runs `telemetry\n"
+        "# check --anomaly` over it): one more deterministic epoch with the\n"
+        "# timeline sampler on, snapshot taken after close so the terminal\n"
+        "# window is in the ring.\n"
+        "from petastorm_tpu.telemetry import write_snapshot\n"
+        "r = make_batch_reader(url, num_epochs=1, shuffle_row_groups=True,\n"
+        "                      seed=0, reader_pool_type='thread',\n"
+        "                      workers_count=3,\n"
+        "                      sample_order='deterministic',\n"
+        "                      timeline_interval_s=0.1)\n"
+        "with r:\n"
+        "    for _ in r:\n"
+        "        pass\n"
+        "os.makedirs(os.environ['PT_BENCH_SNAPSHOT_DIR'], exist_ok=True)\n"
+        "write_snapshot(os.path.join(os.environ['PT_BENCH_SNAPSHOT_DIR'],\n"
+        "                            'deterministic_epoch.json'),\n"
+        "               r.telemetry.snapshot())\n"
         "print('BENCHJSON:' + json.dumps({'deterministic_epoch': result}))\n")
     try:
         out.update(_cpu_subprocess(determinism_child, data_dir,
@@ -923,6 +940,74 @@ def main():
         out.update(_cpu_subprocess(trace_child, data_dir, timeout_s=600.0))
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"trace-overhead phase failed: {e!r}", file=sys.stderr)
+
+    # ---- 4f3c. ops-plane overhead + anomaly latency (docs/observability.md
+    # "Ops plane"): (a) the headline scalar epoch with the timeline
+    # sampler OFF vs ON (windowed rate derivation + anomaly bank per
+    # window), interleaved best-of-5, <=3% acceptance like the trace
+    # phase; (b) an injected throughput collapse — the consumer stops
+    # pulling mid-epoch — asserting the anomaly detector fires within 2
+    # timeline windows of the collapse.
+    ops_plane_child = (
+        "import json, os, statistics, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "def epoch(interval):\n"
+        "    t0 = time.perf_counter()\n"
+        "    with make_batch_reader(url, num_epochs=1, shuffle_row_groups=False,\n"
+        "                           reader_pool_type='thread', workers_count=3,\n"
+        "                           timeline_interval_s=interval) as r:\n"
+        "        rows = sum(len(b[0]) for b in r)\n"
+        "    elapsed = time.perf_counter() - t0\n"
+        "    # After close: the sampler's stop took the terminal window.\n"
+        "    windows = len(r.timeline_report().get('windows', []))\n"
+        "    return rows / elapsed, windows\n"
+        "epoch(None)  # warm-up pays import + fs metadata costs\n"
+        "off, on, windows_on = [], [], 0\n"
+        "for _ in range(5):\n"
+        "    rate_off, _ = epoch(None)\n"
+        "    off.append(rate_off)\n"
+        "    rate_on, windows_on = epoch(0.25)\n"
+        "    on.append(rate_on)\n"
+        "off_best, on_best = max(off), max(on)\n"
+        "overhead = 100.0 * (off_best - on_best) / max(off_best, 1e-9)\n"
+        "# (b) seeded throughput collapse: pull at full rate for 12\n"
+        "# windows, then park the consumer; the EWMA collapse detector\n"
+        "# must fire within 2 windows of the rate cliff.\n"
+        "W = 0.1\n"
+        "with make_batch_reader(url, num_epochs=None,\n"
+        "                       shuffle_row_groups=False,\n"
+        "                       reader_pool_type='thread', workers_count=3,\n"
+        "                       timeline_interval_s=W) as r:\n"
+        "    it = iter(r)\n"
+        "    t0 = time.perf_counter()\n"
+        "    while time.perf_counter() - t0 < 12 * W:\n"
+        "        next(it)\n"
+        "    stall_start = len(r.timeline_report().get('windows', []))\n"
+        "    time.sleep(6 * W)  # consumer parked: rows/s cliff\n"
+        "    rep = r.anomaly_report()\n"
+        "collapses = [d for d in rep.get('detections', [])\n"
+        "             if 'collapse' in d['rule'] and d['window'] >= stall_start]\n"
+        "fired_after = (min(d['window'] for d in collapses) - stall_start\n"
+        "               if collapses else None)\n"
+        "print('BENCHJSON:' + json.dumps({'ops_plane_epoch': {\n"
+        "    'samples_per_sec_off': round(off_best, 1),\n"
+        "    'samples_per_sec_on': round(on_best, 1),\n"
+        "    'samples_per_sec_off_p50': round(statistics.median(off), 1),\n"
+        "    'samples_per_sec_on_p50': round(statistics.median(on), 1),\n"
+        "    'timeline_windows': windows_on,\n"
+        "    'overhead_pct': round(overhead, 2),\n"
+        "    'within_3pct': bool(overhead <= 3.0),\n"
+        "    'collapse_detected_after_windows': fired_after,\n"
+        "    'anomaly_within_2_windows': bool(\n"
+        "        fired_after is not None and fired_after <= 2)}}))\n")
+    try:
+        out.update(_cpu_subprocess(ops_plane_child, data_dir,
+                                   timeout_s=600.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"ops-plane phase failed: {e!r}", file=sys.stderr)
 
     # ---- 4f4. multi-host mesh ingestion (docs/mesh.md): one logical
     # dataset -> one globally sharded jax.Array per step, on the 8-device
@@ -1057,7 +1142,8 @@ def main():
         "with make_batch_reader('file://' + root, reader_pool_type='thread',\n"
         "                       workers_count=3, num_epochs=None,\n"
         "                       shuffle_row_groups=False, fault_plan=plan,\n"
-        "                       refresh_interval_s=POLL_S) as reader:\n"
+        "                       refresh_interval_s=POLL_S,\n"
+        "                       timeline_interval_s=0.25) as reader:\n"
         "    for batch in reader:\n"
         "        rows += len(batch.id)\n"
         "        if time.perf_counter() - t0 > RUN_S:\n"
@@ -1066,6 +1152,14 @@ def main():
         "    rep = reader.dataset_growth_report()\n"
         "    snap = reader.telemetry.snapshot()\n"
         "stop.set()\n"
+        "# Committed ops-plane gate artifact: the snapshot (with its live\n"
+        "# timeline ring + ingest-lag gauges) make ci-lint SLO/anomaly-\n"
+        "# checks against.\n"
+        "from petastorm_tpu.telemetry import write_snapshot\n"
+        "os.makedirs(os.environ['PT_BENCH_SNAPSHOT_DIR'], exist_ok=True)\n"
+        "write_snapshot(os.path.join(os.environ['PT_BENCH_SNAPSHOT_DIR'],\n"
+        "                            'appending_epoch.json'),\n"
+        "               reader.telemetry.snapshot())\n"
         "disc = rep['discovery']\n"
         "lag = disc['max_admission_lag_s']\n"
         "print('BENCHJSON:' + json.dumps({'appending_epoch': {\n"
@@ -1196,7 +1290,10 @@ def _cpu_subprocess(child_code: str, data_dir: str,
     hold a broken PJRT client. data_dir arrives via env, never interpolated
     into code."""
     import subprocess
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PT_BENCH_DATA_DIR=data_dir)
+    snap_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_snapshots")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PT_BENCH_DATA_DIR=data_dir,
+               PT_BENCH_SNAPSHOT_DIR=snap_dir)
     proc = subprocess.run([sys.executable, "-c", child_code], env=env,
                           capture_output=True, text=True, timeout=timeout_s)
     for line in proc.stdout.splitlines():

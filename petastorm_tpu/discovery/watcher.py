@@ -129,6 +129,13 @@ class DatasetWatcher:
                             lambda: float(len(self._pending)))
             telemetry.gauge("discovery.snapshot_age_s", self._snapshot_age_s)
             telemetry.gauge("discovery.ingest_lag_s", self._ingest_lag_s)
+            # The cadence-independent ingestion-health number (admission
+            # wall time minus file mtime, max over admitted files): unlike
+            # ingest_lag_s it excludes the producer's append cadence, so
+            # it is the default timeline series + SLO surface for "is
+            # ADMISSION keeping up" (docs/live_data.md).
+            telemetry.gauge("discovery.max_admission_lag_s",
+                            lambda: self._max_admission_lag_s)
         else:
             self._c_discovered = self._c_admitted = self._c_quarantined = \
                 self._c_refused = self._c_groups = self._c_drift = \

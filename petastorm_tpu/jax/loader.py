@@ -772,6 +772,14 @@ class LoaderBase:
         self-time records. See docs/observability.md."""
         return self.critical_path.report()
 
+    def timeline_report(self) -> dict:
+        """The pipeline's rolling timeline ring (docs/observability.md
+        "Ops plane"). A loader over a Reader shares its registry, so this
+        is the reader's timeline — one per-pipeline ring covering decode
+        through staging. Empty dict when the ops plane is off."""
+        timeline = getattr(self.telemetry, "timeline", None)
+        return {} if timeline is None else timeline.as_dict()
+
     def stage_breakdown(self) -> dict:
         """Cumulative seconds per pipeline stage (the ``stage_breakdown``
         block ``bench.py`` emits):

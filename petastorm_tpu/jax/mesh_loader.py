@@ -127,6 +127,14 @@ class MeshReaderFactory:
         self.batched = bool(batched)
         self.reader_kwargs = dict(reader_kwargs)
         self.reader_kwargs.setdefault("workers_count", 1)
+        # Host readers keep their timeline rings (the federation members)
+        # but not the per-reader anomaly bank by default: a host parked on
+        # assembler backpressure reads as a local throughput collapse, and
+        # fleet health is the MESH monitor's job (host_skew_divergence).
+        # Unconditional — PETASTORM_TPU_TIMELINE enables host timelines
+        # without a timeline_interval_s kwarg. Override explicitly if
+        # per-host detectors are wanted.
+        self.reader_kwargs.setdefault("timeline_anomaly", False)
         pool = self.reader_kwargs.get("reader_pool_type", "thread")
         #: True when per-host delivery order provably equals ventilation
         #: order (columnar one-item-per-group stream through a single
@@ -302,7 +310,8 @@ class MeshDataLoader(LoaderBase):
                  num_epochs: Optional[int] = 1, seed: Optional[int] = None,
                  strict: bool = False, resume_state: Optional[dict] = None,
                  num_rowgroups: Optional[int] = None,
-                 host_queue_depth: int = 2, **kwargs):
+                 host_queue_depth: int = 2,
+                 timeline_interval_s: Optional[float] = None, **kwargs):
         from jax.sharding import NamedSharding, PartitionSpec
 
         from petastorm_tpu.parallel.mesh import (batch_shard_count, make_mesh,
@@ -445,6 +454,47 @@ class MeshDataLoader(LoaderBase):
         self._last_input_state = {
             "mesh": True, "epoch": self._resume_epoch, "hosts": hosts0,
             "num_rowgroups": self._G, "num_hosts": self._H}
+
+        # ----- ops plane (docs/observability.md "Ops plane"): the mesh
+        # registry's own rolling timeline (its mesh.host{h}.rows counters
+        # feed per-host rows/s family series), per-host reader timelines
+        # captured at source teardown for the federated mesh_report view,
+        # the anomaly bank (host_skew_divergence watches the family), and
+        # the postmortem black box for mesh-level fatals.
+        from petastorm_tpu.telemetry.timeseries import (
+            MetricsTimeline, TimelineSampler, timeline_interval_from_env)
+        self._host_timelines: Dict[str, list] = {}
+        self._timeline = None
+        self._timeline_sampler = None
+        self.anomaly_monitor = None
+        self.blackbox = None
+        interval = (timeline_interval_s if timeline_interval_s is not None
+                    else timeline_interval_from_env())
+        if interval:
+            from petastorm_tpu.telemetry.anomaly import AnomalyMonitor
+            self._timeline = MetricsTimeline(interval_s=interval)
+            self.telemetry.timeline = self._timeline
+            self.anomaly_monitor = AnomalyMonitor(
+                self.telemetry, on_detection=self._on_anomaly)
+            self._timeline.add_listener(self.anomaly_monitor.observe_window)
+            self._timeline_sampler = TimelineSampler(
+                self.telemetry, self._timeline, interval).start()
+        from petastorm_tpu.telemetry.postmortem import (
+            BlackBox, blackbox_dir_from_env)
+        bb_dir = blackbox_dir_from_env()
+        if bb_dir:
+            self.blackbox = BlackBox(
+                bb_dir, self.telemetry, label="mesh",
+                config={"hosts": self._H, "batch_size": batch_size,
+                        "num_rowgroups": self._G, "seed": seed,
+                        "multiprocess": self._multiprocess,
+                        "strict": self._strict})
+            self.blackbox.add_collector("mesh", self.mesh_report)
+            self.blackbox.add_collector(
+                "anomaly", lambda: (self.anomaly_monitor.report()
+                                    if self.anomaly_monitor else {}))
+            self.blackbox.add_collector("cursor",
+                                        lambda: self._last_input_state)
 
     # ------------------------------------------------------------- planning
     def _g_at(self, epoch: int) -> int:
@@ -780,6 +830,7 @@ class MeshDataLoader(LoaderBase):
                 self._source_done(1)
         finally:
             self._rollup_host_trace(feed.idx, reader)
+            self._rollup_host_timeline(feed.idx, reader)
             try:
                 reader.stop()
                 reader.join()
@@ -831,6 +882,38 @@ class MeshDataLoader(LoaderBase):
         rec.ingest([
             dataclasses.replace(sp, track=prefix + (sp.track or sp.thread))
             for sp in src_rec.drain()])
+
+    def _rollup_host_timeline(self, host: int, reader) -> None:
+        """Cross-host timeline rollup: capture the per-host reader's
+        timeline ring at source teardown (before the reader is gone) under
+        its ``h{idx}`` federation key. A host that ran several sources
+        (recovery after a reshard) contributes each source's ring in
+        order; ``mesh_report()`` concatenates them
+        (docs/observability.md "Federation")."""
+        timeline = getattr(getattr(reader, "telemetry", None), "timeline",
+                           None)
+        if timeline is None:
+            return
+        # reader.stop() has not run yet — take the terminal window so the
+        # captured ring covers the source's full life.
+        sampler = getattr(reader, "_timeline_sampler", None)
+        if sampler is not None:
+            try:
+                sampler.sample_once()
+            except Exception:  # noqa: BLE001 - rollup best-effort
+                pass
+        with self._cond:
+            self._host_timelines.setdefault(f"h{host}", []).append(
+                timeline.as_dict())
+
+    def _record_fatal(self, exc: BaseException) -> None:
+        if self.blackbox is not None:
+            self.blackbox.write_bundle(type(exc).__name__, exc=exc)
+
+    def _on_anomaly(self, detection: dict) -> None:
+        if self.blackbox is not None:
+            self.blackbox.write_bundle(
+                f"anomaly_{detection.get('rule', '?')}")
 
     def _source_done(self, n: int) -> None:
         """Caller holds ``self._cond``."""
@@ -1093,6 +1176,7 @@ class MeshDataLoader(LoaderBase):
             while True:
                 with self._cond:
                     if self._fatal is not None:
+                        self._record_fatal(self._fatal)
                         raise self._fatal
                     if stop.is_set():
                         # close() mid-iteration: abandon the epoch NOW —
@@ -1359,6 +1443,10 @@ class MeshDataLoader(LoaderBase):
         for feed in feeds:
             if feed.thread is not None:
                 feed.thread.join(15.0)
+        if self._timeline_sampler is not None:
+            # After the host plane joined: the terminal window covers the
+            # last per-host counter syncs.
+            self._timeline_sampler.stop()
 
     # ------------------------------------------------------------ reporting
     def mesh_report(self) -> dict:
@@ -1379,7 +1467,7 @@ class MeshDataLoader(LoaderBase):
                                     if wall else 0.0),
             }
         stalls = [v["input_stall_s"] for v in per_host.values()]
-        return {
+        report = {
             "hosts": self._H,
             "multiprocess": self._multiprocess,
             "ingest_wall_s": round(wall, 6),
@@ -1393,3 +1481,25 @@ class MeshDataLoader(LoaderBase):
             # the rollup the data-service dispatcher will export.
             "critical_path": self.critical_path.report(),
         }
+        timeline = self._federated_timeline()
+        if timeline is not None:
+            report["timeline"] = timeline
+        return report
+
+    def _federated_timeline(self) -> Optional[dict]:
+        """ONE fleet-level timeline rollup (docs/observability.md
+        "Federation"): the mesh registry's own ring (whose
+        ``mesh.host{h}.rows`` counter family yields per-host rows/s
+        series) federated with every captured per-host reader timeline,
+        keyed ``mesh`` / ``h{idx}`` — fleet-sum and skew series included.
+        None when the ops plane is off."""
+        from petastorm_tpu.telemetry.federation import federate_timelines
+        from petastorm_tpu.telemetry.timeseries import concat_timeline_dicts
+        with self._cond:
+            members = {key: concat_timeline_dicts(parts)
+                       for key, parts in self._host_timelines.items()}
+        if self._timeline is not None:
+            members["mesh"] = self._timeline.as_dict()
+        if not members:
+            return None
+        return federate_timelines(members, key_label="host")

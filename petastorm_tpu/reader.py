@@ -379,7 +379,9 @@ def make_reader(dataset_url,
                 row_materialization: str = "eager",
                 sample_order: str = "free",
                 shuffle_window: int = 0,
-                refresh_interval_s: Optional[float] = None):
+                refresh_interval_s: Optional[float] = None,
+                timeline_interval_s: Optional[float] = None,
+                timeline_anomaly: bool = True):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -544,6 +546,22 @@ def make_reader(dataset_url,
         with ``rowgroup_subset`` (the mesh layer folds growth itself,
         docs/mesh.md) and ``shard_seed`` (a pre-shuffled shard stream
         cannot extend monotonically). ``None`` = today's static snapshot.
+    :param timeline_interval_s: **ops plane** (docs/observability.md "Ops
+        plane"): attach a rolling :class:`~petastorm_tpu.telemetry.
+        MetricsTimeline` to this pipeline's registry, sampled every
+        ``timeline_interval_s`` seconds by a background thread — windowed
+        rates (rows/s, bytes/s, stall fraction, hedge rate, ingest lag)
+        and rolling quantiles, exported under ``snapshot()["timeline"]``
+        and :meth:`Reader.timeline_report`, rendered live by ``python -m
+        petastorm_tpu.telemetry top``. ``None`` defers to the
+        ``PETASTORM_TPU_TIMELINE`` env var; unset = off.
+    :param timeline_anomaly: run the default anomaly-detector bank
+        (:func:`petastorm_tpu.telemetry.default_anomaly_rules`) over every
+        timeline window, recording ``anomaly.*`` events/counters and — with
+        ``PETASTORM_TPU_BLACKBOX`` armed — writing a postmortem bundle on
+        a detection's entry edge. ``False`` keeps the ring without the
+        detectors (the right setting for sub-feeds whose local rates
+        legitimately gap, e.g. mesh host readers).
 
     Parity: reference reader.py:60.
     """
@@ -622,7 +640,9 @@ def make_reader(dataset_url,
                   row_materialization=row_materialization,
                   sample_order=sample_order,
                   shuffle_window=shuffle_window,
-                  refresh_interval_s=refresh_interval_s)
+                  refresh_interval_s=refresh_interval_s,
+                  timeline_interval_s=timeline_interval_s,
+                  timeline_anomaly=timeline_anomaly)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -672,7 +692,9 @@ def make_batch_reader(dataset_url_or_urls,
                       rowgroup_subset: Optional[Sequence[int]] = None,
                       sample_order: str = "free",
                       shuffle_window: int = 0,
-                      refresh_interval_s: Optional[float] = None):
+                      refresh_interval_s: Optional[float] = None,
+                      timeline_interval_s: Optional[float] = None,
+                      timeline_anomaly: bool = True):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -804,7 +826,9 @@ def make_batch_reader(dataset_url_or_urls,
                   rowgroup_subset=rowgroup_subset,
                   sample_order=sample_order,
                   shuffle_window=shuffle_window,
-                  refresh_interval_s=refresh_interval_s)
+                  refresh_interval_s=refresh_interval_s,
+                  timeline_interval_s=timeline_interval_s,
+                  timeline_anomaly=timeline_anomaly)
 
 
 class Reader:
@@ -827,7 +851,8 @@ class Reader:
                  readahead_max_bytes=None, pool_factory=None,
                  rowgroup_subset=None, row_materialization="eager",
                  sample_order="free", shuffle_window=0,
-                 refresh_interval_s=None):
+                 refresh_interval_s=None, timeline_interval_s=None,
+                 timeline_anomaly=True):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -855,6 +880,13 @@ class Reader:
         # docs/observability.md for the metric schema.
         self.telemetry = make_registry()
         self._telemetry_exporter = None
+        # Ops plane (docs/observability.md "Ops plane"): rolling timeline +
+        # anomaly monitor + postmortem black box, armed further down once
+        # the pool exists (their collectors read pool state).
+        self._timeline = None
+        self._timeline_sampler = None
+        self.anomaly_monitor = None
+        self.blackbox = None
 
         # ---------------- deterministic epoch plane (docs/determinism.md)
         if sample_order not in ("free", "deterministic"):
@@ -1573,6 +1605,32 @@ class Reader:
         #: (``1`` = default rules, else a ``parse_rules`` spec); rolling
         #: detectors over this pipeline's registry, violations recorded as
         #: ``slo.violation`` events. Stops with the reader.
+        # ---------------- postmortem black box (docs/observability.md
+        # "Postmortem black box"): armed by PETASTORM_TPU_BLACKBOX=/dir.
+        # Collectors snapshot every report surface at trigger time; the
+        # triggers are wired below (SLO/anomaly entry edges, watchdog
+        # abort) and in __next__ (any fatal escaping the pipeline).
+        from petastorm_tpu.telemetry.postmortem import (BlackBox,
+                                                        blackbox_dir_from_env)
+        bb_dir = blackbox_dir_from_env()
+        if bb_dir:
+            self.blackbox = BlackBox(
+                bb_dir, self.telemetry, label="reader",
+                config=self._config_summary())
+            self.blackbox.add_collector("cursor", self.state_dict)
+            self.blackbox.add_collector("quarantine", self.quarantine_report)
+            self.blackbox.add_collector("pruning", self.pruning_report)
+            self.blackbox.add_collector("readahead", self.readahead_report)
+            self.blackbox.add_collector("autotune", self.autotune_report)
+            self.blackbox.add_collector("growth", self.dataset_growth_report)
+            self.blackbox.add_collector("slo", self.slo_report)
+            self.blackbox.add_collector("anomaly", self.anomaly_report)
+            self.blackbox.add_collector("watchdog", self.watchdog_report)
+            if self.watchdog is not None:
+                self.watchdog.on_abort = (
+                    lambda err: self.blackbox.write_bundle("watchdog_abort",
+                                                           exc=err))
+
         self.slo_watcher = None
         slo_spec = os.environ.get(SLO_WATCH_ENV, "").strip()
         if slo_spec:
@@ -1581,7 +1639,36 @@ class Reader:
                                                      parse_rules)
             rules = (default_rules() if slo_spec in ("1", "default")
                      else parse_rules(slo_spec))
-            self.slo_watcher = SloWatcher(self.telemetry, rules).start()
+            self.slo_watcher = SloWatcher(
+                self.telemetry, rules,
+                on_violation=self._on_slo_violation).start()
+
+        # ---------------- rolling timeline + anomaly monitor
+        # (docs/observability.md "Ops plane"): `timeline_interval_s=` or
+        # PETASTORM_TPU_TIMELINE=seconds attach a MetricsTimeline to this
+        # pipeline's registry, fed by a background sampler (monotonic
+        # clock), with the default anomaly detector bank listening on
+        # every closed window.
+        from petastorm_tpu.telemetry.timeseries import (
+            MetricsTimeline, TimelineSampler, timeline_interval_from_env)
+        interval = (timeline_interval_s if timeline_interval_s is not None
+                    else timeline_interval_from_env())
+        if interval:
+            self._timeline = MetricsTimeline(interval_s=interval)
+            self.telemetry.timeline = self._timeline
+            if timeline_anomaly:
+                # `timeline_anomaly=False` keeps the ring without the
+                # detector bank — the right setting for SUB-feeds whose
+                # local rates legitimately gap (mesh host readers parked
+                # on assembler backpressure look "collapsed" from their
+                # own ring; the fleet-level monitor owns their health).
+                from petastorm_tpu.telemetry.anomaly import AnomalyMonitor
+                self.anomaly_monitor = AnomalyMonitor(
+                    self.telemetry, on_detection=self._on_anomaly)
+                self._timeline.add_listener(
+                    self.anomaly_monitor.observe_window)
+            self._timeline_sampler = TimelineSampler(
+                self.telemetry, self._timeline, interval).start()
 
     # ------------------------------------------------------------- planning
     def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
@@ -2186,6 +2273,15 @@ class Reader:
         except EmptyResultError:
             self.last_row_consumed = True
             raise StopIteration
+        except StopIteration:
+            raise
+        except Exception as e:
+            # Fatal escaping the pipeline (PipelineHungError, a pool
+            # abort, crash-budget exhaustion, a worker exception): the
+            # black box writes its bundle BEFORE the consumer unwinds —
+            # the registry/timeline/stacks still describe the death.
+            self._record_fatal(e)
+            raise
 
     def next_batch(self):
         """Next whole decoded unit as COLUMNS — the batch-native consumer
@@ -2211,6 +2307,11 @@ class Reader:
         except EmptyResultError:
             self.last_row_consumed = True
             raise StopIteration
+        except StopIteration:
+            raise
+        except Exception as e:
+            self._record_fatal(e)
+            raise
 
     def next(self):
         return self.__next__()
@@ -2309,6 +2410,11 @@ class Reader:
             self.watchdog.stop()
         if self.slo_watcher is not None:
             self.slo_watcher.stop()
+        if self._timeline_sampler is not None:
+            # Before the exporter's final flush: the sampler's stop takes
+            # the terminal window, so the last exported snapshot carries
+            # the complete timeline ring.
+            self._timeline_sampler.stop()
         if self.autotune is not None:
             self.autotune.stop()
         if self._telemetry_exporter is not None:
@@ -2400,6 +2506,56 @@ class Reader:
         :data:`~petastorm_tpu.telemetry.SLO_WATCH_ENV` is unset. See
         docs/observability.md "SLO watch"."""
         return {} if self.slo_watcher is None else self.slo_watcher.report()
+
+    def timeline_report(self) -> dict:
+        """The rolling timeline ring (``MetricsTimeline.as_dict()`` form:
+        windowed rates + rolling quantiles). Empty dict when
+        ``timeline_interval_s``/:data:`~petastorm_tpu.telemetry.
+        TIMELINE_ENV` is off. See docs/observability.md "Ops plane"."""
+        return {} if self._timeline is None else self._timeline.as_dict()
+
+    def anomaly_report(self) -> dict:
+        """Anomaly monitor readout: the detector bank, every detection so
+        far, and what is actively anomalous. Empty dict when the timeline
+        is off (the detectors run over timeline windows)."""
+        return ({} if self.anomaly_monitor is None
+                else self.anomaly_monitor.report())
+
+    # ------------------------------------------------ ops-plane internals
+    def _config_summary(self) -> dict:
+        """JSON-safe construction summary for the black box's
+        ``config.json`` — what an operator needs to reproduce the run's
+        shape, not every kwarg."""
+        return {
+            "dataset_url": str(self._ctx.path_or_paths),
+            "pool_type": ("process" if isinstance(self._pool, ProcessPool)
+                          else "dummy" if isinstance(self._pool, DummyPool)
+                          else "thread"),
+            "workers_count": getattr(self._pool, "workers_count", None),
+            "is_batched_reader": self.is_batched_reader,
+            "row_materialization": self.row_materialization,
+            "sample_order": self.sample_order,
+            "shuffle_window": self._shuffle_window,
+            "seed": self._seed,
+            "num_items": getattr(self, "_num_items", None),
+        }
+
+    def _record_fatal(self, exc: BaseException) -> None:
+        """Black-box trigger for any fatal escaping the consumer API; the
+        exception class names the bundle (``pipelinehungerror``,
+        ``workercrashbudgetexceeded``, ...), so distinct failure modes
+        latch distinct bundles."""
+        if self.blackbox is not None:
+            self.blackbox.write_bundle(type(exc).__name__, exc=exc)
+
+    def _on_slo_violation(self, violation: dict) -> None:
+        if self.blackbox is not None:
+            self.blackbox.write_bundle(f"slo_{violation.get('rule', '?')}")
+
+    def _on_anomaly(self, detection: dict) -> None:
+        if self.blackbox is not None:
+            self.blackbox.write_bundle(
+                f"anomaly_{detection.get('rule', '?')}")
 
     def watchdog_report(self) -> dict:
         """Watchdog readout: hang detections/recoveries, the current

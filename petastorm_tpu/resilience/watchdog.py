@@ -132,6 +132,11 @@ class PipelineWatchdog:
         self._stage_at = 0.0
         self._aborted = False
         self.last_stack_dump: Optional[dict] = None
+        #: Optional ``fn(PipelineHungError)`` fired when the final abort
+        #: rung declares the pipeline dead, BEFORE ``pool.abort`` unblocks
+        #: the consumer — the postmortem black box's trigger (the bundle
+        #: then captures the hang, not the teardown that follows it).
+        self.on_abort = None
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "PipelineWatchdog":
@@ -296,6 +301,11 @@ class PipelineWatchdog:
             f"after nudge/cancel escalation. Thread stacks were recorded "
             f"to the telemetry registry (resilience.watchdog.stack_dump).")
         logger.error("%s", err)
+        if self.on_abort is not None:
+            try:
+                self.on_abort(err)
+            except Exception:  # noqa: BLE001 - the abort must still happen
+                logger.exception("watchdog on_abort hook failed")
         abort = getattr(self._pool, "abort", None)
         if abort is not None:
             abort(err)

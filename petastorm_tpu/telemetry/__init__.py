@@ -91,15 +91,34 @@ from petastorm_tpu.telemetry.trace import (CriticalPathAttributor,  # noqa: E402
                                            TraceContext, complete_lineages,
                                            lineage_index, to_chrome_trace,
                                            write_chrome_trace)
+from petastorm_tpu.telemetry.timeseries import (DEFAULT_SERIES,  # noqa: E402
+                                                TIMELINE_ENV,
+                                                MetricsTimeline, SeriesSpec,
+                                                TimelineSampler,
+                                                timeline_interval_from_env)
+from petastorm_tpu.telemetry.federation import (federate_snapshots,  # noqa: E402
+                                                federate_timelines)
+from petastorm_tpu.telemetry.anomaly import (AnomalyMonitor,  # noqa: E402
+                                             AnomalyRule,
+                                             default_anomaly_rules,
+                                             detect_over_timeline)
+from petastorm_tpu.telemetry.postmortem import (BLACKBOX_ENV,  # noqa: E402
+                                                BlackBox,
+                                                blackbox_dir_from_env)
 
 __all__ = [
-    "Counter", "CriticalPathAttributor", "DEFAULT_RULES", "Gauge",
-    "LATENCY_BOUNDS_S", "PeriodicExporter", "SIZE_BOUNDS",
-    "SLO_WATCH_ENV", "SNAPSHOT_SCHEMA_VERSION", "SloRule", "SloWatcher",
-    "Span", "SpanRecorder", "StallAttributor", "StreamingHistogram",
-    "TELEMETRY_EXPORT_ENV", "TELEMETRY_SPANS_ENV", "TELEMETRY_TRACE_ENV",
-    "TelemetryRegistry", "TraceContext", "complete_lineages",
-    "evaluate_rules", "from_json", "lineage_index", "make_registry",
-    "parse_prometheus_text", "parse_rules", "to_chrome_trace", "to_json",
-    "to_prometheus_text", "write_chrome_trace", "write_snapshot",
+    "AnomalyMonitor", "AnomalyRule", "BLACKBOX_ENV", "BlackBox",
+    "Counter", "CriticalPathAttributor", "DEFAULT_RULES", "DEFAULT_SERIES",
+    "Gauge", "LATENCY_BOUNDS_S", "MetricsTimeline", "PeriodicExporter",
+    "SIZE_BOUNDS", "SLO_WATCH_ENV", "SNAPSHOT_SCHEMA_VERSION",
+    "SeriesSpec", "SloRule", "SloWatcher", "Span", "SpanRecorder",
+    "StallAttributor", "StreamingHistogram", "TELEMETRY_EXPORT_ENV",
+    "TELEMETRY_SPANS_ENV", "TELEMETRY_TRACE_ENV", "TIMELINE_ENV",
+    "TelemetryRegistry", "TimelineSampler", "TraceContext",
+    "blackbox_dir_from_env", "complete_lineages", "default_anomaly_rules",
+    "detect_over_timeline", "evaluate_rules", "federate_snapshots",
+    "federate_timelines", "from_json", "lineage_index", "make_registry",
+    "parse_prometheus_text", "parse_rules", "timeline_interval_from_env",
+    "to_chrome_trace", "to_json", "to_prometheus_text",
+    "write_chrome_trace", "write_snapshot",
 ]

@@ -7,20 +7,31 @@ fresh JSON snapshot on disk; this tool renders it:
     python -m petastorm_tpu.telemetry dump /tmp/pt.json
     python -m petastorm_tpu.telemetry dump /tmp/pt.json --format prometheus
     python -m petastorm_tpu.telemetry watch /tmp/pt.json --interval 2
+    python -m petastorm_tpu.telemetry top /tmp/pt.json --interval 2
+    python -m petastorm_tpu.telemetry timeline /tmp/pt.json --json series.json
     python -m petastorm_tpu.telemetry trace /tmp/pt.json --out trace.json
-    python -m petastorm_tpu.telemetry check /tmp/pt.json --slo input_stall_pct<=1
+    python -m petastorm_tpu.telemetry check /tmp/pt.json --slo input_stall_pct<=1 --anomaly
+    python -m petastorm_tpu.telemetry postmortem /tmp/blackbox/reader-123-01-pipelinehungerror
 
 ``dump`` prints one rendering and exits; ``watch`` re-renders every
 ``--interval`` seconds until interrupted (or ``--count`` iterations, for
 scripting) — including the per-name event rings (straggler / host-lost /
 reshard / SLO events) and a ``mesh.*`` per-host table when present.
-``trace`` converts one or more trace-mode snapshots (run the pipeline with
+``top`` is the live ops view over a timeline-enabled pipeline
+(``PETASTORM_TPU_TIMELINE=1``): per-series sparklines + current rates,
+re-rendered in place. ``timeline`` renders/flushes the rolling series of
+one or more snapshots (multiple files federate into a fleet view;
+``--json`` writes the merged series for bench artifacts). ``trace``
+converts one or more trace-mode snapshots (run the pipeline with
 ``PETASTORM_TPU_TELEMETRY_TRACE=1``) into Chrome-trace JSON for
 ``ui.perfetto.dev``, with a lineage + critical-path summary on stdout.
-``check`` evaluates SLO rules against a snapshot and exits non-zero on any
-violation — the CI/bench gate. Exit codes: 1 when a snapshot file is
-missing/unreadable (every subcommand), 2 when ``check`` finds violations,
-1 when ``trace`` finds no trace events.
+``check`` evaluates SLO rules against a snapshot — plus the anomaly
+detectors over its timeline with ``--anomaly`` — and exits non-zero on
+any violation: the CI/bench gate. ``postmortem`` renders a black-box
+bundle directory (docs/observability.md "Postmortem black box"). Exit
+codes: 1 when a snapshot file/bundle is missing/unreadable (every
+subcommand), 2 when ``check`` finds violations or anomalies, 1 when
+``trace`` finds no trace events.
 """
 from __future__ import annotations
 
@@ -30,6 +41,7 @@ import sys
 import time
 
 from petastorm_tpu.telemetry.exporters import from_json, to_prometheus_text
+from petastorm_tpu.telemetry.timeseries import render_sparkline as _sparkline
 
 _STAGE_ORDER = ("worker.decode_s", "reader.pool_wait_s", "loader.shuffle_s",
                 "loader.host_wait_s", "loader.stage_s",
@@ -128,6 +140,134 @@ def _render_events(snap: dict) -> list:
                 payload = payload[:117] + "..."
             lines.append(f"    #{entry.get('seq', '?'):<6} {payload}")
     return lines
+
+
+def _series_table(series: dict, names=None, width: int = 40) -> list:
+    """Sparkline table lines over ``{name: [values...]}``."""
+    lines = []
+    for name in sorted(series):
+        if names and not any(pat in name for pat in names):
+            continue
+        values = series[name]
+        tail = [v for v in values if v is not None]
+        if not tail:
+            continue
+        lines.append(f"  {name:<30} {_sparkline(values, width):<{width}} "
+                     f"last={tail[-1]:.6g}  min={min(tail):.6g}  "
+                     f"max={max(tail):.6g}")
+    return lines
+
+
+def _timeline_series(snap: dict) -> dict:
+    """``{name: [values...]}`` from a snapshot's embedded timeline."""
+    tl = snap.get("timeline") or {}
+    windows = tl.get("windows", [])
+    names = set()
+    for w in windows:
+        names.update(w.get("series", {}))
+    return {name: [w["series"].get(name) for w in windows]
+            for name in sorted(names)}
+
+
+def _render_top(snap: dict, series_filter=None) -> str:
+    """The `top` screen: headline gauges + anomaly/SLO state + series
+    sparklines from the embedded timeline ring."""
+    lines = []
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    head = []
+    for name, label in (("loader.input_stall_pct", "stall%"),
+                        ("ventilator.backlog", "backlog"),
+                        ("discovery.ingest_lag_s", "ingest_lag_s"),
+                        ("mesh.host_skew_s", "skew_s")):
+        value = gauges.get(name)
+        if value is not None:
+            head.append(f"{label}={value:.6g}")
+    for name, label in (("reader.rows", "rows"),
+                        ("anomaly.detections_total", "anomalies"),
+                        ("slo.violations_total", "slo_violations")):
+        value = counters.get(name)
+        if value:
+            head.append(f"{label}={value:.6g}")
+    lines.append("petastorm-tpu top — " + ("  ".join(head) or "no data"))
+    series = _timeline_series(snap)
+    if not series:
+        lines.append("(no timeline in snapshot — run the pipeline with "
+                     "PETASTORM_TPU_TIMELINE=1)")
+        return "\n".join(lines)
+    tl = snap.get("timeline", {})
+    lines.append(f"timeline: {len(tl.get('windows', []))} windows x "
+                 f"{tl.get('interval_s', '?')}s")
+    lines.extend(_series_table(series, series_filter))
+    anomalies = {k: v for k, v in (snap.get("events") or {}).items()
+                 if k.startswith(("anomaly.", "slo."))}
+    for name, ring in sorted(anomalies.items()):
+        for entry in ring[-2:]:
+            payload = json.dumps(entry.get("payload", {}), sort_keys=True,
+                                 default=str)
+            if len(payload) > 110:
+                payload = payload[:107] + "..."
+            lines.append(f"  ! {name}: {payload}")
+    return "\n".join(lines)
+
+
+def _cmd_timeline(args) -> int:
+    """Render (and optionally flush) the rolling series of one or more
+    snapshots; multiple files federate into one fleet view keyed by each
+    file's basename stem."""
+    from petastorm_tpu.telemetry.federation import federate_timelines
+    import os
+    members = {}
+    for path in args.paths:
+        try:
+            snap = _load(path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot {path}: {e}", file=sys.stderr)
+            return 1
+        key = os.path.splitext(os.path.basename(path))[0]
+        members[key] = snap.get("timeline") or {}
+    if len(members) == 1:
+        tl = next(iter(members.values()))
+        windows = tl.get("windows", [])
+        if not windows:
+            print("no timeline in the snapshot; run the pipeline with "
+                  "PETASTORM_TPU_TIMELINE=1", file=sys.stderr)
+            return 1
+        names = set()
+        for w in windows:
+            names.update(w.get("series", {}))
+        series = {n: [w["series"].get(n) for w in windows]
+                  for n in sorted(names)}
+        out = {"interval_s": tl.get("interval_s"),
+               "windows": len(windows), "series": series}
+    else:
+        fed = federate_timelines(members, key_label="file")
+        series = fed["series"]
+        out = fed
+    if args.last:
+        series = {k: v[-args.last:] for k, v in series.items()}
+        out = dict(out, series=series)  # --json gets what the table shows
+    print(f"timeline: {out.get('windows', out.get('depth'))} windows, "
+          f"{len(series)} series")
+    for line in _series_table(series, args.series or None):
+        print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+        print(f"wrote {args.json}")
+    return 0
+
+
+def _cmd_postmortem(args) -> int:
+    from petastorm_tpu.telemetry.postmortem import load_bundle, render_report
+    try:
+        bundle = load_bundle(args.bundle)
+    except (OSError, ValueError) as e:
+        print(f"cannot read postmortem bundle {args.bundle}: {e}",
+              file=sys.stderr)
+        return 1
+    print(render_report(bundle))
+    return 0
 
 
 def _stage_breakdown(snap: dict) -> dict:
@@ -256,8 +396,38 @@ def _cmd_check(args) -> int:
         else:
             print(f"ok   {rule.name}: {rule.metric} = {round(value, 6)} "
                   f"<= {rule.max_value}")
+    if args.anomaly:
+        # Anomaly gate: replay the detectors over the snapshot's timeline
+        # ring (docs/observability.md "Anomaly detection"). Honest
+        # skip-vs-ok like the rules above: no timeline = skip, loudly.
+        from petastorm_tpu.telemetry.anomaly import (default_anomaly_rules,
+                                                     detect_over_timeline)
+        timeline = snap.get("timeline")
+        if not timeline or not timeline.get("windows"):
+            print("skip anomaly: no timeline in snapshot (run the pipeline "
+                  "with PETASTORM_TPU_TIMELINE=1)")
+        else:
+            detections = detect_over_timeline(timeline,
+                                              default_anomaly_rules())
+            live = snap.get("counters", {}).get("anomaly.detections_total",
+                                                0)
+            if live and not detections:
+                # The live monitor fired but the offline replay did not
+                # (a window fell off the bounded ring): the gate must not
+                # pass what the pipeline itself flagged.
+                detections = [{"rule": "live_monitor", "window": None,
+                               "detail": f"anomaly.detections_total="
+                                         f"{live} in snapshot"}]
+            for det in detections:
+                violations.append(f"anomaly:{det['rule']}")
+                print(f"FAIL anomaly {det['rule']} at window "
+                      f"{det['window']}: {det['detail']}")
+            if not detections:
+                print(f"ok   anomaly: no detections over "
+                      f"{len(timeline['windows'])} windows")
     if violations:
-        print(f"{len(violations)} SLO violation(s)", file=sys.stderr)
+        print(f"{len(violations)} SLO/anomaly violation(s)",
+              file=sys.stderr)
         return 2
     return 0
 
@@ -279,6 +449,37 @@ def main(argv=None) -> int:
     watch.add_argument("--interval", type=float, default=2.0)
     watch.add_argument("--count", type=int, default=0,
                        help="stop after N renders (0 = forever)")
+
+    top_p = sub.add_parser(
+        "top", help="live ops view: timeline sparklines + anomaly state")
+    top_p.add_argument("path", help="snapshot file written by a "
+                                    "PETASTORM_TPU_TIMELINE-enabled "
+                                    "pipeline's exporter")
+    top_p.add_argument("--interval", type=float, default=2.0)
+    top_p.add_argument("--count", type=int, default=0,
+                       help="stop after N renders (0 = forever)")
+    top_p.add_argument("--series", action="append", default=[],
+                       help="substring filter on series names (repeatable)")
+    top_p.add_argument("--no-clear", action="store_true",
+                       help="append renders instead of redrawing in place")
+
+    tl_p = sub.add_parser(
+        "timeline", help="render/flush a snapshot's rolling series "
+                         "(multiple files federate)")
+    tl_p.add_argument("paths", nargs="+",
+                      help="snapshot file(s); >1 federates by file stem")
+    tl_p.add_argument("--json", default=None,
+                      help="write the (merged) series to this JSON path "
+                           "(bench artifacts)")
+    tl_p.add_argument("--series", action="append", default=[],
+                      help="substring filter on series names (repeatable)")
+    tl_p.add_argument("--last", type=int, default=0,
+                      help="keep only the newest N windows")
+
+    pm_p = sub.add_parser(
+        "postmortem", help="render a black-box bundle directory")
+    pm_p.add_argument("bundle", help="bundle directory written by the "
+                                     "PETASTORM_TPU_BLACKBOX recorder")
 
     trace_p = sub.add_parser(
         "trace", help="merge trace-mode snapshot(s) into Chrome-trace JSON")
@@ -302,12 +503,19 @@ def main(argv=None) -> int:
     check_p.add_argument("--window-s", type=float, default=None,
                          help="seconds between --prev and the snapshot "
                               "(required with --prev for rate rules)")
+    check_p.add_argument("--anomaly", action="store_true",
+                         help="also replay the anomaly detectors over the "
+                              "snapshot's timeline (exit 2 on detection)")
     args = parser.parse_args(argv)
 
     if args.cmd == "trace":
         return _cmd_trace(args)
     if args.cmd == "check":
         return _cmd_check(args)
+    if args.cmd == "timeline":
+        return _cmd_timeline(args)
+    if args.cmd == "postmortem":
+        return _cmd_postmortem(args)
 
     renders = 0
     while True:
@@ -316,12 +524,18 @@ def main(argv=None) -> int:
         except (OSError, ValueError) as e:
             print(f"cannot read snapshot {args.path}: {e}", file=sys.stderr)
             return 1
-        print(_render(snap, args.format))
+        if args.cmd == "top":
+            if renders and not args.no_clear:
+                print("\x1b[2J\x1b[H", end="")
+            print(_render_top(snap, args.series or None))
+        else:
+            print(_render(snap, args.format))
         renders += 1
         if args.cmd == "dump" or (args.count and renders >= args.count):
             return 0
-        print("---", flush=True)
-        time.sleep(args.interval)  # backoff-ok: watch-mode refresh cadence, not a retry
+        if args.cmd != "top" or args.no_clear:
+            print("---", flush=True)
+        time.sleep(args.interval)  # backoff-ok: watch/top refresh cadence, not a retry
 
 
 if __name__ == "__main__":

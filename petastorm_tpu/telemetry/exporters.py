@@ -17,11 +17,13 @@ Both render the dict produced by
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
 import threading
 import time
+import weakref
 from typing import Optional
 
 __all__ = ["to_prometheus_text", "parse_prometheus_text", "to_json",
@@ -142,10 +144,39 @@ def write_snapshot(path: str, snapshot: dict, fmt: str = "json") -> None:
     os.replace(tmp, path)
 
 
+#: Exporters started but not yet stopped. A reader abandoned without
+#: ``close()`` would otherwise lose its terminal snapshot — the daemon
+#: export thread dies with the interpreter before its next tick. The
+#: atexit hook below final-flushes every still-live exporter (weak
+#: references: an exporter whose owner was garbage-collected is gone,
+#: not resurrected).
+_LIVE_EXPORTERS: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_REGISTERED = False
+_ATEXIT_LOCK = threading.Lock()
+
+
+def _flush_live_exporters() -> None:
+    for exporter in list(_LIVE_EXPORTERS):
+        try:
+            exporter._write_once(final=True)
+        except Exception:  # noqa: BLE001 - interpreter exit: best-effort only
+            pass
+
+
+def _register_atexit_flush() -> None:
+    global _ATEXIT_REGISTERED
+    with _ATEXIT_LOCK:
+        if not _ATEXIT_REGISTERED:
+            atexit.register(_flush_live_exporters)
+            _ATEXIT_REGISTERED = True
+
+
 class PeriodicExporter:
     """Daemon thread exporting ``registry.snapshot()`` to ``path`` every
     ``interval_s`` (and once more on ``stop()``, so the final state always
-    lands on disk)."""
+    lands on disk). Abandonment-safe: a started exporter whose owner never
+    calls ``stop()`` still writes its terminal snapshot from an atexit
+    finalizer at interpreter exit."""
 
     def __init__(self, registry, path: str, interval_s: float = 2.0,
                  fmt: str = "json"):
@@ -161,6 +192,8 @@ class PeriodicExporter:
     def start(self) -> "PeriodicExporter":
         if self._thread is not None:
             raise RuntimeError("PeriodicExporter already started")
+        _register_atexit_flush()
+        _LIVE_EXPORTERS.add(self)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="petastorm-tpu-telemetry-export")
         self._thread.start()
@@ -188,4 +221,5 @@ class PeriodicExporter:
         if self._thread is not None:
             self._thread.join(timeout=self._interval + 5.0)
             self._thread = None
+        _LIVE_EXPORTERS.discard(self)
         self._write_once()

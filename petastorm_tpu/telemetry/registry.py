@@ -116,6 +116,13 @@ class TelemetryRegistry:
         # next to the always-on counters the critical-path attributor reads.
         self._stage_counters: Dict[str, Counter] = {}
         self.recorder.on_stage = self._observe_stage
+        #: Optional attached :class:`~petastorm_tpu.telemetry.timeseries.
+        #: MetricsTimeline` — when set (the reader/mesh loader's sampler
+        #: owns it), :meth:`snapshot` embeds its ring under
+        #: ``"timeline"`` so exported files feed ``telemetry top`` /
+        #: ``timeline`` and the anomaly CI gate. ``metrics_view()`` does
+        #: NOT include it (the sampler itself reads that view).
+        self.timeline = None
 
     def _observe_stage(self, stage: str, duration_s: float) -> None:
         c = self._stage_counters.get(stage)
@@ -249,6 +256,9 @@ class TelemetryRegistry:
         events = self.events()
         if events:
             snap["events"] = events
+        timeline = self.timeline
+        if timeline is not None:
+            snap["timeline"] = timeline.as_dict()
         if include_trace and self.recorder.trace_enabled:
             # Trace mode: raw lineage spans ride the snapshot so exported
             # files feed `python -m petastorm_tpu.telemetry trace`.

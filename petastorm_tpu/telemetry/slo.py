@@ -171,11 +171,15 @@ class SloWatcher:
     """
 
     def __init__(self, registry, rules: Optional[Sequence[SloRule]] = None,
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0, on_violation=None):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be > 0, got {interval_s}")
         self._registry = registry
         self.rules = list(rules) if rules is not None else default_rules()
+        #: Optional ``fn(violation_dict)`` fired on the FIRST tick of each
+        #: violation streak (entry edge, like the log line) — the
+        #: postmortem black box's SLO-trip trigger.
+        self._on_violation = on_violation
         self._interval = interval_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -226,6 +230,11 @@ class SloWatcher:
             if v["rule"] not in self._violating:
                 logger.warning("SLO violated: %(rule)s %(metric)s "
                                "%(value)s > %(threshold)s", v)
+                if self._on_violation is not None:
+                    try:
+                        self._on_violation(v)
+                    except Exception:  # noqa: BLE001 - callback must not kill ticks
+                        logger.exception("SLO on_violation callback failed")
         with self._lock:
             self._violating = broken
         return violations

@@ -33,11 +33,22 @@ class VentilatedItemProcessedMessage:
     consumer-side registry sees their decode time with lineage intact
     (trace mode only; the marker already crosses the ctrl-frame transport,
     so the piggyback costs no extra frame). In-process pools leave it
-    None — their workers record into the shared registry directly."""
+    None — their workers record into the shared registry directly.
 
-    def __init__(self, item_context=None, spans=None):
+    ``worker_id`` / ``busy_s``: the spawned worker's identity and this
+    item's in-worker processing seconds — always piggybacked (two floats
+    on an existing frame), so the consumer registry keeps per-worker
+    ``pool.w{id}.items`` / ``pool.w{id}.busy_s`` counters and the ops
+    plane's timeline can federate per-worker rates for a pool whose
+    workers cannot share the registry (docs/observability.md
+    "Federation"). In-process pools leave them None."""
+
+    def __init__(self, item_context=None, spans=None, worker_id=None,
+                 busy_s=None):
         self.item_context = item_context
         self.spans = spans
+        self.worker_id = worker_id
+        self.busy_s = busy_s
 
 
 class WorkerFailure:

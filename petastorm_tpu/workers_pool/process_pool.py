@@ -211,6 +211,10 @@ class ProcessPool:
         # Lazily-resolved transport.deserialize_s counter (telemetry is
         # assigned by the Reader after construction).
         self._c_deser = None
+        # Per-worker federation counters, cached per worker id (the
+        # registry lock is not for per-item paths).
+        self._c_w_items = {}
+        self._c_w_busy = {}
         ipc_dir = tempfile.mkdtemp(prefix="pt_pool_")
         token = uuid.uuid4().hex[:8]
         self._endpoints = {
@@ -327,6 +331,17 @@ class ProcessPool:
                     # frame: re-anchored to OUR clock at arrival (remote
                     # perf_counter bases are not comparable).
                     self.telemetry.recorder.record_remote(spans)
+                wid = getattr(msg, "worker_id", None)
+                if wid is not None and self.telemetry is not None:
+                    # Per-worker federation counters (docs/observability.md
+                    # "Federation"): spawned workers cannot reach the
+                    # registry, so their identity + busy time ride the
+                    # processed marker and land here — the timeline's
+                    # pool.w{id} family derives per-worker rates from them.
+                    self._worker_counters(wid).add(1)
+                    busy = getattr(msg, "busy_s", None)
+                    if busy:
+                        self._worker_busy(wid).add(busy)
                 if self.recovery is not None:
                     self.recovery.on_processed(msg.item_context)
                 if self._ventilator:
@@ -350,6 +365,20 @@ class ProcessPool:
             if isinstance(msg, _WorkerReady):
                 continue
             return msg
+
+    def _worker_counters(self, worker_id: int):
+        c = self._c_w_items.get(worker_id)
+        if c is None:
+            c = self._c_w_items[worker_id] = self.telemetry.counter(
+                f"pool.w{worker_id}.items")
+        return c
+
+    def _worker_busy(self, worker_id: int):
+        c = self._c_w_busy.get(worker_id)
+        if c is None:
+            c = self._c_w_busy[worker_id] = self.telemetry.counter(
+                f"pool.w{worker_id}.busy_s")
+        return c
 
     def abort(self, exc: BaseException):
         """Watchdog escalation endpoint: fail the pipeline with ``exc`` —
@@ -830,12 +859,13 @@ def _worker_bootstrap(worker_id, worker_class, worker_args, serializer_cls,
                     # tuple on the processed marker (the consumer
                     # re-anchors it; perf_counter does not cross process
                     # boundaries).
+                    busy_s = time.perf_counter() - t0
                     spans = ([("petastorm_tpu.worker_decode", "decode",
-                               time.perf_counter() - t0, trace,
-                               worker_track)] if trace is not None
-                             else None)
+                               busy_s, trace, worker_track)]
+                             if trace is not None else None)
                     send_ctrl(VentilatedItemProcessedMessage(
-                        kwargs.get(ITEM_CONTEXT_KWARG), spans=spans))
+                        kwargs.get(ITEM_CONTEXT_KWARG), spans=spans,
+                        worker_id=worker_id, busy_s=busy_s))
                 except _RING_CLOSED_ERRORS:
                     # The consumer stopped and closed our ring mid-publish
                     # (early reader shutdown): a clean exit, not a failure.

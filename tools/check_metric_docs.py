@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Lint guard: every registered metric name must be documented.
+
+docs/observability.md carries the metric schema tables every dashboard,
+SLO rule, timeline series, and bench consumer is written against. A
+metric registered in code but absent from the schema is invisible drift:
+operators cannot find it, the tuning guidance never mentions it, and the
+ops plane's series specs reference names nobody vetted. This AST check
+walks every ``counter("...")`` / ``gauge("...")`` / ``histogram("...")``
+registration in ``petastorm_tpu/`` whose first argument is a (possibly
+f-string) literal and requires the name to appear in
+docs/observability.md.
+
+Dynamic name families match by wildcard: the f-string
+``f"mesh.host{h}.rows"`` normalizes to ``mesh.host*.rows`` and matches a
+documented ``mesh.host{h}.rows`` row (doc-side ``{...}`` placeholders
+normalize the same way). A deliberate undocumented metric can be waived
+with a ``metric-doc-ok`` comment on the registration line (say why).
+
+Usage::
+
+    python tools/check_metric_docs.py          # lint (exit 1 on drift)
+    python tools/check_metric_docs.py --list   # print every registration
+
+Wired into ``make ci-lint``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(ROOT, "petastorm_tpu")
+DOCS = (os.path.join(ROOT, "docs", "observability.md"),)
+
+WAIVER = "metric-doc-ok"
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+#: Backticked dotted tokens in the docs: `mesh.host{h}.rows`,
+#: `trace.span.{stage}_s`, `pool.w{id}.items`...
+_DOC_TOKEN = re.compile(r"`([A-Za-z0-9_*{}.]+\.[A-Za-z0-9_*{}.]+)`")
+_PLACEHOLDER = re.compile(r"\{[^}]*\}")
+
+
+def _normalize(name: str) -> str:
+    """Collapse `{...}` placeholders (and bare `{}`) to `*`."""
+    return _PLACEHOLDER.sub("*", name)
+
+
+def _doc_names() -> set:
+    names = set()
+    for path in DOCS:
+        with open(path) as f:
+            text = f.read()
+        for m in _DOC_TOKEN.finditer(text):
+            names.add(_normalize(m.group(1)))
+    return names
+
+
+def _literal_metric_name(node: ast.AST):
+    """The metric-name literal of a registration call's first arg:
+    a str constant, or an f-string whose constant parts are kept and
+    formatted values become ``*``. None for fully dynamic names (a
+    variable) — those cannot be linted here."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _wildcard_match(code_name: str, doc_name: str) -> bool:
+    """Match two names where either side may hold ``*`` wildcards (single
+    segment each; metric names never contain regex metacharacters beyond
+    the dot)."""
+    if code_name == doc_name:
+        return True
+    pattern = "^" + re.escape(doc_name).replace(r"\*", "[A-Za-z0-9_]+") + "$"
+    if re.match(pattern, code_name):
+        return True
+    pattern = "^" + re.escape(code_name).replace(r"\*", "[A-Za-z0-9_]+") + "$"
+    return bool(re.match(pattern, doc_name))
+
+
+def _registrations(path: str):
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _REGISTER_METHODS):
+            continue
+        name = _literal_metric_name(node.args[0])
+        if name is None or "." not in name:
+            # Fully dynamic names, and bare non-dotted literals
+            # (collections.Counter-style false positives), are out of
+            # scope.
+            continue
+        # Waiver: on the call line or the line the name literal sits on.
+        waived = any(WAIVER in lines[ln - 1]
+                     for ln in {node.lineno, node.args[0].lineno}
+                     if 0 < ln <= len(lines))
+        yield name, node.lineno, waived
+
+
+def _iter_py_files():
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    list_only = "--list" in argv
+    doc_names = _doc_names()
+    failures = []
+    seen = []
+    for path in _iter_py_files():
+        rel = os.path.relpath(path, ROOT)
+        for name, lineno, waived in _registrations(path):
+            norm = _normalize(name)
+            seen.append((rel, lineno, name))
+            if list_only:
+                continue
+            if waived:
+                continue
+            if not any(_wildcard_match(norm, doc) for doc in doc_names):
+                failures.append((rel, lineno, name))
+    if list_only:
+        for rel, lineno, name in seen:
+            print(f"{rel}:{lineno}: {name}")
+        return 0
+    if failures:
+        print("check_metric_docs: metric registrations missing from the "
+              "docs/observability.md schema tables:", file=sys.stderr)
+        for rel, lineno, name in failures:
+            print(f"  {rel}:{lineno}: {name!r}", file=sys.stderr)
+        print(f"{len(failures)} undocumented metric(s). Document each in "
+              f"docs/observability.md (backticked, e.g. `io.bytes_read`) "
+              f"or waive the registration line with a '# {WAIVER}: why' "
+              f"comment.", file=sys.stderr)
+        return 1
+    print(f"check_metric_docs: {len(seen)} metric registrations all "
+          f"documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
