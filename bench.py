@@ -1009,6 +1009,136 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"ops-plane phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4f3d. explain plane (docs/observability.md "Explain plane"):
+    # (a) profiled-explain overhead — the headline scalar epoch (x3 per
+    # sample, amortizing pool spin-up) plain vs calling
+    # Reader.explain(profiled=True) every 10 batches plus a final
+    # explain_report(), interleaved off/on/off best-of-7; the off halves
+    # straddling each on sample also yield the phase's own off-vs-off
+    # noise floor, and acceptance is overhead <= max(3%, noise floor) —
+    # the same measured-noise gate the cross-run regression comparator
+    # uses, because on a loaded host the wall-clock A/B noise dwarfs the
+    # sub-1% true explain cost; (b) what-if validation — two real knob flips
+    # under a deterministic injected 12 ms read latency (the injected
+    # sleep pins per-group service time, so the roofline projection has a
+    # stable target): decode_parallelism 1->3 and readahead_depth 1->8
+    # (fetchers 1->2), each measured and compared against the calibrated
+    # projection's documented 40% error band. The profiled graph +
+    # projections persist as the per-phase explain artifact
+    # (bench_snapshots/explain_epoch.json) so the perf trajectory carries
+    # operator-level provenance.
+    explain_child = (
+        "import json, os, statistics, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from petastorm_tpu.explain import WHATIF_ERROR_BAND_PCT, project\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "from petastorm_tpu.resilience import FaultPlan, FaultSpec\n"
+        "url = 'file://' + os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'scalar_100k')\n"
+        "def epoch(explained):\n"
+        "    t0 = time.perf_counter()\n"
+        "    with make_batch_reader(url, num_epochs=3, shuffle_row_groups=False,\n"
+        "                           reader_pool_type='thread',\n"
+        "                           workers_count=3) as r:\n"
+        "        rows = n = 0\n"
+        "        for b in r:\n"
+        "            rows += len(b[0]); n += 1\n"
+        "            if explained and n % 10 == 0:\n"
+        "                r.explain(profiled=True)\n"
+        "        report = r.explain_report() if explained else None\n"
+        "    return rows / (time.perf_counter() - t0), report\n"
+        "epoch(False)  # warm-up pays import + fs metadata costs\n"
+        "off_a, off_b, on, report = [], [], [], None\n"
+        "for _ in range(7):\n"
+        "    off_a.append(epoch(False)[0])\n"
+        "    rate_on, report = epoch(True)\n"
+        "    on.append(rate_on)\n"
+        "    off_b.append(epoch(False)[0])\n"
+        "off = off_a + off_b\n"
+        "off_best, on_best = max(off), max(on)\n"
+        "overhead = 100.0 * (off_best - on_best) / max(off_best, 1e-9)\n"
+        "# off-vs-off noise floor: the two off halves straddle every on\n"
+        "# sample, so their best-vs-best gap is what this host's scheduler\n"
+        "# noise alone produces under this exact estimator.\n"
+        "noise_floor = (100.0 * abs(max(off_a) - max(off_b))\n"
+        "               / max(off_best, 1e-9))\n"
+        "# (b) what-if validation: injected-latency epochs (deterministic\n"
+        "# per-group service time -> a stable projection target).\n"
+        "def plan():\n"
+        "    return FaultPlan([FaultSpec(site='rowgroup.read',\n"
+        "                                kind='latency', rate=1.0,\n"
+        "                                latency_s=0.012)], seed=7)\n"
+        "def one_fault_epoch(workers, depth=None):\n"
+        "    t0 = time.perf_counter()\n"
+        "    with make_batch_reader(url, num_epochs=1, shuffle_row_groups=False,\n"
+        "                           reader_pool_type='thread',\n"
+        "                           workers_count=workers, fault_plan=plan(),\n"
+        "                           readahead_depth=depth) as r:\n"
+        "        rows = sum(len(b[0]) for b in r)\n"
+        "        rep = r.explain_report()\n"
+        "    return rows / (time.perf_counter() - t0), rep\n"
+        "def fault_epoch(workers, depth=None):\n"
+        "    # Best-of-3: the injected latency pins the service-time floor,\n"
+        "    # so the fastest epoch is the least noise-polluted sample (rate\n"
+        "    # and report stay a consistent pair).\n"
+        "    runs = [one_fault_epoch(workers, depth) for _ in range(3)]\n"
+        "    return max(runs, key=lambda rr: rr[0])\n"
+        "base_w1, spec_w1 = fault_epoch(1)\n"
+        "proj_w = project(spec_w1, observed_rows_per_s=base_w1,\n"
+        "                 decode_parallelism=3)\n"
+        "meas_w3, _ = fault_epoch(3)\n"
+        "err_workers = 100.0 * abs(proj_w['projected']['rows_per_s']\n"
+        "                          - meas_w3) / max(meas_w3, 1e-9)\n"
+        "base_d1, spec_d1 = fault_epoch(2, depth=1)\n"
+        "proj_r = project(spec_d1, observed_rows_per_s=base_d1,\n"
+        "                 readahead_depth=8)\n"
+        "meas_d8, _ = fault_epoch(2, depth=8)\n"
+        "err_ra = 100.0 * abs(proj_r['projected']['rows_per_s']\n"
+        "                     - meas_d8) / max(meas_d8, 1e-9)\n"
+        "# Per-phase explain artifact: operator-level provenance rides the\n"
+        "# perf trajectory next to the ops-plane gate snapshots.\n"
+        "os.makedirs(os.environ['PT_BENCH_SNAPSHOT_DIR'], exist_ok=True)\n"
+        "with open(os.path.join(os.environ['PT_BENCH_SNAPSHOT_DIR'],\n"
+        "                       'explain_epoch.json'), 'w') as f:\n"
+        "    json.dump({'explain': report,\n"
+        "               'whatif': {\n"
+        "                   'decode_parallelism': {\n"
+        "                       'projection': proj_w,\n"
+        "                       'observed_rows_per_s': round(base_w1, 1),\n"
+        "                       'measured_rows_per_s': round(meas_w3, 1)},\n"
+        "                   'readahead_depth': {\n"
+        "                       'projection': proj_r,\n"
+        "                       'observed_rows_per_s': round(base_d1, 1),\n"
+        "                       'measured_rows_per_s': round(meas_d8, 1)}}},\n"
+        "              f, indent=2, sort_keys=True)\n"
+        "band = WHATIF_ERROR_BAND_PCT\n"
+        "print('BENCHJSON:' + json.dumps({'explain_overhead_epoch': {\n"
+        "    'samples_per_sec_off': round(off_best, 1),\n"
+        "    'samples_per_sec_on': round(on_best, 1),\n"
+        "    'samples_per_sec_off_p50': round(statistics.median(off), 1),\n"
+        "    'samples_per_sec_on_p50': round(statistics.median(on), 1),\n"
+        "    'overhead_pct': round(overhead, 2),\n"
+        "    'noise_floor_pct': round(noise_floor, 2),\n"
+        "    'within_3pct': bool(overhead <= max(3.0, noise_floor)),\n"
+        "    'bottleneck': (report.get('profile', {}).get('bottleneck')\n"
+        "                   or {}).get('operator'),\n"
+        "    'whatif_workers_projected': round(\n"
+        "        proj_w['projected']['rows_per_s'], 1),\n"
+        "    'whatif_workers_measured': round(meas_w3, 1),\n"
+        "    'whatif_workers_error_pct': round(err_workers, 1),\n"
+        "    'whatif_workers_within_band': bool(err_workers <= band),\n"
+        "    'whatif_readahead_projected': round(\n"
+        "        proj_r['projected']['rows_per_s'], 1),\n"
+        "    'whatif_readahead_measured': round(meas_d8, 1),\n"
+        "    'whatif_readahead_error_pct': round(err_ra, 1),\n"
+        "    'whatif_readahead_within_band': bool(err_ra <= band),\n"
+        "    'error_band_pct': band}}))\n")
+    try:
+        out.update(_cpu_subprocess(explain_child, data_dir,
+                                   timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"explain phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4f4. multi-host mesh ingestion (docs/mesh.md): one logical
     # dataset -> one globally sharded jax.Array per step, on the 8-device
     # CPU simulation (XLA_FLAGS=--xla_force_host_platform_device_count=8,

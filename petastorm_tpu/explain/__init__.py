@@ -1,0 +1,25 @@
+"""Pipeline explain plane: operator-graph introspection, per-operator cost
+profiles, and what-if capacity modeling (docs/observability.md "Explain
+plane").
+
+Surfaces: ``Reader.explain()`` / ``Reader.explain_report()``,
+``LoaderBase.explain()`` (the full reader+loader graph),
+``MeshDataLoader.explain_report()`` (per-host graphs keyed ``h{idx}``),
+``python -m petastorm_tpu.telemetry explain SNAP [--diff A B]``, and the
+``explain`` payload embedded in every registry snapshot / black-box
+bundle. This is ROADMAP item 2's plan-introspection API, landed as pure
+observability with zero behavior change.
+"""
+from petastorm_tpu.explain.profile import profile_spec, stage_seconds_from_view
+from petastorm_tpu.explain.spec import (SPEC_SCHEMA_VERSION, OperatorNode,
+                                        PipelineSpec, build_reader_spec,
+                                        diff_spec_dicts, extend_with_loader,
+                                        render_spec_dict)
+from petastorm_tpu.explain.whatif import WHATIF_ERROR_BAND_PCT, project
+
+__all__ = [
+    "OperatorNode", "PipelineSpec", "SPEC_SCHEMA_VERSION",
+    "WHATIF_ERROR_BAND_PCT", "build_reader_spec", "diff_spec_dicts",
+    "extend_with_loader", "profile_spec", "project", "render_spec_dict",
+    "stage_seconds_from_view",
+]

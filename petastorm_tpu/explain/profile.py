@@ -1,0 +1,192 @@
+"""Operator cost profiles: bind a PipelineSpec to measured evidence.
+
+The PR 8 trace plane already measures per-*stage* self-time (the
+``trace.self.{stage}_s`` histograms the critical-path attributor fills)
+and the PR 12 timeline derives rates from the same registry — but neither
+ties those numbers to a concrete operator graph. :func:`profile_spec`
+does: it reads the pipeline registry once and attaches, per operator, its
+cumulative busy seconds, per-batch self-time quantiles, utilization
+(busy / (wall x parallelism)), mean service time per row, queue depth,
+and bytes moved — plus the measured **bottleneck operator**.
+
+Bottleneck arbitration reuses the PR 8 critical-path machinery, never a
+parallel reimplementation:
+
+* when per-batch winner counts exist (``trace.critical_path.{stage}`` —
+  a :class:`~petastorm_tpu.telemetry.trace.CriticalPathAttributor` ran,
+  e.g. under any JAX loader), the dominant winner names the bottleneck
+  stage, so ``explain(profiled=True)`` **agrees with the attributor by
+  construction** (asserted by test);
+* otherwise (a bare reader, no per-batch observer) the stage with the
+  largest cumulative self-time wins — the same per-stage sources the
+  attributor reads (:data:`petastorm_tpu.telemetry.trace._STAGE_COUNTERS`
+  incl. the worker.decode_s/trace.span.decode_s max rule), read through
+  targeted registry peeks.
+
+The mapped operator is the graph node whose ``stage`` field names the
+winning edge (docs/observability.md "Explain plane").
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from petastorm_tpu.telemetry.trace import CRITICAL_STAGES
+
+__all__ = ["profile_spec", "stage_seconds_from_view"]
+
+#: Queue-shape context per operator: the gauge that describes its inbound
+#: buffer (sampled, not derived — a point-in-time depth).
+_OP_QUEUE_GAUGES = {
+    "ventilate": "ventilator.backlog",
+    "decode": "pool.results_queue_depth",
+    "ordered_gate": "order.buffer_depth",
+    "shuffle": "shuffle_buffer.fill",
+    "stage": "loader.prefetch_queue_depth",
+}
+
+
+def stage_seconds_from_view(counters: dict, hists: dict) -> Dict[str, float]:
+    """Cumulative per-stage self-time seconds from a ``metrics_view()``
+    dict — the same sources (and the same decode max-not-sum rule) as
+    :meth:`CriticalPathAttributor._cumulative`, so a profile and the
+    attributor can never disagree about what a stage cost."""
+    def c(name):
+        return float(counters.get(name, 0.0))
+
+    def hsum(name):
+        return float(hists.get(name, {}).get("sum", 0.0))
+
+    return {
+        "fetch": c("io.readahead.fetch_s"),
+        # Two sources covering the SAME decode work: max, never sum
+        # (docs/observability.md "Critical-path attribution").
+        "decode": max(hsum("worker.decode_s"), c("trace.span.decode_s"))
+        + c("mesh.host_decode_s"),
+        "transport": c("transport.deserialize_s"),
+        "shuffle": c("loader.shuffle_s"),
+        "stage": c("loader.stage_s"),
+        "assemble": c("mesh.assemble_s"),
+    }
+
+
+def _stage_quantiles(hist, stage: str) -> Dict[str, float]:
+    """Per-delivered-batch self-time p50/p99 for ``stage`` — the PR 8
+    ``trace.self.{stage}_s`` histograms when an attributor ran, else the
+    stage's own latency histogram where one exists (decode). ``hist`` is
+    a name -> summary-dict-or-None lookup."""
+    h = hist(f"trace.self.{stage}_s")
+    if h is None and stage == "decode":
+        h = hist("worker.decode_s")
+    if h is None:
+        return {"self_p50_s": 0.0, "self_p99_s": 0.0}
+    return {"self_p50_s": float(h.get("p50", 0.0)),
+            "self_p99_s": float(h.get("p99", 0.0))}
+
+
+def profile_spec(spec, registry, wall_s: float,
+                 stage_offsets: Optional[Dict[str, float]] = None) -> dict:
+    """Measured cost profile for ``spec`` over ``registry``: targeted
+    registry reads (peeks of exactly the counters/histograms/gauges the
+    profile needs — NOT a full ``metrics_view()``, whose
+    every-histogram-quantile build under the registry lock is measurable
+    pipeline interference when explain is polled mid-epoch), per-operator
+    cost dicts, and the bottleneck verdict. Pure readout — creates no
+    metrics, actuates nothing. Numbers match
+    :func:`stage_seconds_from_view` over a snapshot of the same registry
+    (same sources, same decode max-not-sum rule).
+
+    ``stage_offsets`` subtracts a per-stage baseline from the cumulative
+    registry seconds — a caller whose operator started mid-pipeline (a
+    second loader over the same reader re-baselines ``loader.shuffle_s``
+    at its own ``_shuffle_base``) must not inherit its predecessor's
+    busy time in its cost or bottleneck verdict."""
+    c = registry.peek_counter
+    wall = max(float(wall_s), 1e-9)
+
+    stage_s = {
+        "fetch": c("io.readahead.fetch_s"),
+        # Two sources covering the SAME decode work: max, never sum
+        # (docs/observability.md "Critical-path attribution").
+        "decode": max(registry.peek_histogram_sum("worker.decode_s"),
+                      c("trace.span.decode_s")) + c("mesh.host_decode_s"),
+        "transport": c("transport.deserialize_s"),
+        "shuffle": c("loader.shuffle_s"),
+        "stage": c("loader.stage_s"),
+        "assemble": c("mesh.assemble_s"),
+    }
+    for stage, base in (stage_offsets or {}).items():
+        if stage in stage_s:
+            stage_s[stage] = max(0.0, stage_s[stage] - base)
+    rows = c("reader.rows")
+    winner_counts = {s: c(f"trace.critical_path.{s}")
+                     for s in CRITICAL_STAGES}
+
+    _hists: Dict[str, Optional[dict]] = {}
+
+    def hist(name):
+        if name not in _hists:
+            h = registry.find_histogram(name)
+            _hists[name] = None if h is None else h.as_dict()
+        return _hists[name]
+
+    op_costs: Dict[str, dict] = {}
+    for op in spec.operators.values():
+        depth_gauge = _OP_QUEUE_GAUGES.get(op.op_id)
+        depth = (registry.peek_gauge(depth_gauge)
+                 if depth_gauge is not None else None)
+        if op.stage is None:
+            if depth is not None:
+                op_costs[op.op_id] = {"queue_depth": depth}
+            continue
+        busy = stage_s.get(op.stage, 0.0)
+        cost = {
+            "stage": op.stage,
+            "busy_s": round(busy, 6),
+            "utilization": round(
+                min(1.0, busy / (wall * max(1, op.parallelism))), 4),
+            "service_per_row_s": (round(busy / rows, 9) if rows else None),
+            "throughput_rows_per_s": round(rows / wall, 3),
+        }
+        cost.update(_stage_quantiles(hist, op.stage))
+        if depth is not None:
+            cost["queue_depth"] = depth
+        if op.op_id in ("fetch", "decode"):
+            # The reading operator owns the IO byte flow: fetch when the
+            # readahead stage exists (it performs the reads), decode
+            # otherwise.
+            if op.op_id == "fetch" or "fetch" not in spec.operators:
+                cost["bytes_in"] = c("io.bytes_read")
+        if op.op_id == "transport":
+            cost["bytes_in"] = c("transport.bytes_read") or None
+        op_costs[op.op_id] = cost
+
+    bottleneck = _bottleneck(spec, stage_s, winner_counts)
+    profile = {
+        "wall_s": round(wall, 6),
+        "rows": int(rows),
+        "rows_per_s": round(rows / wall, 3),
+        "stages": {s: round(v, 6) for s, v in stage_s.items() if v},
+        "critical_path_counts": {s: int(v) for s, v in
+                                 winner_counts.items() if v},
+        "operators": op_costs,
+        "bottleneck": bottleneck,
+    }
+    return profile
+
+
+def _bottleneck(spec, stage_s: Dict[str, float],
+                winner_counts: Dict[str, float]) -> Optional[dict]:
+    """The measured bottleneck: dominant PR 8 per-batch winner when an
+    attributor ran, else the largest cumulative self-time edge."""
+    if sum(winner_counts.values()) > 0:
+        stage = max(winner_counts, key=lambda s: winner_counts[s])
+        source = "critical_path"
+    else:
+        positive = {s: v for s, v in stage_s.items() if v > 0}
+        if not positive:
+            return None
+        stage = max(positive, key=lambda s: positive[s])
+        source = "self_time"
+    op_id = next((op.op_id for op in spec.operators.values()
+                  if op.stage == stage), None)
+    return {"operator": op_id, "stage": stage, "source": source}

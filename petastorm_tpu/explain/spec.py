@@ -1,0 +1,467 @@
+"""PipelineSpec: the reader's operator graph as a first-class object.
+
+Every reader is an implicit fetch→decode→filter→transform→shuffle→collate→
+stage operator graph whose placement and capacities are scattered across
+~20 ``make_reader`` kwargs. The explain plane materializes that graph at
+plan time — operator name, layer, placement, configured capacity and
+parallelism, upstream/downstream edges, and the kwargs that induced each
+operator — as an inspectable, JSON-serializable :class:`PipelineSpec`
+returned by ``Reader.explain()`` (docs/observability.md "Explain plane").
+
+This is the plan-introspection API ROADMAP item 2 (the cedar-style
+operator-graph optimizer) names as its first deliverable: a dispatcher
+ships plans, not kwargs, and an optimizer needs declared per-operator
+cost/parallelism/placement before it can rewrite anything. Landed as pure
+observability — building a spec never changes pipeline behavior.
+
+Supersession contract
+---------------------
+A spec describes the pipeline *as configured right now*. Dynamic
+reconfiguration — a placement migration (docs/zero_copy.md), an autotune
+knob change (docs/autotune.md), a live-data growth extension
+(docs/live_data.md) — re-snapshots the spec at the reader's consumer-thread
+safe point (or at the next ``explain()`` call for background knob flips):
+the new spec's ``version`` increments and the previously returned object is
+flagged ``superseded=True``, so a holder of a stale spec can tell it no
+longer describes the live pipeline.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["OperatorNode", "PipelineSpec", "build_reader_spec",
+           "extend_with_loader", "render_spec_dict", "diff_spec_dicts",
+           "is_mesh_rollup", "render_mesh_rollup",
+           "REGISTERED_OPERATOR_CLASSES", "SPEC_SCHEMA_VERSION"]
+
+SPEC_SCHEMA_VERSION = 1
+
+#: Every operator-implementing class the reader planning path may
+#: construct, by name. ``tools/check_operators.py`` lints that any such
+#: construction in the planning files has a matching entry here — an
+#: operator the spec builder does not know about would silently vanish
+#: from ``explain()`` output (the ``operator-ok`` waiver opts a call site
+#: out, with a reason).
+REGISTERED_OPERATOR_CLASSES = {
+    # L3 ventilation / ordering
+    "ConcurrentVentilator", "OrderedDeliveryGate",
+    # L3 decode pools (the decode operator's placement flavors)
+    "ThreadPool", "ProcessPool", "DummyPool",
+    # L3/L5 fetch stage
+    "ReadaheadFetcher",
+    # L3 transport serialization (the transport operator's codecs)
+    "PickleSerializer", "ArrowTableSerializer",
+    # caches (sidecars of decode)
+    "InMemoryRowGroupCache", "LocalDiskCache", "NullCache",
+    # L5 live discovery (sidecar of ventilate)
+    "DatasetWatcher",
+    # L6 loader-side shuffle buffers
+    "RandomShufflingBuffer", "NoopShufflingBuffer",
+    "BatchShufflingBuffer", "BatchedRandomShufflingBuffer",
+    "BatchedNoopShufflingBuffer",
+}
+
+
+@dataclass
+class OperatorNode:
+    """One operator in the pipeline graph.
+
+    ``stage`` names the critical-path edge this operator's measured
+    self-time accrues under (one of
+    :data:`petastorm_tpu.telemetry.trace.CRITICAL_STAGES`), or ``None``
+    for coordination operators (ventilation, ordering, row
+    materialization) whose cost is deliberately near-zero and not
+    separately attributed. ``kind`` is ``"stage"`` for operators on the
+    data path and ``"sidecar"`` for operators that serve one (a cache
+    serving decode, a discovery watcher feeding ventilation).
+    """
+    op_id: str
+    name: str
+    layer: str
+    placement: str
+    parallelism: int = 1
+    stage: Optional[str] = None
+    kind: str = "stage"
+    capacity: dict = field(default_factory=dict)
+    induced_by: dict = field(default_factory=dict)
+    upstream: Tuple[str, ...] = ()
+    downstream: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "op_id": self.op_id, "name": self.name, "layer": self.layer,
+            "placement": self.placement, "parallelism": self.parallelism,
+            "stage": self.stage, "kind": self.kind,
+            "capacity": dict(self.capacity),
+            "induced_by": dict(self.induced_by),
+            "upstream": list(self.upstream),
+            "downstream": list(self.downstream),
+        }
+
+
+class PipelineSpec:
+    """An ordered operator graph plus the construction summary that induced
+    it. JSON-serializable via :meth:`to_dict`; ``profile`` (attached by
+    ``explain(profiled=True)``) binds each operator to its measured cost
+    evidence (docs/observability.md "Explain plane")."""
+
+    def __init__(self, operators: List[OperatorNode], *, pipeline_id: str,
+                 version: int = 1, source: str = "reader",
+                 config: Optional[dict] = None):
+        self.operators: Dict[str, OperatorNode] = {}
+        for op in operators:
+            if op.op_id in self.operators:
+                raise ValueError(f"duplicate operator id {op.op_id!r}")
+            self.operators[op.op_id] = op
+        self.pipeline_id = pipeline_id
+        self.version = int(version)
+        self.source = source
+        self.config = dict(config or {})
+        #: Flipped True by the owner when a dynamic reconfiguration
+        #: re-snapshots the spec: this object no longer describes the live
+        #: pipeline (see the module docstring's supersession contract).
+        self.superseded = False
+        #: Measured cost evidence, attached by ``explain(profiled=True)``
+        #: (:func:`petastorm_tpu.explain.profile.profile_spec`).
+        self.profile: Optional[dict] = None
+        #: Opaque live-knob signature the owner uses to detect staleness.
+        self.signature: Optional[tuple] = None
+
+    # ------------------------------------------------------------- access
+    def operator(self, op_id: str) -> OperatorNode:
+        return self.operators[op_id]
+
+    def chain(self) -> List[OperatorNode]:
+        """Data-path operators in upstream→downstream order (sidecars
+        excluded)."""
+        return [op for op in self.operators.values() if op.kind == "stage"]
+
+    def sidecars(self) -> List[OperatorNode]:
+        return [op for op in self.operators.values() if op.kind == "sidecar"]
+
+    # ------------------------------------------------------------ readout
+    def to_dict(self) -> dict:
+        out = {
+            "schema_version": SPEC_SCHEMA_VERSION,
+            "pipeline_id": self.pipeline_id,
+            "version": self.version,
+            "source": self.source,
+            "superseded": self.superseded,
+            "config": dict(self.config),
+            "operators": [op.to_dict() for op in self.operators.values()],
+        }
+        if self.profile is not None:
+            out["profile"] = self.profile
+        return out
+
+    def render(self) -> str:
+        return render_spec_dict(self.to_dict())
+
+    def whatif(self, **knobs) -> dict:
+        """Project pipeline throughput under a knob change from this spec's
+        measured profile (requires ``explain(profiled=True)`` first); see
+        :func:`petastorm_tpu.explain.whatif.project`."""
+        from petastorm_tpu.explain.whatif import project
+        return project(self.to_dict(), **knobs)
+
+
+def _link_chain(ops: List[OperatorNode]) -> None:
+    """Wire upstream/downstream edges along the data path, in list order."""
+    chain = [op for op in ops if op.kind == "stage"]
+    for prev, nxt in zip(chain, chain[1:]):
+        prev.downstream = prev.downstream + (nxt.op_id,)
+        nxt.upstream = nxt.upstream + (prev.op_id,)
+
+
+# ---------------------------------------------------------------- builders
+def build_reader_spec(reader, *, version: int = 1,
+                      pipeline_id: Optional[str] = None) -> PipelineSpec:
+    """Materialize ``reader``'s live operator graph. Reads configured (and
+    live-tuned) capacities only — never actuates anything."""
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.workers_pool.dummy_pool import DummyPool
+    from petastorm_tpu.workers_pool.process_pool import ProcessPool
+
+    ops: List[OperatorNode] = []
+    pool = reader._pool
+    ventilator = reader._ventilator
+
+    if reader._discovery is not None:
+        ops.append(OperatorNode(
+            op_id="discovery", name="dataset discovery watcher", layer="L5",
+            placement=("background" if (reader._refresh_interval_s or 0) > 0
+                       else "consumer"),
+            kind="sidecar",
+            capacity={"poll_interval_s": reader._refresh_interval_s,
+                      "growth_batches_applied": len(reader._growth_batches)},
+            induced_by={"refresh_interval_s": reader._refresh_interval_s},
+            downstream=("ventilate",)))
+
+    ops.append(OperatorNode(
+        op_id="ventilate", name="row-group ventilation", layer="L3",
+        placement="ventilator",
+        capacity={"max_inflight": ventilator.max_inflight,
+                  "plan_items": reader._num_items},
+        induced_by={"shuffle_row_groups": bool(
+            getattr(ventilator, "_randomize", False)),
+            "seed": reader._seed}))
+
+    if reader.readahead is not None:
+        stats = reader.readahead.stats()
+        ops.append(OperatorNode(
+            op_id="fetch", name="async readahead fetch", layer="L3",
+            placement="fetcher", parallelism=int(stats["fetchers"]),
+            stage="fetch",
+            capacity={"depth": int(stats["depth"]),
+                      "queued": int(stats["queued"])},
+            induced_by={"readahead_depth": int(stats["depth"])}))
+
+    if isinstance(pool, ProcessPool):
+        pool_flavor = "process"
+    elif isinstance(pool, DummyPool):
+        pool_flavor = "inline"
+    else:
+        pool_flavor = "thread"
+    gate = getattr(pool, "concurrency_gate", None)
+    workers = getattr(pool, "workers_count", 1)
+    ops.append(OperatorNode(
+        op_id="decode", name=f"row-group read+decode "
+                             f"({reader._worker_class.__name__})",
+        layer="L2", placement=pool_flavor,
+        parallelism=(int(gate.limit) if gate is not None else int(workers)),
+        stage="decode",
+        capacity={"workers_count": int(workers),
+                  "results_queue_capacity": pool.diagnostics.get(
+                      "results_queue_capacity", 0)},
+        induced_by={"reader_pool_type": pool_flavor,
+                    "workers_count": int(workers),
+                    "row_materialization": reader.row_materialization}))
+
+    cache = reader._cache
+    if not isinstance(cache, NullCache):
+        ops.append(OperatorNode(
+            op_id="cache", name=f"row-group cache "
+                                f"({type(cache).__name__})",
+            layer="L3", placement=pool_flavor, kind="sidecar",
+            capacity={"size_limit_bytes": getattr(cache, "_size_limit",
+                                                  None)},
+            induced_by={"cache": type(cache).__name__},
+            downstream=("decode",)))
+
+    if isinstance(pool, ProcessPool):
+        ops.append(OperatorNode(
+            op_id="transport", name="shm/zmq Arrow IPC transport",
+            layer="L3", placement="consumer", stage="transport",
+            capacity={"ring_capacity_bytes": getattr(pool, "_ring_capacity",
+                                                     None)},
+            induced_by={"reader_pool_type": "process"}))
+
+    if reader._gate is not None:
+        ops.append(OperatorNode(
+            op_id="ordered_gate", name="ordered delivery gate", layer="L3",
+            placement="consumer",
+            capacity={"buffer_bound": ventilator.max_inflight
+                      + max(1, reader._shuffle_window),
+                      "shuffle_window": reader._shuffle_window},
+            induced_by={"sample_order": "deterministic",
+                        "shuffle_window": reader._shuffle_window}))
+
+    ops.append(OperatorNode(
+        op_id="materialize",
+        name=("columnar batch view"
+              if reader.is_batched_reader
+              else f"{reader.row_materialization} row materialization"),
+        layer="L5", placement="consumer",
+        capacity={"mode": ("batched" if reader.is_batched_reader
+                           else reader.row_materialization)},
+        induced_by={"row_materialization": reader.row_materialization}))
+
+    _link_chain(ops)
+    pid = pipeline_id or getattr(reader.telemetry, "pipeline_id", "?")
+    return PipelineSpec(ops, pipeline_id=pid, version=version,
+                        source="reader", config=reader._config_summary())
+
+
+def extend_with_loader(reader_spec: PipelineSpec, loader) -> PipelineSpec:
+    """A NEW spec covering the whole pipeline: the reader's operators plus
+    the loader's shuffle/collate/stage operators appended to the data
+    path. The reader's cached spec is never mutated (repeated loader
+    ``explain()`` calls must not accumulate duplicate operators)."""
+    import copy
+    ops = [copy.deepcopy(op) for op in reader_spec.operators.values()]
+    extra: List[OperatorNode] = []
+    shuffling = int(getattr(loader, "_shuffling_capacity", 0) or 0)
+    if shuffling > 1:
+        extra.append(OperatorNode(
+            op_id="shuffle", name="host shuffling buffer", layer="L6",
+            placement="staging-thread", stage="shuffle",
+            capacity={"capacity_rows": shuffling,
+                      "min_after_retrieve": getattr(loader, "_min_after",
+                                                    None)},
+            induced_by={"shuffling_queue_capacity": shuffling}))
+    extra.append(OperatorNode(
+        op_id="collate", name="batch collate", layer="L6",
+        placement="staging-thread",
+        capacity={"batch_size": getattr(loader, "_batch_size", None)},
+        induced_by={"batch_size": getattr(loader, "_batch_size", None)}))
+    extra.append(OperatorNode(
+        op_id="stage", name="device staging (sanitize + device_put)",
+        layer="L6", placement="staging-thread", stage="stage",
+        capacity={"prefetch_depth": loader.prefetch_depth},
+        induced_by={"prefetch": loader.prefetch_depth}))
+    # Rebuild edges from scratch over the combined chain.
+    for op in ops + extra:
+        if op.kind == "stage":
+            op.upstream, op.downstream = (), ()
+    _link_chain(ops + extra)
+    spec = PipelineSpec(ops + extra, pipeline_id=reader_spec.pipeline_id,
+                        version=reader_spec.version, source="loader",
+                        config=dict(reader_spec.config,
+                                    loader=type(loader).__name__))
+    spec.signature = reader_spec.signature
+    return spec
+
+
+# ------------------------------------------------------------- rendering
+def _fmt_capacity(cap: dict) -> str:
+    parts = [f"{k}={v}" for k, v in cap.items() if v not in (None, {})]
+    return " ".join(parts)
+
+
+def render_spec_dict(spec: dict) -> str:
+    """Human tree rendering of a ``PipelineSpec.to_dict()`` payload (the
+    ``telemetry explain`` CLI's single-snapshot view). Profiled specs get
+    per-operator cost columns and the bottleneck verdict."""
+    profile = spec.get("profile") or {}
+    op_costs = profile.get("operators", {})
+    bottleneck = (profile.get("bottleneck") or {}).get("operator")
+    head = (f"pipeline {spec.get('pipeline_id', '?')} "
+            f"v{spec.get('version', '?')} ({spec.get('source', '?')})")
+    if spec.get("superseded"):
+        head += "  [SUPERSEDED]"
+    lines = [head]
+    if profile:
+        lines.append(
+            f"  profiled over {profile.get('wall_s', 0.0):.3g}s wall, "
+            f"{int(profile.get('rows', 0))} rows "
+            f"({profile.get('rows_per_s', 0.0):.6g} rows/s)")
+    for op in spec.get("operators", []):
+        marker = "*" if op["op_id"] == bottleneck else " "
+        side = " (sidecar)" if op.get("kind") == "sidecar" else ""
+        line = (f" {marker} {op['op_id']:<12} [{op['layer']} "
+                f"{op['placement']} x{op['parallelism']}]{side} "
+                f"{_fmt_capacity(op.get('capacity', {}))}")
+        cost = op_costs.get(op["op_id"])
+        if cost and "busy_s" in cost:
+            line += (f"  | busy={cost.get('busy_s', 0.0):.4g}s "
+                     f"util={cost.get('utilization', 0.0):.2f} "
+                     f"p99={cost.get('self_p99_s', 0.0):.4g}s")
+            if cost.get("queue_depth") is not None:
+                line += f" queue={cost['queue_depth']:g}"
+        elif cost and cost.get("queue_depth") is not None:
+            line += f"  | queue={cost['queue_depth']:g}"
+        lines.append(line)
+    if bottleneck:
+        b = profile["bottleneck"]
+        lines.append(f"  bottleneck: {b['operator']} "
+                     f"(stage={b.get('stage')}, via {b.get('source')})")
+    return "\n".join(lines)
+
+
+def diff_spec_dicts(a: dict, b: dict) -> dict:
+    """Structured diff of two spec dicts (plans AND profiles): operators
+    added/removed, per-operator field changes (placement, parallelism,
+    capacity), and profile deltas (throughput, bottleneck)."""
+    ops_a = {op["op_id"]: op for op in a.get("operators", [])}
+    ops_b = {op["op_id"]: op for op in b.get("operators", [])}
+    added = sorted(set(ops_b) - set(ops_a))
+    removed = sorted(set(ops_a) - set(ops_b))
+    changed = {}
+    for op_id in sorted(set(ops_a) & set(ops_b)):
+        fields = {}
+        for key in ("placement", "parallelism", "capacity"):
+            if ops_a[op_id].get(key) != ops_b[op_id].get(key):
+                fields[key] = {"a": ops_a[op_id].get(key),
+                               "b": ops_b[op_id].get(key)}
+        if fields:
+            changed[op_id] = fields
+    out = {
+        "pipeline_ids": [a.get("pipeline_id"), b.get("pipeline_id")],
+        "versions": [a.get("version"), b.get("version")],
+        "added": added, "removed": removed, "changed": changed,
+    }
+    pa, pb = a.get("profile") or {}, b.get("profile") or {}
+    if pa or pb:
+        prof = {
+            "rows_per_s": {"a": pa.get("rows_per_s"),
+                           "b": pb.get("rows_per_s")},
+            "bottleneck": {
+                "a": (pa.get("bottleneck") or {}).get("operator"),
+                "b": (pb.get("bottleneck") or {}).get("operator")},
+        }
+        busy = {}
+        for op_id in sorted(set(pa.get("operators", {}))
+                            | set(pb.get("operators", {}))):
+            ca = pa.get("operators", {}).get(op_id, {}).get("busy_s", 0.0)
+            cb = pb.get("operators", {}).get(op_id, {}).get("busy_s", 0.0)
+            if ca or cb:
+                busy[op_id] = {"a": round(ca, 6), "b": round(cb, 6)}
+        prof["busy_s"] = busy
+        out["profile"] = prof
+    return out
+
+
+def render_diff(diff: dict) -> str:
+    lines = [f"explain diff: {diff['pipeline_ids'][0]} v{diff['versions'][0]}"
+             f" -> {diff['pipeline_ids'][1]} v{diff['versions'][1]}"]
+    for op in diff.get("added", []):
+        lines.append(f"  + {op}")
+    for op in diff.get("removed", []):
+        lines.append(f"  - {op}")
+    for op, fields in diff.get("changed", {}).items():
+        for key, ab in fields.items():
+            lines.append(f"  ~ {op}.{key}: {ab['a']} -> {ab['b']}")
+    prof = diff.get("profile")
+    if prof:
+        rps = prof["rows_per_s"]
+        if rps["a"] is not None or rps["b"] is not None:
+            lines.append(f"  rows/s: {rps['a']} -> {rps['b']}")
+        bn = prof["bottleneck"]
+        if bn["a"] != bn["b"]:
+            lines.append(f"  bottleneck: {bn['a']} -> {bn['b']}")
+        for op, ab in prof.get("busy_s", {}).items():
+            lines.append(f"  busy {op}: {ab['a']}s -> {ab['b']}s")
+    if len(lines) == 1:
+        lines.append("  (no differences)")
+    return "\n".join(lines)
+
+
+def is_mesh_rollup(payload: dict) -> bool:
+    """True when an ``explain`` payload is a MeshDataLoader federation
+    rollup (``hosts``/``bottlenecks`` schema) rather than one pipeline's
+    ``PipelineSpec.to_dict()`` (``operators`` schema)."""
+    return isinstance(payload, dict) and "hosts" in payload \
+        and "operators" not in payload
+
+
+def render_mesh_rollup(payload: dict) -> str:
+    """Human rendering of a mesh explain rollup: the fleet bottleneck
+    census, the mesh assemble plane, then every host's graph (each a
+    full :func:`render_spec_dict` tree under its ``h{idx}`` key)."""
+    hosts = payload.get("hosts") or {}
+    asm = payload.get("assemble") or {}
+    lines = [f"mesh explain rollup: {len(hosts)} host graph(s) over "
+             f"{asm.get('hosts', '?')} host(s)"]
+    census = payload.get("bottlenecks") or {}
+    if census:
+        lines.append("  bottleneck census: " + ", ".join(
+            f"{op} x{n}" for op, n in
+            sorted(census.items(), key=lambda kv: -kv[1])))
+    if asm.get("critical_path_dominant"):
+        lines.append(f"  mesh critical path: {asm['critical_path_dominant']}")
+    for key in sorted(hosts):
+        lines.append(f"  {key}:")
+        for line in render_spec_dict(hosts[key]).splitlines():
+            lines.append("    " + line)
+    return "\n".join(lines)

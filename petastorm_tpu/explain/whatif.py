@@ -1,0 +1,180 @@
+"""What-if capacity modeling: project throughput under a knob change.
+
+A profiled :class:`~petastorm_tpu.explain.spec.PipelineSpec` carries, per
+data-path operator, a measured mean service time per row (``busy_s /
+rows``) and a parallelism. The model is a roofline over a bounded-queue
+pipeline: with every inter-operator queue bounded (the ventilator cap,
+results queues, the gate window, the prefetch queue), steady-state
+throughput is set by the slowest station —
+
+    X_model = min over operators of  parallelism_i / service_per_row_i
+
+(rows/s). A knob change rewrites one operator's parallelism (or removes
+an operator) and the projection is the **calibrated ratio**
+
+    X_projected = X_observed x X_model(after) / X_model(before)
+
+— calibrating on the observed throughput cancels unmodeled constant
+overheads (consumer think time, ventilation, GIL interleave) to first
+order, which is what makes single-knob projections usable.
+
+Model assumptions (documented error band: ±:data:`WHATIF_ERROR_BAND_PCT`
+%, validated against real knob flips by the bench ``explain_overhead``
+phase — docs/observability.md "Explain plane"):
+
+* operator service times are independent of the knob (no cache-warming or
+  contention shifts);
+* parallelism scales an operator's capacity linearly (true for
+  sleep/IO-bound work; optimistic for GIL-bound CPU decode on threads);
+* pipelining depth knobs (prefetch, readahead *depth* at fixed fetcher
+  count, ventilation inflight) change latency hiding, not steady-state
+  capacity — the model rejects them rather than guessing.
+
+Supported knobs:
+
+* ``decode_parallelism=N`` — worker count / live decode concurrency;
+* ``readahead_depth=N`` — rewrites the fetch operator's parallelism to
+  the fetcher count that depth implies (``min(2, N)``, mirroring
+  :class:`~petastorm_tpu.reader_impl.readahead.ReadaheadFetcher`);
+* ``placement='thread'`` — drops the transport operator (in-process
+  pools serialize nothing); ``placement='process'`` requires a measured
+  transport cost (from a profile that ran on a process pool) and
+  otherwise refuses honestly;
+* ``<op_id>_parallelism=N`` — generic form for any measured operator.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["project", "WHATIF_ERROR_BAND_PCT"]
+
+#: Documented error band for calibrated single-knob projections on
+#: measured operators (docs/observability.md "Explain plane"); the bench
+#: ``explain_overhead`` phase validates real knob flips against it.
+WHATIF_ERROR_BAND_PCT = 40.0
+
+
+def _measured_ops(spec: dict) -> Dict[str, dict]:
+    """``{op_id: {"parallelism", "service_per_row_s"}}`` for every
+    data-path operator with a measured positive service time."""
+    profile = spec.get("profile")
+    if not profile:
+        raise ValueError(
+            "whatif needs a profiled spec — call explain(profiled=True) "
+            "after the pipeline has delivered batches")
+    out = {}
+    for op in spec.get("operators", []):
+        if op.get("kind") != "stage" or not op.get("stage"):
+            continue
+        cost = profile.get("operators", {}).get(op["op_id"], {})
+        service = cost.get("service_per_row_s")
+        if service:
+            out[op["op_id"]] = {"parallelism": max(1, op["parallelism"]),
+                                "service_per_row_s": float(service)}
+    if not out:
+        raise ValueError(
+            "whatif needs at least one operator with measured service time "
+            "(profile saw zero rows or zero stage self-time)")
+    return out
+
+
+def _model_rate(ops: Dict[str, dict]) -> float:
+    """min_i parallelism_i / service_i, rows/s."""
+    return min(op["parallelism"] / op["service_per_row_s"]
+               for op in ops.values())
+
+
+def _model_bottleneck(ops: Dict[str, dict]) -> str:
+    return min(ops, key=lambda k: ops[k]["parallelism"]
+               / ops[k]["service_per_row_s"])
+
+
+def project(spec: dict, observed_rows_per_s: Optional[float] = None,
+            **knobs) -> dict:
+    """Throughput projection for ``knobs`` applied to a profiled spec
+    dict. Returns model and calibrated numbers plus the assumptions made;
+    raises ``ValueError`` for knobs the model cannot honestly project."""
+    if not knobs:
+        raise ValueError("whatif needs at least one knob, e.g. "
+                         "decode_parallelism=8 or placement='process'")
+    base = _measured_ops(spec)
+    after = {k: dict(v) for k, v in base.items()}
+    assumptions = ["operator service times independent of the knob",
+                   "parallelism scales capacity linearly"]
+
+    for knob, value in knobs.items():
+        if knob == "placement":
+            if value == "thread":
+                if after.pop("transport", None) is not None:
+                    assumptions.append(
+                        "thread placement removes the transport operator; "
+                        "decode service time assumed unchanged in-process")
+                else:
+                    assumptions.append(
+                        "already in-process: placement='thread' is a no-op")
+            elif value == "process":
+                if "transport" not in after:
+                    raise ValueError(
+                        "whatif(placement='process') needs a measured "
+                        "transport cost; profile a process-pool run first "
+                        "(this profile never serialized anything)")
+                assumptions.append(
+                    "process placement keeps the measured transport cost")
+            else:
+                raise ValueError(f"placement must be 'thread' or "
+                                 f"'process', got {value!r}")
+            continue
+        if knob == "readahead_depth":
+            if "fetch" not in after:
+                raise ValueError(
+                    "whatif(readahead_depth=...) needs a measured fetch "
+                    "operator; this profile ran without readahead (the "
+                    "model cannot invent an unmeasured stage's cost)")
+            fetchers = max(1, min(2, int(value)))
+            after["fetch"]["parallelism"] = fetchers
+            assumptions.append(
+                f"readahead_depth={value} implies {fetchers} fetcher "
+                f"thread(s) (ReadaheadFetcher default)")
+            continue
+        if knob == "decode_parallelism":
+            op_id = "decode"
+        elif knob.endswith("_parallelism"):
+            op_id = knob[:-len("_parallelism")]
+        else:
+            raise ValueError(
+                f"unknown whatif knob {knob!r} (supported: "
+                f"decode_parallelism, readahead_depth, placement, "
+                f"<op_id>_parallelism; pipelining-depth knobs change "
+                f"latency hiding, not capacity, and are rejected)")
+        if op_id not in after:
+            raise ValueError(
+                f"whatif knob {knob!r}: operator {op_id!r} has no measured "
+                f"service time in this profile")
+        if int(value) < 1:
+            raise ValueError(f"{knob}={value}: parallelism must be >= 1")
+        after[op_id]["parallelism"] = int(value)
+
+    model_before = _model_rate(base)
+    model_after = _model_rate(after)
+    observed = observed_rows_per_s
+    if observed is None:
+        observed = (spec.get("profile") or {}).get("rows_per_s")
+    projected = None
+    if observed:
+        projected = round(observed * model_after / model_before, 3)
+    return {
+        "knobs": dict(knobs),
+        "baseline": {
+            "model_rows_per_s": round(model_before, 3),
+            "observed_rows_per_s": observed,
+            "bottleneck": _model_bottleneck(base),
+        },
+        "projected": {
+            "model_rows_per_s": round(model_after, 3),
+            "rows_per_s": projected,
+            "bottleneck": _model_bottleneck(after),
+        },
+        "speedup": round(model_after / model_before, 4),
+        "error_band_pct": WHATIF_ERROR_BAND_PCT,
+        "assumptions": assumptions,
+    }

@@ -150,6 +150,12 @@ class LoaderBase:
         #: and docs/observability.md "Critical-path attribution".
         from petastorm_tpu.telemetry import CriticalPathAttributor
         self.critical_path = CriticalPathAttributor(self.telemetry)
+        # Explain plane (docs/observability.md "Explain plane"): a loader
+        # over a reader upgrades the shared registry's snapshot attachment
+        # from the reader-only operator graph to the full reader+loader
+        # one. Set before the subclass assigns self._reader — the provider
+        # resolves it lazily and returns None (omitted) until then.
+        self.telemetry.explain = self._explain_payload
         self._shuffle_time = self.telemetry.counter("loader.shuffle_s")
         # The registry is pipeline-cumulative; a second loader over the same
         # reader must not inherit the first one's shuffle seconds in ITS
@@ -779,6 +785,54 @@ class LoaderBase:
         through staging. Empty dict when the ops plane is off."""
         timeline = getattr(self.telemetry, "timeline", None)
         return {} if timeline is None else timeline.as_dict()
+
+    # ------------------------------------------------------ explain plane
+    def explain(self, profiled: bool = False):
+        """The FULL pipeline operator graph — the underlying reader's
+        operators plus this loader's shuffle/collate/stage operators
+        appended to the data path (docs/observability.md "Explain
+        plane"). A fresh :class:`~petastorm_tpu.explain.PipelineSpec` per
+        call (the reader's cached spec is never mutated);
+        ``profiled=True`` binds measured per-operator costs and the
+        bottleneck verdict — which, because this loader runs the PR 8
+        critical-path attributor per delivered batch, is the attributor's
+        dominant edge mapped onto the graph."""
+        reader = getattr(self, "_reader", None)
+        if reader is None:
+            raise TypeError(f"{type(self).__name__} has no underlying "
+                            f"reader to explain")
+        from petastorm_tpu.explain import extend_with_loader, profile_spec
+        spec = extend_with_loader(reader.explain(), self)
+        if profiled:
+            import time as _time
+            # Same re-baseline convention as stage_breakdown(): a second
+            # loader over the same reader must not inherit the first
+            # one's shuffle seconds in ITS cost profile (the registry is
+            # pipeline-cumulative); a registry-wide reset() underneath us
+            # means the base no longer applies.
+            shuffle_base = self._shuffle_base
+            if self._shuffle_time.value < shuffle_base:
+                shuffle_base = 0.0
+            spec.profile = profile_spec(
+                spec, self.telemetry,
+                wall_s=_time.perf_counter() - reader._explain_t0,
+                stage_offsets={"shuffle": shuffle_base})
+        return spec
+
+    def explain_report(self) -> dict:
+        """JSON-safe profiled :meth:`explain` payload (the form exported
+        snapshots embed under ``"explain"``)."""
+        return self.explain(profiled=True).to_dict()
+
+    def _explain_payload(self):
+        """Registry snapshot attachment: the loader upgrades the shared
+        registry's explain provider from the reader-only graph to the
+        full reader+loader graph. None (= omitted from snapshots) for
+        loaders without a reader."""
+        try:
+            return self.explain_report()
+        except TypeError:
+            return None
 
     def stage_breakdown(self) -> dict:
         """Cumulative seconds per pipeline stage (the ``stage_breakdown``

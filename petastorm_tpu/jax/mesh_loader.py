@@ -464,6 +464,9 @@ class MeshDataLoader(LoaderBase):
         from petastorm_tpu.telemetry.timeseries import (
             MetricsTimeline, TimelineSampler, timeline_interval_from_env)
         self._host_timelines: Dict[str, list] = {}
+        #: Per-host profiled operator graphs captured at source teardown
+        #: (explain-plane federation, keyed ``h{idx}``).
+        self._host_specs: Dict[str, dict] = {}
         self._timeline = None
         self._timeline_sampler = None
         self.anomaly_monitor = None
@@ -490,6 +493,7 @@ class MeshDataLoader(LoaderBase):
                         "multiprocess": self._multiprocess,
                         "strict": self._strict})
             self.blackbox.add_collector("mesh", self.mesh_report)
+            self.blackbox.add_collector("explain", self.explain_report)
             self.blackbox.add_collector(
                 "anomaly", lambda: (self.anomaly_monitor.report()
                                     if self.anomaly_monitor else {}))
@@ -831,6 +835,7 @@ class MeshDataLoader(LoaderBase):
         finally:
             self._rollup_host_trace(feed.idx, reader)
             self._rollup_host_timeline(feed.idx, reader)
+            self._rollup_host_spec(feed.idx, reader)
             try:
                 reader.stop()
                 reader.join()
@@ -905,6 +910,48 @@ class MeshDataLoader(LoaderBase):
         with self._cond:
             self._host_timelines.setdefault(f"h{host}", []).append(
                 timeline.as_dict())
+
+    def _rollup_host_spec(self, host: int, reader) -> None:
+        """Explain-plane rollup (docs/observability.md "Explain plane"):
+        capture the per-host reader's profiled operator graph at source
+        teardown under its ``h{idx}`` federation key — the same keying as
+        the PR 12 snapshot/timeline federation, so per-host graphs and
+        per-host rates line up. A host that ran several sources (recovery
+        after a reshard) keeps its NEWEST graph (the one describing the
+        plan it finished on)."""
+        try:
+            spec = reader.explain_report()
+        except Exception:  # noqa: BLE001 - rollup best-effort at teardown
+            return
+        with self._cond:
+            self._host_specs[f"h{host}"] = spec
+
+    def explain_report(self) -> dict:
+        """Mesh explain rollup: every host reader's operator graph keyed
+        ``h{idx}`` (captured at source teardown), a fleet bottleneck
+        census over the per-host profiled verdicts, and the mesh-level
+        assemble plane (hosts, the PR 8 critical-path dominant edge over
+        the whole mesh pipeline)."""
+        with self._cond:
+            hosts = dict(self._host_specs)
+        bottlenecks: Dict[str, int] = {}
+        for rep in hosts.values():
+            op = ((rep.get("profile") or {}).get("bottleneck")
+                  or {}).get("operator")
+            if op:
+                bottlenecks[op] = bottlenecks.get(op, 0) + 1
+        return {
+            "schema_version": 1,
+            "key_label": "host",
+            "hosts": hosts,
+            "bottlenecks": bottlenecks,
+            "assemble": {
+                "hosts": self._H,
+                "multiprocess": self._multiprocess,
+                "critical_path_dominant":
+                    self.critical_path.report()["dominant"],
+            },
+        }
 
     def _record_fatal(self, exc: BaseException) -> None:
         if self.blackbox is not None:

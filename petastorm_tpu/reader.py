@@ -1442,7 +1442,7 @@ class Reader:
         if sample_order == "deterministic":
             from petastorm_tpu.reader_impl.epoch_plan import (
                 EpochPlan, OrderedDeliveryGate)
-            self._epoch_plan = EpochPlan(seed=seed,
+            self._epoch_plan = EpochPlan(seed=seed,  # operator-ok: the canonical plan the ventilate/order operators execute, not an operator
                                          num_items=base_items_count,
                                          shuffled=shuffle_row_groups,
                                          window=shuffle_window,
@@ -1669,6 +1669,22 @@ class Reader:
                     self.anomaly_monitor.observe_window)
             self._timeline_sampler = TimelineSampler(
                 self.telemetry, self._timeline, interval).start()
+
+        # ---------------- explain plane (docs/observability.md "Explain
+        # plane"): the operator graph is materialized lazily on the first
+        # explain() call and re-snapshotted — previous spec flagged
+        # superseded — whenever a dynamic reconfiguration (placement
+        # migration, autotune knob change, live growth) changes the live
+        # knob signature. The registry attachment embeds the profiled
+        # graph in every exported snapshot and black-box bundle.
+        self._explain_lock = threading.Lock()
+        self._explain_spec = None
+        self._explain_version = 0
+        self._explain_dirty = False
+        self._explain_t0 = time.perf_counter()
+        self.telemetry.explain = self._explain_payload
+        if self.blackbox is not None:
+            self.blackbox.add_collector("explain", self.explain_report)
 
     # ------------------------------------------------------------- planning
     def _filter_row_groups(self, row_groups, predicate, rowgroup_selector,
@@ -1949,6 +1965,9 @@ class Reader:
                             a.num_row_groups] for a in staged],
                  "items": len(new_items), **info}
         self._growth_batches.append(batch)
+        # Explain-plane safe point: the plan just grew — re-snapshot the
+        # operator graph (plan_items / growth capacities changed).
+        self._explain_dirty = True
         self.telemetry.counter("discovery.items_extended").add(
             len(new_items))
         self.telemetry.record_event(
@@ -2219,6 +2238,11 @@ class Reader:
             self._results_reader.swap_pool(new_pool, buffered)
             buffered = []
             migrated = True
+            # Explain-plane safe point: the operator graph's decode
+            # placement (and possibly the transport operator) just
+            # changed; the next explain() re-snapshots and flags the
+            # previous spec superseded.
+            self._explain_dirty = True
         except BaseException as exc:
             # Hard failure mid-swap (pool start, spawn, ...): the old pool
             # may already be stopped, so the pipeline is broken — remember
@@ -2521,6 +2545,75 @@ class Reader:
         return ({} if self.anomaly_monitor is None
                 else self.anomaly_monitor.report())
 
+    # ------------------------------------------------------ explain plane
+    def _explain_signature(self) -> tuple:
+        """The live knob values the operator graph depends on: a change —
+        a placement migration, an autotune actuation, a growth extension —
+        means the cached spec no longer describes the pipeline and the
+        next :meth:`explain` re-snapshots it (flagging the old spec
+        ``superseded``). Cheap attribute reads only."""
+        pool = self._pool
+        gate = getattr(pool, "concurrency_gate", None)
+        return (type(pool).__name__,
+                getattr(pool, "workers_count", 1),
+                int(gate.limit) if gate is not None else None,
+                self._ventilator.max_inflight,
+                self.readahead.depth if self.readahead is not None else None,
+                self._num_items)
+
+    def explain(self, profiled: bool = False):
+        """This reader's operator graph as a
+        :class:`~petastorm_tpu.explain.PipelineSpec` — every pipeline
+        stage the configuration induced (ventilation, fetch, decode,
+        transport, ordering, materialization, caches, discovery) with its
+        layer, placement, parallelism, live capacity, and the kwargs that
+        induced it (docs/observability.md "Explain plane").
+
+        ``profiled=True`` additionally binds each operator to its measured
+        cost evidence from this pipeline's registry — per-stage self-time
+        p50/p99, busy seconds, utilization, queue depths, bytes — and
+        names the measured **bottleneck operator** (agreeing with the PR 8
+        critical-path attributor's winner whenever one ran).
+
+        The returned object is JSON-serializable (:meth:`~petastorm_tpu.
+        explain.PipelineSpec.to_dict`) and supports what-if capacity
+        projections (:meth:`~petastorm_tpu.explain.PipelineSpec.whatif`).
+        It describes the pipeline *as configured now*: a later dynamic
+        reconfiguration re-snapshots the spec and flags this one
+        ``superseded=True``."""
+        from petastorm_tpu.explain import build_reader_spec, profile_spec
+        with self._explain_lock:
+            sig = self._explain_signature()
+            if (self._explain_spec is None or self._explain_dirty
+                    or self._explain_spec.signature != sig):
+                old = self._explain_spec
+                if old is not None:
+                    old.superseded = True
+                self._explain_version += 1
+                spec = build_reader_spec(
+                    self, version=self._explain_version,
+                    pipeline_id=self.telemetry.pipeline_id)
+                spec.signature = sig
+                self._explain_spec = spec
+                self._explain_dirty = False
+            spec = self._explain_spec
+        if profiled:
+            spec.profile = profile_spec(
+                spec, self.telemetry,
+                wall_s=time.perf_counter() - self._explain_t0)
+        return spec
+
+    def explain_report(self) -> dict:
+        """JSON-safe profiled explain payload: :meth:`explain`
+        ``(profiled=True)`` as a plain dict — the form exported snapshots
+        embed under ``"explain"`` and black-box bundles record."""
+        return self.explain(profiled=True).to_dict()
+
+    def _explain_payload(self):
+        """Registry snapshot attachment (never raises; see
+        ``TelemetryRegistry.explain``)."""
+        return self.explain_report()
+
     # ------------------------------------------------ ops-plane internals
     def _config_summary(self) -> dict:
         """JSON-safe construction summary for the black box's
@@ -2719,7 +2812,8 @@ class _RowResultsReader(_PoolWaitTimer):
         self._batch_cols = None
         if pos == 0:
             return batch
-        return ColumnarBatch({name: col[pos:]
+        return ColumnarBatch({name: col[pos:]  # operator-ok: per-batch data container, not an operator
+
                               for name, col in batch.columns.items()},
                              batch.num_rows - pos)
 

@@ -10,6 +10,8 @@ fresh JSON snapshot on disk; this tool renders it:
     python -m petastorm_tpu.telemetry top /tmp/pt.json --interval 2
     python -m petastorm_tpu.telemetry timeline /tmp/pt.json --json series.json
     python -m petastorm_tpu.telemetry trace /tmp/pt.json --out trace.json
+    python -m petastorm_tpu.telemetry explain /tmp/pt.json
+    python -m petastorm_tpu.telemetry explain --diff runA.json runB.json
     python -m petastorm_tpu.telemetry check /tmp/pt.json --slo input_stall_pct<=1 --anomaly
     python -m petastorm_tpu.telemetry postmortem /tmp/blackbox/reader-123-01-pipelinehungerror
 
@@ -25,9 +27,12 @@ one or more snapshots (multiple files federate into a fleet view;
 converts one or more trace-mode snapshots (run the pipeline with
 ``PETASTORM_TPU_TELEMETRY_TRACE=1``) into Chrome-trace JSON for
 ``ui.perfetto.dev``, with a lineage + critical-path summary on stdout.
-``check`` evaluates SLO rules against a snapshot — plus the anomaly
-detectors over its timeline with ``--anomaly`` — and exits non-zero on
-any violation: the CI/bench gate. ``postmortem`` renders a black-box
+``explain`` renders the snapshot's embedded pipeline operator graph with
+per-operator cost columns and the measured bottleneck (two files with
+``--diff`` compare two runs' plans AND profiles) — docs/observability.md
+"Explain plane". ``check`` evaluates SLO rules against a snapshot — plus
+the anomaly detectors over its timeline with ``--anomaly`` — and exits
+non-zero on any violation: the CI/bench gate. ``postmortem`` renders a black-box
 bundle directory (docs/observability.md "Postmortem black box"). Exit
 codes: 1 when a snapshot file/bundle is missing/unreadable (every
 subcommand), 2 when ``check`` finds violations or anomalies, 1 when
@@ -55,6 +60,12 @@ def _load(path: str) -> dict:
 
 def _render_pretty(snap: dict) -> str:
     lines = [f"schema_version: {snap.get('schema_version', '?')}"]
+    if snap.get("pipeline_id"):
+        # Registry identity (PR 13): multi-reader processes / federated
+        # merges tell snapshots apart by pipeline, not file stem.
+        created = snap.get("created_at")
+        lines.append(f"pipeline: {snap['pipeline_id']}"
+                     + (f" (created_at {created})" if created else ""))
     gauges = snap.get("gauges", {})
     if gauges:
         lines.append("gauges:")
@@ -183,6 +194,14 @@ def _render_top(snap: dict, series_filter=None) -> str:
         value = gauges.get(name)
         if value is not None:
             head.append(f"{label}={value:.6g}")
+    # Fleet-level pool utilization (the newest timeline window's derived
+    # `pool.utilization` value — sum of per-worker busy fractions /
+    # worker count): the headline "are my decode workers busy" number.
+    for w in reversed((snap.get("timeline") or {}).get("windows", [])):
+        util = w.get("series", {}).get("pool.utilization")
+        if util is not None:
+            head.append(f"pool_util={util:.2f}")
+            break
     for name, label in (("reader.rows", "rows"),
                         ("anomaly.detections_total", "anomalies"),
                         ("slo.violations_total", "slo_violations")):
@@ -217,15 +236,31 @@ def _cmd_timeline(args) -> int:
     file's basename stem."""
     from petastorm_tpu.telemetry.federation import federate_timelines
     import os
-    members = {}
+    loaded = []
     for path in args.paths:
         try:
             snap = _load(path)
         except (OSError, ValueError) as e:
             print(f"cannot read snapshot {path}: {e}", file=sys.stderr)
             return 1
-        key = os.path.splitext(os.path.basename(path))[0]
-        members[key] = snap.get("timeline") or {}
+        loaded.append((os.path.splitext(os.path.basename(path))[0],
+                       snap.get("pipeline_id"),
+                       snap.get("timeline") or {}))
+    # Federation keys: file stems (human-meaningful), disambiguated by
+    # the snapshots' own stable pipeline_id when stems collide (PR 13 —
+    # two readers exporting the same filename into different directories
+    # no longer silently merge into one member).
+    stem_counts = {}
+    for stem, _pid, _tl in loaded:
+        stem_counts[stem] = stem_counts.get(stem, 0) + 1
+    members = {}
+    for i, (stem, pid, tl) in enumerate(loaded):
+        key = stem
+        if stem_counts[stem] > 1:
+            key = f"{stem}[{pid or i}]"
+        if key in members:  # same stem AND same registry exported twice
+            key = f"{stem}[{i}]"
+        members[key] = tl
     if len(members) == 1:
         tl = next(iter(members.values()))
         windows = tl.get("windows", [])
@@ -255,6 +290,65 @@ def _cmd_timeline(args) -> int:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2, sort_keys=True)
         print(f"wrote {args.json}")
+    return 0
+
+
+def _cmd_explain(args) -> int:
+    """Render a snapshot's embedded operator graph (+ cost profile), or
+    diff two snapshots' plans and profiles (docs/observability.md
+    "Explain plane")."""
+    from petastorm_tpu.explain.spec import (diff_spec_dicts, is_mesh_rollup,
+                                            render_diff, render_mesh_rollup,
+                                            render_spec_dict)
+    if args.diff and len(args.paths) != 2:
+        print("--diff needs exactly two snapshot files", file=sys.stderr)
+        return 1
+    if not args.diff and len(args.paths) != 1:
+        print("explain renders ONE snapshot; pass --diff to compare two",
+              file=sys.stderr)
+        return 1
+    specs = []
+    for path in args.paths:
+        try:
+            snap = _load(path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot {path}: {e}", file=sys.stderr)
+            return 1
+        spec = snap.get("explain")
+        if not spec:
+            print(f"no explain payload in {path}: the snapshot predates "
+                  f"the explain plane, or was written by a registry with "
+                  f"no Reader attached", file=sys.stderr)
+            return 1
+        specs.append(spec)
+    if args.diff:
+        if is_mesh_rollup(specs[0]) or is_mesh_rollup(specs[1]):
+            if not (is_mesh_rollup(specs[0]) and is_mesh_rollup(specs[1])):
+                print("cannot diff a mesh rollup against a single-pipeline "
+                      "spec", file=sys.stderr)
+                return 1
+            # Mesh rollups diff host-by-host on the shared h{idx} keys.
+            ha, hb = specs[0]["hosts"] or {}, specs[1]["hosts"] or {}
+            common = sorted(set(ha) & set(hb))
+            if not common:
+                print("the two mesh rollups share no host keys",
+                      file=sys.stderr)
+                return 1
+            for only, side in ((set(ha) - set(hb), "A"),
+                               (set(hb) - set(ha), "B")):
+                for key in sorted(only):
+                    print(f"{key}: only in {side}")
+            for key in common:
+                print(f"{key}:")
+                for line in render_diff(
+                        diff_spec_dicts(ha[key], hb[key])).splitlines():
+                    print("  " + line)
+        else:
+            print(render_diff(diff_spec_dicts(specs[0], specs[1])))
+    elif is_mesh_rollup(specs[0]):
+        print(render_mesh_rollup(specs[0]))
+    else:
+        print(render_spec_dict(specs[0]))
     return 0
 
 
@@ -476,6 +570,18 @@ def main(argv=None) -> int:
     tl_p.add_argument("--last", type=int, default=0,
                       help="keep only the newest N windows")
 
+    exp_p = sub.add_parser(
+        "explain", help="render a snapshot's pipeline operator graph + "
+                        "cost profile (two files with --diff compare "
+                        "plans and profiles)")
+    exp_p.add_argument("paths", nargs="+",
+                       help="snapshot file(s) with an embedded explain "
+                            "payload (any reader-owning pipeline writes "
+                            "one)")
+    exp_p.add_argument("--diff", action="store_true",
+                       help="diff two snapshots' operator graphs and "
+                            "profiles")
+
     pm_p = sub.add_parser(
         "postmortem", help="render a black-box bundle directory")
     pm_p.add_argument("bundle", help="bundle directory written by the "
@@ -514,6 +620,8 @@ def main(argv=None) -> int:
         return _cmd_check(args)
     if args.cmd == "timeline":
         return _cmd_timeline(args)
+    if args.cmd == "explain":
+        return _cmd_explain(args)
     if args.cmd == "postmortem":
         return _cmd_postmortem(args)
 

@@ -290,6 +290,30 @@ def render_report(bundle: dict) -> str:
                 lines.append(f"  {name} #{entry.get('seq', '?')}: {payload}")
         lines.append("")
     reports = bundle.get("reports") or {}
+    explain = reports.get("explain") or snapshot.get("explain")
+    if isinstance(explain, dict) and explain.get("operators"):
+        # One-line operator-graph provenance: which operator was the
+        # measured bottleneck when the pipeline died (full graph via
+        # `telemetry explain` over the bundle's snapshot.json).
+        bn = (explain.get("profile") or {}).get("bottleneck") or {}
+        ops = ">".join(op["op_id"] for op in explain["operators"]
+                       if op.get("kind") != "sidecar")
+        lines.append(
+            f"explain: v{explain.get('version', '?')} {ops}"
+            + (f"  bottleneck={bn.get('operator')} ({bn.get('source')})"
+               if bn.get("operator") else ""))
+        lines.append("")
+    elif isinstance(explain, dict) and "hosts" in explain:
+        # Mesh rollup flavor: per-host graphs live in the bundle; the
+        # report carries the fleet bottleneck census.
+        census = explain.get("bottlenecks") or {}
+        lines.append(
+            f"explain: mesh rollup, {len(explain['hosts'] or {})} host "
+            f"graph(s)" + ("  bottlenecks: " + ", ".join(
+                f"{op} x{n}" for op, n in
+                sorted(census.items(), key=lambda kv: -kv[1]))
+                if census else ""))
+        lines.append("")
     for name in ("watchdog", "slo", "anomaly", "quarantine", "growth",
                  "mesh"):
         rep = reports.get(name)

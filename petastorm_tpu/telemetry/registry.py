@@ -10,7 +10,10 @@ for their format (Prometheus rewrites ``.`` to ``_``).
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
+import uuid
 from collections import deque
 from typing import Callable, Dict, Optional, Sequence
 
@@ -123,6 +126,22 @@ class TelemetryRegistry:
         #: ``timeline`` and the anomaly CI gate. ``metrics_view()`` does
         #: NOT include it (the sampler itself reads that view).
         self.timeline = None
+        #: Optional explain-plane provider (docs/observability.md "Explain
+        #: plane"): a zero-arg callable returning the owning pipeline's
+        #: ``PipelineSpec.to_dict()`` payload (or None). When set — the
+        #: Reader attaches its own ``explain_report``; a loader over the
+        #: same registry upgrades it to the full reader+loader graph —
+        #: :meth:`snapshot` embeds it under ``"explain"`` so exported
+        #: files feed ``telemetry explain`` and black-box bundles carry
+        #: operator-level provenance.
+        self.explain = None
+        #: Stable identity for this registry's pipeline: multi-reader
+        #: processes and federated merges need more than file-path stems
+        #: to tell registries apart. Unique per construction (pid +
+        #: random), constant for the registry's lifetime, stamped into
+        #: every snapshot together with the wall-clock creation time.
+        self.pipeline_id = f"p{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.created_at = time.time()  # wall-clock-ok: one-shot provenance stamp at construction, not a hot-path read
 
     def _observe_stage(self, stage: str, duration_s: float) -> None:
         c = self._stage_counters.get(stage)
@@ -178,6 +197,15 @@ class TelemetryRegistry:
         with self._lock:
             h = self._histograms.get(name)
         return 0.0 if h is None else h.sum
+
+    def peek_gauge(self, name: str) -> Optional[float]:
+        """A gauge's current value without creating it (``None`` when
+        absent, and — like :attr:`Gauge.value` — ``None`` when a
+        callable-backed gauge's subject was torn down). The lazy callable
+        runs outside the registry lock."""
+        with self._lock:
+            g = self._gauges.get(name)
+        return None if g is None else g.value
 
     def find_counter(self, name: str):
         """The live :class:`Counter` object WITHOUT creating it (``None``
@@ -246,6 +274,8 @@ class TelemetryRegistry:
             histograms = dict(self._histograms)
         snap = {
             "schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "pipeline_id": self.pipeline_id,
+            "created_at": self.created_at,
             "counters": {k: round(c.value, 6)
                          for k, c in sorted(counters.items())},
             "gauges": {k: g.value for k, g in sorted(gauges.items())},
@@ -259,6 +289,16 @@ class TelemetryRegistry:
         timeline = self.timeline
         if timeline is not None:
             snap["timeline"] = timeline.as_dict()
+        explain_fn = self.explain
+        if explain_fn is not None:
+            # Outside the metric lock: the provider reads this registry
+            # back through metrics_view()/peeks.
+            try:
+                payload = explain_fn()
+            except Exception:  # noqa: BLE001 - a dead provider must not kill snapshots
+                payload = None
+            if payload is not None:
+                snap["explain"] = payload
         if include_trace and self.recorder.trace_enabled:
             # Trace mode: raw lineage spans ride the snapshot so exported
             # files feed `python -m petastorm_tpu.telemetry trace`.
@@ -284,6 +324,8 @@ class TelemetryRegistry:
         drained_spans = self.recorder.drain()
         out = {
             "schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "pipeline_id": self.pipeline_id,
+            "created_at": self.created_at,
             "counters": {k: round(c.reset(), 6)
                          for k, c in sorted(counters.items())},
             "gauges": {k: g.value for k, g in sorted(gauges.items())},

@@ -80,24 +80,37 @@ class SeriesSpec:
       seconds-type counters: the fraction of the window spent there);
     * ``gauge`` — the gauge's sampled value, passed through;
     * ``p50`` / ``p99`` — the quantile of the histogram's *windowed*
-      observations (bucket-count deltas, not the cumulative distribution).
+      observations (bucket-count deltas, not the cumulative distribution);
+    * ``util`` — ONE aggregate series over a seconds-counter *family*:
+      sum of member deltas / (window seconds x member count), clamped to
+      [0, 1] — the fleet-level utilization of a per-member busy family
+      (``pool.w*.busy_s`` -> ``pool.utilization``). Members are the
+      family counters present in the sample; a worker that never
+      processed an item has no counter yet and is not in the denominator
+      until it does.
 
     A single ``*`` in ``metric`` matches a metric family (``mesh.host*.
-    rows``); the matched wildcard text is substituted into ``name``'s
-    ``{}`` placeholder, yielding one series per family member.
+    rows``); for per-member kinds the matched wildcard text is
+    substituted into ``name``'s ``{}`` placeholder, yielding one series
+    per family member (``util`` aggregates instead — no placeholder).
     """
     name: str
     kind: str
     metric: str
 
     def __post_init__(self):
-        if self.kind not in ("rate", "frac", "gauge", "p50", "p99"):
+        if self.kind not in ("rate", "frac", "gauge", "p50", "p99", "util"):
             raise ValueError(f"series {self.name!r}: unknown kind "
                              f"{self.kind!r}")
         if self.metric.count("*") > 1:
             raise ValueError(f"series {self.name!r}: at most one '*' "
                              f"wildcard is supported")
-        if "*" in self.metric and "{}" not in self.name:
+        if self.kind == "util":
+            if "*" not in self.metric:
+                raise ValueError(f"series {self.name!r}: kind 'util' "
+                                 f"aggregates a metric FAMILY and needs a "
+                                 f"'*' wildcard in the metric")
+        elif "*" in self.metric and "{}" not in self.name:
             raise ValueError(f"series {self.name!r}: family metrics need a "
                              f"'{{}}' placeholder in the series name")
 
@@ -131,6 +144,10 @@ DEFAULT_SERIES: Sequence[SeriesSpec] = (
     SeriesSpec("mesh.host{}.rows_per_s", "rate", "mesh.host*.rows"),
     SeriesSpec("pool.w{}.items_per_s", "rate", "pool.w*.items"),
     SeriesSpec("pool.w{}.busy_frac", "frac", "pool.w*.busy_s"),
+    # Fleet-level pool utilization: sum of per-worker busy fractions /
+    # worker count, one number per window (both in-process and spawned
+    # pool backends publish the pool.w*.busy_s family).
+    SeriesSpec("pool.utilization", "util", "pool.w*.busy_s"),
     SeriesSpec("mixer.m{}.lag_s", "gauge", "mixer.m*.lag_s"),
     SeriesSpec("mixer.m{}.starved_per_s", "rate", "mixer.m*.starved_total"),
 )
@@ -285,6 +302,17 @@ class MetricsTimeline:
 
     def _derive(self, spec: SeriesSpec, counters, gauges, hists,
                 dt: float, out: Dict[str, Optional[float]]) -> None:
+        if spec.kind == "util":
+            members = _match_family(spec.metric, counters)
+            if not members:
+                return
+            total = sum(
+                self._counter_delta(float(counters[m]),
+                                    self._prev_counters.get(m))
+                for m, _wild in members)
+            out[spec.name] = round(
+                min(1.0, max(0.0, total / (dt * len(members)))), 6)
+            return
         if "*" in spec.metric:
             source = gauges if spec.kind == "gauge" else counters
             for metric, wild in _match_family(spec.metric, source):

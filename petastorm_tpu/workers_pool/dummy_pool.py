@@ -110,6 +110,14 @@ class DummyPool:
                     if self._decode_hist is None:
                         self._decode_hist = self.telemetry.histogram(
                             "worker.decode_s")
+                        # Per-worker identity family (the dummy pool's one
+                        # inline "worker"), so the timeline's
+                        # `pool.utilization` covers every backend.
+                        wid = 0
+                        self._c_w_items = self.telemetry.counter(
+                            f"pool.w{wid}.items")
+                        self._c_w_busy = self.telemetry.counter(
+                            f"pool.w{wid}.busy_s")
                     with self.telemetry.span("petastorm_tpu.worker_decode",
                                              trace=trace, stage="decode",
                                              track="worker:0"):
@@ -117,6 +125,8 @@ class DummyPool:
                     dt = time.perf_counter() - t0
                     self._decode_hist.observe(dt)
                     self.inline_decode_s += dt
+                    self._c_w_busy.add(dt)
+                    self._c_w_items.add(1)
                 else:
                     self._process_item(args, kwargs)
                 self._results.append(VentilatedItemProcessedMessage(

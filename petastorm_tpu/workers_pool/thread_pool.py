@@ -150,6 +150,15 @@ class _WorkerThread(threading.Thread):
         self._decode_hist = (telemetry.histogram("worker.decode_s")
                              if telemetry is not None else None)
         self._telemetry = telemetry
+        # Per-worker identity counters, same family the process pool's
+        # consumer-side marker accounting feeds — the timeline derives
+        # `pool.w{id}.busy_frac` per worker and the fleet-level
+        # `pool.utilization` series from them on BOTH pool backends.
+        wid = worker_impl.worker_id
+        self._c_items = (telemetry.counter(f"pool.w{wid}.items")
+                         if telemetry is not None else None)
+        self._c_busy = (telemetry.counter(f"pool.w{wid}.busy_s")
+                        if telemetry is not None else None)
 
     def _beat(self):
         if self._heartbeats is not None:
@@ -208,6 +217,9 @@ class _WorkerThread(threading.Thread):
             finally:
                 if self._gate is not None:
                     self._gate.release()
+            if self._c_busy is not None:
+                self._c_busy.add(time.perf_counter() - t0)
+                self._c_items.add(1)
             self._put(VentilatedItemProcessedMessage(
                 kwargs.get(ITEM_CONTEXT_KWARG)))
             self._beat()

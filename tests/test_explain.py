@@ -1,0 +1,669 @@
+"""Explain-plane tests (docs/observability.md "Explain plane"): pipeline
+operator-graph introspection (PipelineSpec), per-operator cost profiles,
+the what-if capacity model, spec supersession across dynamic
+reconfiguration, the pool.utilization timeline series, snapshot
+pipeline_id disambiguation, the `telemetry explain` CLI, and the
+check_operators lint."""
+import json
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.explain import (WHATIF_ERROR_BAND_PCT, OperatorNode,
+                                   PipelineSpec, diff_spec_dicts, project,
+                                   render_spec_dict)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.resilience import FaultPlan, FaultSpec
+from petastorm_tpu.telemetry import CriticalPathAttributor, make_registry
+from petastorm_tpu.telemetry.__main__ import main as telemetry_cli
+from petastorm_tpu.telemetry.timeseries import (DEFAULT_SERIES,
+                                                MetricsTimeline, SeriesSpec)
+
+pytestmark = pytest.mark.explain
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- helpers
+def write_scalar_store(root, rows=100, row_group_size=10):
+    os.makedirs(root, exist_ok=True)
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(rows)),
+                  "val": pa.array(np.arange(rows, dtype=np.float64))}),
+        os.path.join(root, "part0.parquet"), row_group_size=row_group_size)
+    return f"file://{root}"
+
+
+@pytest.fixture()
+def scalar_store(tmp_path):
+    return write_scalar_store(str(tmp_path / "scalar"))
+
+
+def _synthetic_profiled_spec():
+    """A hand-built two-operator profiled spec for model-math tests."""
+    ops = [
+        OperatorNode(op_id="fetch", name="fetch", layer="L3",
+                     placement="fetcher", parallelism=1, stage="fetch"),
+        OperatorNode(op_id="decode", name="decode", layer="L2",
+                     placement="thread", parallelism=2, stage="decode"),
+    ]
+    spec = PipelineSpec(ops, pipeline_id="p-test", version=1)
+    spec.profile = {
+        "wall_s": 10.0, "rows": 1000, "rows_per_s": 100.0,
+        "operators": {
+            # service: fetch 2 ms/row @ x1 -> bound 500/s;
+            #          decode 8 ms/row @ x2 -> bound 250/s (bottleneck)
+            "fetch": {"stage": "fetch", "busy_s": 2.0,
+                      "service_per_row_s": 0.002},
+            "decode": {"stage": "decode", "busy_s": 8.0,
+                       "service_per_row_s": 0.008},
+        },
+        "bottleneck": {"operator": "decode", "stage": "decode",
+                       "source": "self_time"},
+    }
+    return spec
+
+
+# ------------------------------------------------------------ spec shape
+def test_spec_materializes_operator_graph(scalar_store):
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread",
+                           workers_count=2) as r:
+        spec = r.explain()
+        ids = [op.op_id for op in spec.operators.values()]
+        assert ids == ["ventilate", "decode", "materialize"]
+        decode = spec.operator("decode")
+        assert decode.placement == "thread"
+        assert decode.parallelism == 2
+        assert decode.stage == "decode"
+        assert decode.induced_by["workers_count"] == 2
+        vent = spec.operator("ventilate")
+        assert vent.capacity["plan_items"] == 10
+        assert vent.downstream == ("decode",)
+        assert decode.upstream == ("ventilate",)
+        assert not spec.superseded and spec.version == 1
+        # JSON round-trip: the payload is a plain-JSON object.
+        payload = json.loads(json.dumps(spec.to_dict()))
+        assert payload["operators"][1]["op_id"] == "decode"
+        assert payload["pipeline_id"] == r.telemetry.pipeline_id
+
+
+def test_spec_reflects_knob_induced_operators(scalar_store):
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=2,
+                           readahead_depth=4,
+                           sample_order="deterministic",
+                           memory_cache_size_bytes=1 << 20) as r:
+        spec = r.explain()
+        ids = [op.op_id for op in spec.operators.values()]
+        assert "fetch" in ids and "ordered_gate" in ids and "cache" in ids
+        fetch = spec.operator("fetch")
+        assert fetch.capacity["depth"] == 4
+        assert fetch.parallelism == 2  # min(2, depth) fetchers
+        assert fetch.stage == "fetch"
+        cache = spec.operator("cache")
+        assert cache.kind == "sidecar"
+        assert cache.capacity["size_limit_bytes"] == 1 << 20
+        # Sidecars stay off the data path; the chain is fully linked.
+        chain = [op.op_id for op in spec.chain()]
+        assert "cache" not in chain
+        assert chain == ["ventilate", "fetch", "decode", "ordered_gate",
+                         "materialize"]
+
+
+def test_spec_stale_after_knob_change_returns_superseded(scalar_store):
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=2) as r:
+        spec1 = r.explain()
+        assert r.explain() is spec1  # unchanged knobs: cached object
+        before = r._ventilator.max_inflight
+        r._ventilator.set_max_inflight(before + 4)  # knob-ok: simulated autotune actuation
+        spec2 = r.explain()
+        assert spec2 is not spec1
+        assert spec1.superseded and not spec2.superseded
+        assert spec2.version == spec1.version + 1
+        assert spec2.operator("ventilate").capacity["max_inflight"] == \
+            before + 4
+        # The stale object still renders, flagged.
+        assert "SUPERSEDED" in render_spec_dict(spec1.to_dict())
+
+
+def test_spec_resnapshots_across_growth(tmp_path):
+    root = str(tmp_path / "live")
+    url = write_scalar_store(root, rows=40, row_group_size=10)
+    with make_batch_reader(url, num_epochs=None, shuffle_row_groups=False,
+                           reader_pool_type="dummy",
+                           refresh_interval_s=0) as r:
+        spec1 = r.explain()
+        items1 = spec1.operator("ventilate").capacity["plan_items"]
+        pq.write_table(
+            pa.table({"id": pa.array(np.arange(40, 60)),
+                      "val": pa.array(np.arange(40, 60,
+                                                dtype=np.float64))}),
+            os.path.join(root, "part1.parquet"), row_group_size=10)
+        r.refresh_dataset()
+        spec2 = r.explain()
+        assert spec1.superseded
+        assert spec2.version == spec1.version + 1
+        assert spec2.operator("ventilate").capacity["plan_items"] > items1
+        disc = spec2.operator("discovery")
+        assert disc.kind == "sidecar"
+        assert disc.capacity["growth_batches_applied"] == 1
+        r.stop()
+
+
+@pytest.mark.process_pool
+def test_spec_resnapshots_across_placement_migration(scalar_store):
+    """Satellite 3 keystone: a PR 6 thread→process migration re-snapshots
+    the spec at the safe point — new placement + transport operator, old
+    spec flagged superseded."""
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=2) as r:
+        spec1 = r.explain()
+        assert spec1.operator("decode").placement == "thread"
+        assert "transport" not in spec1.operators
+        r._request_pool_migration("process")
+        n = sum(1 for _ in r)  # migration happens at the __next__ safe point
+        assert n == 10
+        spec2 = r.explain()
+        assert spec1.superseded and not spec2.superseded
+        assert spec2.version > spec1.version
+        assert spec2.operator("decode").placement == "process"
+        assert "transport" in spec2.operators
+        assert spec2.operator("transport").stage == "transport"
+
+
+# ------------------------------------------------------------- profiling
+def test_profiled_explain_names_bottleneck_producer_bound(scalar_store):
+    """Producer-bound pinned workload (injected 10 ms read latency):
+    explain(profiled=True) and a PR 8 CriticalPathAttributor over the
+    same registry must name the same bottleneck operator/stage."""
+    plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="latency",
+                                rate=1.0, latency_s=0.01)], seed=3)
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=2,
+                           fault_plan=plan) as r:
+        attr = CriticalPathAttributor(r.telemetry)
+        for _ in r:
+            attr.observe_batch()
+        spec = r.explain(profiled=True)
+    bn = spec.profile["bottleneck"]
+    assert bn["operator"] == "decode"
+    assert bn["source"] == "critical_path"
+    assert attr.report()["dominant"] == bn["stage"]
+    cost = spec.profile["operators"]["decode"]
+    # 10 groups x 10 ms injected latency is the decode-busy floor.
+    assert cost["busy_s"] >= 0.1
+    assert 0.0 < cost["utilization"] <= 1.0
+    assert cost["service_per_row_s"] > 0
+    assert spec.profile["rows"] == 100
+
+
+def test_profiled_explain_agrees_with_attributor_consumer_bound(
+        synthetic_dataset):
+    """Consumer-bound pinned workload: a slow consumer over a DataLoader —
+    whatever edge the loader's always-on attributor names dominant, the
+    profiled explain maps to the same operator (the acceptance assertion
+    is AGREEMENT with PR 8, on this side of the producer/consumer divide
+    too)."""
+    from petastorm_tpu.jax.loader import DataLoader
+    with make_reader(synthetic_dataset.url, num_epochs=1,
+                     shuffle_row_groups=False, reader_pool_type="thread",
+                     workers_count=2, schema_fields=["^id$", "^id2$"]) as r:
+        loader = DataLoader(r, batch_size=5)
+        for _ in loader:
+            time.sleep(0.005)  # parked consumer: decode drains ahead
+        spec = loader.explain(profiled=True)
+        dominant = loader.critical_path_report()["dominant"]
+    bn = spec.profile["bottleneck"]
+    assert bn["source"] == "critical_path"
+    assert bn["stage"] == dominant
+    assert spec.operators[bn["operator"]].stage == dominant
+    # The loader graph covers reader + loader operators.
+    ids = [op.op_id for op in spec.operators.values()]
+    assert ids[:2] == ["ventilate", "decode"]
+    assert ids[-2:] == ["collate", "stage"]
+
+
+def test_loader_explain_does_not_mutate_reader_spec(synthetic_dataset):
+    from petastorm_tpu.jax.loader import DataLoader
+    with make_reader(synthetic_dataset.url, num_epochs=1,
+                     shuffle_row_groups=False, reader_pool_type="dummy",
+                     schema_fields=["^id$", "^id2$"]) as r:
+        loader = DataLoader(r, batch_size=5, shuffling_queue_capacity=20)
+        s1 = loader.explain()
+        s2 = loader.explain()
+        assert s1 is not s2
+        assert [op.op_id for op in s1.operators.values()] == \
+            [op.op_id for op in s2.operators.values()]
+        assert "shuffle" in s1.operators
+        # The reader's own cached spec never grew loader operators.
+        assert "shuffle" not in r.explain().operators
+        assert "stage" not in r.explain().operators
+        list(loader)
+
+
+# ---------------------------------------------------------------- whatif
+def test_whatif_model_math_bottleneck_shift():
+    spec = _synthetic_profiled_spec()
+    out = project(spec.to_dict(), decode_parallelism=8)
+    # decode bound 250/s -> 1000/s; fetch (500/s) becomes the bottleneck.
+    assert out["baseline"]["bottleneck"] == "decode"
+    assert out["baseline"]["model_rows_per_s"] == 250.0
+    assert out["projected"]["bottleneck"] == "fetch"
+    assert out["projected"]["model_rows_per_s"] == 500.0
+    assert out["speedup"] == 2.0
+    # Calibration: observed 100 rows/s scales by the model ratio.
+    assert out["projected"]["rows_per_s"] == pytest.approx(200.0)
+    assert out["error_band_pct"] == WHATIF_ERROR_BAND_PCT
+
+
+def test_whatif_rejects_unmodelable_knobs():
+    spec = _synthetic_profiled_spec()
+    with pytest.raises(ValueError, match="capacity"):
+        project(spec.to_dict(), prefetch_depth=8)
+    with pytest.raises(ValueError, match="transport"):
+        project(spec.to_dict(), placement="process")
+    with pytest.raises(ValueError, match="at least one knob"):
+        project(spec.to_dict())
+    with pytest.raises(ValueError, match=">= 1"):
+        project(spec.to_dict(), decode_parallelism=0)
+    unprofiled = PipelineSpec(
+        [OperatorNode(op_id="decode", name="d", layer="L2",
+                      placement="thread", stage="decode")],
+        pipeline_id="p", version=1)
+    with pytest.raises(ValueError, match="profiled"):
+        project(unprofiled.to_dict(), decode_parallelism=2)
+
+
+def test_whatif_placement_thread_drops_transport():
+    spec = _synthetic_profiled_spec()
+    spec.operators["transport"] = OperatorNode(
+        op_id="transport", name="t", layer="L3", placement="consumer",
+        stage="transport")
+    spec.profile["operators"]["transport"] = {
+        "stage": "transport", "busy_s": 20.0, "service_per_row_s": 0.02}
+    out = project(spec.to_dict(), placement="thread")
+    # transport bound 50/s was the bottleneck; dropping it exposes decode.
+    assert out["baseline"]["bottleneck"] == "transport"
+    assert out["projected"]["bottleneck"] == "decode"
+    assert out["speedup"] == pytest.approx(5.0)
+
+
+def test_whatif_projection_within_band_e2e(tmp_path):
+    """Acceptance: a real knob flip (decode workers 1→3) under a
+    deterministic injected latency lands within the documented error band
+    of the measured rate."""
+    url = write_scalar_store(str(tmp_path / "s"), rows=200,
+                             row_group_size=10)
+    plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="latency",
+                                rate=1.0, latency_s=0.015)], seed=3)
+
+    def one_epoch(workers):
+        t0 = time.perf_counter()
+        with make_batch_reader(url, num_epochs=1,
+                               shuffle_row_groups=False,
+                               reader_pool_type="thread",
+                               workers_count=workers,
+                               fault_plan=plan) as r:
+            rows = sum(len(b[0]) for b in r)
+            rep = r.explain_report()
+        return rows / (time.perf_counter() - t0), rep
+
+    def epoch(workers):
+        # Best-of-3: the injected latency pins the service-time floor, so
+        # the fastest epoch is the least scheduler-noise-polluted sample.
+        runs = [one_epoch(workers) for _ in range(3)]
+        return max(runs, key=lambda rr: rr[0])
+
+    def measure_once():
+        base_rate, rep = epoch(1)
+        out = project(rep, observed_rows_per_s=base_rate,
+                      decode_parallelism=3)
+        measured, _ = epoch(3)
+        err_pct = 100.0 * abs(out["projected"]["rows_per_s"] - measured) \
+            / measured
+        return err_pct, out, measured
+
+    # One full remeasure on a band miss: the projection is validated
+    # against real wall-clock throughput, and a loaded CI host can pollute
+    # a whole round; two independent rounds both missing the band is a
+    # model failure, not noise.
+    err_pct, out, measured = measure_once()
+    if err_pct > WHATIF_ERROR_BAND_PCT:
+        err_pct, out, measured = measure_once()
+    assert err_pct <= WHATIF_ERROR_BAND_PCT, (
+        f"projected {out['projected']['rows_per_s']:.0f} vs measured "
+        f"{measured:.0f} rows/s ({err_pct:.0f}% > band)")
+
+
+def test_profile_spec_stage_offsets_rebaseline():
+    """A caller whose operator started mid-pipeline (second loader over
+    the same reader) passes its stage baseline; profile_spec must not
+    attribute the predecessor's busy seconds to the new operator."""
+    from petastorm_tpu.explain import profile_spec
+    reg = make_registry()
+    reg.counter("loader.shuffle_s").add(10.0)
+    reg.counter("reader.rows").add(100)
+    ops = [OperatorNode(op_id="decode", name="d", layer="L2",
+                        placement="thread", parallelism=1, stage="decode"),
+           OperatorNode(op_id="shuffle", name="s", layer="L6",
+                        placement="consumer", parallelism=1,
+                        stage="shuffle")]
+    spec = PipelineSpec(ops, pipeline_id="p", version=1)
+    inherited = profile_spec(spec, reg, wall_s=5.0)
+    assert inherited["operators"]["shuffle"]["busy_s"] == 10.0
+    rebased = profile_spec(spec, reg, wall_s=5.0,
+                           stage_offsets={"shuffle": 10.0})
+    assert rebased["operators"]["shuffle"]["busy_s"] == 0.0
+    assert "shuffle" not in rebased["stages"]
+
+
+# ------------------------------------------------------- render and diff
+def test_render_and_diff():
+    spec = _synthetic_profiled_spec()
+    text = render_spec_dict(spec.to_dict())
+    assert "fetch" in text and "decode" in text
+    assert "bottleneck: decode" in text
+    b = _synthetic_profiled_spec()
+    b.version = 2
+    b.operators["decode"].parallelism = 6
+    b.profile["bottleneck"] = {"operator": "fetch", "stage": "fetch",
+                               "source": "self_time"}
+    d = diff_spec_dicts(spec.to_dict(), b.to_dict())
+    assert d["changed"]["decode"]["parallelism"] == {"a": 2, "b": 6}
+    assert d["profile"]["bottleneck"] == {"a": "decode", "b": "fetch"}
+    assert not d["added"] and not d["removed"]
+    from petastorm_tpu.explain.spec import render_diff
+    out = render_diff(d)
+    assert "decode.parallelism: 2 -> 6" in out
+
+
+# ----------------------------------------------- snapshot + CLI surfaces
+def test_snapshot_embeds_explain_and_pipeline_id(scalar_store):
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        for _ in r:
+            pass
+        snap = r.telemetry.snapshot()
+    assert snap["pipeline_id"] == r.telemetry.pipeline_id
+    assert snap["created_at"] > 0
+    ops = [op["op_id"] for op in snap["explain"]["operators"]]
+    assert "decode" in ops
+    assert snap["explain"]["profile"]["rows"] == 100
+    # Two registries never collide on identity.
+    assert make_registry().pipeline_id != make_registry().pipeline_id
+
+
+def test_registry_reset_keeps_identity():
+    reg = make_registry()
+    reg.counter("reader.rows").add(5)
+    out = reg.reset()
+    assert out["pipeline_id"] == reg.pipeline_id
+    assert out["created_at"] == reg.created_at
+
+
+def test_cli_explain_render_and_diff(tmp_path, capsys, scalar_store):
+    def write_snap(workers, path):
+        with make_batch_reader(scalar_store, num_epochs=1,
+                               shuffle_row_groups=False,
+                               reader_pool_type="thread",
+                               workers_count=workers) as r:
+            for _ in r:
+                pass
+            snap = r.telemetry.snapshot()
+        with open(path, "w") as f:
+            json.dump(snap, f)
+
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    write_snap(1, a)
+    write_snap(3, b)
+    assert telemetry_cli(["explain", a]) == 0
+    out = capsys.readouterr().out
+    assert "decode" in out and "[L2 thread x1]" in out
+    assert telemetry_cli(["explain", "--diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "decode.parallelism: 1 -> 3" in out
+    # Error paths: wrong arity, missing payload.
+    assert telemetry_cli(["explain", a, b]) == 1
+    capsys.readouterr()
+    empty = str(tmp_path / "empty.json")
+    with open(empty, "w") as f:
+        json.dump({"counters": {}}, f)
+    assert telemetry_cli(["explain", empty]) == 1
+    assert "no explain payload" in capsys.readouterr().err
+
+
+def test_cli_dump_shows_pipeline_id(tmp_path, capsys):
+    reg = make_registry()
+    reg.counter("reader.rows").add(1)
+    path = str(tmp_path / "s.json")
+    with open(path, "w") as f:
+        json.dump(reg.snapshot(), f)
+    assert telemetry_cli(["dump", path]) == 0
+    assert f"pipeline: {reg.pipeline_id}" in capsys.readouterr().out
+
+
+def test_cli_timeline_disambiguates_colliding_stems(tmp_path, capsys):
+    """Two readers exporting the SAME filename into different directories
+    must federate as two members (keyed stem[pipeline_id]), not silently
+    merge — the failure mode pipeline_id exists to prevent. Distinct
+    stems keep their plain human-meaningful keys."""
+    def snap_file(directory, rate):
+        reg = make_registry()
+        snap = reg.snapshot()
+        snap["timeline"] = {
+            "interval_s": 1.0, "window_count": 120, "windows_total": 2,
+            "windows": [{"index": i, "t_s": float(i + 1), "dt_s": 1.0,
+                         "series": {"rows_per_s": rate}}
+                        for i in range(2)]}
+        directory.mkdir(exist_ok=True)
+        path = directory / "pipeline.json"
+        with open(path, "w") as f:
+            json.dump(snap, f)
+        return str(path), reg.pipeline_id
+
+    a, pid_a = snap_file(tmp_path / "hostA", 10.0)
+    b, pid_b = snap_file(tmp_path / "hostB", 30.0)
+    assert telemetry_cli(["timeline", a, b]) == 0
+    out = capsys.readouterr().out
+    assert f"pipeline[{pid_a}]:rows_per_s" in out
+    assert f"pipeline[{pid_b}]:rows_per_s" in out
+
+
+# -------------------------------------------- pool.utilization satellite
+def test_pool_utilization_series_derivation():
+    tl = MetricsTimeline(interval_s=1.0, series=DEFAULT_SERIES)
+    # Two workers, one second: w0 busy 0.8 s, w1 busy 0.4 s -> 0.6.
+    tl.sample({"counters": {"pool.w0.busy_s": 0.0, "pool.w1.busy_s": 0.0},
+               "gauges": {}, "histograms": {}}, now_s=100.0)
+    w = tl.sample({"counters": {"pool.w0.busy_s": 0.8,
+                                "pool.w1.busy_s": 0.4},
+                   "gauges": {}, "histograms": {}}, now_s=101.0)
+    assert w["series"]["pool.utilization"] == pytest.approx(0.6)
+    # Per-worker family series still derive alongside the aggregate.
+    assert w["series"]["pool.w0.busy_frac"] == pytest.approx(0.8)
+    # Restart-safe: a counter reset cannot push utilization negative.
+    w2 = tl.sample({"counters": {"pool.w0.busy_s": 0.1,
+                                 "pool.w1.busy_s": 0.05},
+                    "gauges": {}, "histograms": {}}, now_s=102.0)
+    assert 0.0 <= w2["series"]["pool.utilization"] <= 1.0
+
+
+def test_util_seriesspec_validation():
+    with pytest.raises(ValueError, match="util"):
+        SeriesSpec("u", "util", "pool.w0.busy_s")  # no family wildcard
+    with pytest.raises(ValueError, match="placeholder"):
+        SeriesSpec("u", "rate", "pool.w*.busy_s")  # per-member needs {}
+    SeriesSpec("u", "util", "pool.w*.busy_s")  # aggregate: no placeholder
+
+
+def test_thread_and_dummy_pools_publish_worker_busy_family(scalar_store):
+    for pool in ("thread", "dummy"):
+        with make_batch_reader(scalar_store, num_epochs=1,
+                               shuffle_row_groups=False,
+                               reader_pool_type=pool,
+                               workers_count=2) as r:
+            for _ in r:
+                pass
+            counters = r.telemetry.snapshot()["counters"]
+        busy = {k: v for k, v in counters.items()
+                if k.startswith("pool.w") and k.endswith(".busy_s")}
+        items = {k: v for k, v in counters.items()
+                 if k.startswith("pool.w") and k.endswith(".items")}
+        assert busy and all(v > 0 for v in busy.values()), (pool, counters)
+        assert sum(items.values()) == 10, (pool, items)
+
+
+def test_pool_utilization_rides_reader_timeline(scalar_store):
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=2,
+                           timeline_interval_s=0.05) as r:
+        for _ in r:
+            pass
+    # close() took the terminal window; the series exists with a value.
+    series = set()
+    values = []
+    for w in r.timeline_report().get("windows", []):
+        series.update(w["series"])
+        v = w["series"].get("pool.utilization")
+        if v is not None:
+            values.append(v)
+    assert "pool.utilization" in series
+    assert values and all(0.0 <= v <= 1.0 for v in values)
+
+
+def test_top_headline_includes_pool_util(tmp_path, capsys, scalar_store):
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=2,
+                           timeline_interval_s=0.05) as r:
+        for _ in r:
+            pass
+    # After close: the sampler's stop took the terminal window.
+    snap = r.telemetry.snapshot()
+    path = str(tmp_path / "s.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    assert telemetry_cli(["top", path, "--count", "1"]) == 0
+    assert "pool_util=" in capsys.readouterr().out
+
+
+# -------------------------------------------------------- lint / bundles
+def test_check_operators_lint_clean():
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "check_operators.py")],
+        capture_output=True, text=True)
+    assert result.returncode == 0, result.stderr
+    assert "clean" in result.stdout
+
+
+def test_check_operators_catches_unregistered(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_operators_tool",
+        os.path.join(REPO_ROOT, "tools", "check_operators.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = tmp_path / "petastorm_tpu"
+    bad.mkdir()
+    (bad / "reader.py").write_text(
+        "from petastorm_tpu.reader_impl.readahead import ReadaheadFetcher\n"
+        "def plan():\n"
+        "    a = ReadaheadFetcher(None, [])\n"
+        "    b = ReadaheadFetcher(None, [])  # operator-ok: waived\n")
+    violations = mod.check_file("petastorm_tpu/reader.py",
+                                registered=set(),
+                                repo_root=str(tmp_path))
+    assert len(violations) == 1
+    assert "ReadaheadFetcher" in violations[0]
+    # Registered or waived: clean.
+    assert mod.check_file("petastorm_tpu/reader.py",
+                          registered={"ReadaheadFetcher"},
+                          repo_root=str(tmp_path)) == []
+    # The drift case the lint exists for: a BRAND-NEW operator class
+    # nobody registered anywhere is still detected, because the
+    # candidate set derives from the planning file's own imports of the
+    # operator-implementing modules.
+    (bad / "reader.py").write_text(
+        "from petastorm_tpu.workers_pool.hedged import HedgedFetchPool\n"
+        "def plan():\n"
+        "    return HedgedFetchPool(8)\n")
+    violations = mod.check_file("petastorm_tpu/reader.py",
+                                registered={"ReadaheadFetcher"},
+                                repo_root=str(tmp_path))
+    assert len(violations) == 1 and "HedgedFetchPool" in violations[0]
+
+
+def test_blackbox_bundle_records_explain(tmp_path, monkeypatch,
+                                         scalar_store):
+    monkeypatch.setenv("PETASTORM_TPU_BLACKBOX", str(tmp_path / "bb"))
+    with make_batch_reader(scalar_store, num_epochs=1,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as r:
+        for _ in r:
+            pass
+        r.blackbox.write_bundle("test_trigger")
+    bundles = os.listdir(str(tmp_path / "bb"))
+    assert len(bundles) == 1
+    reports = json.load(open(os.path.join(str(tmp_path / "bb"), bundles[0],
+                                          "reports.json")))
+    assert "explain" in reports
+    assert any(op["op_id"] == "decode"
+               for op in reports["explain"]["operators"])
+    from petastorm_tpu.telemetry.postmortem import load_bundle, render_report
+    text = render_report(load_bundle(os.path.join(str(tmp_path / "bb"),
+                                                  bundles[0])))
+    assert "explain:" in text
+
+
+def test_mesh_explain_rollup_federates_per_host(tmp_path):
+    """Per-host graphs captured at source teardown under h{idx} keys +
+    fleet bottleneck census (docs/observability.md "Explain plane")."""
+    from petastorm_tpu.jax.mesh_loader import (MeshDataLoader,
+                                               MeshReaderFactory)
+    url = write_scalar_store(str(tmp_path / "mesh"), rows=80,
+                             row_group_size=10)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        factory = MeshReaderFactory(url, batched=True)
+        with MeshDataLoader(factory, batch_size=8, num_hosts=2,
+                            num_epochs=1) as loader:
+            for _ in loader:
+                pass
+            rep = loader.explain_report()
+    assert rep["key_label"] == "host"
+    assert set(rep["hosts"]) == {"h0", "h1"}
+    for host_rep in rep["hosts"].values():
+        assert any(op["op_id"] == "decode"
+                   for op in host_rep["operators"])
+        assert host_rep["profile"]["rows"] > 0
+    assert rep["assemble"]["hosts"] == 2
+    assert sum(rep["bottlenecks"].values()) <= 2
+    # The rollup is its own payload flavor — every consumer of embedded
+    # explain payloads must render it, not silently show an empty graph.
+    from petastorm_tpu.explain.spec import is_mesh_rollup, render_mesh_rollup
+    assert is_mesh_rollup(rep)
+    text = render_mesh_rollup(rep)
+    assert "2 host graph(s)" in text
+    assert "h0:" in text and "h1:" in text and "decode" in text
+    snap = {"schema_version": 1, "explain": rep}
+    path = str(tmp_path / "mesh_snap.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    assert telemetry_cli(["explain", path]) == 0

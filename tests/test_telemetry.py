@@ -352,8 +352,10 @@ def test_json_snapshot_round_trips_with_documented_keys():
     restored = from_json(to_json(snap))
     assert restored == json.loads(json.dumps(snap))  # JSON-safe throughout
     assert restored["schema_version"] == SNAPSHOT_SCHEMA_VERSION
-    assert set(restored) == {"schema_version", "counters", "gauges",
-                             "histograms", "spans"}
+    assert set(restored) == {"schema_version", "pipeline_id", "created_at",
+                             "counters", "gauges", "histograms", "spans"}
+    assert restored["pipeline_id"].startswith("p")
+    assert restored["created_at"] > 0
     h = restored["histograms"]["reader.pool_wait_s"]
     assert set(h) == {"count", "sum", "min", "max", "p50", "p95", "p99",
                       "buckets"}

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Lint guard: every operator constructed on the reader planning path must
+register a PipelineSpec node.
+
+The explain plane (docs/observability.md "Explain plane") is only truthful
+if the operator graph ``Reader.explain()`` materializes covers every
+operator the planning path actually stands up — a new pool flavor, buffer,
+fetch stage, or cache added without a spec node silently vanishes from
+``explain()`` output, black-box bundles, and the what-if model, and
+nothing else fails. This AST check pins the contract: any construction,
+in the planning files, of a class imported from the operator-implementing
+modules (detected from the file's own imports — not a hand-maintained
+list that would drift exactly when a new class appears) must have its
+class name in ``petastorm_tpu/explain/spec.py``'s
+``REGISTERED_OPERATOR_CLASSES`` set (parsed from source — no imports), or
+carry an ``operator-ok`` waiver comment on the call line saying why it is
+not a data-path operator.
+
+Usage::
+
+    python tools/check_operators.py          # check the planning files
+    python tools/check_operators.py --list   # print the operator classes
+
+Exit code 1 on any violation (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: Modules that implement pipeline operators. The detection set is NOT a
+#: hand-maintained copy of the spec registry — it is DERIVED per planning
+#: file as every class imported from these module prefixes (so a brand-new
+#: operator class nobody registered is still detected the moment the
+#: planning path imports it), unioned with the registry itself (covers
+#: operator classes that later move modules).
+OPERATOR_MODULE_PREFIXES = (
+    "petastorm_tpu.workers_pool",
+    "petastorm_tpu.reader_impl",
+    "petastorm_tpu.discovery",
+    "petastorm_tpu.cache",
+    "petastorm_tpu.local_disk_cache",
+    "petastorm_tpu.autotune.mem_cache",
+    "petastorm_tpu.jax.batched_buffer",
+)
+
+#: The reader planning path: everywhere operators are stood up.
+PLANNING_FILES = (
+    "petastorm_tpu/reader.py",
+    "petastorm_tpu/jax/loader.py",
+    "petastorm_tpu/jax/mesh_loader.py",
+)
+
+SPEC_FILE = os.path.join("petastorm_tpu", "explain", "spec.py")
+REGISTRY_NAME = "REGISTERED_OPERATOR_CLASSES"
+WAIVER = "operator-ok"
+
+
+def load_registered_classes(repo_root: str) -> set:
+    """Parse ``REGISTERED_OPERATOR_CLASSES`` out of the spec module's
+    source (a set literal of string constants) without importing it."""
+    path = os.path.join(repo_root, SPEC_FILE)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if REGISTRY_NAME in targets and isinstance(node.value, ast.Set):
+                out = set()
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        out.add(elt.value)
+                return out
+    raise ValueError(f"{SPEC_FILE} does not define {REGISTRY_NAME} as a "
+                     f"set literal — the explain plane's operator registry "
+                     f"moved; update tools/check_operators.py")
+
+
+def _candidate_classes(tree: ast.AST) -> set:
+    """Class names this file imports from the operator-implementing
+    modules (``from petastorm_tpu.workers_pool.x import ThreadPool`` at
+    any nesting level — lazy in-function imports included). A name counts
+    as a class when it starts uppercase and contains a lowercase letter
+    (filters SCREAMING_SNAKE constants)."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or not node.module:
+            continue
+        if not any(node.module == p or node.module.startswith(p + ".")
+                   for p in OPERATOR_MODULE_PREFIXES):
+            continue
+        for alias in node.names:
+            name = alias.asname or alias.name
+            if name[:1].isupper() and any(c.islower() for c in name):
+                out.add(name)
+    return out
+
+
+def _constructed_classes(tree: ast.AST, candidates: set):
+    """Yield (class_name, lineno) for every Call of a bare Name or
+    attribute whose terminal name is a candidate operator class."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if name in candidates:
+            yield name, node.lineno
+
+
+def check_file(path: str, registered: set, repo_root: str) -> list:
+    full = os.path.join(repo_root, path)
+    try:
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return [f"{path}: registered in check_operators but unreadable "
+                f"({e}) — update PLANNING_FILES"]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: "
+                f"{e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    candidates = _candidate_classes(tree) | registered
+    for name, lineno in _constructed_classes(tree, candidates):
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        if name not in registered:
+            violations.append(
+                f"{path}:{lineno}: {name} is constructed on the reader "
+                f"planning path but registers no PipelineSpec node — add "
+                f"it to {REGISTRY_NAME} in {SPEC_FILE} and teach the spec "
+                f"builder about it (or waive with '# {WAIVER}: <why>')")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        registered = load_registered_classes(repo_root)
+    except (OSError, ValueError) as e:
+        print(f"check_operators: {e}", file=sys.stderr)
+        return 1
+    if argv and argv[0] == "--list":
+        for name in sorted(registered):
+            print(name)
+        return 0
+    all_violations = []
+    checked = 0
+    for path in PLANNING_FILES:
+        all_violations.extend(check_file(path, registered, repo_root))
+        checked += 1
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_operators: {len(all_violations)} violation(s) across "
+              f"{checked} planning file(s)", file=sys.stderr)
+        return 1
+    print(f"check_operators: {checked} planning file(s) clean "
+          f"({len(registered)} operator class(es) registered)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
