@@ -58,6 +58,10 @@ ci-lint:
 	# run): a rule/detector regression fails the BUILD, not just the bench.
 	python -m petastorm_tpu.telemetry check bench_snapshots/appending_epoch.json --anomaly
 	python -m petastorm_tpu.telemetry check bench_snapshots/deterministic_epoch.json --anomaly
+	# Data-quality contract (docs/observability.md "Data quality plane"):
+	# the committed quality-on bench snapshot must hold the drift SLO — a
+	# shipped profile/scoring regression fails the BUILD.
+	python -m petastorm_tpu.telemetry check bench_snapshots/quality_epoch.json --slo "quality.max_drift<=0.2"
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

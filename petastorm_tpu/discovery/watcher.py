@@ -71,13 +71,24 @@ class DatasetWatcher:
     :param quarantine: the reader's :class:`RowGroupQuarantine`; torn new
         files land there with ``state='pending_retry'``
     :param stats_columns: columns whose per-row-group statistics to
-        harvest from validation footers (the pruner's constrained fields)
+        harvest from validation footers (the pruner's constrained fields,
+        plus every planned column when the data-quality plane scores
+        admissions)
+    :param quality_scorer: optional ``callable(path, per_group_stats) ->
+        {"score", "verdict", ...}`` (the data-quality plane's
+        :meth:`~petastorm_tpu.quality.QualityMonitor.score_admitted_file`)
+        run on every file that passes footer + schema validation, with
+        the SAME harvested statistics — a drifted file is flagged (and,
+        with ``admission_action='refuse'``, refused like incompatible
+        schema drift) **before** its bytes can join an epoch
+        (docs/observability.md "Data quality plane")
     """
 
     def __init__(self, ctx, *, base_snapshot: DatasetSnapshot,
                  reference_schema=None, poll_interval_s: Optional[float] = None,
                  retry_policy=None, deadline=None, fault_plan=None,
-                 telemetry=None, quarantine=None, stats_columns=()):
+                 telemetry=None, quarantine=None, stats_columns=(),
+                 quality_scorer=None):
         self._ctx = ctx
         self._reference_schema = reference_schema
         self._poll_interval_s = (float(poll_interval_s)
@@ -88,6 +99,7 @@ class DatasetWatcher:
         self._telemetry = telemetry
         self._quarantine = quarantine
         self._stats_columns = tuple(stats_columns)
+        self._quality_scorer = quality_scorer
 
         self._lock = threading.Lock()
         #: Serializes whole discovery passes: the background poll thread
@@ -312,6 +324,40 @@ class DatasetWatcher:
             logger.error("discovery refused %s: %s", adm.path, detail)
             summary["refused"] += 1
             return
+
+        if self._quality_scorer is not None:
+            # Data-quality admission gate (docs/observability.md "Data
+            # quality plane"): score the file's footer statistics against
+            # the reference BEFORE its bytes can join an epoch. The scorer
+            # owns the telemetry/events; 'refuse' degrades exactly like
+            # incompatible schema drift — serving continues on the last
+            # good snapshot, re-validated only when the bytes change.
+            try:
+                quality = self._quality_scorer(adm.path, stats)
+            except Exception as e:  # noqa: BLE001 - scoring must not kill admission
+                logger.warning("quality admission scoring failed for %s: "
+                               "%r; admitting unscored", adm.path, e)
+                quality = None
+            if quality is not None and quality.get("verdict") == "refuse":
+                adm.state = STATE_REFUSED
+                adm.detail = (f"data-quality drift score "
+                              f"{quality.get('score')} over the admission "
+                              f"threshold")
+                with self._lock:
+                    self._pending.pop(adm.path, None)
+                    self._refused[adm.path] = adm
+                if self._c_refused is not None:
+                    self._c_refused.add(1)
+                warnings.warn(
+                    f"live discovery refused {adm.path}: data-quality "
+                    f"drift (score {quality.get('score')}). The reader "
+                    f"continues on the last good snapshot; inspect the "
+                    f"producer (docs/observability.md \"Data quality "
+                    f"plane\").")
+                logger.error("discovery refused %s: %s", adm.path,
+                             adm.detail)
+                summary["refused"] += 1
+                return
 
         was_pending = adm.state == STATE_PENDING and adm.attempts > 1
         adm.state = STATE_ADMITTED

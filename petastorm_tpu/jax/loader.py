@@ -786,6 +786,16 @@ class LoaderBase:
         timeline = getattr(self.telemetry, "timeline", None)
         return {} if timeline is None else timeline.as_dict()
 
+    def quality_report(self) -> dict:
+        """The underlying reader's data-quality readout
+        (docs/observability.md "Data quality plane") — profiles, drift
+        scores, coverage manifests. The loader adds no observation of its
+        own: what the reader delivered IS what this loader staged. Empty
+        dict when the plane is off (``make_reader(quality=True)``)."""
+        reader = getattr(self, "_reader", None)
+        report = getattr(reader, "quality_report", None)
+        return {} if report is None else report()
+
     # ------------------------------------------------------ explain plane
     def explain(self, profiled: bool = False):
         """The FULL pipeline operator graph — the underlying reader's

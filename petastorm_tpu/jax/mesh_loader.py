@@ -168,7 +168,8 @@ class _Source:
     order. ``pulled`` counts items enqueued to the assembler."""
 
     __slots__ = ("ordinals", "reader", "pulled", "recovery", "plan_base",
-                 "fifo", "counted", "safe_delivered", "plan_positions")
+                 "fifo", "counted", "safe_delivered", "plan_positions",
+                 "audited")
 
     def __init__(self, ordinals, recovery: bool = False, plan_base: int = 0,
                  plan_positions=None):
@@ -202,6 +203,9 @@ class _Source:
         #: yet enqueued (the reader confirms on pull); slicing past it
         #: would drop that in-hand group from the epoch entirely.
         self.safe_delivered = 0
+        #: Groups already fed to the coverage auditor (docs/observability.md
+        #: "Data quality plane") — _mark_consumed feeds only the delta.
+        self.audited = 0
 
     def plan_watermark(self, delivered: int) -> int:
         """Full-plan position watermark after ``delivered`` groups of THIS
@@ -467,6 +471,16 @@ class MeshDataLoader(LoaderBase):
         #: Per-host profiled operator graphs captured at source teardown
         #: (explain-plane federation, keyed ``h{idx}``).
         self._host_specs: Dict[str, dict] = {}
+        # ----- data-quality plane (docs/observability.md "Data quality
+        # plane"): the mesh coverage auditor proves every planned global
+        # row-group ordinal was delivered (or quarantine-skip-accounted)
+        # exactly once per epoch — primary and reshard-recovery sources
+        # alike; per-host quality reports are captured at source teardown
+        # (same keying as timelines/specs) and federated in mesh_report().
+        from petastorm_tpu.quality import MeshCoverageLedger
+        self._quality_ledger = MeshCoverageLedger(self._g_at,
+                                                  telemetry=self.telemetry)
+        self._host_quality: Dict[str, dict] = {}
         self._timeline = None
         self._timeline_sampler = None
         self.anomaly_monitor = None
@@ -830,12 +844,28 @@ class MeshDataLoader(LoaderBase):
                 self._c_host_groups[feed.idx].add(
                     len(src.ordinals) - src.counted)
                 src.counted = len(src.ordinals)
+            # Coverage-audit top-up (docs/observability.md "Data quality
+            # plane"): a cleanly drained source delivered every planned
+            # group EXCEPT quarantine skips, which are skip-accounted
+            # (count level — a skip shifts the positional enqueue
+            # accounting, so per-ordinal attribution past it would lie).
+            quarantined = len(getattr(reader, "quarantine", ()) or ())
+            epoch_idx = self._planned_through
+            deliver_to = max(src.audited, len(src.ordinals) - quarantined)
+            if deliver_to > src.audited:
+                self._quality_ledger.record_delivered(
+                    epoch_idx, src.ordinals[src.audited:deliver_to],
+                    recovery=src.recovery)
+                src.audited = deliver_to
+            if quarantined:
+                self._quality_ledger.record_skipped(epoch_idx, quarantined)
             with self._cond:
                 self._source_done(1)
         finally:
             self._rollup_host_trace(feed.idx, reader)
             self._rollup_host_timeline(feed.idx, reader)
             self._rollup_host_spec(feed.idx, reader)
+            self._rollup_host_quality(feed.idx, reader)
             try:
                 reader.stop()
                 reader.join()
@@ -910,6 +940,22 @@ class MeshDataLoader(LoaderBase):
         with self._cond:
             self._host_timelines.setdefault(f"h{host}", []).append(
                 timeline.as_dict())
+
+    def _rollup_host_quality(self, host: int, reader) -> None:
+        """Data-quality rollup (docs/observability.md "Data quality
+        plane"): capture the per-host reader's quality report at source
+        teardown under its ``h{idx}`` federation key — the mergeable
+        profiles federate into one dataset profile in
+        ``mesh_report()["quality"]``. A host that ran several sources
+        keeps the newest report per source; profiles merge across them at
+        report time."""
+        try:
+            rep = reader.quality_report()
+        except Exception:  # noqa: BLE001 - rollup best-effort at teardown
+            return
+        if rep:
+            with self._cond:
+                self._host_quality.setdefault(f"h{host}", []).append(rep)
 
     def _rollup_host_spec(self, host: int, reader) -> None:
         """Explain-plane rollup (docs/observability.md "Explain plane"):
@@ -1331,6 +1377,14 @@ class MeshDataLoader(LoaderBase):
                 feed.primary_consumed = max(
                     feed.primary_consumed,
                     src.plan_watermark(part.delivered_after))
+            if part.delivered_after > src.audited:
+                # Coverage audit: only the newly-consumed slice (the set
+                # dedupes, but the redelivery counter must not see a
+                # source's own prefix twice).
+                self._quality_ledger.record_delivered(
+                    epoch, src.ordinals[src.audited:part.delivered_after],
+                    recovery=src.recovery)
+                src.audited = part.delivered_after
         self._pending_safe_state = self._cursor(epoch)
 
     def _cursor(self, epoch: int, fresh: bool = False) -> dict:
@@ -1531,7 +1585,42 @@ class MeshDataLoader(LoaderBase):
         timeline = self._federated_timeline()
         if timeline is not None:
             report["timeline"] = timeline
+        report["quality"] = self.quality_report()
         return report
+
+    def quality_report(self) -> dict:
+        """Mesh data-quality rollup (docs/observability.md "Data quality
+        plane"): the coverage auditor's per-epoch manifests (every global
+        row-group ordinal delivered or skip-accounted exactly once,
+        reshard redeliveries counted), plus — when host readers run with
+        ``quality=True`` — their captured profiles federated into ONE
+        dataset profile (the merge is exact: Chan moments, histogram
+        bucket sums, KMV unions) with per-host drift maxima."""
+        out = {"coverage": self._quality_ledger.report()}
+        with self._cond:
+            hosts = {k: list(reps) for k, reps in self._host_quality.items()}
+        if hosts:
+            from petastorm_tpu.quality import DatasetProfile
+            merged = DatasetProfile()
+            per_host = {}
+            drift_max = 0.0
+            for key in sorted(hosts):
+                host_drift = 0.0
+                host_rows = 0
+                for rep in hosts[key]:
+                    prof = rep.get("profile")
+                    if prof:
+                        merged.merge(DatasetProfile.from_dict(prof))
+                    host_rows += rep.get("rows_observed", 0)
+                    host_drift = max(host_drift,
+                                     (rep.get("drift") or {}).get("max", 0.0))
+                per_host[key] = {"rows_observed": host_rows,
+                                 "drift_max": round(host_drift, 6)}
+                drift_max = max(drift_max, host_drift)
+            out["profile"] = merged.to_dict()
+            out["per_host"] = per_host
+            out["drift_max"] = round(drift_max, 6)
+        return out
 
     def _federated_timeline(self) -> Optional[dict]:
         """ONE fleet-level timeline rollup (docs/observability.md

@@ -1,0 +1,30 @@
+"""Data-quality plane (docs/observability.md "Data quality plane"):
+streaming column profiles, drift detection, and epoch coverage auditing.
+
+The pipeline planes built so far make the *machinery* observable (spans,
+time series, operator graphs); this package makes the *data* flowing
+through it observable — what the columns looked like, how far they have
+moved from a persisted reference, and whether every planned sample was
+delivered or skip-accounted exactly once. Enable with
+``make_reader(quality=True)`` / ``make_batch_reader(quality=True)``;
+read through ``Reader.quality_report()``, the ``quality.*`` telemetry,
+``mesh_report()["quality"]``, and ``python -m petastorm_tpu.telemetry
+quality SNAP [--diff REF]``.
+"""
+from petastorm_tpu.quality.coverage import CoverageLedger, MeshCoverageLedger
+from petastorm_tpu.quality.drift import (DRIFT_ACTIONABLE, DRIFT_STABLE,
+                                         chi_square_score, drift_scores,
+                                         psi_score, score_stats_profile)
+from petastorm_tpu.quality.monitor import QualityConfig, QualityMonitor
+from petastorm_tpu.quality.profile import (ColumnProfile, DatasetProfile,
+                                           load_profile, save_profile)
+from petastorm_tpu.quality.sketch import KMVSketch
+
+__all__ = [
+    "QualityConfig", "QualityMonitor",
+    "ColumnProfile", "DatasetProfile", "load_profile", "save_profile",
+    "KMVSketch",
+    "psi_score", "chi_square_score", "drift_scores", "score_stats_profile",
+    "DRIFT_STABLE", "DRIFT_ACTIONABLE",
+    "CoverageLedger", "MeshCoverageLedger",
+]

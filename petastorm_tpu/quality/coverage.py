@@ -1,0 +1,279 @@
+"""Epoch coverage auditing: prove what was delivered, exactly once.
+
+Reproducible-at-scale training needs more than a deterministic order — it
+needs **auditable evidence** that every planned sample reached the model
+or was explicitly skip-accounted (quarantine), with nothing delivered
+twice (crash re-ventilation, hedge duplicates, mesh reshard redelivery).
+The deterministic plane's :class:`~petastorm_tpu.reader_impl.epoch_plan.
+OrderedDeliveryGate` already *enforces* that contract; the
+:class:`CoverageLedger` records the evidence as a per-epoch **coverage
+manifest** (docs/observability.md "Data quality plane"):
+
+``{"epoch", "planned", "delivered", "empty", "skipped": [ordinals],
+"duplicates_dropped", "accounted", "reconciled", "complete"}``
+
+``reconciled`` means delivered + empty + skipped == planned over the
+audited range — every plan position accounted exactly once.
+
+Modes:
+
+* ``ordinal`` — fed by the gate (deterministic mode): exact per-ordinal
+  accounting, including quarantine skips and dropped duplicates.
+* ``count`` — free-order readers have no consumer-side ordinals; the
+  ledger audits at unit granularity (delivered units + quarantine skips
+  vs. the plan's item count), which still catches silent truncation.
+
+:class:`MeshCoverageLedger` audits the mesh plane: delivered row-group
+**global ordinals** per epoch (primary and reshard-recovery sources
+alike), proving a host-loss reshard redelivered the lost range exactly
+once (docs/mesh.md).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["CoverageLedger", "MeshCoverageLedger"]
+
+
+class CoverageLedger:
+    """Per-epoch delivery accounting. ``record()`` is called on the
+    consumer thread (the gate's pull path / the results readers);
+    ``report()`` from any thread."""
+
+    def __init__(self, plan=None, num_items: Optional[int] = None,
+                 num_epochs: Optional[int] = None, telemetry=None):
+        #: EpochPlan (ordinal mode) — maps linear ordinals to (epoch, pos)
+        #: and knows per-epoch item counts under live growth.
+        self._plan = plan
+        self._num_items = num_items
+        self._num_epochs = num_epochs
+        self.mode = "ordinal" if plan is not None else "count"
+        self._lock = threading.Lock()
+        #: {epoch: {"planned", "delivered", "empty", "skipped": [...],
+        #:          "duplicates_dropped"}} (ordinal mode)
+        self._epochs: Dict[int, dict] = {}
+        #: Units delivered across the pass (count mode).
+        self._units = 0
+        self._resumed_at: Optional[dict] = None
+        self._c_delivered = (telemetry.counter("quality.coverage.delivered")
+                             if telemetry is not None else None)
+        self._c_skipped = (telemetry.counter("quality.coverage.skipped")
+                           if telemetry is not None else None)
+        self._c_dups = (telemetry.counter(
+            "quality.coverage.duplicates_dropped")
+            if telemetry is not None else None)
+
+    #: Newest epochs retained per ledger — a weeks-long infinite-epoch job
+    #: must not grow its audit state unboundedly (matches the anomaly
+    #: plane's bounded-history discipline).
+    MAX_EPOCHS = 16
+
+    # ------------------------------------------------------------- feeding
+    def _epoch_rec(self, epoch: int) -> dict:
+        rec = self._epochs.get(epoch)
+        if rec is None:
+            planned = (self._plan.num_items_at(epoch)
+                       if self._plan is not None else self._num_items)
+            rec = self._epochs[epoch] = {
+                "planned": planned, "delivered": 0, "empty": 0,
+                "skipped": [], "duplicates_dropped": 0}
+            while len(self._epochs) > self.MAX_EPOCHS:
+                self._epochs.pop(min(self._epochs))
+        return rec
+
+    def mark_resumed(self, epoch: int, offset: int) -> None:
+        """A resume starts the audit mid-plan: positions before the cursor
+        belong to the previous run's ledger. The manifest reports the
+        audited range honestly instead of claiming a hole."""
+        with self._lock:
+            self._resumed_at = {"epoch": int(epoch), "offset": int(offset)}
+            rec = self._epoch_rec(int(epoch))
+            rec["audited_from_offset"] = int(offset)
+
+    def record(self, kind: str, linear: int) -> None:
+        """One gate accounting event: ``kind`` in ``delivered`` / ``empty``
+        / ``skip`` / ``duplicate``; ``linear`` the plan's linear ordinal."""
+        if self._plan is not None:
+            epoch, pos = self._plan.slot_epoch(int(linear))
+        else:
+            epoch, pos = 0, int(linear)
+        with self._lock:
+            rec = self._epoch_rec(epoch)
+            if kind == "delivered":
+                rec["delivered"] += 1
+                if self._c_delivered is not None:
+                    self._c_delivered.add(1)
+            elif kind == "empty":
+                rec["empty"] += 1
+            elif kind == "skip":
+                rec["skipped"].append(pos)
+                if self._c_skipped is not None:
+                    self._c_skipped.add(1)
+            elif kind == "duplicate":
+                rec["duplicates_dropped"] += 1
+                if self._c_dups is not None:
+                    self._c_dups.add(1)
+
+    def record_unit(self) -> None:
+        """Count-mode feeding: one delivered unit (free-order readers have
+        no consumer-side plan ordinals; the audit is a unit count over the
+        whole pass — a lower bound that still catches silent truncation,
+        not the exactly-once proof the ordinal mode gives)."""
+        with self._lock:
+            self._units += 1
+            if self._c_delivered is not None:
+                self._c_delivered.add(1)
+
+    def reset(self) -> None:
+        """Another pass restarts the stream origin (``Reader.reset()``):
+        the audit restarts with it — manifests describe ONE pass."""
+        with self._lock:
+            self._epochs.clear()
+            self._units = 0
+            self._resumed_at = None
+
+    # ------------------------------------------------------------- readout
+    @staticmethod
+    def _manifest(epoch: int, rec: dict) -> dict:
+        planned = rec.get("planned")
+        audited_from = rec.get("audited_from_offset", 0)
+        skipped = sorted(rec["skipped"])
+        accounted = rec["delivered"] + rec["empty"] + len(skipped)
+        expected = (None if planned is None
+                    else max(0, planned - audited_from))
+        m = {
+            "epoch": int(epoch), "planned": planned,
+            "delivered": rec["delivered"], "empty": rec["empty"],
+            "skipped": skipped,
+            "duplicates_dropped": rec["duplicates_dropped"],
+            "accounted": accounted,
+            "complete": (expected is not None and accounted >= expected),
+            "reconciled": (expected is not None
+                           and accounted == expected),
+        }
+        if audited_from:
+            m["audited_from_offset"] = audited_from
+        return m
+
+    def report(self, quarantine_count: int = 0) -> dict:
+        """All epochs' manifests (ordinal mode) or the pass-level unit
+        audit (count mode). ``quarantine_count`` (count mode only) folds
+        the reader's quarantine tally into the accounting — in ordinal
+        mode skips arrive through the gate and must NOT be counted
+        twice."""
+        with self._lock:
+            epochs = {e: dict(rec, skipped=list(rec["skipped"]))
+                      for e, rec in self._epochs.items()}
+            units = self._units
+            resumed = dict(self._resumed_at) if self._resumed_at else None
+        if self.mode == "count":
+            expected = (None if not self._num_epochs or not self._num_items
+                        else self._num_items * self._num_epochs)
+            accounted = units + quarantine_count
+            return {
+                "mode": "count",
+                "planned_per_epoch": self._num_items,
+                "epochs_planned": self._num_epochs,
+                "units_delivered": units,
+                "quarantine_skips": quarantine_count,
+                "accounted": accounted,
+                # Free-order workers publish nothing for filtered-to-empty
+                # groups, so count mode can only certify completeness as a
+                # lower bound — the exactly-once PROOF is ordinal mode.
+                "complete": (None if expected is None
+                             else accounted >= expected),
+            }
+        manifests = [self._manifest(e, rec)
+                     for e, rec in sorted(epochs.items())]
+        out = {"mode": self.mode, "epochs": manifests}
+        if resumed:
+            out["resumed_at"] = resumed
+        return out
+
+    def manifest(self, epoch: int) -> Optional[dict]:
+        """One epoch's coverage manifest (ordinal mode; None if never
+        fed)."""
+        with self._lock:
+            rec = self._epochs.get(int(epoch))
+            rec = dict(rec, skipped=list(rec["skipped"])) if rec else None
+        if rec is None:
+            return None
+        return self._manifest(int(epoch), rec)
+
+
+class MeshCoverageLedger:
+    """Row-group-ordinal delivery audit for the mesh plane: per epoch, the
+    set of delivered global ordinals (primary + recovery sources), with
+    redeliveries counted instead of silently re-added. Fed from
+    ``MeshDataLoader._mark_consumed`` deltas; reported through
+    ``mesh_report()["quality"]["coverage"]``."""
+
+    def __init__(self, planned_fn, telemetry=None):
+        self._lock = threading.Lock()
+        #: ``planned_fn(epoch) -> int``: the epoch's planned row-group
+        #: count (the mesh loader's growth-schedule lookup, so a
+        #: live-grown epoch audits against ITS ordinal range).
+        self._planned_fn = planned_fn
+        #: {epoch: {"delivered": set, "redelivered": int,
+        #:          "recovered": set, "skipped": int}}
+        self._epochs: Dict[int, dict] = {}
+        self._c_redelivered = (
+            telemetry.counter("quality.coverage.mesh_redelivered")
+            if telemetry is not None else None)
+
+    def _epoch_rec(self, epoch: int) -> dict:
+        rec = self._epochs.get(int(epoch))
+        if rec is None:
+            rec = self._epochs[int(epoch)] = {
+                "planned": int(self._planned_fn(int(epoch))),
+                "delivered": set(),
+                "redelivered": 0, "recovered": set(), "skipped": 0}
+            while len(self._epochs) > CoverageLedger.MAX_EPOCHS:
+                self._epochs.pop(min(self._epochs))
+        return rec
+
+    def record_delivered(self, epoch: int, ordinals, recovery: bool) -> None:
+        with self._lock:
+            rec = self._epoch_rec(epoch)
+            for o in ordinals:
+                o = int(o)
+                if o in rec["delivered"]:
+                    rec["redelivered"] += 1
+                    if self._c_redelivered is not None:
+                        self._c_redelivered.add(1)
+                else:
+                    rec["delivered"].add(o)
+                    if recovery:
+                        rec["recovered"].add(o)
+
+    def record_skipped(self, epoch: int, count: int) -> None:
+        """Quarantine skips inside a host reader: the group was planned,
+        never delivered, and IS accounted (the host's quarantine report
+        carries its provenance). Count-level — a skip shifts the source's
+        positional enqueue accounting, so per-ordinal attribution past it
+        is not trustworthy; the count still reconciles the epoch."""
+        if count:
+            with self._lock:
+                self._epoch_rec(epoch)["skipped"] += int(count)
+
+    def report(self) -> dict:
+        with self._lock:
+            manifests: List[dict] = []
+            for epoch, rec in sorted(self._epochs.items()):
+                planned = rec["planned"]
+                delivered = len(rec["delivered"])
+                accounted = delivered + rec["skipped"]
+                manifests.append({
+                    "epoch": epoch, "planned": planned,
+                    "delivered": delivered,
+                    "recovered_via_reshard": len(rec["recovered"]),
+                    "redelivered": rec["redelivered"],
+                    "quarantine_skips": rec["skipped"],
+                    "missing": max(0, planned - accounted),
+                    "accounted": accounted,
+                    "complete": accounted >= planned,
+                    "reconciled": (accounted == planned
+                                   and rec["redelivered"] == 0),
+                })
+            return {"mode": "mesh_ordinal", "epochs": manifests}

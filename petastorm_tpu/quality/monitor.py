@@ -1,0 +1,379 @@
+"""The QualityMonitor: one per pipeline, owning the live
+:class:`~petastorm_tpu.quality.profile.DatasetProfile`, the drift scorer,
+and the coverage ledger hookup (docs/observability.md "Data quality
+plane").
+
+Observation happens at the **consumer delivery point** (the Reader's
+results readers), one vectorized pass per column per delivered unit —
+pool-agnostic (thread/process/dummy payloads all arrive as columnar
+units), migration-safe, and measuring exactly what was *fed to the
+model*, which is the auditable quantity.
+
+Drift gauges are **lazy**: ``quality.max_drift`` and the per-column
+``quality.drift.{col}`` family are function-backed, so scores are
+computed when telemetry is read — which the PR 12 timeline sampler does
+on its fixed cadence, making the sampler interval the drift-detection
+cadence for free (no timeline = scores computed at snapshot/report
+time). Threshold crossings fire ``quality.drift`` events on the entry
+edge and bump ``quality.drift_detections_total`` — both compose with the
+existing SLO/anomaly planes (``telemetry check --slo
+"quality.max_drift<=0.2"`` is a CI-gateable data contract).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from petastorm_tpu.quality.drift import (DRIFT_ACTIONABLE, drift_scores,
+                                         score_stats_profile)
+from petastorm_tpu.quality.profile import (DatasetProfile, _histogram_edges,
+                                           load_profile)
+
+__all__ = ["QualityConfig", "QualityMonitor"]
+
+
+@dataclass(frozen=True)
+class QualityConfig:
+    """Tuning knobs for the data-quality plane (all defaults are safe for
+    production pipelines; docs/observability.md has the tuning table)."""
+
+    #: Interior histogram bucket count per numeric column (plus underflow/
+    #: overflow); more buckets = finer PSI at slightly more state.
+    histogram_buckets: int = 24
+    #: KMV sketch size (distinct-count accuracy ~ 1/sqrt(k)).
+    sketch_k: int = 256
+    #: Restrict profiling to these columns (None = every delivered column,
+    #: capped at ``max_columns``).
+    columns: Optional[Sequence[str]] = None
+    #: Hard cap on tracked columns — a 2000-column store must opt columns
+    #: in rather than silently ballooning profile state.
+    max_columns: int = 64
+    #: Profile every Nth delivered unit (1 = all; an explicit int is a
+    #: fixed, deterministic duty cycle). ``None`` — the default — is
+    #: **adaptive**: the first ``min_profile_units`` units profile fully
+    #: (fast convergence, and small tests stay exact), then the monitor
+    #: measures its own per-unit cost against the unit arrival rate and
+    #: skips enough units to hold profiling at ``profile_budget_frac`` of
+    #: wall time. Sampling thins only the statistical profile; the
+    #: observation counters and the coverage audit are NEVER sampled.
+    sample_every: Optional[int] = None
+    #: Adaptive mode's duty-cycle target: profiling wall time as a
+    #: fraction of pipeline wall time (0.01 = 1%, inside the bench's 3%
+    #: acceptance bar with headroom for the first fully-profiled units).
+    profile_budget_frac: float = 0.01
+    #: Units profiled unconditionally before the adaptive throttle may
+    #: engage — enough to fix column kinds and histogram edges (edges
+    #: usually come from the reference/stats seed anyway).
+    min_profile_units: int = 2
+    #: PSI (or null-rate/NaN-delta) at or above this fires a
+    #: ``quality.drift`` event per column (entry edge).
+    drift_threshold: float = DRIFT_ACTIONABLE
+    #: Admission-score threshold for newly admitted live files (stats
+    #: drift: range-outlier fraction / null-rate delta, NOT PSI scale).
+    admission_threshold: float = 0.5
+    #: ``'warn'`` records events/telemetry only; ``'refuse'`` additionally
+    #: tells the discovery watcher to refuse the file (serving continues
+    #: on the last good snapshot, like incompatible schema drift).
+    admission_action: str = "warn"
+    #: Reserved for callers that build configs programmatically.
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.admission_action not in ("warn", "refuse"):
+            raise ValueError(f"admission_action must be 'warn' or "
+                             f"'refuse', got {self.admission_action!r}")
+        if self.sample_every is not None and self.sample_every < 1:
+            raise ValueError(f"sample_every must be >= 1 (or None for "
+                             f"adaptive), got {self.sample_every}")
+        if not 0.0 < self.profile_budget_frac <= 1.0:
+            raise ValueError(f"profile_budget_frac must be in (0, 1], "
+                             f"got {self.profile_budget_frac}")
+
+
+def _edges_from_stats(stats_seed: Dict[str, dict], buckets: int) \
+        -> Dict[str, list]:
+    """Histogram edge seed from retained plan ColumnStats aggregates
+    (zero extra IO; docs/io.md "Pruning" retention)."""
+    out = {}
+    for name, agg in (stats_seed or {}).items():
+        lo, hi = agg.get("min"), agg.get("max")
+        if lo is None or hi is None:
+            continue
+        try:
+            lo, hi = float(lo), float(hi)
+        except (TypeError, ValueError):
+            continue
+        out[name] = _histogram_edges(lo, hi, buckets)
+    return out
+
+
+class QualityMonitor:
+    """Per-pipeline data-quality state; thread-safe."""
+
+    def __init__(self, config: Optional[QualityConfig] = None,
+                 telemetry=None, reference=None,
+                 stats_seed: Optional[Dict[str, dict]] = None,
+                 label: str = "reader"):
+        self.config = config or QualityConfig()
+        self.telemetry = telemetry
+        self.label = label
+        #: Reference :class:`DatasetProfile` (path/dict/object resolved) —
+        #: the drift baseline; None = no baseline yet (live profile serves
+        #: as the admission baseline once it has data).
+        self.reference = (load_profile(reference)
+                          if reference is not None else None)
+        self._reference_source = (reference if isinstance(reference, str)
+                                  else ("inline" if reference is not None
+                                        else None))
+        edge_seed = {}
+        if self.reference is not None:
+            edge_seed.update(self.reference.edge_map())
+        self._stats_seed = dict(stats_seed or {})
+        for name, edges in _edges_from_stats(
+                self._stats_seed, self.config.histogram_buckets).items():
+            edge_seed.setdefault(name, edges)
+        self.profile = DatasetProfile(
+            buckets=self.config.histogram_buckets,
+            sketch_k=self.config.sketch_k,
+            columns=self.config.columns,
+            max_columns=self.config.max_columns,
+            edge_seed=edge_seed)
+        #: Coverage ledger (set by the owning Reader; docs above).
+        self.ledger = None
+        self._lock = threading.Lock()
+        self._drift_cache = (-1, {})
+        self._above: set = set()
+        self._drift_gauges: set = set()
+        self._admission_log: list = []
+        self._admission_max = 0.0
+        self._sample_skip = 0
+        # Adaptive duty-cycle state (config.sample_every is None): EWMA of
+        # per-unit profiling cost and unit arrival gap, and how many units
+        # the throttle decided to skip. Consumer-thread only; monotonic
+        # clock per the repo clock discipline.
+        self._profiled_units = 0
+        self._skip_remaining = 0
+        self._cost_ewma: Optional[float] = None
+        self._gap_ewma: Optional[float] = None
+        self._last_arrival: Optional[float] = None
+        # True observation totals (the profile's own counts thin under
+        # sampling; these never do).
+        self._units_total = 0
+        self._rows_total = 0
+        if telemetry is not None:
+            self._c_units = telemetry.counter("quality.units_observed")
+            self._c_rows = telemetry.counter("quality.rows_observed")
+            self._c_detect = telemetry.counter(
+                "quality.drift_detections_total")
+            telemetry.gauge("quality.max_drift", self.max_drift)
+            telemetry.gauge("quality.columns_tracked",
+                            lambda: len(self.profile.columns))
+        else:
+            self._c_units = self._c_rows = self._c_detect = None
+
+    # ------------------------------------------------------------- feeding
+    def observe_columns(self, columns: Dict[str, object],
+                        num_rows: int) -> None:
+        """One delivered unit's columns (ColumnarBatch columns / batched
+        reader dict). The profile update is sampled per
+        ``config.sample_every`` (fixed, or the adaptive duty cycle); the
+        observation counters and coverage accounting are not."""
+        self._units_total += 1
+        self._rows_total += int(num_rows)
+        if self._c_units is not None:
+            self._c_units.add(1)
+            self._c_rows.add(num_rows)
+        ledger = self.ledger
+        if ledger is not None and ledger.mode == "count":
+            # Free-order coverage is a unit count (ordinal-mode ledgers
+            # are fed by the delivery gate; counting here would double).
+            ledger.record_unit()
+        sample_every = self.config.sample_every
+        if sample_every is not None and sample_every > 1:
+            with self._lock:
+                self._sample_skip += 1
+                if self._sample_skip % sample_every:
+                    return
+        elif sample_every is None:
+            now = time.perf_counter()
+            with self._lock:
+                last = self._last_arrival
+                self._last_arrival = now
+                if last is not None:
+                    gap = now - last
+                    self._gap_ewma = (gap if self._gap_ewma is None
+                                      else 0.8 * self._gap_ewma + 0.2 * gap)
+                if self._skip_remaining > 0:
+                    self._skip_remaining -= 1
+                    return
+        t0 = time.perf_counter()
+        self.profile.observe_columns(columns, num_rows)
+        cost = time.perf_counter() - t0
+        if sample_every is None:
+            with self._lock:
+                self._profiled_units += 1
+                self._cost_ewma = (cost if self._cost_ewma is None
+                                   else 0.8 * self._cost_ewma + 0.2 * cost)
+                if (self._profiled_units >= self.config.min_profile_units
+                        and self._gap_ewma and self._gap_ewma > 0):
+                    # Duty cycle: profiling one unit in (skip + 1) holds
+                    # cost / ((skip + 1) * gap) at the budget fraction.
+                    per = (self.config.profile_budget_frac
+                           * self._gap_ewma)
+                    skip = int(self._cost_ewma / per) if per > 0 else 255
+                    self._skip_remaining = max(0, min(255, skip))
+        self._register_drift_gauges()
+
+    def observe_rows(self, rows: Sequence[dict]) -> None:
+        """Eager-path fallback: columnarize one work item's row dicts
+        (one gather per column) and fold them in. NGram window dicts
+        (non-str keys) are counted but not profiled — a window is a view
+        over rows other units already profile."""
+        if not rows:
+            return
+        first = rows[0]
+        if not isinstance(first, dict) or any(not isinstance(k, str)
+                                              for k in first):
+            self._units_total += 1
+            self._rows_total += len(rows)
+            if self._c_units is not None:
+                self._c_units.add(1)
+                self._c_rows.add(len(rows))
+            ledger = self.ledger
+            if ledger is not None and ledger.mode == "count":
+                ledger.record_unit()
+            return
+        columns = {}
+        for name in first:
+            vals = [row.get(name) for row in rows]  # rowloop-ok: eager payloads are already per-row dicts
+            try:
+                arr = np.asarray(vals)
+                columns[name] = vals if arr.dtype.kind == "O" else arr
+            except (ValueError, TypeError):
+                columns[name] = vals
+        self.observe_columns(columns, len(rows))
+
+    # ------------------------------------------------------- drift scoring
+    def _register_drift_gauges(self) -> None:
+        if self.telemetry is None or self.reference is None:
+            return
+        for name in self.profile.columns:
+            if name in self._drift_gauges \
+                    or name not in self.reference.columns:
+                continue
+            self._drift_gauges.add(name)
+            self.telemetry.gauge(
+                f"quality.drift.{name}",
+                (lambda name=name:
+                 self._scores().get(name, {}).get("score", 0.0)))
+
+    def _scores(self) -> Dict[str, dict]:
+        """Per-column drift vs. the reference, cached by profile version;
+        threshold entry edges fire events here — i.e. on whatever cadence
+        reads the gauges (the timeline sampler, a snapshot, a report)."""
+        if self.reference is None:
+            return {}
+        with self._lock:
+            version = self.profile.version
+            if self._drift_cache[0] == version:
+                return self._drift_cache[1]
+            scores = drift_scores(self.reference, self.profile)
+            self._drift_cache = (version, scores)
+            threshold = self.config.drift_threshold
+            for name, detail in scores.items():
+                above = detail["score"] >= threshold
+                was_above = name in self._above
+                if above and not was_above:
+                    self._above.add(name)
+                    if self._c_detect is not None:
+                        self._c_detect.add(1)
+                    if self.telemetry is not None:
+                        self.telemetry.record_event(
+                            "quality.drift",
+                            {"column": name, "threshold": threshold,
+                             **detail})
+                elif not above and was_above:
+                    self._above.discard(name)
+            return scores
+
+    def max_drift(self) -> float:
+        scores = self._scores()
+        return max((d["score"] for d in scores.values()), default=0.0)
+
+    # ------------------------------------------------------ live admission
+    def score_admitted_file(self, path: str, per_group_stats) -> dict:
+        """Zero-IO admission scoring (docs/live_data.md x quality
+        interaction): the new file's footer ColumnStats against the
+        reference profile (or the live profile when no reference was
+        given). Returns ``{"score", "verdict", "columns"}`` where verdict
+        is ``ok`` / ``drift`` / ``refuse`` per ``admission_action``."""
+        baseline = self.reference
+        if baseline is None and self.profile.rows > 0:
+            baseline = self.profile
+        if baseline is None:
+            return {"score": 0.0, "verdict": "no_baseline", "columns": {}}
+        scored = score_stats_profile(baseline, per_group_stats)
+        score = scored["score"]
+        drifted = score >= self.config.admission_threshold
+        verdict = "ok"
+        if drifted:
+            verdict = ("refuse" if self.config.admission_action == "refuse"
+                       else "drift")
+        entry = {"path": path, "score": score, "verdict": verdict}
+        with self._lock:
+            self._admission_max = max(self._admission_max, score)
+            self._admission_log.append(
+                {**entry, "columns": scored["columns"]})
+            del self._admission_log[:-64]
+        if self.telemetry is not None:
+            self.telemetry.counter("quality.admission.files_scored").add(1)
+            self.telemetry.gauge("quality.admission.max_drift").set(
+                self._admission_max)
+            if drifted:
+                self.telemetry.counter(
+                    "quality.admission.drift_detections_total").add(1)
+                self.telemetry.record_event(
+                    "quality.admission.drift",
+                    {**entry,
+                     "columns": {n: c["score"]
+                                 for n, c in scored["columns"].items()}})
+        return {**scored, "verdict": verdict}
+
+    # ------------------------------------------------------------- readout
+    def report(self, quarantine_count: int = 0) -> dict:
+        """The full quality readout ``Reader.quality_report()`` returns
+        and snapshots/black boxes embed."""
+        scores = self._scores()
+        with self._lock:
+            admission = list(self._admission_log)
+            admission_max = self._admission_max
+        out = {
+            "enabled": True,
+            "rows_observed": self._rows_total,
+            "units_observed": self._units_total,
+            # Sampling (fixed or adaptive) thins these, never the above.
+            "rows_profiled": self.profile.rows,
+            "units_profiled": self.profile.units,
+            "columns_tracked": len(self.profile.columns),
+            "profile": self.profile.to_dict(),
+            "drift": {
+                "reference": self._reference_source,
+                "threshold": self.config.drift_threshold,
+                "max": round(max((d["score"] for d in scores.values()),
+                                 default=0.0), 6),
+                "columns": scores,
+            },
+        }
+        if self._stats_seed:
+            out["stats_seed_columns"] = sorted(self._stats_seed)
+        if admission:
+            out["admission"] = {"max_score": round(admission_max, 6),
+                                "files": admission}
+        if self.ledger is not None:
+            out["coverage"] = self.ledger.report(
+                quarantine_count=quarantine_count)
+        return out

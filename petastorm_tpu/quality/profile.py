@@ -1,0 +1,505 @@
+"""Streaming column profiles: the data-quality plane's state.
+
+A :class:`DatasetProfile` holds one :class:`ColumnProfile` per output
+column, updated in **one vectorized pass per column per delivered unit**
+(a ColumnarBatch / batched-reader column dict — the PR 9 batch-native
+payloads). Per column kind:
+
+* ``numeric`` — count, null(NaN) count, min/max, streaming moments
+  (mean + M2 via Chan's parallel-variance merge, so host merges are
+  exact), a fixed-edge streaming histogram
+  (:class:`~petastorm_tpu.telemetry.histogram.StreamingHistogram` — the
+  telemetry plane's bucket machinery, reused), and a KMV distinct sketch;
+* ``ndarray`` — shape/dtype tallies and NaN fraction over elements (one
+  ``np.isnan`` pass over the stacked ``(n, *shape)`` column);
+* ``object`` — count, None-rate, distinct sketch (strings, Decimals,
+  user-codec cells).
+
+Everything is **mergeable** (mesh hosts federate partial profiles into
+one dataset profile) and **JSON-round-trippable** (a persisted profile is
+the *reference* a later run — or a newly admitted live file — is scored
+against; :mod:`petastorm_tpu.quality.drift`).
+
+Histogram edges are fixed at first observation — from the reference
+profile when one was given (PSI needs shared edges), else from the plan's
+retained footer :class:`~petastorm_tpu.etl.dataset_metadata.ColumnStats`
+bounds (the PR 5 pruning scan, retained at zero extra IO), else from the
+first observed batch's min/max padded 25% each side. Underflow/overflow
+land in the histogram's first/+Inf buckets, so excursions past the seeded
+range are visible as tail mass rather than lost.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from petastorm_tpu.quality.sketch import KMVSketch
+from petastorm_tpu.telemetry.histogram import StreamingHistogram
+
+__all__ = ["ColumnProfile", "DatasetProfile", "load_profile",
+           "save_profile", "PROFILE_SCHEMA_VERSION"]
+
+PROFILE_SCHEMA_VERSION = 1
+
+#: Relative padding applied each side when histogram edges are derived
+#: from a first observed batch (no reference, no stats seed): leaves room
+#: for later batches without pushing everything into the overflow buckets.
+_EDGE_PAD = 0.25
+
+#: ``str(dtype)`` cache: dtype objects are interned per kind, and the
+#: name rendering showed up at ~30 us/unit in the hot-path profile.
+_DTYPE_NAMES: Dict[int, str] = {}
+
+
+def _dtype_name(dt) -> str:
+    name = _DTYPE_NAMES.get(id(dt))
+    if name is None:
+        name = _DTYPE_NAMES[id(dt)] = str(dt)
+        if len(_DTYPE_NAMES) > 256:
+            _DTYPE_NAMES.clear()
+    return name
+
+
+def _histogram_edges(lo: float, hi: float, buckets: int) -> List[float]:
+    """``buckets - 1`` interior edges spanning ``[lo, hi]`` (linear): with
+    the implicit underflow (<= first edge) and +Inf overflow buckets the
+    histogram has ``buckets + 1`` cells. Degenerate ranges widen to a unit
+    span so a constant column still gets usable edges."""
+    lo, hi = float(lo), float(hi)
+    if not np.isfinite(lo) or not np.isfinite(hi):
+        lo, hi = 0.0, 1.0
+    if hi <= lo:
+        lo, hi = lo - 0.5, lo + 0.5
+    return [round(float(e), 12)
+            for e in np.linspace(lo, hi, max(2, buckets) - 1)]
+
+
+class ColumnProfile:
+    """Streaming profile of one column. Not thread-safe on its own (the
+    owning :class:`DatasetProfile` serializes access)."""
+
+    __slots__ = ("name", "kind", "count", "null_count", "min", "max",
+                 "_mean", "_m2", "_num_valid", "hist", "sketch", "dtypes",
+                 "shapes", "nan_count", "element_count", "_edges",
+                 "_buckets", "_sketch_k")
+
+    def __init__(self, name: str, buckets: int = 24, sketch_k: int = 256,
+                 edges: Optional[Sequence[float]] = None):
+        self.name = name
+        self.kind: Optional[str] = None   # fixed by the first observation
+        self.count = 0
+        self.null_count = 0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._mean = 0.0
+        self._m2 = 0.0
+        #: Numeric NON-null rows folded into the moments — the Chan-merge
+        #: weight. Tracked separately from ``count`` because a mixed-kind
+        #: column (live schema drift) also counts object/ndarray cells,
+        #: which must never enter the merge as phantom zero-valued rows.
+        self._num_valid = 0
+        self.hist: Optional[StreamingHistogram] = None
+        self.sketch: Optional[KMVSketch] = None
+        self.dtypes: Dict[str, int] = {}
+        self.shapes: Dict[str, int] = {}
+        self.nan_count = 0
+        self.element_count = 0
+        self._edges = list(edges) if edges is not None else None
+        self._buckets = int(buckets)
+        self._sketch_k = int(sketch_k)
+
+    # ------------------------------------------------------------- updates
+    def observe(self, values) -> None:
+        """Fold one unit's column into the profile — one vectorized pass.
+        ``values`` is the column as the batch plane carries it: a numpy
+        array (scalar columns 1-D, ndarray columns stacked ``(n, *shape)``)
+        or a list of cells (strings/Decimals/ragged ndarray fallbacks)."""
+        if isinstance(values, np.ndarray) and values.ndim == 1 \
+                and values.dtype.kind in "biuf":
+            self._observe_numeric(values)
+        elif isinstance(values, np.ndarray) and values.ndim > 1:
+            self._observe_stacked(values)
+        else:
+            self._observe_cells(values)
+
+    def _set_kind(self, kind: str) -> None:
+        if self.kind is None:
+            self.kind = kind
+        elif self.kind != kind:
+            # A column that changes payload kind mid-stream (mixed-schema
+            # live growth) is itself a quality signal: tally it as an
+            # "other" dtype rather than corrupting the numeric state.
+            self.dtypes["mixed"] = self.dtypes.get("mixed", 0) + 1
+
+    def _ensure_numeric_state(self, data: np.ndarray) -> None:
+        if self.sketch is None:
+            self.sketch = KMVSketch(self._sketch_k)
+        if self.hist is None:
+            if self._edges is None:
+                lo, hi = float(data.min()), float(data.max())
+                pad = (hi - lo) * _EDGE_PAD
+                self._edges = _histogram_edges(lo - pad, hi + pad,
+                                               self._buckets)
+            self.hist = StreamingHistogram(self._edges)
+
+    def _observe_numeric(self, arr: np.ndarray) -> None:
+        self._set_kind("numeric")
+        n = int(arr.size)
+        self.count += n
+        dt = _dtype_name(arr.dtype)
+        self.dtypes[dt] = self.dtypes.get(dt, 0) + n
+        data = arr
+        if arr.dtype.kind == "f":
+            nulls = int(np.count_nonzero(np.isnan(arr)))
+            if nulls:
+                self.null_count += nulls
+                data = arr[~np.isnan(arr)]
+        if data.size == 0:
+            return
+        data64 = data.astype(np.float64, copy=False)
+        lo, hi = float(data64.min()), float(data64.max())
+        self.min = lo if self.min is None else min(self.min, lo)
+        self.max = hi if self.max is None else max(self.max, hi)
+        # Chan parallel-variance merge of this batch's (mean, M2) into the
+        # running pair — exact under any batch split, which is also what
+        # makes cross-host profile merges exact. The batch M2 comes from
+        # one fused dot-product pass (sum-of-squares minus n*mean^2,
+        # clamped: cancellation can only undershoot toward 0, and a
+        # monitoring plane's variance tolerates that far better than two
+        # extra temporaries per unit on the hot path).
+        bn = int(data64.size)
+        s1 = float(data64.sum())
+        b_mean = s1 / bn
+        b_m2 = max(0.0, float(np.dot(data64, data64)) - bn * b_mean * b_mean)
+        a_n = self._num_valid  # numeric rows already folded in
+        if a_n <= 0:
+            self._mean, self._m2 = b_mean, b_m2
+        else:
+            delta = b_mean - self._mean
+            tot = a_n + bn
+            self._mean += delta * bn / tot
+            self._m2 += b_m2 + delta * delta * a_n * bn / tot
+        self._num_valid = a_n + bn
+        self._ensure_numeric_state(data64)
+        self.hist.observe_many(data64, total=s1, lo=lo, hi=hi)
+        self.sketch.update_numeric(data64)
+
+    def _observe_stacked(self, arr: np.ndarray) -> None:
+        """Stacked ndarray column ``(n, *shape)``: ONE pass for shape/
+        dtype/NaN telemetry."""
+        self._set_kind("ndarray")
+        n = int(arr.shape[0])
+        self.count += n
+        dt = _dtype_name(arr.dtype)
+        self.dtypes[dt] = self.dtypes.get(dt, 0) + n
+        shape_key = "x".join(str(d) for d in arr.shape[1:])
+        self.shapes[shape_key] = self.shapes.get(shape_key, 0) + n
+        self.element_count += int(arr.size)
+        if arr.dtype.kind == "f":
+            self.nan_count += int(np.isnan(arr).sum())
+
+    def _observe_cells(self, values) -> None:
+        """Per-cell fallback for list columns (the batch plane's own
+        fallback representation for strings/Decimals/user codecs): ndarray
+        cells profile as ``ndarray``, everything else as ``object``."""
+        cells = list(values)
+        probe = next((v for v in cells if v is not None), None)
+        if isinstance(probe, np.ndarray):
+            self._set_kind("ndarray")
+            self.count += len(cells)
+            for cell in cells:  # rowloop-ok: ragged object column, already per-cell upstream
+                if cell is None:
+                    self.null_count += 1
+                    continue
+                dt = str(cell.dtype)
+                self.dtypes[dt] = self.dtypes.get(dt, 0) + 1
+                key = "x".join(str(d) for d in cell.shape)
+                self.shapes[key] = self.shapes.get(key, 0) + 1
+                self.element_count += int(cell.size)
+                if cell.dtype.kind == "f":
+                    self.nan_count += int(np.isnan(cell).sum())
+            return
+        self._set_kind("object")
+        self.count += len(cells)
+        nulls = sum(1 for v in cells if v is None)
+        self.null_count += nulls
+        if self.sketch is None:
+            self.sketch = KMVSketch(self._sketch_k)
+        self.sketch.update_objects(cells)
+
+    # ------------------------------------------------------------- readout
+    @property
+    def null_rate(self) -> float:
+        return self.null_count / self.count if self.count else 0.0
+
+    @property
+    def nan_fraction(self) -> float:
+        return (self.nan_count / self.element_count
+                if self.element_count else 0.0)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return (self._mean if (self.kind == "numeric"
+                               and self._num_valid > 0) else None)
+
+    @property
+    def std(self) -> Optional[float]:
+        if self.kind != "numeric" or self._num_valid <= 1:
+            return None
+        return float(np.sqrt(self._m2 / self._num_valid))
+
+    def distinct_estimate(self) -> Optional[float]:
+        return None if self.sketch is None else round(
+            self.sketch.estimate(), 1)
+
+    # ------------------------------------------------------ merge / codec
+    def merge(self, other: "ColumnProfile") -> None:
+        """Fold another host's partial profile in (federation). Histograms
+        with different edges cannot merge — the histogram is dropped with
+        a ``hist_dropped`` dtype marker instead of failing the rollup."""
+        if other.count == 0:
+            return
+        if self.kind is None:
+            self.kind = other.kind
+        a_valid = self._num_valid
+        b_valid = other._num_valid
+        self.count += other.count
+        self.null_count += other.null_count
+        for d, n in other.dtypes.items():
+            self.dtypes[d] = self.dtypes.get(d, 0) + n
+        for s, n in other.shapes.items():
+            self.shapes[s] = self.shapes.get(s, 0) + n
+        self.nan_count += other.nan_count
+        self.element_count += other.element_count
+        if other.min is not None:
+            self.min = other.min if self.min is None \
+                else min(self.min, other.min)
+        if other.max is not None:
+            self.max = other.max if self.max is None \
+                else max(self.max, other.max)
+        if b_valid > 0 and other.kind == "numeric":
+            if a_valid <= 0:
+                self._mean, self._m2 = other._mean, other._m2
+            else:
+                delta = other._mean - self._mean
+                tot = a_valid + b_valid
+                self._mean += delta * b_valid / tot
+                self._m2 += other._m2 \
+                    + delta * delta * a_valid * b_valid / tot
+            self._num_valid = a_valid + b_valid
+        if other.hist is not None:
+            if self.hist is None:
+                self._edges = other.hist.bounds
+                self.hist = StreamingHistogram(self._edges)
+            try:
+                self.hist.merge(other.hist)
+            except ValueError:
+                self.dtypes["hist_dropped"] = \
+                    self.dtypes.get("hist_dropped", 0) + 1
+        if other.sketch is not None:
+            if self.sketch is None:
+                self.sketch = KMVSketch(other.sketch.k)
+            try:
+                self.sketch.merge(other.sketch)
+            except ValueError:
+                pass  # mismatched k: keep the local estimate
+        return
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name, "kind": self.kind, "count": self.count,
+            "null_count": self.null_count,
+            "null_rate": round(self.null_rate, 6),
+        }
+        if self.kind == "numeric":
+            d.update({
+                "min": self.min, "max": self.max,
+                "mean": (round(self.mean, 9)
+                         if self.mean is not None else None),
+                "std": (round(self.std, 9)
+                        if self.std is not None else None),
+                "m2": round(self._m2, 9),
+                "num_valid": self._num_valid,
+                "distinct_estimate": self.distinct_estimate(),
+                "dtypes": dict(self.dtypes),
+            })
+            if self.hist is not None:
+                d["histogram"] = {"edges": self.hist.bounds,
+                                  "counts": self.hist.raw_counts()}
+            if self.sketch is not None:
+                d["sketch"] = self.sketch.to_dict()
+        elif self.kind == "ndarray":
+            d.update({
+                "dtypes": dict(self.dtypes), "shapes": dict(self.shapes),
+                "nan_fraction": round(self.nan_fraction, 9),
+                "nan_count": self.nan_count,
+                "element_count": self.element_count,
+            })
+        else:
+            d["distinct_estimate"] = self.distinct_estimate()
+            if self.sketch is not None:
+                d["sketch"] = self.sketch.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ColumnProfile":
+        p = cls(d["name"])
+        p.kind = d.get("kind")
+        p.count = int(d.get("count", 0))
+        p.null_count = int(d.get("null_count", 0))
+        p.min = d.get("min")
+        p.max = d.get("max")
+        if d.get("mean") is not None:
+            p._mean = float(d["mean"])
+        p._m2 = float(d.get("m2", 0.0))
+        p._num_valid = int(d.get("num_valid",
+                                 max(0, p.count - p.null_count)))
+        p.dtypes = dict(d.get("dtypes", {}))
+        p.shapes = dict(d.get("shapes", {}))
+        p.nan_count = int(d.get("nan_count", 0))
+        p.element_count = int(d.get("element_count", 0))
+        hist = d.get("histogram")
+        if hist:
+            p._edges = list(hist["edges"])
+            p.hist = StreamingHistogram(p._edges)
+            counts = list(hist["counts"])
+            # Rebuild the bucket state directly: counts land at bucket
+            # midpoints only for sum/min/max purposes, which a restored
+            # REFERENCE never reads (drift scoring reads raw counts).
+            p.hist._counts = [int(c) for c in counts]
+            p.hist._count = int(sum(counts))
+        sk = d.get("sketch")
+        if sk:
+            p.sketch = KMVSketch.from_dict(sk)
+        return p
+
+
+class DatasetProfile:
+    """One profile per column + dataset-level counters; the thread-safe
+    aggregation point the :class:`~petastorm_tpu.quality.monitor.
+    QualityMonitor` feeds."""
+
+    def __init__(self, buckets: int = 24, sketch_k: int = 256,
+                 columns: Optional[Sequence[str]] = None,
+                 max_columns: int = 64,
+                 edge_seed: Optional[Dict[str, Sequence[float]]] = None):
+        self._buckets = int(buckets)
+        self._sketch_k = int(sketch_k)
+        self._restrict = set(columns) if columns else None
+        self._max_columns = int(max_columns)
+        #: ``{column: [edges...]}`` fixing histogram edges before the first
+        #: observation (reference adoption / ColumnStats seeding).
+        self._edge_seed = dict(edge_seed or {})
+        self._lock = threading.Lock()
+        self.columns: Dict[str, ColumnProfile] = {}
+        self.rows = 0
+        self.units = 0
+        #: Bumped on every observation — cheap staleness key for cached
+        #: drift scores.
+        self.version = 0
+
+    # ------------------------------------------------------------- feeding
+    def observe_columns(self, columns: Dict[str, object],
+                        num_rows: int) -> None:
+        """One delivered unit: fold every (tracked) column in — one
+        vectorized pass per column."""
+        with self._lock:
+            self.rows += int(num_rows)
+            self.units += 1
+            self.version += 1
+            for name, values in columns.items():
+                if self._restrict is not None and name not in self._restrict:
+                    continue
+                prof = self.columns.get(name)
+                if prof is None:
+                    if len(self.columns) >= self._max_columns:
+                        continue
+                    prof = self.columns[name] = ColumnProfile(
+                        name, buckets=self._buckets,
+                        sketch_k=self._sketch_k,
+                        edges=self._edge_seed.get(name))
+                try:
+                    prof.observe(values)
+                except (TypeError, ValueError):
+                    # A cell type the profiler cannot vectorize must never
+                    # kill delivery; tally it and move on.
+                    prof.dtypes["unprofiled"] = \
+                        prof.dtypes.get("unprofiled", 0) + 1
+
+    def merge(self, other: "DatasetProfile") -> None:
+        with self._lock:
+            self.rows += other.rows
+            self.units += other.units
+            self.version += 1
+            for name, prof in other.columns.items():
+                mine = self.columns.get(name)
+                if mine is None:
+                    if len(self.columns) >= self._max_columns:
+                        continue
+                    mine = self.columns[name] = ColumnProfile(
+                        name, buckets=self._buckets,
+                        sketch_k=self._sketch_k)
+                mine.merge(prof)
+
+    # ------------------------------------------------------------- readout
+    def column(self, name: str) -> Optional[ColumnProfile]:
+        with self._lock:
+            return self.columns.get(name)
+
+    def columns_snapshot(self) -> Dict[str, ColumnProfile]:
+        """A consistent shallow copy of the column map, taken under the
+        profile lock — what the drift scorers iterate. Reading a LIVE
+        profile's dict directly races the consumer thread's column
+        insertion (dictionary-changed-size mid-iteration on the timeline
+        sampler's gauge reads)."""
+        with self._lock:
+            return dict(self.columns)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            cols = {name: prof.to_dict()
+                    for name, prof in sorted(self.columns.items())}
+            return {"schema_version": PROFILE_SCHEMA_VERSION,
+                    "rows": self.rows, "units": self.units,
+                    "columns": cols}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DatasetProfile":
+        p = cls()
+        p.rows = int(d.get("rows", 0))
+        p.units = int(d.get("units", 0))
+        for name, cd in d.get("columns", {}).items():
+            p.columns[name] = ColumnProfile.from_dict(dict(cd, name=name))
+        return p
+
+    def edge_map(self) -> Dict[str, List[float]]:
+        """``{column: histogram edges}`` for every numeric column that has
+        a histogram — what a CURRENT profile adopts from a reference so
+        PSI compares identical buckets."""
+        with self._lock:
+            return {name: prof.hist.bounds
+                    for name, prof in self.columns.items()
+                    if prof.hist is not None}
+
+
+def save_profile(profile: DatasetProfile, path: str) -> None:
+    """Persist a profile as the JSON reference a later run diffs against
+    (``make_reader(reference_profile=path)``)."""
+    with open(path, "w") as f:
+        json.dump(profile.to_dict(), f, indent=2, sort_keys=True)
+
+
+def load_profile(source) -> DatasetProfile:
+    """Resolve a ``reference_profile=`` argument: a
+    :class:`DatasetProfile`, a profile dict, or a path to a JSON file
+    written by :func:`save_profile` (or extracted from
+    ``Reader.quality_report()["profile"]``)."""
+    if isinstance(source, DatasetProfile):
+        return source
+    if isinstance(source, dict):
+        return DatasetProfile.from_dict(source)
+    with open(source) as f:
+        return DatasetProfile.from_dict(json.load(f))

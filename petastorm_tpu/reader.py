@@ -381,7 +381,10 @@ def make_reader(dataset_url,
                 shuffle_window: int = 0,
                 refresh_interval_s: Optional[float] = None,
                 timeline_interval_s: Optional[float] = None,
-                timeline_anomaly: bool = True):
+                timeline_anomaly: bool = True,
+                quality: bool = False,
+                quality_config=None,
+                reference_profile=None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -562,6 +565,28 @@ def make_reader(dataset_url,
         a detection's entry edge. ``False`` keeps the ring without the
         detectors (the right setting for sub-feeds whose local rates
         legitimately gap, e.g. mesh host readers).
+    :param quality: **data-quality plane** (docs/observability.md "Data
+        quality plane"): attach a :class:`~petastorm_tpu.quality.
+        QualityMonitor` — streaming per-column profiles (count/null-rate/
+        min-max/moments, fixed-bucket histogram, distinct sketch; ndarray
+        columns profile shape/dtype/NaN-fraction) updated in one
+        vectorized pass per delivered unit, PSI/chi-square drift scoring
+        against ``reference_profile`` surfaced as ``quality.drift.{col}``
+        gauges + ``quality.max_drift`` (SLO-gateable), and an epoch
+        **coverage auditor** (exact per-ordinal with
+        ``sample_order='deterministic'``; unit counts otherwise). With
+        live discovery, newly admitted files are scored against the
+        reference from their footer statistics *before* their bytes join
+        an epoch. Read via :meth:`Reader.quality_report`.
+    :param quality_config: a :class:`~petastorm_tpu.quality.QualityConfig`
+        overriding bucket counts, tracked columns, drift thresholds, and
+        the admission action (implies ``quality=True``).
+    :param reference_profile: the drift baseline — a path to a JSON
+        profile written by :func:`petastorm_tpu.quality.save_profile`, a
+        profile dict, or a :class:`~petastorm_tpu.quality.DatasetProfile`
+        (implies ``quality=True``). Without it the live profile is still
+        built (and becomes the admission baseline); drift scores need the
+        reference.
 
     Parity: reference reader.py:60.
     """
@@ -642,7 +667,10 @@ def make_reader(dataset_url,
                   shuffle_window=shuffle_window,
                   refresh_interval_s=refresh_interval_s,
                   timeline_interval_s=timeline_interval_s,
-                  timeline_anomaly=timeline_anomaly)
+                  timeline_anomaly=timeline_anomaly,
+                  quality=quality,
+                  quality_config=quality_config,
+                  reference_profile=reference_profile)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -694,7 +722,10 @@ def make_batch_reader(dataset_url_or_urls,
                       shuffle_window: int = 0,
                       refresh_interval_s: Optional[float] = None,
                       timeline_interval_s: Optional[float] = None,
-                      timeline_anomaly: bool = True):
+                      timeline_anomaly: bool = True,
+                      quality: bool = False,
+                      quality_config=None,
+                      reference_profile=None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -744,6 +775,11 @@ def make_batch_reader(dataset_url_or_urls,
     exactly as in :func:`make_reader` (docs/live_data.md) — plain Parquet
     stores that other producers append to are the primary live-data
     shape.
+    ``quality`` / ``quality_config`` / ``reference_profile`` attach the
+    data-quality plane exactly as in :func:`make_reader`
+    (docs/observability.md "Data quality plane") — batched readers
+    profile the delivered column dicts directly, so this is the
+    zero-overhead-iest surface for it.
     Parity: reference reader.py:209.
     """
     _warn_compat_kwargs(hdfs_driver, False)
@@ -828,7 +864,10 @@ def make_batch_reader(dataset_url_or_urls,
                   shuffle_window=shuffle_window,
                   refresh_interval_s=refresh_interval_s,
                   timeline_interval_s=timeline_interval_s,
-                  timeline_anomaly=timeline_anomaly)
+                  timeline_anomaly=timeline_anomaly,
+                  quality=quality,
+                  quality_config=quality_config,
+                  reference_profile=reference_profile)
 
 
 class Reader:
@@ -852,7 +891,8 @@ class Reader:
                  rowgroup_subset=None, row_materialization="eager",
                  sample_order="free", shuffle_window=0,
                  refresh_interval_s=None, timeline_interval_s=None,
-                 timeline_anomaly=True):
+                 timeline_anomaly=True, quality=False, quality_config=None,
+                 reference_profile=None):
         self._ctx = ctx
         self._pool = pool
         self.is_batched_reader = is_batched_reader
@@ -1018,6 +1058,10 @@ class Reader:
         #: Plan-time pruning provenance — filled by the selector pass and
         #: the statistics pruner below; see :meth:`pruning_report`.
         self._pruning_report = {"enabled": False}
+        #: Per-column aggregate of the footer ColumnStats the pruning scan
+        #: harvests (retained instead of dropped — the quality plane's
+        #: zero-IO seed; see :meth:`_fold_plan_column_stats`).
+        self._plan_column_stats: dict = {}
         self._subset_kept_ordinals = None
         resume_manifest = (resume_state.get("manifest")
                            if isinstance(resume_state, dict) else None)
@@ -1266,6 +1310,36 @@ class Reader:
             # per-attempt enforcement.
             self._pool.stage_deadline = stage_deadline
 
+        # ---------------- data-quality plane (docs/observability.md
+        # "Data quality plane"): streaming column profiles + drift scoring
+        # + coverage auditing. Observation happens at the consumer
+        # delivery point (the results readers below) — pool-agnostic and
+        # migration-safe; the coverage ledger attaches to the ordered
+        # gate (exact per-ordinal audit) or counts units in free mode.
+        #: :class:`~petastorm_tpu.quality.QualityMonitor` when the plane
+        #: is enabled (``quality=`` / ``quality_config=`` /
+        #: ``reference_profile=``), else None.
+        self.quality_monitor = None
+        if quality or quality_config is not None \
+                or reference_profile is not None:
+            from petastorm_tpu.quality import QualityConfig, QualityMonitor
+            if quality_config is not None \
+                    and not isinstance(quality_config, QualityConfig):
+                raise TypeError(
+                    f"quality_config must be a petastorm_tpu.quality."
+                    f"QualityConfig (or None), got "
+                    f"{type(quality_config).__name__}")
+            if self.ngram is not None:
+                warnings.warn(
+                    "quality profiling does not apply to NGram readers "
+                    "(windows are views over rows other units profile); "
+                    "unit counters still run, column profiles stay empty")
+            self.quality_monitor = QualityMonitor(
+                quality_config, telemetry=self.telemetry,
+                reference=reference_profile,
+                stats_seed=self._plan_column_stats)
+            self.telemetry.quality = self._quality_payload
+
         # ---------------- live discovery wiring (docs/live_data.md)
         if refresh_interval_s is not None:
             from petastorm_tpu.discovery import DatasetWatcher
@@ -1287,12 +1361,19 @@ class Reader:
                               f"dataset's Arrow schema ({e!r}); appended "
                               f"files will be admitted without schema-"
                               f"drift classification")
-            stats_cols = ()
+            stats_cols = set()
             if rowgroup_pruning and predicate is not None \
                     and hasattr(predicate, "intervals"):
                 constraints = predicate.intervals()
                 if constraints:
-                    stats_cols = sorted({f for f, _ in constraints})
+                    stats_cols = {f for f, _ in constraints}
+            if self.quality_monitor is not None:
+                # Admission scoring reads the SAME validation footer the
+                # watcher already parses: harvest stats for every planned
+                # column so a new file can be scored against the
+                # reference at zero extra IO (docs/observability.md
+                # "Data quality plane").
+                stats_cols |= set(view_schema.fields.keys())
             self._discovery = DatasetWatcher(
                 ctx, base_snapshot=watch_snapshot,
                 reference_schema=reference_schema,
@@ -1302,7 +1383,11 @@ class Reader:
                 deadline=(stage_deadline if stage_deadline is not None
                           else DEFAULT_LIST_DEADLINE),
                 fault_plan=fault_plan, telemetry=self.telemetry,
-                quarantine=self.quarantine, stats_columns=stats_cols)
+                quarantine=self.quarantine,
+                stats_columns=sorted(stats_cols),
+                quality_scorer=(
+                    None if self.quality_monitor is None
+                    else self.quality_monitor.score_admitted_file))
             if refresh_interval_s > 0:
                 self._discovery.start()
 
@@ -1343,6 +1428,11 @@ class Reader:
             # Deterministic plane: workers publish one OrderedUnit envelope
             # per work item (docs/determinism.md).
             "sample_order": sample_order,
+            # Data-quality plane: in-process workers publish predicate
+            # selectivity telemetry (quality.predicate.*) when enabled —
+            # the one quality signal only the workers can see (rows the
+            # mask dropped never reach the consumer).
+            "quality": self.quality_monitor is not None,
         }
         worker_args = (self._spawnable_worker_args()
                        if isinstance(self._pool, ProcessPool)
@@ -1448,13 +1538,30 @@ class Reader:
                                          window=shuffle_window,
                                          growth=(growth_segments[1:]
                                                  if growth_segments else ()))
+            quality_ledger = None
+            if self.quality_monitor is not None:
+                # Exact per-ordinal coverage audit: the gate accounts
+                # every plan position as delivered/empty/skip and every
+                # dropped duplicate (docs/observability.md "Data quality
+                # plane").
+                from petastorm_tpu.quality import CoverageLedger
+                quality_ledger = CoverageLedger(plan=self._epoch_plan,
+                                                telemetry=self.telemetry)
+                self.quality_monitor.ledger = quality_ledger
             self._gate = OrderedDeliveryGate(
                 self._epoch_plan, start_epoch=start_epoch,
                 start_offset=start_offset,
                 window_delivered=resume_window_k, skipped=resume_skips,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry, ledger=quality_ledger)
             self.telemetry.gauge("order.buffer_depth",
                                  lambda: self._gate.buffered_count)
+        elif self.quality_monitor is not None:
+            # Free order: no consumer-side ordinals — unit-count audit
+            # (a lower bound that still catches silent truncation).
+            from petastorm_tpu.quality import CoverageLedger
+            self.quality_monitor.ledger = CoverageLedger(
+                num_items=self._num_items, num_epochs=num_epochs,
+                telemetry=self.telemetry)
         self._ventilator = ConcurrentVentilator(
             self._make_ventilate_fn(self._pool), items,
             iterations=num_epochs,
@@ -1584,13 +1691,15 @@ class Reader:
             self._results_reader = _BatchResultsReader(self._pool, self.schema,
                                                        telemetry=self.telemetry,
                                                        watchdog=self.watchdog,
-                                                       gate=self._gate)
+                                                       gate=self._gate,
+                                                       quality=self.quality_monitor)
         else:
             self._results_reader = _RowResultsReader(self._pool, self.schema,
                                                      self.ngram,
                                                      telemetry=self.telemetry,
                                                      watchdog=self.watchdog,
-                                                     gate=self._gate)
+                                                     gate=self._gate,
+                                                     quality=self.quality_monitor)
 
         export_path = os.environ.get(TELEMETRY_EXPORT_ENV)
         if export_path:
@@ -1626,6 +1735,10 @@ class Reader:
             self.blackbox.add_collector("slo", self.slo_report)
             self.blackbox.add_collector("anomaly", self.anomaly_report)
             self.blackbox.add_collector("watchdog", self.watchdog_report)
+            if self.quality_monitor is not None:
+                # A dead run's bundle shows what the DATA looked like when
+                # it died: profiles, drift scores, coverage manifests.
+                self.blackbox.add_collector("quality", self.quality_report)
             if self.watchdog is not None:
                 self.watchdog.on_abort = (
                     lambda err: self.blackbox.write_bundle("watchdog_abort",
@@ -1832,6 +1945,13 @@ class Reader:
         from petastorm_tpu.etl.dataset_metadata import load_row_group_stats
         stats = load_row_group_stats(self._ctx, row_groups, fields,
                                      telemetry=self.telemetry)
+        # Retain the harvested per-group statistics as per-column
+        # aggregates (satellite of the data-quality plane,
+        # docs/observability.md): the SAME footer scan that prunes also
+        # seeds the quality plane's reference bounds and histogram edges —
+        # zero extra IO. Exposed in pruning_report()["column_stats"].
+        self._fold_plan_column_stats(stats.values())
+        report["column_stats"] = dict(self._plan_column_stats)
         kept, pruned_per_file = self._prune_with_stats(row_groups,
                                                        constraints, stats)
         pruned = len(row_groups) - len(kept)
@@ -1844,6 +1964,37 @@ class Reader:
             logger.debug("Statistics pruning dropped %d/%d row groups "
                          "(fields: %s)", pruned, len(row_groups), fields)
         return kept
+
+    def _fold_plan_column_stats(self, per_group_stats) -> None:
+        """Fold harvested per-row-group ``{column: ColumnStats}`` dicts
+        into the plan-level per-column aggregate
+        (``self._plan_column_stats``): min of mins, max of maxes, summed
+        null/row counts. Previously these were dropped after pruning;
+        retaining them costs nothing and gives the quality plane its
+        zero-IO reference seed (docs/observability.md "Data quality
+        plane")."""
+        agg = self._plan_column_stats
+        for group in per_group_stats:
+            for name, st in group.items():
+                rec = agg.get(name)
+                if rec is None:
+                    rec = agg[name] = {"min": None, "max": None,
+                                       "null_count": 0, "num_rows": 0,
+                                       "groups": 0}
+                rec["groups"] += 1
+                if st.num_rows is not None:
+                    rec["num_rows"] += int(st.num_rows)
+                if st.null_count is not None:
+                    rec["null_count"] += int(st.null_count)
+                if getattr(st, "has_min_max", False):
+                    try:
+                        lo, hi = float(st.min), float(st.max)
+                    except (TypeError, ValueError):
+                        continue  # non-numeric bounds stay unaggregated
+                    rec["min"] = lo if rec["min"] is None \
+                        else min(rec["min"], lo)
+                    rec["max"] = hi if rec["max"] is None \
+                        else max(rec["max"], hi)
 
     @staticmethod
     def _prune_with_stats(row_groups, constraints, stats):
@@ -1920,6 +2071,10 @@ class Reader:
                 stats = (stats_by_key if have_stats
                          else load_row_group_stats(self._ctx, kept, fields,
                                                    telemetry=self.telemetry))
+                self._fold_plan_column_stats(stats.values())
+                if self._pruning_report.get("enabled"):
+                    self._pruning_report["column_stats"] = \
+                        dict(self._plan_column_stats)
                 kept2, pruned_per_file = self._prune_with_stats(
                     kept, constraints, stats)
                 pruned = len(kept) - len(kept2)
@@ -2424,6 +2579,11 @@ class Reader:
             # Another pass replays the exact same canonical order from the
             # stream's origin (the ventilator reset restarts at epoch 0).
             self._gate.reset()
+        elif self.quality_monitor is not None \
+                and self.quality_monitor.ledger is not None:
+            # Count-mode coverage audits ONE pass (the gate reset covers
+            # the ordinal ledger).
+            self.quality_monitor.ledger.reset()
         self.last_row_consumed = False
 
     # ------------------------------------------------------------- lifetime
@@ -2544,6 +2704,25 @@ class Reader:
         is off (the detectors run over timeline windows)."""
         return ({} if self.anomaly_monitor is None
                 else self.anomaly_monitor.report())
+
+    def quality_report(self) -> dict:
+        """Data-quality plane readout (docs/observability.md "Data
+        quality plane"): the streaming column profiles, drift scores
+        against the reference profile, live-admission scoring, and the
+        epoch coverage manifests. Empty dict when the plane is off
+        (``quality=`` / ``quality_config=`` / ``reference_profile=``)."""
+        if self.quality_monitor is None:
+            return {}
+        return self.quality_monitor.report(
+            quarantine_count=len(self.quarantine))
+
+    def _quality_payload(self):
+        """Registry snapshot attachment (never raises; see
+        ``TelemetryRegistry.quality``)."""
+        try:
+            return self.quality_report() or None
+        except Exception:  # noqa: BLE001 - snapshots must not die on a report
+            return None
 
     # ------------------------------------------------------ explain plane
     def _explain_signature(self) -> tuple:
@@ -2764,10 +2943,14 @@ class _RowResultsReader(_PoolWaitTimer):
     batch-granular accounting instead of a locked add per row."""
 
     def __init__(self, pool, schema, ngram, telemetry=None, watchdog=None,
-                 gate=None):
+                 gate=None, quality=None):
         super().__init__(pool, telemetry, watchdog=watchdog, gate=gate)
         self._schema = schema
         self._ngram = ngram
+        # Data-quality plane (docs/observability.md): payloads are
+        # profiled HERE — the consumer delivery point — one vectorized
+        # pass per column per unit, pool-agnostic and migration-safe.
+        self._quality = quality
         self._buffer = deque()
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)
@@ -2795,6 +2978,8 @@ class _RowResultsReader(_PoolWaitTimer):
         self._batch = batch
         self._batch_cols = [batch.columns.get(name) for name in tt._fields]
         self._batch_pos = 0
+        if self._quality is not None:
+            self._quality.observe_columns(batch.columns, batch.num_rows)
         if self._rows is not None:
             self._rows.add(batch.num_rows)
         if self._telemetry_reg is not None:
@@ -2841,6 +3026,8 @@ class _RowResultsReader(_PoolWaitTimer):
                 if result.num_rows:
                     self._adopt(result)
             else:
+                if self._quality is not None:
+                    self._quality.observe_rows(result)
                 self._buffer.extend(result)
 
     def read_next_batch(self):
@@ -2859,6 +3046,8 @@ class _RowResultsReader(_PoolWaitTimer):
                 if result.num_rows:
                     self._adopt(result)
             elif result:
+                if self._quality is not None:
+                    self._quality.observe_rows(result)
                 self._buffer.extend(result)
 
 
@@ -2867,9 +3056,10 @@ class _BatchResultsReader(_PoolWaitTimer):
     (parity: arrow_reader_worker.py:89-111, batched_output=True)."""
 
     def __init__(self, pool, schema, telemetry=None, watchdog=None,
-                 gate=None):
+                 gate=None, quality=None):
         super().__init__(pool, telemetry, watchdog=watchdog, gate=gate)
         self._schema = schema
+        self._quality = quality
         self._rows = (telemetry.counter("reader.rows")
                       if telemetry is not None else None)
         self._telemetry_reg = telemetry
@@ -2883,8 +3073,14 @@ class _BatchResultsReader(_PoolWaitTimer):
             # dicts when converting early (incl. the process pool's shm
             # result_transform path).
             result = arrow_table_to_numpy_dict(result, self._schema)
-        if self._rows is not None and result:
-            self._rows.add(len(next(iter(result.values()))))
+        if result:
+            n = len(next(iter(result.values())))
+            if self._quality is not None:
+                # One vectorized profile pass per column per row group
+                # (docs/observability.md "Data quality plane").
+                self._quality.observe_columns(result, n)
+            if self._rows is not None:
+                self._rows.add(n)
         return result
 
     def read_next(self):

@@ -52,7 +52,27 @@ class BatchReaderWorker(WorkerBase):
         # Deterministic epoch plane (docs/determinism.md): one OrderedUnit
         # envelope per work item, exactly as in RowReaderWorker.
         self._ordered = args.get("sample_order", "free") == "deterministic"
+        # Data-quality plane (docs/observability.md "Data quality plane"):
+        # predicate selectivity counters, as in RowReaderWorker — masked
+        # rows never reach the consumer's profiler, so this is worker-only
+        # evidence (in-process pools share the registry; spawned workers
+        # have none).
+        self._quality_telemetry = (args.get("resilience_telemetry")
+                                   if args.get("quality") else None)
+        self._q_rows_in = None
+        self._q_rows_kept = None
         _init_latency_defense(self, args)
+
+    def _record_predicate_selectivity(self, rows_in: int,
+                                      rows_kept: int) -> None:
+        t = self._quality_telemetry
+        if t is None:
+            return
+        if self._q_rows_in is None:
+            self._q_rows_in = t.counter("quality.predicate.rows_in")
+            self._q_rows_kept = t.counter("quality.predicate.rows_kept")
+        self._q_rows_in.add(rows_in)
+        self._q_rows_kept.add(rows_kept)
 
     def _ensure_open(self):
         if self._ctx is None:
@@ -216,6 +236,8 @@ class BatchReaderWorker(WorkerBase):
             pred_fields = sorted(predicate.get_fields())
             pred_table = self._read_table(rowgroup, set(pred_fields))
             mask = self._predicate_mask(pred_table, predicate)
+            self._record_predicate_selectivity(pred_table.num_rows,
+                                               int(mask.sum()))
             if not mask.any():
                 return None
             rest = needed - set(pred_fields)

@@ -274,8 +274,17 @@ class OrderedDeliveryGate:
 
     def __init__(self, plan: EpochPlan, start_epoch: int = 0,
                  start_offset: int = 0, window_delivered: int = 0,
-                 skipped: Iterable[int] = (), telemetry=None):
+                 skipped: Iterable[int] = (), telemetry=None, ledger=None):
         self._plan = plan
+        #: Optional :class:`~petastorm_tpu.quality.coverage.CoverageLedger`
+        #: — the data-quality plane's per-epoch delivery audit
+        #: (docs/observability.md "Data quality plane"): every watermark
+        #: advance is accounted as delivered/empty/skip, every dropped
+        #: duplicate recorded, so the epoch's coverage manifest proves
+        #: exactly-once delivery over the plan.
+        self._ledger = ledger
+        if ledger is not None and (start_epoch or start_offset):
+            ledger.mark_resumed(start_epoch, start_offset)
         self._c = plan.consumed_from_cursor(start_epoch, start_offset,
                                             window_delivered)
         #: Consumption slot at entry of the pull that produced the most
@@ -319,14 +328,20 @@ class OrderedDeliveryGate:
             if needed in self._skips:
                 self._skips.discard(needed)
                 self._advance(needed)
+                if self._ledger is not None:
+                    self._ledger.record("skip", needed)
                 continue
             unit = self._buffered.pop(needed, None)
             if unit is _EMPTY:
                 self._advance(needed)
+                if self._ledger is not None:
+                    self._ledger.record("empty", needed)
                 continue
             if unit is not None:
                 self._advance(needed)
                 self._c_entry = c_entry
+                if self._ledger is not None:
+                    self._ledger.record("delivered", needed)
                 return unit
             try:
                 result = fetch()
@@ -368,6 +383,8 @@ class OrderedDeliveryGate:
         self._skips.clear()
         self._skip_log.clear()
         self._consumed_in_block.clear()
+        if self._ledger is not None:
+            self._ledger.reset()
 
     # ---------------------------------------------------------- internals
     def _advance(self, consumed_linear: int) -> None:
@@ -412,6 +429,8 @@ class OrderedDeliveryGate:
             # resume re-reading already-delivered window members).
             if self._c_dups is not None:
                 self._c_dups.add(1)
+            if self._ledger is not None:
+                self._ledger.record("duplicate", linear)
             return
         if result.kind == "empty" or result.payload is None:
             # (payload None guards the buffered-vs-missing distinction in

@@ -440,7 +440,27 @@ class RowReaderWorker(WorkerBase):
         # so the consumer-side reorder gate can account for every plan
         # position regardless of completion order.
         self._ordered = args.get("sample_order", "free") == "deterministic"
+        # Data-quality plane (docs/observability.md "Data quality plane"):
+        # predicate selectivity is the one quality signal only the worker
+        # can see — masked-out rows never reach the consumer's profiler.
+        # In-process pools share the pipeline registry; spawned workers
+        # have none (their selectivity is invisible, documented).
+        self._quality_telemetry = (args.get("resilience_telemetry")
+                                   if args.get("quality") else None)
+        self._q_rows_in = None
+        self._q_rows_kept = None
         _init_latency_defense(self, args)
+
+    def _record_predicate_selectivity(self, rows_in: int,
+                                      rows_kept: int) -> None:
+        t = self._quality_telemetry
+        if t is None:
+            return
+        if self._q_rows_in is None:
+            self._q_rows_in = t.counter("quality.predicate.rows_in")
+            self._q_rows_kept = t.counter("quality.predicate.rows_kept")
+        self._q_rows_in.add(rows_in)
+        self._q_rows_kept.add(rows_kept)
 
     # Lazily build per-process handles (cheap for threads, required for processes).
     def _ensure_open(self):
@@ -849,6 +869,7 @@ class RowReaderWorker(WorkerBase):
                        if k not in pred_schema.fields}
         mask = evaluate_predicate_mask(predicate,
                                        {**passthrough, **decoded}, num_rows)
+        self._record_predicate_selectivity(num_rows, int(mask.sum()))
         if not mask.any():
             return pred_data, []
 

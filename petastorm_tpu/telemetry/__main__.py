@@ -190,7 +190,8 @@ def _render_top(snap: dict, series_filter=None) -> str:
     for name, label in (("loader.input_stall_pct", "stall%"),
                         ("ventilator.backlog", "backlog"),
                         ("discovery.ingest_lag_s", "ingest_lag_s"),
-                        ("mesh.host_skew_s", "skew_s")):
+                        ("mesh.host_skew_s", "skew_s"),
+                        ("quality.max_drift", "max_drift")):
         value = gauges.get(name)
         if value is not None:
             head.append(f"{label}={value:.6g}")
@@ -349,6 +350,137 @@ def _cmd_explain(args) -> int:
         print(render_mesh_rollup(specs[0]))
     else:
         print(render_spec_dict(specs[0]))
+    return 0
+
+
+def _load_reference_profile(path: str):
+    """A ``--diff`` reference: a profile JSON written by
+    ``petastorm_tpu.quality.save_profile``, OR any telemetry snapshot /
+    quality payload that embeds one."""
+    from petastorm_tpu.quality import DatasetProfile
+    data = _load(path)
+    if "columns" in data and "rows" in data:           # bare profile file
+        return DatasetProfile.from_dict(data)
+    payload = data.get("quality") or data               # snapshot / payload
+    profile = payload.get("profile")
+    if profile:
+        return DatasetProfile.from_dict(profile)
+    raise ValueError(f"{path} holds neither a profile nor a snapshot with "
+                     f"an embedded quality payload")
+
+
+def _render_quality(payload: dict, drift: dict = None) -> str:
+    lines = [f"data quality — rows={payload.get('rows_observed', '?')}  "
+             f"units={payload.get('units_observed', '?')}  "
+             f"columns={payload.get('columns_tracked', '?')}"]
+    columns = (payload.get("profile") or {}).get("columns", {})
+    if columns:
+        lines.append(f"  {'column':<20} {'kind':<8} {'count':>9} "
+                     f"{'null%':>7} {'min':>12} {'max':>12} {'mean':>12} "
+                     f"{'distinct':>9}")
+        for name in sorted(columns):
+            c = columns[name]
+            def num(v, d="-"):
+                return d if v is None else f"{v:.6g}"
+            lines.append(
+                f"  {name:<20} {c.get('kind') or '?':<8} "
+                f"{c.get('count', 0):>9} "
+                f"{100.0 * c.get('null_rate', 0.0):>6.2f}% "
+                f"{num(c.get('min')):>12} {num(c.get('max')):>12} "
+                f"{num(c.get('mean')):>12} "
+                f"{num(c.get('distinct_estimate')):>9}")
+            if c.get("kind") == "ndarray":
+                lines.append(f"      shapes={c.get('shapes')} "
+                             f"dtypes={c.get('dtypes')} "
+                             f"nan_fraction={c.get('nan_fraction')}")
+    drift = drift if drift is not None else (payload.get("drift") or {})
+    cols = drift.get("columns") or {}
+    if cols:
+        ref = drift.get("reference")
+        lines.append(f"drift vs reference"
+                     + (f" ({ref})" if ref else "")
+                     + f": max={drift.get('max', 0.0)}")
+        for name in sorted(cols):
+            detail = dict(cols[name])
+            score = detail.pop("score", None)
+            kind = detail.pop("kind", "?")
+            flag = " !" if (score or 0) >= drift.get("threshold", 0.2) else ""
+            lines.append(f"  {name:<20} score={score} ({kind}) "
+                         f"{detail}{flag}")
+    elif drift.get("reference"):
+        lines.append(f"drift vs reference ({drift['reference']}): "
+                     f"no comparable columns yet")
+    admission = payload.get("admission")
+    if admission:
+        lines.append(f"live admission scoring: "
+                     f"max_score={admission.get('max_score')}")
+        for f in admission.get("files", [])[-8:]:
+            lines.append(f"  {f.get('verdict', '?'):<8} "
+                         f"score={f.get('score')}  {f.get('path')}")
+    coverage = payload.get("coverage")
+    if coverage:
+        lines.append(f"coverage audit ({coverage.get('mode')}):")
+        if coverage.get("mode") == "count":
+            lines.append(f"  units_delivered="
+                         f"{coverage.get('units_delivered')} "
+                         f"accounted={coverage.get('accounted')} "
+                         f"planned_per_epoch="
+                         f"{coverage.get('planned_per_epoch')} "
+                         f"complete={coverage.get('complete')}")
+        else:
+            for m in coverage.get("epochs", []):
+                lines.append(
+                    f"  epoch {m['epoch']}: planned={m['planned']} "
+                    f"delivered={m['delivered']} "
+                    f"skipped={len(m.get('skipped', []))} "
+                    f"dups_dropped={m.get('duplicates_dropped', 0)} "
+                    f"reconciled={m.get('reconciled')}")
+    return "\n".join(lines)
+
+
+def _cmd_quality(args) -> int:
+    """Render a snapshot's embedded data-quality payload; ``--diff REF``
+    re-scores its profile against a reference profile file
+    (docs/observability.md "Data quality plane")."""
+    try:
+        snap = _load(args.path)
+    except (OSError, ValueError) as e:
+        print(f"cannot read snapshot {args.path}: {e}", file=sys.stderr)
+        return 1
+    payload = snap.get("quality")
+    if not payload and "columns" in snap and "rows" in snap:
+        # A bare profile file renders as a profile-only payload.
+        payload = {"profile": snap, "rows_observed": snap.get("rows"),
+                   "units_observed": snap.get("units"),
+                   "columns_tracked": len(snap.get("columns", {}))}
+    if not payload:
+        # Mesh rollups embed quality under mesh_report()["quality"].
+        payload = (snap.get("mesh") or {}).get("quality")
+    if not payload:
+        print(f"no quality payload in {args.path}: run the pipeline with "
+              f"make_reader(quality=True) (docs/observability.md)",
+              file=sys.stderr)
+        return 1
+    drift = None
+    if args.diff:
+        from petastorm_tpu.quality import DatasetProfile, drift_scores
+        try:
+            ref = _load_reference_profile(args.diff)
+        except (OSError, ValueError) as e:
+            print(f"cannot read reference {args.diff}: {e}",
+                  file=sys.stderr)
+            return 1
+        profile = payload.get("profile")
+        if not profile:
+            print("the quality payload carries no profile to diff",
+                  file=sys.stderr)
+            return 1
+        scores = drift_scores(ref, DatasetProfile.from_dict(profile))
+        drift = {"reference": args.diff, "threshold": 0.2,
+                 "max": max((d["score"] for d in scores.values()),
+                            default=0.0),
+                 "columns": scores}
+    print(_render_quality(payload, drift))
     return 0
 
 
@@ -582,6 +714,17 @@ def main(argv=None) -> int:
                        help="diff two snapshots' operator graphs and "
                             "profiles")
 
+    q_p = sub.add_parser(
+        "quality", help="render a snapshot's data-quality payload "
+                        "(profiles, drift, coverage); --diff re-scores "
+                        "against a reference profile")
+    q_p.add_argument("path", help="snapshot file (or a profile JSON "
+                                  "written by quality.save_profile)")
+    q_p.add_argument("--diff", default=None,
+                     help="reference profile file (or snapshot with an "
+                          "embedded quality payload) to re-score the "
+                          "snapshot's profile against")
+
     pm_p = sub.add_parser(
         "postmortem", help="render a black-box bundle directory")
     pm_p.add_argument("bundle", help="bundle directory written by the "
@@ -622,6 +765,8 @@ def main(argv=None) -> int:
         return _cmd_timeline(args)
     if args.cmd == "explain":
         return _cmd_explain(args)
+    if args.cmd == "quality":
+        return _cmd_quality(args)
     if args.cmd == "postmortem":
         return _cmd_postmortem(args)
 

@@ -135,6 +135,14 @@ class TelemetryRegistry:
         #: files feed ``telemetry explain`` and black-box bundles carry
         #: operator-level provenance.
         self.explain = None
+        #: Optional data-quality provider (docs/observability.md "Data
+        #: quality plane"): a zero-arg callable returning the owning
+        #: pipeline's ``QualityMonitor.report()`` payload (or None). When
+        #: set, :meth:`snapshot` embeds it under ``"quality"`` so exported
+        #: files feed ``telemetry quality`` and black-box bundles carry
+        #: the column profiles / drift scores / coverage manifests the
+        #: run died with.
+        self.quality = None
         #: Stable identity for this registry's pipeline: multi-reader
         #: processes and federated merges need more than file-path stems
         #: to tell registries apart. Unique per construction (pid +
@@ -299,6 +307,14 @@ class TelemetryRegistry:
                 payload = None
             if payload is not None:
                 snap["explain"] = payload
+        quality_fn = self.quality
+        if quality_fn is not None:
+            try:
+                payload = quality_fn()
+            except Exception:  # noqa: BLE001 - a dead provider must not kill snapshots
+                payload = None
+            if payload is not None:
+                snap["quality"] = payload
         if include_trace and self.recorder.trace_enabled:
             # Trace mode: raw lineage spans ride the snapshot so exported
             # files feed `python -m petastorm_tpu.telemetry trace`.

@@ -57,7 +57,8 @@ def default_rules(input_stall_pct: float = 5.0,
                   reshards: float = 0.0,
                   hedges_per_s: float = 2.0,
                   stragglers_per_s: float = 2.0,
-                  ingest_lag_s: float = 300.0) -> List[SloRule]:
+                  ingest_lag_s: float = 300.0,
+                  max_drift: float = 0.2) -> List[SloRule]:
     """The documented default rule set (thresholds per the tuning table in
     docs/observability.md). ``ingest_lag_s`` is the live-data freshness
     contract (docs/live_data.md): now minus the newest admitted file's
@@ -82,6 +83,11 @@ def default_rules(input_stall_pct: float = 5.0,
                 stragglers_per_s),
         SloRule("ingest_lag_s", "gauge", "discovery.ingest_lag_s",
                 ingest_lag_s),
+        # Data-quality contract (docs/observability.md "Data quality
+        # plane"): PSI >= 0.2 is the industry-conventional actionable
+        # band; the gauge only exists on quality-enabled readers WITH a
+        # reference profile, so other pipelines skip the rule.
+        SloRule("max_drift", "gauge", "quality.max_drift", max_drift),
     ]
 
 
@@ -94,6 +100,10 @@ def parse_rules(spec: str) -> List[SloRule]:
     ``kind:metric<=value`` entries (e.g.
     ``input_stall_pct<=1,counter:resilience.worker_crashes<=0``)."""
     by_name = {r.name: r for r in DEFAULT_RULES}
+    # A default rule is addressable by its full metric name too
+    # (`quality.max_drift<=0.2` reads better in a CI config than the
+    # short rule name); explicit names win on collision.
+    by_metric = {r.metric: r for r in DEFAULT_RULES}
     out: List[SloRule] = []
     for raw in spec.split(","):
         entry = raw.strip()
@@ -109,8 +119,8 @@ def parse_rules(spec: str) -> List[SloRule]:
             kind, metric = lhs.split(":", 1)
             out.append(SloRule(metric, kind.strip(), metric.strip(),
                                threshold))
-        elif lhs in by_name:
-            base = by_name[lhs]
+        elif lhs in by_name or lhs in by_metric:
+            base = by_name.get(lhs) or by_metric[lhs]
             out.append(SloRule(base.name, base.kind, base.metric, threshold))
         else:
             raise ValueError(

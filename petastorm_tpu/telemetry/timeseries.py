@@ -150,6 +150,14 @@ DEFAULT_SERIES: Sequence[SeriesSpec] = (
     SeriesSpec("pool.utilization", "util", "pool.w*.busy_s"),
     SeriesSpec("mixer.m{}.lag_s", "gauge", "mixer.m*.lag_s"),
     SeriesSpec("mixer.m{}.starved_per_s", "rate", "mixer.m*.starved_total"),
+    # Data-quality plane (docs/observability.md "Data quality plane"):
+    # the lazy drift gauges are COMPUTED by these reads, so the sampler
+    # cadence is the drift-detection cadence; one sparkline per drifting
+    # column plus the headline maximum.
+    SeriesSpec("quality.max_drift", "gauge", "quality.max_drift"),
+    SeriesSpec("quality.drift.{}", "gauge", "quality.drift.*"),
+    SeriesSpec("quality.admission.max_drift", "gauge",
+               "quality.admission.max_drift"),
 )
 
 

@@ -148,6 +148,26 @@ class WeightedSamplingReader:
             })
         return {"step": self._step, "seed": self._seed, "members": members}
 
+    def quality_report(self) -> dict:
+        """Per-source data-quality rollup (docs/observability.md "Data
+        quality plane"): each member reader's own quality report keyed by
+        member index — per-SOURCE profiles and drift scores are exactly
+        what a mixture curriculum needs (one drifting source inside an
+        otherwise healthy mix is invisible in an aggregate profile) —
+        plus the mix-level drift maximum. Members without the plane
+        enabled contribute empty reports."""
+        members = {}
+        drift_max = 0.0
+        for i, r in enumerate(self._readers):
+            report = getattr(r, "quality_report", None)
+            rep = report() if report is not None else {}
+            if rep:
+                members[f"m{i}"] = rep
+                drift_max = max(drift_max,
+                                (rep.get("drift") or {}).get("max", 0.0))
+        return ({"members": members, "drift_max": round(drift_max, 6)}
+                if members else {})
+
     def __iter__(self):
         return self
 

@@ -1,0 +1,878 @@
+"""Data-quality plane (docs/observability.md "Data quality plane"):
+streaming column profiles, PSI/chi-square drift detection, zero-IO
+admission scoring on live growth, and the epoch coverage auditor —
+units plus the acceptance e2es (drift-on-growth fires within one poll
+interval; a faulted deterministic epoch's coverage manifest reconciles
+to exactly-once; a mesh host-loss reshard reconciles too).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.quality import (ColumnProfile, CoverageLedger,
+                                   DatasetProfile, KMVSketch,
+                                   MeshCoverageLedger, QualityConfig,
+                                   QualityMonitor, chi_square_score,
+                                   drift_scores, load_profile, psi_score,
+                                   save_profile, score_stats_profile)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.telemetry import make_registry
+from petastorm_tpu.telemetry.histogram import StreamingHistogram
+
+pytestmark = pytest.mark.quality
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ KMV sketch
+def test_kmv_exact_below_k_and_estimates_above():
+    s = KMVSketch(64)
+    s.update_numeric(np.arange(40))
+    assert s.estimate() == 40.0
+    s.update_numeric(np.arange(10_000))
+    est = s.estimate()
+    assert 8_000 <= est <= 12_000  # ~1/sqrt(64) relative error
+
+
+def test_kmv_merge_equals_union_and_roundtrips():
+    a, b = KMVSketch(64), KMVSketch(64)
+    a.update_numeric(np.arange(0, 40))
+    b.update_numeric(np.arange(20, 60))
+    a.merge(b)
+    assert a.estimate() == 60.0
+    rt = KMVSketch.from_dict(a.to_dict())
+    assert rt.estimate() == a.estimate()
+    with pytest.raises(ValueError, match="different k"):
+        a.merge(KMVSketch(32))
+
+
+def test_kmv_object_hashing_is_deterministic():
+    a, b = KMVSketch(64), KMVSketch(64)
+    a.update_objects(["x", "y", None, b"z"])
+    b.update_objects([b"z", "y", "x"])
+    assert a.to_dict() == b.to_dict()  # None skipped; hashes stable
+    assert a.estimate() == 3.0
+
+
+# ------------------------------------------------- vectorized histogram
+def test_observe_many_is_bucket_identical_to_observe():
+    bounds = [0.0, 1.0, 2.0, 5.0]
+    values = [-3.0, 0.0, 0.5, 1.0, 1.5, 2.0, 4.9, 5.0, 7.0]
+    h1, h2 = StreamingHistogram(bounds), StreamingHistogram(bounds)
+    for v in values:
+        h1.observe(v)
+    h2.observe_many(np.array(values))
+    assert h1.raw_counts() == h2.raw_counts()
+    assert h1.as_dict() == h2.as_dict()
+    assert h2.bounds == bounds
+
+
+# -------------------------------------------------------- column profiles
+def test_numeric_profile_matches_numpy_moments():
+    rng = np.random.RandomState(0)
+    data = rng.normal(3.0, 2.0, 5000)
+    data[::10] = np.nan
+    p = ColumnProfile("x")
+    for chunk in np.split(data, 10):
+        p.observe(chunk)
+    valid = data[~np.isnan(data)]
+    assert p.count == 5000
+    assert p.null_count == 500
+    assert p.min == pytest.approx(valid.min())
+    assert p.max == pytest.approx(valid.max())
+    assert p.mean == pytest.approx(valid.mean(), rel=1e-9)
+    assert p.std == pytest.approx(valid.std(), rel=1e-6)
+
+
+def test_profile_merge_is_exact_under_any_split():
+    rng = np.random.RandomState(1)
+    data = rng.normal(0, 1, 4000)
+    whole = ColumnProfile("x", edges=[-3, -1, 0, 1, 3])
+    whole.observe(data)
+    a = ColumnProfile("x", edges=[-3, -1, 0, 1, 3])
+    b = ColumnProfile("x", edges=[-3, -1, 0, 1, 3])
+    a.observe(data[:1234])
+    b.observe(data[1234:])
+    a.merge(b)
+    assert a.count == whole.count
+    assert a.mean == pytest.approx(whole.mean, rel=1e-12)
+    assert a.std == pytest.approx(whole.std, rel=1e-9)
+    assert a.hist.raw_counts() == whole.hist.raw_counts()
+
+
+def test_ndarray_profile_shapes_dtypes_nan_fraction():
+    p = ColumnProfile("emb")
+    arr = np.zeros((100, 8), dtype=np.float32)
+    arr[0, :4] = np.nan
+    p.observe(arr)
+    assert p.kind == "ndarray"
+    assert p.shapes == {"8": 100}
+    assert p.dtypes == {"float32": 100}
+    assert p.nan_fraction == pytest.approx(4 / 800)
+    # Ragged list-of-arrays fallback (the batch plane's list columns).
+    p2 = ColumnProfile("img")
+    p2.observe([np.zeros((2, 2)), np.zeros((3, 3)), None])
+    assert p2.kind == "ndarray"
+    assert p2.shapes == {"2x2": 1, "3x3": 1}
+    assert p2.null_count == 1
+
+
+def test_mixed_kind_column_does_not_corrupt_numeric_moments():
+    """Review-round regression: object cells folded into a column that
+    later reverts to numeric (mixed-schema live growth) must not enter
+    the Chan merge as phantom zero-valued rows."""
+    p = ColumnProfile("x")
+    p.observe(np.full(100, 0.0))
+    p.observe(["a", "b"] * 500)          # mixed-kind interlude
+    p.observe(np.full(100, 10.0))
+    assert p.dtypes.get("mixed")         # the drift signal is recorded
+    assert p.mean == pytest.approx(5.0)  # 200 numeric rows, mean 5
+    # And a JSON round-trip preserves the merge weight for future merges.
+    rt = ColumnProfile.from_dict(p.to_dict())
+    rt.merge(ColumnProfile.from_dict(p.to_dict()))
+    assert rt.mean == pytest.approx(5.0)
+
+
+def test_drift_scoring_races_no_dict_mutation(tmp_path):
+    """Review-round regression: scoring iterates locked snapshots, so a
+    sampler thread reading the lazy gauges cannot hit 'dictionary changed
+    size during iteration' while the consumer inserts columns."""
+    import threading
+    ref = DatasetProfile()
+    for i in range(64):
+        ref.observe_columns({f"c{i}": np.arange(10.0)}, 10)
+    reg = make_registry()
+    m = QualityMonitor(QualityConfig(sample_every=1), telemetry=reg,
+                       reference=ref)
+    errors = []
+    stop = threading.Event()
+
+    def score_loop():
+        while not stop.is_set():
+            try:
+                m.max_drift()
+                drift_scores(ref, m.profile)
+            except RuntimeError as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=score_loop)
+    t.start()
+    try:
+        for i in range(64):
+            m.observe_columns({f"c{i}": np.arange(10.0)}, 10)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+
+
+def test_object_profile_nulls_and_distinct():
+    p = ColumnProfile("s")
+    p.observe(["a", "b", None, "a"] * 100)
+    assert p.kind == "object"
+    assert p.null_rate == pytest.approx(0.25)
+    assert p.distinct_estimate() == 2.0
+
+
+def test_dataset_profile_json_roundtrip_and_edge_map():
+    prof = DatasetProfile()
+    prof.observe_columns({"x": np.arange(100.0),
+                          "s": ["a", None] * 50}, 100)
+    d = prof.to_dict()
+    rt = DatasetProfile.from_dict(d)
+    assert rt.to_dict() == d
+    assert "x" in rt.edge_map() and "s" not in rt.edge_map()
+
+
+def test_profile_restrict_and_max_columns():
+    prof = DatasetProfile(columns=["x"])
+    prof.observe_columns({"x": np.arange(5.0), "y": np.arange(5.0)}, 5)
+    assert list(prof.columns) == ["x"]
+    capped = DatasetProfile(max_columns=2)
+    capped.observe_columns({f"c{i}": np.arange(3.0) for i in range(5)}, 3)
+    assert len(capped.columns) == 2
+
+
+def test_merge_with_mismatched_edges_drops_histogram_not_rollup():
+    a = ColumnProfile("x", edges=[0, 1, 2])
+    b = ColumnProfile("x", edges=[0, 10, 20])
+    a.observe(np.arange(5.0))
+    b.observe(np.arange(5.0))
+    a.merge(b)
+    assert a.count == 10
+    assert a.dtypes.get("hist_dropped") == 1
+
+
+# ----------------------------------------------------------- drift scores
+def test_psi_and_chi2_zero_for_identical_and_large_for_shifted():
+    # Laplace smoothing leaves a small residual when totals differ.
+    assert psi_score([10, 20, 10], [100, 200, 100]) == pytest.approx(
+        0.0, abs=0.02)
+    assert psi_score([100, 200, 100], [100, 200, 100]) == pytest.approx(
+        0.0, abs=1e-12)
+    shifted = psi_score([100, 10, 1], [1, 10, 100])
+    assert shifted is not None and shifted > 1.0
+    assert chi_square_score([10, 20, 10], [10, 20, 10]) == pytest.approx(
+        0.0, abs=0.1)
+    assert psi_score([], []) is None
+    assert psi_score([0, 0], [1, 1]) is None
+    assert psi_score([1, 2], [1, 2, 3]) is None
+
+
+def test_drift_scores_detect_mean_shift_and_ignore_same_distribution():
+    ref = DatasetProfile()
+    ref.observe_columns(
+        {"x": np.random.RandomState(0).normal(0, 1, 5000)}, 5000)
+    same = DatasetProfile(edge_seed=ref.edge_map())
+    same.observe_columns(
+        {"x": np.random.RandomState(7).normal(0, 1, 5000)}, 5000)
+    moved = DatasetProfile(edge_seed=ref.edge_map())
+    moved.observe_columns(
+        {"x": np.random.RandomState(8).normal(4, 1, 5000)}, 5000)
+    assert drift_scores(ref, same)["x"]["score"] < 0.1
+    assert drift_scores(ref, moved)["x"]["score"] > 0.5
+
+
+def test_drift_scores_ndarray_new_shape_and_nan_delta():
+    ref, cur = DatasetProfile(), DatasetProfile()
+    ref.observe_columns({"e": np.zeros((10, 4))}, 10)
+    bad = np.zeros((10, 5))
+    bad[:, 0] = np.nan
+    cur.observe_columns({"e": bad}, 10)
+    scored = drift_scores(ref, cur)["e"]
+    assert scored["score"] == 1.0 and "5" in scored["new_shapes"]
+
+
+def test_score_stats_profile_range_and_null_drift():
+    from petastorm_tpu.etl.dataset_metadata import ColumnStats
+    ref = DatasetProfile()
+    ref.observe_columns({"x": np.arange(0.0, 100.0)}, 100)
+    inside = [{"x": ColumnStats(min=10.0, max=90.0, null_count=0,
+                                num_rows=50, has_min_max=True)}]
+    outside = [{"x": ColumnStats(min=500.0, max=600.0, null_count=25,
+                                 num_rows=50, has_min_max=True)}]
+    assert score_stats_profile(ref, inside)["score"] == 0.0
+    scored = score_stats_profile(ref, outside)
+    assert scored["score"] == 1.0
+    assert scored["columns"]["x"]["range_overshoot"] == 1.0
+    assert scored["columns"]["x"]["null_rate_delta"] == pytest.approx(0.5)
+    # Tail sampling noise (a few % past the observed extremes) is NOT
+    # drift: overshoot is proportional, not binary.
+    from petastorm_tpu.etl.dataset_metadata import ColumnStats
+    grazing = [{"x": ColumnStats(min=-2.0, max=104.0, null_count=0,
+                                 num_rows=50, has_min_max=True)}]
+    assert score_stats_profile(ref, grazing)["score"] < 0.05
+
+
+# ------------------------------------------------------- coverage ledgers
+def test_coverage_ledger_ordinal_reconciles_with_skips_and_dups():
+    from petastorm_tpu.reader_impl.epoch_plan import EpochPlan
+    plan = EpochPlan(seed=1, num_items=6)
+    ledger = CoverageLedger(plan=plan)
+    for i in (0, 1, 3, 4):
+        ledger.record("delivered", i)
+    ledger.record("empty", 2)
+    ledger.record("skip", 5)
+    ledger.record("duplicate", 3)
+    m = ledger.manifest(0)
+    assert m["delivered"] == 4 and m["empty"] == 1 and m["skipped"] == [5]
+    assert m["duplicates_dropped"] == 1
+    assert m["accounted"] == 6 and m["reconciled"] and m["complete"]
+    # A second epoch's ordinals land in their own manifest.
+    ledger.record("delivered", 6)
+    assert ledger.manifest(1)["delivered"] == 1
+    assert not ledger.manifest(1)["reconciled"]
+
+
+def test_coverage_ledger_resume_audits_the_suffix():
+    from petastorm_tpu.reader_impl.epoch_plan import EpochPlan
+    plan = EpochPlan(seed=1, num_items=10)
+    ledger = CoverageLedger(plan=plan)
+    ledger.mark_resumed(0, 4)
+    for i in range(4, 10):
+        ledger.record("delivered", i)
+    m = ledger.manifest(0)
+    assert m["audited_from_offset"] == 4
+    assert m["reconciled"] and m["accounted"] == 6
+
+
+def test_coverage_ledger_count_mode():
+    ledger = CoverageLedger(num_items=8, num_epochs=2)
+    for _ in range(14):
+        ledger.record_unit()
+    rep = ledger.report(quarantine_count=2)
+    assert rep["mode"] == "count"
+    assert rep["units_delivered"] == 14 and rep["accounted"] == 16
+    assert rep["complete"] is True
+    ledger.reset()
+    assert ledger.report()["units_delivered"] == 0
+
+
+def test_mesh_coverage_ledger_reshard_and_skip_accounting():
+    ledger = MeshCoverageLedger(lambda epoch: 10)
+    ledger.record_delivered(0, [0, 1, 2, 3], recovery=False)
+    ledger.record_delivered(0, [4, 5, 6], recovery=True)     # reshard
+    ledger.record_delivered(0, [6], recovery=True)           # redelivery
+    ledger.record_delivered(0, [7, 8], recovery=False)
+    ledger.record_skipped(0, 1)                              # quarantine
+    m = ledger.report()["epochs"][0]
+    assert m["delivered"] == 9 and m["recovered_via_reshard"] == 3
+    assert m["redelivered"] == 1 and m["quarantine_skips"] == 1
+    assert m["accounted"] == 10 and m["complete"]
+    assert not m["reconciled"]  # the redelivery disproves exactly-once
+    clean = MeshCoverageLedger(lambda epoch: 3)
+    clean.record_delivered(0, [0, 2], recovery=False)
+    clean.record_delivered(0, [1], recovery=True)
+    assert clean.report()["epochs"][0]["reconciled"]
+
+
+# ------------------------------------------------------------ the monitor
+def test_quality_config_validation():
+    with pytest.raises(ValueError, match="admission_action"):
+        QualityConfig(admission_action="explode")
+    with pytest.raises(ValueError, match="sample_every"):
+        QualityConfig(sample_every=0)
+
+
+def test_monitor_gauges_events_and_edge_detection():
+    reg = make_registry()
+    ref = DatasetProfile()
+    ref.observe_columns(
+        {"x": np.random.RandomState(0).normal(0, 1, 5000)}, 5000)
+    m = QualityMonitor(QualityConfig(), telemetry=reg, reference=ref)
+    m.observe_columns(
+        {"x": np.random.RandomState(3).normal(5, 1, 2000)}, 2000)
+    assert m.max_drift() > 0.2
+    snap = reg.metrics_view()
+    assert snap["gauges"]["quality.max_drift"] > 0.2
+    assert snap["gauges"]["quality.drift.x"] > 0.2
+    events = reg.events("quality.drift")
+    assert len(events) == 1 and events[0]["payload"]["column"] == "x"
+    # The entry edge fires ONCE; re-reading does not re-fire.
+    m.observe_columns(
+        {"x": np.random.RandomState(4).normal(5, 1, 2000)}, 2000)
+    m.max_drift()
+    assert len(reg.events("quality.drift")) == 1
+    assert reg.peek_counter("quality.drift_detections_total") == 1
+
+
+def test_monitor_observe_rows_columnarizes_and_skips_ngram_windows():
+    m = QualityMonitor(QualityConfig(), telemetry=make_registry())
+    m.observe_rows([{"x": 1.0, "e": np.zeros(3), "s": "a"},
+                    {"x": 2.0, "e": np.ones(3), "s": None}])
+    assert m.profile.columns["x"].kind == "numeric"
+    assert m.profile.columns["e"].kind == "ndarray"
+    assert m.profile.columns["s"].null_count == 1
+    before = len(m.profile.columns)
+    m.observe_rows([{0: ("not", "a", "row")}])  # ngram-shaped: counted only
+    assert len(m.profile.columns) == before
+    assert m.profile.units == 1  # the ngram unit never reached the profile
+
+
+def test_monitor_sampling_profiles_a_subset_but_counts_everything():
+    reg = make_registry()
+    m = QualityMonitor(QualityConfig(sample_every=2), telemetry=reg)
+    for _ in range(10):
+        m.observe_columns({"x": np.arange(4.0)}, 4)
+    assert reg.peek_counter("quality.units_observed") == 10
+    assert m.profile.units == 5
+
+
+def test_monitor_admission_verdicts():
+    from petastorm_tpu.etl.dataset_metadata import ColumnStats
+    ref = DatasetProfile()
+    ref.observe_columns({"x": np.arange(0.0, 100.0)}, 100)
+    drifted = [{"x": ColumnStats(min=900.0, max=950.0, null_count=0,
+                                 num_rows=10, has_min_max=True)}]
+    reg = make_registry()
+    warn = QualityMonitor(QualityConfig(), telemetry=reg, reference=ref)
+    assert warn.score_admitted_file("/d/f.pq", drifted)["verdict"] == "drift"
+    assert reg.peek_gauge("quality.admission.max_drift") == 1.0
+    assert len(reg.events("quality.admission.drift")) == 1
+    refuse = QualityMonitor(QualityConfig(admission_action="refuse"),
+                            reference=ref)
+    assert refuse.score_admitted_file("/d/f.pq",
+                                      drifted)["verdict"] == "refuse"
+    bare = QualityMonitor(QualityConfig())
+    assert bare.score_admitted_file("/d/f.pq",
+                                    drifted)["verdict"] == "no_baseline"
+
+
+def test_save_load_profile_file(tmp_path):
+    prof = DatasetProfile()
+    prof.observe_columns({"x": np.arange(50.0)}, 50)
+    path = str(tmp_path / "ref.json")
+    save_profile(prof, path)
+    assert load_profile(path).to_dict() == prof.to_dict()
+    assert load_profile(prof) is prof
+    assert load_profile(prof.to_dict()).to_dict() == prof.to_dict()
+
+
+# ------------------------------------------------------------- reader e2e
+@pytest.fixture()
+def scalar_store(tmp_path):
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    for f in range(4):
+        rng = np.random.RandomState(f)
+        pq.write_table(
+            pa.table({"id": pa.array(np.arange(f * 100, f * 100 + 100)),
+                      "val": pa.array(rng.normal(0.0, 1.0, 100))}),
+            f"{root}/{f}.parquet", row_group_size=25)
+    return root
+
+
+def test_batch_reader_quality_report_and_snapshot_embedding(scalar_store):
+    # sample_every=1: the assertions below count every profiled row.
+    with make_batch_reader(f"file://{scalar_store}",
+                           quality_config=QualityConfig(sample_every=1),
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        rows = sum(len(b.id) for b in r)
+        rep = r.quality_report()
+        snap = r.telemetry.snapshot()
+    assert rows == 400
+    assert rep["rows_observed"] == 400 and rep["units_observed"] == 16
+    val = rep["profile"]["columns"]["val"]
+    assert val["kind"] == "numeric" and val["count"] == 400
+    assert rep["coverage"]["mode"] == "count"
+    assert rep["coverage"]["complete"] is True
+    assert snap["quality"]["rows_observed"] == 400
+    assert snap["gauges"]["quality.columns_tracked"] == 2.0
+
+
+def test_quality_off_by_default(scalar_store):
+    with make_batch_reader(f"file://{scalar_store}",
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        next(iter(r))
+        assert r.quality_report() == {}
+        assert "quality" not in r.telemetry.snapshot()
+
+
+def test_row_reader_quality_eager_and_lazy(tmp_path):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from dataset_utils import create_test_dataset
+    url = "file://" + str(tmp_path / "ds")
+    create_test_dataset(url, num_rows=60, rows_per_row_group=20)
+    for mat in ("eager", "lazy"):
+        with make_reader(url, quality=True, row_materialization=mat,
+                         shuffle_row_groups=False,
+                         reader_pool_type="dummy", num_epochs=1) as r:
+            n = sum(1 for _ in r)
+            rep = r.quality_report()
+        assert n == 60 and rep["rows_observed"] == 60
+        kinds = {c["kind"] for c in rep["profile"]["columns"].values()}
+        assert {"numeric", "ndarray"} <= kinds
+
+
+def test_deterministic_epoch_coverage_reconciles_quarantine_skips(
+        scalar_store):
+    """Acceptance: a faulted epoch (every read of one file quarantined)
+    reconciles to exactly-once — delivered + skip-accounted == planned."""
+    from petastorm_tpu.resilience import FaultPlan, FaultSpec
+    fp = FaultPlan([FaultSpec(site="rowgroup.read", kind="corruption",
+                              rate=1.0, times=100,
+                              key_substring="1.parquet")])
+    with make_batch_reader(f"file://{scalar_store}", quality=True,
+                           sample_order="deterministic", seed=7,
+                           shuffle_row_groups=True,
+                           reader_pool_type="thread", workers_count=3,
+                           degraded_mode=True, fault_plan=fp,
+                           num_epochs=1) as r:
+        rows = sum(len(b.id) for b in r)
+        rep = r.quality_report()
+    m = rep["coverage"]["epochs"][0]
+    assert rows == 300
+    assert m["planned"] == 16 and m["delivered"] == 12
+    assert len(m["skipped"]) == 4
+    assert m["reconciled"] and m["complete"]
+
+
+@pytest.mark.process_pool
+def test_worker_kill_coverage_still_reconciles(scalar_store):
+    """Acceptance: a worker kill mid-epoch (crash re-ventilation can race
+    a published unit) still reconciles — the gate drops the duplicate and
+    the ledger records it."""
+    from petastorm_tpu.resilience import FaultPlan, FaultSpec
+    fp = FaultPlan([FaultSpec(site="worker.item", kind="worker_kill",
+                              at=3, worker=0)])
+    with make_batch_reader(f"file://{scalar_store}", quality=True,
+                           sample_order="deterministic", seed=3,
+                           shuffle_row_groups=True,
+                           reader_pool_type="process", workers_count=2,
+                           worker_crash_budget=1, fault_plan=fp,
+                           num_epochs=1) as r:
+        rows = sum(len(b.id) for b in r)
+        rep = r.quality_report()
+    m = rep["coverage"]["epochs"][0]
+    assert rows == 400
+    assert m["planned"] == 16
+    assert m["delivered"] == 16 and m["reconciled"]
+
+
+def test_reference_drift_e2e_and_slo_gate(scalar_store, tmp_path):
+    """Run A profiles the store into a reference; run B reads a shifted
+    store against it — the drift gauges cross the threshold and the
+    default max_drift SLO rule fails the check."""
+    with make_batch_reader(f"file://{scalar_store}",
+                           quality_config=QualityConfig(sample_every=1),
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        for _ in r:
+            pass
+        ref_path = str(tmp_path / "ref.json")
+        save_profile(
+            DatasetProfile.from_dict(r.quality_report()["profile"]),
+            ref_path)
+    drifted_root = str(tmp_path / "drifted")
+    os.makedirs(drifted_root)
+    rng = np.random.RandomState(0)
+    pq.write_table(
+        # ids stay uniform over the reference range (no drift); only
+        # `val`'s distribution moves.
+        pa.table({"id": pa.array(np.arange(0, 400, 4)),
+                  "val": pa.array(rng.normal(25.0, 1.0, 100))}),
+        f"{drifted_root}/0.parquet", row_group_size=25)
+    with make_batch_reader(f"file://{drifted_root}",
+                           quality_config=QualityConfig(sample_every=1),
+                           reference_profile=ref_path,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        for _ in r:
+            pass
+        rep = r.quality_report()
+        snap = r.telemetry.snapshot()
+    assert rep["drift"]["columns"]["val"]["score"] > 0.2
+    # `id` is monotone, so its first-batch-seeded reference histogram is
+    # degenerate (mass in the overflow bucket): the scorer must fall back
+    # to null-rate honesty instead of manufacturing PSI drift.
+    id_drift = rep["drift"]["columns"]["id"]
+    assert id_drift["score"] < 0.1
+    assert id_drift.get("degenerate_reference_histogram")
+    assert snap["gauges"]["quality.max_drift"] > 0.2
+    assert any(e["payload"]["column"] == "val"
+               for e in snap["events"]["quality.drift"])
+    from petastorm_tpu.telemetry.slo import parse_rules, rule_value
+    rule = parse_rules("quality.max_drift<=0.2")[0]
+    assert rule.metric == "quality.max_drift"
+    assert rule_value(rule, snap) > rule.max_value
+
+
+def test_pruning_scan_stats_retained_and_seed_histogram_edges(tmp_path):
+    """Satellite: the pruning footer scan's ColumnStats are retained on
+    the plan (pruning_report) and seed the quality histogram edges at
+    zero extra IO."""
+    from petastorm_tpu.predicates import in_range
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(400)),
+                  "val": pa.array(np.linspace(-5.0, 5.0, 400))}),
+        f"{root}/0.parquet", row_group_size=50)
+    with make_batch_reader(f"file://{root}", quality=True,
+                           predicate=in_range("id", 0, 200),
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        for _ in r:
+            pass
+        pruning = r.pruning_report()
+        rep = r.quality_report()
+    stats = pruning["column_stats"]["id"]
+    assert stats["min"] == 0.0 and stats["max"] == 399.0
+    assert stats["groups"] == 8 and stats["num_rows"] == 400
+    assert rep["stats_seed_columns"] == ["id"]
+    # Seeded edges: the histogram spans the FOOTER range, not the first
+    # delivered batch's range.
+    edges = rep["profile"]["columns"]["id"]["histogram"]["edges"]
+    assert edges[0] == 0.0 and edges[-1] == 399.0
+
+
+def test_worker_predicate_selectivity_counters(tmp_path):
+    from petastorm_tpu.predicates import in_range
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(100)),
+                  "val": pa.array(np.arange(100.0))}),
+        f"{root}/0.parquet", row_group_size=50)
+    with make_batch_reader(f"file://{root}", quality=True,
+                           predicate=in_range("val", 0.0, 30.0),
+                           rowgroup_pruning=False,
+                           shuffle_row_groups=False,
+                           reader_pool_type="thread", workers_count=1,
+                           num_epochs=1) as r:
+        rows = sum(len(b.id) for b in r)
+        snap = r.telemetry.snapshot()
+    assert rows == 30
+    assert snap["counters"]["quality.predicate.rows_in"] == 100
+    assert snap["counters"]["quality.predicate.rows_kept"] == 30
+
+
+# --------------------------------------------------- live growth / drift
+def write_scalar_file(path, start, rows=40, val_mean=0.0, row_group_size=20):
+    rng = np.random.RandomState(start)
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(start, start + rows)),
+                  "val": pa.array(rng.normal(val_mean, 1.0, rows))}),
+        path, row_group_size=row_group_size)
+
+
+def test_drifted_admitted_file_fires_within_one_poll(tmp_path):
+    """Acceptance: the watcher admits a deliberately drifted file and the
+    detector fires within ONE poll interval — before any of its bytes
+    are decoded into an epoch (the score comes from the validation
+    footer's statistics)."""
+    root = str(tmp_path / "live")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0)
+    write_scalar_file(f"{root}/b.parquet", 40)
+    with make_batch_reader(f"file://{root}", quality=True, num_epochs=None,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy",
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        for _ in range(4):
+            next(it)  # profile the base files
+        write_scalar_file(f"{root}/c.parquet", 80, val_mean=40.0)
+        growth = r.refresh_dataset()          # ONE poll
+        snap = r.telemetry.snapshot()
+        rep = r.quality_report()
+    assert len(growth["discovery"]["admissions"]) == 1
+    assert snap["gauges"]["quality.admission.max_drift"] > 0.5
+    assert snap["counters"]["quality.admission.drift_detections_total"] == 1
+    events = snap["events"]["quality.admission.drift"]
+    assert any("c.parquet" in e["payload"]["path"] for e in events)
+    files = rep["admission"]["files"]
+    assert files[-1]["verdict"] == "drift"
+
+
+def test_drifted_file_refused_when_admission_action_refuse(tmp_path):
+    root = str(tmp_path / "live")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0)
+    cfg = QualityConfig(admission_action="refuse")
+    with make_batch_reader(f"file://{root}", quality_config=cfg,
+                           num_epochs=None, shuffle_row_groups=False,
+                           reader_pool_type="dummy",
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        next(it)
+        write_scalar_file(f"{root}/c.parquet", 80, val_mean=40.0)
+        growth = r.refresh_dataset()
+        ids = set()
+        for _ in range(1):
+            ids.update(int(i) for i in next(it).id)
+    assert not growth["discovery"]["admissions"]
+    refused = growth["discovery"]["refused"]
+    assert len(refused) == 1 and "data-quality drift" in refused[0]["detail"]
+    assert max(ids) < 80  # the refused file's rows never join the stream
+
+
+def test_in_range_admitted_file_scores_clean(tmp_path):
+    root = str(tmp_path / "live")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0)
+    # `id` grows by construction (every appended file's ids are new), so
+    # a live-profile baseline would flag it forever — restrict the plane
+    # to the distribution-stationary column, as the docs advise.
+    cfg = QualityConfig(columns=["val"])
+    with make_batch_reader(f"file://{root}", quality_config=cfg,
+                           num_epochs=None,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy",
+                           refresh_interval_s=0) as r:
+        it = iter(r)
+        next(it)
+        next(it)  # drain the base pass: the baseline covers both groups
+        write_scalar_file(f"{root}/b.parquet", 40)  # same distribution
+        growth = r.refresh_dataset()
+        rep = r.quality_report()
+    assert len(growth["discovery"]["admissions"]) == 1
+    assert rep["admission"]["files"][-1]["verdict"] == "ok"
+
+
+# -------------------------------------------------------------- mesh e2e
+@pytest.mark.mesh
+def test_mesh_coverage_reconciles_host_loss_reshard(tmp_path):
+    """Acceptance: an epoch with a mesh host-loss reshard reconciles to
+    exactly-once — recovered ordinals counted, zero redeliveries on the
+    FIFO default — and host profiles federate into mesh_report."""
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "mesh")
+    os.makedirs(root)
+    n = 800
+    pq.write_table(
+        pa.table({"id": np.arange(n, dtype=np.int64),
+                  "x": (np.arange(n) * 0.5).astype(np.float32)}),
+        f"{root}/part0.parquet", row_group_size=20)
+    factory = MeshReaderFactory(f"file://{root}", batched=True,
+                                quality_config=QualityConfig(sample_every=1))
+    loader = MeshDataLoader(factory, batch_size=80, seed=0, num_epochs=1,
+                            drop_last=False, pad_last=True)
+    with loader:
+        it = iter(loader)
+        next(it)
+        loader.kill_host(5)
+        for _ in it:
+            pass
+        report = loader.mesh_report()
+    quality = report["quality"]
+    m = quality["coverage"]["epochs"][0]
+    assert m["planned"] == 40 and m["delivered"] == 40
+    assert m["recovered_via_reshard"] > 0
+    assert m["redelivered"] == 0 and m["reconciled"]
+    # Host profiles federated. Profiles observe at READER delivery, so a
+    # group in flight when the kill lands can be profiled by both the
+    # dying reader and its recovery source — bounded duplication; the
+    # ledger above is the exact surface.
+    assert 800 <= quality["profile"]["columns"]["id"]["count"] <= 840
+    assert quality["per_host"]
+
+
+@pytest.mark.mesh
+def test_mesh_clean_epoch_coverage(tmp_path):
+    from petastorm_tpu.jax import MeshDataLoader, MeshReaderFactory
+    root = str(tmp_path / "mesh")
+    os.makedirs(root)
+    pq.write_table(
+        pa.table({"id": np.arange(160, dtype=np.int64)}),
+        f"{root}/part0.parquet", row_group_size=20)
+    factory = MeshReaderFactory(f"file://{root}", batched=True)
+    with MeshDataLoader(factory, batch_size=16, num_epochs=1,
+                        drop_last=False, pad_last=True) as loader:
+        for _ in loader:
+            pass
+        quality = loader.quality_report()
+    m = quality["coverage"]["epochs"][0]
+    assert m["planned"] == 8 and m["reconciled"]
+    assert "profile" not in quality  # host readers ran without quality=
+
+
+# ----------------------------------------------------- loader and mixer
+def test_loader_quality_report_delegates(tmp_path):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from dataset_utils import create_test_scalar_dataset
+    from petastorm_tpu.jax import BatchedDataLoader
+    url = "file://" + str(tmp_path / "ds")
+    create_test_scalar_dataset(url, num_rows=50, row_group_size=10)
+    with make_batch_reader(url, quality=True, shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        with BatchedDataLoader(r, batch_size=10) as loader:
+            for _ in loader:
+                pass
+            rep = loader.quality_report()
+    assert rep["rows_observed"] == 50
+
+
+def test_mixer_quality_rollup(tmp_path):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    roots = []
+    for i, mean in enumerate((0.0, 30.0)):
+        root = str(tmp_path / f"s{i}")
+        os.makedirs(root)
+        write_scalar_file(f"{root}/0.parquet", 0, val_mean=mean, rows=400,
+                          row_group_size=100)
+        roots.append(root)
+    ref = DatasetProfile()
+    ref.observe_columns(
+        {"val": np.random.RandomState(0).normal(0, 1, 2000)}, 2000)
+    readers = [make_batch_reader(f"file://{root}", quality=True,
+                                 reference_profile=ref,
+                                 shuffle_row_groups=False,
+                                 reader_pool_type="dummy",
+                                 num_epochs=None)
+               for root in roots]
+    mix = WeightedSamplingReader(readers, [0.5, 0.5], seed=5)
+    with mix:
+        it = iter(mix)
+        for _ in range(20):
+            next(it)
+        rep = mix.quality_report()
+    assert set(rep["members"]) == {"m0", "m1"}
+    # Per-SOURCE drift: the shifted member is visible, the clean one is
+    # not — exactly what an aggregate profile would hide.
+    drifts = {k: v["drift"]["columns"].get("val", {}).get("score", 0.0)
+              for k, v in rep["members"].items()}
+    assert max(drifts.values()) > 0.2 > min(drifts.values())
+    assert rep["drift_max"] > 0.2
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_quality_render_and_diff(tmp_path, capsys):
+    from petastorm_tpu.telemetry.__main__ import main as telemetry_main
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/0.parquet", 0)
+    with make_batch_reader(f"file://{root}", quality=True,
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy", num_epochs=1) as r:
+        for _ in r:
+            pass
+        snap = r.telemetry.snapshot()
+        prof = DatasetProfile.from_dict(r.quality_report()["profile"])
+    snap_path = str(tmp_path / "snap.json")
+    with open(snap_path, "w") as f:
+        json.dump(snap, f)
+    ref_path = str(tmp_path / "ref.json")
+    save_profile(prof, ref_path)
+    assert telemetry_main(["quality", snap_path]) == 0
+    out = capsys.readouterr().out
+    assert "data quality" in out and "val" in out
+    assert telemetry_main(["quality", snap_path, "--diff", ref_path]) == 0
+    out = capsys.readouterr().out
+    assert "drift vs reference" in out and "score=0.0" in out
+    # A bare profile file renders too.
+    assert telemetry_main(["quality", ref_path]) == 0
+    # And the SLO gate accepts the metric-name spelling from the docs.
+    assert telemetry_main(["check", snap_path,
+                           "--slo", "quality.max_drift<=0.2"]) == 0
+
+
+def test_cli_quality_missing_payload_errors(tmp_path, capsys):
+    from petastorm_tpu.telemetry.__main__ import main as telemetry_main
+    path = str(tmp_path / "empty.json")
+    with open(path, "w") as f:
+        json.dump({"counters": {}, "gauges": {}}, f)
+    assert telemetry_main(["quality", path]) == 1
+    assert "no quality payload" in capsys.readouterr().err
+
+
+# ------------------------------------------------------- series and lint
+def test_default_series_include_quality_family():
+    from petastorm_tpu.telemetry.timeseries import (DEFAULT_SERIES,
+                                                    MetricsTimeline)
+    names = {s.name for s in DEFAULT_SERIES}
+    assert "quality.max_drift" in names and "quality.drift.{}" in names
+    tl = MetricsTimeline(interval_s=0.1)
+    view = {"counters": {}, "histograms": {},
+            "gauges": {"quality.max_drift": 0.4,
+                       "quality.drift.val": 0.4}}
+    tl.sample(view, now_s=0.0)
+    window = tl.sample(view, now_s=0.1)
+    assert window["series"]["quality.max_drift"] == 0.4
+    assert window["series"]["quality.drift.val"] == 0.4
+
+
+def test_check_metric_docs_two_level_wildcards():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    import check_metric_docs as lint
+    assert lint._wildcard_match("quality.c.*.null_rate", "quality.c.*.*")
+    assert lint._wildcard_match("quality.drift.val", "quality.drift.*")
+    assert lint._wildcard_match("mesh.host7.rows", "mesh.host*.rows")
+    assert not lint._wildcard_match("quality.drift.a.b", "quality.drift.*")
+    assert not lint._wildcard_match("pool.w1.items", "pool.w*.busy_s")
+
+
+def test_check_metric_docs_passes_on_repo():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "check_metric_docs.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr

@@ -77,17 +77,38 @@ def _literal_metric_name(node: ast.AST):
     return None
 
 
+def _segment_match(a: str, b: str) -> bool:
+    """One dotted segment against another, where EITHER side may hold a
+    ``*`` wildcard (whole-segment ``*`` or embedded ``host*``). A
+    wildcard matches any segment text *including the other side's
+    wildcard region* — that is what lets a two-level doc family like
+    ``quality.c.{col}.*`` (-> ``quality.c.*.*``) cover a code
+    registration like ``quality.c.*.null_rate`` and vice versa."""
+    if a == b:
+        return True
+    pa = "^" + re.escape(a).replace(r"\*", r"[A-Za-z0-9_*]+") + "$"
+    if re.match(pa, b):
+        return True
+    pb = "^" + re.escape(b).replace(r"\*", r"[A-Za-z0-9_*]+") + "$"
+    return bool(re.match(pb, a))
+
+
 def _wildcard_match(code_name: str, doc_name: str) -> bool:
-    """Match two names where either side may hold ``*`` wildcards (single
-    segment each; metric names never contain regex metacharacters beyond
-    the dot)."""
+    """Match two dotted names where either side may hold ``*`` wildcards.
+    Matching is **segment-wise** (wildcards never swallow a dot), so a
+    doc row can declare a multi-level family — ``quality.c.{col}.*``
+    documents every per-column metric in one row — without a single-level
+    ``*`` over-matching unrelated names. The previous whole-name regex
+    could not express two-level families: each direction's character
+    class refused the other side's literal ``*``."""
     if code_name == doc_name:
         return True
-    pattern = "^" + re.escape(doc_name).replace(r"\*", "[A-Za-z0-9_]+") + "$"
-    if re.match(pattern, code_name):
-        return True
-    pattern = "^" + re.escape(code_name).replace(r"\*", "[A-Za-z0-9_]+") + "$"
-    return bool(re.match(pattern, doc_name))
+    code_segs = code_name.split(".")
+    doc_segs = doc_name.split(".")
+    if len(code_segs) != len(doc_segs):
+        return False
+    return all(_segment_match(c, d)
+               for c, d in zip(code_segs, doc_segs))
 
 
 def _registrations(path: str):
