@@ -53,6 +53,7 @@ ci-lint:
 	python tools/check_listing.py
 	python tools/check_metric_docs.py
 	python tools/check_operators.py
+	python tools/check_lowering.py
 	# Shipped SLO rules + anomaly detectors, gated against the committed
 	# known-good bench telemetry snapshots (bench.py refreshes them each
 	# run): a rule/detector regression fails the BUILD, not just the bench.

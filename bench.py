@@ -890,6 +890,204 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"deterministic epoch phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4f3a4. plan fusion (docs/plan.md "Fusion rules"): the fused
+    # mask+decode+transform pass vs its unfused twin on a predicate +
+    # batched-transform lazy row pipeline — ONE row-group read and ONE
+    # predicate-column decode per group instead of two of each. Store:
+    # 50k rows in 256-row groups (per-group costs are what fusion
+    # halves). A deterministic 0.5 ms injected read latency pins the
+    # per-read service floor (same technique as the readahead/what-if
+    # phases — page-cached local files undersell a second storage
+    # round-trip, and the shared bench host's noise would otherwise
+    # swamp the A/B); raw unpinned rates ride along as info. Both modes
+    # hash every delivered cell: the fusion is byte-identity-gated, and
+    # this phase re-proves it on real data every round. The acceptance
+    # bar is fused >= 1.15x unfused (plan_fusion_speedup joins the
+    # bench_compare regression surface, as do the absolute rates).
+    plan_fusion_child = (
+        "import hashlib, json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from petastorm_tpu.codecs import ScalarCodec\n"
+        "from petastorm_tpu.etl.writer import materialize_dataset_local\n"
+        "from petastorm_tpu.predicates import in_range\n"
+        "from petastorm_tpu.reader import make_reader\n"
+        "from petastorm_tpu.resilience import FaultPlan, FaultSpec\n"
+        "from petastorm_tpu.transform import TransformSpec\n"
+        "from petastorm_tpu.unischema import Unischema, UnischemaField\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'planfuse_50k')\n"
+        "url = 'file://' + store\n"
+        "if not os.path.exists(os.path.join(store, '_common_metadata')):\n"
+        "    fields = [UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False)]\n"
+        "    fields += [UnischemaField('f%d' % i, np.float32, (),\n"
+        "                              ScalarCodec(np.float32), False)\n"
+        "               for i in range(8)]\n"
+        "    schema = Unischema('PlanFuse', fields)\n"
+        "    n, rng = 50_000, np.random.default_rng(0)\n"
+        "    rows = [dict({'id': i},\n"
+        "                 **{'f%d' % j: np.float32(rng.standard_normal())\n"
+        "                    for j in range(8)}) for i in range(n)]\n"
+        "    with materialize_dataset_local(url, schema,\n"
+        "                                   rows_per_row_group=256,\n"
+        "                                   rows_per_file=16384) as w:\n"
+        "        w.write_rows(rows)\n"
+        "ts = TransformSpec(lambda cols: {**cols, 'f0': cols['f0'] * 2.0},\n"
+        "                   batched=True)\n"
+        "def epoch(fused, pinned=True):\n"
+        "    os.environ['PETASTORM_TPU_PLAN_FUSION'] = '1' if fused else '0'\n"
+        "    fp = FaultPlan([FaultSpec(site='rowgroup.read', kind='latency',\n"
+        "                              rate=1.0, latency_s=0.0005)], seed=3) \\\n"
+        "        if pinned else None\n"
+        "    h, n = hashlib.md5(), 0\n"
+        "    t0 = time.perf_counter()\n"
+        "    with make_reader(url, num_epochs=1, shuffle_row_groups=False,\n"
+        "                     reader_pool_type='dummy', fault_plan=fp,\n"
+        "                     predicate=in_range('id', 0, 45_000),\n"
+        "                     row_materialization='lazy',\n"
+        "                     transform_spec=ts) as r:\n"
+        "        try:\n"
+        "            while True:\n"
+        "                b = r.next_batch()\n"
+        "                n += b.num_rows\n"
+        "                for name in sorted(b.columns):\n"
+        "                    h.update(np.ascontiguousarray(\n"
+        "                        b.columns[name]).tobytes())\n"
+        "        except StopIteration:\n"
+        "            pass\n"
+        "    return n / (time.perf_counter() - t0), h.hexdigest()\n"
+        "epoch(True)  # warm-up pays import + fs costs\n"
+        "fused, unfused, hashes = [], [], set()\n"
+        "for _ in range(3):  # interleaved so host drift hits both modes\n"
+        "    r1, h1 = epoch(True)\n"
+        "    r2, h2 = epoch(False)\n"
+        "    fused.append(r1); unfused.append(r2)\n"
+        "    hashes.update((h1, h2))\n"
+        "raw_fused, _ = epoch(True, pinned=False)\n"
+        "raw_unfused, _ = epoch(False, pinned=False)\n"
+        "f, u = max(fused), max(unfused)\n"
+        "print('BENCHJSON:' + json.dumps({'plan_fusion_epoch': {\n"
+        "    'plan_fusion_fused_samples_per_sec': round(f, 1),\n"
+        "    'plan_fusion_unfused_samples_per_sec': round(u, 1),\n"
+        "    'plan_fusion_speedup': round(f / max(u, 1e-9), 3),\n"
+        "    'byte_identical': len(hashes) == 1,\n"
+        "    'read_latency_pinned_s': 0.0005,\n"
+        "    'raw_fused_samples_per_sec': round(raw_fused, 1),\n"
+        "    'raw_unfused_samples_per_sec': round(raw_unfused, 1),\n"
+        "    'runs': {'fused': [round(x, 1) for x in fused],\n"
+        "             'unfused': [round(x, 1) for x in unfused]}}}))\n")
+    try:
+        out.update(_cpu_subprocess(plan_fusion_child, data_dir,
+                                   timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"plan_fusion phase failed: {e!r}", file=sys.stderr)
+
+    # ---- 4f3a5. plan warm start (docs/plan.md "Plan cache"): the
+    # optimizer's persisted-placement loop end to end. Cold: a process-
+    # pool reader on the embedding-heavy tensor store (threads measured
+    # ~1.5x there in round 8 — placement matters) runs a REAL placement
+    # trial (manually ticked controller, migration at the __next__ safe
+    # point) and persists the winner keyed by (dataset fingerprint,
+    # store type, host). Warm: the identical construction consults the
+    # cache, builds the winning pool DIRECTLY, and pins the knob — no
+    # trial window in the timeline (asserted from the autotune report)
+    # and a lower time-to-first-batch (the skipped spawn+migration).
+    # plan_warm_start_speedup (cold/warm TTFB) joins the bench_compare
+    # regression surface; the *_ttfb_s keys join its lower-is-better
+    # surface.
+    plan_warm_child = (
+        "import json, os, shutil, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import pyarrow as pa\n"
+        "import pyarrow.parquet as pq\n"
+        "from petastorm_tpu.autotune import AutotuneConfig\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "cache_dir = os.path.join(os.environ['PT_BENCH_DATA_DIR'],\n"
+        "                         'plan_cache')\n"
+        "shutil.rmtree(cache_dir, ignore_errors=True)\n"
+        "os.environ['PETASTORM_TPU_PLAN_CACHE'] = cache_dir\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'tensor_50k')\n"
+        "if not os.path.exists(os.path.join(store, 'part0.parquet')):\n"
+        "    os.makedirs(store, exist_ok=True)\n"
+        "    n, rng = 50_000, np.random.default_rng(0)\n"
+        "    cols = {'id': np.arange(n, dtype=np.int64)}\n"
+        "    cols.update({'f%d' % i: rng.standard_normal(n).astype(np.float32)\n"
+        "                 for i in range(8)})\n"
+        "    for j in range(2):\n"
+        "        flat = rng.standard_normal(n * 64).astype(np.float32)\n"
+        "        cols['emb%d' % j] = pa.FixedSizeListArray.from_arrays(\n"
+        "            pa.array(flat), 64)\n"
+        "    pq.write_table(pa.table(cols), os.path.join(store, 'part0.parquet'),\n"
+        "                   row_group_size=2048)\n"
+        "url = 'file://' + store\n"
+        "def cfg():\n"
+        "    return AutotuneConfig(interval_s=3600.0, hysteresis=1,\n"
+        "                          cooldown_ticks=0, placement=True,\n"
+        "                          placement_settle_ticks=1,\n"
+        "                          placement_tolerance=0.15)\n"
+        "def run(drive_trial):\n"
+        "    t0 = time.perf_counter()\n"
+        "    r = make_batch_reader(url, num_epochs=None,\n"
+        "                          shuffle_row_groups=False,\n"
+        "                          reader_pool_type='process',\n"
+        "                          workers_count=2, autotune=True,\n"
+        "                          autotune_config=cfg())\n"
+        "    with r:\n"
+        "        it = iter(r)\n"
+        "        next(it)\n"
+        "        ttfb = time.perf_counter() - t0\n"
+        "        trial_s = None\n"
+        "        if drive_trial:\n"
+        "            host_bound = r.telemetry.counter('loader.next_host_bound')\n"
+        "            for _ in range(3):\n"
+        "                next(it)\n"
+        "                r.autotune.tick()\n"
+        "            t1 = time.perf_counter()\n"
+        "            deadline = time.monotonic() + 180.0\n"
+        "            while r.autotune.placement_outcome is None \\\n"
+        "                    and time.monotonic() < deadline:\n"
+        "                next(it)\n"
+        "                host_bound.add(5)\n"
+        "                r.autotune.tick()\n"
+        "            trial_s = time.perf_counter() - t1\n"
+        "            for _ in range(50):\n"
+        "                next(it)  # run the WINNER: the close-time cache\n"
+        "                # refresh persists its measured service times,\n"
+        "                # which seed the warm start's roofline\n"
+        "        report = r.autotune.report()\n"
+        "        return {'ttfb_s': ttfb, 'trial_s': trial_s,\n"
+        "                'plan': r.plan_report(),\n"
+        "                'pool': r.diagnostics['pool_type'],\n"
+        "                'outcome': r.autotune.placement_outcome,\n"
+        "                'trial_adjustments': sum(\n"
+        "                    1 for a in report['adjustments']\n"
+        "                    if a['actuator'] == 'placement')}\n"
+        "cold = run(drive_trial=True)\n"
+        "assert cold['outcome'] is not None, 'trial never resolved'\n"
+        "warm = run(drive_trial=False)\n"
+        "result = {\n"
+        "    'plan_warm_start_cold_ttfb_s': round(cold['ttfb_s'], 3),\n"
+        "    'plan_warm_start_warm_ttfb_s': round(warm['ttfb_s'], 3),\n"
+        "    'plan_warm_start_speedup': round(\n"
+        "        cold['ttfb_s'] / max(warm['ttfb_s'], 1e-9), 2),\n"
+        "    'cold_trial_window_s': round(cold['trial_s'], 2),\n"
+        "    'trial_verdict': cold['outcome'],\n"
+        "    'winner_pool': warm['pool'],\n"
+        "    'warm_plan_source': warm['plan']['source'],\n"
+        "    'warm_trial_skipped': warm['trial_adjustments'] == 0\n"
+        "        and warm['plan']['source'] == 'persisted',\n"
+        "    'warm_ttfb_improved': warm['ttfb_s'] < cold['ttfb_s'],\n"
+        "    'capacity_seeds': warm['plan'].get('capacity_seeds', {}),\n"
+        "}\n"
+        "print('BENCHJSON:' + json.dumps({'plan_warm_start': result}))\n")
+    try:
+        out.update(_cpu_subprocess(plan_warm_child, data_dir,
+                                   timeout_s=900.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"plan_warm_start phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4f3b. trace-plane overhead (docs/observability.md "Trace
     # plane"): the headline scalar columnar epoch with trace mode OFF vs
     # ON (lineage spans minted at ventilation, decode/fetch spans per row

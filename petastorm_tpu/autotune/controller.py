@@ -152,6 +152,13 @@ class AutotuneController:
         self._placement_trial: Optional[dict] = None
         self._placement_pinned = False
         self._placement_apply_failures = 0
+        #: Resolution record once placement is pinned: ``{"verdict":
+        #: "kept"|"reverted"|"apply_failed"|"persisted", "backend", ...}``.
+        self.placement_outcome: Optional[dict] = None
+        #: Optional callable invoked with the outcome dict when a MEASURED
+        #: trial resolves (kept/reverted) — the owning Reader persists the
+        #: winner to the plan cache here (docs/plan.md "Plan cache").
+        self.on_placement_resolved = None
         #: ``(tick, actuator, old, new, verdict)`` rows, append-only.
         self.history: List[tuple] = []
         self._thread: Optional[threading.Thread] = None
@@ -277,12 +284,15 @@ class AutotuneController:
             self._placement_trial = None
             self._placement_apply_failures += 1
             if self._placement_apply_failures >= 2:
-                self._placement_pinned = True
+                self._finish_trial({"verdict": "apply_failed",
+                                    "backend": act.backend})
             return
         if trial.get("reverting"):
             # The revert migration landed: trial over, loser measured.
-            self._placement_pinned = True
+            outcome = trial.get("outcome") or {"verdict": "reverted",
+                                               "backend": act.backend}
             self._placement_trial = None
+            self._finish_trial(outcome)
             return
         if "settle_left" not in trial:
             trial["settle_left"] = self.config.placement_settle_ticks
@@ -303,10 +313,43 @@ class AutotuneController:
                                  act.value, "placement_revert"))
             trial.clear()
             trial["reverting"] = True
+            # Verdict recorded now (act.backend already names the winner
+            # being flipped back to); finish once the revert applies.
+            trial["outcome"] = {
+                "verdict": "reverted", "backend": act.backend,
+                "baseline_rows_per_tick": round(baseline, 3),
+                "measured_rows_per_tick": round(current, 3)}
         else:
             # Winner (or wash — migration cost is sunk, stay put): pin.
-            self._placement_pinned = True
             self._placement_trial = None
+            self._finish_trial({
+                "verdict": "kept", "backend": act.backend,
+                "baseline_rows_per_tick": round(baseline, 3),
+                "measured_rows_per_tick": round(current, 3)})
+
+    def _finish_trial(self, outcome: dict) -> None:
+        """Pin placement with a resolution record; measured verdicts
+        (kept/reverted) also reach :attr:`on_placement_resolved` so the
+        owner can persist the winner."""
+        self._placement_pinned = True
+        self.placement_outcome = dict(outcome)
+        callback = self.on_placement_resolved
+        if callback is not None \
+                and outcome.get("verdict") in ("kept", "reverted"):
+            try:
+                callback(dict(outcome))
+            except Exception:  # noqa: BLE001 - persistence never kills IO
+                import logging
+                logging.getLogger(__name__).exception(
+                    "on_placement_resolved callback failed")
+
+    def pin_placement(self, outcome: Optional[dict] = None) -> None:
+        """Pin the placement knob WITHOUT a trial — the warm-start path
+        (docs/plan.md): a persisted plan already carries a measured
+        verdict, so no trial window ever opens."""
+        self._placement_pinned = True
+        self.placement_outcome = dict(outcome) if outcome else \
+            {"verdict": "pinned"}
 
     def _try_placement(self, acts, verdict: str) -> bool:
         """Last rung of the producer-bound ladder: start the one-shot
@@ -414,9 +457,12 @@ class AutotuneController:
         with self._lock:
             acts = {name: {"value": a.value, "lo": a.lo, "hi": a.hi}
                     for name, a in self._actuators.items()}
-        return {"ticks": self._tick_count,
-                "actuators": acts,
-                "adjustments": [
-                    {"tick": t, "actuator": n, "old": o, "new": v,
-                     "verdict": verdict}
-                    for t, n, o, v, verdict in list(self.history)]}
+        out = {"ticks": self._tick_count,
+               "actuators": acts,
+               "adjustments": [
+                   {"tick": t, "actuator": n, "old": o, "new": v,
+                    "verdict": verdict}
+                   for t, n, o, v, verdict in list(self.history)]}
+        if self.placement_outcome is not None:
+            out["placement"] = dict(self.placement_outcome)
+        return out

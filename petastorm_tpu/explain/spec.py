@@ -127,6 +127,11 @@ class PipelineSpec:
         self.profile: Optional[dict] = None
         #: Opaque live-knob signature the owner uses to detect staleness.
         self.signature: Optional[tuple] = None
+        #: Executed-plan decisions when the reader was built from a lowered
+        #: :class:`~petastorm_tpu.plan.PipelinePlan` (docs/plan.md): the
+        #: placement source (``default``/``persisted``/``trial``), the
+        #: trial verdict, applied/declined fusions, plan-cache consult.
+        self.plan: Optional[dict] = None
 
     # ------------------------------------------------------------- access
     def operator(self, op_id: str) -> OperatorNode:
@@ -151,6 +156,8 @@ class PipelineSpec:
             "config": dict(self.config),
             "operators": [op.to_dict() for op in self.operators.values()],
         }
+        if self.plan is not None:
+            out["plan"] = self.plan
         if self.profile is not None:
             out["profile"] = self.profile
         return out
@@ -175,10 +182,152 @@ def _link_chain(ops: List[OperatorNode]) -> None:
 
 
 # ---------------------------------------------------------------- builders
+#: Canonical data-path order for plan-refresh reassembly (a migration can
+#: add/remove transport mid-flight; the rebuilt node must slot in where
+#: the chain expects it, not at the end).
+_CANONICAL_OP_ORDER = ("discovery", "ventilate", "fetch", "decode", "cache",
+                       "transport", "ordered_gate", "materialize")
+
+
 def build_reader_spec(reader, *, version: int = 1,
                       pipeline_id: Optional[str] = None) -> PipelineSpec:
     """Materialize ``reader``'s live operator graph. Reads configured (and
-    live-tuned) capacities only — never actuates anything."""
+    live-tuned) capacities only — never actuates anything.
+
+    Readers built through ``make_reader``/``make_batch_reader`` carry
+    their lowered :class:`~petastorm_tpu.plan.PipelinePlan`
+    (docs/plan.md); for those the spec starts from the PLAN's operator
+    nodes — explain renders the plan that actually executed, not a
+    parallel reconstruction — with live capacities (and any runtime
+    placement migration) refreshed on top. Direct ``Reader(...)``
+    constructions fall back to the live-graph builder below."""
+    plan = getattr(reader, "_plan", None)
+    if plan is not None:
+        return _spec_from_plan(reader, plan, version=version,
+                               pipeline_id=pipeline_id)
+    return _spec_from_live(reader, version=version, pipeline_id=pipeline_id)
+
+
+def _spec_from_plan(reader, plan, *, version: int,
+                    pipeline_id: Optional[str]) -> PipelineSpec:
+    """The plan's nodes, refreshed with live state (docs/plan.md): plan
+    items and ventilation caps, live decode placement/parallelism (a
+    placement migration moves the pool under the plan), fetch/cache/
+    transport presence per the LIVE pipeline, and the effective
+    materialization mode (lazy can downgrade to eager at construction)."""
+    import copy
+
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.workers_pool.dummy_pool import DummyPool
+    from petastorm_tpu.workers_pool.process_pool import ProcessPool
+
+    ops = {op_id: copy.deepcopy(op)
+           for op_id, op in plan.operators.items()}
+    pool = reader._pool
+    ventilator = reader._ventilator
+
+    if reader._discovery is None:
+        ops.pop("discovery", None)
+    elif "discovery" in ops:
+        ops["discovery"].capacity["growth_batches_applied"] = \
+            len(reader._growth_batches)
+
+    vent = ops["ventilate"]
+    vent.capacity = {"max_inflight": ventilator.max_inflight,
+                     "plan_items": reader._num_items}
+
+    if reader.readahead is None:
+        # The plan may carry a fetch node the live pipeline dropped (a
+        # persisted-placement flip to the process pool warns readahead
+        # off) — explain shows what runs.
+        ops.pop("fetch", None)
+    else:
+        stats = reader.readahead.stats()
+        fetch = ops.get("fetch")
+        if fetch is None:
+            # Mirror case: the plan was lowered for a process pool (no
+            # fetch node) but a persisted thread winner re-enabled the
+            # readahead stage at construction.
+            fetch = ops["fetch"] = OperatorNode(
+                op_id="fetch", name="async readahead fetch", layer="L3",
+                placement="fetcher", stage="fetch",
+                induced_by={"readahead_depth": int(stats["depth"])})
+        fetch.parallelism = int(stats["fetchers"])
+        fetch.capacity = {"depth": int(stats["depth"]),
+                          "queued": int(stats["queued"])}
+
+    if isinstance(pool, ProcessPool):
+        pool_flavor = "process"
+    elif isinstance(pool, DummyPool):
+        pool_flavor = "inline"
+    else:
+        pool_flavor = "thread"
+    gate = getattr(pool, "concurrency_gate", None)
+    workers = getattr(pool, "workers_count", 1)
+    dec = ops["decode"]
+    dec.placement = pool_flavor
+    dec.parallelism = (int(gate.limit) if gate is not None
+                       else int(workers))
+    dec.name = (f"row-group read+decode "
+                f"({reader._worker_class.__name__})")
+    dec.capacity["workers_count"] = int(workers)
+    dec.capacity["results_queue_capacity"] = pool.diagnostics.get(
+        "results_queue_capacity", 0)
+    dec.induced_by["row_materialization"] = reader.row_materialization
+
+    cache = reader._cache
+    if isinstance(cache, NullCache):
+        ops.pop("cache", None)
+    elif "cache" in ops:
+        ops["cache"].placement = pool_flavor
+        ops["cache"].name = f"row-group cache ({type(cache).__name__})"
+        ops["cache"].capacity["size_limit_bytes"] = getattr(
+            cache, "_size_limit", ops["cache"].capacity.get(
+                "size_limit_bytes"))
+
+    if isinstance(pool, ProcessPool):
+        transport = ops.get("transport")
+        if transport is None:
+            transport = ops["transport"] = OperatorNode(
+                op_id="transport", name="shm/zmq Arrow IPC transport",
+                layer="L3", placement="consumer", stage="transport",
+                induced_by={"migration": "thread->process"})
+        transport.capacity["ring_capacity_bytes"] = getattr(
+            pool, "_ring_capacity", None)
+    else:
+        ops.pop("transport", None)
+
+    if reader._gate is None:
+        ops.pop("ordered_gate", None)
+    elif "ordered_gate" in ops:
+        ops["ordered_gate"].capacity = {
+            "buffer_bound": ventilator.max_inflight
+            + max(1, reader._shuffle_window),
+            "shuffle_window": reader._shuffle_window}
+
+    mat = ops["materialize"]
+    mat.name = ("columnar batch view" if reader.is_batched_reader
+                else f"{reader.row_materialization} row materialization")
+    mat.capacity["mode"] = ("batched" if reader.is_batched_reader
+                            else reader.row_materialization)
+
+    ordered = sorted(ops.values(),
+                     key=lambda op: _CANONICAL_OP_ORDER.index(op.op_id)
+                     if op.op_id in _CANONICAL_OP_ORDER else 99)
+    for op in ordered:
+        if op.kind == "stage":
+            op.upstream, op.downstream = (), ()
+    _link_chain(ordered)
+    pid = pipeline_id or getattr(reader.telemetry, "pipeline_id", "?")
+    spec = PipelineSpec(ordered, pipeline_id=pid, version=version,
+                        source="reader", config=reader._config_summary())
+    spec.plan = plan.describe()
+    return spec
+
+
+def _spec_from_live(reader, *, version: int,
+                    pipeline_id: Optional[str]) -> PipelineSpec:
+    """Live-graph fallback for plan-less (directly constructed) readers."""
     from petastorm_tpu.cache import NullCache
     from petastorm_tpu.workers_pool.dummy_pool import DummyPool
     from petastorm_tpu.workers_pool.process_pool import ProcessPool
@@ -320,6 +469,7 @@ def extend_with_loader(reader_spec: PipelineSpec, loader) -> PipelineSpec:
                         config=dict(reader_spec.config,
                                     loader=type(loader).__name__))
     spec.signature = reader_spec.signature
+    spec.plan = reader_spec.plan
     return spec
 
 
@@ -341,6 +491,18 @@ def render_spec_dict(spec: dict) -> str:
     if spec.get("superseded"):
         head += "  [SUPERSEDED]"
     lines = [head]
+    plan = spec.get("plan")
+    if plan:
+        line = f"  plan: source={plan.get('source', '?')}"
+        trial = plan.get("trial") or {}
+        if trial:
+            line += (f" trial={trial.get('verdict', '?')}"
+                     f"->{trial.get('backend', '?')}")
+        fused = [f["name"] for f in plan.get("fusions", [])
+                 if f.get("applied")]
+        if fused:
+            line += "  fused: " + ", ".join(fused)
+        lines.append(line)
     if profile:
         lines.append(
             f"  profiled over {profile.get('wall_s', 0.0):.3g}s wall, "

@@ -1,0 +1,38 @@
+"""Executable pipeline plans (docs/plan.md).
+
+``make_reader``/``make_batch_reader`` kwargs **lower**
+(:mod:`~petastorm_tpu.plan.lowering`) into a
+:class:`~petastorm_tpu.plan.plan.PipelinePlan` — the PR 13 operator-node
+schema made executable: one consolidated plan-time validation pass
+(:mod:`~petastorm_tpu.plan.validate`), byte-identity-gated operator
+fusions (:mod:`~petastorm_tpu.plan.fusion`), and an optimizer
+(:mod:`~petastorm_tpu.plan.optimizer`) that persists winning placement
+plans per (dataset fingerprint, store type, host)
+(:mod:`~petastorm_tpu.plan.cache`) so warm starts skip the placement
+trial entirely.
+"""
+from petastorm_tpu.plan.cache import (DEFAULT_PLAN_TTL_S, PLAN_CACHE_ENV,
+                                      PLAN_CACHE_TTL_ENV, PlanCache, PlanKey,
+                                      plan_cache_dir)
+from petastorm_tpu.plan.fusion import (FUSION_DECODE_TRANSPORT,
+                                       FUSION_MASK_DECODE, PLAN_FUSION_ENV,
+                                       apply_fusions, fusions_enabled)
+from petastorm_tpu.plan.lowering import LOWERING_TABLE, lower_reader_kwargs
+from petastorm_tpu.plan.optimizer import (consult_plan_cache,
+                                          record_trial_outcome,
+                                          roofline_seeds)
+from petastorm_tpu.plan.plan import (PLAN_SCHEMA_VERSION, PLAN_SOURCES,
+                                     PipelinePlan)
+from petastorm_tpu.plan.validate import (CONFLICT_RULES, ValidationRule,
+                                         validate_reader_config)
+
+__all__ = [
+    "PipelinePlan", "PLAN_SCHEMA_VERSION", "PLAN_SOURCES",
+    "LOWERING_TABLE", "lower_reader_kwargs",
+    "CONFLICT_RULES", "ValidationRule", "validate_reader_config",
+    "FUSION_MASK_DECODE", "FUSION_DECODE_TRANSPORT", "PLAN_FUSION_ENV",
+    "apply_fusions", "fusions_enabled",
+    "PlanCache", "PlanKey", "plan_cache_dir", "PLAN_CACHE_ENV",
+    "PLAN_CACHE_TTL_ENV", "DEFAULT_PLAN_TTL_S",
+    "consult_plan_cache", "record_trial_outcome", "roofline_seeds",
+]

@@ -1,0 +1,194 @@
+"""Persisted winning plans: warm starts skip the placement trial.
+
+tf.data's experience (PAPERS.md) is that persisted/reused tuning
+decisions are where autotune's wall-clock win compounds — the trial is
+paid once per *(dataset, store, host)*, not once per process. This module
+is that ledger: when a placement trial resolves (docs/zero_copy.md), the
+owning Reader persists the winner here; the next reader constructed for
+the same key starts **directly on the winning backend** with the trial
+pinned off and the tuned knob values seeded
+(:mod:`petastorm_tpu.plan.optimizer`).
+
+Key = (dataset fingerprint, store type, host):
+
+* **fingerprint** — md5 over the dataset URL(s) + the sorted output
+  schema field names, so renaming a column or pointing at different data
+  is a miss (schema drift falls back to a fresh trial, never an error);
+* **store type** — the filesystem scheme (``file``/``hdfs``/``s3``...):
+  the thread-vs-process verdict is mostly an IO-vs-decode balance, and
+  the same dataset over a different transport balances differently;
+* **host** — ``socket.gethostname()``: core count and memory decide the
+  winner as much as the workload does.
+
+Entries live under ``$PETASTORM_TPU_PLAN_CACHE`` (default
+``~/.cache/petastorm_tpu/plans``, ``$XDG_CACHE_HOME`` respected) as one
+JSON sidecar per key; set the env var to a store-adjacent directory to
+share plans across hosts of one fleet (the host key still partitions
+them). Writes are atomic (tmp + rename). **Every failure mode reads as a
+miss**: corrupt JSON, a plan-schema-version mismatch
+(:data:`~petastorm_tpu.plan.plan.PLAN_SCHEMA_VERSION`), a fingerprint
+mismatch (hash collision / hand-edited file), an entry older than
+``$PETASTORM_TPU_PLAN_TTL_S`` (default 30 days), an unreadable directory
+— a warm start is an optimization, and its absence must never break a
+cold one. ``PETASTORM_TPU_PLAN_CACHE=0`` disables persistence outright.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from petastorm_tpu.plan.plan import PLAN_SCHEMA_VERSION
+
+__all__ = ["PlanCache", "PlanKey", "PLAN_CACHE_ENV", "PLAN_CACHE_TTL_ENV",
+           "DEFAULT_PLAN_TTL_S", "plan_cache_dir"]
+
+PLAN_CACHE_ENV = "PETASTORM_TPU_PLAN_CACHE"
+PLAN_CACHE_TTL_ENV = "PETASTORM_TPU_PLAN_TTL_S"
+
+#: Entries older than this are stale: the host's load profile, the
+#: dataset's size, and the build itself all drift — a month-old verdict
+#: is a guess, and a fresh trial is cheap relative to a training run.
+DEFAULT_PLAN_TTL_S = 30 * 24 * 3600.0
+
+
+def plan_cache_dir() -> Optional[str]:
+    """The cache directory, or None when persistence is disabled."""
+    configured = os.environ.get(PLAN_CACHE_ENV, "").strip()
+    if configured.lower() in ("0", "off", "false"):
+        return None
+    if configured:
+        return configured
+    base = os.environ.get("XDG_CACHE_HOME") \
+        or os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "petastorm_tpu", "plans")
+
+
+@dataclass(frozen=True)
+class PlanKey:
+    """What a persisted plan is keyed by (see the module docstring)."""
+
+    fingerprint: str
+    store_type: str
+    host: str
+
+    @classmethod
+    def for_dataset(cls, dataset_url_or_urls, schema_field_names,
+                    host: Optional[str] = None) -> "PlanKey":
+        urls = dataset_url_or_urls
+        url_text = urls if isinstance(urls, str) else "|".join(urls)
+        fields = ",".join(schema_field_names or ())
+        fp = hashlib.md5(f"{url_text}::{fields}".encode()).hexdigest()
+        scheme = url_text.split("://", 1)[0] if "://" in url_text else "file"
+        return cls(fingerprint=fp, store_type=scheme,
+                   host=host or socket.gethostname())
+
+    @property
+    def filename(self) -> str:
+        tag = hashlib.md5(
+            f"{self.fingerprint}:{self.store_type}:{self.host}"
+            .encode()).hexdigest()
+        return f"plan_{tag}.json"
+
+    def to_dict(self) -> dict:
+        return {"fingerprint": self.fingerprint,
+                "store_type": self.store_type, "host": self.host}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanKey":
+        return cls(fingerprint=d["fingerprint"],
+                   store_type=d["store_type"], host=d["host"])
+
+
+class PlanCache:
+    """Load/store persisted plan records. Never raises: a cache that can
+    fail would turn an optimization into an outage."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 ttl_s: Optional[float] = None):
+        self.directory = directory if directory is not None \
+            else plan_cache_dir()
+        if ttl_s is None:
+            env_ttl = os.environ.get(PLAN_CACHE_TTL_ENV, "").strip()
+            try:
+                ttl_s = float(env_ttl) if env_ttl else DEFAULT_PLAN_TTL_S
+            except ValueError:
+                ttl_s = DEFAULT_PLAN_TTL_S
+        self.ttl_s = ttl_s
+
+    @property
+    def enabled(self) -> bool:
+        return self.directory is not None
+
+    def _path(self, key: PlanKey) -> str:
+        return os.path.join(self.directory, key.filename)
+
+    # ------------------------------------------------------------------ io
+    def load(self, key: PlanKey) -> Optional[dict]:
+        """The persisted record for ``key``, or None on miss / stale /
+        corrupt / schema-drifted entries (the corrupt file is removed so
+        the breakage cannot recur)."""
+        if not self.enabled:
+            return None
+        path = self._path(key)
+        try:
+            with open(path, encoding="utf-8") as f:
+                record = json.load(f)
+        except OSError:
+            # Plain miss (or a transient IO failure on a shared cache
+            # directory) — never unlink: only CORRUPTION warrants removal,
+            # and a fleet-shared entry must survive one host's EIO.
+            return None
+        except ValueError:
+            self._discard(path)
+            return None
+        if not isinstance(record, dict):
+            self._discard(path)
+            return None
+        if record.get("plan_schema_version") != PLAN_SCHEMA_VERSION:
+            return None  # another build's schema; leave the file for it
+        saved_key = record.get("key") or {}
+        if saved_key.get("fingerprint") != key.fingerprint \
+                or saved_key.get("store_type") != key.store_type \
+                or saved_key.get("host") != key.host:
+            return None  # filename collision or hand-edited entry
+        created = record.get("created_at")
+        if not isinstance(created, (int, float)) \
+                or (self.ttl_s is not None
+                    and time.time() - created > self.ttl_s):
+            return None  # stale (or unstampable): re-trial
+        if record.get("backend") not in ("thread", "process"):
+            return None
+        return record
+
+    def store(self, key: PlanKey, record: dict) -> bool:
+        """Atomically persist ``record`` under ``key``; returns whether
+        the write landed (False on disabled cache or any IO failure)."""
+        if not self.enabled:
+            return False
+        payload = dict(record)
+        payload["plan_schema_version"] = PLAN_SCHEMA_VERSION
+        payload["key"] = key.to_dict()
+        payload.setdefault("created_at", time.time())
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            self._discard(tmp)
+            return False
+
+    @staticmethod
+    def _discard(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

@@ -1,0 +1,117 @@
+"""Operator fusion passes — each gated on byte-identical output.
+
+PR 9 made predicate masks, columnar decode, and batched transforms
+*compose*; this module makes the composition a formal plan rewrite with a
+declared gate: a fusion may only change **when** work happens (one pass
+over the row group instead of several), never **what** comes out — the
+fused and unfused pipelines must produce byte-identical rows, and
+tests/test_plan.py pins that per fusion across pool flavors
+(docs/plan.md "Fusion rules").
+
+``mask_decode_transform`` (L2)
+    With a worker-side ``predicate``, the unfused worker makes TWO
+    row-group IO calls (predicate columns, then survivors' columns) and
+    decodes the predicate columns TWICE (whole-group for the mask, then
+    again over survivors when they are also output columns). Fused: ONE
+    read covering every needed column, one whole-group decode of the
+    predicate columns reused for the output by index selection, then the
+    batched transform over the surviving columns — no intermediate
+    materialization between mask, decode and transform. Byte-identity
+    holds because every decode kernel is cell-independent
+    (select-then-decode == decode-then-select; the scalar kernel's
+    cast-then-select equals select-then-cast bit-for-bit). Declined for
+    NGram readers (windows re-sort rows across the mask boundary).
+
+``decode_transport`` (L2/L3)
+    When producer and consumer share a process (thread/dummy pools) there
+    is no serializer on the boundary — but the batched reader still pays
+    a transport-shaped cost there: workers publish Arrow tables that the
+    *consumer thread* converts to numpy. Fused, the decode workers run
+    the identical conversion themselves (the same
+    ``arrow_table_to_numpy_dict`` call on the same table — byte-identical
+    by construction) and the consumer pops ready column dicts: the
+    operator boundary costs nothing and the conversion parallelizes
+    across workers. On the process pool the serializer round-trip is
+    load-bearing (Arrow IPC over shm), so the fusion declines there — and
+    a placement migration re-decides it, because worker args are rebuilt
+    per pool flavor (``Reader._spawnable_worker_args``).
+
+Kill switch: ``PETASTORM_TPU_PLAN_FUSION=0`` disables every fusion (the
+bench's unfused twin and the byte-identity tests A/B through it).
+"""
+from __future__ import annotations
+
+import os
+
+from petastorm_tpu.plan.plan import PipelinePlan
+
+__all__ = ["FUSION_MASK_DECODE", "FUSION_DECODE_TRANSPORT",
+           "PLAN_FUSION_ENV", "apply_fusions", "fusions_enabled"]
+
+#: Worker-args fusion names (``plan_fusions`` worker arg).
+FUSION_MASK_DECODE = "mask_decode_transform"
+FUSION_DECODE_TRANSPORT = "decode_transport"
+
+#: Set to ``0``/``off``/``false`` to disable every fusion pass.
+PLAN_FUSION_ENV = "PETASTORM_TPU_PLAN_FUSION"
+
+
+def fusions_enabled() -> bool:
+    return os.environ.get(PLAN_FUSION_ENV, "").strip().lower() \
+        not in ("0", "off", "false")
+
+
+def _record(plan: PipelinePlan, name: str, operators: tuple,
+            applied: bool, reason: str) -> None:
+    plan.fusions.append({"name": name, "operators": list(operators),
+                         "applied": bool(applied), "reason": reason})
+
+
+def apply_fusions(plan: PipelinePlan, kwargs: dict, *,
+                  ngram: bool = False) -> None:
+    """Run every fusion pass over ``plan``, recording applied/declined
+    (+reason) per candidate. Only called from lowering."""
+    enabled = fusions_enabled()
+
+    # ---- mask + decode + transform -----------------------------------
+    ops = ("decode",)
+    if not enabled:
+        _record(plan, FUSION_MASK_DECODE, ops, False,
+                f"disabled via {PLAN_FUSION_ENV}")
+    elif kwargs.get("predicate") is None:
+        _record(plan, FUSION_MASK_DECODE, ops, False,
+                "no worker-side predicate: nothing to fuse")
+    elif ngram:
+        _record(plan, FUSION_MASK_DECODE, ops, False,
+                "NGram readers window across the mask boundary; unfused "
+                "path keeps the documented per-row assembly")
+    else:
+        _record(plan, FUSION_MASK_DECODE, ops, True,
+                "one read + one predicate-column decode per row group, "
+                "reused for the output by index selection")
+
+    # ---- decode -> transport -----------------------------------------
+    if plan.flavor != "batch":
+        return  # row payloads cross the boundary undecoded-table-free
+    ops = ("decode", "transport")
+    if not enabled:
+        _record(plan, FUSION_DECODE_TRANSPORT, ops, False,
+                f"disabled via {PLAN_FUSION_ENV}")
+    elif kwargs.get("convert_early_to_numpy"):
+        _record(plan, FUSION_DECODE_TRANSPORT, ops, False,
+                "convert_early_to_numpy already moves the conversion into "
+                "the workers (the fusion is the kwarg's default-on form)")
+    elif plan.pool_type == "process":
+        # Recorded for the CONSTRUCTED placement; a runtime migration to
+        # an in-process pool re-enables it through the per-pool worker
+        # args (the fusion is carried in _worker_args_inproc and stripped
+        # by _spawnable_worker_args).
+        _record(plan, FUSION_DECODE_TRANSPORT, ops, True,
+                "applies only while decode runs in-process: the process "
+                "pool's Arrow IPC serializer is load-bearing (spawned "
+                "workers publish tables; a thread-migration re-fuses)")
+    else:
+        _record(plan, FUSION_DECODE_TRANSPORT, ops, True,
+                "producer and consumer share a process: workers convert "
+                "Arrow->numpy themselves; the consumer pops ready column "
+                "dicts (no serializer, no consumer-side conversion)")

@@ -1,0 +1,246 @@
+"""Kwarg lowering: ``make_reader``/``make_batch_reader`` -> PipelinePlan.
+
+Every reader kwarg lowers to one or more operators (or a plan-time role)
+per :data:`LOWERING_TABLE` — the table is the contract ``tools/
+check_lowering.py`` lints (every kwarg in either entry-point signature
+must appear here or carry a ``lowering-ok`` waiver) and docs/plan.md
+renders. Lowering itself is **behavior-preserving by construction**: the
+plan's operators are exactly the ones the pre-plan construction path
+stood up for the same kwargs; only the fusion pass
+(:mod:`petastorm_tpu.plan.fusion`, gated on byte-identical output) and
+the optimizer's persisted-placement warm start (opt-in via
+``autotune_config.placement``; :mod:`petastorm_tpu.plan.optimizer`)
+change anything downstream.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from petastorm_tpu.explain.spec import OperatorNode
+from petastorm_tpu.plan.plan import PipelinePlan
+from petastorm_tpu.plan.validate import validate_reader_config
+
+__all__ = ["LOWERING_TABLE", "lower_reader_kwargs"]
+
+#: kwarg -> the operator ids it induces/configures. Pseudo-targets for
+#: kwargs that have no runtime operator: ``plan`` (plan-time row-group
+#: selection — filters/sharding/pruning run once, before any operator),
+#: ``optimizer`` (the autotune/plan-optimizer control loop), ``telemetry``
+#: (ops/quality-plane sidecars on the registry), ``compat`` (accepted for
+#: drop-in petastorm compatibility, ignored). The full rendered table
+#: with per-kwarg notes lives in docs/plan.md.
+LOWERING_TABLE = {
+    # store identity / planning inputs
+    "dataset_url": ("plan",),
+    "dataset_url_or_urls": ("plan",),
+    "schema_fields": ("decode", "materialize"),
+    "storage_options": ("plan", "decode"),
+    "filesystem": ("plan", "decode"),
+    "filters": ("plan",),
+    "rowgroup_selector": ("plan",),
+    "rowgroup_pruning": ("plan",),
+    "rowgroup_subset": ("plan", "ventilate"),
+    "rowgroup_coalescing": ("plan", "ventilate"),
+    "cur_shard": ("plan",),
+    "shard_count": ("plan",),
+    "shard_seed": ("plan",),
+    # ventilation / ordering
+    "shuffle_row_groups": ("ventilate",),
+    "num_epochs": ("ventilate",),
+    "seed": ("ventilate", "decode", "ordered_gate"),
+    "resume_state": ("ventilate", "ordered_gate"),
+    "sample_order": ("ordered_gate",),
+    "shuffle_window": ("ordered_gate",),
+    "shuffle_row_drop_partitions": ("ventilate", "decode"),
+    # decode stage (+ its resilience wrapping)
+    "reader_pool_type": ("decode", "transport"),
+    "workers_count": ("decode",),
+    "results_queue_size": ("decode", "transport"),
+    "shuffle_rows": ("decode",),
+    "predicate": ("plan", "decode"),
+    "transform_spec": ("decode",),
+    "pool_profiling_enabled": ("decode",),
+    "retry_policy": ("decode",),
+    "degraded_mode": ("decode",),
+    "fault_plan": ("decode",),
+    "worker_crash_budget": ("decode",),
+    "stage_deadline_s": ("decode",),
+    "hedge_policy": ("decode",),
+    "hang_timeout_s": ("decode",),
+    "convert_early_to_numpy": ("decode", "transport"),
+    "row_materialization": ("decode", "materialize"),
+    # fetch stage
+    "readahead_depth": ("fetch",),
+    "readahead_max_bytes": ("fetch",),
+    # transport
+    "zmq_copy_buffers": ("transport",),
+    "serializer": ("transport",),
+    # caches
+    "cache_type": ("cache",),
+    "cache_location": ("cache",),
+    "cache_size_limit": ("cache",),
+    "cache_row_size_estimate": ("cache",),
+    "cache_extra_settings": ("cache",),
+    "memory_cache_size_bytes": ("cache",),
+    # live data
+    "refresh_interval_s": ("discovery",),
+    # control loop
+    "autotune": ("optimizer",),
+    "autotune_config": ("optimizer",),
+    # ops / quality planes (registry sidecars, no data-path operator)
+    "timeline_interval_s": ("telemetry",),
+    "timeline_anomaly": ("telemetry",),
+    "quality": ("telemetry",),
+    "quality_config": ("telemetry",),
+    "reference_profile": ("telemetry",),
+    # drop-in petastorm compatibility, ignored (warned about)
+    "hdfs_driver": ("compat",),
+    "pyarrow_serialize": ("compat",),
+}
+
+
+def _induced(kwargs: dict, *names) -> dict:
+    """The ``induced_by`` payload for a node: the listed kwargs at their
+    given values (defaults included — the plan records what it ran with)."""
+    return {n: kwargs.get(n) for n in names if n in kwargs}
+
+
+def lower_reader_kwargs(flavor: str, kwargs: dict, *,
+                        schema_field_names: Optional[list] = None,
+                        ngram: bool = False) -> PipelinePlan:
+    """Lower one entry point's kwargs to an executable
+    :class:`~petastorm_tpu.plan.plan.PipelinePlan`:
+
+    1. the consolidated mutual-exclusion validation pass
+       (:mod:`petastorm_tpu.plan.validate`) — conflicts raise here, at
+       plan time, naming kwargs + operators;
+    2. operator materialization per :data:`LOWERING_TABLE`;
+    3. the fusion pass (:mod:`petastorm_tpu.plan.fusion`), each fusion
+       gated on byte-identical output;
+    4. the optimizer's plan-cache consult
+       (:mod:`petastorm_tpu.plan.optimizer`) — placement warm start +
+       capacity seeds, only when ``autotune_config.placement`` opted in.
+
+    :param flavor: ``"row"`` or ``"batch"``
+    :param kwargs: the entry point's kwarg dict (defaults resolved)
+    :param schema_field_names: sorted output-schema field names (the
+        dataset-fingerprint ingredient that makes schema drift a cache
+        miss)
+    :param ngram: True when ``schema_fields`` is an NGram (fusion
+        preconditions)
+    """
+    validated = validate_reader_config(kwargs)
+    pool_type = kwargs.get("reader_pool_type", "thread")
+    ops: List[OperatorNode] = []
+
+    refresh = kwargs.get("refresh_interval_s")
+    if refresh is not None:
+        ops.append(OperatorNode(
+            op_id="discovery", name="dataset discovery watcher", layer="L5",
+            placement=("background" if (refresh or 0) > 0 else "consumer"),
+            kind="sidecar",
+            capacity={"poll_interval_s": refresh},
+            induced_by=_induced(kwargs, "refresh_interval_s"),
+            downstream=("ventilate",)))
+
+    ops.append(OperatorNode(
+        op_id="ventilate", name="row-group ventilation", layer="L3",
+        placement="ventilator",
+        # max_inflight / plan_items are live values; explain's plan
+        # refresh fills them (lowering runs before the dataset is listed).
+        induced_by=_induced(kwargs, "shuffle_row_groups", "seed",
+                            "num_epochs", "rowgroup_coalescing",
+                            "shuffle_row_drop_partitions")))
+
+    readahead_depth = kwargs.get("readahead_depth")
+    if readahead_depth and pool_type != "process":
+        ops.append(OperatorNode(
+            op_id="fetch", name="async readahead fetch", layer="L3",
+            placement="fetcher", parallelism=min(2, int(readahead_depth)),
+            stage="fetch",
+            capacity={"depth": int(readahead_depth)},
+            induced_by=_induced(kwargs, "readahead_depth",
+                                "readahead_max_bytes")))
+
+    worker = "BatchReaderWorker" if flavor == "batch" else "RowReaderWorker"
+    pool_placement = "inline" if pool_type == "dummy" else pool_type
+    ops.append(OperatorNode(
+        op_id="decode", name=f"row-group read+decode ({worker})",
+        layer="L2", placement=pool_placement,
+        parallelism=int(kwargs.get("workers_count", 4))
+        if pool_type != "dummy" else 1,
+        stage="decode",
+        capacity={"workers_count": int(kwargs.get("workers_count", 4))
+                  if pool_type != "dummy" else 1,
+                  "results_queue_capacity":
+                      int(kwargs.get("results_queue_size", 50))},
+        induced_by=dict(
+            _induced(kwargs, "reader_pool_type", "workers_count",
+                     "row_materialization"),
+            # Objects summarized by type: induced_by must stay JSON-safe
+            # (plans round-trip and embed in telemetry snapshots).
+            **({"predicate": type(kwargs["predicate"]).__name__}
+               if kwargs.get("predicate") is not None else {}),
+            **({"transform_spec": "batched"
+                if getattr(kwargs.get("transform_spec"), "batched", False)
+                else "per_row"}
+               if kwargs.get("transform_spec") is not None else {}))))
+
+    if kwargs.get("memory_cache_size_bytes"):
+        ops.append(OperatorNode(
+            op_id="cache", name="row-group cache (InMemoryRowGroupCache)",
+            layer="L3", placement=pool_placement, kind="sidecar",
+            capacity={"size_limit_bytes":
+                      kwargs.get("memory_cache_size_bytes")},
+            induced_by=_induced(kwargs, "memory_cache_size_bytes"),
+            downstream=("decode",)))
+    elif kwargs.get("cache_type") not in (None, "null"):
+        ops.append(OperatorNode(
+            op_id="cache", name="row-group cache (LocalDiskCache)",
+            layer="L3", placement=pool_placement, kind="sidecar",
+            capacity={"size_limit_bytes": kwargs.get("cache_size_limit")},
+            induced_by=_induced(kwargs, "cache_type", "cache_location",
+                                "cache_size_limit"),
+            downstream=("decode",)))
+
+    if pool_type == "process":
+        ops.append(OperatorNode(
+            op_id="transport", name="shm/zmq Arrow IPC transport",
+            layer="L3", placement="consumer", stage="transport",
+            induced_by=dict(
+                _induced(kwargs, "reader_pool_type", "zmq_copy_buffers"),
+                **({"serializer": type(kwargs["serializer"]).__name__}
+                   if kwargs.get("serializer") is not None else {}))))
+
+    if kwargs.get("sample_order", "free") == "deterministic":
+        ops.append(OperatorNode(
+            op_id="ordered_gate", name="ordered delivery gate", layer="L3",
+            placement="consumer",
+            capacity={"shuffle_window":
+                      int(kwargs.get("shuffle_window") or 0)},
+            induced_by=_induced(kwargs, "sample_order", "shuffle_window")))
+
+    materialization = kwargs.get("row_materialization", "eager")
+    ops.append(OperatorNode(
+        op_id="materialize",
+        name=("columnar batch view" if flavor == "batch"
+              else f"{materialization} row materialization"),
+        layer="L5", placement="consumer",
+        capacity={"mode": ("batched" if flavor == "batch"
+                           else materialization)},
+        induced_by=_induced(kwargs, "row_materialization")))
+
+    from petastorm_tpu.explain.spec import _link_chain
+    _link_chain(ops)
+
+    plan = PipelinePlan(ops, flavor=flavor,
+                        placement={"decode": pool_type})
+    plan.validated = validated
+
+    from petastorm_tpu.plan.fusion import apply_fusions
+    apply_fusions(plan, kwargs, ngram=ngram)
+
+    from petastorm_tpu.plan.optimizer import consult_plan_cache
+    consult_plan_cache(plan, kwargs,
+                       schema_field_names=schema_field_names)
+    return plan

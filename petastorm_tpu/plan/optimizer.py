@@ -1,0 +1,143 @@
+"""Plan optimizer: persisted placement + roofline-seeded capacities.
+
+The PR 3 controller reacts to live telemetry; the PR 6 placement trial
+measures one backend flip per reader lifetime. This module generalizes
+both into decisions made **at plan time**:
+
+* :func:`consult_plan_cache` (called from lowering) — when the caller
+  opted into placement tuning (``autotune_config.placement=True``), look
+  the plan's key up in the persisted-plan cache
+  (:mod:`petastorm_tpu.plan.cache`). A valid entry rewrites
+  ``plan.placement["decode"]`` to the recorded winner, marks the plan
+  ``source="persisted"``, and carries the recorded trial verdict +
+  capacity seeds — the reader then constructs the winning pool directly,
+  pins the controller's placement knob (no trial window at all), and
+  starts its actuators at the tuned values. Anything short of a fully
+  valid entry is a miss and the cold path runs unchanged.
+
+* :func:`record_trial_outcome` (called by the Reader when this run's
+  trial resolves) — persist the measured winner, the verdict, the
+  controller's final actuator values, and the profiled per-operator
+  service times so the NEXT start can seed from them.
+
+* :func:`roofline_seeds` — vet persisted actuator values against the PR
+  13 what-if roofline over the persisted profile: the model's projected
+  bottleneck and throughput ride along in ``plan.capacity_seeds`` so an
+  operator reading ``explain()`` sees *why* the knobs started where they
+  did. Seeding never exceeds an actuator's clamped range (``Actuator.set``
+  clamps), and a record without a usable profile seeds nothing.
+
+Without ``autotune_config.placement`` every function here is a no-op:
+existing kwargs lower to plans with zero behavior change.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from petastorm_tpu.plan.cache import PlanCache, PlanKey
+from petastorm_tpu.plan.plan import PipelinePlan
+
+__all__ = ["consult_plan_cache", "record_trial_outcome", "roofline_seeds"]
+
+
+def _placement_opted_in(kwargs: dict) -> bool:
+    if not kwargs.get("autotune"):
+        return False
+    return bool(getattr(kwargs.get("autotune_config"), "placement", False))
+
+
+def roofline_seeds(record: dict) -> dict:
+    """Capacity seeds from a persisted record, vetted by the what-if
+    roofline: ``{"actuators": {...}, "roofline": {...}}``. The actuator
+    values are the persisted run's converged knob positions; the roofline
+    block is the model's X = min_i p_i/s_i over the persisted per-operator
+    service times (:mod:`petastorm_tpu.explain.whatif`'s model, applied to
+    stored evidence instead of a live registry)."""
+    seeds: dict = {}
+    actuators = record.get("actuators")
+    if isinstance(actuators, dict) and actuators:
+        seeds["actuators"] = {
+            name: int(value) for name, value in actuators.items()
+            if isinstance(value, (int, float)) and name != "placement"}
+    profile = record.get("profile") or {}
+    rates = {}
+    for op_id, cost in (profile.get("operators") or {}).items():
+        service = cost.get("service_per_row_s")
+        if service:
+            rates[op_id] = max(1, int(cost.get("parallelism", 1))) \
+                / float(service)
+    if rates:
+        bottleneck = min(rates, key=rates.get)
+        seeds["roofline"] = {
+            "projected_rows_per_s": round(rates[bottleneck], 3),
+            "bottleneck": bottleneck,
+        }
+    return seeds
+
+
+def consult_plan_cache(plan: PipelinePlan, kwargs: dict, *,
+                       schema_field_names=None,
+                       cache: Optional[PlanCache] = None) -> None:
+    """Warm-start consult (see module docstring). Mutates ``plan`` only
+    on a valid hit; records the consult outcome either way."""
+    if not _placement_opted_in(kwargs):
+        plan.cache = "off"
+        return
+    if plan.pool_type not in ("thread", "process"):
+        # Same eligibility gate the live trial enforces: a dummy pool is
+        # an explicit single-threaded-inline choice the optimizer must
+        # not silently replace with a spawned backend.
+        plan.cache = "ineligible"
+        return
+    urls = kwargs.get("dataset_url") or kwargs.get("dataset_url_or_urls")
+    plan.key = PlanKey.for_dataset(urls, schema_field_names)
+    cache = cache or PlanCache()
+    if not cache.enabled:
+        plan.cache = "disabled"
+        return
+    record = cache.load(plan.key)
+    if record is None:
+        plan.cache = "miss"
+        return
+    plan.cache = "hit"
+    backend = record["backend"]
+    if backend != plan.placement.get("decode"):
+        plan.placement["decode"] = backend
+        decode = plan.operators.get("decode")
+        if decode is not None:
+            decode.placement = backend
+            # The transport operator exists exactly when decode is
+            # spawned; a persisted winner flips it with the placement.
+            if backend == "process" and "transport" not in plan.operators:
+                from petastorm_tpu.explain.spec import OperatorNode
+                plan.operators["transport"] = OperatorNode(
+                    op_id="transport", name="shm/zmq Arrow IPC transport",
+                    layer="L3", placement="consumer", stage="transport",
+                    induced_by={"persisted_plan": backend})
+            elif backend != "process":
+                plan.operators.pop("transport", None)
+    plan.source = "persisted"
+    plan.trial = record.get("trial")
+    plan.capacity_seeds = roofline_seeds(record)
+
+
+def record_trial_outcome(plan: PipelinePlan, outcome: dict, *,
+                         actuators: Optional[dict] = None,
+                         profile: Optional[dict] = None,
+                         cache: Optional[PlanCache] = None) -> bool:
+    """Persist a resolved placement trial for ``plan.key``; updates the
+    plan's live source/trial record either way. Returns whether the
+    persist landed (False when caching is off/disabled/unwritable — the
+    trial verdict still applies to this run)."""
+    plan.source = "trial"
+    plan.trial = dict(outcome)
+    if plan.key is None:
+        return False
+    cache = cache or PlanCache()
+    record = {
+        "backend": outcome.get("backend"),
+        "trial": dict(outcome),
+        "actuators": dict(actuators or {}),
+        "profile": profile,
+    }
+    return cache.store(plan.key, record)

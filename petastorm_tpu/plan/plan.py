@@ -1,0 +1,177 @@
+"""PipelinePlan: the executable form of a reader configuration.
+
+PR 13 made the operator graph *visible* (``Reader.explain()`` /
+:class:`~petastorm_tpu.explain.spec.PipelineSpec`); this module makes it
+*executable*: ``make_reader``/``make_batch_reader`` kwargs **lower** —
+:mod:`petastorm_tpu.plan.lowering` — into a :class:`PipelinePlan` whose
+operators the reader construction path then stands up, so ``explain()``
+renders the plan that actually runs, not a parallel reconstruction
+(docs/plan.md).
+
+A plan is built from the same :class:`~petastorm_tpu.explain.spec.
+OperatorNode` schema the explain plane defined (one node schema for the
+whole repo — a dispatcher can ship either form), plus the executable
+decisions layered on top:
+
+* ``placement`` — where each placeable operator runs (today: the decode
+  stage's pool backend, the knob the PR 6 placement trial tunes);
+* ``fusions`` — operator fusions the fusion pass applied (or declined,
+  with the reason), each gated on byte-identical output
+  (:mod:`petastorm_tpu.plan.fusion`);
+* ``source`` — where the placement decision came from: ``"default"``
+  (the kwargs as given), ``"persisted"`` (a warm start from the plan
+  cache — the trial is skipped entirely), or ``"trial"`` (this run's
+  measured placement trial chose it);
+* ``capacity_seeds`` — knob warm-start values seeded from a persisted
+  run's tuned actuators + what-if roofline
+  (:mod:`petastorm_tpu.plan.optimizer`).
+
+JSON round-trip (:meth:`to_dict` / :meth:`from_dict`) is schema-versioned:
+:data:`PLAN_SCHEMA_VERSION` gates the persisted-plan cache — an entry
+written by a different plan schema is a miss, never an error
+(docs/plan.md "Plan cache").
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from petastorm_tpu.explain.spec import OperatorNode
+
+__all__ = ["PipelinePlan", "PLAN_SCHEMA_VERSION", "PLAN_SOURCES"]
+
+#: Version of the executable-plan schema (operators + placement + fusions
+#: + seeds). Bump on any change to the persisted shape: cache entries from
+#: another version fall back to a fresh trial (docs/plan.md).
+PLAN_SCHEMA_VERSION = 1
+
+#: Where a plan's placement decision came from.
+PLAN_SOURCES = ("default", "persisted", "trial")
+
+
+class PipelinePlan:
+    """One reader configuration, lowered to operators + decisions.
+
+    :param operators: data-path + sidecar nodes in upstream→downstream
+        order (the PR 13 node schema; duplicate ids rejected)
+    :param flavor: ``"row"`` (make_reader) or ``"batch"``
+        (make_batch_reader)
+    :param placement: placeable-operator placements; ``placement["decode"]``
+        is the pool backend construction must stand up
+    """
+
+    def __init__(self, operators: List[OperatorNode], *, flavor: str,
+                 placement: Optional[Dict[str, str]] = None):
+        if flavor not in ("row", "batch"):
+            raise ValueError(f"flavor must be 'row' or 'batch', "
+                             f"got {flavor!r}")
+        self.flavor = flavor
+        self.operators: Dict[str, OperatorNode] = {}
+        for op in operators:
+            if op.op_id in self.operators:
+                raise ValueError(f"duplicate operator id {op.op_id!r}")
+            self.operators[op.op_id] = op
+        self.placement: Dict[str, str] = dict(placement or {})
+        #: Fusion-pass outcomes: ``{"name", "operators", "applied",
+        #: "reason"}`` per candidate fusion (docs/plan.md "Fusion rules").
+        self.fusions: List[dict] = []
+        #: ``"default"`` | ``"persisted"`` | ``"trial"``.
+        self.source: str = "default"
+        #: Placement-trial verdict record once a trial resolved (or the
+        #: persisted entry's recorded verdict on a warm start).
+        self.trial: Optional[dict] = None
+        #: Plan-cache consultation outcome: ``"disabled"`` | ``"miss"`` |
+        #: ``"hit"`` | ``"off"`` (placement tuning not requested).
+        self.cache: str = "off"
+        #: The :class:`~petastorm_tpu.plan.cache.PlanKey` this plan would
+        #: persist under (None when caching is off/disabled).
+        self.key = None
+        #: Warm-start knob seeds from the optimizer (actuator name ->
+        #: initial value) plus the roofline projection that vetted them.
+        self.capacity_seeds: dict = {}
+        #: Names of the validation rules the plan-time pass checked
+        #: (:mod:`petastorm_tpu.plan.validate`).
+        self.validated: List[str] = []
+
+    # ------------------------------------------------------------- access
+    @property
+    def pool_type(self) -> str:
+        """The decode pool backend construction must build."""
+        return self.placement.get("decode", "thread")
+
+    def fusion_names(self) -> frozenset:
+        """Names of the fusions that APPLIED (the set worker args carry)."""
+        return frozenset(f["name"] for f in self.fusions if f["applied"])
+
+    def fusion(self, name: str) -> Optional[dict]:
+        for f in self.fusions:
+            if f["name"] == name:
+                return f
+        return None
+
+    def operator(self, op_id: str) -> OperatorNode:
+        return self.operators[op_id]
+
+    # ------------------------------------------------------------ readout
+    def describe(self) -> dict:
+        """Compact summary for ``Reader.plan_report()`` / explain's
+        ``plan`` section: decisions only, not the full node graph."""
+        return {
+            "flavor": self.flavor,
+            "placement": dict(self.placement),
+            "source": self.source,
+            "trial": dict(self.trial) if self.trial else None,
+            "cache": self.cache,
+            "key": self.key.to_dict() if self.key is not None else None,
+            "fusions": [dict(f) for f in self.fusions],
+            "capacity_seeds": dict(self.capacity_seeds),
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            "plan_schema_version": PLAN_SCHEMA_VERSION,
+            "flavor": self.flavor,
+            "placement": dict(self.placement),
+            "source": self.source,
+            "trial": dict(self.trial) if self.trial else None,
+            "cache": self.cache,
+            "key": self.key.to_dict() if self.key is not None else None,
+            "fusions": [dict(f) for f in self.fusions],
+            "capacity_seeds": dict(self.capacity_seeds),
+            "validated": list(self.validated),
+            "operators": [op.to_dict() for op in self.operators.values()],
+        }
+
+    @classmethod
+    def from_dict(cls, payload: dict) -> "PipelinePlan":
+        """Rebuild a plan from :meth:`to_dict` output. Raises
+        ``ValueError`` on a schema-version mismatch — callers that must
+        never fail (the plan cache) catch and treat it as a miss."""
+        version = payload.get("plan_schema_version")
+        if version != PLAN_SCHEMA_VERSION:
+            raise ValueError(
+                f"plan schema version mismatch: payload has {version!r}, "
+                f"this build speaks {PLAN_SCHEMA_VERSION}")
+        ops = []
+        for od in payload.get("operators", []):
+            ops.append(OperatorNode(
+                op_id=od["op_id"], name=od["name"], layer=od["layer"],
+                placement=od["placement"],
+                parallelism=int(od.get("parallelism", 1)),
+                stage=od.get("stage"), kind=od.get("kind", "stage"),
+                capacity=dict(od.get("capacity", {})),
+                induced_by=dict(od.get("induced_by", {})),
+                upstream=tuple(od.get("upstream", ())),
+                downstream=tuple(od.get("downstream", ()))))
+        plan = cls(ops, flavor=payload["flavor"],
+                   placement=payload.get("placement"))
+        plan.source = payload.get("source", "default")
+        plan.trial = payload.get("trial")
+        plan.cache = payload.get("cache", "off")
+        plan.fusions = [dict(f) for f in payload.get("fusions", [])]
+        plan.capacity_seeds = dict(payload.get("capacity_seeds", {}))
+        plan.validated = list(payload.get("validated", []))
+        key = payload.get("key")
+        if key:
+            from petastorm_tpu.plan.cache import PlanKey
+            plan.key = PlanKey.from_dict(key)
+        return plan

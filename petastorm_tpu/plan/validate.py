@@ -1,0 +1,180 @@
+"""Plan-time kwarg validation: every mutual-exclusion rule in one pass.
+
+Before this module, ``make_reader``'s conflicting-kwarg checks fired at
+different depths with inconsistent messages — ``rowgroup_subset`` x
+``cur_shard`` inside ``Reader.__init__`` after the dataset was already
+opened, ``memory_cache_size_bytes`` x ``cache_type`` inside the cache
+factory, ``refresh_interval_s`` x ``shard_seed`` in the live-data wiring.
+Lowering gives them one home: every rule is a row in :data:`CONFLICT_RULES`
+naming (a) the kwargs in conflict and (b) the **operators they induce** —
+because a kwarg conflict is really an operator-graph conflict (an explicit
+ordinal plan and a shard partitioner are two writers of the same ventilate
+plan), and the operator names are what lets a reader of the error find the
+node in ``Reader.explain()`` / docs/plan.md's lowering table.
+
+``Reader.__init__`` calls the same pass (direct ``Reader(...)``
+constructions bypass the ``make_*`` entry points), so there is exactly one
+source of truth for these messages.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["CONFLICT_RULES", "ValidationRule", "validate_reader_config"]
+
+
+class ValidationRule:
+    """:param name: stable rule id (recorded in ``plan.validated``)
+    :param kwargs: the kwarg names in conflict (named in the message)
+    :param operators: the operator ids those kwargs induce
+    :param check: ``cfg -> None | str`` — extra message detail when the
+        rule fires, None when the configuration is fine"""
+
+    def __init__(self, name: str, kwargs: tuple, operators: tuple, check):
+        self.name = name
+        self.kwargs = kwargs
+        self.operators = operators
+        self.check = check
+
+    def error(self, detail: str) -> str:
+        ops = " + ".join(self.operators)
+        kws = " and ".join(self.kwargs)
+        return (f"{kws} conflict at plan time: {detail} "
+                f"[operators: {ops}; see the lowering table in "
+                f"docs/plan.md]")
+
+
+def _get(cfg: dict, name: str, default=None):
+    return cfg.get(name, default)
+
+
+# Each check returns the message DETAIL (the rule wraps it with the kwarg
+# and operator names) or None. Details keep the exact phrases earlier
+# rounds documented and tests pin ("mutually exclusive", "exactly the
+# given", ...).
+def _subset_x_shard(cfg):
+    if _get(cfg, "rowgroup_subset") is not None \
+            and _get(cfg, "cur_shard") is not None:
+        return ("mutually exclusive — an explicit ordinal subset IS a "
+                "shard assignment (the mesh layer computes it with the "
+                "same index %% shard_count arithmetic; docs/mesh.md)")
+    return None
+
+
+def _subset_x_shuffle(cfg):
+    if _get(cfg, "rowgroup_subset") is not None \
+            and _get(cfg, "shuffle_row_groups"):
+        return ("rowgroup_subset delivers row groups in exactly the given "
+                "order; pass shuffle_row_groups=False and shuffle the "
+                "ordinal list itself instead (docs/mesh.md)")
+    return None
+
+
+def _refresh_x_subset(cfg):
+    if _get(cfg, "refresh_interval_s") is not None \
+            and _get(cfg, "rowgroup_subset") is not None:
+        return ("mutually exclusive — an explicit ordinal plan is frozen "
+                "by construction; the mesh layer folds growth into its own "
+                "shard plans (MeshDataLoader.admit_growth, docs/mesh.md)")
+    return None
+
+
+def _refresh_x_shard_seed(cfg):
+    if _get(cfg, "refresh_interval_s") is not None \
+            and _get(cfg, "shard_seed") is not None:
+        return ("cannot compose — a shard_seed pre-shuffled shard "
+                "partition reorders on every new file, so growth could "
+                "not extend monotonically (docs/live_data.md)")
+    return None
+
+
+def _memcache_x_diskcache(cfg):
+    if _get(cfg, "memory_cache_size_bytes") \
+            and _get(cfg, "cache_type") not in (None, "null"):
+        return (f"mutually exclusive with cache_type="
+                f"{cfg.get('cache_type')!r}: the memory tier caches "
+                f"decoded payloads, the disk tier raw ones — pick the "
+                f"tier matching where the time goes (docs/autotune.md)")
+    return None
+
+
+def _window_x_order(cfg):
+    window = int(_get(cfg, "shuffle_window") or 0)
+    if window and _get(cfg, "sample_order", "free") != "deterministic":
+        return ("shuffle_window is the deterministic plane's "
+                "window-shuffle mode; pass sample_order='deterministic' "
+                "with it (docs/determinism.md)")
+    return None
+
+
+def _convert_early_x_serializer(cfg):
+    serializer = _get(cfg, "serializer")
+    if serializer is None or not _get(cfg, "convert_early_to_numpy"):
+        return None
+    from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+    if not isinstance(serializer, PickleSerializer):
+        return ("convert_early_to_numpy publishes numpy dicts, which only "
+                "the PickleSerializer can carry; drop serializer= or "
+                "convert_early_to_numpy")
+    return None
+
+
+#: The consolidated mutual-exclusion table. Order is the check order;
+#: every rule runs (the pass raises on the FIRST violation so messages
+#: stay single-conflict, but ``plan.validated`` records the whole table).
+CONFLICT_RULES = (
+    ValidationRule("rowgroup_subset_x_cur_shard",
+                   ("rowgroup_subset", "cur_shard/shard_count"),
+                   ("ventilate",), _subset_x_shard),
+    ValidationRule("rowgroup_subset_x_shuffle_row_groups",
+                   ("rowgroup_subset", "shuffle_row_groups"),
+                   ("ventilate",), _subset_x_shuffle),
+    ValidationRule("refresh_x_rowgroup_subset",
+                   ("refresh_interval_s", "rowgroup_subset"),
+                   ("discovery", "ventilate"), _refresh_x_subset),
+    ValidationRule("refresh_x_shard_seed",
+                   ("refresh_interval_s", "shard_seed"),
+                   ("discovery", "ventilate"), _refresh_x_shard_seed),
+    ValidationRule("memory_cache_x_disk_cache",
+                   ("memory_cache_size_bytes", "cache_type"),
+                   ("cache", "decode"), _memcache_x_diskcache),
+    ValidationRule("shuffle_window_x_sample_order",
+                   ("shuffle_window", "sample_order"),
+                   ("ordered_gate",), _window_x_order),
+    ValidationRule("convert_early_x_serializer",
+                   ("convert_early_to_numpy", "serializer"),
+                   ("transport",), _convert_early_x_serializer),
+)
+
+
+def validate_reader_config(cfg: dict,
+                           rules=CONFLICT_RULES) -> List[str]:
+    """Run every mutual-exclusion rule over a kwarg dict; raises
+    ``ValueError`` (naming the conflicting kwargs and the operators they
+    induce) on the first violation, returns the list of checked rule
+    names otherwise. Missing keys read as their defaults — callers pass
+    only the kwargs their entry point accepts."""
+    checked = []
+    for rule in rules:
+        detail = rule.check(cfg)
+        if detail is not None:
+            raise ValueError(rule.error(detail))
+        checked.append(rule.name)
+    _validate_enums(cfg)
+    return checked
+
+
+def _validate_enums(cfg: dict) -> None:
+    """Enumerated-value checks that belong to the same plan-time pass
+    (they gate which operators lowering builds)."""
+    sample_order = _get(cfg, "sample_order", "free")
+    if sample_order not in ("free", "deterministic"):
+        raise ValueError(f"sample_order must be 'free' or 'deterministic', "
+                         f"got {sample_order!r}")
+    window: Optional[int] = _get(cfg, "shuffle_window")
+    if window is not None and int(window) < 0:
+        raise ValueError(f"shuffle_window must be >= 0, got {window}")
+    materialization = _get(cfg, "row_materialization", "eager")
+    if materialization not in ("eager", "lazy"):
+        raise ValueError(f"row_materialization must be 'eager' or 'lazy', "
+                         f"got {materialization!r}")

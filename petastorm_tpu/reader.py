@@ -306,16 +306,28 @@ def _warn_predicate_bypasses_cache(predicate, memory_cache_size_bytes):
             "drop memory_cache_size_bytes to silence this.")
 
 
+def _fingerprint_fields(schema, schema_fields) -> list:
+    """The plan-cache fingerprint's field ingredient (docs/plan.md "Plan
+    cache"): the NARROWED output view's names — two readers selecting
+    different column subsets of one dataset are different workloads and
+    must never share a persisted placement verdict. Falls back to the
+    full schema for NGram windows and for view errors (the Reader raises
+    the real error later on the normal path)."""
+    from petastorm_tpu.ngram import NGram
+    if schema_fields is not None and not isinstance(schema_fields, NGram):
+        try:
+            return sorted(schema.create_schema_view(schema_fields).fields)
+        except Exception:  # noqa: BLE001 - fingerprint is best-effort
+            pass
+    return sorted(schema.fields)
+
+
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
                 cache_extra_settings, retry_policy=None, fault_plan=None,
                 memory_cache_size_bytes=None):
     if memory_cache_size_bytes:
-        if cache_type not in (None, "null"):
-            raise ValueError(
-                f"memory_cache_size_bytes and cache_type={cache_type!r} are "
-                f"mutually exclusive: the memory tier caches decoded "
-                f"payloads, the disk tier raw ones — pick the tier matching "
-                f"where the time goes (docs/autotune.md)")
+        # (memory_cache_size_bytes x cache_type conflicts raise in the
+        # plan-time validation pass before this factory runs.)
         from petastorm_tpu.autotune import InMemoryRowGroupCache
         return InMemoryRowGroupCache(memory_cache_size_bytes,
                                      fault_plan=fault_plan)
@@ -607,6 +619,40 @@ def make_reader(dataset_url,
             f"(underlying error: {e}). If this is a plain Parquet store, use "
             f"make_batch_reader() instead.") from e
 
+    # ---------------- plan lowering (docs/plan.md): kwargs -> executable
+    # PipelinePlan — one consolidated validation pass, operator
+    # materialization, fusion passes, and the optimizer's persisted-plan
+    # consult (which may override the pool backend on an opted-in warm
+    # start; plan.pool_type is what construction stands up).
+    from petastorm_tpu.plan import lower_reader_kwargs
+    plan = lower_reader_kwargs(
+        "row",
+        {"dataset_url": dataset_url, "reader_pool_type": reader_pool_type,
+         "workers_count": workers_count,
+         "results_queue_size": results_queue_size,
+         "shuffle_row_groups": shuffle_row_groups,
+         "shuffle_rows": shuffle_rows,
+         "shuffle_row_drop_partitions": shuffle_row_drop_partitions,
+         "predicate": predicate, "transform_spec": transform_spec,
+         "num_epochs": num_epochs,
+         "cur_shard": cur_shard, "shard_seed": shard_seed, "seed": seed,
+         "cache_type": cache_type, "cache_location": cache_location,
+         "cache_size_limit": cache_size_limit,
+         "memory_cache_size_bytes": memory_cache_size_bytes,
+         "rowgroup_coalescing": rowgroup_coalescing,
+         "zmq_copy_buffers": zmq_copy_buffers,
+         "readahead_depth": readahead_depth,
+         "readahead_max_bytes": readahead_max_bytes,
+         "rowgroup_subset": rowgroup_subset,
+         "row_materialization": row_materialization,
+         "sample_order": sample_order, "shuffle_window": shuffle_window,
+         "refresh_interval_s": refresh_interval_s,
+         "autotune": autotune, "autotune_config": autotune_config},
+        schema_field_names=_fingerprint_fields(stored_schema,
+                                               schema_fields),
+        ngram=isinstance(schema_fields, NGram))
+    reader_pool_type = plan.pool_type
+
     _warn_predicate_bypasses_cache(predicate, memory_cache_size_bytes)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
@@ -626,6 +672,7 @@ def make_reader(dataset_url,
     pool = pool_factory(reader_pool_type)
 
     return Reader(ctx, stored_schema,
+                  plan=plan,
                   pool_factory=pool_factory,
                   dataset_url_or_urls=dataset_url,
                   schema_fields=schema_fields,
@@ -792,6 +839,36 @@ def make_batch_reader(dataset_url_or_urls,
     if isinstance(schema_fields, NGram):
         raise ValueError("NGram is not supported by make_batch_reader; use make_reader")
 
+    # ---------------- plan lowering (docs/plan.md) — see make_reader.
+    from petastorm_tpu.plan import lower_reader_kwargs
+    plan = lower_reader_kwargs(
+        "batch",
+        {"dataset_url_or_urls": dataset_url_or_urls,
+         "reader_pool_type": reader_pool_type,
+         "workers_count": workers_count,
+         "results_queue_size": results_queue_size,
+         "shuffle_row_groups": shuffle_row_groups,
+         "shuffle_rows": shuffle_rows,
+         "shuffle_row_drop_partitions": shuffle_row_drop_partitions,
+         "predicate": predicate, "transform_spec": transform_spec,
+         "num_epochs": num_epochs,
+         "cur_shard": cur_shard, "shard_seed": shard_seed, "seed": seed,
+         "cache_type": cache_type, "cache_location": cache_location,
+         "cache_size_limit": cache_size_limit,
+         "memory_cache_size_bytes": memory_cache_size_bytes,
+         "rowgroup_coalescing": rowgroup_coalescing,
+         "zmq_copy_buffers": zmq_copy_buffers,
+         "readahead_depth": readahead_depth,
+         "readahead_max_bytes": readahead_max_bytes,
+         "rowgroup_subset": rowgroup_subset,
+         "convert_early_to_numpy": convert_early_to_numpy,
+         "serializer": serializer,
+         "sample_order": sample_order, "shuffle_window": shuffle_window,
+         "refresh_interval_s": refresh_interval_s,
+         "autotune": autotune, "autotune_config": autotune_config},
+        schema_field_names=_fingerprint_fields(schema, schema_fields))
+    reader_pool_type = plan.pool_type
+
     _warn_predicate_bypasses_cache(predicate, memory_cache_size_bytes)
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, cache_extra_settings,
@@ -823,6 +900,7 @@ def make_batch_reader(dataset_url_or_urls,
     pool = pool_factory(reader_pool_type)
 
     return Reader(ctx, schema,
+                  plan=plan,
                   pool_factory=pool_factory,
                   dataset_url_or_urls=dataset_url_or_urls,
                   schema_fields=schema_fields,
@@ -892,8 +970,13 @@ class Reader:
                  sample_order="free", shuffle_window=0,
                  refresh_interval_s=None, timeline_interval_s=None,
                  timeline_anomaly=True, quality=False, quality_config=None,
-                 reference_profile=None):
+                 reference_profile=None, plan=None):
         self._ctx = ctx
+        #: The lowered :class:`~petastorm_tpu.plan.PipelinePlan` this
+        #: reader executes (docs/plan.md) — None for direct ``Reader(...)``
+        #: constructions, which skip lowering (explain falls back to the
+        #: live-graph builder and no fusion applies).
+        self._plan = plan
         self._pool = pool
         self.is_batched_reader = is_batched_reader
         self.last_row_consumed = False
@@ -928,19 +1011,24 @@ class Reader:
         self.anomaly_monitor = None
         self.blackbox = None
 
+        # ---------------- plan-time validation (docs/plan.md): the one
+        # consolidated mutual-exclusion pass. make_* already ran it inside
+        # lowering; direct Reader(...) constructions get the same rules
+        # (and the same messages) here.
+        from petastorm_tpu.plan import validate_reader_config
+        _validation_cfg = {
+            "rowgroup_subset": rowgroup_subset, "cur_shard": cur_shard,
+            "shuffle_row_groups": shuffle_row_groups,
+            "refresh_interval_s": refresh_interval_s,
+            "shard_seed": shard_seed, "sample_order": sample_order,
+            "shuffle_window": shuffle_window,
+        }
+        if not is_batched_reader:
+            _validation_cfg["row_materialization"] = row_materialization
+        validate_reader_config(_validation_cfg)
+
         # ---------------- deterministic epoch plane (docs/determinism.md)
-        if sample_order not in ("free", "deterministic"):
-            raise ValueError(f"sample_order must be 'free' or "
-                             f"'deterministic', got {sample_order!r}")
         shuffle_window = int(shuffle_window or 0)
-        if shuffle_window < 0:
-            raise ValueError(f"shuffle_window must be >= 0, "
-                             f"got {shuffle_window}")
-        if shuffle_window and sample_order != "deterministic":
-            raise ValueError(
-                "shuffle_window is the deterministic plane's window-shuffle "
-                "mode; pass sample_order='deterministic' with it "
-                "(docs/determinism.md)")
         #: ``'free'`` or ``'deterministic'`` — the delivery-order contract
         #: this reader runs under (docs/determinism.md).
         self.sample_order = sample_order
@@ -957,20 +1045,8 @@ class Reader:
             raise ValueError("cur_shard and shard_count must be used together")
         if cur_shard is not None and not (0 <= cur_shard < shard_count):
             raise ValueError(f"cur_shard {cur_shard} out of range [0, {shard_count})")
-        if rowgroup_subset is not None and cur_shard is not None:
-            raise ValueError(
-                "rowgroup_subset and cur_shard/shard_count are mutually "
-                "exclusive: an explicit ordinal subset IS a shard "
-                "assignment (the mesh layer computes it with the same "
-                "index %% shard_count arithmetic; docs/mesh.md)")
-        if rowgroup_subset is not None and shuffle_row_groups:
-            # The subset's ORDER is its contract (delivery watermarks map
-            # back to plan positions through it); a seeded ventilation
-            # shuffle would silently reorder underneath that arithmetic.
-            raise ValueError(
-                "rowgroup_subset delivers row groups in exactly the given "
-                "order; pass shuffle_row_groups=False and shuffle the "
-                "ordinal list itself instead (docs/mesh.md)")
+        # (rowgroup_subset x cur_shard / x shuffle_row_groups conflicts:
+        # raised by the consolidated plan-time validation pass above.)
 
         # ---------------- schema views
         self.ngram: Optional[NGram] = None
@@ -1001,10 +1077,6 @@ class Reader:
         #: whole columnar row group — :meth:`next_batch` works either way).
         self.row_materialization = "eager"
         if not is_batched_reader:
-            if row_materialization not in ("eager", "lazy"):
-                raise ValueError(
-                    f"row_materialization must be 'eager' or 'lazy', got "
-                    f"{row_materialization!r}")
             if row_materialization == "lazy":
                 if self.ngram is not None:
                     warnings.warn(
@@ -1027,19 +1099,8 @@ class Reader:
             if refresh_interval_s < 0:
                 raise ValueError(f"refresh_interval_s must be >= 0, "
                                  f"got {refresh_interval_s}")
-            if rowgroup_subset is not None:
-                raise ValueError(
-                    "refresh_interval_s and rowgroup_subset are mutually "
-                    "exclusive: an explicit ordinal plan is frozen by "
-                    "construction — the mesh layer folds growth into its "
-                    "own shard plans (MeshDataLoader.admit_growth, "
-                    "docs/mesh.md)")
-            if shard_seed is not None:
-                raise ValueError(
-                    "refresh_interval_s cannot compose with shard_seed: a "
-                    "pre-shuffled shard partition reorders on every new "
-                    "file, so growth could not extend monotonically "
-                    "(docs/live_data.md)")
+            # (refresh x rowgroup_subset / x shard_seed conflicts: raised
+            # by the consolidated plan-time validation pass above.)
             if ctx.is_multi_path:
                 raise ValueError(
                     "refresh_interval_s needs a single dataset root to "
@@ -1433,6 +1494,12 @@ class Reader:
             # the one quality signal only the workers can see (rows the
             # mask dropped never reach the consumer).
             "quality": self.quality_monitor is not None,
+            # Plan fusions (docs/plan.md "Fusion rules"): the byte-identity
+            # -gated operator fusions the lowered plan applied. The
+            # decode->transport fusion only holds while decode runs
+            # in-process; _spawnable_worker_args strips it.
+            "plan_fusions": (self._plan.fusion_names()
+                             if self._plan is not None else frozenset()),
         }
         worker_args = (self._spawnable_worker_args()
                        if isinstance(self._pool, ProcessPool)
@@ -1644,7 +1711,10 @@ class Reader:
             if self.readahead is not None:
                 from petastorm_tpu.autotune import ReadaheadDepthActuator
                 self.autotune.register(ReadaheadDepthActuator(self.readahead))
-            if getattr(autotune_config, "placement", False):
+            persisted_plan = (self._plan is not None
+                              and self._plan.source == "persisted")
+            if getattr(autotune_config, "placement", False) \
+                    and not persisted_plan:
                 # Cedar-style placement tuning (docs/zero_copy.md): only
                 # when a migration can actually be performed — a factory
                 # exists, the pool is a migratable flavor, and no
@@ -1662,11 +1732,31 @@ class Reader:
                             self._request_pool_migration,
                             "process" if isinstance(self._pool, ProcessPool)
                             else "thread"))
+                    # When this run's trial resolves, the verdict persists
+                    # to the plan cache so the NEXT start skips the trial
+                    # (docs/plan.md "Plan cache").
+                    self.autotune.on_placement_resolved = \
+                        self._on_placement_resolved
                 else:
                     warnings.warn(
                         "autotune_config.placement=True ignored: placement "
                         "migration needs a thread/process pool without "
                         "readahead_depth or hang_timeout_s")
+            elif persisted_plan:
+                # Warm start (docs/plan.md): the pool was CONSTRUCTED on
+                # the persisted winner; pin the placement knob so no trial
+                # window ever opens, and seed the registered actuators
+                # with the persisted run's converged values (clamped by
+                # each actuator's own safe range).
+                self.autotune.pin_placement(
+                    {"verdict": "persisted",
+                     "backend": self._plan.pool_type,
+                     "trial": self._plan.trial})
+                for name, value in (self._plan.capacity_seeds.get(
+                        "actuators") or {}).items():
+                    seeded = self.autotune.actuator(name)
+                    if seeded is not None:
+                        seeded.set(value)
             self.autotune.start()
 
         if self.readahead is not None:
@@ -1727,6 +1817,10 @@ class Reader:
                 bb_dir, self.telemetry, label="reader",
                 config=self._config_summary())
             self.blackbox.add_collector("cursor", self.state_dict)
+            # Postmortems show what the optimizer chose and why: plan
+            # source (default/persisted/trial), trial verdict, fusions
+            # (docs/plan.md).
+            self.blackbox.add_collector("plan", self.plan_report)
             self.blackbox.add_collector("quarantine", self.quarantine_report)
             self.blackbox.add_collector("pruning", self.pruning_report)
             self.blackbox.add_collector("readahead", self.readahead_report)
@@ -2224,11 +2318,19 @@ class Reader:
         from the URL, retry without the shared registry, read inline
         instead of popping the shared readahead store, and have no
         cross-process cancel flag to consult."""
+        from petastorm_tpu.plan import FUSION_DECODE_TRANSPORT
         return {**self._worker_args_inproc,
                 "filesystem": None,
                 "resilience_telemetry": None,
                 "cancel_token": None,
-                "readahead": None}
+                "readahead": None,
+                # Spawned workers must publish Arrow tables — the process
+                # pool's Arrow IPC serializer is the transport; the
+                # in-process decode->transport fusion does not apply there
+                # (docs/plan.md "Fusion rules").
+                "plan_fusions": frozenset(
+                    self._worker_args_inproc.get("plan_fusions") or ())
+                - {FUSION_DECODE_TRANSPORT}}
 
     def _sync_pool_gauges(self, pool) -> None:
         """Point every pool-derived telemetry gauge at ``pool`` — one sync
@@ -2588,6 +2690,23 @@ class Reader:
 
     # ------------------------------------------------------------- lifetime
     def stop(self):
+        if self._plan is not None and self._plan.source == "trial" \
+                and self._plan.trial is not None \
+                and self._plan.trial.get("verdict") in ("kept", "reverted"):
+            # Refresh the persisted record with end-of-run evidence: the
+            # at-resolution snapshot was taken moments after the migration
+            # (the winning pool's counters near zero), so the full-epoch
+            # profile and final knob positions seed the next warm start's
+            # roofline far better (docs/plan.md "Plan cache").
+            try:
+                from petastorm_tpu.plan import record_trial_outcome
+                record_trial_outcome(
+                    self._plan, self._plan.trial,
+                    actuators=(self.autotune.actuator_values()
+                               if self.autotune is not None else {}),
+                    profile=self.explain(profiled=True).profile)
+            except Exception:  # noqa: BLE001 - persistence never kills IO
+                logger.exception("plan-cache refresh at close failed")
         if self._discovery is not None:
             self._discovery.stop()
         if self.watchdog is not None:
@@ -2683,6 +2802,43 @@ class Reader:
         verdict). Empty dict when ``autotune`` is off. See docs/autotune.md
         for the schema."""
         return {} if self.autotune is None else self.autotune.report()
+
+    def plan_report(self) -> dict:
+        """The executed plan's decisions (docs/plan.md): placement and its
+        source (``default``/``persisted``/``trial``), the trial verdict
+        when one resolved, applied/declined fusions, the plan-cache
+        consult outcome, and capacity seeds. Empty dict for direct
+        ``Reader(...)`` constructions (no lowering ran)."""
+        return {} if self._plan is None else self._plan.describe()
+
+    def _on_placement_resolved(self, outcome: dict) -> None:
+        """Controller callback at placement-trial resolution: record the
+        verdict on the live plan and persist the winner (plus the tuned
+        actuator values and the measured operator profile, the warm
+        start's capacity seeds) to the plan cache. Failures only cost the
+        warm start, never the run."""
+        if self._plan is None:
+            return
+        if outcome.get("verdict") not in ("kept", "reverted"):
+            # Apply-failure pins are not measured verdicts; persisting one
+            # would freeze a backend that was never compared.
+            self._plan.trial = dict(outcome)
+            self._explain_dirty = True
+            return
+        try:
+            from petastorm_tpu.plan import record_trial_outcome
+            actuators = (self.autotune.actuator_values()
+                         if self.autotune is not None else {})
+            try:
+                profile = self.explain(profiled=True).profile
+            except Exception:  # noqa: BLE001 - profile is a best-effort seed
+                profile = None
+            record_trial_outcome(self._plan, outcome, actuators=actuators,
+                                 profile=profile)
+        except Exception:  # noqa: BLE001 - persistence must never kill IO
+            logger.exception("plan-cache persist failed; trial verdict "
+                             "still applies to this run")
+        self._explain_dirty = True
 
     def slo_report(self) -> dict:
         """SLO watcher readout: the rule set, violation tallies per rule,
@@ -2810,6 +2966,8 @@ class Reader:
             "shuffle_window": self._shuffle_window,
             "seed": self._seed,
             "num_items": getattr(self, "_num_items", None),
+            "plan_source": (self._plan.source if self._plan is not None
+                            else None),
         }
 
     def _record_fatal(self, exc: BaseException) -> None:

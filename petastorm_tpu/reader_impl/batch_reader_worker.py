@@ -52,6 +52,13 @@ class BatchReaderWorker(WorkerBase):
         # Deterministic epoch plane (docs/determinism.md): one OrderedUnit
         # envelope per work item, exactly as in RowReaderWorker.
         self._ordered = args.get("sample_order", "free") == "deterministic"
+        # Plan fusions (docs/plan.md "Fusion rules"): byte-identity-gated
+        # rewrites from the lowered plan. "mask_decode_transform" reads
+        # predicate + output columns in ONE IO call; "decode_transport"
+        # (in-process pools only — the Reader strips it from spawned
+        # worker args) converts Arrow->numpy INSIDE the worker so the
+        # consumer pops ready column dicts.
+        self._fusions = frozenset(args.get("plan_fusions") or ())
         # Data-quality plane (docs/observability.md "Data quality plane"):
         # predicate selectivity counters, as in RowReaderWorker — masked
         # rows never reach the consumer's profiler, so this is worker-only
@@ -171,10 +178,14 @@ class BatchReaderWorker(WorkerBase):
         out_schema = self.args.get("output_schema", view_schema)
         keep = [n for n in table.column_names if n in out_schema.fields]
         table = table.select(keep)
-        if self.args.get("convert_early_to_numpy"):
+        if self.args.get("convert_early_to_numpy") \
+                or "decode_transport" in self._fusions:
             # Worker-side conversion (parity: reference
             # arrow_reader_worker.py:279): worker parallelism absorbs the
-            # Arrow->numpy cost; payloads cross pools as numpy dicts.
+            # Arrow->numpy cost. convert_early_to_numpy ships numpy dicts
+            # across pools; the decode->transport fusion (docs/plan.md)
+            # runs the IDENTICAL conversion in-process so the consumer
+            # thread never converts — byte-identical by construction.
             return arrow_table_to_numpy_dict(table, out_schema)
         return table
 
@@ -232,7 +243,25 @@ class BatchReaderWorker(WorkerBase):
 
     def _load_table(self, rowgroup, needed, predicate, drop_part, cache, rng):
         part_index, num_parts = drop_part
-        if predicate is not None:
+        if predicate is not None \
+                and "mask_decode_transform" in self._fusions:
+            # Fused mask+decode (docs/plan.md "Fusion rules"): ONE read
+            # covers predicate and output columns; the mask evaluates over
+            # a zero-copy column selection of the same table. Identical
+            # values to the two-read path (the unfused early-exit only
+            # saves the second read when a whole group masks out).
+            pred_fields = set(predicate.get_fields())
+            table = self._read_table(rowgroup, needed | pred_fields)
+            pred_table = table.select(
+                [n for n in table.column_names if n in pred_fields])
+            mask = self._predicate_mask(pred_table, predicate)
+            self._record_predicate_selectivity(table.num_rows,
+                                               int(mask.sum()))
+            if not mask.any():
+                return None
+            keep = [n for n in table.column_names if n in needed]
+            table = table.select(keep).filter(pa.array(mask))
+        elif predicate is not None:
             pred_fields = sorted(predicate.get_fields())
             pred_table = self._read_table(rowgroup, set(pred_fields))
             mask = self._predicate_mask(pred_table, predicate)

@@ -440,6 +440,11 @@ class RowReaderWorker(WorkerBase):
         # so the consumer-side reorder gate can account for every plan
         # position regardless of completion order.
         self._ordered = args.get("sample_order", "free") == "deterministic"
+        # Plan fusions (docs/plan.md "Fusion rules"): byte-identity-gated
+        # rewrites the lowered plan applied. "mask_decode_transform" fuses
+        # the predicate path into one read + one predicate-column decode
+        # per row group.
+        self._fusions = frozenset(args.get("plan_fusions") or ())
         # Data-quality plane (docs/observability.md "Data quality plane"):
         # predicate selectivity is the one quality signal only the worker
         # can see — masked-out rows never reach the consumer's profiler.
@@ -520,9 +525,18 @@ class RowReaderWorker(WorkerBase):
         rng = item_shuffle_rng(self.args.get("seed"), shuffle_context, self._rng)
 
         decoded_cache = False
+        predecoded = None
         if predicate is not None:
-            data, indices = self._load_columns_with_predicate(
-                rowgroup, needed, predicate, shuffle_row_drop_partition, rng)
+            # Fused mask+decode+transform (docs/plan.md "Fusion rules"):
+            # ONE read covering predicate + output columns, and the
+            # whole-group predicate-column decode reused for the output by
+            # index selection. NGram readers stay unfused (the plan's
+            # fusion pass never enables it for them).
+            fused = ("mask_decode_transform" in self._fusions
+                     and ngram is None)
+            data, indices, predecoded = self._load_columns_with_predicate(
+                rowgroup, needed, predicate, shuffle_row_drop_partition,
+                rng, fused=fused)
         else:
             data, indices, decoded_cache = self._maybe_cached(
                 rowgroup, needed, shuffle_row_drop_partition, rng)
@@ -542,7 +556,8 @@ class RowReaderWorker(WorkerBase):
             if decoded_cache:
                 cols = self._cols_from_decoded(data, indices)
             else:
-                cols = self._decode_columns(data, indices)
+                cols = self._decode_columns(data, indices,
+                                            reuse=predecoded)
             if batched_transform:
                 cols = apply_batched_transform(transform_spec, cols)
             if self._lazy:
@@ -572,7 +587,8 @@ class RowReaderWorker(WorkerBase):
         else:
             # Column-major decode on both paths, so image columns keep the
             # native batch decoder under predicates too.
-            decoded = self._decode_columns_to_rows(data, indices)
+            decoded = self._decode_columns_to_rows(data, indices,
+                                                   reuse=predecoded)
 
         if transform_spec is not None and transform_spec.func is not None:
             decoded = [transform_spec.func(r) for r in decoded]
@@ -756,15 +772,17 @@ class RowReaderWorker(WorkerBase):
             return copy.deepcopy(v)
         return v  # immutable (or a user type we cannot safely clone)
 
-    def _decode_columns_to_rows(self, data: dict, indices) -> List[dict]:
+    def _decode_columns_to_rows(self, data: dict, indices,
+                                reuse=None) -> List[dict]:
         """Column-major decode, then row assembly — one tight loop per field
         instead of a per-row schema walk (the row-path analog of the batch
         worker's vectorized conversion)."""
-        cols = self._decode_columns(data, indices)
+        cols = self._decode_columns(data, indices, reuse=reuse)
         names = list(cols.keys())
         return [{n: cols[n][j] for n in names} for j in range(len(indices))]
 
-    def _decode_columns(self, data: dict, indices, schema=None) -> dict:
+    def _decode_columns(self, data: dict, indices, schema=None,
+                        reuse=None) -> dict:
         """Codec-decode the selected rows of every needed column; returns
         ``{name: per-row decoded values}`` (list, or ndarray from one of
         the batched column decoders). Shared by the row path above, the
@@ -787,7 +805,23 @@ class RowReaderWorker(WorkerBase):
                                                 native_image_eligible)
         cols = {}
         plan = (self._decode_schema if schema is None else schema).decode_plan
+        idx = None
         for name, field, codec in plan:
+            if reuse is not None and name in reuse:
+                # Fused predicate path (docs/plan.md "Fusion rules"): this
+                # column was already decoded whole-group for the mask —
+                # select the surviving rows instead of decoding again.
+                # Byte-identical: every decode kernel is cell-independent,
+                # and the scalar kernel's cast-then-select equals
+                # select-then-cast bit-for-bit.
+                full = reuse[name]
+                if idx is None:
+                    idx = np.asarray(indices, dtype=np.intp)
+                if isinstance(full, np.ndarray):
+                    cols[name] = full[idx]
+                else:
+                    cols[name] = [full[i] for i in idx]
+                continue
             src = data.get(name)
             if src is None:
                 continue
@@ -839,17 +873,27 @@ class RowReaderWorker(WorkerBase):
         return _inject_partition_values(data, table.num_rows, rowgroup, columns)
 
     def _load_columns_with_predicate(self, rowgroup, needed, predicate,
-                                     drop_part, rng):
+                                     drop_part, rng, fused=False):
         """Load predicate columns first; early-exit if nothing matches
-        (parity: reference :197). Returns ``(columns, surviving indices)``
-        so the caller can decode column-major like the no-predicate path.
+        (parity: reference :197). Returns ``(columns, surviving indices,
+        predecoded)`` so the caller can decode column-major like the
+        no-predicate path.
 
         Evaluation is batch-native (docs/io.md): the predicate columns
         decode COLUMN-MAJOR (the same batched codec kernels as the output
         path) and the predicate answers with ONE vectorized mask per row
         group (``do_include_batch``); predicates without a kernel fall
         back to per-row ``do_include`` over the same decoded columns —
-        identical decisions, no per-row codec walk either way."""
+        identical decisions, no per-row codec walk either way.
+
+        ``fused=True`` is the plan's mask+decode+transform fusion
+        (docs/plan.md "Fusion rules"): ONE read covers predicate and
+        output columns together (the unfused path's early-exit saves the
+        second read only when a whole row group masks out), and the
+        returned ``predecoded`` dict hands the whole-group decoded
+        predicate columns to the output decode for reuse by index
+        selection — byte-identical either way, one row-group pass instead
+        of two."""
         schema = self.args["schema"]
         predicate_fields = set(predicate.get_fields())
         unknown = predicate_fields - set(schema.fields.keys()) - {
@@ -857,7 +901,11 @@ class RowReaderWorker(WorkerBase):
         if unknown:
             raise ValueError(f"Predicate references unknown fields: {sorted(unknown)}")
 
-        pred_data = self._read_columns(rowgroup, predicate_fields)
+        if fused:
+            pred_data = self._read_columns(rowgroup,
+                                           needed | predicate_fields)
+        else:
+            pred_data = self._read_columns(rowgroup, predicate_fields)
         num_rows = len(next(iter(pred_data.values()))) if pred_data else 0
         # Predicates run on *decoded* values; partition keys and other
         # non-schema fields pass through raw, exactly as before.
@@ -865,13 +913,14 @@ class RowReaderWorker(WorkerBase):
             [n for n in sorted(predicate_fields) if n in schema.fields])
         decoded = self._decode_columns(pred_data, range(num_rows),
                                        schema=pred_schema)
-        passthrough = {k: v for k, v in pred_data.items()
-                       if k not in pred_schema.fields}
+        passthrough = {k: pred_data[k] for k in predicate_fields
+                       if k in pred_data and k not in pred_schema.fields}
         mask = evaluate_predicate_mask(predicate,
                                        {**passthrough, **decoded}, num_rows)
         self._record_predicate_selectivity(num_rows, int(mask.sum()))
+        predecoded = decoded if fused else None
         if not mask.any():
-            return pred_data, []
+            return pred_data, [], predecoded
 
         part_index, num_parts = drop_part
         indices = select_drop_partition(num_rows, part_index, num_parts,
@@ -879,8 +928,10 @@ class RowReaderWorker(WorkerBase):
         indices = np.asarray(indices, dtype=np.intp)
         indices = indices[mask[indices]]
 
+        if fused:
+            return pred_data, indices, predecoded
         other_fields = needed - predicate_fields
         if other_fields:
             other_data = self._read_columns(rowgroup, other_fields)
-            return {**pred_data, **other_data}, indices
-        return pred_data, indices
+            return {**pred_data, **other_data}, indices, None
+        return pred_data, indices, None

@@ -47,6 +47,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PHASE_RE = re.compile(
     r"(_samples_per_sec|_per_sec|_speedup|_improvement)$")
 
+#: Lower-is-better phase keys (suffix match): time-to-first-batch
+#: latencies from the plan warm-start phase (docs/plan.md) — a regression
+#: here is an INCREASE beyond the threshold.
+_LOWER_PHASE_RE = re.compile(r"_ttfb_s$")
+
 
 def load_round(path: str) -> dict:
     """The bench JSON line of one round artifact, unwrapping the driver's
@@ -82,7 +87,8 @@ def phase_values(doc: dict) -> dict:
             if isinstance(v, dict) and not prefix:  # one level deep only
                 visit(f"{k}.", v)
             elif isinstance(v, (int, float)) and not isinstance(v, bool) \
-                    and (_PHASE_RE.search(k) or (not prefix and k == "value")):
+                    and (_PHASE_RE.search(k) or _LOWER_PHASE_RE.search(k)
+                         or (not prefix and k == "value")):
                 p50 = d.get(f"{k}_p50")
                 out[name] = float(p50 if isinstance(p50, (int, float))
                                   else v)
@@ -108,7 +114,8 @@ def compare(old: dict, new: dict, threshold: float) -> tuple:
             continue
         delta = (n - o) / o
         status = "ok"
-        if delta < -threshold:
+        lower_is_better = bool(_LOWER_PHASE_RE.search(key.split(".")[-1]))
+        if (delta > threshold) if lower_is_better else (delta < -threshold):
             status = "REGRESSION"
             regressions.append(key)
         rows.append((key, status, o, n, delta))

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Lint guard: every reader kwarg must appear in the plan lowering table.
+
+The plan plane (docs/plan.md) is only truthful if the lowering table
+``petastorm_tpu/plan/lowering.py::LOWERING_TABLE`` covers every kwarg the
+``make_reader``/``make_batch_reader`` signatures accept — a new kwarg
+added without a table entry silently vanishes from the lowered plan, the
+explain output, and the docs table, and nothing else fails. This AST
+check pins the contract (mirroring ``tools/check_operators.py``): every
+parameter of either entry point must be a key in the table, or carry a
+``lowering-ok`` waiver comment on its signature line saying why it has no
+operator.
+
+Usage::
+
+    python tools/check_lowering.py          # check the signatures
+    python tools/check_lowering.py --list   # print the lowering table
+
+Exit code 1 on any violation (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+READER_FILE = os.path.join("petastorm_tpu", "reader.py")
+LOWERING_FILE = os.path.join("petastorm_tpu", "plan", "lowering.py")
+ENTRY_POINTS = ("make_reader", "make_batch_reader")
+TABLE_NAME = "LOWERING_TABLE"
+WAIVER = "lowering-ok"
+
+
+def load_lowering_table(repo_root: str) -> dict:
+    """Parse ``LOWERING_TABLE`` out of the lowering module's source (a
+    dict literal of string keys) without importing it."""
+    path = os.path.join(repo_root, LOWERING_FILE)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if TABLE_NAME in targets and isinstance(node.value, ast.Dict):
+                out = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        ops = tuple(
+                            e.value for e in getattr(v, "elts", ())
+                            if isinstance(e, ast.Constant))
+                        out[k.value] = ops
+                return out
+    raise ValueError(f"{LOWERING_FILE} does not define {TABLE_NAME} as a "
+                     f"dict literal — the plan plane's lowering table "
+                     f"moved; update tools/check_lowering.py")
+
+
+def check_signatures(repo_root: str, table: dict) -> list:
+    """Violations: entry-point kwargs missing from the lowering table and
+    not waived on their signature line."""
+    path = os.path.join(repo_root, READER_FILE)
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name not in ENTRY_POINTS:
+            continue
+        args = node.args
+        for arg in list(args.args) + list(args.kwonlyargs):
+            if arg.arg in table:
+                continue
+            line = lines[arg.lineno - 1]
+            if WAIVER in line:
+                continue
+            violations.append(
+                f"{READER_FILE}:{arg.lineno}: {node.name}() kwarg "
+                f"{arg.arg!r} has no {TABLE_NAME} entry (add one in "
+                f"{LOWERING_FILE} naming the operator(s) it induces, or "
+                f"waive with `# {WAIVER}: <reason>`)")
+    return violations
+
+
+def main(argv) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    table = load_lowering_table(repo_root)
+    if "--list" in argv:
+        for kwarg in sorted(table):
+            print(f"{kwarg:<28} -> {', '.join(table[kwarg])}")
+        return 0
+    violations = check_signatures(repo_root, table)
+    if violations:
+        print(f"check_lowering: {len(violations)} kwarg(s) missing from "
+              f"the lowering table:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"check_lowering: clean ({len(table)} kwargs lowered across "
+          f"{len(ENTRY_POINTS)} entry points)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
