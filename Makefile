@@ -63,6 +63,10 @@ ci-lint:
 	# the committed quality-on bench snapshot must hold the drift SLO — a
 	# shipped profile/scoring regression fails the BUILD.
 	python -m petastorm_tpu.telemetry check bench_snapshots/quality_epoch.json --slo "quality.max_drift<=0.2"
+	# Telemetry-fabric contract (docs/observability.md "Telemetry fabric"):
+	# the committed healthy 3-publisher fleet snapshot must replay clean —
+	# a fabric aggregation/federation regression fails the BUILD.
+	python -m petastorm_tpu.telemetry check bench_snapshots/fleet_telemetry_epoch.json --anomaly
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

@@ -315,7 +315,9 @@ class MeshDataLoader(LoaderBase):
                  strict: bool = False, resume_state: Optional[dict] = None,
                  num_rowgroups: Optional[int] = None,
                  host_queue_depth: int = 2,
-                 timeline_interval_s: Optional[float] = None, **kwargs):
+                 timeline_interval_s: Optional[float] = None,
+                 telemetry_publish: Optional[str] = None,
+                 tenant: Optional[str] = None, **kwargs):
         from jax.sharding import NamedSharding, PartitionSpec
 
         from petastorm_tpu.parallel.mesh import (batch_shard_count, make_mesh,
@@ -496,6 +498,18 @@ class MeshDataLoader(LoaderBase):
             self._timeline.add_listener(self.anomaly_monitor.observe_window)
             self._timeline_sampler = TimelineSampler(
                 self.telemetry, self._timeline, interval).start()
+        # Telemetry fabric (docs/observability.md "Telemetry fabric"):
+        # stream the mesh coordinator's registry — which already rolls up
+        # per-host counters — as one fabric member.
+        self._telemetry_publisher = None
+        self._tenant = tenant
+        from petastorm_tpu.telemetry.fabric import publish_addr_from_env
+        publish_addr = (telemetry_publish if telemetry_publish is not None
+                        else publish_addr_from_env())
+        if publish_addr:
+            from petastorm_tpu.telemetry.fabric import TelemetryPublisher
+            self._telemetry_publisher = TelemetryPublisher(
+                self.telemetry, publish_addr, tenant=tenant).start()
         from petastorm_tpu.telemetry.postmortem import (
             BlackBox, blackbox_dir_from_env)
         bb_dir = blackbox_dir_from_env()
@@ -1548,6 +1562,10 @@ class MeshDataLoader(LoaderBase):
             # After the host plane joined: the terminal window covers the
             # last per-host counter syncs.
             self._timeline_sampler.stop()
+        if self._telemetry_publisher is not None:
+            # Last: the final (`bye`) window ships the fully-joined state.
+            self._telemetry_publisher.stop()
+            self._telemetry_publisher = None
 
     # ------------------------------------------------------------ reporting
     def mesh_report(self) -> dict:

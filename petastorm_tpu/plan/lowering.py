@@ -93,6 +93,8 @@ LOWERING_TABLE = {
     "quality": ("telemetry",),
     "quality_config": ("telemetry",),
     "reference_profile": ("telemetry",),
+    "telemetry_publish": ("telemetry",),
+    "tenant": ("telemetry",),
     # drop-in petastorm compatibility, ignored (warned about)
     "hdfs_driver": ("compat",),
     "pyarrow_serialize": ("compat",),

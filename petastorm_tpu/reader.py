@@ -396,7 +396,9 @@ def make_reader(dataset_url,
                 timeline_anomaly: bool = True,
                 quality: bool = False,
                 quality_config=None,
-                reference_profile=None):
+                reference_profile=None,
+                telemetry_publish: Optional[str] = None,
+                tenant: Optional[str] = None):
     """Reader for **petastorm-written** datasets (codec-decoded rows).
 
     :param schema_fields: list of UnischemaField / name regexes narrowing the
@@ -717,7 +719,9 @@ def make_reader(dataset_url,
                   timeline_anomaly=timeline_anomaly,
                   quality=quality,
                   quality_config=quality_config,
-                  reference_profile=reference_profile)
+                  reference_profile=reference_profile,
+                  telemetry_publish=telemetry_publish,
+                  tenant=tenant)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -772,7 +776,9 @@ def make_batch_reader(dataset_url_or_urls,
                       timeline_anomaly: bool = True,
                       quality: bool = False,
                       quality_config=None,
-                      reference_profile=None):
+                      reference_profile=None,
+                      telemetry_publish: Optional[str] = None,
+                      tenant: Optional[str] = None):
     """Columnar reader for **any** Parquet store (one numpy batch per row
     group; batch size = row-group size).
 
@@ -945,7 +951,9 @@ def make_batch_reader(dataset_url_or_urls,
                   timeline_anomaly=timeline_anomaly,
                   quality=quality,
                   quality_config=quality_config,
-                  reference_profile=reference_profile)
+                  reference_profile=reference_profile,
+                  telemetry_publish=telemetry_publish,
+                  tenant=tenant)
 
 
 class Reader:
@@ -970,7 +978,8 @@ class Reader:
                  sample_order="free", shuffle_window=0,
                  refresh_interval_s=None, timeline_interval_s=None,
                  timeline_anomaly=True, quality=False, quality_config=None,
-                 reference_profile=None, plan=None):
+                 reference_profile=None, telemetry_publish=None,
+                 tenant=None, plan=None):
         self._ctx = ctx
         #: The lowered :class:`~petastorm_tpu.plan.PipelinePlan` this
         #: reader executes (docs/plan.md) — None for direct ``Reader(...)``
@@ -1877,6 +1886,23 @@ class Reader:
             self._timeline_sampler = TimelineSampler(
                 self.telemetry, self._timeline, interval).start()
 
+        # ---------------- telemetry fabric (docs/observability.md
+        # "Telemetry fabric"): `telemetry_publish=` or
+        # PETASTORM_TPU_TELEMETRY_PUBLISH=addr streams this registry's
+        # delta-encoded metric windows (plus the per-tenant accounting
+        # record) to a live aggregator. `tenant=` labels every window
+        # regardless of whether a publisher runs — it also stamps
+        # accounting_report().
+        self._telemetry_publisher = None
+        self._tenant = tenant
+        from petastorm_tpu.telemetry.fabric import publish_addr_from_env
+        publish_addr = (telemetry_publish if telemetry_publish is not None
+                        else publish_addr_from_env())
+        if publish_addr:
+            from petastorm_tpu.telemetry.fabric import TelemetryPublisher
+            self._telemetry_publisher = TelemetryPublisher(
+                self.telemetry, publish_addr, tenant=tenant).start()
+
         # ---------------- explain plane (docs/observability.md "Explain
         # plane"): the operator graph is materialized lazily on the first
         # explain() call and re-snapshotted — previous spec flagged
@@ -2720,6 +2746,12 @@ class Reader:
             self._timeline_sampler.stop()
         if self.autotune is not None:
             self.autotune.stop()
+        if self._telemetry_publisher is not None:
+            # After the sampler stop for the same reason as the exporter:
+            # the publisher's final (`bye`) window ships the terminal
+            # state the aggregator bills and renders last.
+            self._telemetry_publisher.stop()
+            self._telemetry_publisher = None
         if self._telemetry_exporter is not None:
             self._telemetry_exporter.stop()
             self._telemetry_exporter = None
@@ -2860,6 +2892,20 @@ class Reader:
         is off (the detectors run over timeline windows)."""
         return ({} if self.anomaly_monitor is None
                 else self.anomaly_monitor.report())
+
+    def accounting_report(self) -> dict:
+        """Per-pipeline resource-accounting totals (docs/observability.md
+        "Telemetry fabric"): rows, bytes read/decoded, decode/fetch
+        seconds, and cache hits derived from this registry's counters,
+        stamped with the pipeline id and the ``tenant=`` label — the
+        same record a running publisher streams to the aggregator's
+        ledger. Always available (the source counters are always on)."""
+        from petastorm_tpu.telemetry.accounting import (
+            ACCOUNTING_SCHEMA_VERSION, accounting_totals)
+        return {"schema_version": ACCOUNTING_SCHEMA_VERSION,
+                "pipeline_id": self.telemetry.pipeline_id,
+                "tenant": self._tenant,
+                "totals": accounting_totals(self.telemetry.metrics_view())}
 
     def quality_report(self) -> dict:
         """Data-quality plane readout (docs/observability.md "Data

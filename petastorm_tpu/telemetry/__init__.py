@@ -105,20 +105,33 @@ from petastorm_tpu.telemetry.anomaly import (AnomalyMonitor,  # noqa: E402
 from petastorm_tpu.telemetry.postmortem import (BLACKBOX_ENV,  # noqa: E402
                                                 BlackBox,
                                                 blackbox_dir_from_env)
+from petastorm_tpu.telemetry.fabric import (FABRIC_SCHEMA_VERSION,  # noqa: E402
+                                            TELEMETRY_PUBLISH_ENV,
+                                            TelemetryAggregator,
+                                            TelemetryPublisher,
+                                            fabric_available,
+                                            publish_addr_from_env)
+from petastorm_tpu.telemetry.accounting import (  # noqa: E402
+    ACCOUNTING_FIELDS, ACCOUNTING_SCHEMA_VERSION, AccountingLedger,
+    accounting_totals, merge_accounting_reports)
 
 __all__ = [
+    "ACCOUNTING_FIELDS", "ACCOUNTING_SCHEMA_VERSION", "AccountingLedger",
     "AnomalyMonitor", "AnomalyRule", "BLACKBOX_ENV", "BlackBox",
     "Counter", "CriticalPathAttributor", "DEFAULT_RULES", "DEFAULT_SERIES",
-    "Gauge", "LATENCY_BOUNDS_S", "MetricsTimeline", "PeriodicExporter",
-    "SIZE_BOUNDS", "SLO_WATCH_ENV", "SNAPSHOT_SCHEMA_VERSION",
-    "SeriesSpec", "SloRule", "SloWatcher", "Span", "SpanRecorder",
-    "StallAttributor", "StreamingHistogram", "TELEMETRY_EXPORT_ENV",
-    "TELEMETRY_SPANS_ENV", "TELEMETRY_TRACE_ENV", "TIMELINE_ENV",
-    "TelemetryRegistry", "TimelineSampler", "TraceContext",
-    "blackbox_dir_from_env", "complete_lineages", "default_anomaly_rules",
-    "detect_over_timeline", "evaluate_rules", "federate_snapshots",
+    "FABRIC_SCHEMA_VERSION", "Gauge", "LATENCY_BOUNDS_S", "MetricsTimeline",
+    "PeriodicExporter", "SIZE_BOUNDS", "SLO_WATCH_ENV",
+    "SNAPSHOT_SCHEMA_VERSION", "SeriesSpec", "SloRule", "SloWatcher",
+    "Span", "SpanRecorder", "StallAttributor", "StreamingHistogram",
+    "TELEMETRY_EXPORT_ENV", "TELEMETRY_PUBLISH_ENV", "TELEMETRY_SPANS_ENV",
+    "TELEMETRY_TRACE_ENV", "TIMELINE_ENV", "TelemetryAggregator",
+    "TelemetryPublisher", "TelemetryRegistry", "TimelineSampler",
+    "TraceContext", "accounting_totals", "blackbox_dir_from_env",
+    "complete_lineages", "default_anomaly_rules", "detect_over_timeline",
+    "evaluate_rules", "fabric_available", "federate_snapshots",
     "federate_timelines", "from_json", "lineage_index", "make_registry",
-    "parse_prometheus_text", "parse_rules", "timeline_interval_from_env",
+    "merge_accounting_reports", "parse_prometheus_text", "parse_rules",
+    "publish_addr_from_env", "timeline_interval_from_env",
     "to_chrome_trace", "to_json", "to_prometheus_text",
     "write_chrome_trace", "write_snapshot",
 ]

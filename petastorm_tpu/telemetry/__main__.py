@@ -14,6 +14,9 @@ fresh JSON snapshot on disk; this tool renders it:
     python -m petastorm_tpu.telemetry explain --diff runA.json runB.json
     python -m petastorm_tpu.telemetry check /tmp/pt.json --slo input_stall_pct<=1 --anomaly
     python -m petastorm_tpu.telemetry postmortem /tmp/blackbox/reader-123-01-pipelinehungerror
+    python -m petastorm_tpu.telemetry serve tcp://0.0.0.0:5556 --flush /tmp/fleet.json
+    python -m petastorm_tpu.telemetry top --connect tcp://0.0.0.0:5556
+    python -m petastorm_tpu.telemetry top /tmp/fleet.json --follow
 
 ``dump`` prints one rendering and exits; ``watch`` re-renders every
 ``--interval`` seconds until interrupted (or ``--count`` iterations, for
@@ -33,10 +36,24 @@ per-operator cost columns and the measured bottleneck (two files with
 "Explain plane". ``check`` evaluates SLO rules against a snapshot — plus
 the anomaly detectors over its timeline with ``--anomaly`` — and exits
 non-zero on any violation: the CI/bench gate. ``postmortem`` renders a black-box
-bundle directory (docs/observability.md "Postmortem black box"). Exit
-codes: 1 when a snapshot file/bundle is missing/unreadable (every
-subcommand), 2 when ``check`` finds violations or anomalies, 1 when
-``trace`` finds no trace events.
+bundle directory (docs/observability.md "Postmortem black box").
+
+The telemetry-fabric commands (docs/observability.md "Telemetry
+fabric"): ``serve`` binds a :class:`~petastorm_tpu.telemetry.fabric.
+TelemetryAggregator` at a ZeroMQ address — pipelines started with
+``telemetry_publish=addr`` / ``PETASTORM_TPU_TELEMETRY_PUBLISH=addr``
+stream to it — and keeps a fleet snapshot flushed to ``--flush PATH``,
+where every file subcommand (including ``check --anomaly``) consumes it
+unmodified. ``top --connect addr`` binds the same aggregator in-process
+and renders the live fleet: aggregate + per-member sparklines, member
+liveness (silent members flagged), and the per-tenant accounting table;
+given a snapshot path too, it degrades to file mode when the wire is
+unavailable. ``top``/``watch`` ``--follow`` waits for a missing or
+half-written snapshot file instead of exiting.
+
+Exit codes: 1 when a snapshot file/bundle is missing/unreadable (every
+subcommand, unless ``--follow``), 2 when ``check`` finds violations or
+anomalies, 1 when ``trace`` finds no trace events.
 """
 from __future__ import annotations
 
@@ -180,6 +197,42 @@ def _timeline_series(snap: dict) -> dict:
             for name in sorted(names)}
 
 
+def _render_fleet(snap: dict) -> list:
+    """Fabric-aggregator extras (docs/observability.md "Telemetry
+    fabric"): member liveness table + per-tenant accounting. Present in
+    ``TelemetryAggregator.fleet_snapshot()`` / ``serve --flush`` output;
+    empty for single-pipeline snapshots."""
+    lines = []
+    members = snap.get("fabric_members") or {}
+    if members:
+        lines.append(f"fabric members ({len(members)}):")
+        for key, m in members.items():
+            state = ("left" if m.get("left")
+                     else "SILENT" if m.get("silent") else "live")
+            off = m.get("clock_offset_s")
+            lines.append(
+                f"  {key:<14} {state:<7} "
+                f"tenant={m.get('tenant') or '-':<10} "
+                f"windows={m.get('windows_received', 0):<6} "
+                f"resyncs={m.get('resyncs', 0):<3} "
+                f"clock_offset_s="
+                f"{'n/a' if off is None else format(off, '.3f')}")
+    tenants = (snap.get("accounting") or {}).get("tenants") or {}
+    if tenants:
+        lines.append("per-tenant accounting (rows / bytes_read / "
+                     "bytes_decoded / decode_s / fetch_s / cache_hits):")
+        for tenant in sorted(tenants):
+            t = tenants[tenant]
+            lines.append(
+                f"  {tenant:<14} {t.get('rows', 0):>10.6g} / "
+                f"{t.get('bytes_read', 0):>12.6g} / "
+                f"{t.get('bytes_decoded', 0):>12.6g} / "
+                f"{t.get('decode_s', 0):>8.6g} / "
+                f"{t.get('fetch_s', 0):>8.6g} / "
+                f"{t.get('cache_hits', 0):>8.6g}")
+    return lines
+
+
 def _render_top(snap: dict, series_filter=None) -> str:
     """The `top` screen: headline gauges + anomaly/SLO state + series
     sparklines from the embedded timeline ring."""
@@ -211,14 +264,27 @@ def _render_top(snap: dict, series_filter=None) -> str:
             head.append(f"{label}={value:.6g}")
     lines.append("petastorm-tpu top — " + ("  ".join(head) or "no data"))
     series = _timeline_series(snap)
-    if not series:
+    fed = snap.get("fleet_timeline") or {}
+    if not series and not fed.get("series"):
         lines.append("(no timeline in snapshot — run the pipeline with "
                      "PETASTORM_TPU_TIMELINE=1)")
+        lines.extend(_render_fleet(snap))
         return "\n".join(lines)
-    tl = snap.get("timeline", {})
-    lines.append(f"timeline: {len(tl.get('windows', []))} windows x "
-                 f"{tl.get('interval_s', '?')}s")
-    lines.extend(_series_table(series, series_filter))
+    if series:
+        tl = snap.get("timeline", {})
+        lines.append(f"timeline: {len(tl.get('windows', []))} windows x "
+                     f"{tl.get('interval_s', '?')}s")
+        lines.extend(_series_table(series, series_filter))
+    if fed.get("series"):
+        # Aggregator snapshots carry the per-member federated timeline
+        # too; default to the fleet-sum + skew rows (--series h0 widens
+        # to one member, --series '' to everything).
+        lines.append(f"fleet timeline: {fed.get('depth', '?')} windows x "
+                     f"{fed.get('interval_s', '?')}s "
+                     f"(--series '' for per-member rows)")
+        lines.extend(_series_table(fed["series"],
+                                   series_filter or ["fleet:", "skew:"]))
+    lines.extend(_render_fleet(snap))
     anomalies = {k: v for k, v in (snap.get("events") or {}).items()
                  if k.startswith(("anomaly.", "slo."))}
     for name, ring in sorted(anomalies.items()):
@@ -658,6 +724,103 @@ def _cmd_check(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """Run a fleet telemetry aggregator: bind ``addr``, fold every
+    publisher stream, and (with ``--flush``) keep a fleet snapshot on
+    disk that the rest of this CLI — including ``check --anomaly`` —
+    consumes unmodified (docs/observability.md "Telemetry fabric")."""
+    from petastorm_tpu.telemetry import fabric as _fabric
+    if not _fabric.fabric_available():
+        print("pyzmq unavailable: the telemetry fabric needs it",
+              file=sys.stderr)
+        return 1
+    rules = None
+    if args.slo:
+        from petastorm_tpu.telemetry.slo import parse_rules
+        rules = [r for spec in args.slo for r in parse_rules(spec)]
+    try:
+        agg = _fabric.TelemetryAggregator(args.addr,
+                                          key_label=args.key_label,
+                                          interval_s=args.interval,
+                                          slo_rules=rules)
+    except Exception as e:  # noqa: BLE001 - bad addr / port in use
+        print(f"cannot bind aggregator at {args.addr}: {e}",
+              file=sys.stderr)
+        return 1
+    print(f"telemetry aggregator on {args.addr} "
+          f"(beat {args.interval}s"
+          + (f", flushing {args.flush}" if args.flush else "")
+          + ") — ctrl-c to stop", flush=True)
+    beats = 0
+    last = time.perf_counter()
+    try:
+        # Driven inline (no agg.start() thread): poll_once drains the
+        # socket with a bounded wait and runs due aggregation ticks.
+        while True:
+            agg.poll_once()
+            now = time.perf_counter()
+            if now - last >= args.interval:
+                last = now
+                beats += 1
+                if args.flush:
+                    agg.flush(args.flush)
+                if args.count and beats >= args.count:
+                    break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if args.flush:
+            try:
+                agg.flush(args.flush)
+                print(f"wrote {args.flush}", flush=True)
+            except OSError as e:
+                print(f"cannot flush {args.flush}: {e}", file=sys.stderr)
+        agg.stop()
+    return 0
+
+
+def _cmd_top_live(args):
+    """``top --connect``: bind an in-process aggregator and render the
+    live fleet every ``--interval``. Returns an exit code, or None to
+    degrade to file mode (wire unavailable, snapshot path given)."""
+    from petastorm_tpu.telemetry import fabric as _fabric
+    degrade = ("degrading to file mode" if args.path
+               else "no snapshot path to fall back to")
+    if not _fabric.fabric_available():
+        print(f"pyzmq unavailable — cannot aggregate {args.connect}; "
+              f"{degrade}", file=sys.stderr)
+        return None if args.path else 1
+    try:
+        # Aggregation beats at most 1s apart regardless of the render
+        # cadence: member-silence detection must not wait a slow
+        # --interval.
+        agg = _fabric.TelemetryAggregator(
+            args.connect, interval_s=min(args.interval, 1.0))
+    except Exception as e:  # noqa: BLE001 - bad addr / port in use
+        print(f"cannot bind aggregator at {args.connect}: {e}; {degrade}",
+              file=sys.stderr)
+        return None if args.path else 1
+    renders = 0
+    try:
+        while True:
+            deadline = time.perf_counter() + args.interval
+            while time.perf_counter() < deadline:
+                agg.poll_once(timeout_s=min(0.2, args.interval))
+            snap = agg.fleet_snapshot()
+            if renders and not args.no_clear:
+                print("\x1b[2J\x1b[H", end="")
+            print(_render_top(snap, args.series or None))
+            renders += 1
+            if args.count and renders >= args.count:
+                return 0
+            if args.no_clear:
+                print("---", flush=True)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        agg.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m petastorm_tpu.telemetry",
@@ -675,12 +838,17 @@ def main(argv=None) -> int:
     watch.add_argument("--interval", type=float, default=2.0)
     watch.add_argument("--count", type=int, default=0,
                        help="stop after N renders (0 = forever)")
+    watch.add_argument("--follow", action="store_true",
+                       help="wait for a missing/half-written snapshot "
+                            "file instead of exiting")
 
     top_p = sub.add_parser(
         "top", help="live ops view: timeline sparklines + anomaly state")
-    top_p.add_argument("path", help="snapshot file written by a "
-                                    "PETASTORM_TPU_TIMELINE-enabled "
-                                    "pipeline's exporter")
+    top_p.add_argument("path", nargs="?", default=None,
+                       help="snapshot file written by a "
+                            "PETASTORM_TPU_TIMELINE-enabled "
+                            "pipeline's exporter (optional with "
+                            "--connect: then it is the fallback)")
     top_p.add_argument("--interval", type=float, default=2.0)
     top_p.add_argument("--count", type=int, default=0,
                        help="stop after N renders (0 = forever)")
@@ -688,6 +856,38 @@ def main(argv=None) -> int:
                        help="substring filter on series names (repeatable)")
     top_p.add_argument("--no-clear", action="store_true",
                        help="append renders instead of redrawing in place")
+    top_p.add_argument("--connect", default=None, metavar="ADDR",
+                       help="bind an in-process telemetry aggregator at "
+                            "this ZeroMQ address and render the live "
+                            "fleet (pipelines publish to it via "
+                            "telemetry_publish= / "
+                            "PETASTORM_TPU_TELEMETRY_PUBLISH)")
+    top_p.add_argument("--follow", action="store_true",
+                       help="file mode: wait for a missing/half-written "
+                            "snapshot file instead of exiting")
+
+    serve_p = sub.add_parser(
+        "serve", help="run a fleet telemetry aggregator (binds a ZeroMQ "
+                      "address publishers connect to)")
+    serve_p.add_argument("addr", help="ZeroMQ bind address, e.g. "
+                                      "tcp://0.0.0.0:5556 or "
+                                      "ipc:///tmp/pt-fabric")
+    serve_p.add_argument("--interval", type=float, default=1.0,
+                         help="aggregation beat: timeline window / "
+                              "silence check / flush cadence")
+    serve_p.add_argument("--count", type=int, default=0,
+                         help="stop after N beats (0 = forever; "
+                              "scripting/CI)")
+    serve_p.add_argument("--flush", default=None, metavar="PATH",
+                         help="write the fleet snapshot here every beat "
+                              "and at exit (consumable by every file "
+                              "subcommand incl. `check --anomaly`)")
+    serve_p.add_argument("--key-label", default="member",
+                         help="federation key label in rollup output "
+                              "(default: member)")
+    serve_p.add_argument("--slo", action="append", default=[],
+                         help="SLO rule spec evaluated on the fleet "
+                              "registry every beat (repeatable)")
 
     tl_p = sub.add_parser(
         "timeline", help="render/flush a snapshot's rolling series "
@@ -769,12 +969,31 @@ def main(argv=None) -> int:
         return _cmd_quality(args)
     if args.cmd == "postmortem":
         return _cmd_postmortem(args)
+    if args.cmd == "serve":
+        return _cmd_serve(args)
+    if args.cmd == "top" and args.connect:
+        rc = _cmd_top_live(args)
+        if rc is not None:
+            return rc
+        # Wire unavailable + snapshot path given: degrade to the file
+        # loop below.
+    if args.cmd == "top" and not args.path:
+        print("top needs a snapshot path or --connect ADDR",
+              file=sys.stderr)
+        return 1
 
     renders = 0
     while True:
         try:
             snap = _load(args.path)
         except (OSError, ValueError) as e:
+            if getattr(args, "follow", False):
+                # --follow: the exporter may not have started (or the
+                # file is mid-replace); keep waiting instead of exiting.
+                print(f"waiting for snapshot {args.path}: {e}",
+                      file=sys.stderr)
+                time.sleep(args.interval)  # backoff-ok: follow-mode wait for the exporter
+                continue
             print(f"cannot read snapshot {args.path}: {e}", file=sys.stderr)
             return 1
         if args.cmd == "top":

@@ -241,6 +241,7 @@ class MetricsTimeline:
         self._last_t: Optional[float] = None
         self._prev_counters: Dict[str, float] = {}
         self._prev_hists: Dict[str, List[int]] = {}
+        self._util_members: Dict[str, set] = {}
         self._listeners: List[Callable[[dict], None]] = []
 
     # ------------------------------------------------------------ feeding
@@ -312,14 +313,22 @@ class MetricsTimeline:
                 dt: float, out: Dict[str, Optional[float]]) -> None:
         if spec.kind == "util":
             members = _match_family(spec.metric, counters)
-            if not members:
+            # Sticky membership: remember every family member ever seen.
+            # In a federated/fabric view a member's counters can be
+            # absent from one sample (its window arrived late) — the
+            # denominator must not shrink, and the series must stay
+            # defined (a missing member contributes zero delta, not a
+            # gap that NaNs the fleet rollup).
+            seen = self._util_members.setdefault(spec.metric, set())
+            seen.update(m for m, _wild in members)
+            if not seen:
                 return
             total = sum(
                 self._counter_delta(float(counters[m]),
                                     self._prev_counters.get(m))
                 for m, _wild in members)
             out[spec.name] = round(
-                min(1.0, max(0.0, total / (dt * len(members)))), 6)
+                min(1.0, max(0.0, total / (dt * len(seen)))), 6)
             return
         if "*" in spec.metric:
             source = gauges if spec.kind == "gauge" else counters

@@ -1,0 +1,439 @@
+"""Telemetry fabric (docs/observability.md "Telemetry fabric"): live
+cross-process metric streaming, fleet aggregation, member lifecycle,
+clock re-anchoring, and per-tenant accounting.
+
+Socketed tests run over per-test ``ipc://`` endpoints; message-level
+edge cases feed :meth:`TelemetryAggregator.handle_message` directly so
+the lifecycle/clock assertions stay deterministic.
+"""
+import json
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.telemetry import TelemetryRegistry
+from petastorm_tpu.telemetry.__main__ import main as telemetry_cli
+from petastorm_tpu.telemetry.accounting import (AccountingLedger,
+                                                accounting_totals,
+                                                merge_accounting_reports)
+from petastorm_tpu.telemetry.fabric import (FABRIC_SCHEMA_VERSION,
+                                            SILENCE_AFTER_HEARTBEATS,
+                                            TELEMETRY_PUBLISH_ENV,
+                                            TelemetryAggregator,
+                                            TelemetryPublisher,
+                                            fabric_available,
+                                            publish_addr_from_env)
+from petastorm_tpu.telemetry.timeseries import MetricsTimeline
+
+pytestmark = [pytest.mark.fabric,
+              pytest.mark.skipif(not fabric_available(),
+                                 reason="pyzmq unavailable")]
+
+
+@pytest.fixture()
+def addr():
+    # Short /tmp path: ipc:// endpoints have a ~100-char OS limit that
+    # pytest's tmp_path regularly blows through.
+    return f"ipc:///tmp/ptfab-{uuid.uuid4().hex[:12]}"
+
+
+@pytest.fixture(scope="module")
+def scalar_store(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = tmp_path_factory.mktemp("fabric_scalar")
+    n = 5000
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(n, dtype=np.int64)),
+                  "v": pa.array(np.arange(n, dtype=np.float64))}),
+        str(path / "part0.parquet"), row_group_size=500)
+    return f"file://{path}"
+
+
+def _wait(cond, timeout_s=10.0):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _msg(seq, pipeline_id="p-test", tenant=None, mtype="window",
+         t_perf=None, interval_s=0.1, **extra):
+    msg = {"v": FABRIC_SCHEMA_VERSION, "type": mtype, "member": "h0",
+           "pipeline_id": pipeline_id, "tenant": tenant, "seq": seq,
+           "t_perf": time.perf_counter() if t_perf is None else t_perf,
+           "interval_s": interval_s}
+    msg.update(extra)
+    return msg
+
+
+# --------------------------------------------------------------- wire e2e
+class TestWire:
+    def test_publisher_final_flush_outlives_closed_reader(self, addr,
+                                                          scalar_store):
+        """A reader closed before the (long) publish interval ever fires
+        still delivers its complete totals: ``Reader.stop()`` ships the
+        final ``bye`` window from the registry, which outlives the
+        reader's worker pool."""
+        agg = TelemetryAggregator(addr, interval_s=0.1)
+        rows = 0
+        with make_batch_reader(scalar_store, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False,
+                               telemetry_publish=addr,
+                               tenant="solo") as r:
+            member = r.telemetry.pipeline_id
+            for batch in r:
+                rows += len(batch.id)
+        assert rows == 5000
+        assert _wait(lambda: (agg.poll_once(timeout_s=0.05) or True)
+                     and agg.members_report().get(member, {}).get("left"))
+        state = agg.members_report()[member]
+        assert state["tenant"] == "solo"
+        fleet = agg.registry.metrics_view()["counters"]
+        assert fleet.get("reader.rows") == rows
+        report = agg.ledger.report()
+        assert report["tenants"]["solo"]["rows"] == rows
+        agg.stop()
+
+    def test_fleet_sum_and_member_silence_within_two_heartbeats(self, addr):
+        """The acceptance e2e: 3 publishers -> 1 aggregator; fleet rows
+        equal the sum of member ground truth, and a killed publisher is
+        flagged ``anomaly.member_silent`` within two heartbeat
+        intervals."""
+        heartbeat = 0.5
+        agg = TelemetryAggregator(addr, interval_s=0.1)
+        agg.start()
+        regs = [TelemetryRegistry() for _ in range(3)]
+        pubs = [TelemetryPublisher(reg, addr, member=f"h{i}",
+                                   tenant=f"t{i % 2}",
+                                   interval_s=heartbeat).start()
+                for i, reg in enumerate(regs)]
+        truth = [0, 0, 0]
+        for _ in range(4):
+            for i, reg in enumerate(regs):
+                reg.counter("reader.rows").add(11)
+                truth[i] += 11
+            time.sleep(heartbeat / 2)
+        # Kill h0 without a bye: stop its loop, leave the socket open —
+        # the process-died case, not a graceful close. One explicit
+        # window first so the ground-truth comparison is deterministic
+        # (the periodic cadence may not have shipped the final adds).
+        pubs[0].publish_once()
+        pubs[0]._stop.set()
+        pubs[0]._thread.join()
+        pubs[0]._thread = None
+        assert _wait(lambda: agg.registry.metrics_view()["counters"].get(
+            "anomaly.member_silent_total", 0) >= 1,
+            timeout_s=4 * heartbeat)
+        events = agg.registry.events()["anomaly.member_silent"]
+        det = events[-1]["payload"]
+        assert det["member"] == "h0"
+        # Entry-edge quiet time bounds the detection latency: within two
+        # heartbeat intervals of the last window received.
+        assert det["quiet_s"] <= 2 * heartbeat
+        assert "h0" not in agg.live_members()
+        assert sorted(agg.live_members()) == ["h1", "h2"]
+        # Survivors keep streaming; totals converge to the ground truth.
+        for pub in pubs[1:]:
+            pub.stop()
+        assert _wait(lambda: agg.registry.metrics_view()["counters"].get(
+            "reader.rows") == float(sum(truth)))
+        fed = agg.federated_snapshot()
+        assert fed["counters"]["reader.rows"] == float(sum(truth))
+        assert fed["counters"]["h1:reader.rows"] == float(truth[1])
+        agg.stop()
+
+    def test_publish_env_var_attaches_publisher(self, addr, scalar_store,
+                                                monkeypatch):
+        monkeypatch.setenv(TELEMETRY_PUBLISH_ENV, addr)
+        assert publish_addr_from_env() == addr
+        agg = TelemetryAggregator(addr, interval_s=0.1)
+        with make_batch_reader(scalar_store, num_epochs=1, workers_count=1,
+                               shuffle_row_groups=False) as r:
+            assert r._telemetry_publisher is not None
+            member = r.telemetry.pipeline_id
+            for _ in r:
+                break
+        assert _wait(lambda: (agg.poll_once(timeout_s=0.05) or True)
+                     and member in agg.members_report())
+        agg.stop()
+
+    def test_concurrent_publish_races_registry_reset(self, addr):
+        """Hammer: publishes race ``registry.reset()`` and live counter
+        adds. The aggregator's clamped deltas must never go negative (a
+        negative would raise in ``Counter.add`` and kill the fold), and
+        the publisher thread must survive the whole run."""
+        agg = TelemetryAggregator(addr, interval_s=0.05)
+        agg.start()
+        reg = TelemetryRegistry()
+        pub = TelemetryPublisher(reg, addr, member="racer",
+                                 interval_s=0.02).start()
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                reg.counter("reader.rows").add(3)
+                reg.counter("io.bytes_read").add(100)
+
+        def resetter():
+            while not stop.is_set():
+                reg.reset()
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=churn) for _ in range(2)]
+        threads.append(threading.Thread(target=resetter))
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert pub._thread.is_alive()
+        pub.stop()
+        assert _wait(lambda: (agg.poll_once(timeout_s=0.05) or True)
+                     and agg.members_report().get("racer", {}).get("left"))
+        agg.stop()
+        counters = agg.registry.metrics_view()["counters"]
+        assert counters.get("fabric.bad_messages", 0) == 0
+        state_applied = agg._members["racer"].applied
+        assert state_applied, "no windows applied"
+        assert all(v >= 0 for v in state_applied.values())
+        assert all(v >= 0 for v in counters.values())
+
+    def test_two_tenant_accounting_matches_reader_ground_truth(
+            self, addr, scalar_store):
+        """Two tenants, three pipelines: the aggregator's per-tenant
+        ledger must equal each reader's own ``accounting_report()`` —
+        exact, not approximate."""
+        agg = TelemetryAggregator(addr, interval_s=0.1)
+        agg.start()
+        truth = {"alpha": {"rows": 0, "bytes_read": 0.0},
+                 "beta": {"rows": 0, "bytes_read": 0.0}}
+        members = []
+        for tenant in ("alpha", "alpha", "beta"):
+            with make_batch_reader(scalar_store, num_epochs=1,
+                                   workers_count=2,
+                                   shuffle_row_groups=False,
+                                   telemetry_publish=addr,
+                                   tenant=tenant) as r:
+                members.append(r.telemetry.pipeline_id)
+                rows = sum(len(batch.id) for batch in r)
+                acct = r.accounting_report()
+                assert acct["tenant"] == tenant
+                assert acct["totals"]["rows"] == rows == 5000
+                truth[tenant]["rows"] += rows
+                truth[tenant]["bytes_read"] += acct["totals"]["bytes_read"]
+        assert _wait(lambda: all(
+            agg.members_report().get(m, {}).get("left") for m in members))
+        agg.stop()
+        report = agg.ledger.report()
+        for tenant, t in truth.items():
+            got = report["tenants"][tenant]
+            assert got["rows"] == t["rows"]
+            assert got["bytes_read"] == pytest.approx(t["bytes_read"])
+        assert report["tenants"]["alpha"]["pipelines"] == 2
+        assert report["tenants"]["beta"]["pipelines"] == 1
+        per_pipeline = {row["pipeline_id"]: row
+                        for row in report["pipelines"]}
+        assert set(per_pipeline) == set(members)
+        assert all(row["rows"] == 5000 for row in per_pipeline.values())
+
+
+# ------------------------------------------------------- message lifecycle
+class TestLifecycle:
+    def test_join_leave_rejoin_resyncs_deltas(self, addr):
+        agg = TelemetryAggregator(addr, interval_s=1.0)
+        agg.handle_message("h0", "hello", _msg(1, mtype="hello"))
+        assert agg.members_report()["h0"]["windows_received"] == 0
+        agg.handle_message("h0", "window",
+                           _msg(2, counters={"reader.rows": 10.0}))
+        # Windows 3..4 dropped on the floor: the cumulative encoding must
+        # resync from seq 5 without losing the missed progress.
+        agg.handle_message("h0", "window",
+                           _msg(5, counters={"reader.rows": 50.0}))
+        state = agg._members["h0"]
+        assert state.applied["reader.rows"] == 50.0
+        assert state.resyncs == 1
+        agg.handle_message("h0", "bye",
+                           _msg(6, mtype="bye",
+                                counters={"reader.rows": 60.0}))
+        report = agg.members_report()["h0"]
+        assert report["left"] and report["resyncs"] == 1
+        # Rejoin as a NEW incarnation (restarted process, same member
+        # key): cumulative counters restart near zero; the fleet total
+        # must keep the old incarnation's 60 and add the new 5.
+        agg.handle_message("h0", "window",
+                           _msg(1, pipeline_id="p-test-2",
+                                counters={"reader.rows": 5.0}))
+        state = agg._members["h0"]
+        assert not state.left
+        assert state.applied["reader.rows"] == 65.0
+        assert state.resyncs >= 2
+        counters = agg.registry.metrics_view()["counters"]
+        assert counters["reader.rows"] == 65.0
+        assert counters["fabric.members_joined"] == 1.0
+        assert counters["fabric.members_left"] == 1.0
+        agg.stop()
+
+    def test_silent_member_rejoin_records_event(self, addr):
+        agg = TelemetryAggregator(addr, interval_s=1.0)
+        agg.handle_message("h0", "window",
+                           _msg(1, counters={"reader.rows": 1.0},
+                                interval_s=0.1))
+        start = agg._members["h0"].last_seen
+        agg.tick(now=start + 10 * 0.1)
+        assert agg.members_report()["h0"]["silent"]
+        assert agg.registry.metrics_view()["counters"][
+            "anomaly.member_silent_total"] == 1.0
+        agg.handle_message("h0", "window",
+                           _msg(2, counters={"reader.rows": 2.0},
+                                interval_s=0.1))
+        assert not agg.members_report()["h0"]["silent"]
+        assert "fabric.member_rejoined" in agg.registry.events()
+        # Entry-edge: silence does not re-fire while already silent.
+        agg.tick(now=start + 20 * 0.1)
+        agg.tick(now=start + 30 * 0.1)
+        assert agg.registry.metrics_view()["counters"][
+            "anomaly.member_silent_total"] == 2.0
+        agg.stop()
+
+    def test_clock_reanchor_under_skewed_perf_counter_bases(self, addr):
+        """Remote ``perf_counter`` bases are boot-relative and arbitrary;
+        the aggregator's min-latency offset estimate must re-anchor
+        member timeline windows onto the local clock."""
+        agg = TelemetryAggregator(addr, interval_s=1.0)
+        now = time.perf_counter()
+        agg.handle_message("h0", "window", _msg(
+            1, t_perf=now - 1000.0,
+            timeline={"interval_s": 0.1,
+                      "windows": [{"index": 0, "t_s": 5.0, "dt_s": 0.1,
+                                   "series": {"rows_per_s": 10.0}}]}))
+        state = agg._members["h0"]
+        assert state.clock_offset_s == pytest.approx(1000.0, abs=1.0)
+        assert state.windows[-1]["t_s"] == pytest.approx(1005.0, abs=1.0)
+        # A later arrival with LESS apparent latency (remote clock ahead)
+        # lowers the estimate; one with more leaves it alone.
+        agg.handle_message("h0", "window", _msg(
+            2, t_perf=time.perf_counter() + 500.0))
+        assert state.clock_offset_s == pytest.approx(-500.0, abs=1.0)
+        agg.handle_message("h0", "window", _msg(
+            3, t_perf=time.perf_counter() - 2000.0))
+        assert state.clock_offset_s == pytest.approx(-500.0, abs=1.0)
+        agg.stop()
+
+    def test_newer_schema_and_garbage_frames_counted_not_crashed(self,
+                                                                 addr):
+        agg = TelemetryAggregator(addr, interval_s=1.0)
+        agg._handle_raw(b"not json at all")
+        agg._handle_raw(json.dumps(
+            dict(_msg(1), v=FABRIC_SCHEMA_VERSION + 1)).encode())
+        agg._handle_raw(json.dumps(
+            dict(_msg(1), type="mystery")).encode())
+        assert agg.registry.metrics_view()["counters"][
+            "fabric.bad_messages"] == 3.0
+        assert not agg._members
+        agg.stop()
+
+
+# ----------------------------------------------------------- accounting
+class TestAccounting:
+    def test_ledger_deltas_and_merge(self):
+        ledger = AccountingLedger()
+        ledger.apply("p1", "alpha", {"rows": 10, "bytes_read": 100})
+        ledger.apply("p1", "alpha", {"rows": 25, "bytes_read": 300})
+        # Restart: cumulative totals went backwards -> the new value is
+        # the progress, never a negative delta.
+        ledger.apply("p1", "alpha", {"rows": 5, "bytes_read": 50})
+        report = ledger.report()
+        assert report["tenants"]["alpha"]["rows"] == 30.0
+        assert report["tenants"]["alpha"]["bytes_read"] == 350.0
+        other = AccountingLedger()
+        other.apply("p2", "alpha", {"rows": 7})
+        other.apply("p3", "beta", {"rows": 2})
+        merged = merge_accounting_reports([report, other.report()])
+        assert merged["tenants"]["alpha"]["rows"] == 37.0
+        assert merged["tenants"]["alpha"]["pipelines"] == 2
+        assert merged["tenants"]["beta"]["rows"] == 2.0
+
+    def test_accounting_totals_sources(self):
+        reg = TelemetryRegistry()
+        reg.counter("reader.rows").add(12)
+        reg.counter("io.bytes_read").add(4096)
+        reg.counter("io.readahead.fetch_s").add(0.5)
+        reg.counter("cache.mem.hits").add(3)
+        reg.counter("io.readahead.hits").add(2)
+        totals = accounting_totals(reg.metrics_view())
+        assert totals["rows"] == 12.0
+        assert totals["bytes_read"] == 4096.0
+        assert totals["fetch_s"] == 0.5
+        assert totals["cache_hits"] == 5.0
+
+
+# ------------------------------------------------------------- timeline
+class TestUtilizationSticky:
+    def test_pool_utilization_survives_late_member_window(self):
+        """Satellite fix: a family member whose window arrives late must
+        not shrink the utilization denominator or NaN the series."""
+        view = lambda c: {"counters": c, "gauges": {}, "histograms": {}}  # noqa: E731
+        tl = MetricsTimeline(interval_s=0.1, window_count=10)
+        tl.sample(view({"pool.w0.busy_s": 0.0, "pool.w1.busy_s": 0.0}),
+                  now_s=0.0)
+        w = tl.sample(view({"pool.w0.busy_s": 0.05,
+                            "pool.w1.busy_s": 0.05}), now_s=0.1)
+        assert w["series"]["pool.utilization"] == pytest.approx(0.5)
+        # w1's counters missing from this sample entirely (late window in
+        # a federated view): stays defined, denominator stays 2.
+        w = tl.sample(view({"pool.w0.busy_s": 0.15}), now_s=0.2)
+        assert w["series"]["pool.utilization"] == pytest.approx(0.5)
+        w = tl.sample(view({"pool.w0.busy_s": 0.25,
+                            "pool.w1.busy_s": 0.25}), now_s=0.3)
+        util = w["series"]["pool.utilization"]
+        assert util is not None and 0.0 <= util <= 1.0
+
+
+# -------------------------------------------------------------------- CLI
+class TestCli:
+    def test_serve_flush_feeds_check_and_top(self, addr, tmp_path, capsys):
+        path = tmp_path / "fleet.json"
+        reg = TelemetryRegistry()
+        pub = TelemetryPublisher(reg, addr, member="h0", tenant="alpha",
+                                 interval_s=0.1).start()
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                reg.counter("reader.rows").add(5)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            rc = telemetry_cli(["serve", addr, "--interval", "0.2",
+                                "--count", "4", "--flush", str(path)])
+        finally:
+            stop.set()
+            t.join()
+            pub.stop()
+        assert rc == 0
+        snap = json.loads(path.read_text())
+        assert "fabric_members" in snap and "accounting" in snap
+        assert snap["accounting"]["tenants"]["alpha"]["rows"] > 0
+        capsys.readouterr()
+        assert telemetry_cli(["check", str(path), "--anomaly"]) == 0
+        assert telemetry_cli(["top", str(path), "--count", "1",
+                              "--no-clear"]) == 0
+        out = capsys.readouterr().out
+        assert "fabric members" in out
+        assert "per-tenant accounting" in out
+        assert "alpha" in out
+
+    def test_top_requires_path_or_connect(self, capsys):
+        assert telemetry_cli(["top"]) == 1
+        assert "needs a snapshot path or --connect" in \
+            capsys.readouterr().err

@@ -19,7 +19,11 @@ queue):
   ``q.get_nowait()`` never match;
 * ``x.recv()`` with no arguments (ZMQ/multiprocessing pipes block forever);
 * ``x.wait()`` with no arguments and no ``timeout=`` (``Event``/
-  ``Condition``/process waits).
+  ``Condition``/process waits);
+* ``x.poll()`` with no arguments and no ``timeout=`` (a bare ZMQ
+  socket/poller or pipe ``poll()`` defaults to an infinite wait — the
+  telemetry-fabric aggregator loop is exactly this shape; always pass a
+  bounded wait in milliseconds).
 
 A wait that is genuinely unbounded *by design* (e.g. it is itself
 liveness-checked some other way) may opt out with a ``timeout-ok`` comment
@@ -48,7 +52,7 @@ EXEMPT_DIRS = (os.path.join("petastorm_tpu", "workers_pool"),)
 
 WAIVER = "timeout-ok"
 
-_BLOCKING_ATTRS = ("get", "recv", "wait")
+_BLOCKING_ATTRS = ("get", "recv", "wait", "poll")
 
 
 def _python_files(paths):
@@ -88,8 +92,9 @@ def _unbounded_blocking_call(node: ast.Call):
         if block is not None and _is_true_const(block) and not node.args:
             return fn.attr
         return None
-    # recv() / wait(): any positional argument is a timeout/bufsize — only
-    # the bare zero-argument call blocks unboundedly.
+    # recv() / wait() / poll(): any positional argument is a
+    # timeout/flags/bufsize — only the bare zero-argument call blocks
+    # unboundedly (zmq poll() with no args waits forever).
     if not node.args and not kwargs:
         return fn.attr
     return None
