@@ -54,6 +54,7 @@ ci-lint:
 	python tools/check_metric_docs.py
 	python tools/check_operators.py
 	python tools/check_lowering.py
+	python tools/check_wire.py
 	# Shipped SLO rules + anomaly detectors, gated against the committed
 	# known-good bench telemetry snapshots (bench.py refreshes them each
 	# run): a rule/detector regression fails the BUILD, not just the bench.
@@ -67,6 +68,10 @@ ci-lint:
 	# the committed healthy 3-publisher fleet snapshot must replay clean —
 	# a fabric aggregation/federation regression fails the BUILD.
 	python -m petastorm_tpu.telemetry check bench_snapshots/fleet_telemetry_epoch.json --anomaly
+	# Data-service contract (docs/service.md): the committed dispatcher
+	# snapshot from the bench fleet must hold the exactly-once SLO — a
+	# lease/coverage regression fails the BUILD.
+	python -m petastorm_tpu.telemetry check bench_snapshots/data_service_epoch.json --slo "counter:service.coverage_violations_total<=0"
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

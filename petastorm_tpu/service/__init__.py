@@ -1,0 +1,31 @@
+"""Data-service mode: a disaggregated ingestion fleet (docs/service.md).
+
+One :class:`~petastorm_tpu.service.dispatcher.Dispatcher` owns the dataset
+listing and the :class:`~petastorm_tpu.reader_impl.epoch_plan.EpochPlan`,
+and leases plan-ordinal ranges to clients; N stateless
+:class:`~petastorm_tpu.service.server.DecodeServer` processes execute
+``rowgroup_subset`` work orders and stream Arrow IPC batches back over
+bounded ZeroMQ sockets; :func:`make_service_reader` gives trainers the
+familiar ``Reader`` surface over the fleet.
+
+The whole plane is optional — importable without pyzmq, gated by
+:func:`service_available`.
+"""
+
+from petastorm_tpu.service.wire import (SERVICE_WIRE_VERSION,
+                                        service_available)
+from petastorm_tpu.service.lease import (Lease, LeaseBook,
+                                         FleetCoverageLedger)
+from petastorm_tpu.service.scheduler import FairShareScheduler
+from petastorm_tpu.service.dispatcher import Dispatcher, ServiceJobSpec
+from petastorm_tpu.service.server import DecodeServer
+from petastorm_tpu.service.client import ServiceReader, make_service_reader
+
+__all__ = [
+    "SERVICE_WIRE_VERSION", "service_available",
+    "Lease", "LeaseBook", "FleetCoverageLedger",
+    "FairShareScheduler",
+    "Dispatcher", "ServiceJobSpec",
+    "DecodeServer",
+    "ServiceReader", "make_service_reader",
+]

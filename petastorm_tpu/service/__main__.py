@@ -1,0 +1,132 @@
+"""Service-plane CLI: ``python -m petastorm_tpu.service dispatch|serve|status``.
+
+``dispatch`` runs a dispatcher over a jobs config (JSON list of
+:class:`~petastorm_tpu.service.dispatcher.ServiceJobSpec` dicts);
+``serve`` runs one decode server registered against a dispatcher;
+``status`` prints a running fleet's ``service_report()`` (coverage
+manifests, scheduler shares, lease book, accounting bill) as JSON.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_kv(pairs, cast):
+    out = {}
+    for pair in pairs or ():
+        key, _, value = pair.partition("=")
+        if not _:
+            raise SystemExit(f"expected TENANT=VALUE, got {pair!r}")
+        out[key] = cast(value)
+    return out
+
+
+def _cmd_dispatch(args) -> int:
+    from petastorm_tpu.service.dispatcher import Dispatcher, load_jobs_config
+    jobs = load_jobs_config(args.jobs)
+    dispatcher = Dispatcher(
+        args.bind, jobs=jobs, servers=args.server or (),
+        lease_ttl_s=args.lease_ttl, hedge_delay_s=args.hedge_delay,
+        weights=_parse_kv(args.weight, float),
+        quotas=_parse_kv(args.quota, int),
+        telemetry_publish=args.telemetry_publish)
+    dispatcher.start()
+    print(f"dispatcher up at {args.bind} ({len(jobs)} job(s), "
+          f"gen {dispatcher.gen})", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(args.status_interval)
+            report = dispatcher.service_report()
+            leases = report["leases"]
+            print(f"leases active={leases['active']} "
+                  f"granted={leases['granted']} "
+                  f"expired={leases['expired']} "
+                  f"violations={report['coverage_violations']}",
+                  file=sys.stderr)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print(json.dumps(dispatcher.service_report(), indent=2))
+        dispatcher.stop()
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from petastorm_tpu.service.server import DecodeServer
+    server = DecodeServer(args.bind, dispatcher_addr=args.dispatcher,
+                          server_id=args.server_id,
+                          cache_bytes=args.cache_bytes,
+                          telemetry_publish=args.telemetry_publish)
+    server.start()
+    print(f"decode server {server.server_id} up at {args.bind}",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+def _cmd_status(args) -> int:
+    import zmq
+    from petastorm_tpu.service.wire import rpc, service_socket
+    ctx = zmq.Context.instance()
+    sock = service_socket(ctx, zmq.DEALER, connect=args.dispatcher)
+    try:
+        reply, _ = rpc(sock, {"type": "status"},
+                       timeout_ms=int(args.timeout * 1000))
+    finally:
+        sock.close()
+    print(json.dumps(reply.get("report"), indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m petastorm_tpu.service",
+        description="disaggregated ingestion fleet (docs/service.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("dispatch", help="run the fleet dispatcher")
+    p.add_argument("--bind", required=True,
+                   help="control-plane address, e.g. tcp://*:7733")
+    p.add_argument("--jobs", required=True, help="jobs config JSON path")
+    p.add_argument("--server", action="append",
+                   help="pre-registered decode server address (repeatable; "
+                        "servers may also self-register)")
+    p.add_argument("--lease-ttl", type=float, default=10.0)
+    p.add_argument("--hedge-delay", type=float, default=1.0)
+    p.add_argument("--weight", action="append", metavar="TENANT=W",
+                   help="fair-share weight (repeatable)")
+    p.add_argument("--quota", action="append", metavar="TENANT=UNITS",
+                   help="per-epoch unit quota (repeatable)")
+    p.add_argument("--telemetry-publish", default=None)
+    p.add_argument("--status-interval", type=float, default=10.0)
+    p.set_defaults(fn=_cmd_dispatch)
+
+    p = sub.add_parser("serve", help="run one decode server")
+    p.add_argument("--bind", required=True,
+                   help="data-plane address, e.g. tcp://*:7801")
+    p.add_argument("--dispatcher", default=None,
+                   help="dispatcher control address to register with")
+    p.add_argument("--server-id", default=None)
+    p.add_argument("--cache-bytes", type=int, default=256 << 20)
+    p.add_argument("--telemetry-publish", default=None)
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("status", help="print a fleet's service_report()")
+    p.add_argument("--dispatcher", required=True)
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=_cmd_status)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

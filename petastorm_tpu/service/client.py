@@ -1,0 +1,635 @@
+"""The service client: the familiar ``Reader`` surface over the fleet.
+
+:func:`make_service_reader` returns a :class:`ServiceReader` that
+iterates like a ``make_batch_reader`` reader in deterministic mode — one
+namedtuple batch per row group, in the minted plan's canonical order for
+this client's leased positions — while under the hood it runs the lease
+protocol: attach, lease a plan-ordinal range, send the work order to the
+assigned decode server, reassemble the streamed Arrow units **by plan
+ordinal** (a per-lease
+:class:`~petastorm_tpu.reader_impl.epoch_plan.OrderedDeliveryGate`
+window: late, reordered, or hedge-duplicated units land exactly once),
+acknowledge, repeat.
+
+Hedging (PR 4, generalized to servers): when the assigned server makes
+no progress for ``hedge_delay_s``, the same work order is re-dispatched
+to the lease's backup server and whichever copy of each unit arrives
+first wins by ordinal — the loser is dropped at the gate and counted,
+never delivered twice.
+
+Cross-client determinism: a client only ever yields positions from
+leases it holds; the dispatcher hands out disjoint ranges and fences
+expired leases, so the union of all clients' streams ordered by plan
+position is byte-identical to one local
+``make_batch_reader(..., sample_order='deterministic')`` with the same
+seed (docs/service.md).
+"""
+
+import logging
+import threading
+import time
+import uuid
+from collections import namedtuple
+from typing import Dict, List, Optional
+
+from petastorm_tpu.reader_impl.arrow_table_serializer import \
+    ArrowTableSerializer
+from petastorm_tpu.service.wire import (WireError, WireTimeout, recv_msg,
+                                        rpc, send_msg, service_socket)
+from petastorm_tpu.telemetry.accounting import accounting_totals
+
+try:
+    import zmq
+except ImportError:  # pragma: no cover - pyzmq is an install-time dep
+    zmq = None
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CONTROL_TIMEOUT_MS = 10000
+DEFAULT_UNIT_TIMEOUT_S = 60.0
+
+
+class ServiceError(RuntimeError):
+    """Fleet-side failure surfaced to the consumer."""
+
+
+class _LeaseRun:
+    """Consumer-side state of one active lease."""
+
+    def __init__(self, grant: dict):
+        self.lease_id = grant["lease_id"]
+        self.epoch = int(grant["epoch"])
+        self.positions = [int(p) for p in grant["positions"]]
+        self.ordinals = [int(o) for o in grant["ordinals"]]
+        self.server = grant.get("server")
+        self.backup = grant.get("backup")
+        self.ttl_s = float(grant.get("ttl_s") or 10.0)
+        self.delivered: List[int] = []
+        self.skipped: List[int] = []
+        self.duplicates_dropped = 0
+        self.lost = False
+
+
+class ServiceReader:
+    """Iterator over the fleet for one job. Not thread-safe (one consumer
+    thread, like ``Reader``)."""
+
+    def __init__(self, dispatcher_addr: str, *, job_id: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 client_id: Optional[str] = None,
+                 max_units_per_lease: Optional[int] = None,
+                 hedge_delay_s: Optional[float] = None,
+                 resume_state: Optional[dict] = None,
+                 unit_timeout_s: float = DEFAULT_UNIT_TIMEOUT_S,
+                 control_timeout_ms: int = DEFAULT_CONTROL_TIMEOUT_MS,
+                 telemetry_publish: Optional[str] = None,
+                 context=None):
+        if zmq is None:
+            raise RuntimeError("service plane requires pyzmq")
+        self.dispatcher_addr = dispatcher_addr
+        self.client_id = client_id or f"cli-{uuid.uuid4().hex[:8]}"
+        self._requested_job = job_id
+        self._requested_tenant = tenant
+        self._max_units = max_units_per_lease
+        self._hedge_delay_override = hedge_delay_s
+        self._unit_timeout_s = float(unit_timeout_s)
+        self._control_timeout_ms = int(control_timeout_ms)
+        self._serializer = ArrowTableSerializer()
+
+        from petastorm_tpu.telemetry import make_registry
+        self.telemetry = make_registry()
+        t = self.telemetry
+        self._c_rows = t.counter("reader.rows")
+        self._c_units = t.counter("service.client.units_total")
+        self._c_leases = t.counter("service.client.leases_total")
+        self._c_waits = t.counter("service.client.lease_waits_total")
+        self._c_hedges = t.counter("service.client.hedges_total")
+        self._c_dups = t.counter("service.client.hedge_duplicates_dropped_total")
+        self._c_resyncs = t.counter("service.client.resyncs_total")
+
+        self._publisher = None
+        if telemetry_publish:
+            from petastorm_tpu.telemetry.fabric import TelemetryPublisher
+            self._publisher = TelemetryPublisher(
+                self.telemetry, telemetry_publish,
+                member=f"service.client.{self.client_id}",
+                tenant=None, context=context)  # tenant stamped after attach
+
+        self._ctx = context or zmq.Context.instance()
+        self._ctrl = service_socket(self._ctx, zmq.DEALER,
+                                    connect=dispatcher_addr)
+        self._data_socks: Dict[str, object] = {}
+        self._poller = zmq.Poller()
+
+        #: plan positions this client has consumed, per epoch — the
+        #: resync payload and the ``state_dict`` cursor.
+        self._consumed: Dict[int, List[int]] = {}
+        if resume_state:
+            if resume_state.get("type") != "service":
+                raise ValueError("resume_state is not a service cursor "
+                                 "(pass the dict state_dict() returned)")
+            for epoch_str, positions in (resume_state.get("consumed")
+                                         or {}).items():
+                self._consumed[int(epoch_str)] = sorted(
+                    int(p) for p in positions)
+            self._requested_job = resume_state.get("job_id",
+                                                   self._requested_job)
+
+        self._job: Optional[dict] = None
+        self._gen: Optional[str] = None
+        self._run: Optional[_LeaseRun] = None
+        self._pending_units: List[tuple] = []
+        self._row_type = None
+        self._end = False
+        self._stopped = False
+        self._last_renew = 0.0
+
+        self._attach()
+        if self._consumed:
+            self._resync()
+
+    # ----------------------------------------------------------- control
+    def _rpc(self, header: dict) -> dict:
+        reply, _ = rpc(self._ctrl, header,
+                       timeout_ms=self._control_timeout_ms)
+        gen = reply.get("gen")
+        if self._gen is not None and gen is not None and gen != self._gen:
+            # The dispatcher restarted under us: drop the in-flight lease
+            # (its book is gone), re-attach and replay our cursor, then
+            # let the caller retry.
+            logger.info("dispatcher generation changed (%s -> %s); "
+                        "resyncing client %s", self._gen, gen,
+                        self.client_id)
+            if self._run is not None:
+                self._run.lost = True
+            self._run = None
+            self._pending_units = []
+            self._attach()
+            self._resync()
+            raise _GenerationChanged()
+        return reply
+
+    def _attach(self) -> None:
+        reply, _ = rpc(self._ctrl, {"type": "attach",
+                                    "client_id": self.client_id,
+                                    "job_id": self._requested_job,
+                                    "tenant": self._requested_tenant},
+                       timeout_ms=self._control_timeout_ms)
+        if reply.get("type") != "attach_ok":
+            raise ServiceError(f"attach failed: {reply.get('error')}")
+        if self._job is not None and reply["seed"] != self._job["seed"]:
+            logger.warning(
+                "dispatcher re-minted the job seed (%s -> %s): the fleet "
+                "stays exactly-once per position but is no longer "
+                "byte-comparable to the pre-restart stream; pin the job "
+                "seed for restart-stable determinism", self._job["seed"],
+                reply["seed"])
+        self._job = reply
+        self._gen = reply.get("gen")
+        if self._publisher is not None and self._publisher.tenant is None:
+            self._publisher.tenant = reply.get("tenant")
+
+    def _resync(self) -> None:
+        if not self._consumed:
+            return
+        payload = {str(e): sorted(ps) for e, ps in self._consumed.items()}
+        reply, _ = rpc(self._ctrl, {"type": "resync",
+                                    "client_id": self.client_id,
+                                    "job_id": self._job["job_id"],
+                                    "consumed": payload},
+                       timeout_ms=self._control_timeout_ms)
+        if reply.get("type") != "resync_ok":
+            raise ServiceError(f"resync failed: {reply.get('error')}")
+        self._gen = reply.get("gen", self._gen)
+        self._c_resyncs.add(1)
+
+    def _renew_if_due(self) -> None:
+        run = self._run
+        if run is None or run.lost:
+            return
+        now = time.monotonic()
+        if now - self._last_renew < run.ttl_s / 3.0:
+            return
+        self._last_renew = now
+        try:
+            reply = self._rpc({"type": "lease_renew",
+                               "lease_id": run.lease_id,
+                               "job_id": self._job["job_id"]})
+        except _GenerationChanged:
+            return
+        except WireError:
+            return  # best-effort: the next due renewal retries
+        if reply.get("type") != "renew_ok":
+            # Fenced: stop yielding from this lease — the range folds back
+            # and another client redelivers it.
+            run.lost = True
+
+    def _complete_lease(self, run: _LeaseRun,
+                        returned: Optional[List[int]] = None) -> None:
+        if run.lost:
+            return
+        totals = accounting_totals(self.telemetry.metrics_view())
+        try:
+            self._rpc({"type": "lease_complete",
+                       "lease_id": run.lease_id,
+                       "job_id": self._job["job_id"],
+                       "client_id": self.client_id,
+                       "delivered": run.delivered,
+                       "skipped": run.skipped,
+                       "returned": sorted(returned or ()),
+                       "duplicates_dropped": run.duplicates_dropped,
+                       "accounting": totals})
+        except _GenerationChanged:
+            pass
+
+    # -------------------------------------------------------- data plane
+    def _data_sock(self, addr: str):
+        sock = self._data_socks.get(addr)
+        if sock is None:
+            sock = service_socket(self._ctx, zmq.DEALER, connect=addr)
+            self._data_socks[addr] = sock
+            self._poller.register(sock, zmq.POLLIN)
+        return sock
+
+    def _send_order(self, run: _LeaseRun, addr: str) -> str:
+        order_id = uuid.uuid4().hex[:12]
+        job = self._job
+        send_msg(self._data_sock(addr), {
+            "type": "work_order", "order_id": order_id,
+            "job_id": job["job_id"], "tenant": job["tenant"],
+            "dataset_url": job["dataset_url"],
+            "reader_kwargs": job["reader_kwargs"], "plan": job["plan"],
+            "fingerprint": job["fingerprint"],
+            "store_type": job["store_type"],
+            "epoch": run.epoch, "positions": run.positions,
+            "ordinals": run.ordinals})
+        return order_id
+
+    def _fetch_lease_units(self, run: _LeaseRun) -> List[tuple]:
+        """Stream one lease's units into plan order. Returns
+        ``[(position, table-or-None), ...]`` ascending; ``None`` payload
+        marks a skip-accounted position. Reorder and hedge-duplicate
+        dedup run through a per-lease
+        :class:`~petastorm_tpu.reader_impl.epoch_plan.OrderedDeliveryGate`
+        keyed by the position's rank within the lease — the same
+        first-result-wins-by-ordinal gate PR 4 uses for file handles."""
+        from petastorm_tpu.reader_impl.epoch_plan import (EpochPlan,
+                                                          OrderedDeliveryGate,
+                                                          OrderedUnit)
+        from petastorm_tpu.workers_pool import EmptyResultError
+        if run.server is None:
+            raise ServiceError("no decode servers registered with the "
+                               "dispatcher")
+        rank = {p: i for i, p in enumerate(run.positions)}
+        gate = OrderedDeliveryGate(
+            EpochPlan(seed=0, num_items=len(run.positions)),
+            telemetry=self.telemetry)
+        dups_before = self.telemetry.peek_counter("order.duplicates_dropped")
+        hedge_delay = (self._hedge_delay_override
+                       if self._hedge_delay_override is not None
+                       else float(self._job.get("hedge_delay_s") or 1.0))
+        order_ids = {self._send_order(run, run.server)}
+        hedged = [False]
+        last_progress = [time.monotonic()]
+        arrivals: List[OrderedUnit] = []
+        seen_positions: set = set()
+        skipped_positions: set = set()
+
+        def _pump() -> None:
+            """Poll all data sockets once, translating unit frames into
+            per-lease gate units (rank-indexed)."""
+            self._renew_if_due()
+            timeout_ms = max(50, int(min(hedge_delay, 0.1) * 1000))
+            events = dict(self._poller.poll(timeout_ms))  # wire-ok: bounded multi-socket poll; frames drained via recv_msg
+            progressed = False
+            for sock in list(self._data_socks.values()):
+                if events.get(sock) != zmq.POLLIN:
+                    continue
+                while True:
+                    try:
+                        _, header, payload = recv_msg(sock, timeout_ms=0)
+                    except WireTimeout:
+                        break
+                    except WireError:
+                        continue
+                    mtype = header.get("type")
+                    if mtype == "order_error":
+                        if header.get("order_id") in order_ids:
+                            raise ServiceError(
+                                f"work order failed on server: "
+                                f"{header.get('error')}")
+                        continue
+                    if mtype != "unit" \
+                            or header.get("order_id") not in order_ids:
+                        continue  # stale frames from an abandoned order
+                    position = int(header["position"])
+                    if position not in rank:
+                        continue
+                    kind = header.get("kind", "data")
+                    table = (self._serializer.deserialize(payload)
+                             if kind == "data" and payload is not None
+                             else None)
+                    seen_positions.add(position)
+                    if kind != "data":
+                        skipped_positions.add(position)
+                    arrivals.append(OrderedUnit(
+                        (0, rank[position]),
+                        kind=("data" if kind == "data" else "skip"),
+                        payload=(position, table)))
+                    progressed = True
+            if progressed:
+                last_progress[0] = time.monotonic()
+                return
+            now = time.monotonic()
+            if (not hedged[0] and run.backup
+                    and run.backup != run.server
+                    and now - last_progress[0] >= hedge_delay):
+                # Straggler: re-dispatch to the backup; first result per
+                # ordinal wins at the gate.
+                hedged[0] = True
+                self._c_hedges.add(1)
+                order_ids.add(self._send_order(run, run.backup))
+                last_progress[0] = now
+            elif now - last_progress[0] > self._unit_timeout_s:
+                raise ServiceError(
+                    f"no progress on lease {run.lease_id} for "
+                    f"{self._unit_timeout_s}s (servers "
+                    f"{run.server}/{run.backup})")
+
+        def _fetch():
+            while not arrivals:
+                if run.lost or len(seen_positions) >= len(run.positions):
+                    raise EmptyResultError()
+                _pump()
+            return arrivals.pop(0)
+
+        out: List[tuple] = []
+        while (len(out) + len(skipped_positions) < len(run.positions)
+               and not run.lost):
+            try:
+                out.append(gate.pull(_fetch))
+            except EmptyResultError:
+                break
+        for position in sorted(skipped_positions):
+            out.append((position, None))
+        out.sort(key=lambda item: item[0])
+        dups = self.telemetry.peek_counter("order.duplicates_dropped") \
+            - dups_before
+        run.duplicates_dropped += int(dups)
+        self._c_dups.add(int(dups))
+        return out
+
+    # ----------------------------------------------------------- consume
+    def _next_lease(self) -> bool:
+        """Acquire the next lease and stage its units; False at end of
+        data."""
+        while True:
+            if self._end or self._stopped:
+                return False
+            try:
+                reply = self._rpc({"type": "lease_request",
+                                   "client_id": self.client_id,
+                                   "job_id": self._job["job_id"],
+                                   "max_units": self._max_units})
+            except _GenerationChanged:
+                continue
+            mtype = reply.get("type")
+            if mtype == "end_of_data":
+                self._end = True
+                return False
+            if mtype == "wait":
+                self._c_waits.add(1)
+                wait_s = float(reply.get("retry_after_s") or 0.05)
+                time.sleep(wait_s)  # backoff-ok: dispatcher's admission hint (fair-share pacing), not client retry policy
+                continue
+            if mtype != "lease":
+                raise ServiceError(f"lease_request failed: "
+                                   f"{reply.get('error') or reply}")
+            run = _LeaseRun(reply)
+            self._run = run
+            self._last_renew = time.monotonic()
+            self._c_leases.add(1)
+            try:
+                units = self._fetch_lease_units(run)
+            except ServiceError:
+                # Hand the range back cleanly before surfacing the error.
+                try:
+                    self._complete_lease(run, returned=run.positions)
+                except WireError:
+                    pass  # expiry will fold the range back regardless
+                self._run = None
+                raise
+            if run.lost:
+                # Fenced mid-fetch: nothing we buffered may be yielded —
+                # the dispatcher already folded the range back.
+                self._run = None
+                continue
+            staged = []
+            for position, table in units:
+                if table is None:
+                    run.skipped.append(position)
+                else:
+                    staged.append((position, table))
+            self._pending_units = staged
+            if not staged:
+                # All-skip lease: ack and move on.
+                run.delivered = []
+                self._finish_run()
+                continue
+            return True
+
+    def _finish_run(self) -> None:
+        run, self._run = self._run, None
+        if run is not None:
+            self._complete_lease(run)
+
+    def _record_delivery(self, position: int, table) -> None:
+        run = self._run
+        run.delivered.append(position)
+        self._consumed.setdefault(run.epoch, []).append(position)
+        self._c_units.add(1)
+        self._c_rows.add(table.num_rows)
+
+    def _next_table(self):
+        self._renew_if_due()
+        if self._run is not None and self._run.lost:
+            # Fenced mid-consumption: the rest of the range belongs to
+            # whoever the dispatcher re-leases it to.
+            self._pending_units = []
+            self._run = None
+        while not self._pending_units:
+            if not self._next_lease():
+                raise StopIteration
+        position, table = self._pending_units.pop(0)
+        self._record_delivery(position, table)
+        if not self._pending_units:
+            self._finish_run()
+        return table
+
+    @staticmethod
+    def _columns(table) -> dict:
+        return {name: table.column(i).to_numpy(zero_copy_only=False)
+                for i, name in enumerate(table.column_names)}
+
+    def __iter__(self) -> "ServiceReader":
+        return self
+
+    def __next__(self):
+        columns = self._columns(self._next_table())
+        if self._row_type is None:
+            self._row_type = namedtuple("ServiceBatch",
+                                        list(columns), rename=True)
+        return self._row_type(**columns)
+
+    def next(self):
+        return self.__next__()
+
+    def next_batch(self) -> dict:
+        """The next unit as a ``{column: ndarray}`` dict (the batch-native
+        consumer API, mirroring ``Reader.next_batch``)."""
+        return self._columns(self._next_table())
+
+    # ------------------------------------------------------------ surface
+    def state_dict(self) -> dict:
+        """Service cursor: which plan positions this client consumed. A
+        new client resumed from it replays them to the dispatcher
+        (``resync``) so the fleet never redelivers them."""
+        return {"type": "service", "version": 1,
+                "job_id": self._job["job_id"],
+                "tenant": self._job["tenant"],
+                "seed": self._job["seed"],
+                "num_items": self._job["num_items"],
+                "consumed": {str(e): sorted(ps)
+                             for e, ps in self._consumed.items()}}
+
+    @property
+    def diagnostics(self) -> dict:
+        view = self.telemetry.metrics_view()["counters"]
+        return {"client_id": self.client_id,
+                "job_id": self._job["job_id"] if self._job else None,
+                "units": int(view.get("service.client.units_total", 0)),
+                "rows": int(view.get("reader.rows", 0)),
+                "leases": int(view.get("service.client.leases_total", 0)),
+                "hedges": int(view.get("service.client.hedges_total", 0)),
+                "hedge_duplicates_dropped": int(
+                    view.get("service.client.hedge_duplicates_dropped_total",
+                             0)),
+                "resyncs": int(view.get("service.client.resyncs_total", 0))}
+
+    def explain(self, profiled: bool = False):
+        """The service pipeline's operator graph (docs/service.md): lease
+        acquisition → fleet decode → ordered reassembly → materialize."""
+        from petastorm_tpu.explain.spec import OperatorNode, PipelineSpec
+        job = self._job or {}
+        ops = [
+            OperatorNode(op_id="lease", name="plan-ordinal lease protocol",
+                         layer="L5", placement="dispatcher",
+                         capacity={"chunk": job.get("chunk"),
+                                   "ttl_s": job.get("lease_ttl_s")},
+                         induced_by={"dispatcher": self.dispatcher_addr,
+                                     "job_id": job.get("job_id"),
+                                     "tenant": job.get("tenant")},
+                         downstream=("fleet_decode",)),
+            OperatorNode(op_id="fleet_decode",
+                         name="decode-server work orders", layer="L2",
+                         placement="service.server",
+                         parallelism=len(job.get("servers") or ()) or 1,
+                         stage="decode",
+                         induced_by={"servers": job.get("servers"),
+                                     "hedge_delay_s":
+                                         job.get("hedge_delay_s")},
+                         upstream=("lease",), downstream=("order",)),
+            OperatorNode(op_id="order", name="ordered delivery gate",
+                         layer="L4", placement="consumer", stage="order",
+                         induced_by={"sample_order": "deterministic",
+                                     "seed": job.get("seed")},
+                         upstream=("fleet_decode",),
+                         downstream=("materialize",)),
+            OperatorNode(op_id="materialize",
+                         name="arrow -> numpy batch materialization",
+                         layer="L5", placement="consumer",
+                         stage="materialize", upstream=("order",)),
+        ]
+        spec = PipelineSpec(ops, pipeline_id=self.telemetry.pipeline_id,
+                            source="service_reader",
+                            config={"dispatcher": self.dispatcher_addr,
+                                    "job": {k: job.get(k) for k in
+                                            ("job_id", "tenant", "seed",
+                                             "num_items", "num_epochs")}})
+        if profiled:
+            spec.profile = {"counters":
+                            dict(self.telemetry.metrics_view()["counters"])}
+        return spec
+
+    def service_report(self) -> dict:
+        """The dispatcher's fleet report (coverage, scheduler, leases,
+        accounting) fetched over the control socket."""
+        try:
+            reply = self._rpc({"type": "status"})
+        except _GenerationChanged:
+            reply = self._rpc({"type": "status"})
+        return reply.get("report") or {}
+
+    # ---------------------------------------------------------- lifecycle
+    def stop(self) -> None:
+        """Hand back the in-flight range (clean detach) and stop."""
+        if self._stopped:
+            return
+        self._stopped = True
+        run = self._run
+        if run is not None:
+            undelivered = sorted(set(run.positions) - set(run.delivered)
+                                 - set(run.skipped))
+            try:
+                self._complete_lease(run, returned=undelivered)
+            except (WireError, ServiceError):
+                # Best-effort: an unreachable dispatcher fences the lease
+                # by expiry and folds the range back on its own.
+                pass
+            self._run = None
+        self._pending_units = []
+        try:
+            self._rpc({"type": "detach", "client_id": self.client_id})
+        except (WireError, _GenerationChanged, ServiceError):
+            pass
+
+    def join(self) -> None:
+        if self._publisher is not None:
+            self._publisher.stop()
+        for sock in self._data_socks.values():
+            try:
+                self._poller.unregister(sock)
+            except KeyError:
+                pass
+            sock.close()
+        self._data_socks = {}
+        if self._ctrl is not None:
+            ctrl, self._ctrl = self._ctrl, None
+            ctrl.close()
+
+    def abandon(self) -> None:
+        """Die without detaching — the crash-simulation entry point tests
+        and the bench use: leases are left to expire and fold back."""
+        self._stopped = True
+        self._run = None
+        self._pending_units = []
+        self.join()
+
+    def __enter__(self) -> "ServiceReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+        self.join()
+
+
+class _GenerationChanged(Exception):
+    """Internal: the dispatcher restarted; state was resynced — retry."""
+
+
+def make_service_reader(dispatcher_addr: str, **kwargs) -> ServiceReader:
+    """A fleet-backed reader with the ``make_batch_reader`` consumer
+    surface. See :class:`ServiceReader` for kwargs (``job_id``,
+    ``tenant``, ``resume_state``, ``hedge_delay_s``,
+    ``telemetry_publish``, ...)."""
+    return ServiceReader(dispatcher_addr, **kwargs)

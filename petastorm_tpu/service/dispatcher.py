@@ -1,0 +1,606 @@
+"""The service-plane control plane: one dispatcher per fleet.
+
+The dispatcher owns what must be owned exactly once — the dataset
+listing, the :class:`~petastorm_tpu.reader_impl.epoch_plan.EpochPlan`,
+the lease book, the fair-share scheduler, the fleet coverage ledger, the
+accounting bill, and the fleet plan registry. It never touches row-group
+bytes: data flows client ↔ decode server; the dispatcher only answers
+small framed JSON RPCs on one ROUTER socket (attach / lease_request /
+lease_renew / lease_complete / resync / detach / server_hello /
+plan_get / plan_put / status).
+
+Determinism across the fleet: every client draws disjoint plan-position
+ranges from the same minted plan; an expired lease's positions fold back
+into the pending pool in plan order (the PR 7 reshard fold-back), and a
+fenced lease can never ack — so the union of acknowledged deliveries
+visits every plan position exactly once per epoch, in a permutation that
+is byte-for-byte the single-reader ``sample_order='deterministic'``
+order for the same seed (docs/service.md).
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from petastorm_tpu.reader_impl.epoch_plan import EpochPlan, mint_seed
+from petastorm_tpu.service.lease import LeaseBook, FleetCoverageLedger
+from petastorm_tpu.service.scheduler import FairShareScheduler
+from petastorm_tpu.service.wire import (WireError, WireTimeout, recv_msg,
+                                        send_msg, service_socket)
+from petastorm_tpu.telemetry.accounting import AccountingLedger, DEFAULT_TENANT
+
+try:
+    import zmq
+except ImportError:  # pragma: no cover - pyzmq is an install-time dep
+    zmq = None
+
+logger = logging.getLogger(__name__)
+
+#: Reader kwargs a service job may carry. Everything else either breaks
+#: the fleet determinism contract (``shuffle_rows`` keys its RNG by the
+#: server-local position, predicates/shards change the item list) or
+#: names host-local resources that make no sense in a work order.
+SUPPORTED_READER_KWARGS = frozenset({
+    "schema_fields", "shuffle_row_groups", "workers_count",
+    "reader_pool_type", "results_queue_size", "memory_cache_size_bytes",
+    "zmq_copy_buffers",
+})
+
+DEFAULT_LEASE_TTL_S = 10.0
+DEFAULT_CHUNK = 8
+DEFAULT_HEDGE_DELAY_S = 1.0
+
+
+class ServiceJobSpec:
+    """Declarative description of one fleet job (CLI config row)."""
+
+    def __init__(self, job_id: str, dataset_url: str,
+                 tenant: str = DEFAULT_TENANT, flavor: str = "batch",
+                 reader_kwargs: Optional[dict] = None,
+                 num_epochs: int = 1, seed: Optional[int] = None,
+                 chunk: int = DEFAULT_CHUNK):
+        if flavor != "batch":
+            raise ValueError(f"service flavor {flavor!r} unsupported: the "
+                             "fleet serves make_batch_reader semantics "
+                             "(docs/service.md)")
+        kwargs = dict(reader_kwargs or {})
+        unsupported = set(kwargs) - SUPPORTED_READER_KWARGS
+        if unsupported:
+            raise ValueError(
+                f"service job {job_id!r}: unsupported reader kwargs "
+                f"{sorted(unsupported)} (supported: "
+                f"{sorted(SUPPORTED_READER_KWARGS)})")
+        self.job_id = str(job_id)
+        self.dataset_url = dataset_url
+        self.tenant = str(tenant or DEFAULT_TENANT)
+        self.flavor = flavor
+        self.reader_kwargs = kwargs
+        self.num_epochs = int(num_epochs)
+        self.seed = seed if seed is None else int(seed)
+        self.chunk = int(chunk)
+
+    def to_dict(self) -> dict:
+        return {"job_id": self.job_id, "dataset_url": self.dataset_url,
+                "tenant": self.tenant, "flavor": self.flavor,
+                "reader_kwargs": dict(self.reader_kwargs),
+                "num_epochs": self.num_epochs, "seed": self.seed,
+                "chunk": self.chunk}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServiceJobSpec":
+        return cls(**d)
+
+
+class _Job:
+    """Dispatcher-side runtime state of one job. Loaded lazily (first
+    attach) so constructing a dispatcher never touches storage."""
+
+    def __init__(self, spec: ServiceJobSpec):
+        self.spec = spec
+        self.loaded = False
+        self.seed: Optional[int] = None
+        self.num_items = 0
+        self.plan: Optional[EpochPlan] = None
+        self.pipeline_plan: Optional[dict] = None
+        self.fingerprint: Optional[str] = None
+        self.store_type: Optional[str] = None
+        self.epoch = 0
+        self.done = False
+        self.pending: List[int] = []
+        self.outstanding: set = set()
+        self.coverage: Optional[FleetCoverageLedger] = None
+
+    def load(self) -> None:
+        if self.loaded:
+            return
+        from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                        load_row_groups)
+        from petastorm_tpu.plan.cache import PlanKey
+        from petastorm_tpu.plan.lowering import lower_reader_kwargs
+        ctx = DatasetContext(self.spec.dataset_url)
+        self.num_items = len(load_row_groups(ctx))
+        if self.num_items == 0:
+            raise ValueError(f"dataset {self.spec.dataset_url} has no row "
+                             "groups to serve")
+        self.seed = (self.spec.seed if self.spec.seed is not None
+                     else mint_seed())
+        kwargs = self.spec.reader_kwargs
+        self.plan = EpochPlan(seed=self.seed, num_items=self.num_items,
+                              shuffled=bool(kwargs.get("shuffle_row_groups",
+                                                       True)))
+        lowered = lower_reader_kwargs(
+            self.spec.flavor,
+            dict(kwargs, seed=self.seed, num_epochs=self.spec.num_epochs,
+                 sample_order="deterministic"),
+            schema_field_names=sorted(kwargs.get("schema_fields") or ()))
+        self.pipeline_plan = lowered.to_dict()
+        key = PlanKey.for_dataset(self.spec.dataset_url,
+                                  sorted(kwargs.get("schema_fields") or ()))
+        self.fingerprint, self.store_type = key.fingerprint, key.store_type
+        self.pending = list(range(self.num_items))
+        self.coverage = FleetCoverageLedger(self.num_items)
+        self.loaded = True
+
+    def fold_back(self, positions: Sequence[int]) -> None:
+        """Reclaimed positions return to the pending pool in plan order."""
+        self.pending = sorted(set(self.pending) | set(positions))
+
+
+class Dispatcher:
+    """One fleet's control plane. ``start()`` spawns the request loop;
+    everything else is RPC-driven (see module docstring for the verbs)."""
+
+    def __init__(self, addr: str, jobs: Sequence[ServiceJobSpec] = (),
+                 servers: Sequence[str] = (), *,
+                 lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 hedge_delay_s: float = DEFAULT_HEDGE_DELAY_S,
+                 weights: Optional[Dict[str, float]] = None,
+                 quotas: Optional[Dict[str, int]] = None,
+                 scheduler: Optional[FairShareScheduler] = None,
+                 telemetry_publish: Optional[str] = None,
+                 context=None, clock=time.monotonic):
+        if zmq is None:
+            raise RuntimeError("service plane requires pyzmq")
+        self.addr = addr
+        self.gen = uuid.uuid4().hex[:12]
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.hedge_delay_s = float(hedge_delay_s)
+        self._clock = clock
+        self._jobs: Dict[str, _Job] = {}
+        for spec in jobs:
+            self.add_job(spec)
+        self._servers: List[str] = list(servers)
+        self._rr = 0
+        self.book = LeaseBook(ttl_s=self.lease_ttl_s, clock=clock)
+        self.accounting = AccountingLedger()
+        self.scheduler = scheduler or FairShareScheduler(
+            weights=weights, quotas=quotas, ledger=self.accounting)
+        #: Fleet plan registry: ``(fingerprint, store_type) -> record``.
+        #: One host's placement trial (``plan_put``) warms every server
+        #: (``plan_get`` at work-order time seeds the server's local
+        #: PlanCache under its own host key).
+        self._plan_registry: Dict[Tuple[str, str], dict] = {}
+        self._registry_lock = threading.Lock()
+
+        from petastorm_tpu.telemetry import make_registry
+        self.telemetry = make_registry()
+        t = self.telemetry
+        self._c_granted = t.counter("service.leases_granted_total")
+        self._c_renewed = t.counter("service.leases_renewed_total")
+        self._c_reclaimed = t.counter("service.leases_reclaimed_total")
+        self._c_late = t.counter("service.late_acks_total")
+        self._c_delivered = t.counter("service.units_delivered_total")
+        self._c_skipped = t.counter("service.units_skipped_total")
+        self._c_violations = t.counter("service.coverage_violations_total")
+        self._c_denials = t.counter("service.sched_denials_total")
+        self._c_requests = t.counter("service.requests_total")
+        self._c_wire_errors = t.counter("service.wire_errors_total")
+        t.gauge("service.leases_active", self.book.active_count)
+        t.gauge("service.servers", lambda: len(self._servers))
+        t.gauge("service.pending_units",
+                lambda: sum(len(j.pending) for j in self._jobs.values()))
+
+        self._publisher = None
+        if telemetry_publish:
+            from petastorm_tpu.telemetry.fabric import TelemetryPublisher
+            self._publisher = TelemetryPublisher(
+                self.telemetry, telemetry_publish,
+                member="service.dispatcher", context=context)
+
+        self._ctx = context
+        self._own_ctx = context is None
+        self._sock = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+    def add_job(self, spec: ServiceJobSpec) -> None:
+        self._jobs[spec.job_id] = _Job(spec)
+
+    def register_server(self, addr: str) -> None:
+        with self._lock:
+            if addr not in self._servers:
+                self._servers.append(addr)
+
+    def start(self) -> "Dispatcher":
+        if self._thread is not None:
+            raise RuntimeError("Dispatcher already started")
+        if self._ctx is None:
+            self._ctx = zmq.Context.instance()
+            self._own_ctx = False
+        self._sock = service_socket(self._ctx, zmq.ROUTER, bind=self.addr)
+        if self._publisher is not None:
+            self._publisher.start()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="petastorm-tpu-svc-dispatch")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+        if self._publisher is not None:
+            self._publisher.stop()
+        if self._sock is not None:
+            sock, self._sock = self._sock, None
+            sock.close()
+
+    def __enter__(self) -> "Dispatcher":
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- the loop
+    def _run(self) -> None:
+        last_sweep = self._clock()
+        sweep_every = max(0.05, min(1.0, self.lease_ttl_s / 4.0))
+        while not self._stop.is_set():
+            try:
+                ident, msg, _ = recv_msg(self._sock, timeout_ms=100,
+                                         routed=True)
+            except WireTimeout:
+                ident, msg = None, None
+            except WireError:
+                self._c_wire_errors.add(1)
+                ident, msg = None, None
+            if msg is not None:
+                self._c_requests.add(1)
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # noqa: BLE001 - loop must survive
+                    logger.exception("dispatcher request failed")
+                    reply = {"type": "error", "error": repr(e)}
+                reply.setdefault("gen", self.gen)
+                if "req_id" in msg:
+                    reply["re"] = msg["req_id"]
+                try:
+                    send_msg(self._sock, reply, ident=ident)
+                except WireError:
+                    self._c_wire_errors.add(1)
+            now = self._clock()
+            if now - last_sweep >= sweep_every:
+                last_sweep = now
+                self.sweep_expired()
+
+    def sweep_expired(self) -> None:
+        """Fence every expired lease and fold its positions back into its
+        job's pending pool (public so tests can sweep without sleeping)."""
+        for lease in self.book.expire():
+            job = self._jobs.get(lease.job_id)
+            if job is not None:
+                with self._lock:
+                    job.outstanding.discard(lease.lease_id)
+                    job.fold_back(lease.positions)
+            self.scheduler.on_reclaimed(lease.tenant, len(lease.positions),
+                                        lease.epoch)
+            self._c_reclaimed.add(1)
+            logger.info("lease %s (client %s) expired; %d positions fold "
+                        "back", lease.lease_id, lease.client_id,
+                        len(lease.positions))
+
+    # ------------------------------------------------------------- handlers
+    def _handle(self, msg: dict) -> dict:
+        mtype = msg.get("type")
+        handler = getattr(self, f"_on_{mtype}", None)
+        if handler is None:
+            return {"type": "error", "error": f"unknown request {mtype!r}"}
+        return handler(msg)
+
+    def _job_for(self, msg: dict) -> Optional[_Job]:
+        job_id = msg.get("job_id")
+        if job_id is not None:
+            return self._jobs.get(job_id)
+        tenant = msg.get("tenant")
+        for job in self._jobs.values():
+            if tenant is None or job.spec.tenant == tenant:
+                return job
+        return None
+
+    def _on_attach(self, msg: dict) -> dict:
+        job = self._job_for(msg)
+        if job is None:
+            return {"type": "error",
+                    "error": f"no job matches {msg.get('job_id') or msg.get('tenant')!r}"}
+        with self._lock:
+            job.load()
+        record = None
+        if job.fingerprint is not None:
+            with self._registry_lock:
+                record = self._plan_registry.get(
+                    (job.fingerprint, job.store_type))
+        spec = job.spec
+        return {"type": "attach_ok", "job_id": spec.job_id,
+                "tenant": spec.tenant, "flavor": spec.flavor,
+                "dataset_url": spec.dataset_url,
+                "reader_kwargs": dict(spec.reader_kwargs),
+                "seed": job.seed, "num_items": job.num_items,
+                "num_epochs": spec.num_epochs, "chunk": spec.chunk,
+                "plan": job.pipeline_plan, "plan_record": record,
+                "fingerprint": job.fingerprint,
+                "store_type": job.store_type,
+                "servers": list(self._servers),
+                "lease_ttl_s": self.lease_ttl_s,
+                "hedge_delay_s": self.hedge_delay_s}
+
+    def _assign_servers(self, ordinals: Sequence[int] = (),
+                        num_items: int = 0,
+                        ) -> Tuple[Optional[str], Optional[str]]:
+        """Cache-affinity routing: the row-group ordinal space is
+        range-striped across the fleet and a lease goes to the server
+        owning the plurality of its groups, so replays of a group —
+        later epochs, sibling clients, other jobs over the same dataset
+        fingerprint — land where its serialized Arrow buffers are
+        already cached instead of forcing a cold decode on a random
+        server. Ties break to the lowest stripe; leases with nothing to
+        key on fall back to round-robin. The hedge backup is the next
+        server in registration order, so a straggling owner never
+        blocks the lease."""
+        with self._lock:
+            if not self._servers:
+                return None, None
+            n = len(self._servers)
+            if ordinals and num_items > 0 and n > 1:
+                owners: Dict[int, int] = {}
+                for o in ordinals:
+                    stripe = min(int(o) * n // num_items, n - 1)
+                    owners[stripe] = owners.get(stripe, 0) + 1
+                top = max(owners.values())
+                idx = min(k for k, v in owners.items() if v == top)
+            else:
+                idx = self._rr % n
+                self._rr += 1
+            primary = self._servers[idx]
+            backup = self._servers[(idx + 1) % n] if n > 1 else None
+        return primary, backup
+
+    def _on_lease_request(self, msg: dict) -> dict:
+        job = self._jobs.get(msg.get("job_id"))
+        if job is None or not job.loaded:
+            return {"type": "error", "error": "attach before lease_request"}
+        client_id = str(msg.get("client_id"))
+        tenant = job.spec.tenant
+        with self._lock:
+            self._advance_epoch_locked(job)
+            if job.done:
+                return {"type": "end_of_data", "epoch": job.epoch}
+            if not job.pending:
+                # Epoch drain barrier: everything is leased out; the next
+                # ranges appear when leases ack or expire.
+                return {"type": "wait", "reason": "drain",
+                        "retry_after_s": min(0.05, self.lease_ttl_s / 4)}
+            units = min(int(msg.get("max_units") or job.spec.chunk),
+                        job.spec.chunk, len(job.pending))
+        ok, reason, retry = self.scheduler.admit(tenant, units, job.epoch)
+        if not ok:
+            self._c_denials.add(1)
+            return {"type": "wait", "reason": reason,
+                    "retry_after_s": retry}
+        with self._lock:
+            if not job.pending:
+                return {"type": "wait", "reason": "drain",
+                        "retry_after_s": 0.05}
+            units = min(units, len(job.pending))
+            positions = job.pending[:units]
+            del job.pending[:units]
+            epoch = job.epoch
+            perm = job.plan.permutation(epoch)
+            ordinals = [perm[p] for p in positions]
+        primary, backup = self._assign_servers(ordinals, job.num_items)
+        lease = self.book.grant(client_id, tenant, job.spec.job_id, epoch,
+                                positions, server=primary, backup=backup)
+        with self._lock:
+            job.outstanding.add(lease.lease_id)
+        self.scheduler.on_granted(tenant, len(positions), epoch)
+        self._c_granted.add(1)
+        self._tenant_counter(tenant, "units_granted_total").add(len(positions))
+        return {"type": "lease", "lease_id": lease.lease_id, "epoch": epoch,
+                "positions": positions, "ordinals": ordinals,
+                "server": primary, "backup": backup,
+                "ttl_s": self.lease_ttl_s,
+                "hedge_delay_s": self.hedge_delay_s}
+
+    def _advance_epoch_locked(self, job: _Job) -> None:
+        while (not job.done and not job.pending and not job.outstanding
+               and job.coverage.accounted(job.epoch) >= job.num_items):
+            job.epoch += 1
+            if job.epoch >= job.spec.num_epochs:
+                job.done = True
+            else:
+                job.pending = list(range(job.num_items))
+
+    def _on_lease_renew(self, msg: dict) -> dict:
+        if self.book.renew(str(msg.get("lease_id"))):
+            self._c_renewed.add(1)
+            return {"type": "renew_ok"}
+        return {"type": "lease_lost"}
+
+    def _on_lease_complete(self, msg: dict) -> dict:
+        lease = self.book.complete(str(msg.get("lease_id")))
+        if lease is None:
+            # Fenced: expired (and possibly re-leased) before the ack.
+            self._c_late.add(1)
+            job = self._jobs.get(msg.get("job_id"))
+            if job is not None and job.coverage is not None:
+                job.coverage.note_late_ack()
+            return {"type": "lease_lost"}
+        job = self._jobs[lease.job_id]
+        delivered = [int(p) for p in msg.get("delivered") or ()]
+        skipped = [int(p) for p in msg.get("skipped") or ()]
+        returned = [int(p) for p in msg.get("returned") or ()]
+        # Anything the ack doesn't place is treated as returned — a lease
+        # can never strand positions.
+        leftover = (set(lease.positions) - set(delivered) - set(skipped)
+                    - set(returned))
+        returned = sorted(set(returned) | leftover)
+        dup = int(msg.get("duplicates_dropped") or 0)
+        added = job.coverage.account(lease.epoch, lease.client_id,
+                                     delivered, skipped, dup)
+        if added:
+            self._c_violations.add(added)
+        with self._lock:
+            job.outstanding.discard(lease.lease_id)
+            if returned:
+                job.fold_back(returned)
+            self._advance_epoch_locked(job)
+        self.scheduler.on_accounted(lease.tenant,
+                                    len(delivered) + len(skipped))
+        if returned:
+            self.scheduler.on_reclaimed(lease.tenant, len(returned),
+                                        lease.epoch)
+        totals = msg.get("accounting")
+        if isinstance(totals, dict):
+            self.accounting.apply(lease.client_id, lease.tenant, totals,
+                                  member=f"service.client.{lease.client_id}")
+        self._c_delivered.add(len(delivered))
+        self._c_skipped.add(len(skipped))
+        self._tenant_counter(lease.tenant,
+                             "units_delivered_total").add(len(delivered))
+        return {"type": "ack_ok", "epoch": job.epoch}
+
+    def _on_resync(self, msg: dict) -> dict:
+        """A client replays its consumed plan positions (from its
+        ``state_dict`` cursor) after a dispatcher restart: those positions
+        leave the pending pool and count as delivered — never redelivered,
+        never a violation."""
+        job = self._jobs.get(msg.get("job_id"))
+        if job is None:
+            return {"type": "error", "error": "unknown job"}
+        client_id = str(msg.get("client_id"))
+        with self._lock:
+            job.load()
+            resynced = 0
+            for epoch_str, positions in (msg.get("consumed") or {}).items():
+                epoch = int(epoch_str)
+                positions = [int(p) for p in positions]
+                fresh = job.coverage.resync(epoch, client_id, positions)
+                resynced += len(fresh)
+                if epoch == job.epoch and fresh:
+                    pend = set(job.pending)
+                    pend.difference_update(fresh)
+                    job.pending = sorted(pend)
+                if epoch > job.epoch and not job.done:
+                    # The fleet was further along than this incarnation
+                    # believed: jump forward, re-planning the rest.
+                    job.epoch = epoch
+                    job.pending = sorted(set(range(job.num_items))
+                                         - set(fresh))
+            self._advance_epoch_locked(job)
+        return {"type": "resync_ok", "resynced": resynced}
+
+    def _on_detach(self, msg: dict) -> dict:
+        client_id = str(msg.get("client_id"))
+        for lease in self.book.release_client(client_id):
+            job = self._jobs.get(lease.job_id)
+            if job is not None:
+                with self._lock:
+                    job.outstanding.discard(lease.lease_id)
+                    job.fold_back(lease.positions)
+            self.scheduler.on_reclaimed(lease.tenant, len(lease.positions),
+                                        lease.epoch)
+        return {"type": "ok"}
+
+    def _on_server_hello(self, msg: dict) -> dict:
+        addr = msg.get("addr")
+        if addr:
+            self.register_server(str(addr))
+        return {"type": "server_ok", "servers": list(self._servers)}
+
+    def _on_plan_get(self, msg: dict) -> dict:
+        key = (str(msg.get("fingerprint")), str(msg.get("store_type")))
+        with self._registry_lock:
+            record = self._plan_registry.get(key)
+        return {"type": "plan_record", "record": record}
+
+    def _on_plan_put(self, msg: dict) -> dict:
+        record = msg.get("record")
+        if not isinstance(record, dict) \
+                or record.get("backend") not in ("thread", "process"):
+            return {"type": "error", "error": "malformed plan record"}
+        key = (str(msg.get("fingerprint")), str(msg.get("store_type")))
+        clean = {k: v for k, v in record.items() if k != "key"}
+        with self._registry_lock:
+            self._plan_registry[key] = clean
+        return {"type": "plan_ok"}
+
+    def _on_status(self, msg: dict) -> dict:
+        return {"type": "status", "report": self.service_report()}
+
+    # ------------------------------------------------------------- reports
+    def _tenant_counter(self, tenant: str, suffix: str):
+        # metric-docs-ok: per-tenant dynamic family, documented as
+        # ``service.tenant.{tenant}.*`` in docs/observability.md
+        return self.telemetry.counter(f"service.tenant.{tenant}.{suffix}")
+
+    def service_report(self) -> dict:
+        """The fleet's coverage/fairness/billing rollup: per-job coverage
+        manifests (every plan position delivered or skip-accounted exactly
+        once — ``reconciled``), the scheduler's share table, the lease
+        book, and the accounting bill."""
+        jobs = {}
+        for job_id, job in self._jobs.items():
+            if not job.loaded:
+                jobs[job_id] = {"loaded": False}
+                continue
+            jobs[job_id] = {
+                "loaded": True, "tenant": job.spec.tenant,
+                "seed": job.seed, "num_items": job.num_items,
+                "epoch": job.epoch, "done": job.done,
+                "pending": len(job.pending),
+                "outstanding_leases": len(job.outstanding),
+                "coverage": job.coverage.report(),
+            }
+        return {
+            "gen": self.gen,
+            "jobs": jobs,
+            "servers": list(self._servers),
+            "leases": {"active": self.book.active_count(),
+                       "granted": self.book.granted_total,
+                       "renewed": self.book.renewed_total,
+                       "completed": self.book.completed_total,
+                       "expired": self.book.expired_total,
+                       "by_tenant": self.book.active_by_tenant()},
+            "scheduler": self.scheduler.report(),
+            "accounting": self.accounting.report(),
+            "coverage_violations": sum(
+                j.coverage.violations for j in self._jobs.values()
+                if j.coverage is not None),
+        }
+
+
+def load_jobs_config(path: str) -> List[ServiceJobSpec]:
+    """Jobs config file for the CLI: a JSON list of ServiceJobSpec dicts
+    (or ``{"jobs": [...]}``)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    rows = doc.get("jobs") if isinstance(doc, dict) else doc
+    return [ServiceJobSpec.from_dict(row) for row in rows]

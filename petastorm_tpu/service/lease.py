@@ -1,0 +1,261 @@
+"""Plan-ordinal leases and the fleet-wide coverage ledger.
+
+A *lease* is the dispatcher's unit of work distribution: a contiguous-ish
+set of plan positions within one epoch, granted to one client with a TTL.
+The state machine (docs/service.md) is::
+
+    PENDING --grant--> ACTIVE --complete--> ACCOUNTED
+                        |  ^
+                 expire |  | renew
+                        v  |
+                     RECLAIMED --(positions fold back to PENDING)
+
+A reclaimed lease is *fenced*: its late ``lease_complete`` is rejected
+(``lease_lost``), so every plan position has at most one accounting
+lease and the fleet ledger's exactly-once claim is over acknowledged
+deliveries. The undelivered range folds back into the pending pool in
+plan order — the same fold-back a host reshard performs on the
+:class:`~petastorm_tpu.reader_impl.epoch_plan.EpochPlan` — which is what
+keeps the fleet's union stream byte-identical to a single local reader
+as clients join and leave mid-epoch.
+
+:class:`FleetCoverageLedger` is the service-plane twin of the quality
+plane's :class:`~petastorm_tpu.quality.coverage.CoverageLedger`: same
+manifest vocabulary (planned/delivered/skipped/duplicates/reconciled),
+but merged from per-client lease acknowledgements instead of fed by one
+reader's delivery gate.
+"""
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+
+class Lease:
+    """One granted plan-ordinal range. Mutated only under the book's lock."""
+
+    __slots__ = ("lease_id", "client_id", "tenant", "job_id", "epoch",
+                 "positions", "server", "backup", "granted_at", "expires_at",
+                 "renewals")
+
+    def __init__(self, lease_id: str, client_id: str, tenant: str,
+                 job_id: str, epoch: int, positions: List[int],
+                 server: Optional[str], backup: Optional[str],
+                 granted_at: float, expires_at: float):
+        self.lease_id = lease_id
+        self.client_id = client_id
+        self.tenant = tenant
+        self.job_id = job_id
+        self.epoch = epoch
+        self.positions = positions
+        self.server = server
+        self.backup = backup
+        self.granted_at = granted_at
+        self.expires_at = expires_at
+        self.renewals = 0
+
+    def describe(self) -> dict:
+        return {
+            "lease_id": self.lease_id, "client_id": self.client_id,
+            "tenant": self.tenant, "job_id": self.job_id,
+            "epoch": self.epoch, "positions": list(self.positions),
+            "server": self.server, "backup": self.backup,
+            "renewals": self.renewals,
+        }
+
+
+class LeaseBook:
+    """Grant/renew/complete/expire bookkeeping for one dispatcher.
+
+    Thread-safe; the dispatcher's request loop and its expiry sweep both
+    touch it. ``clock`` is injectable so tests can expire leases without
+    sleeping.
+    """
+
+    def __init__(self, ttl_s: float = 10.0, clock=time.monotonic):
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active: Dict[str, Lease] = {}
+        self.granted_total = 0
+        self.renewed_total = 0
+        self.completed_total = 0
+        self.expired_total = 0
+
+    def grant(self, client_id: str, tenant: str, job_id: str, epoch: int,
+              positions: Sequence[int], server: Optional[str] = None,
+              backup: Optional[str] = None) -> Lease:
+        now = self._clock()
+        lease = Lease(uuid.uuid4().hex[:12], client_id, tenant, job_id,
+                      epoch, sorted(positions), server, backup,
+                      granted_at=now, expires_at=now + self.ttl_s)
+        with self._lock:
+            self._active[lease.lease_id] = lease
+            self.granted_total += 1
+        return lease
+
+    def renew(self, lease_id: str) -> bool:
+        """Push the expiry out one TTL; False once the lease is fenced."""
+        with self._lock:
+            lease = self._active.get(lease_id)
+            if lease is None:
+                return False
+            lease.expires_at = self._clock() + self.ttl_s
+            lease.renewals += 1
+            self.renewed_total += 1
+            return True
+
+    def complete(self, lease_id: str) -> Optional[Lease]:
+        """Pop an active lease for accounting; None if already fenced."""
+        with self._lock:
+            lease = self._active.pop(lease_id, None)
+            if lease is not None:
+                self.completed_total += 1
+            return lease
+
+    def expire(self) -> List[Lease]:
+        """Pop every lease past its deadline (the dispatcher folds their
+        positions back to pending). Popping *is* the fence."""
+        now = self._clock()
+        with self._lock:
+            dead = [l for l in self._active.values() if l.expires_at <= now]
+            for lease in dead:
+                del self._active[lease.lease_id]
+            self.expired_total += len(dead)
+        return dead
+
+    def release_client(self, client_id: str) -> List[Lease]:
+        """Pop every lease of one client (explicit detach/abandon)."""
+        with self._lock:
+            dead = [l for l in self._active.values()
+                    if l.client_id == client_id]
+            for lease in dead:
+                del self._active[lease.lease_id]
+            self.expired_total += len(dead)
+        return dead
+
+    def get(self, lease_id: str) -> Optional[Lease]:
+        with self._lock:
+            return self._active.get(lease_id)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def active_by_tenant(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for lease in self._active.values():
+                out[lease.tenant] = out.get(lease.tenant, 0) + len(lease.positions)
+            return out
+
+    def describe(self) -> List[dict]:
+        with self._lock:
+            return [l.describe() for l in self._active.values()]
+
+
+class FleetCoverageLedger:
+    """Per-epoch exactly-once accounting merged from client lease acks.
+
+    ``account()`` folds one acknowledged lease's per-client ledger slice
+    (delivered/skipped position lists) into the fleet view; a position
+    accounted twice — or both delivered and skipped — increments
+    ``violations`` (the ``service.coverage_violations_total`` SLO). The
+    manifest mirrors the quality plane's coverage vocabulary so
+    ``service_report()`` reads like a fleet-wide ``quality_report()``.
+    """
+
+    def __init__(self, planned_per_epoch: int):
+        self.planned_per_epoch = int(planned_per_epoch)
+        self._lock = threading.Lock()
+        self._epochs: Dict[int, dict] = {}
+        self.violations = 0
+        self.duplicates = 0
+        self.late_acks = 0
+
+    def _epoch(self, epoch: int) -> dict:
+        state = self._epochs.get(epoch)
+        if state is None:
+            state = {"delivered": set(), "skipped": set(), "clients": set()}
+            self._epochs[epoch] = state
+        return state
+
+    def account(self, epoch: int, client_id: str,
+                delivered: Sequence[int], skipped: Sequence[int],
+                duplicates_dropped: int = 0) -> int:
+        """Merge one lease acknowledgement; returns violations added."""
+        added = 0
+        with self._lock:
+            state = self._epoch(epoch)
+            state["clients"].add(client_id)
+            self.duplicates += int(duplicates_dropped)
+            for pos in delivered:
+                if pos in state["delivered"] or pos in state["skipped"]:
+                    self.violations += 1
+                    added += 1
+                else:
+                    state["delivered"].add(pos)
+            for pos in skipped:
+                if pos in state["delivered"] or pos in state["skipped"]:
+                    self.violations += 1
+                    added += 1
+                else:
+                    state["skipped"].add(pos)
+        return added
+
+    def resync(self, epoch: int, client_id: str,
+               positions: Sequence[int]) -> List[int]:
+        """Replay of already-consumed positions (a client resyncing a
+        restarted dispatcher from its ``state_dict`` cursor): marks the
+        not-yet-accounted ones delivered WITHOUT counting violations —
+        the client consumed them under a previous incarnation's lease.
+        Returns the freshly-marked positions."""
+        with self._lock:
+            state = self._epoch(epoch)
+            state["clients"].add(client_id)
+            fresh = [p for p in positions
+                     if p not in state["delivered"]
+                     and p not in state["skipped"]]
+            state["delivered"].update(fresh)
+            return fresh
+
+    def note_late_ack(self) -> None:
+        with self._lock:
+            self.late_acks += 1
+
+    def accounted(self, epoch: int) -> int:
+        with self._lock:
+            state = self._epochs.get(epoch)
+            if state is None:
+                return 0
+            return len(state["delivered"]) + len(state["skipped"])
+
+    def epoch_manifest(self, epoch: int) -> dict:
+        with self._lock:
+            state = self._epochs.get(epoch,
+                                     {"delivered": set(), "skipped": set(),
+                                      "clients": set()})
+            delivered = len(state["delivered"])
+            skipped = len(state["skipped"])
+            return {
+                "epoch": epoch,
+                "planned": self.planned_per_epoch,
+                "delivered": delivered,
+                "skipped": skipped,
+                "accounted": delivered + skipped,
+                "clients": sorted(state["clients"]),
+                "reconciled": delivered + skipped == self.planned_per_epoch,
+            }
+
+    def report(self) -> dict:
+        with self._lock:
+            epochs = sorted(self._epochs)
+        manifests = [self.epoch_manifest(e) for e in epochs]
+        return {
+            "epochs": manifests,
+            "violations": self.violations,
+            "duplicates_dropped": self.duplicates,
+            "late_acks": self.late_acks,
+            "reconciled": all(m["reconciled"] for m in manifests) if manifests else True,
+        }

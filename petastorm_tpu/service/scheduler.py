@@ -1,0 +1,176 @@
+"""Per-tenant quotas and weighted fair-share admission for the dispatcher.
+
+The scheduler answers one question per ``lease_request``: may this tenant
+draw ``units`` more plan positions right now? Usage is *driven by the
+accounting ledger* (PR 16): acknowledged draw is the tenant's ``rows``
+rollup from the dispatcher's :class:`AccountingLedger`, and in-flight
+leases are added on top at the ledger's observed rows-per-unit rate, so
+the share a tenant is judged on is the same number its bill shows.
+
+Admission is a ceiling, not a reservation: a tenant is denied only while
+its share of total draw exceeds ``weight_fraction + slack`` *and* some
+other tenant is actively competing — an idle fleet never starves its
+only customer, and a tenant at or below its weight entitlement is never
+denied (shares and entitlements both sum to 1, so someone always
+qualifies — the projected-increment throttle alone would deadlock the
+whole fleet when lease increments are large against a near-empty
+ledger). Denials return a retry hint; under sustained demand from all
+tenants the draw shares converge to the configured weight fractions
+within the slack band (bench ``data_service_epoch`` measures exactly
+this). Per-epoch unit quotas are absolute and checked first.
+"""
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from petastorm_tpu.telemetry.accounting import AccountingLedger
+
+#: Tenants that issued a lease_request within this window count as
+#: "actively competing" for fair-share purposes.
+DEFAULT_ACTIVITY_WINDOW_S = 5.0
+
+
+class FairShareScheduler:
+    """Weighted fair-share + quota admission over accounting-ledger usage."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 quotas: Optional[Dict[str, int]] = None,
+                 default_weight: float = 1.0, slack: float = 0.10,
+                 ledger: Optional[AccountingLedger] = None,
+                 activity_window_s: float = DEFAULT_ACTIVITY_WINDOW_S,
+                 clock=time.monotonic):
+        self.weights = dict(weights or {})
+        self.quotas = dict(quotas or {})
+        self.default_weight = float(default_weight)
+        self.slack = float(slack)
+        self.ledger = ledger if ledger is not None else AccountingLedger()
+        self.activity_window_s = float(activity_window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+        self._inflight_units: Dict[str, int] = {}
+        self._accounted_units: Dict[str, int] = {}
+        self._epoch_granted: Dict[Tuple[str, int], int] = {}
+        self.denials_quota = 0
+        self.denials_share = 0
+        self.admits = 0
+
+    # -- usage ---------------------------------------------------------
+
+    def _ledger_rows(self) -> Dict[str, float]:
+        return {tenant: float(roll.get("rows", 0.0) or 0.0)
+                for tenant, roll in self.ledger.report()["tenants"].items()}
+
+    def _rows_per_unit(self, rows: Dict[str, float]) -> float:
+        units = sum(self._accounted_units.values())
+        total_rows = sum(rows.values())
+        if units <= 0 or total_rows <= 0:
+            return 1.0
+        return total_rows / units
+
+    def _draw(self) -> Dict[str, float]:
+        """Per-tenant draw: billed rows + in-flight units at the observed
+        rows-per-unit rate. Caller holds the lock."""
+        rows = self._ledger_rows()
+        rpu = self._rows_per_unit(rows)
+        draw = dict(rows)
+        for tenant, units in self._inflight_units.items():
+            if units:
+                draw[tenant] = draw.get(tenant, 0.0) + units * rpu
+        return draw
+
+    def _weight_fraction(self, tenant: str, active) -> float:
+        total = sum(self.weights.get(t, self.default_weight) for t in active)
+        if total <= 0:
+            return 1.0
+        return self.weights.get(tenant, self.default_weight) / total
+
+    # -- admission -----------------------------------------------------
+
+    def admit(self, tenant: str, units: int, epoch: int
+              ) -> Tuple[bool, str, float]:
+        """``(admitted, reason, retry_after_s)``. Reasons: ``ok``,
+        ``quota`` (hard per-epoch cap), ``share`` (over fair-share
+        ceiling while others compete)."""
+        now = self._clock()
+        with self._lock:
+            self._last_seen[tenant] = now
+            quota = self.quotas.get(tenant)
+            if quota is not None:
+                drawn = self._epoch_granted.get((tenant, epoch), 0)
+                if drawn + units > quota:
+                    self.denials_quota += 1
+                    return False, "quota", 0.25
+            active = {t for t, ts in self._last_seen.items()
+                      if now - ts <= self.activity_window_s}
+            active.add(tenant)
+            if len(active) > 1:
+                draw = self._draw()
+                rpu = self._rows_per_unit(self._ledger_rows())
+                total_cur = sum(draw.values())
+                mine_cur = draw.get(tenant, 0.0)
+                frac = self._weight_fraction(tenant, active)
+                # Progress guarantee: a tenant at or below its weight
+                # entitlement is never denied. Shares sum to 1 and so do
+                # entitlements, so some active tenant always qualifies —
+                # admission cannot deadlock even when the projected
+                # increment below overshoots every ceiling (large units
+                # against a near-empty ledger would otherwise wedge the
+                # whole fleet at startup).
+                if total_cur > 0 and mine_cur / total_cur > frac:
+                    total = total_cur + units * rpu
+                    mine = mine_cur + units * rpu
+                    ceiling = frac + self.slack
+                    if mine / total > ceiling:
+                        self.denials_share += 1
+                        return False, "share", 0.05
+            self.admits += 1
+            return True, "ok", 0.0
+
+    def on_granted(self, tenant: str, units: int, epoch: int) -> None:
+        with self._lock:
+            self._inflight_units[tenant] = (
+                self._inflight_units.get(tenant, 0) + units)
+            key = (tenant, epoch)
+            self._epoch_granted[key] = self._epoch_granted.get(key, 0) + units
+
+    def on_accounted(self, tenant: str, units: int) -> None:
+        """A lease acked: its units leave in-flight (the ledger now holds
+        the billed rows for them)."""
+        with self._lock:
+            self._inflight_units[tenant] = max(
+                0, self._inflight_units.get(tenant, 0) - units)
+            self._accounted_units[tenant] = (
+                self._accounted_units.get(tenant, 0) + units)
+
+    def on_reclaimed(self, tenant: str, units: int, epoch: int) -> None:
+        """A lease expired unacked: its units return to the pool and its
+        per-epoch quota draw is refunded."""
+        with self._lock:
+            self._inflight_units[tenant] = max(
+                0, self._inflight_units.get(tenant, 0) - units)
+            key = (tenant, epoch)
+            self._epoch_granted[key] = max(
+                0, self._epoch_granted.get(key, 0) - units)
+
+    def report(self) -> dict:
+        with self._lock:
+            draw = self._draw()
+            total = sum(draw.values())
+            tenants = {}
+            for tenant in sorted(set(draw) | set(self.weights)
+                                 | set(self._last_seen)):
+                tenants[tenant] = {
+                    "weight": self.weights.get(tenant, self.default_weight),
+                    "quota": self.quotas.get(tenant),
+                    "draw": round(draw.get(tenant, 0.0), 3),
+                    "share": round(draw.get(tenant, 0.0) / total, 4)
+                    if total > 0 else 0.0,
+                    "inflight_units": self._inflight_units.get(tenant, 0),
+                    "accounted_units": self._accounted_units.get(tenant, 0),
+                }
+            return {"tenants": tenants, "admits": self.admits,
+                    "denials_share": self.denials_share,
+                    "denials_quota": self.denials_quota,
+                    "slack": self.slack}

@@ -1,0 +1,369 @@
+"""Stateless decode servers: the service-plane data plane.
+
+A :class:`DecodeServer` owns no plan state at all — every work order
+arrives self-contained (dataset URL, whitelisted reader kwargs, the
+serialized ``PipelinePlan``, and the ``rowgroup_subset`` ordinals with
+their plan positions), so any server can execute any order and a dead
+server costs a re-dispatch, never lost state. Results stream back as
+framed messages: a JSON ``unit`` header per plan position plus an Arrow
+IPC payload (the PR 6 ``ArrowTableSerializer`` bytes), then an
+``order_done`` summary.
+
+Decoded row groups are cached by ``(dataset fingerprint, ordinal)`` as
+their *serialized* Arrow buffers — the exact bytes the wire wants — so
+N clients drawing the same dataset (or the same client across epochs)
+pay one decode per row group fleet-wide per server. The fast path
+decodes a whole order through one ``rowgroup_subset`` reader in
+deterministic order; any decode failure falls back to per-ordinal
+readers so a poisoned row group becomes a ``skip`` unit (the quarantine
+interplay, docs/service.md) instead of poisoning its neighbors.
+"""
+
+import logging
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from petastorm_tpu.reader_impl.arrow_table_serializer import \
+    ArrowTableSerializer
+from petastorm_tpu.service.wire import (WireError, WireTimeout, recv_msg,
+                                        rpc, send_msg, service_socket)
+
+try:
+    import zmq
+except ImportError:  # pragma: no cover - pyzmq is an install-time dep
+    zmq = None
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CACHE_BYTES = 256 << 20
+
+
+class _BufferCache:
+    """Byte-bounded LRU of serialized row-group tables."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = int(capacity_bytes)
+        self._items: "OrderedDict[Tuple[str, int], object]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            buf = self._items.get(key)
+            if buf is None:
+                self.misses += 1
+                return None
+            self._items.move_to_end(key)
+            self.hits += 1
+            return buf
+
+    def put(self, key, buf) -> None:
+        size = len(buf)
+        with self._lock:
+            if key in self._items:
+                return
+            while self._items and self.bytes + size > self.capacity:
+                _, old = self._items.popitem(last=False)
+                self.bytes -= len(old)
+                self.evictions += 1
+            if size <= self.capacity:
+                self._items[key] = buf
+                self.bytes += size
+
+
+class DecodeServer:
+    """One stateless decode server; ``start()`` spawns the order loop.
+
+    ``stall_s`` delays every order — the fault-injection knob the hedging
+    tests and bench use to manufacture a straggler. ``extra_reader_kwargs``
+    merge into every reader this server builds (process-local, never on
+    the wire): tests inject ``fault_plan`` here.
+    """
+
+    def __init__(self, addr: str, dispatcher_addr: Optional[str] = None,
+                 server_id: Optional[str] = None, *,
+                 cache_bytes: int = DEFAULT_CACHE_BYTES,
+                 stall_s: float = 0.0,
+                 extra_reader_kwargs: Optional[dict] = None,
+                 plan_cache_dir: Optional[str] = None,
+                 telemetry_publish: Optional[str] = None,
+                 context=None):
+        if zmq is None:
+            raise RuntimeError("service plane requires pyzmq")
+        self.addr = addr
+        self.dispatcher_addr = dispatcher_addr
+        self.server_id = server_id or f"srv-{uuid.uuid4().hex[:8]}"
+        self.stall_s = float(stall_s)
+        self.extra_reader_kwargs = dict(extra_reader_kwargs or {})
+        self.plan_cache_dir = plan_cache_dir
+        self.cache = _BufferCache(cache_bytes)
+        self._serializer = ArrowTableSerializer()
+        self._seeded_fingerprints = set()
+
+        from petastorm_tpu.telemetry import make_registry
+        self.telemetry = make_registry()
+        t = self.telemetry
+        self._c_orders = t.counter("service.server.orders_total")
+        self._c_units = t.counter("service.server.units_sent_total")
+        self._c_skips = t.counter("service.server.units_skipped_total")
+        self._c_send_timeouts = t.counter("service.server.send_timeouts_total")
+        self._c_wire_errors = t.counter("service.wire_errors_total")
+        t.gauge("service.server.cache_bytes", lambda: self.cache.bytes)
+        t.gauge("service.server.cache_hits", lambda: self.cache.hits)
+
+        self._publisher = None
+        if telemetry_publish:
+            from petastorm_tpu.telemetry.fabric import TelemetryPublisher
+            self._publisher = TelemetryPublisher(
+                self.telemetry, telemetry_publish,
+                member=f"service.server.{self.server_id}", context=context)
+
+        self._ctx = context
+        self._sock = None
+        self._disp = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "DecodeServer":
+        if self._thread is not None:
+            raise RuntimeError("DecodeServer already started")
+        if self._ctx is None:
+            self._ctx = zmq.Context.instance()
+        self._sock = service_socket(self._ctx, zmq.ROUTER, bind=self.addr)
+        if self.dispatcher_addr:
+            self._disp = service_socket(self._ctx, zmq.DEALER,
+                                        connect=self.dispatcher_addr)
+            try:
+                rpc(self._disp, {"type": "server_hello", "addr": self.addr,
+                                 "server_id": self.server_id},
+                    timeout_ms=5000)
+            except WireError:
+                logger.warning("server %s could not register with "
+                               "dispatcher %s", self.server_id,
+                               self.dispatcher_addr)
+        if self._publisher is not None:
+            self._publisher.start()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"petastorm-tpu-svc-{self.server_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+        if self._publisher is not None:
+            self._publisher.stop()
+        for sock_name in ("_sock", "_disp"):
+            sock = getattr(self, sock_name)
+            if sock is not None:
+                setattr(self, sock_name, None)
+                sock.close()
+
+    def __enter__(self) -> "DecodeServer":
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- the loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ident, msg, _ = recv_msg(self._sock, timeout_ms=100,
+                                         routed=True)
+            except WireTimeout:
+                continue
+            except WireError:
+                self._c_wire_errors.add(1)
+                continue
+            if msg.get("type") != "work_order":
+                try:
+                    send_msg(self._sock, {"type": "error",
+                                          "error": f"unknown request "
+                                                   f"{msg.get('type')!r}"},
+                             ident=ident)
+                except WireError:
+                    self._c_wire_errors.add(1)
+                continue
+            try:
+                self._serve_order(ident, msg)
+            except Exception as e:  # noqa: BLE001 - loop must survive
+                logger.exception("work order failed")
+                try:
+                    send_msg(self._sock,
+                             {"type": "order_error",
+                              "order_id": msg.get("order_id"),
+                              "error": repr(e)}, ident=ident)
+                except WireError:
+                    self._c_wire_errors.add(1)
+
+    # ------------------------------------------------------------- decoding
+    #: Keys the server pins itself in ``_read_subset`` — the work order's
+    #: kwargs must not override ordering/identity knobs.
+    _PINNED_KWARGS = ("shuffle_row_groups", "sample_order", "seed",
+                      "num_epochs", "rowgroup_subset")
+
+    def _reader_kwargs(self, order: dict) -> dict:
+        kwargs = dict(order.get("reader_kwargs") or {})
+        for key in self._PINNED_KWARGS:
+            kwargs.pop(key, None)
+        plan = order.get("plan") or {}
+        if plan.get("pool_type"):
+            # The serialized PipelinePlan decides placement — the fleet
+            # plan registry's warm start lands here.
+            kwargs["reader_pool_type"] = plan["pool_type"]
+        kwargs.update(self.extra_reader_kwargs)
+        return kwargs
+
+    def _seed_plan_cache(self, order: dict) -> None:
+        """Fleet plan registry exchange, once per dataset fingerprint:
+        pull the dispatcher's promoted record into this host's local
+        PlanCache (warm start), or push our local record up if the
+        registry is still cold."""
+        fp, store = order.get("fingerprint"), order.get("store_type")
+        if not fp or self._disp is None or fp in self._seeded_fingerprints:
+            return
+        self._seeded_fingerprints.add(fp)
+        import socket as _socket
+        from petastorm_tpu.plan.cache import PlanCache, PlanKey
+        cache = PlanCache(directory=self.plan_cache_dir)
+        key = PlanKey(fingerprint=fp, store_type=store or "file",
+                      host=_socket.gethostname())
+        try:
+            reply, _ = rpc(self._disp, {"type": "plan_get",
+                                        "fingerprint": fp,
+                                        "store_type": key.store_type},
+                           timeout_ms=2000)
+        except WireError:
+            return
+        record = reply.get("record") if reply.get("type") == "plan_record" \
+            else None
+        if record:
+            cache.store(key, dict(record))
+            return
+        local = cache.load(key)
+        if local:
+            try:
+                rpc(self._disp, {"type": "plan_put", "fingerprint": fp,
+                                 "store_type": key.store_type,
+                                 "record": {k: v for k, v in local.items()
+                                            if k != "key"}},
+                    timeout_ms=2000)
+            except WireError:
+                pass
+
+    def _decode_ordinals(self, order: dict, ordinals: List[int]
+                         ) -> Tuple[Dict[int, object], List[int]]:
+        """``ordinal -> serialized table buffer`` for every decodable
+        ordinal, plus the skipped (undecodable) ones."""
+        from petastorm_tpu.reader import make_batch_reader
+        import pyarrow as pa
+        kwargs = self._reader_kwargs(order)
+        url = order["dataset_url"]
+        want = sorted(set(ordinals))
+
+        def _serialize(columns: dict):
+            return self._serializer.serialize(
+                pa.table({name: pa.array(arr)
+                          for name, arr in columns.items()}))
+
+        def _read_subset(subset: List[int]) -> List[object]:
+            bufs = []
+            with make_batch_reader(url, rowgroup_subset=subset,
+                                   shuffle_row_groups=False,
+                                   sample_order="deterministic", seed=0,
+                                   num_epochs=1, **kwargs) as reader:
+                while True:
+                    try:
+                        columns = reader.next_batch()
+                    except StopIteration:
+                        break
+                    bufs.append(_serialize(columns))
+            return bufs
+
+        try:
+            bufs = _read_subset(want)
+            if len(bufs) == len(want):
+                return dict(zip(want, bufs)), []
+            logger.warning("subset decode returned %d/%d batches; "
+                           "re-reading per ordinal", len(bufs), len(want))
+        except Exception:  # noqa: BLE001 - isolate the poisoned ordinal
+            logger.exception("subset decode failed; re-reading per ordinal")
+        decoded: Dict[int, object] = {}
+        skipped: List[int] = []
+        for ordinal in want:
+            try:
+                bufs = _read_subset([ordinal])
+                if len(bufs) != 1:
+                    raise RuntimeError(
+                        f"ordinal {ordinal} produced {len(bufs)} batches")
+                decoded[ordinal] = bufs[0]
+            except Exception:  # noqa: BLE001 - this ordinal is the casualty
+                logger.exception("ordinal %d undecodable; skip-accounting",
+                                 ordinal)
+                skipped.append(ordinal)
+        return decoded, skipped
+
+    def _serve_order(self, ident: bytes, order: dict) -> None:
+        self._c_orders.add(1)
+        if self.stall_s > 0:
+            time.sleep(self.stall_s)
+        self._seed_plan_cache(order)
+        fp = order.get("fingerprint") or order.get("dataset_url")
+        epoch = int(order.get("epoch") or 0)
+        positions = [int(p) for p in order.get("positions") or ()]
+        ordinals = [int(o) for o in order.get("ordinals") or ()]
+        if len(positions) != len(ordinals):
+            raise ValueError("work order positions/ordinals length mismatch")
+
+        missing = [o for o in ordinals
+                   if self.cache.get((fp, o)) is None]
+        decoded, undecodable = ({}, [])
+        if missing:
+            decoded, undecodable = self._decode_ordinals(order, missing)
+            for ordinal, buf in decoded.items():
+                self.cache.put((fp, ordinal), buf)
+
+        delivered = 0
+        skipped_positions: List[int] = []
+        for position, ordinal in zip(positions, ordinals):
+            buf = self.cache.get((fp, ordinal))
+            if buf is None:
+                buf = decoded.get(ordinal)
+            header = {"type": "unit", "order_id": order.get("order_id"),
+                      "position": position, "epoch": epoch}
+            try:
+                if buf is None:
+                    skipped_positions.append(position)
+                    self._c_skips.add(1)
+                    send_msg(self._sock, dict(header, kind="skip"),
+                             ident=ident)
+                else:
+                    delivered += 1
+                    self._c_units.add(1)
+                    send_msg(self._sock, dict(header, kind="data"),
+                             payload=buf, ident=ident)
+            except WireTimeout:
+                # Client gone or wedged: abandon the rest of the order —
+                # the lease will expire and fold back.
+                self._c_send_timeouts.add(1)
+                return
+        try:
+            send_msg(self._sock, {"type": "order_done",
+                                  "order_id": order.get("order_id"),
+                                  "delivered": delivered,
+                                  "skipped": skipped_positions},
+                     ident=ident)
+        except WireTimeout:
+            self._c_send_timeouts.add(1)

@@ -1,0 +1,166 @@
+"""Versioned framed-message wire layer for the service plane.
+
+Every ZeroMQ ``send``/``recv`` in :mod:`petastorm_tpu.service` goes
+through these helpers (enforced by ``tools/check_wire.py``): a message is
+``[identity?][json header][binary payload?]`` where the header always
+carries ``{"v": SERVICE_WIRE_VERSION, "type": ...}``. Sockets built by
+:func:`service_socket` are bounded — finite HWMs, send timeouts and zero
+linger — so a dead peer backs the sender up into a :class:`WireTimeout`
+instead of an unbounded queue, and receives always go through a poller
+with an explicit deadline. No pickle ever crosses the wire: headers are
+JSON, payloads are Arrow IPC (``ArrowTableSerializer``) or raw bytes.
+"""
+
+import itertools
+import json
+import threading
+from typing import Optional, Tuple
+
+try:
+    import zmq
+except ImportError:  # pragma: no cover - pyzmq is an install-time dep
+    zmq = None
+
+SERVICE_WIRE_VERSION = 1
+
+#: Default bound on every service socket: a peer that stops draining
+#: stalls the sender within this window instead of buffering forever.
+DEFAULT_SNDTIMEO_MS = 5000
+DEFAULT_HWM = 1000
+
+
+class WireError(Exception):
+    """Malformed or version-incompatible service frame."""
+
+
+class WireTimeout(WireError):
+    """A bounded send/recv hit its deadline (peer gone or backed up)."""
+
+
+def service_available() -> bool:
+    """Whether the ZeroMQ transport is importable in this build."""
+    return zmq is not None
+
+
+_REQ_COUNTER = itertools.count(1)
+_REQ_LOCK = threading.Lock()
+
+
+def next_req_id() -> int:
+    """Process-unique monotonic request id for control-plane RPCs."""
+    with _REQ_LOCK:
+        return next(_REQ_COUNTER)
+
+
+def service_socket(context, sock_type, *, bind: Optional[str] = None,
+                   connect: Optional[str] = None,
+                   identity: Optional[bytes] = None,
+                   sndhwm: int = DEFAULT_HWM, rcvhwm: int = DEFAULT_HWM,
+                   sndtimeo_ms: int = DEFAULT_SNDTIMEO_MS):
+    """A bounded service socket: finite HWMs, finite ``SNDTIMEO``, zero
+    linger. All service sockets are built here so the bounds are uniform."""
+    if zmq is None:
+        raise RuntimeError("service plane requires pyzmq")
+    sock = context.socket(sock_type)
+    sock.setsockopt(zmq.LINGER, 0)
+    sock.setsockopt(zmq.SNDHWM, int(sndhwm))
+    sock.setsockopt(zmq.RCVHWM, int(rcvhwm))
+    sock.setsockopt(zmq.SNDTIMEO, int(sndtimeo_ms))
+    if identity is not None:
+        sock.setsockopt(zmq.IDENTITY, identity)
+    if bind is not None:
+        sock.bind(bind)
+    if connect is not None:
+        sock.connect(connect)
+    return sock
+
+
+def _encode(header: dict) -> bytes:
+    if "v" not in header:
+        header = dict(header, v=SERVICE_WIRE_VERSION)
+    return json.dumps(header, sort_keys=True).encode("utf-8")
+
+
+def _decode(frame: bytes) -> dict:
+    try:
+        header = json.loads(bytes(frame).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"undecodable service header: {e!r}")
+    if not isinstance(header, dict):
+        raise WireError("service header is not a JSON object")
+    if header.get("v") != SERVICE_WIRE_VERSION:
+        raise WireError(
+            f"service wire version mismatch: got {header.get('v')!r}, "
+            f"this build speaks {SERVICE_WIRE_VERSION}")
+    return header
+
+
+def send_msg(sock, header: dict, payload: Optional[bytes] = None, *,
+             ident: Optional[bytes] = None) -> None:
+    """Send one framed message; ``ident`` prefixes a ROUTER destination.
+
+    Raises :class:`WireTimeout` when the bounded send can't complete —
+    the peer is gone or its pipe is full; callers drop or retry, they
+    never block forever.
+    """
+    frames = []
+    if ident is not None:
+        frames.append(ident)
+    frames.append(_encode(header))
+    if payload is not None:
+        frames.append(payload)
+    try:
+        sock.send_multipart(frames, copy=False)  # wire-ok: the framed send primitive
+    except zmq.Again:
+        raise WireTimeout("bounded send timed out (peer gone or backed up)")
+    except zmq.ZMQError as e:  # pragma: no cover - socket torn down under us
+        raise WireError(f"send failed: {e!r}")
+
+
+def recv_msg(sock, timeout_ms: Optional[int] = None, *,
+             routed: bool = False
+             ) -> Tuple[Optional[bytes], dict, Optional[bytes]]:
+    """Receive one framed message within ``timeout_ms`` (None = block).
+
+    Returns ``(identity, header, payload)``; identity is only non-None
+    for ``routed=True`` (ROUTER) sockets. Raises :class:`WireTimeout`
+    past the deadline and :class:`WireError` on malformed frames.
+    """
+    if timeout_ms is not None:
+        if sock.poll(timeout=timeout_ms, flags=zmq.POLLIN) == 0:  # wire-ok: bounded poll
+            raise WireTimeout(f"no frame within {timeout_ms}ms")
+    try:
+        frames = sock.recv_multipart(copy=False)  # wire-ok: poll-bounded framed recv
+    except zmq.ZMQError as e:  # pragma: no cover - socket torn down under us
+        raise WireError(f"recv failed: {e!r}")
+    ident = None
+    if routed:
+        if not frames:
+            raise WireError("empty routed frame")
+        ident = bytes(frames[0])
+        frames = frames[1:]
+    if not frames or len(frames) > 2:
+        raise WireError(f"expected [header][payload?], got {len(frames)} frames")
+    header = _decode(frames[0])
+    payload = bytes(frames[1]) if len(frames) == 2 else None
+    return ident, header, payload
+
+
+def rpc(sock, header: dict, timeout_ms: int,
+        payload: Optional[bytes] = None) -> Tuple[dict, Optional[bytes]]:
+    """One control-plane round trip on a DEALER socket: send a request
+    stamped with a fresh ``req_id``, return the matching reply. Stale
+    replies (an earlier request that timed out, then answered) are
+    discarded by ``re`` mismatch rather than mis-delivered."""
+    req_id = next_req_id()
+    send_msg(sock, dict(header, req_id=req_id))
+    if payload is not None:
+        raise WireError("rpc() requests are header-only")
+    import time
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    while True:
+        remaining_ms = max(0, int((deadline - time.monotonic()) * 1000))
+        _, reply, reply_payload = recv_msg(sock, timeout_ms=remaining_ms)
+        if reply.get("re") == req_id:
+            return reply, reply_payload
+        # else: stale reply from an abandoned request — drop and keep waiting

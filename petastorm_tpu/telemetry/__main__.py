@@ -197,6 +197,35 @@ def _timeline_series(snap: dict) -> dict:
             for name in sorted(names)}
 
 
+def _render_service_tenants(snap: dict) -> list:
+    """Data-service per-tenant lease/throughput table (docs/service.md):
+    built from the dispatcher's ``service.tenant.{tenant}.*`` counter
+    family plus the accounting ledger's per-tenant row totals. Empty for
+    fleets without a dispatcher member."""
+    counters = snap.get("counters") or {}
+    tenants = {}
+    for name, value in counters.items():
+        if not name.startswith("service.tenant."):
+            continue
+        rest = name[len("service.tenant."):]
+        tenant, _, suffix = rest.partition(".")
+        if not suffix:
+            continue
+        tenants.setdefault(tenant, {})[suffix] = value
+    if not tenants:
+        return []
+    rows_by_tenant = (snap.get("accounting") or {}).get("tenants") or {}
+    lines = ["service tenants (units granted / delivered / rows):"]
+    for tenant in sorted(tenants):
+        t = tenants[tenant]
+        rows = (rows_by_tenant.get(tenant) or {}).get("rows", 0)
+        lines.append(
+            f"  {tenant:<14} {t.get('units_granted_total', 0):>8.6g} / "
+            f"{t.get('units_delivered_total', 0):>8.6g} / "
+            f"{rows:>10.6g}")
+    return lines
+
+
 def _render_fleet(snap: dict) -> list:
     """Fabric-aggregator extras (docs/observability.md "Telemetry
     fabric"): member liveness table + per-tenant accounting. Present in
@@ -205,18 +234,26 @@ def _render_fleet(snap: dict) -> list:
     lines = []
     members = snap.get("fabric_members") or {}
     if members:
+        # Service-fleet members publish dotted role names
+        # (service.dispatcher, service.server.<id>, service.client.<id>)
+        # which both overflow the old 14-char key column and carry a
+        # role worth its own column.
+        key_w = max(14, max(len(k) for k in members))
         lines.append(f"fabric members ({len(members)}):")
         for key, m in members.items():
             state = ("left" if m.get("left")
                      else "SILENT" if m.get("silent") else "live")
+            role = key.split(".", 2)[1] if key.startswith("service.") \
+                else "reader"
             off = m.get("clock_offset_s")
             lines.append(
-                f"  {key:<14} {state:<7} "
+                f"  {key:<{key_w}} {role:<10} {state:<7} "
                 f"tenant={m.get('tenant') or '-':<10} "
                 f"windows={m.get('windows_received', 0):<6} "
                 f"resyncs={m.get('resyncs', 0):<3} "
                 f"clock_offset_s="
                 f"{'n/a' if off is None else format(off, '.3f')}")
+    lines.extend(_render_service_tenants(snap))
     tenants = (snap.get("accounting") or {}).get("tenants") or {}
     if tenants:
         lines.append("per-tenant accounting (rows / bytes_read / "

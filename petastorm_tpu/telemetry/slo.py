@@ -58,7 +58,8 @@ def default_rules(input_stall_pct: float = 5.0,
                   hedges_per_s: float = 2.0,
                   stragglers_per_s: float = 2.0,
                   ingest_lag_s: float = 300.0,
-                  max_drift: float = 0.2) -> List[SloRule]:
+                  max_drift: float = 0.2,
+                  coverage_violations: float = 0.0) -> List[SloRule]:
     """The documented default rule set (thresholds per the tuning table in
     docs/observability.md). ``ingest_lag_s`` is the live-data freshness
     contract (docs/live_data.md): now minus the newest admitted file's
@@ -88,6 +89,13 @@ def default_rules(input_stall_pct: float = 5.0,
         # band; the gauge only exists on quality-enabled readers WITH a
         # reference profile, so other pipelines skip the rule.
         SloRule("max_drift", "gauge", "quality.max_drift", max_drift),
+        # Data-service exactly-once contract (docs/service.md): a plan
+        # position accounted twice across the fleet is a determinism
+        # break, never operational noise — threshold is a hard 0. The
+        # counter only exists on dispatcher registries, so single-reader
+        # pipelines skip the rule.
+        SloRule("coverage_violations", "counter",
+                "service.coverage_violations_total", coverage_violations),
     ]
 
 

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Lint guard: service-plane ZeroMQ traffic goes through the framed helpers.
+
+The disaggregated data service (docs/service.md) speaks a versioned
+framed wire protocol: ``[identity?][json header][payload?]``, every
+header stamped ``{"v": SERVICE_WIRE_VERSION}``, every send bounded by
+SNDTIMEO/HWM and every recv bounded by a poll deadline. Those
+guarantees live in exactly three primitives in
+``petastorm_tpu/service/wire.py`` — ``send_msg`` / ``recv_msg`` /
+``rpc``. A raw ``sock.send_*``/``sock.recv_*`` call anywhere else in
+``petastorm_tpu/service/`` bypasses version negotiation (silent
+cross-version corruption), blocks unboundedly (a dead peer wedges the
+dispatcher loop), or — worst — ships pickles: ``send_pyobj`` /
+``recv_pyobj`` are remote code execution against whoever connects, and
+are banned outright, waiver or no waiver.
+
+This AST check flags every attribute call named like a ZeroMQ
+send/recv inside ``petastorm_tpu/service/``. The wire.py primitives
+themselves carry a ``wire-ok`` waiver comment on the call line (with a
+reason), the same waiver idiom as ``check_metric_docs``'s
+``metric-doc-ok``.
+
+Usage::
+
+    python tools/check_wire.py          # lint (exit 1 on violations)
+    python tools/check_wire.py --list   # print every zmq send/recv call
+
+Wired into ``make ci-lint``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVICE = os.path.join(ROOT, "petastorm_tpu", "service")
+
+WAIVER = "wire-ok"
+
+#: Raw socket verbs that must flow through wire.py's framed helpers.
+_RAW_VERBS = {
+    "send", "recv",
+    "send_multipart", "recv_multipart",
+    "send_json", "recv_json",
+    "send_string", "recv_string",
+    "send_pyobj", "recv_pyobj",
+    "send_serialized", "recv_serialized",
+}
+
+#: Unwaivable: pickle over the wire is remote code execution.
+_BANNED_VERBS = {"send_pyobj", "recv_pyobj"}
+
+#: ``sock.poll(...)`` is how recv_msg bounds its waits; unwaived raw
+#: polls elsewhere usually signal a hand-rolled recv loop.
+_POLL_VERBS = {"poll"}
+
+
+def _calls(path):
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        verb = func.attr
+        if verb not in _RAW_VERBS and verb not in _POLL_VERBS:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        waived = WAIVER in line
+        yield verb, node.lineno, waived
+
+
+def _iter_py_files():
+    if not os.path.isdir(SERVICE):
+        return
+    for dirpath, _dirnames, filenames in os.walk(SERVICE):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    list_only = "--list" in argv
+    failures = []
+    banned = []
+    seen = []
+    for path in _iter_py_files():
+        rel = os.path.relpath(path, ROOT)
+        for verb, lineno, waived in _calls(path):
+            seen.append((rel, lineno, verb, waived))
+            if list_only:
+                continue
+            if verb in _BANNED_VERBS:
+                # No waiver: pickle framing is an RCE, period.
+                banned.append((rel, lineno, verb))
+            elif not waived:
+                failures.append((rel, lineno, verb))
+    if list_only:
+        for rel, lineno, verb, waived in seen:
+            tag = " (waived)" if waived else ""
+            print(f"{rel}:{lineno}: .{verb}(){tag}")
+        return 0
+    rc = 0
+    if banned:
+        print("check_wire: pickle-framed ZeroMQ calls are banned in the "
+              "service plane (remote code execution):", file=sys.stderr)
+        for rel, lineno, verb in banned:
+            print(f"  {rel}:{lineno}: .{verb}()", file=sys.stderr)
+        rc = 1
+    if failures:
+        print("check_wire: raw ZeroMQ send/recv outside the framed wire "
+              "helpers (petastorm_tpu/service/wire.py):", file=sys.stderr)
+        for rel, lineno, verb in failures:
+            print(f"  {rel}:{lineno}: .{verb}()", file=sys.stderr)
+        print(f"{len(failures)} raw call(s). Route traffic through "
+              f"send_msg/recv_msg/rpc, or — for the primitives "
+              f"themselves — waive the call line with a "
+              f"'# {WAIVER}: why' comment.", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        waived_n = sum(1 for *_x, w in seen if w)
+        print(f"check_wire: {len(seen)} zmq send/recv call(s), "
+              f"{waived_n} waived primitive(s), all framed")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
