@@ -49,6 +49,7 @@ ci-lint:
 	python tools/check_hostlocal.py
 	python tools/check_spans.py
 	python tools/check_rowloops.py
+	python tools/check_pointreads.py
 	python tools/check_determinism.py
 	python tools/check_listing.py
 	python tools/check_metric_docs.py

@@ -1972,6 +1972,83 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"data-service phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4m. RL-replay mixed access (docs/random_access.md): one dataset
+    # served BOTH ways at once — a sequential epoch streams batches while a
+    # replay sampler fires keyed lookup() calls against the same reader
+    # (shared decoded cache). Reports the roadmap item-3 targets: warm
+    # single-key lookup p99 (<10ms) and batched-gather rows/s (>=100k),
+    # plus the coalescing/cache counters that explain them.
+    replay_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import pyarrow as pa\n"
+        "import pyarrow.parquet as pq\n"
+        "store = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'replay')\n"
+        "url = 'file://' + store\n"
+        "N = 100_000\n"
+        "if not os.path.exists(os.path.join(store, 'data.parquet')):\n"
+        "    os.makedirs(store, exist_ok=True)\n"
+        "    ids = np.arange(N, dtype=np.int64)\n"
+        "    pq.write_table(pa.table({'id': ids,\n"
+        "                             'val': (ids * 0.5).astype(np.float32)}),\n"
+        "                   os.path.join(store, 'data.parquet'),\n"
+        "                   row_group_size=4096)\n"
+        "from petastorm_tpu.index import (build_field_index, gather_rows,\n"
+        "                                 INDEX_SIDECAR_NAME)\n"
+        "if not os.path.exists(os.path.join(store, INDEX_SIDECAR_NAME)):\n"
+        "    build_field_index(url, ['id'])\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "rng = np.random.default_rng(0)\n"
+        "with make_batch_reader(url, num_epochs=1, shuffle_row_groups=False,\n"
+        "                       reader_pool_type='thread', workers_count=3,\n"
+        "                       memory_cache_size_bytes=1 << 30) as r:\n"
+        "    seq_rows, replay_rows = 0, 0\n"
+        "    t0 = time.perf_counter()\n"
+        "    for i, batch in enumerate(r):\n"
+        "        seq_rows += len(batch.id)\n"
+        "        if i % 8 == 0:  # replay sampler interleaved with the epoch\n"
+        "            keys = [int(k) for k in rng.integers(0, N, size=64)]\n"
+        "            replay_rows += len(r.lookup(keys))\n"
+        "    mixed_s = time.perf_counter() - t0\n"
+        "    lat = []\n"
+        "    for k in rng.integers(0, N, size=300):\n"
+        "        t1 = time.perf_counter()\n"
+        "        r.lookup([int(k)])\n"
+        "        lat.append(time.perf_counter() - t1)\n"
+        "    p99_s = float(np.percentile(lat, 99))\n"
+        "    g_rows, t2 = 0, time.perf_counter()\n"
+        "    for _ in range(4):  # replay draw: keyed lookup -> device batch\n"
+        "        keys = [int(k) for k in rng.integers(0, N, size=4096)]\n"
+        "        b = gather_rows(r.lookup(keys))\n"
+        "        jax.block_until_ready(b['val'])\n"
+        "        g_rows += int(b['val'].shape[0])\n"
+        "    replay_s = time.perf_counter() - t2\n"
+        "    rows = r.lookup([int(k) for k in rng.integers(0, N, size=4096)])\n"
+        "    t3 = time.perf_counter()\n"
+        "    for _ in range(8):  # gather-only: host stack + one commit\n"
+        "        jax.block_until_ready(gather_rows(rows)['val'])\n"
+        "    gather_s = time.perf_counter() - t3\n"
+        "    c = r.telemetry.metrics_view()['counters']\n"
+        "print('BENCHJSON:' + json.dumps({'rl_replay_epoch': {\n"
+        "    'rows': N,\n"
+        "    'mixed_epoch_samples_per_sec': round(seq_rows / mixed_s, 1),\n"
+        "    'replay_rows_interleaved': replay_rows,\n"
+        "    'lookup_warm_p99_ms': round(p99_s * 1e3, 3),\n"
+        "    'lookup_p99_under_10ms': bool(p99_s < 0.010),\n"
+        "    'replay_gather_rows_per_sec': round(g_rows / replay_s, 1),\n"
+        "    'gather_rows_per_sec': round(8 * len(rows) / gather_s, 1),\n"
+        "    'gather_rows_ok': bool(8 * len(rows) / gather_s >= 100_000),\n"
+        "    'rowgroups_touched': c.get('index.rowgroups_touched_total', 0),\n"
+        "    'keys_requested': c.get('index.keys_requested_total', 0),\n"
+        "    'index_cache_hits': c.get('index.cache_hits_total', 0),\n"
+        "    'index_cache_misses': c.get('index.cache_misses_total', 0)}}))\n")
+    try:
+        out.update(_cpu_subprocess(replay_child, data_dir, timeout_s=600.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"rl-replay phase failed: {e!r}", file=sys.stderr)
+
     # ---- assemble the line ---------------------------------------------
     out.update({
         "metric": "hello_world reader throughput",

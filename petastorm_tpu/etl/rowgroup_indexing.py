@@ -1,11 +1,16 @@
-"""Build and load row-group inverted indexes (Spark-free).
+"""Build and load row-group inverted indexes (Spark-free) — **deprecated**
+in favor of the random-access plane (:mod:`petastorm_tpu.index`,
+docs/random_access.md).
 
-``build_rowgroup_index`` scans every row group through a thread pool, feeds
-the requested indexers (only their columns are read), and stores the pickled
-index map in ``_common_metadata``. Reading goes through the restricted
-unpickler (allowlisting only this package's indexer classes and primitives),
-and the reference's legacy ``dataset-toolkit.rowgroups_index.v1`` key is
-honored for old stores.
+This legacy surface is group-granular (value -> set of row-group
+ordinals, no row offsets) and pickled into ``_common_metadata``; the new
+plane maps values to exact ``(file, row_group, row_offset)`` rows in a
+versioned JSON sidecar that ``Reader.lookup()`` serves through the
+decoded cache. ``build_rowgroup_index`` keeps working for existing
+callers (``rowgroup_selector=`` still consumes it) and now **also
+bridges**: every keyed ``SingleFieldIndexer`` it populates is converted
+to the new sidecar format on the way out, so a store indexed through the
+legacy API is immediately lookup()-able.
 
 Parity: reference petastorm/etl/rowgroup_indexing.py —
 ``build_rowgroup_index`` (:37-80, a Spark job there), key constant (:32),
@@ -13,7 +18,9 @@ Parity: reference petastorm/etl/rowgroup_indexing.py —
 """
 from __future__ import annotations
 
+import logging
 import pickle
+import warnings
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Sequence
 
@@ -23,13 +30,31 @@ from petastorm_tpu.errors import MetadataError
 from petastorm_tpu.etl.dataset_metadata import (DatasetContext, load_row_groups)
 from petastorm_tpu.etl.rowgroup_indexers import RowGroupIndexerBase
 
+logger = logging.getLogger(__name__)
+
 TPU_ROWGROUPS_INDEX_KEY = b"petastorm-tpu.rowgroups_index.v1"
 LEGACY_ROWGROUPS_INDEX_KEY = b"dataset-toolkit.rowgroups_index.v1"
 
+_DEPRECATION = (
+    "petastorm_tpu.etl.rowgroup_indexing is deprecated: the random-access "
+    "plane (petastorm_tpu.index.build_field_index + Reader.lookup(), "
+    "docs/random_access.md) indexes exact rows, extends on live growth, "
+    "and serves point reads through the decoded cache. The legacy "
+    "group-granular index keeps working for rowgroup_selector=.")
+
 
 def build_rowgroup_index(dataset_url_or_ctx, indexers: Sequence[RowGroupIndexerBase],
-                         num_workers: int = 10) -> Dict[str, RowGroupIndexerBase]:
-    """Populate ``indexers`` over every row group and persist the index."""
+                         num_workers: int = 10,
+                         emit_field_index: bool = True) -> Dict[str, RowGroupIndexerBase]:
+    """Populate ``indexers`` over every row group and persist the index.
+
+    .. deprecated:: use :func:`petastorm_tpu.index.build_field_index`.
+       While this remains supported, ``emit_field_index=True`` (default)
+       bridges every keyed single-field indexer into the new sidecar
+       format so ``Reader.lookup()`` works on the same store — with
+       group-granular entries (the legacy protocol records no row
+       offsets; lookups decode the group and filter)."""
+    warnings.warn(_DEPRECATION, DeprecationWarning, stacklevel=2)
     ctx = (dataset_url_or_ctx if isinstance(dataset_url_or_ctx, DatasetContext)
            else DatasetContext(dataset_url_or_ctx))
     row_groups = load_row_groups(ctx)
@@ -48,6 +73,19 @@ def build_rowgroup_index(dataset_url_or_ctx, indexers: Sequence[RowGroupIndexerB
 
     index_dict = {ix.index_name: ix for ix in indexers}
     _store_index(ctx, index_dict)
+    if emit_field_index:
+        # Bridge (docs/random_access.md "Legacy bridge"): best-effort —
+        # a bridge failure must not break the legacy path it rides on.
+        try:
+            from petastorm_tpu.index import index_from_legacy_indexers
+            bridged = index_from_legacy_indexers(ctx, indexers,
+                                                 num_workers=num_workers)
+            if bridged.fields_indexed:
+                bridged.save(ctx)
+        except Exception:  # noqa: BLE001
+            logger.exception("could not bridge legacy indexers to the "
+                             "field-index sidecar; Reader.lookup() will "
+                             "need build_field_index()")
     return index_dict
 
 

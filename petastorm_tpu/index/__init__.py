@@ -1,0 +1,26 @@
+"""Random-access plane: persisted field->row-group index, keyed lookups,
+``DatasetView`` ordinal access, and device-side batched gather.
+
+See docs/random_access.md. Entry points:
+
+* :func:`build_field_index` / :func:`extend_field_index` — build and grow
+  the persisted ``_petastorm_tpu_index.json`` sidecar;
+* ``Reader.lookup(keys)`` / ``Reader.dataset_view()`` — point reads that
+  share the reader's decoded cache, quarantine, and telemetry;
+* :class:`IndexLookupPlane` — the standalone serving surface;
+* :func:`gather_rows` — batched gather into one ``jax.Array`` per field.
+"""
+from petastorm_tpu.index.builder import (build_field_index,
+                                         extend_field_index,
+                                         index_from_legacy_indexers)
+from petastorm_tpu.index.gather import gather_rows
+from petastorm_tpu.index.lookup import IndexLookupPlane
+from petastorm_tpu.index.sidecar import (FieldIndex, GROUP_GRANULAR,
+                                         INDEX_FORMAT, INDEX_SIDECAR_NAME,
+                                         encode_key)
+from petastorm_tpu.index.view import DatasetView
+
+__all__ = ["FieldIndex", "IndexLookupPlane", "DatasetView",
+           "build_field_index", "extend_field_index", "gather_rows",
+           "index_from_legacy_indexers", "encode_key", "GROUP_GRANULAR",
+           "INDEX_FORMAT", "INDEX_SIDECAR_NAME"]

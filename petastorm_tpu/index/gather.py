@@ -1,0 +1,93 @@
+"""Batched gather: looked-up rows -> one device ``jax.Array`` per field
+(docs/random_access.md "Batched gather").
+
+Stacks each field's cells into a single host array, then commits the
+whole column dict to the default device in ONE compiled-identity call —
+the same AOT-compiled staging path the JAX loader uses for epoch batches
+(``jax/loader.py _commit_batch``): ``jax.device_put``'s per-leaf Python
+walk costs ~38us/leaf, so a wide gather through the compiled identity is
+one dispatch instead of one per field. The executable cache is keyed by
+the batch's ``(name, shape, dtype)`` signature; replay batches of a fixed
+size hit one entry forever.
+
+Lifetime rules: the returned arrays are **committed copies** — they do
+not alias the decoded cache, any Arrow buffer, or the lookup rows, so
+holding a gathered batch pins nothing upstream (the cache may evict, the
+reader may stop). See docs/random_access.md "Lifetime rules".
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["gather_rows"]
+
+#: Compiled-identity executables keyed by (name, shape, dtype) signature,
+#: module-level so every plane/view shares warm entries (cap mirrors the
+#: loader's: unstable shapes must not pin executables forever).
+_COMMIT_CACHE: Dict[tuple, object] = {}
+_COMMIT_CACHE_CAP = 8
+
+
+def gather_rows(rows: Sequence[dict], fields: Optional[Sequence[str]] = None,
+                to_device: bool = True, telemetry=None) -> dict:
+    """Stack ``rows`` (lookup/DatasetView output) into one array per field.
+
+    ``fields=None`` auto-selects the batchable fields: numeric scalars and
+    fixed-shape arrays whose cells stack uniformly (strings, Decimals and
+    ragged cells are skipped with a debug log — pass ``fields=`` to make a
+    non-batchable field a hard error). ``to_device=False`` returns the
+    host-side numpy columns (e.g. for a CPU replay buffer)."""
+    rows = [r for r in rows if r is not None]
+    if not rows:
+        return {}
+    explicit = fields is not None
+    names = list(fields) if explicit else list(rows[0].keys())
+    cols: Dict[str, np.ndarray] = {}
+    for name in names:
+        try:
+            arr = np.stack([np.asarray(r[name]) for r in rows])
+        except (ValueError, TypeError, KeyError) as e:
+            if explicit:
+                raise TypeError(
+                    f"field {name!r} does not stack into a uniform array "
+                    f"({e}); gather needs fixed-shape numeric fields"
+                ) from e
+            continue
+        if arr.dtype == object or arr.dtype.kind in "USmM":
+            if explicit:
+                raise TypeError(
+                    f"field {name!r} stacks to dtype {arr.dtype} — not "
+                    f"device-committable; drop it from fields=")
+            logger.debug("gather: skipping non-batchable field %r (%s)",
+                         name, arr.dtype)
+            continue
+        cols[name] = arr
+    if telemetry is not None:
+        telemetry.counter("index.gather_rows_total").add(len(rows))
+    if not to_device:
+        return cols
+    return _commit(cols)
+
+
+def _commit(cols: Dict[str, np.ndarray]) -> dict:
+    """One compiled-identity dispatch for the whole column dict; falls
+    back to the per-leaf ``device_put`` walk on any odd leaf — gather
+    never fails because staging had a cache miss."""
+    import jax
+    sig = tuple((k, v.shape, v.dtype.str) for k, v in cols.items())
+    compiled = _COMMIT_CACHE.get(sig)
+    try:
+        if compiled is None:
+            ident = jax.jit(lambda c: c)
+            compiled = ident.lower(cols).compile()
+            if len(_COMMIT_CACHE) >= _COMMIT_CACHE_CAP:
+                _COMMIT_CACHE.clear()
+            _COMMIT_CACHE[sig] = compiled
+        return dict(compiled(cols))
+    except Exception:  # noqa: BLE001 - pre-committed array, unhashable aval
+        return dict(jax.device_put(cols))

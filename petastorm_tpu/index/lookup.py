@@ -1,0 +1,375 @@
+"""Point-read execution path: keyed lookups over the field index
+(docs/random_access.md).
+
+The plane resolves keys through the persisted :class:`FieldIndex`, groups
+co-resident keys by ``(file, row_group)``, and serves each touched group
+with **one** ``read_row_group(columns=...)`` call through the exact decode
+machinery the sequential epoch path runs — the same
+:class:`~petastorm_tpu.reader_impl.row_reader_worker.RowReaderWorker`
+zero-copy read + batched-codec decode, the same decoded in-memory cache
+keys (``{md5(url)}:{path}:{group}:{cols}:decoded``, docs/autotune.md).
+Two consequences, both load-bearing:
+
+* lookups return **byte-identical cells** to a sequential epoch read of
+  the same rows (one decode implementation, not two); and
+* a lookup warms the cache for the epoch stream and vice versa — a warm
+  single-key lookup is a dict-assembly over cache-resident columns, no
+  IO and no codec work.
+
+Failures follow the quarantine contract (docs/resilience.md): each group
+fetch runs under the worker's :class:`RowGroupGuard` — transient errors
+retry per the read policy; in ``degraded_mode`` a give-up records a
+:class:`QuarantineRecord` on the reader's aggregator and the affected
+keys are *skipped* (returned rows simply omit them) instead of hanging or
+killing the caller.
+
+Telemetry (all on the owning pipeline's registry, docs/observability.md):
+``index.lookup_s`` latency histogram, lookup/key/row counters, decoded
+cache hit/miss split, and row groups touched per call.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import DatasetContext, RowGroupRef
+from petastorm_tpu.index.sidecar import (GROUP_GRANULAR, FieldIndex,
+                                         encode_key)
+from petastorm_tpu.resilience.quarantine import RowGroupSkipped
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IndexLookupPlane"]
+
+
+class IndexLookupPlane:
+    """Keyed point reads over one dataset; one per Reader (built lazily by
+    :meth:`Reader.lookup <petastorm_tpu.reader.Reader.lookup>`), or
+    standalone via :meth:`for_dataset` for serving tiers without an epoch
+    stream."""
+
+    def __init__(self, ctx: DatasetContext, index: FieldIndex, schema, *,
+                 dataset_url_or_urls=None, storage_options=None,
+                 filesystem=None, cache=None, retry_policy=None,
+                 degraded_mode: bool = False, fault_plan=None,
+                 hedge_policy=None, telemetry=None, quarantine=None,
+                 default_columns: Optional[Sequence[str]] = None):
+        self._ctx = ctx
+        self._index = index
+        self._schema = schema
+        self._url = (dataset_url_or_urls if dataset_url_or_urls is not None
+                     else ctx.path_or_paths)
+        self._storage_options = storage_options
+        self._filesystem = filesystem if filesystem is not None \
+            else ctx.filesystem
+        self._cache = cache
+        self._retry_policy = retry_policy
+        self._degraded_mode = degraded_mode
+        self._fault_plan = fault_plan
+        self._hedge_policy = hedge_policy
+        self.quarantine = quarantine
+        self._default_columns = (
+            list(default_columns) if default_columns is not None
+            else sorted(schema.fields.keys()))
+        #: Per-needed-column-set decode workers (the column set fixes a
+        #: worker's decode plan and cache-key suffix at construction).
+        self._workers: Dict[frozenset, object] = {}
+        self._telemetry = telemetry
+        if telemetry is not None:
+            self._h_lookup = telemetry.histogram("index.lookup_s")
+            self._c_lookups = telemetry.counter("index.lookups_total")
+            self._c_keys = telemetry.counter("index.keys_requested_total")
+            self._c_missing = telemetry.counter("index.keys_missing_total")
+            self._c_skipped = telemetry.counter("index.keys_skipped_total")
+            self._c_groups = telemetry.counter(
+                "index.rowgroups_touched_total")
+            self._c_rows = telemetry.counter("index.rows_served_total")
+            self._c_hits = telemetry.counter("index.cache_hits_total")
+            self._c_misses = telemetry.counter("index.cache_misses_total")
+            self._c_growth = telemetry.counter("index.growth_files_total")
+
+    @classmethod
+    def for_dataset(cls, dataset_url, *, cache=None, telemetry=None,
+                    storage_options=None, filesystem=None,
+                    **kwargs) -> "IndexLookupPlane":
+        """Standalone plane over a dataset URL: loads the persisted
+        sidecar and the stored/inferred Unischema. For lookups sharing a
+        live Reader's cache and telemetry, use ``Reader.lookup()``."""
+        from petastorm_tpu.etl.dataset_metadata import infer_or_load_unischema
+        ctx = DatasetContext(dataset_url, storage_options=storage_options,
+                            filesystem=filesystem)
+        return cls(ctx, FieldIndex.load(ctx), infer_or_load_unischema(ctx),
+                   dataset_url_or_urls=dataset_url,
+                   storage_options=storage_options, filesystem=filesystem,
+                   cache=cache, telemetry=telemetry, **kwargs)
+
+    # ------------------------------------------------------------ surface
+    @property
+    def index(self) -> FieldIndex:
+        return self._index
+
+    def lookup(self, keys, field: Optional[str] = None,
+               columns: Optional[Sequence[str]] = None,
+               on_missing: str = "error") -> List[dict]:
+        """Fetch the rows holding each key value of ``field``.
+
+        Returns one row dict per matching row, ordered by key position
+        (a key occurring in multiple rows yields all of them, in dataset
+        order). ``columns`` narrows the fetched/returned fields (default:
+        the plane's view — the owning reader's schema fields); the key
+        field itself always rides along in the fetch so group-granular
+        (legacy-bridged) entries can filter. ``on_missing``: ``"error"``
+        raises :class:`KeyError` naming the absent keys; ``"skip"`` counts
+        them on ``index.keys_missing_total`` and omits them. Keys whose
+        row group was quarantined mid-lookup (degraded mode) are skipped
+        and recorded — never an infinite retry."""
+        t0 = time.perf_counter()
+        field = self._resolve_field(field)
+        out_columns, needed = self._column_sets(columns, field)
+        keys = list(keys)
+
+        missing = []
+        by_group: Dict[Tuple[str, int], list] = {}
+        order: List[list] = []  # per-key slots, filled per group, flattened
+        for pos, key in enumerate(keys):
+            entries = self._index.entries_for(field, key)
+            order.append([])
+            if not entries:
+                missing.append(key)
+                continue
+            for rel, rg, off in entries:
+                by_group.setdefault((rel, rg), []).append((pos, key, off))
+        if missing:
+            if on_missing == "error":
+                raise KeyError(
+                    f"{len(missing)} key(s) not in the {field!r} index "
+                    f"(first: {missing[:5]!r}); pass on_missing='skip' to "
+                    f"drop absent keys")
+            if self._telemetry is not None:
+                self._c_missing.add(len(missing))
+
+        skipped_keys = 0
+        worker = self._worker(needed)
+        for (rel, rg), wants in sorted(by_group.items()):
+            data = self._decoded_group(rel, rg, needed)
+            if data is None:  # quarantined: skip-and-record semantics
+                skipped_keys += len(wants)
+                continue
+            key_col = data.get(field)
+            for pos, key, off in wants:
+                if off == GROUP_GRANULAR:
+                    offs = _matching_offsets(key_col, key)
+                else:
+                    offs = (off,)
+                for o in offs:
+                    order[pos].append({
+                        c: worker._copy_cell(data[c][o])
+                        for c in out_columns if c in data})
+
+        rows = [row for slot in order for row in slot]
+        if self._telemetry is not None:
+            self._c_lookups.add(1)
+            self._c_keys.add(len(keys))
+            self._c_rows.add(len(rows))
+            if skipped_keys:
+                self._c_skipped.add(skipped_keys)
+            self._h_lookup.observe(time.perf_counter() - t0)
+        return rows
+
+    def fetch_rows(self, locations: Sequence[Tuple[str, int, int]],
+                   columns: Optional[Sequence[str]] = None) -> List[dict]:
+        """Point reads by exact ``(rel_path, row_group, row_offset)`` —
+        the :class:`~petastorm_tpu.index.DatasetView` primitive. Same
+        coalescing/cache/quarantine behavior as :meth:`lookup`; a
+        quarantined group's rows come back as ``None`` placeholders (the
+        caller addressed specific rows, so silent omission would shift
+        positions)."""
+        t0 = time.perf_counter()
+        out_columns, needed = self._column_sets(columns, None)
+        by_group: Dict[Tuple[str, int], list] = {}
+        for pos, (rel, rg, off) in enumerate(locations):
+            by_group.setdefault((rel, rg), []).append((pos, off))
+        out: List[Optional[dict]] = [None] * len(locations)
+        skipped = 0
+        for (rel, rg), wants in sorted(by_group.items()):
+            data = self._decoded_group(rel, rg, needed)
+            if data is None:
+                skipped += len(wants)
+                continue
+            worker = self._worker(needed)
+            for pos, off in wants:
+                out[pos] = {c: worker._copy_cell(data[c][off])
+                            for c in out_columns if c in data}
+        if self._telemetry is not None:
+            self._c_lookups.add(1)
+            self._c_rows.add(len(locations) - skipped)
+            if skipped:
+                self._c_skipped.add(skipped)
+            self._h_lookup.observe(time.perf_counter() - t0)
+        return out
+
+    def gather(self, keys, field: Optional[str] = None,
+               columns: Optional[Sequence[str]] = None,
+               on_missing: str = "error") -> dict:
+        """Batched lookup committed to the device as one ``jax.Array`` per
+        field — the replay-sampler fast path (docs/random_access.md
+        "Batched gather")."""
+        from petastorm_tpu.index.gather import gather_rows
+        rows = self.lookup(keys, field=field, columns=columns,
+                           on_missing=on_missing)
+        return gather_rows(rows, fields=columns, telemetry=self._telemetry)
+
+    def extend_files(self, files: Sequence[Tuple[str, int]]) -> int:
+        """Reader-side growth hook (docs/live_data.md): scan newly
+        admitted ``(abs_path, num_row_groups)`` files' key columns and
+        extend the in-memory index monotonically — the appended keys
+        become visible to lookups without touching the persisted sidecar
+        (the writer owns that via
+        :func:`~petastorm_tpu.index.extend_field_index`). Idempotent per
+        file. Returns how many files were newly indexed."""
+        fields = self._index.fields_indexed
+        if not fields:
+            return 0
+        from petastorm_tpu.index.builder import scan_files_into_index
+        added = scan_files_into_index(
+            self._ctx, self._index, fields,
+            [(path, n) for path, n in files])
+        if added:
+            self._index.generation += 1
+            if self._telemetry is not None:
+                self._c_growth.add(added)
+        return added
+
+    def close(self) -> None:
+        for worker in self._workers.values():
+            files = getattr(worker, "_files", None)
+            if files is not None:
+                files.close_all()
+        self._workers.clear()
+
+    # ----------------------------------------------------------- internals
+    def _resolve_field(self, field: Optional[str]) -> str:
+        if field is not None:
+            return field
+        indexed = self._index.fields_indexed
+        if len(indexed) == 1:
+            return indexed[0]
+        raise ValueError(
+            f"lookup(field=...) is required when {len(indexed)} fields are "
+            f"indexed ({indexed})")
+
+    def _column_sets(self, columns: Optional[Sequence[str]],
+                     field: Optional[str]):
+        """``(output columns, needed fetch set)``. The default set IS the
+        owning reader's view — so the decoded-cache key matches the
+        sequential epoch path's and the two share entries."""
+        out = list(columns) if columns is not None else self._default_columns
+        unknown = [c for c in out if c not in self._schema.fields]
+        if unknown:
+            raise ValueError(f"unknown column(s) {unknown} (schema fields: "
+                             f"{sorted(self._schema.fields)})")
+        needed = set(out)
+        if field is not None and field in self._schema.fields:
+            needed.add(field)
+        return out, frozenset(needed)
+
+    def _worker(self, needed: frozenset):
+        worker = self._workers.get(needed)
+        if worker is None:
+            from petastorm_tpu.reader_impl.row_reader_worker import \
+                RowReaderWorker
+            view = self._schema.create_schema_view(sorted(needed))
+            args = {
+                "dataset_url_or_urls": self._url,
+                "storage_options": self._storage_options,
+                "filesystem": self._filesystem,
+                "schema": self._schema,
+                "view_schema": view,
+                "cache": self._cache,
+                "retry_policy": self._retry_policy,
+                "degraded_mode": self._degraded_mode,
+                "fault_plan": self._fault_plan,
+                "hedge_policy": self._hedge_policy,
+                "resilience_telemetry": self._telemetry,
+            }
+            worker = RowReaderWorker(0, lambda *_: None, args)
+            worker._ensure_open()
+            self._workers[needed] = worker
+        return worker
+
+    def _decoded_group(self, rel_path: str, row_group: int,
+                       needed: frozenset) -> Optional[dict]:
+        """Whole-row-group post-codec columns for one touched group — ONE
+        coalesced ``read_row_group(columns=...)`` on a miss, a pure cache
+        read on a hit (decoded memory tier, docs/autotune.md). ``None``
+        when the group was quarantined (degraded mode)."""
+        path = os.path.join(self._ctx.root_path, rel_path)
+        rowgroup = RowGroupRef(path, row_group,
+                               self._ctx.partition_values_for(path))
+        worker = self._worker(needed)
+        filled = []
+
+        def fetch():
+            cache = self._cache
+            from petastorm_tpu.cache import NullCache
+            if cache is None or isinstance(cache, NullCache):
+                filled.append(1)
+                return worker._decode_all_columns(rowgroup, needed)
+            if getattr(cache, "caches_decoded", False):
+                # Same key the sequential workers fill — shared warmth.
+                def fill():
+                    filled.append(1)
+                    return worker._decode_all_columns(rowgroup, needed)
+                return cache.get(
+                    worker._cache_key(rowgroup, needed) + ":decoded", fill)
+            # Disk tier caches RAW columns; decode per retrieval, exactly
+            # like the epoch path.
+            def fill_raw():
+                filled.append(1)
+                return worker._read_columns(rowgroup, needed,
+                                            zero_copy=False)
+            data = cache.get(worker._cache_key(rowgroup, needed), fill_raw)
+            n = len(next(iter(data.values()))) if data else 0
+            return worker._decode_columns(data, range(n))
+
+        try:
+            data = worker._guard.run(
+                fetch, rowgroup,
+                on_retry=lambda *_: worker._files.evict(rowgroup.path))
+        except RowGroupSkipped as skip:
+            if self.quarantine is not None:
+                self.quarantine.add(skip.record)
+            logger.warning("lookup skipped quarantined row group %s",
+                           skip.record.piece)
+            if self._telemetry is not None:
+                self._c_groups.add(1)
+                self._c_misses.add(1)
+            return None
+        if self._telemetry is not None:
+            self._c_groups.add(1)
+            (self._c_misses if filled else self._c_hits).add(1)
+        return data
+
+
+def _matching_offsets(key_col, key) -> List[int]:
+    """Row offsets whose cell matches ``key`` — the group-granular
+    (legacy-bridge) filter. Scalar cells compare through the same typed
+    encoding the index uses; array cells match on membership."""
+    if key_col is None:
+        return []
+    want = encode_key(key)
+    offs = []
+    for i, cell in enumerate(key_col):
+        if cell is None:
+            continue
+        if isinstance(cell, (list, tuple)) or (
+                hasattr(cell, "__len__")
+                and not isinstance(cell, (str, bytes, memoryview))):
+            if any(v is not None and encode_key(v) == want for v in cell):
+                offs.append(i)
+        elif encode_key(cell) == want:
+            offs.append(i)
+    return offs

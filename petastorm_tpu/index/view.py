@@ -1,0 +1,60 @@
+"""``DatasetView``: random access by global row ordinal
+(docs/random_access.md).
+
+The ordinal space is defined by the index sidecar's **append-only** file
+table and per-group row counts — file order, then row-group order, then
+row order — NOT by any reader's epoch plan. That makes ``view[i]`` stable
+across reader resume (the sidecar doesn't move when a cursor does) and
+monotonic under live growth (appended files extend the range; existing
+ordinals never shift). Point reads route through the owning
+:class:`~petastorm_tpu.index.IndexLookupPlane`, so slicing shares the
+decoded cache, coalescing, and quarantine semantics with ``lookup()``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+__all__ = ["DatasetView"]
+
+
+class DatasetView:
+    """Sequence-like random access over an indexed dataset.
+
+    ``view[i]`` -> row dict; ``view[i:j]`` / ``view[[i, j, k]]`` -> list
+    of row dicts, co-resident ordinals coalesced into one row-group read
+    each. Rows whose group was quarantined (degraded mode) come back as
+    ``None`` placeholders — positions never silently shift."""
+
+    def __init__(self, plane, columns: Optional[Sequence[str]] = None):
+        self._plane = plane
+        self._columns = list(columns) if columns is not None else None
+
+    def __len__(self) -> int:
+        return self._plane.index.num_rows
+
+    def __getitem__(self, item):
+        if isinstance(item, slice):
+            ordinals = range(*item.indices(len(self)))
+            return self._fetch(ordinals)
+        if isinstance(item, (list, tuple)):
+            return self._fetch(item)
+        row = self._fetch([item])[0]
+        if row is None:
+            raise LookupError(
+                f"row {item} is unavailable (its row group was "
+                f"quarantined; see Reader.quarantine_report())")
+        return row
+
+    def _fetch(self, ordinals) -> List[Optional[dict]]:
+        index = self._plane.index
+        locations = [index.ordinal_to_location(int(i)) for i in ordinals]
+        return self._plane.fetch_rows(locations, columns=self._columns)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return (f"DatasetView({len(self)} rows, "
+                f"{len(self._plane.index.files)} files, "
+                f"columns={self._columns or 'all'})")

@@ -1345,6 +1345,11 @@ class Reader:
         #: :meth:`quarantine_report`. Attached to every pool type.
         self.quarantine = RowGroupQuarantine(telemetry=self.telemetry)
         self._pool.quarantine = self.quarantine
+        #: Lazily-built random-access plane (docs/random_access.md):
+        #: constructed by the first :meth:`lookup` / :meth:`dataset_view`
+        #: from the dataset's persisted field-index sidecar; shares this
+        #: reader's decoded cache, quarantine aggregator, and telemetry.
+        self._lookup_plane = None
         if worker_crash_budget:
             if isinstance(self._pool, ProcessPool):
                 self._pool.recovery = WorkerCrashRecovery(
@@ -2240,6 +2245,18 @@ class Reader:
                             a.num_row_groups] for a in staged],
                  "items": len(new_items), **info}
         self._growth_batches.append(batch)
+        if self._lookup_plane is not None:
+            # Random-access plane rides the same admission point
+            # (docs/random_access.md): the appended files' keys become
+            # visible to lookup()/DatasetView the moment the epoch plan
+            # grows. Best-effort — an index-extension failure must never
+            # take down the epoch stream the growth is really for.
+            try:
+                self._lookup_plane.extend_files(
+                    [(a.path, a.num_row_groups) for a in staged])
+            except Exception:  # noqa: BLE001
+                logger.exception("field-index growth extension failed; "
+                                 "lookups will not see the appended files")
         # Explain-plane safe point: the plan just grew — re-snapshot the
         # operator graph (plan_items / growth capacities changed).
         self._explain_dirty = True
@@ -2284,6 +2301,73 @@ class Reader:
         if self._discovery is not None:
             report["discovery"] = self._discovery.report()
         return report
+
+    # ------------------------------------------------------------------
+    # Random-access plane (docs/random_access.md)
+    # ------------------------------------------------------------------
+    def _ensure_lookup_plane(self):
+        """Build the lookup plane from the dataset's persisted field-index
+        sidecar on first use. Shares this reader's decoded cache (so
+        lookups and the epoch stream warm each other and return
+        byte-identical cells), quarantine aggregator, retry/degraded
+        policy, and telemetry registry. Growth batches already applied to
+        the epoch plan are folded in, so a late-built plane sees exactly
+        the files the plan does."""
+        if self._lookup_plane is None:
+            from petastorm_tpu.index import FieldIndex, IndexLookupPlane
+            index = FieldIndex.load(self._ctx)
+            args = self._worker_args_inproc
+            self._lookup_plane = IndexLookupPlane(
+                self._ctx, index, self._stored_schema,
+                dataset_url_or_urls=args["dataset_url_or_urls"],
+                storage_options=args.get("storage_options"),
+                filesystem=args.get("filesystem"),
+                cache=self._cache,
+                retry_policy=args.get("retry_policy"),
+                degraded_mode=args.get("degraded_mode", False),
+                fault_plan=args.get("fault_plan"),
+                hedge_policy=args.get("hedge_policy"),
+                telemetry=self.telemetry, quarantine=self.quarantine,
+                default_columns=sorted(
+                    n for n in self.schema.fields
+                    if n in self._stored_schema.fields))
+            # Reconcile the plane with every file this reader's plan
+            # covers: growth batches applied before the plane was built,
+            # AND base-plan files newer than the persisted sidecar (a
+            # fresh reader over a grown store lists appended files as
+            # base, not growth). extend_files dedupes per file, so
+            # already-indexed entries are untouched.
+            newer = [(os.path.join(self._ctx.root_path, rel), n)
+                     for rel, n in (self._base_manifest or [])]
+            newer += [(os.path.join(self._ctx.root_path, rel), n)
+                      for b in self._growth_batches for rel, n in b["files"]]
+            newer = [(path, n) for path, n in newer
+                     if not self._lookup_plane.index.has_file(
+                         os.path.relpath(path, self._ctx.root_path))]
+            if newer:
+                self._lookup_plane.extend_files(newer)
+        return self._lookup_plane
+
+    def lookup(self, keys, field=None, columns=None, on_missing="error"):
+        """Keyed point reads (docs/random_access.md): fetch the decoded
+        rows holding each value of ``field``, coalescing co-resident keys
+        into one row-group read and serving warm keys straight from the
+        decoded in-memory cache. Returns a list of row dicts in key
+        order; cells are byte-identical to a sequential epoch read of the
+        same rows. Requires a persisted field index
+        (``petastorm_tpu.index.build_field_index``). Predicates,
+        transforms, and shuffling do not apply — this is the raw
+        random-access surface next to the epoch stream."""
+        return self._ensure_lookup_plane().lookup(
+            keys, field=field, columns=columns, on_missing=on_missing)
+
+    def dataset_view(self, columns=None):
+        """:class:`~petastorm_tpu.index.DatasetView` over this reader's
+        lookup plane: random access by global row ordinal, stable across
+        resume and monotonic under live growth (the ordinal space is the
+        index sidecar's append-only file table, not the epoch plan)."""
+        from petastorm_tpu.index import DatasetView
+        return DatasetView(self._ensure_lookup_plane(), columns=columns)
 
     def _current_manifest(self) -> dict:
         """The cursor-side plan manifest: base files plus applied growth
@@ -2755,6 +2839,8 @@ class Reader:
         if self._telemetry_exporter is not None:
             self._telemetry_exporter.stop()
             self._telemetry_exporter = None
+        if self._lookup_plane is not None:
+            self._lookup_plane.close()
         self._pool.stop()
         if self.readahead is not None:
             # After the pool: a worker blocked in a readahead pop sees the

@@ -59,7 +59,8 @@ def default_rules(input_stall_pct: float = 5.0,
                   stragglers_per_s: float = 2.0,
                   ingest_lag_s: float = 300.0,
                   max_drift: float = 0.2,
-                  coverage_violations: float = 0.0) -> List[SloRule]:
+                  coverage_violations: float = 0.0,
+                  index_lookup_p99_s: float = 0.010) -> List[SloRule]:
     """The documented default rule set (thresholds per the tuning table in
     docs/observability.md). ``ingest_lag_s`` is the live-data freshness
     contract (docs/live_data.md): now minus the newest admitted file's
@@ -96,6 +97,12 @@ def default_rules(input_stall_pct: float = 5.0,
         # pipelines skip the rule.
         SloRule("coverage_violations", "counter",
                 "service.coverage_violations_total", coverage_violations),
+        # Random-access contract (docs/random_access.md): warm point
+        # lookups must stay interactive — p99 of end-to-end lookup()
+        # latency <= 10ms. The histogram only exists once a lookup plane
+        # has served a call, so epoch-only pipelines skip the rule.
+        SloRule("index_lookup_p99_s", "p99", "index.lookup_s",
+                index_lookup_p99_s),
     ]
 
 

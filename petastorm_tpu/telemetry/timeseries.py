@@ -139,6 +139,11 @@ DEFAULT_SERIES: Sequence[SeriesSpec] = (
     SeriesSpec("host_skew_s", "gauge", "mesh.host_skew_s"),
     SeriesSpec("decode_p99_s", "p99", "worker.decode_s"),
     SeriesSpec("host_wait_p99_s", "p99", "loader.host_wait_seconds"),
+    # Random-access plane (docs/random_access.md): warm-lookup latency
+    # tail and point-read throughput of the field-index lookup path.
+    SeriesSpec("index.lookup_p99_s", "p99", "index.lookup_s"),
+    SeriesSpec("index.lookups_per_s", "rate", "index.lookups_total"),
+    SeriesSpec("index.rows_served_per_s", "rate", "index.rows_served_total"),
     # Families: one series per mesh host / process-pool worker / mixer
     # member — the federation plane's per-member views.
     SeriesSpec("mesh.host{}.rows_per_s", "rate", "mesh.host*.rows"),

@@ -1,0 +1,454 @@
+"""Random-access plane (docs/random_access.md): field-index sidecar,
+lookup()/DatasetView point reads, growth extension, quarantine skip
+semantics, legacy bridge, batched gather, SLO/series membership.
+
+Tier-1 (`randaccess` marker). The acceptance criteria pinned here:
+lookups return byte-identical cells to a sequential epoch read of the
+same rows (across thread AND process pools), an appended file's keys are
+visible after admission, quarantined-group lookups skip-and-record
+instead of hanging, and `DatasetView` ordinals are stable across a
+deterministic reader's resume.
+"""
+import glob
+import os
+import warnings
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.autotune import InMemoryRowGroupCache
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl.dataset_metadata import DatasetContext
+from petastorm_tpu.index import (DatasetView, FieldIndex, GROUP_GRANULAR,
+                                 INDEX_FORMAT, INDEX_SIDECAR_NAME,
+                                 IndexLookupPlane, build_field_index,
+                                 encode_key, extend_field_index, gather_rows)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.resilience import (ExponentialBackoff, FaultPlan,
+                                      FaultSpec, RetryPolicy)
+from petastorm_tpu.telemetry import make_registry
+
+pytestmark = pytest.mark.randaccess
+
+FAST = RetryPolicy(max_attempts=2,
+                   backoff=ExponentialBackoff(base=0.0, multiplier=1.0,
+                                              cap=0.0),
+                   jitter="none", seed=0)
+
+
+# --------------------------------------------------------------- helpers
+def write_scalar_file(path, start, rows=20, row_group_size=10):
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(start, start + rows)),
+                  "val": pa.array(np.arange(start, start + rows,
+                                            dtype=np.float64))}),
+        path, row_group_size=row_group_size)
+
+
+@pytest.fixture()
+def indexed_store(tmp_path):
+    """Plain parquet store (a: ids 0-19, b: ids 20-39; 10 rows/group) with
+    a persisted field index on ``id``."""
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0)
+    write_scalar_file(f"{root}/b.parquet", 20)
+    build_field_index(f"file://{root}", ["id"])
+    return root
+
+
+@pytest.fixture(scope="module")
+def synthetic_indexed(synthetic_dataset):
+    """The shared synthetic (Unischema, codec-heavy) dataset with an
+    ``id`` field index built once."""
+    ctx = DatasetContext(synthetic_dataset.url)
+    if not ctx.filesystem.exists(FieldIndex.sidecar_path(ctx)):
+        build_field_index(synthetic_dataset.url, ["id"])
+    return synthetic_dataset
+
+
+def cells_equal(a, b):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.array_equal(np.asarray(a), np.asarray(b))
+    return a == b
+
+
+def assert_rows_match_epoch(reader, keys, epoch_rows):
+    rows = reader.lookup(keys)
+    assert [int(r["id"]) for r in rows] == keys
+    for row in rows:
+        expected = epoch_rows[int(row["id"])]
+        assert set(row) == set(expected)
+        for name, cell in row.items():
+            assert cells_equal(cell, expected[name]), \
+                f"field {name!r} differs for id {row['id']}"
+
+
+# ------------------------------------------------------------ sidecar unit
+def test_encode_key_typed_tags():
+    assert encode_key(42) == "i:42"
+    assert encode_key(np.int64(42)) == "i:42"
+    assert encode_key(True) == "i:1"
+    assert encode_key(0.5) == "f:0.5"
+    assert encode_key(np.float32(0.5)) == "f:0.5"
+    assert encode_key("abc") == "s:abc"
+    assert encode_key(b"\x01\xff") == "b:01ff"
+    # No cross-type collisions: 1, "1", and b"1" are different keys.
+    assert len({encode_key(1), encode_key("1"), encode_key(b"1")}) == 3
+    with pytest.raises(TypeError, match="unindexable"):
+        encode_key(object())
+
+
+def test_build_load_roundtrip(indexed_store):
+    ctx = DatasetContext(f"file://{indexed_store}")
+    assert ctx.filesystem.exists(
+        os.path.join(indexed_store, INDEX_SIDECAR_NAME))
+    idx = FieldIndex.load(ctx)
+    assert idx.files == ["a.parquet", "b.parquet"]
+    assert idx.row_counts == [[10, 10], [10, 10]]
+    assert idx.num_rows == 40
+    assert idx.generation == 1
+    assert idx.fields_indexed == ["id"]
+    # Exact (file, group, offset) resolution.
+    assert idx.entries_for("id", 27) == [("b.parquet", 0, 7)]
+    assert idx.entries_for("id", 999) == []
+    with pytest.raises(MetadataError, match="not indexed"):
+        idx.entries_for("val", 1.0)
+
+
+def test_load_missing_and_bad_format(tmp_path):
+    root = str(tmp_path / "empty")
+    os.makedirs(root)
+    write_scalar_file(f"{root}/a.parquet", 0)
+    with pytest.raises(MetadataError, match="build_field_index"):
+        FieldIndex.load(DatasetContext(f"file://{root}"))
+    with pytest.raises(MetadataError, match="unsupported field-index"):
+        FieldIndex.from_dict({"format": "petastorm-tpu.field-index.v999"})
+
+
+def test_ordinal_space(indexed_store):
+    idx = FieldIndex.load(DatasetContext(f"file://{indexed_store}"))
+    assert idx.ordinal_to_location(0) == ("a.parquet", 0, 0)
+    assert idx.ordinal_to_location(15) == ("a.parquet", 1, 5)
+    assert idx.ordinal_to_location(20) == ("b.parquet", 0, 0)
+    assert idx.ordinal_to_location(-1) == ("b.parquet", 1, 9)
+    with pytest.raises(IndexError):
+        idx.ordinal_to_location(40)
+
+
+def test_extend_field_index_monotonic(indexed_store):
+    url = f"file://{indexed_store}"
+    write_scalar_file(f"{indexed_store}/c.parquet", 40)
+    idx = extend_field_index(url)
+    # Append-only: existing ordinals never move, appended file rides last.
+    assert idx.files == ["a.parquet", "b.parquet", "c.parquet"]
+    assert idx.generation == 2
+    assert idx.entries_for("id", 45) == [("c.parquet", 0, 5)]
+    assert idx.entries_for("id", 27) == [("b.parquet", 0, 7)]
+    assert idx.num_rows == 60
+    # Idempotent: nothing new -> no rescan, no generation bump.
+    again = extend_field_index(url)
+    assert again.generation == 2 and again.num_rows == 60
+
+
+# --------------------------------------------------- byte-identity pinning
+def _epoch_rows(url, pool):
+    with make_reader(url, reader_pool_type=pool, workers_count=2,
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        return {int(s.id): s._asdict() for s in reader}
+
+
+def test_lookup_byte_identical_to_thread_epoch(synthetic_indexed):
+    epoch_rows = _epoch_rows(synthetic_indexed.url, "thread")
+    with make_reader(synthetic_indexed.url, reader_pool_type="thread",
+                     workers_count=2, shuffle_row_groups=False,
+                     num_epochs=1) as reader:
+        assert_rows_match_epoch(reader, [3, 17, 42, 99, 64], epoch_rows)
+
+
+@pytest.mark.process_pool
+def test_lookup_byte_identical_to_process_epoch(synthetic_indexed):
+    epoch_rows = _epoch_rows(synthetic_indexed.url, "process")
+    with make_reader(synthetic_indexed.url, reader_pool_type="process",
+                     workers_count=2, shuffle_row_groups=False,
+                     num_epochs=1) as reader:
+        assert_rows_match_epoch(reader, [5, 28, 77], epoch_rows)
+
+
+# --------------------------------------------- coalescing / cache sharing
+def test_lookup_coalesces_and_shares_decoded_cache(indexed_store):
+    registry = make_registry()
+    cache = InMemoryRowGroupCache(1 << 24)
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}",
+                                         cache=cache, telemetry=registry)
+    try:
+        # 4 keys, all resident in a.parquet group 0 -> ONE group read.
+        rows = plane.lookup([1, 3, 5, 7])
+        assert [int(r["id"]) for r in rows] == [1, 3, 5, 7]
+        counters = registry.metrics_view()["counters"]
+        assert counters["index.rowgroups_touched_total"] == 1
+        assert counters["index.cache_misses_total"] == 1
+        # Same group again: pure cache hit, still one touched group.
+        plane.lookup([2, 4])
+        counters = registry.metrics_view()["counters"]
+        assert counters["index.rowgroups_touched_total"] == 2
+        assert counters["index.cache_hits_total"] == 1
+        assert counters["index.rows_served_total"] == 6
+        hist = registry.metrics_view()["histograms"]["index.lookup_s"]
+        assert hist["count"] == 2
+    finally:
+        plane.close()
+
+
+def test_lookup_missing_key_semantics(indexed_store):
+    registry = make_registry()
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}",
+                                         telemetry=registry)
+    try:
+        with pytest.raises(KeyError, match="not in the 'id' index"):
+            plane.lookup([1, 999])
+        rows = plane.lookup([1, 999, 2], on_missing="skip")
+        assert [int(r["id"]) for r in rows] == [1, 2]
+        counters = registry.metrics_view()["counters"]
+        assert counters["index.keys_missing_total"] == 1
+    finally:
+        plane.close()
+
+
+def test_lookup_column_projection(indexed_store):
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}")
+    try:
+        rows = plane.lookup([8], columns=["val"])
+        assert set(rows[0]) == {"val"}
+        assert rows[0]["val"] == 8.0
+        with pytest.raises(ValueError, match="unknown column"):
+            plane.lookup([8], columns=["nope"])
+    finally:
+        plane.close()
+
+
+# ------------------------------------------------------- growth visibility
+def test_appended_file_keys_visible_after_admission(indexed_store):
+    url = f"file://{indexed_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=2,
+                           refresh_interval_s=0) as reader:
+        assert int(reader.lookup([27])[0]["id"]) == 27
+        with pytest.raises(KeyError):
+            reader.lookup([45])
+        write_scalar_file(f"{indexed_store}/c.parquet", 40)
+        report = reader.refresh_dataset()
+        assert report["applied"]
+        # The admitted file's keys are lookup-able with NO sidecar rewrite.
+        assert int(reader.lookup([45])[0]["id"]) == 45
+        assert len(reader.dataset_view()) == 60
+        counters = reader.telemetry.metrics_view()["counters"]
+        assert counters["index.growth_files_total"] == 1
+    # A plane built AFTER the growth replays the applied batches too.
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=2,
+                           refresh_interval_s=0) as late:
+        late.refresh_dataset()
+        assert int(late.lookup([52])[0]["id"]) == 52
+
+
+# -------------------------------------------------- quarantine skip path
+def test_quarantined_group_lookup_skips_and_records(indexed_store):
+    plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="corruption",
+                                rate=1.0, key_substring="b.parquet")],
+                     seed=0)
+    url = f"file://{indexed_store}"
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=1,
+                           retry_policy=FAST, degraded_mode=True,
+                           fault_plan=plan) as reader:
+        # Keys in the corrupt file are skipped (call returns, no hang);
+        # keys in the healthy file still arrive.
+        rows = reader.lookup([5, 25, 15])
+        assert [int(r["id"]) for r in rows] == [5, 15]
+        report = reader.quarantine_report()
+        assert report["quarantined"] >= 1
+        assert any("b.parquet" in p["path"] for p in report["pieces"])
+        counters = reader.telemetry.metrics_view()["counters"]
+        assert counters["index.keys_skipped_total"] == 1
+        # DatasetView never shifts positions: the quarantined ordinal is a
+        # None placeholder in slices and a LookupError on scalar access.
+        view = reader.dataset_view()
+        got = view[[5, 25]]
+        assert int(got[0]["id"]) == 5 and got[1] is None
+        with pytest.raises(LookupError, match="quarantined"):
+            view[25]
+
+
+def test_lookup_corruption_without_degraded_mode_fails_fast(indexed_store):
+    from petastorm_tpu.resilience.faults import InjectedCorruptionError
+    plan = FaultPlan([FaultSpec(site="rowgroup.read", kind="corruption",
+                                rate=1.0, key_substring="b.parquet")],
+                     seed=0)
+    with make_batch_reader(f"file://{indexed_store}",
+                           reader_pool_type="dummy", num_epochs=1,
+                           retry_policy=FAST, fault_plan=plan) as reader:
+        with pytest.raises(InjectedCorruptionError):
+            reader.lookup([25])
+
+
+# ----------------------------------------------------------- DatasetView
+def test_dataset_view_access_modes(indexed_store):
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}")
+    try:
+        view = DatasetView(plane)
+        assert len(view) == 40
+        assert int(view[0]["id"]) == 0
+        assert int(view[-1]["id"]) == 39
+        assert [int(r["id"]) for r in view[18:22]] == [18, 19, 20, 21]
+        assert [int(r["id"]) for r in view[[7, 33, 12]]] == [7, 33, 12]
+        with pytest.raises(IndexError):
+            view[40]
+        narrowed = DatasetView(plane, columns=["val"])
+        assert set(narrowed[3]) == {"val"}
+    finally:
+        plane.close()
+
+
+def test_dataset_view_stable_across_resume(indexed_store):
+    """View ordinals are anchored to the sidecar's append-only file table,
+    not the epoch plan — a mid-epoch cursor resume must not move them."""
+    url = f"file://{indexed_store}"
+
+    def mk(resume=None):
+        return make_batch_reader(url, reader_pool_type="dummy", num_epochs=2,
+                                 shuffle_row_groups=True, seed=7,
+                                 sample_order="deterministic",
+                                 resume_state=resume)
+
+    probe = [0, 13, 27, 39]
+    with mk() as r:
+        it = iter(r)
+        next(it)
+        before = [r.dataset_view()[i]["id"] for i in probe]
+        cursor = r.state_dict()
+    with mk(resume=cursor) as r2:
+        after = [r2.dataset_view()[i]["id"] for i in probe]
+    assert [int(x) for x in before] == [int(x) for x in after] \
+        == [0, 13, 27, 39]
+
+
+# --------------------------------------------------------- legacy bridge
+def test_legacy_build_warns_and_bridges(tmp_path):
+    from petastorm_tpu.etl.rowgroup_indexers import (FieldNotNullIndexer,
+                                                     SingleFieldIndexer)
+    from petastorm_tpu.etl.rowgroup_indexing import (build_rowgroup_index,
+                                                     get_row_group_indexes)
+    root = str(tmp_path / "legacy")
+    os.makedirs(root)
+    ids = np.arange(40)
+    pq.write_table(
+        pa.table({"id": ids, "part": [f"p{i % 4}" for i in ids]}),
+        f"{root}/a.parquet", row_group_size=10)
+    url = f"file://{root}"
+    with pytest.warns(DeprecationWarning, match="random-access"):
+        build_rowgroup_index(url, [SingleFieldIndexer("by_part", "part"),
+                                   FieldNotNullIndexer("nn", "part")])
+    ctx = DatasetContext(url)
+    # The legacy pickled surface still answers (rowgroup_selector= path).
+    assert sorted(get_row_group_indexes(ctx)) == ["by_part", "nn"]
+    # ...and the bridge emitted the v1 sidecar: keyed indexer converted to
+    # group-granular entries, the synthetic not-null indexer skipped.
+    idx = FieldIndex.load(ctx)
+    assert idx.fields_indexed == ["part"]
+    assert all(off == GROUP_GRANULAR
+               for _, _, off in idx.entries_for("part", "p2"))
+    plane = IndexLookupPlane.for_dataset(url)
+    try:
+        rows = plane.lookup(["p2"], field="part")
+        assert sorted(int(r["id"]) for r in rows) == list(range(2, 40, 4))
+    finally:
+        plane.close()
+
+
+# --------------------------------------------------------- batched gather
+def test_gather_rows_shapes_dtypes_values(indexed_store):
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}")
+    try:
+        rows = plane.lookup([4, 30, 11])
+        batch = gather_rows(rows)
+        import jax
+        assert isinstance(batch["val"], jax.Array)
+        assert batch["val"].shape == (3,)
+        assert batch["val"].dtype == np.float64 or \
+            batch["val"].dtype == np.float32  # x64-off downcast
+        np.testing.assert_allclose(np.asarray(batch["val"]),
+                                   [4.0, 30.0, 11.0])
+        np.testing.assert_array_equal(np.asarray(batch["id"]), [4, 30, 11])
+    finally:
+        plane.close()
+
+
+def test_gather_rows_edge_semantics():
+    rows = [{"x": np.float32(1.0), "s": "a"},
+            None,  # quarantine placeholder: filtered, not fatal
+            {"x": np.float32(2.0), "s": "b"}]
+    host = gather_rows(rows, to_device=False)
+    # Auto mode drops the non-batchable string column silently...
+    assert set(host) == {"x"}
+    np.testing.assert_allclose(host["x"], [1.0, 2.0])
+    # ...explicit fields= makes it a hard error.
+    with pytest.raises(TypeError, match="'s'"):
+        gather_rows(rows, fields=["s"], to_device=False)
+    assert gather_rows([]) == {}
+    assert gather_rows([None, None]) == {}
+
+
+def test_gather_counts_telemetry(indexed_store):
+    registry = make_registry()
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}",
+                                         telemetry=registry)
+    try:
+        batch = plane.gather([2, 21, 33])
+        assert batch["id"].shape == (3,)
+        counters = registry.metrics_view()["counters"]
+        assert counters["index.gather_rows_total"] == 3
+    finally:
+        plane.close()
+
+
+# ------------------------------------------------------ SLO / ops plane
+def test_lookup_p99_rule_and_series_membership():
+    from petastorm_tpu.telemetry.slo import (DEFAULT_RULES, evaluate_rules,
+                                             parse_rules, rule_value)
+    from petastorm_tpu.telemetry.timeseries import DEFAULT_SERIES
+    rule = {r.name: r for r in DEFAULT_RULES}["index_lookup_p99_s"]
+    assert rule.kind == "p99" and rule.metric == "index.lookup_s"
+    assert rule.max_value == 0.010
+    assert parse_rules("index_lookup_p99_s<=0.02")[0].max_value == 0.02
+    series = {s.name: s for s in DEFAULT_SERIES}
+    assert series["index.lookup_p99_s"].metric == "index.lookup_s"
+    assert series["index.lookups_per_s"].kind == "rate"
+    # No histogram -> the rule is skipped, not violated (epoch-only
+    # pipelines must not fail the lookup SLO).
+    assert rule_value(rule, {"histograms": {}}) is None
+    bad = {"histograms": {"index.lookup_s": {"count": 100, "p99": 0.5}}}
+    assert any(v["rule"] == "index_lookup_p99_s"
+               for v in evaluate_rules(bad, [rule]))
+
+
+def test_slo_evaluable_from_live_lookup_snapshot(indexed_store):
+    """`telemetry check --slo` path: a real lookup-serving registry
+    snapshot evaluates the rule (warm lookups on this store sit far under
+    the 10ms budget)."""
+    from petastorm_tpu.telemetry.slo import DEFAULT_RULES, evaluate_rules
+    registry = make_registry()
+    cache = InMemoryRowGroupCache(1 << 24)
+    plane = IndexLookupPlane.for_dataset(f"file://{indexed_store}",
+                                         cache=cache, telemetry=registry)
+    try:
+        plane.lookup([1])          # cold
+        for _ in range(20):        # warm
+            plane.lookup([2, 17, 35])
+        snap = registry.metrics_view()
+        assert snap["histograms"]["index.lookup_s"]["count"] == 21
+        rules = [r for r in DEFAULT_RULES if r.name == "index_lookup_p99_s"]
+        assert evaluate_rules(snap, rules) == []
+    finally:
+        plane.close()

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Lint guard: point reads route through the random-access plane.
+
+``read_row_group(...)`` is the raw point-read primitive. Called ad hoc it
+bypasses everything the lookup plane provides: coalescing of co-resident
+keys into one group read, the shared decoded cache (and its keys — an ad
+hoc read can't warm the epoch stream or be warmed by it), the quarantine
+guard (a corrupt group re-poisons per call site), and ``index.*``
+telemetry. Every point read outside the sanctioned machinery must go
+through ``Reader.lookup()`` / ``IndexLookupPlane`` (docs/random_access.md).
+
+Sanctioned call sites:
+
+* ``petastorm_tpu/index/`` — the lookup plane itself;
+* ``petastorm_tpu/reader_impl/row_reader_worker.py`` — the epoch decode
+  worker the plane reuses;
+* ``petastorm_tpu/reader_impl/readahead.py`` — plan-driven epoch
+  prefetch (group-sequential, not point access);
+* ``petastorm_tpu/etl/rowgroup_indexing.py`` — the deprecated legacy
+  index builder (full-scan, bridged to the new sidecar).
+
+This is an AST check, not a grep: it catches any ``*.read_row_group(...)``
+/ ``*.read_row_groups(...)`` attribute call while ignoring comments and
+strings. A deliberate new site may opt out with a ``pointread-ok``
+comment on the call line (say why the lookup plane can't serve it).
+
+Usage::
+
+    python tools/check_pointreads.py            # scan petastorm_tpu/
+    python tools/check_pointreads.py PATH...    # scan specific files/dirs
+
+Exit code 1 when any violation is found (wired into ``make ci-lint``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_PATHS = ("petastorm_tpu",)
+
+#: Call sites allowed to issue raw point reads (repo-relative prefixes).
+ALLOWED_PREFIXES = (
+    "petastorm_tpu/index/",
+    "petastorm_tpu/reader_impl/row_reader_worker.py",
+    "petastorm_tpu/reader_impl/readahead.py",
+    "petastorm_tpu/etl/rowgroup_indexing.py",
+)
+
+WAIVER = "pointread-ok"
+_POINT_READS = ("read_row_group", "read_row_groups")
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield path
+
+
+def _is_allowed(path: str) -> bool:
+    rel = os.path.relpath(os.path.abspath(path), ROOT).replace(os.sep, "/")
+    return any(rel == p or rel.startswith(p) for p in ALLOWED_PREFIXES)
+
+
+def _point_read_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _POINT_READS):
+            yield node
+
+
+def check_file(path: str) -> list:
+    """``["path:line: message", ...]`` for every unwaived point read."""
+    if _is_allowed(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: syntax error prevents linting: {e.msg}"]
+    lines = source.splitlines()
+    violations = []
+    for call in sorted(_point_read_calls(tree), key=lambda c: c.lineno):
+        line = lines[call.lineno - 1] if call.lineno <= len(lines) else ""
+        if WAIVER in line:
+            continue
+        violations.append(
+            f"{path}:{call.lineno}: raw {call.func.attr}() outside the "
+            f"random-access plane — route point reads through "
+            f"Reader.lookup()/IndexLookupPlane (docs/random_access.md) so "
+            f"they get coalescing, the shared decoded cache, and the "
+            f"quarantine guard (or add '# {WAIVER}' with a reason)")
+    return violations
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    paths = argv or [os.path.join(ROOT, p) for p in DEFAULT_PATHS]
+    all_violations = []
+    checked = 0
+    for path in _python_files(paths):
+        all_violations.extend(check_file(path))
+        checked += 1
+    for v in all_violations:
+        print(v, file=sys.stderr)
+    if all_violations:
+        print(f"check_pointreads: {len(all_violations)} violation(s) in "
+              f"{checked} file(s)", file=sys.stderr)
+        return 1
+    print(f"check_pointreads: {checked} file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
