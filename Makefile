@@ -56,6 +56,7 @@ ci-lint:
 	python tools/check_operators.py
 	python tools/check_lowering.py
 	python tools/check_wire.py
+	python tools/check_journal.py
 	# Shipped SLO rules + anomaly detectors, gated against the committed
 	# known-good bench telemetry snapshots (bench.py refreshes them each
 	# run): a rule/detector regression fails the BUILD, not just the bench.
@@ -73,6 +74,11 @@ ci-lint:
 	# snapshot from the bench fleet must hold the exactly-once SLO — a
 	# lease/coverage regression fails the BUILD.
 	python -m petastorm_tpu.telemetry check bench_snapshots/data_service_epoch.json --slo "counter:service.coverage_violations_total<=0"
+	# Fleet-survivability contract (docs/service.md "Failure modes &
+	# recovery"): the committed chaos snapshot — dispatcher AND one decode
+	# server killed mid-epoch — must still hold the exactly-once SLO and
+	# show a clean journal; a failover/replay regression fails the BUILD.
+	python -m petastorm_tpu.telemetry check bench_snapshots/chaos_service_epoch.json --slo "counter:service.coverage_violations_total<=0" --slo "counter:journal.torn_records_total<=0"
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

@@ -1972,6 +1972,196 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"data-service phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4k. fleet chaos drill (docs/service.md "Failure modes &
+    # recovery"): the seeded service chaos plan. One journaled dispatcher
+    # (+ a warm standby tailing the journal) + 4 decode servers + 2
+    # clients drain one epoch while the installed FaultPlan kills the
+    # dispatcher at the 6th lease_request AND one named decode server at
+    # its first work order. The standby re-binds the primary's control
+    # address after 2.0s of journal silence (VIP-style takeover: the
+    # surviving servers re-register through their heartbeats; the dead
+    # one never does), replays the journal, and re-fences the in-flight
+    # leases. Clients ride the outage out on whichever recovery path the
+    # timing hands them — a generation-change resync when their RPC
+    # window spans the takeover, or a state_dict resume + resync when it
+    # doesn't. Proven: the union stream is byte-identical to the
+    # fault-free local reference, the promoted dispatcher's ledger
+    # reconciles with zero violations, and recovery lands within 2 lease
+    # TTLs. The promoted dispatcher's telemetry snapshot is flushed to
+    # bench_snapshots/chaos_service_epoch.json — the `make ci-lint`
+    # survivability SLO gate artifact (coverage violations == 0, torn
+    # journal records == 0).
+    chaos_child = (
+        "import json, os, shutil, threading, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import pyarrow as pa\n"
+        "import pyarrow.parquet as pq\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "from petastorm_tpu.resilience.faults import FaultPlan, FaultSpec\n"
+        "from petastorm_tpu.service import (Dispatcher, DecodeServer,\n"
+        "                                   ServiceJobSpec, WarmStandby,\n"
+        "                                   install_service_fault_plan,\n"
+        "                                   make_service_reader)\n"
+        "path = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'service_chaos')\n"
+        "url = 'file://' + path\n"
+        "if not os.path.exists(os.path.join(path, 'part0.parquet')):\n"
+        "    os.makedirs(path, exist_ok=True)\n"
+        "    rng = np.random.default_rng(11)\n"
+        "    nrows = 48 * 512\n"
+        "    cols = {'id': np.arange(nrows, dtype=np.float64)}\n"
+        "    for i in range(1, 6):\n"
+        "        cols['f%d' % i] = rng.normal(size=nrows)\n"
+        "    pq.write_table(pa.table(cols), os.path.join(path, 'part0.parquet'),\n"
+        "                   row_group_size=512, compression='zstd')\n"
+        "SEED, TTL, pid = 20260807, 3.0, os.getpid()\n"
+        "NUM_ITEMS = 48\n"
+        "ref = []\n"
+        "with make_batch_reader(url, shuffle_row_groups=True, seed=SEED,\n"
+        "                       num_epochs=1,\n"
+        "                       sample_order='deterministic') as r:\n"
+        "    for b in r:\n"
+        "        ref.append({f: getattr(b, f) for f in b._fields})\n"
+        "assert len(ref) == NUM_ITEMS\n"
+        "daddr = 'ipc:///tmp/pt-chaos-d-%d' % pid\n"
+        "saddrs = ['ipc:///tmp/pt-chaos-s%d-%d' % (i, pid) for i in range(4)]\n"
+        "jdir = os.path.join(os.environ['PT_BENCH_DATA_DIR'],\n"
+        "                    'chaos_journal_%d' % pid)\n"
+        "shutil.rmtree(jdir, ignore_errors=True)\n"
+        "mk = lambda: [ServiceJobSpec('job-chaos', url, tenant='chaos',\n"
+        "                             seed=SEED, chunk=4)]\n"
+        "mkdisp = lambda a, jd: Dispatcher(a, jobs=mk(), lease_ttl_s=TTL,\n"
+        "                                  hedge_delay_s=30.0,\n"
+        "                                  server_heartbeat_s=0.5,\n"
+        "                                  journal_dir=jd)\n"
+        "disp = mkdisp(daddr, jdir).start()\n"
+        "standby = WarmStandby(daddr, jdir, heartbeat_s=0.75,\n"
+        "                      takeover_silence_s=2.0,\n"
+        "                      dispatcher_factory=mkdisp).start()\n"
+        "servers = [DecodeServer(a, dispatcher_addr=daddr, heartbeat_s=0.5,\n"
+        "                        server_id=('srv-victim' if i == 1\n"
+        "                                   else 'srv-%d' % i)).start()\n"
+        "           for i, a in enumerate(saddrs)]\n"
+        "install_service_fault_plan(FaultPlan([\n"
+        "    FaultSpec(site='dispatcher.kill', kind='ioerror', at=6,\n"
+        "              key_substring='lease_request'),\n"
+        "    FaultSpec(site='server.order', kind='ioerror', at=1,\n"
+        "              key_substring='srv-victim')], seed=SEED))\n"
+        "t_kill = [None]; t_grant = [None]\n"
+        "def watch():\n"
+        "    while t_kill[0] is None:\n"
+        "        if disp.killed:\n"
+        "            t_kill[0] = time.perf_counter()\n"
+        "            break\n"
+        "        time.sleep(0.02)\n"
+        "    standby.promoted.wait(60.0)\n"
+        "    deadline = time.perf_counter() + 60.0\n"
+        "    while t_grant[0] is None and time.perf_counter() < deadline:\n"
+        "        d2 = standby.dispatcher\n"
+        "        if d2 is not None and d2.book.granted_total > 0:\n"
+        "            t_grant[0] = time.perf_counter()\n"
+        "            break\n"
+        "        time.sleep(0.02)\n"
+        "watcher = threading.Thread(target=watch, daemon=True)\n"
+        "watcher.start()\n"
+        "got, resume_s = {}, []\n"
+        "outages = {'n': 0}\n"
+        "lock = threading.Lock()\n"
+        "def consume(tag):\n"
+        "    state, t_fail = None, None\n"
+        "    deadline = time.perf_counter() + 120.0\n"
+        "    while time.perf_counter() < deadline:\n"
+        "        r = None\n"
+        "        try:\n"
+        "            r = make_service_reader(\n"
+        "                daddr, job_id='job-chaos', client_id=tag,\n"
+        "                max_units_per_lease=4, hedge_delay_s=30.0,\n"
+        "                control_timeout_ms=2000, unit_timeout_s=15.0,\n"
+        "                resume_state=state)\n"
+        "            for b in r:\n"
+        "                if t_fail is not None:\n"
+        "                    with lock:\n"
+        "                        resume_s.append(time.perf_counter() - t_fail)\n"
+        "                    t_fail = None\n"
+        "                pos = r._consumed[0][-1]\n"
+        "                with lock:\n"
+        "                    got[pos] = {f: getattr(b, f) for f in b._fields}\n"
+        "            r.close()\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            # Outage (dead dispatcher / dead server): remember the\n"
+        "            # cursor and come back as a resumed client -- the\n"
+        "            # state_dict + resync recovery path.\n"
+        "            if t_fail is None:\n"
+        "                t_fail = time.perf_counter()\n"
+        "            with lock:\n"
+        "                outages['n'] += 1\n"
+        "            if r is not None:\n"
+        "                state = r.state_dict()\n"
+        "                r.abandon()\n"
+        "            time.sleep(0.4)\n"
+        "threads = [threading.Thread(target=consume, args=('chaos-c%d' % i,))\n"
+        "           for i in range(2)]\n"
+        "for t in threads:\n"
+        "    t.start()\n"
+        "for t in threads:\n"
+        "    t.join()\n"
+        "watcher.join(timeout=10.0)\n"
+        "install_service_fault_plan(None)\n"
+        "d2 = standby.dispatcher\n"
+        "report = d2.service_report()\n"
+        "cov = report['jobs']['job-chaos']['coverage']\n"
+        "byte_ok = (sorted(got) == list(range(NUM_ITEMS))\n"
+        "           and all(set(got[i]) == set(ref[i])\n"
+        "                   and all(np.array_equal(got[i][k], ref[i][k])\n"
+        "                           for k in ref[i])\n"
+        "                   for i in range(NUM_ITEMS)))\n"
+        "peek = lambda d, name: int(d.telemetry.peek_counter(name))\n"
+        "evicted = (peek(disp, 'service.failover.servers_evicted_total')\n"
+        "           + peek(d2, 'service.failover.servers_evicted_total'))\n"
+        "takeover_recovery = (t_grant[0] - t_kill[0]\n"
+        "                     if t_grant[0] is not None\n"
+        "                     and t_kill[0] is not None else None)\n"
+        "recovery_vals = list(resume_s)\n"
+        "if takeover_recovery is not None:\n"
+        "    recovery_vals.append(takeover_recovery)\n"
+        "recovery_ok = bool(recovery_vals) and max(recovery_vals) <= 2 * TTL\n"
+        "os.makedirs(os.environ['PT_BENCH_SNAPSHOT_DIR'], exist_ok=True)\n"
+        "with open(os.path.join(os.environ['PT_BENCH_SNAPSHOT_DIR'],\n"
+        "                       'chaos_service_epoch.json'), 'w') as f:\n"
+        "    json.dump(d2.telemetry.snapshot(), f, default=str)\n"
+        "standby.stop()\n"
+        "disp.stop()\n"
+        "for s in servers:\n"
+        "    s.stop()\n"
+        "print('BENCHJSON:' + json.dumps({'chaos_service_epoch': {\n"
+        "    'fleet': '1 dispatcher + warm standby, 4 servers, 2 clients',\n"
+        "    'dispatcher_killed': bool(disp.killed),\n"
+        "    'server_killed': bool(servers[1].killed),\n"
+        "    'standby_promoted': bool(standby.promoted.is_set()),\n"
+        "    'standby_takeovers': peek(standby,\n"
+        "                              'service.failover.takeovers_total'),\n"
+        "    'servers_evicted': evicted,\n"
+        "    'journal_replayed_records': peek(\n"
+        "        d2, 'service.failover.replayed_records_total'),\n"
+        "    'refenced_leases': peek(\n"
+        "        d2, 'service.failover.refenced_leases_total'),\n"
+        "    'torn_journal_records': peek(d2, 'journal.torn_records_total'),\n"
+        "    'client_outages': outages['n'],\n"
+        "    'client_resume_s': [round(v, 3) for v in resume_s],\n"
+        "    'takeover_recovery_s': (None if takeover_recovery is None\n"
+        "                            else round(takeover_recovery, 3)),\n"
+        "    'lease_ttl_s': TTL,\n"
+        "    'recovery_within_2_ttl': bool(recovery_ok),\n"
+        "    'byte_identical': bool(byte_ok),\n"
+        "    'coverage_reconciled': bool(cov['reconciled']),\n"
+        "    'coverage_violations': cov['violations']}}))\n")
+    try:
+        out.update(_cpu_subprocess(chaos_child, data_dir, timeout_s=600.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"chaos-service phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4m. RL-replay mixed access (docs/random_access.md): one dataset
     # served BOTH ways at once — a sequential epoch streams batches while a
     # replay sampler fires keyed lookup() calls against the same reader

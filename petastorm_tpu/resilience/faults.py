@@ -27,6 +27,21 @@ site                fired
                     ``pending_retry`` (a torn footer and an injected one
                     classify identically), ``latency`` models a slow
                     footer fetch.
+``service.wire.send`` per service-plane frame send (``key`` = message
+                    type): ``ioerror`` surfaces as ``WireTimeout``,
+                    ``corruption`` as ``WireError``, ``latency`` stalls
+                    the socket. Installed per-process via
+                    ``install_service_fault_plan``.
+``service.wire.recv`` per decoded service-plane frame (``key`` = message
+                    type); same flavors as ``service.wire.send``.
+``server.order``    at the start of each decode-server work order
+                    (``key`` = server id, so ``key_substring`` targets
+                    one fleet member): any fault kills that server
+                    abruptly — sockets closed, no goodbye.
+``dispatcher.kill`` per dispatcher control request (``key`` = message
+                    type): any fault kills the dispatcher abruptly —
+                    socket closed, journal tail NOT flushed, exactly the
+                    crash the journal replay path is built for.
 ==================  ========================================================
 
 Determinism: ``at=N`` fires on exactly the Nth matching access *in this

@@ -13,18 +13,23 @@ The whole plane is optional — importable without pyzmq, gated by
 """
 
 from petastorm_tpu.service.wire import (SERVICE_WIRE_VERSION,
+                                        install_service_fault_plan,
                                         service_available)
 from petastorm_tpu.service.lease import (Lease, LeaseBook,
                                          FleetCoverageLedger)
 from petastorm_tpu.service.scheduler import FairShareScheduler
+from petastorm_tpu.service.journal import (JournalTail, ServiceJournal,
+                                           WarmStandby)
 from petastorm_tpu.service.dispatcher import Dispatcher, ServiceJobSpec
 from petastorm_tpu.service.server import DecodeServer
 from petastorm_tpu.service.client import ServiceReader, make_service_reader
 
 __all__ = [
     "SERVICE_WIRE_VERSION", "service_available",
+    "install_service_fault_plan",
     "Lease", "LeaseBook", "FleetCoverageLedger",
     "FairShareScheduler",
+    "ServiceJournal", "JournalTail", "WarmStandby",
     "Dispatcher", "ServiceJobSpec",
     "DecodeServer",
     "ServiceReader", "make_service_reader",

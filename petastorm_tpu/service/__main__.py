@@ -26,18 +26,52 @@ def _parse_kv(pairs, cast):
 def _cmd_dispatch(args) -> int:
     from petastorm_tpu.service.dispatcher import Dispatcher, load_jobs_config
     jobs = load_jobs_config(args.jobs)
-    dispatcher = Dispatcher(
-        args.bind, jobs=jobs, servers=args.server or (),
+    if args.standby and not args.journal:
+        raise SystemExit("--standby requires --journal (the standby tails "
+                         "the primary's journal)")
+    kwargs = dict(
+        jobs=jobs, servers=args.server or (),
         lease_ttl_s=args.lease_ttl, hedge_delay_s=args.hedge_delay,
         weights=_parse_kv(args.weight, float),
         quotas=_parse_kv(args.quota, int),
+        standby_addr=args.standby_addr,
+        server_heartbeat_s=args.server_heartbeat,
         telemetry_publish=args.telemetry_publish)
+    if args.standby:
+        from petastorm_tpu.service.journal import WarmStandby
+        standby = WarmStandby(args.bind, args.journal,
+                              takeover_silence_s=args.takeover_silence,
+                              **kwargs)
+        standby.start()
+        print(f"warm standby tailing {args.journal}; will bind {args.bind} "
+              f"on primary silence", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(args.status_interval)
+                if standby.promoted.is_set():
+                    d = standby.dispatcher
+                    print(f"PROMOTED: dispatcher up at {args.bind} "
+                          f"(gen {d.gen}, takeover "
+                          f"{standby.takeover_s:.3f}s)", file=sys.stderr)
+                    _watch(d)
+                    return 0
+        except KeyboardInterrupt:
+            pass
+        finally:
+            standby.stop()
+        return 0
+    dispatcher = Dispatcher(args.bind, journal_dir=args.journal, **kwargs)
     dispatcher.start()
     print(f"dispatcher up at {args.bind} ({len(jobs)} job(s), "
           f"gen {dispatcher.gen})", file=sys.stderr)
+    _watch(dispatcher, args.status_interval)
+    return 0
+
+
+def _watch(dispatcher, status_interval: float = 10.0) -> None:
     try:
         while True:
-            time.sleep(args.status_interval)
+            time.sleep(status_interval)
             report = dispatcher.service_report()
             leases = report["leases"]
             print(f"leases active={leases['active']} "
@@ -50,7 +84,6 @@ def _cmd_dispatch(args) -> int:
     finally:
         print(json.dumps(dispatcher.service_report(), indent=2))
         dispatcher.stop()
-    return 0
 
 
 def _cmd_serve(args) -> int:
@@ -58,6 +91,7 @@ def _cmd_serve(args) -> int:
     server = DecodeServer(args.bind, dispatcher_addr=args.dispatcher,
                           server_id=args.server_id,
                           cache_bytes=args.cache_bytes,
+                          heartbeat_s=args.heartbeat_s,
                           telemetry_publish=args.telemetry_publish)
     server.start()
     print(f"decode server {server.server_id} up at {args.bind}",
@@ -105,6 +139,23 @@ def main(argv=None) -> int:
                    help="fair-share weight (repeatable)")
     p.add_argument("--quota", action="append", metavar="TENANT=UNITS",
                    help="per-epoch unit quota (repeatable)")
+    p.add_argument("--journal", default=None, metavar="DIR",
+                   help="durable journal directory (WAL + snapshot); a "
+                        "restarted dispatcher replays it and re-fences "
+                        "in-flight leases")
+    p.add_argument("--standby", action="store_true",
+                   help="run as a warm standby: tail --journal and take "
+                        "over --bind when the primary falls silent")
+    p.add_argument("--standby-addr", default=None,
+                   help="advertised warm-standby address handed to clients "
+                        "in attach_ok for failover")
+    p.add_argument("--takeover-silence", type=float, default=None,
+                   help="standby promotion threshold in seconds of journal "
+                        "silence (default: 1.5 heartbeats)")
+    p.add_argument("--server-heartbeat", type=float, default=2.0,
+                   help="expected decode-server heartbeat cadence; silent "
+                        "servers are evicted after 1.5 intervals (0 "
+                        "disables eviction)")
     p.add_argument("--telemetry-publish", default=None)
     p.add_argument("--status-interval", type=float, default=10.0)
     p.set_defaults(fn=_cmd_dispatch)
@@ -116,6 +167,8 @@ def main(argv=None) -> int:
                    help="dispatcher control address to register with")
     p.add_argument("--server-id", default=None)
     p.add_argument("--cache-bytes", type=int, default=256 << 20)
+    p.add_argument("--heartbeat-s", type=float, default=2.0,
+                   help="dispatcher heartbeat cadence (0 disables)")
     p.add_argument("--telemetry-publish", default=None)
     p.set_defaults(fn=_cmd_serve)
 

@@ -68,6 +68,11 @@ class _LeaseRun:
         self.skipped: List[int] = []
         self.duplicates_dropped = 0
         self.lost = False
+        #: Set when a lease_renew reply re-striped this lease to a new
+        #: primary (its old owner died); the fetch loop re-sends the
+        #: in-flight order to the new owner and the ordinal gate drops
+        #: whatever the old one already delivered.
+        self.moved = False
 
 
 class ServiceReader:
@@ -82,12 +87,26 @@ class ServiceReader:
                  resume_state: Optional[dict] = None,
                  unit_timeout_s: float = DEFAULT_UNIT_TIMEOUT_S,
                  control_timeout_ms: int = DEFAULT_CONTROL_TIMEOUT_MS,
+                 failover_addrs: Optional[List[str]] = None,
                  telemetry_publish: Optional[str] = None,
                  context=None):
         if zmq is None:
             raise RuntimeError("service plane requires pyzmq")
         self.dispatcher_addr = dispatcher_addr
         self.client_id = client_id or f"cli-{uuid.uuid4().hex[:8]}"
+        #: Control-plane candidates in preference order: the primary,
+        #: any explicit ``failover_addrs``, plus the standby address the
+        #: primary advertises in ``attach_ok``. A control RPC that times
+        #: out rotates to the next candidate (and retries until the
+        #: unit-timeout budget) — the takeover path after a dispatcher
+        #: death. With a single candidate there is nowhere to rotate, so
+        #: timeouts surface immediately (the pre-failover behavior).
+        self._candidates: List[str] = [dispatcher_addr]
+        for extra in failover_addrs or ():
+            if extra and extra not in self._candidates:
+                self._candidates.append(extra)
+        self._candidate_idx = 0
+        self._teardown = False
         self._requested_job = job_id
         self._requested_tenant = tenant
         self._max_units = max_units_per_lease
@@ -106,6 +125,10 @@ class ServiceReader:
         self._c_hedges = t.counter("service.client.hedges_total")
         self._c_dups = t.counter("service.client.hedge_duplicates_dropped_total")
         self._c_resyncs = t.counter("service.client.resyncs_total")
+        self._c_failovers = t.counter("service.client.failovers_total")
+        self._c_order_retries = t.counter(
+            "service.client.order_retries_total")
+        self._c_detach_timeouts = t.counter("service.detach_timeouts_total")
 
         self._publisher = None
         if telemetry_publish:
@@ -149,10 +172,49 @@ class ServiceReader:
             self._resync()
 
     # ----------------------------------------------------------- control
+    def _rotate_ctrl(self) -> None:
+        """Swap the control socket to the next dispatcher candidate (the
+        failover path). A fresh DEALER also drops any half-sent request
+        state from the dead primary."""
+        self._candidate_idx = (self._candidate_idx + 1) \
+            % len(self._candidates)
+        addr = self._candidates[self._candidate_idx]
+        old, self._ctrl = self._ctrl, None
+        if old is not None:
+            old.close()
+        self._ctrl = service_socket(self._ctx, zmq.DEALER, connect=addr)
+        self._c_failovers.add(1)
+        logger.info("client %s: control plane failing over to %s",
+                    self.client_id, addr)
+
+    def _control_rpc(self, header: dict) -> dict:
+        """One control round trip with dispatcher failover: a timed-out
+        RPC rotates through the candidate list (primary → standby → ...)
+        until the unit-timeout budget runs out — long enough to ride out
+        a standby takeover, bounded so a dead fleet still surfaces. In
+        teardown, one short attempt and no rotation: teardown must never
+        hang on (or re-attach to) a dying fleet."""
+        if self._teardown:
+            timeout_ms = min(self._control_timeout_ms, 2000)
+            reply, _ = rpc(self._ctrl, header, timeout_ms=timeout_ms)
+            return reply
+        deadline = time.monotonic() + self._unit_timeout_s
+        while True:
+            try:
+                reply, _ = rpc(self._ctrl, header,
+                               timeout_ms=self._control_timeout_ms)
+                return reply
+            except WireTimeout:
+                if len(self._candidates) <= 1 \
+                        or time.monotonic() >= deadline:
+                    raise
+                self._rotate_ctrl()
+
     def _rpc(self, header: dict) -> dict:
-        reply, _ = rpc(self._ctrl, header,
-                       timeout_ms=self._control_timeout_ms)
+        reply = self._control_rpc(header)
         gen = reply.get("gen")
+        if self._teardown:
+            return reply
         if self._gen is not None and gen is not None and gen != self._gen:
             # The dispatcher restarted under us: drop the in-flight lease
             # (its book is gone), re-attach and replay our cursor, then
@@ -170,13 +232,18 @@ class ServiceReader:
         return reply
 
     def _attach(self) -> None:
-        reply, _ = rpc(self._ctrl, {"type": "attach",
-                                    "client_id": self.client_id,
-                                    "job_id": self._requested_job,
-                                    "tenant": self._requested_tenant},
-                       timeout_ms=self._control_timeout_ms)
+        reply = self._control_rpc({"type": "attach",
+                                   "client_id": self.client_id,
+                                   "job_id": self._requested_job,
+                                   "tenant": self._requested_tenant})
         if reply.get("type") != "attach_ok":
             raise ServiceError(f"attach failed: {reply.get('error')}")
+        standby = reply.get("standby")
+        if standby and standby not in self._candidates:
+            # The primary advertises its warm standby: learn it as a
+            # failover candidate so a dispatcher death mid-run rotates
+            # there without any client-side configuration.
+            self._candidates.append(standby)
         if self._job is not None and reply["seed"] != self._job["seed"]:
             logger.warning(
                 "dispatcher re-minted the job seed (%s -> %s): the fleet "
@@ -193,11 +260,10 @@ class ServiceReader:
         if not self._consumed:
             return
         payload = {str(e): sorted(ps) for e, ps in self._consumed.items()}
-        reply, _ = rpc(self._ctrl, {"type": "resync",
-                                    "client_id": self.client_id,
-                                    "job_id": self._job["job_id"],
-                                    "consumed": payload},
-                       timeout_ms=self._control_timeout_ms)
+        reply = self._control_rpc({"type": "resync",
+                                   "client_id": self.client_id,
+                                   "job_id": self._job["job_id"],
+                                   "consumed": payload})
         if reply.get("type") != "resync_ok":
             raise ServiceError(f"resync failed: {reply.get('error')}")
         self._gen = reply.get("gen", self._gen)
@@ -223,6 +289,17 @@ class ServiceReader:
             # Fenced: stop yielding from this lease — the range folds back
             # and another client redelivers it.
             run.lost = True
+            return
+        new_server = reply.get("server")
+        if new_server and new_server != run.server:
+            # The dispatcher re-striped this lease (its owner died): the
+            # fetch loop retries the in-flight order against the new
+            # owner; duplicate units are dropped by ordinal at the gate.
+            logger.info("lease %s re-striped %s -> %s; retrying in-flight "
+                        "order", run.lease_id, run.server, new_server)
+            run.server = new_server
+            run.backup = reply.get("backup")
+            run.moved = True
 
     def _complete_lease(self, run: _LeaseRun,
                         returned: Optional[List[int]] = None) -> None:
@@ -299,6 +376,12 @@ class ServiceReader:
             """Poll all data sockets once, translating unit frames into
             per-lease gate units (rank-indexed)."""
             self._renew_if_due()
+            if run.moved and not run.lost:
+                # Re-striped mid-flight: re-send to the new stripe owner.
+                run.moved = False
+                self._c_order_retries.add(1)
+                order_ids.add(self._send_order(run, run.server))
+                last_progress[0] = time.monotonic()
             timeout_ms = max(50, int(min(hedge_delay, 0.1) * 1000))
             events = dict(self._poller.poll(timeout_ms))  # wire-ok: bounded multi-socket poll; frames drained via recv_msg
             progressed = False
@@ -514,7 +597,13 @@ class ServiceReader:
                 "hedge_duplicates_dropped": int(
                     view.get("service.client.hedge_duplicates_dropped_total",
                              0)),
-                "resyncs": int(view.get("service.client.resyncs_total", 0))}
+                "resyncs": int(view.get("service.client.resyncs_total", 0)),
+                "failovers": int(
+                    view.get("service.client.failovers_total", 0)),
+                "order_retries": int(
+                    view.get("service.client.order_retries_total", 0)),
+                "detach_timeouts": int(
+                    view.get("service.detach_timeouts_total", 0))}
 
     def explain(self, profiled: bool = False):
         """The service pipeline's operator graph (docs/service.md): lease
@@ -572,16 +661,26 @@ class ServiceReader:
 
     # ---------------------------------------------------------- lifecycle
     def stop(self) -> None:
-        """Hand back the in-flight range (clean detach) and stop."""
+        """Hand back the in-flight range (clean detach) and stop.
+
+        Teardown is deliberately lossy-tolerant: a dead or failing-over
+        dispatcher must never turn ``stop()``/``close()`` into a raised
+        :class:`WireTimeout` — the timeout is swallowed (counted on
+        ``service.detach_timeouts_total``) so any original in-flight
+        exception propagating through ``__exit__`` is preserved, and the
+        lease fences itself by expiry anyway."""
         if self._stopped:
             return
         self._stopped = True
+        self._teardown = True
         run = self._run
         if run is not None:
             undelivered = sorted(set(run.positions) - set(run.delivered)
                                  - set(run.skipped))
             try:
                 self._complete_lease(run, returned=undelivered)
+            except WireTimeout:
+                self._c_detach_timeouts.add(1)
             except (WireError, ServiceError):
                 # Best-effort: an unreachable dispatcher fences the lease
                 # by expiry and folds the range back on its own.
@@ -590,6 +689,8 @@ class ServiceReader:
         self._pending_units = []
         try:
             self._rpc({"type": "detach", "client_id": self.client_id})
+        except WireTimeout:
+            self._c_detach_timeouts.add(1)
         except (WireError, _GenerationChanged, ServiceError):
             pass
 
@@ -606,6 +707,12 @@ class ServiceReader:
         if self._ctrl is not None:
             ctrl, self._ctrl = self._ctrl, None
             ctrl.close()
+
+    def close(self) -> None:
+        """One-call teardown: ``stop()`` (clean detach, timeouts
+        swallowed) then ``join()`` (sockets closed)."""
+        self.stop()
+        self.join()
 
     def abandon(self) -> None:
         """Die without detaching — the crash-simulation entry point tests

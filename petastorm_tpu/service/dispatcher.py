@@ -27,10 +27,12 @@ import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from petastorm_tpu.reader_impl.epoch_plan import EpochPlan, mint_seed
+from petastorm_tpu.service.journal import ServiceJournal
 from petastorm_tpu.service.lease import LeaseBook, FleetCoverageLedger
 from petastorm_tpu.service.scheduler import FairShareScheduler
 from petastorm_tpu.service.wire import (WireError, WireTimeout, recv_msg,
-                                        send_msg, service_socket)
+                                        send_msg, service_fault_plan,
+                                        service_socket)
 from petastorm_tpu.telemetry.accounting import AccountingLedger, DEFAULT_TENANT
 
 try:
@@ -53,6 +55,13 @@ SUPPORTED_READER_KWARGS = frozenset({
 DEFAULT_LEASE_TTL_S = 10.0
 DEFAULT_CHUNK = 8
 DEFAULT_HEDGE_DELAY_S = 1.0
+
+#: Decode-server heartbeat cadence the dispatcher expects. A server
+#: quiet past ``SILENCE_AFTER_HEARTBEATS`` (the telemetry fabric's 1.5x
+#: member-silence rule) × this is evicted from the stripe map. Only
+#: servers that have heartbeated at least once are subject to eviction —
+#: statically registered addresses (tests, ``--server``) are exempt.
+DEFAULT_SERVER_HEARTBEAT_S = 2.0
 
 
 class ServiceJobSpec:
@@ -102,6 +111,10 @@ class _Job:
     def __init__(self, spec: ServiceJobSpec):
         self.spec = spec
         self.loaded = False
+        #: Seed recovered from the journal: a restarted dispatcher re-mints
+        #: NOTHING — the replayed seed reproduces the exact pre-crash
+        #: EpochPlan even when the job spec never pinned one.
+        self.replay_seed: Optional[int] = None
         self.seed: Optional[int] = None
         self.num_items = 0
         self.plan: Optional[EpochPlan] = None
@@ -126,8 +139,12 @@ class _Job:
         if self.num_items == 0:
             raise ValueError(f"dataset {self.spec.dataset_url} has no row "
                              "groups to serve")
-        self.seed = (self.spec.seed if self.spec.seed is not None
-                     else mint_seed())
+        if self.spec.seed is not None:
+            self.seed = self.spec.seed
+        elif self.replay_seed is not None:
+            self.seed = self.replay_seed
+        else:
+            self.seed = mint_seed()
         kwargs = self.spec.reader_kwargs
         self.plan = EpochPlan(seed=self.seed, num_items=self.num_items,
                               shuffled=bool(kwargs.get("shuffle_row_groups",
@@ -162,6 +179,9 @@ class Dispatcher:
                  quotas: Optional[Dict[str, int]] = None,
                  scheduler: Optional[FairShareScheduler] = None,
                  telemetry_publish: Optional[str] = None,
+                 journal_dir: Optional[str] = None,
+                 standby_addr: Optional[str] = None,
+                 server_heartbeat_s: float = DEFAULT_SERVER_HEARTBEAT_S,
                  context=None, clock=time.monotonic):
         if zmq is None:
             raise RuntimeError("service plane requires pyzmq")
@@ -169,11 +189,21 @@ class Dispatcher:
         self.gen = uuid.uuid4().hex[:12]
         self.lease_ttl_s = float(lease_ttl_s)
         self.hedge_delay_s = float(hedge_delay_s)
+        self.standby_addr = standby_addr
+        self.server_heartbeat_s = float(server_heartbeat_s)
+        self.killed = False
         self._clock = clock
         self._jobs: Dict[str, _Job] = {}
         for spec in jobs:
             self.add_job(spec)
         self._servers: List[str] = list(servers)
+        #: addr -> last heartbeat (clock time); only heartbeating servers
+        #: are in here, so only they are subject to silence eviction.
+        self._server_seen: Dict[str, float] = {}
+        #: addrs evicted for silence; a heartbeat/hello from one of these
+        #: is a *rejoin*, folded back in at the next lease boundary (it
+        #: re-enters the stripe map for future grants only).
+        self._down: set = set()
         self._rr = 0
         self.book = LeaseBook(ttl_s=self.lease_ttl_s, clock=clock)
         self.accounting = AccountingLedger()
@@ -199,6 +229,11 @@ class Dispatcher:
         self._c_denials = t.counter("service.sched_denials_total")
         self._c_requests = t.counter("service.requests_total")
         self._c_wire_errors = t.counter("service.wire_errors_total")
+        self._c_refenced = t.counter("service.failover.refenced_leases_total")
+        self._c_replayed = t.counter(
+            "service.failover.replayed_records_total")
+        self._c_evicted = t.counter("service.failover.servers_evicted_total")
+        self._c_rejoins = t.counter("service.failover.server_rejoins_total")
         t.gauge("service.leases_active", self.book.active_count)
         t.gauge("service.servers", lambda: len(self._servers))
         t.gauge("service.pending_units",
@@ -218,6 +253,18 @@ class Dispatcher:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
+        #: Durable state (docs/service.md "Fleet survivability"): every
+        #: exactly-once mutation is journaled BEFORE it is applied (the
+        #: ``_j_*`` helpers; enforced by ``tools/check_journal.py``), and
+        #: a dispatcher constructed over a non-empty journal directory
+        #: replays it — restoring minted seeds, coverage, accounting and
+        #: the plan registry, and re-fencing the leases that were in
+        #: flight at the crash.
+        self.journal: Optional[ServiceJournal] = None
+        if journal_dir:
+            self.journal = ServiceJournal(journal_dir, telemetry=t)
+            self._recover()
+
     # ------------------------------------------------------------ lifecycle
     def add_job(self, spec: ServiceJobSpec) -> None:
         self._jobs[spec.job_id] = _Job(spec)
@@ -226,6 +273,326 @@ class Dispatcher:
         with self._lock:
             if addr not in self._servers:
                 self._servers.append(addr)
+
+    # ------------------------------------------------- journaled mutations
+    # Every exactly-once state transition lives in a ``_j_*`` helper that
+    # appends its journal record BEFORE applying the in-memory mutation,
+    # so the WAL always explains at least as much as the state holds. A
+    # crash between append and apply re-applies on replay — every record
+    # is idempotent under the coverage ledger's set semantics.
+    # ``tools/check_journal.py`` lints that lease/ledger/registry
+    # mutations in service/ only happen here (or in ``_replay*``).
+
+    def _append(self, kind: str, record: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, record)
+
+    def _j_job_load(self, job: _Job) -> None:
+        """Load (caller holds the lock) and journal the minted plan —
+        seed + item count are what make a restart byte-identical."""
+        if job.loaded:
+            return
+        job.load()
+        self._append("job_load", {"job_id": job.spec.job_id,
+                                  "seed": job.seed,
+                                  "num_items": job.num_items})
+
+    def _j_grant(self, client_id: str, tenant: str, job: _Job, epoch: int,
+                 positions: List[int], server, backup):
+        lease_id = uuid.uuid4().hex[:12]
+        self._append("grant", {"lease_id": lease_id, "client_id": client_id,
+                               "tenant": tenant,
+                               "job_id": job.spec.job_id, "epoch": epoch,
+                               "positions": positions})
+        lease = self.book.grant(client_id, tenant, job.spec.job_id, epoch,
+                                positions, server=server, backup=backup,
+                                lease_id=lease_id)
+        with self._lock:
+            job.outstanding.add(lease.lease_id)
+        self.scheduler.on_granted(tenant, len(positions), epoch)
+        return lease
+
+    def _j_ack(self, lease, job: _Job, delivered: List[int],
+               skipped: List[int], returned: List[int], dup: int,
+               totals: Optional[dict]) -> int:
+        """Journal + apply one acknowledged lease (the lease is already
+        popped from the book — popping is the fence)."""
+        self._append("ack", {"lease_id": lease.lease_id,
+                             "client_id": lease.client_id,
+                             "tenant": lease.tenant, "job_id": lease.job_id,
+                             "epoch": lease.epoch, "delivered": delivered,
+                             "skipped": skipped, "returned": returned,
+                             "dup": dup, "accounting": totals})
+        added = job.coverage.account(lease.epoch, lease.client_id,
+                                     delivered, skipped, dup)
+        with self._lock:
+            job.outstanding.discard(lease.lease_id)
+            if returned:
+                # Fold-back filtered through the coverage ledger under the
+                # lock: a racing resync that already accounted one of these
+                # positions wins — it never re-enters pending.
+                job.fold_back(job.coverage.unaccounted(lease.epoch,
+                                                       returned))
+            self._advance_epoch_locked(job)
+        self.scheduler.on_accounted(lease.tenant,
+                                    len(delivered) + len(skipped))
+        if returned:
+            self.scheduler.on_reclaimed(lease.tenant, len(returned),
+                                        lease.epoch)
+        if isinstance(totals, dict):
+            self.accounting.apply(lease.client_id, lease.tenant, totals,
+                                  member=f"service.client.{lease.client_id}")
+        return added
+
+    def _j_reclaim(self, lease, cause: str) -> None:
+        """Journal + apply one fenced lease (expiry sweep or detach; the
+        lease is already popped from the book). Serialized with client
+        ``resync`` on the dispatcher lock: positions a resync accounted
+        while this lease was dying are filtered out of the fold-back, so
+        they can never be redelivered and double-accounted."""
+        self._append("reclaim", {"lease_id": lease.lease_id,
+                                 "tenant": lease.tenant,
+                                 "job_id": lease.job_id,
+                                 "epoch": lease.epoch,
+                                 "positions": list(lease.positions),
+                                 "cause": cause})
+        job = self._jobs.get(lease.job_id)
+        if job is not None:
+            with self._lock:
+                job.outstanding.discard(lease.lease_id)
+                job.fold_back(job.coverage.unaccounted(lease.epoch,
+                                                       lease.positions))
+        self.scheduler.on_reclaimed(lease.tenant, len(lease.positions),
+                                    lease.epoch)
+
+    def _j_resync(self, job: _Job, client_id: str, consumed: dict) -> int:
+        """Journal + apply one client's consumed-cursor replay. Caller
+        holds the dispatcher lock (the serialization point with the
+        expiry sweep's fold-back)."""
+        self._append("resync", {"job_id": job.spec.job_id,
+                                "client_id": client_id,
+                                "consumed": {str(e): sorted(int(p)
+                                                            for p in ps)
+                                             for e, ps in consumed.items()}})
+        return self._apply_resync_locked(job, client_id, consumed)
+
+    def _apply_resync_locked(self, job: _Job, client_id: str,
+                             consumed: dict) -> int:
+        resynced = 0
+        for epoch_str, positions in consumed.items():
+            epoch = int(epoch_str)
+            positions = [int(p) for p in positions]
+            fresh = job.coverage.resync(epoch, client_id, positions)
+            resynced += len(fresh)
+            if epoch == job.epoch and fresh:
+                pend = set(job.pending)
+                pend.difference_update(fresh)
+                job.pending = sorted(pend)
+            if epoch > job.epoch and not job.done:
+                # The fleet was further along than this incarnation
+                # believed: jump forward, re-planning the rest.
+                job.epoch = epoch
+                job.pending = sorted(set(range(job.num_items))
+                                     - set(fresh))
+        self._advance_epoch_locked(job)
+        return resynced
+
+    def _j_plan_put(self, key: Tuple[str, str], record: dict) -> None:
+        self._append("plan_put", {"fingerprint": key[0],
+                                  "store_type": key[1], "record": record})
+        with self._registry_lock:
+            self._plan_registry[key] = record
+
+    def _j_late_ack(self, job: _Job) -> None:
+        job.coverage.note_late_ack()
+
+    # ------------------------------------------------------------ recovery
+    def _recover(self) -> None:
+        """Replay the journal (snapshot + WAL) into this incarnation.
+        Jobs the previous incarnation had loaded are loaded eagerly here
+        (their minted seed comes from the journal, so the restored
+        EpochPlan is byte-identical); leases in flight at the crash are
+        re-fenced — their unaccounted positions fold back into pending,
+        and their late acks land on the fresh generation as
+        ``lease_lost``. Finishes with a compaction so the next restart
+        replays O(snapshot), not O(history)."""
+        state, records = self.journal.recover()
+        #: lease_id -> {job_id, tenant, epoch, positions} for every lease
+        #: granted but neither acked nor reclaimed yet — re-fenced below.
+        in_flight: Dict[str, dict] = {}
+        replayed = 0
+        if state:
+            self._restore_state(state, in_flight)
+            replayed += 1
+        for rec in records:
+            try:
+                self._replay_record(rec, in_flight)
+                replayed += 1
+            except Exception:  # noqa: BLE001 - best-effort per record
+                logger.exception("journal replay: record %r failed; "
+                                 "skipped", rec.get("kind"))
+        refenced = 0
+        for info in in_flight.values():
+            job = self._jobs.get(info["job_id"])
+            if job is None or not job.loaded:
+                continue
+            with self._lock:
+                job.outstanding.discard(info["lease_id"])
+                job.fold_back(job.coverage.unaccounted(
+                    int(info["epoch"]), info["positions"]))
+                self._advance_epoch_locked(job)
+            refenced += 1
+        if refenced:
+            self._c_refenced.add(refenced)
+        if replayed:
+            self._c_replayed.add(replayed)
+            self.telemetry.record_event(
+                "service.failover.recovered",
+                {"records": replayed, "refenced_leases": refenced,
+                 "gen": self.gen})
+            logger.info("dispatcher recovered from journal: %d record(s) "
+                        "replayed, %d in-flight lease(s) re-fenced (gen "
+                        "%s)", replayed, refenced, self.gen)
+            self.journal.compact(self._dump_state())
+
+    def _restore_state(self, state: dict, in_flight: Dict[str, dict]) -> None:
+        for job_id, js in (state.get("jobs") or {}).items():
+            job = self._jobs.get(job_id)
+            if job is None:
+                logger.warning("journal snapshot names job %r not in this "
+                               "dispatcher's config; ignored", job_id)
+                continue
+            job.replay_seed = js.get("seed")
+            with self._lock:
+                job.load()
+            job.epoch = int(js.get("epoch", 0))
+            job.done = bool(js.get("done", False))
+            job.pending = sorted(int(p) for p in js.get("pending") or ())
+            if js.get("coverage"):
+                job.coverage = FleetCoverageLedger.restore(js["coverage"])
+            for lease_id, info in (js.get("outstanding") or {}).items():
+                job.outstanding.add(lease_id)
+                in_flight[lease_id] = {
+                    "lease_id": lease_id, "job_id": job_id,
+                    "tenant": info.get("tenant", job.spec.tenant),
+                    "epoch": int(info.get("epoch", job.epoch)),
+                    "positions": [int(p)
+                                  for p in info.get("positions") or ()]}
+        for key, record in (state.get("plan_registry") or []):
+            with self._registry_lock:
+                self._plan_registry[tuple(key)] = record
+        if state.get("accounting"):
+            self.accounting.restore(state["accounting"])
+
+    def _replay_record(self, rec: dict, in_flight: Dict[str, dict]) -> None:
+        kind = rec.get("kind")
+        if kind in ("hb", None):
+            return
+        if kind == "job_load":
+            job = self._jobs.get(rec.get("job_id"))
+            if job is None:
+                logger.warning("journal names job %r not in this "
+                               "dispatcher's config; ignored",
+                               rec.get("job_id"))
+                return
+            job.replay_seed = rec.get("seed")
+            with self._lock:
+                job.load()
+            return
+        if kind == "plan_put":
+            with self._registry_lock:
+                self._plan_registry[(rec["fingerprint"],
+                                     rec["store_type"])] = rec["record"]
+            return
+        job = self._jobs.get(rec.get("job_id"))
+        if job is None or not job.loaded:
+            logger.warning("journal %s record for unknown/unloaded job %r; "
+                           "ignored", kind, rec.get("job_id"))
+            return
+        if kind == "grant":
+            positions = [int(p) for p in rec.get("positions") or ()]
+            with self._lock:
+                pend = set(job.pending)
+                pend.difference_update(positions)
+                job.pending = sorted(pend)
+                job.outstanding.add(rec["lease_id"])
+            in_flight[rec["lease_id"]] = {
+                "lease_id": rec["lease_id"], "job_id": rec["job_id"],
+                "tenant": rec.get("tenant", job.spec.tenant),
+                "epoch": int(rec.get("epoch", 0)), "positions": positions}
+            self.scheduler.on_granted(rec.get("tenant", job.spec.tenant),
+                                      len(positions),
+                                      int(rec.get("epoch", 0)))
+        elif kind == "ack":
+            in_flight.pop(rec["lease_id"], None)
+            epoch = int(rec.get("epoch", 0))
+            delivered = [int(p) for p in rec.get("delivered") or ()]
+            skipped = [int(p) for p in rec.get("skipped") or ()]
+            returned = [int(p) for p in rec.get("returned") or ()]
+            job.coverage.account(epoch, rec.get("client_id", "?"),
+                                 delivered, skipped,
+                                 int(rec.get("dup") or 0))
+            with self._lock:
+                job.outstanding.discard(rec["lease_id"])
+                if returned:
+                    job.fold_back(job.coverage.unaccounted(epoch, returned))
+                self._advance_epoch_locked(job)
+            tenant = rec.get("tenant", job.spec.tenant)
+            self.scheduler.on_accounted(tenant,
+                                        len(delivered) + len(skipped))
+            if returned:
+                self.scheduler.on_reclaimed(tenant, len(returned), epoch)
+            totals = rec.get("accounting")
+            if isinstance(totals, dict):
+                self.accounting.apply(
+                    rec.get("client_id", "?"), tenant, totals,
+                    member=f"service.client.{rec.get('client_id', '?')}")
+        elif kind == "reclaim":
+            in_flight.pop(rec["lease_id"], None)
+            epoch = int(rec.get("epoch", 0))
+            positions = [int(p) for p in rec.get("positions") or ()]
+            with self._lock:
+                job.outstanding.discard(rec["lease_id"])
+                job.fold_back(job.coverage.unaccounted(epoch, positions))
+                self._advance_epoch_locked(job)
+            self.scheduler.on_reclaimed(rec.get("tenant", job.spec.tenant),
+                                        len(positions), epoch)
+        elif kind == "resync":
+            with self._lock:
+                self._apply_resync_locked(job, rec.get("client_id", "?"),
+                                          rec.get("consumed") or {})
+        else:
+            logger.warning("journal record kind %r unknown to this build; "
+                           "ignored", kind)
+
+    def _dump_state(self) -> dict:
+        """The compacted-snapshot payload: everything a restart needs for
+        exactly-once (plans, pending, coverage, in-flight leases,
+        accounting, plan registry). Scheduler shares and telemetry
+        counters are deliberately NOT durable — fairness pacing restarts
+        fresh; the exactly-once proof does not."""
+        jobs = {}
+        with self._lock:
+            for job_id, job in self._jobs.items():
+                if not job.loaded:
+                    continue
+                outstanding = {}
+                for lease_id in job.outstanding:
+                    lease = self.book.get(lease_id)
+                    if lease is not None:
+                        outstanding[lease_id] = {
+                            "tenant": lease.tenant, "epoch": lease.epoch,
+                            "positions": list(lease.positions)}
+                jobs[job_id] = {"seed": job.seed,
+                                "num_items": job.num_items,
+                                "epoch": job.epoch, "done": job.done,
+                                "pending": list(job.pending),
+                                "outstanding": outstanding,
+                                "coverage": job.coverage.dump()}
+        with self._registry_lock:
+            registry = [[list(k), v] for k, v in self._plan_registry.items()]
+        return {"jobs": jobs, "plan_registry": registry,
+                "accounting": self.accounting.dump()}
 
     def start(self) -> "Dispatcher":
         if self._thread is not None:
@@ -251,6 +618,11 @@ class Dispatcher:
         if self._sock is not None:
             sock, self._sock = self._sock, None
             sock.close()
+        if self.journal is not None and not self.killed:
+            # Clean shutdown fsyncs the tail; an injected death (chaos)
+            # must NOT — losing the un-fsynced batch is the crash shape
+            # the journal is designed to survive.
+            self.journal.close()
 
     def __enter__(self) -> "Dispatcher":
         if self._thread is None:
@@ -274,6 +646,8 @@ class Dispatcher:
                 self._c_wire_errors.add(1)
                 ident, msg = None, None
             if msg is not None:
+                if self._maybe_die(msg):
+                    return
                 self._c_requests.add(1)
                 try:
                     reply = self._handle(msg)
@@ -291,22 +665,81 @@ class Dispatcher:
             if now - last_sweep >= sweep_every:
                 last_sweep = now
                 self.sweep_expired()
+                self.sweep_servers()
+                if self.journal is not None:
+                    # The heartbeat record doubles as the warm standby's
+                    # liveness signal: journal silence IS primary silence.
+                    self._append("hb", {})
+                    if self.journal.should_compact():
+                        self.journal.compact(self._dump_state())
+
+    def _maybe_die(self, msg: dict) -> bool:
+        """The ``dispatcher.kill`` chaos site: consulted per request
+        (``key`` = request type) so a seeded FaultPlan can kill the
+        dispatcher at exactly the Nth request, deterministically. An
+        injected death is abrupt — no cleanup, no final journal flush;
+        whatever the fsync batch had not yet made durable is the
+        (designed-for) crash loss."""
+        plan = service_fault_plan()
+        if plan is None:
+            return False
+        from petastorm_tpu.resilience.faults import InjectedFault
+        try:
+            plan.fire("dispatcher.kill", key=str(msg.get("type") or ""))
+        except Exception as e:  # noqa: BLE001 - any injected kind kills here
+            if not isinstance(e, InjectedFault):
+                raise
+            logger.warning("dispatcher %s: injected death at "
+                           "dispatcher.kill (%s)", self.gen, e)
+            self.killed = True
+            self._stop.set()
+            sock, self._sock = self._sock, None
+            if sock is not None:
+                sock.close()
+            return True
+        return False
 
     def sweep_expired(self) -> None:
-        """Fence every expired lease and fold its positions back into its
-        job's pending pool (public so tests can sweep without sleeping)."""
-        for lease in self.book.expire():
-            job = self._jobs.get(lease.job_id)
-            if job is not None:
-                with self._lock:
-                    job.outstanding.discard(lease.lease_id)
-                    job.fold_back(lease.positions)
-            self.scheduler.on_reclaimed(lease.tenant, len(lease.positions),
-                                        lease.epoch)
+        """Fence every expired lease and fold its unaccounted positions
+        back into its job's pending pool (public so tests can sweep
+        without sleeping). The pop from the book is the fence; the
+        fold-back runs under the dispatcher lock and is filtered through
+        the coverage ledger, so it serializes against a client resync
+        racing on the same lease (the double-account bug)."""
+        for lease in self.book.expire():  # journal-ok: fence pop; the reclaim transition is journaled per lease in _j_reclaim
+            self._j_reclaim(lease, cause="expired")
             self._c_reclaimed.add(1)
             logger.info("lease %s (client %s) expired; %d positions fold "
                         "back", lease.lease_id, lease.client_id,
                         len(lease.positions))
+
+    def sweep_servers(self) -> None:
+        """Evict decode servers that stopped heartbeating (the telemetry
+        fabric's 1.5-heartbeat member-silence rule). Removal from the
+        registration list re-stripes the ordinal space over the
+        survivors deterministically — every dispatcher computes the same
+        new stripe map from the same surviving list — and the next
+        ``lease_renew`` reply hands clients their range's new owner."""
+        from petastorm_tpu.telemetry.fabric import SILENCE_AFTER_HEARTBEATS
+        if self.server_heartbeat_s <= 0:
+            return
+        limit = SILENCE_AFTER_HEARTBEATS * self.server_heartbeat_s
+        now = self._clock()
+        with self._lock:
+            dead = [a for a in self._servers
+                    if a in self._server_seen
+                    and now - self._server_seen[a] > limit]
+            for addr in dead:
+                self._servers.remove(addr)
+                self._server_seen.pop(addr, None)
+                self._down.add(addr)
+        for addr in dead:
+            self._c_evicted.add(1)
+            self.telemetry.record_event("service.failover.server_evicted",
+                                        {"addr": addr})
+            logger.warning("decode server %s silent > %.1fs; evicted from "
+                           "the stripe map (%d survivor(s))", addr, limit,
+                           len(self._servers))
 
     # ------------------------------------------------------------- handlers
     def _handle(self, msg: dict) -> dict:
@@ -332,7 +765,7 @@ class Dispatcher:
             return {"type": "error",
                     "error": f"no job matches {msg.get('job_id') or msg.get('tenant')!r}"}
         with self._lock:
-            job.load()
+            self._j_job_load(job)
         record = None
         if job.fingerprint is not None:
             with self._registry_lock:
@@ -350,7 +783,8 @@ class Dispatcher:
                 "store_type": job.store_type,
                 "servers": list(self._servers),
                 "lease_ttl_s": self.lease_ttl_s,
-                "hedge_delay_s": self.hedge_delay_s}
+                "hedge_delay_s": self.hedge_delay_s,
+                "standby": self.standby_addr}
 
     def _assign_servers(self, ordinals: Sequence[int] = (),
                         num_items: int = 0,
@@ -416,11 +850,8 @@ class Dispatcher:
             perm = job.plan.permutation(epoch)
             ordinals = [perm[p] for p in positions]
         primary, backup = self._assign_servers(ordinals, job.num_items)
-        lease = self.book.grant(client_id, tenant, job.spec.job_id, epoch,
-                                positions, server=primary, backup=backup)
-        with self._lock:
-            job.outstanding.add(lease.lease_id)
-        self.scheduler.on_granted(tenant, len(positions), epoch)
+        lease = self._j_grant(client_id, tenant, job, epoch, positions,
+                              primary, backup)
         self._c_granted.add(1)
         self._tenant_counter(tenant, "units_granted_total").add(len(positions))
         return {"type": "lease", "lease_id": lease.lease_id, "epoch": epoch,
@@ -439,19 +870,33 @@ class Dispatcher:
                 job.pending = list(range(job.num_items))
 
     def _on_lease_renew(self, msg: dict) -> dict:
-        if self.book.renew(str(msg.get("lease_id"))):
-            self._c_renewed.add(1)
-            return {"type": "renew_ok"}
-        return {"type": "lease_lost"}
+        lease_id = str(msg.get("lease_id"))
+        if not self.book.renew(lease_id):  # journal-ok: renewal only extends the TTL; a restart re-fences in-flight leases regardless
+            return {"type": "lease_lost"}
+        self._c_renewed.add(1)
+        reply = {"type": "renew_ok"}
+        # Re-striping piggybacks on renewal: recompute the lease's stripe
+        # owner against the CURRENT surviving server list. After an
+        # eviction the client sees its range's new owner here, retries
+        # the in-flight order against it, and the ordinal gate drops
+        # whatever the dead (or slow) server already delivered.
+        lease = self.book.get(lease_id)
+        job = self._jobs.get(lease.job_id) if lease is not None else None
+        if job is not None and job.loaded:
+            perm = job.plan.permutation(lease.epoch)
+            ordinals = [perm[p] for p in lease.positions]
+            primary, backup = self._assign_servers(ordinals, job.num_items)
+            reply["server"], reply["backup"] = primary, backup
+        return reply
 
     def _on_lease_complete(self, msg: dict) -> dict:
-        lease = self.book.complete(str(msg.get("lease_id")))
+        lease = self.book.complete(str(msg.get("lease_id")))  # journal-ok: fence pop; the accounted transition is journaled in _j_ack
         if lease is None:
             # Fenced: expired (and possibly re-leased) before the ack.
             self._c_late.add(1)
             job = self._jobs.get(msg.get("job_id"))
             if job is not None and job.coverage is not None:
-                job.coverage.note_late_ack()
+                self._j_late_ack(job)
             return {"type": "lease_lost"}
         job = self._jobs[lease.job_id]
         delivered = [int(p) for p in msg.get("delivered") or ()]
@@ -463,24 +908,11 @@ class Dispatcher:
                     - set(returned))
         returned = sorted(set(returned) | leftover)
         dup = int(msg.get("duplicates_dropped") or 0)
-        added = job.coverage.account(lease.epoch, lease.client_id,
-                                     delivered, skipped, dup)
+        totals = msg.get("accounting")
+        added = self._j_ack(lease, job, delivered, skipped, returned, dup,
+                            totals if isinstance(totals, dict) else None)
         if added:
             self._c_violations.add(added)
-        with self._lock:
-            job.outstanding.discard(lease.lease_id)
-            if returned:
-                job.fold_back(returned)
-            self._advance_epoch_locked(job)
-        self.scheduler.on_accounted(lease.tenant,
-                                    len(delivered) + len(skipped))
-        if returned:
-            self.scheduler.on_reclaimed(lease.tenant, len(returned),
-                                        lease.epoch)
-        totals = msg.get("accounting")
-        if isinstance(totals, dict):
-            self.accounting.apply(lease.client_id, lease.tenant, totals,
-                                  member=f"service.client.{lease.client_id}")
         self._c_delivered.add(len(delivered))
         self._c_skipped.add(len(skipped))
         self._tenant_counter(lease.tenant,
@@ -497,43 +929,51 @@ class Dispatcher:
             return {"type": "error", "error": "unknown job"}
         client_id = str(msg.get("client_id"))
         with self._lock:
-            job.load()
-            resynced = 0
-            for epoch_str, positions in (msg.get("consumed") or {}).items():
-                epoch = int(epoch_str)
-                positions = [int(p) for p in positions]
-                fresh = job.coverage.resync(epoch, client_id, positions)
-                resynced += len(fresh)
-                if epoch == job.epoch and fresh:
-                    pend = set(job.pending)
-                    pend.difference_update(fresh)
-                    job.pending = sorted(pend)
-                if epoch > job.epoch and not job.done:
-                    # The fleet was further along than this incarnation
-                    # believed: jump forward, re-planning the rest.
-                    job.epoch = epoch
-                    job.pending = sorted(set(range(job.num_items))
-                                         - set(fresh))
-            self._advance_epoch_locked(job)
+            self._j_job_load(job)
+            resynced = self._j_resync(job, client_id,
+                                      msg.get("consumed") or {})
         return {"type": "resync_ok", "resynced": resynced}
 
     def _on_detach(self, msg: dict) -> dict:
         client_id = str(msg.get("client_id"))
-        for lease in self.book.release_client(client_id):
-            job = self._jobs.get(lease.job_id)
-            if job is not None:
-                with self._lock:
-                    job.outstanding.discard(lease.lease_id)
-                    job.fold_back(lease.positions)
-            self.scheduler.on_reclaimed(lease.tenant, len(lease.positions),
-                                        lease.epoch)
+        for lease in self.book.release_client(client_id):  # journal-ok: fence pop; the reclaim transition is journaled per lease in _j_reclaim
+            self._j_reclaim(lease, cause="detach")
         return {"type": "ok"}
+
+    def _note_server_alive(self, addr: str, heartbeat: bool) -> None:
+        """Shared hello/heartbeat bookkeeping: (re)register, stamp
+        liveness, and fold an evicted server back in. Rejoin happens at
+        a lease boundary by construction — re-entering the registration
+        list only affects *future* ``_assign_servers`` calls; live
+        leases keep the owner they were granted with."""
+        now = self._clock()
+        rejoined = False
+        with self._lock:
+            if addr in self._down:
+                self._down.discard(addr)
+                rejoined = True
+            if addr not in self._servers:
+                self._servers.append(addr)
+            if heartbeat:
+                self._server_seen[addr] = now
+        if rejoined:
+            self._c_rejoins.add(1)
+            self.telemetry.record_event("service.failover.server_rejoined",
+                                        {"addr": addr})
+            logger.info("decode server %s rejoined the stripe map", addr)
 
     def _on_server_hello(self, msg: dict) -> dict:
         addr = msg.get("addr")
         if addr:
-            self.register_server(str(addr))
+            self._note_server_alive(str(addr), heartbeat=False)
         return {"type": "server_ok", "servers": list(self._servers)}
+
+    def _on_server_heartbeat(self, msg: dict) -> dict:
+        addr = msg.get("addr")
+        if not addr:
+            return {"type": "error", "error": "heartbeat without addr"}
+        self._note_server_alive(str(addr), heartbeat=True)
+        return {"type": "hb_ok"}
 
     def _on_plan_get(self, msg: dict) -> dict:
         key = (str(msg.get("fingerprint")), str(msg.get("store_type")))
@@ -548,8 +988,7 @@ class Dispatcher:
             return {"type": "error", "error": "malformed plan record"}
         key = (str(msg.get("fingerprint")), str(msg.get("store_type")))
         clean = {k: v for k, v in record.items() if k != "key"}
-        with self._registry_lock:
-            self._plan_registry[key] = clean
+        self._j_plan_put(key, clean)
         return {"type": "plan_ok"}
 
     def _on_status(self, msg: dict) -> dict:
@@ -583,6 +1022,11 @@ class Dispatcher:
             "gen": self.gen,
             "jobs": jobs,
             "servers": list(self._servers),
+            "down_servers": sorted(self._down),
+            "standby": self.standby_addr,
+            "journal": (None if self.journal is None
+                        else {"dir": self.journal.directory,
+                              "wal_records": self.journal.wal_records}),
             "leases": {"active": self.book.active_count(),
                        "granted": self.book.granted_total,
                        "renewed": self.book.renewed_total,

@@ -1,0 +1,403 @@
+"""Durable dispatcher state: append-only journal + warm standby.
+
+The PR 17 dispatcher owned the fleet's exactly-once proof — lease book,
+coverage ledger, accounting bill, plan registry — entirely in memory, so
+a dispatcher restart evaporated it. This module makes that state
+*survive*: a :class:`ServiceJournal` is an append-only, fsync-batched
+JSON-lines write-ahead log (plus a periodically compacted snapshot)
+that the dispatcher writes **before** applying any durable mutation
+(lease grant/ack/reclaim, coverage merge, accounting delta, plan-
+registry put; enforced by ``tools/check_journal.py``). A restarted
+dispatcher replays it, re-fences the in-flight leases (their positions
+fold back into pending, their late acks get ``lease_lost``), bumps its
+generation, and resumes the *same* minted :class:`EpochPlan` — the
+journal records the minted seed, so the post-restart fleet stream stays
+byte-identical even when the job never pinned one.
+
+Crash semantics: appends are flushed per record and fsynced every
+``fsync_every`` records (and always at compaction), so a crash loses at
+most the tail of un-fsynced records — each of which describes work the
+fleet will simply redo (an unjournaled grant is a lease the restarted
+dispatcher never honors; the client's ack gets ``lease_lost`` and the
+range is redelivered — exactly-once holds because accounting follows
+the journal, not the wire). A torn final line (the classic crash
+artifact) is dropped and counted; a torn line anywhere *else* is
+corruption and trips the ``journal.torn_records_total`` SLO.
+
+:class:`WarmStandby` is the failover half: a second ``dispatch
+--standby`` process tails the same journal, tracks the primary's
+heartbeat records, and on primary silence replays everything it has and
+binds its own control address as a fresh generation. Clients carry the
+standby address (advertised in ``attach_ok``) and rotate to it when the
+primary stops answering — re-attach + resync is the same machinery a
+plain dispatcher restart exercises.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+JOURNAL_FORMAT = "petastorm-tpu.service-journal.v1"
+
+WAL_NAME = "journal.jsonl"
+SNAPSHOT_NAME = "journal.snapshot.json"
+
+#: fsync once per this many appended records (1 = every record). The
+#: batch bound is the maximum work a power loss can un-journal.
+DEFAULT_FSYNC_EVERY = 8
+
+#: Compact once the WAL holds this many records: write one snapshot of
+#: the dispatcher's durable state and truncate the log, so replay cost
+#: stays O(snapshot + tail), not O(history).
+DEFAULT_COMPACT_EVERY = 4096
+
+
+class JournalError(RuntimeError):
+    """Unreadable or corrupt journal (torn mid-log record, bad format)."""
+
+
+class ServiceJournal:
+    """One dispatcher's write-ahead log. Thread-safe appends.
+
+    ``telemetry`` (a registry) is optional; when present the journal
+    maintains the ``journal.*`` counter family (docs/observability.md).
+    """
+
+    def __init__(self, directory: str, *,
+                 fsync_every: int = DEFAULT_FSYNC_EVERY,
+                 compact_every: int = DEFAULT_COMPACT_EVERY,
+                 telemetry=None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.wal_path = os.path.join(directory, WAL_NAME)
+        self.snapshot_path = os.path.join(directory, SNAPSHOT_NAME)
+        self.fsync_every = max(1, int(fsync_every))
+        self.compact_every = max(1, int(compact_every))
+        self._lock = threading.Lock()
+        self._fh = None
+        self._since_fsync = 0
+        self._wal_records = 0
+
+        t = telemetry
+        self._c_records = t.counter("journal.records_total") if t else None
+        self._c_fsyncs = t.counter("journal.fsyncs_total") if t else None
+        self._c_compactions = (t.counter("journal.compactions_total")
+                               if t else None)
+        self._c_torn = t.counter("journal.torn_records_total") if t else None
+        self._c_torn_tail = (t.counter("journal.torn_tail_total")
+                             if t else None)
+        if t is not None:
+            t.gauge("journal.wal_records", lambda: self._wal_records)
+
+    # --------------------------------------------------------------- write
+    def _open(self):
+        if self._fh is None:
+            self._fh = open(self.wal_path, "a", encoding="utf-8")
+        return self._fh
+
+    def append(self, kind: str, record: Optional[dict] = None) -> None:
+        """Durably log one event. Write+flush per record; fsync batched
+        (every ``fsync_every`` records) — the WAL is written *before*
+        the in-memory mutation it describes, so replay can only ever
+        see state the log explains."""
+        row = dict(record or ())
+        row["kind"] = kind
+        line = json.dumps(row, sort_keys=True, default=str)
+        with self._lock:
+            fh = self._open()
+            fh.write(line + "\n")
+            fh.flush()
+            self._wal_records += 1
+            self._since_fsync += 1
+            if self._c_records is not None:
+                self._c_records.add(1)
+            if self._since_fsync >= self.fsync_every:
+                self._fsync_locked()
+
+    def _fsync_locked(self) -> None:
+        try:
+            os.fsync(self._fh.fileno())
+        except OSError:  # pragma: no cover - fs without fsync (tmpfs quirk)
+            pass
+        self._since_fsync = 0
+        if self._c_fsyncs is not None:
+            self._c_fsyncs.add(1)
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None and self._since_fsync:
+                self._fh.flush()
+                self._fsync_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                if self._since_fsync:
+                    self._fh.flush()
+                    self._fsync_locked()
+                self._fh.close()
+                self._fh = None
+
+    @property
+    def wal_records(self) -> int:
+        with self._lock:
+            return self._wal_records
+
+    # ------------------------------------------------------------- compact
+    def should_compact(self) -> bool:
+        with self._lock:
+            return self._wal_records >= self.compact_every
+
+    def compact(self, state: dict) -> None:
+        """Write one compacted snapshot of the dispatcher's durable state
+        and truncate the WAL. Atomic: the snapshot lands via tmp+rename
+        (fsynced) before the log is cut, so a crash at any point leaves
+        either the old (snapshot, full WAL) or the new (snapshot, empty
+        WAL) — never a gap."""
+        doc = {"format": JOURNAL_FORMAT, "state": state}
+        tmp = self.snapshot_path + ".tmp"
+        with self._lock:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, sort_keys=True, default=str)
+                f.flush()
+                try:
+                    os.fsync(f.fileno())
+                except OSError:  # pragma: no cover
+                    pass
+            os.replace(tmp, self.snapshot_path)
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = open(self.wal_path, "w", encoding="utf-8")
+            self._fh.flush()
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:  # pragma: no cover
+                pass
+            self._wal_records = 0
+            self._since_fsync = 0
+            if self._c_compactions is not None:
+                self._c_compactions.add(1)
+
+    # -------------------------------------------------------------- replay
+    def recover(self) -> Tuple[Optional[dict], List[dict]]:
+        """Read back ``(snapshot_state, wal_records)`` for replay. A torn
+        *final* WAL line is the expected crash artifact: dropped and
+        counted (``journal.torn_tail_total``). A torn line anywhere else
+        means the log was damaged after the fact — counted on the
+        ``journal.torn_records_total`` SLO and skipped, so recovery is
+        best-effort rather than wedged."""
+        state = None
+        if os.path.exists(self.snapshot_path):
+            with open(self.snapshot_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("format") != JOURNAL_FORMAT:
+                raise JournalError(
+                    f"journal snapshot format {doc.get('format')!r} "
+                    f"(this build reads {JOURNAL_FORMAT})")
+            state = doc.get("state")
+        records: List[dict] = []
+        torn: List[int] = []
+        n_lines = 0
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+            n_lines = len(lines)
+            for i, line in enumerate(lines):
+                if not line.strip():
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    torn.append(i)
+        for i in torn:
+            if i == n_lines - 1:
+                if self._c_torn_tail is not None:
+                    self._c_torn_tail.add(1)
+                logger.warning("journal %s: torn final record dropped "
+                               "(crash artifact)", self.wal_path)
+            else:
+                if self._c_torn is not None:
+                    self._c_torn.add(1)
+                logger.error("journal %s: torn record at line %d (mid-log "
+                             "corruption)", self.wal_path, i + 1)
+        with self._lock:
+            self._wal_records = len(records)
+        return state, records
+
+
+class JournalTail:
+    """Incremental reader over another process's live journal (the warm
+    standby's view). ``poll()`` returns records appended since the last
+    call; a compaction (WAL truncated under us) restarts the tail from
+    the new snapshot."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.wal_path = os.path.join(directory, WAL_NAME)
+        self.snapshot_path = os.path.join(directory, SNAPSHOT_NAME)
+        self._offset = 0
+        self._carry = ""
+        self.snapshot_state: Optional[dict] = None
+        self.records: List[dict] = []
+
+    def _load_snapshot(self) -> None:
+        if not os.path.exists(self.snapshot_path):
+            return
+        try:
+            with open(self.snapshot_path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):  # mid-rename; next poll rereads
+            return
+        if doc.get("format") == JOURNAL_FORMAT:
+            self.snapshot_state = doc.get("state")
+
+    def poll(self) -> List[dict]:
+        """New complete records since the last poll (empty when quiet)."""
+        if not os.path.exists(self.wal_path):
+            return []
+        size = os.path.getsize(self.wal_path)
+        if size < self._offset:
+            # Compacted under us: state moved into the snapshot, WAL
+            # restarted. Reset and re-anchor.
+            self._load_snapshot()
+            self.records = []
+            self._offset = 0
+            self._carry = ""
+        if size == self._offset:
+            return []
+        with open(self.wal_path, encoding="utf-8") as f:
+            f.seek(self._offset)
+            chunk = f.read()
+            self._offset = f.tell()
+        text = self._carry + chunk
+        lines = text.split("\n")
+        self._carry = lines.pop()  # incomplete tail (possibly "")
+        fresh: List[dict] = []
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                fresh.append(json.loads(line))
+            except ValueError:
+                logger.warning("journal tail: undecodable record skipped")
+        self.records.extend(fresh)
+        return fresh
+
+
+#: Primary-silence threshold, in heartbeat periods, before a standby
+#: takes over — same 1.5x rule as the telemetry fabric's member-silence
+#: detector (petastorm_tpu/telemetry/fabric.py), but measured on journal
+#: heartbeat *records* so it needs no extra channel. A hair above one
+#: missed beat: crash detection within two heartbeats, no flapping on a
+#: single slow write.
+TAKEOVER_AFTER_HEARTBEATS = 1.5
+
+
+class WarmStandby:
+    """``service dispatch --standby``: tail the primary's journal, take
+    over on its silence.
+
+    The standby holds a fully-configured (but unstarted, unbound)
+    dispatcher spec. Its thread tails the journal; every record —
+    heartbeats included — proves the primary alive. When the journal
+    goes quiet past ``takeover_silence_s`` the standby *promotes*:
+    constructs a dispatcher over the same journal directory (which
+    replays snapshot + WAL exactly as a plain restart would), binds its
+    own address as a fresh generation, and serves. Clients that learned
+    the standby address from ``attach_ok`` rotate to it on primary
+    timeout; re-attach + resync restores their cursors.
+    """
+
+    def __init__(self, addr: str, journal_dir: str, *,
+                 heartbeat_s: float = 1.0,
+                 takeover_silence_s: Optional[float] = None,
+                 dispatcher_factory: Optional[Callable] = None,
+                 clock=time.monotonic,
+                 **dispatcher_kwargs):
+        self.addr = addr
+        self.journal_dir = journal_dir
+        self.heartbeat_s = float(heartbeat_s)
+        self.takeover_silence_s = (
+            float(takeover_silence_s) if takeover_silence_s is not None
+            else TAKEOVER_AFTER_HEARTBEATS * self.heartbeat_s)
+        self._factory = dispatcher_factory
+        self._kwargs = dict(dispatcher_kwargs)
+        self._clock = clock
+        self._tail = JournalTail(journal_dir)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.promoted = threading.Event()
+        self.dispatcher = None
+        self.takeover_s: Optional[float] = None
+
+        from petastorm_tpu.telemetry import make_registry
+        self.telemetry = make_registry()
+        self._c_takeovers = self.telemetry.counter(
+            "service.failover.takeovers_total")
+        self.telemetry.gauge("service.failover.takeover_s",
+                             lambda: self.takeover_s or 0.0)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "WarmStandby":
+        if self._thread is not None:
+            raise RuntimeError("WarmStandby already started")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="petastorm-tpu-svc-standby")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
+
+    def __enter__(self) -> "WarmStandby":
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- tailing
+    def _run(self) -> None:
+        last_activity = self._clock()
+        poll_s = max(0.02, min(0.25, self.heartbeat_s / 4.0))
+        while not self._stop.is_set():
+            if self._tail.poll():  # wire-ok, timeout-ok: JournalTail.poll is a non-blocking WAL file read, not a socket
+                last_activity = self._clock()
+            quiet_s = self._clock() - last_activity
+            if quiet_s > self.takeover_silence_s:
+                detected = self._clock()
+                logger.warning("standby %s: primary journal quiet %.3fs "
+                               "(> %.3fs); taking over", self.addr,
+                               quiet_s, self.takeover_silence_s)
+                self._promote()
+                self.takeover_s = self._clock() - detected
+                return
+            self._stop.wait(poll_s)
+
+    def _promote(self) -> None:
+        """Replay the tailed journal and come up as the new primary."""
+        if self._factory is not None:
+            self.dispatcher = self._factory(self.addr, self.journal_dir)
+        else:
+            from petastorm_tpu.service.dispatcher import Dispatcher
+            self.dispatcher = Dispatcher(self.addr,
+                                         journal_dir=self.journal_dir,
+                                         **self._kwargs)
+        self.dispatcher.start()
+        self._c_takeovers.add(1)
+        self.telemetry.record_event(
+            "service.failover.takeover",
+            {"addr": self.addr, "gen": self.dispatcher.gen})
+        self.promoted.set()

@@ -85,10 +85,14 @@ class LeaseBook:
 
     def grant(self, client_id: str, tenant: str, job_id: str, epoch: int,
               positions: Sequence[int], server: Optional[str] = None,
-              backup: Optional[str] = None) -> Lease:
+              backup: Optional[str] = None,
+              lease_id: Optional[str] = None) -> Lease:
+        """``lease_id`` may be pre-minted by the caller — the journaled
+        dispatcher writes the grant record (id included) to the WAL
+        before this book ever sees the lease."""
         now = self._clock()
-        lease = Lease(uuid.uuid4().hex[:12], client_id, tenant, job_id,
-                      epoch, sorted(positions), server, backup,
+        lease = Lease(lease_id or uuid.uuid4().hex[:12], client_id, tenant,
+                      job_id, epoch, sorted(positions), server, backup,
                       granted_at=now, expires_at=now + self.ttl_s)
         with self._lock:
             self._active[lease.lease_id] = lease
@@ -220,9 +224,54 @@ class FleetCoverageLedger:
             state["delivered"].update(fresh)
             return fresh
 
+    def unaccounted(self, epoch: int, positions: Sequence[int]) -> List[int]:
+        """The subset of ``positions`` not yet delivered or skip-accounted
+        in this epoch — the fold-back filter. Every dispatcher fold-back
+        (expiry sweep, detach, ack leftovers) routes through this under
+        the dispatcher's lock so it serializes against a racing client
+        ``resync``: a position the resync already accounted can never
+        re-enter the pending pool and be double-accounted on
+        redelivery."""
+        with self._lock:
+            state = self._epochs.get(epoch)
+            if state is None:
+                return sorted(int(p) for p in positions)
+            return sorted(int(p) for p in positions
+                          if p not in state["delivered"]
+                          and p not in state["skipped"])
+
     def note_late_ack(self) -> None:
         with self._lock:
             self.late_acks += 1
+
+    def dump(self) -> dict:
+        """JSON-safe full state for the dispatcher journal's compacted
+        snapshot; inverse of :meth:`restore`."""
+        with self._lock:
+            return {
+                "planned_per_epoch": self.planned_per_epoch,
+                "violations": self.violations,
+                "duplicates": self.duplicates,
+                "late_acks": self.late_acks,
+                "epochs": {str(e): {"delivered": sorted(s["delivered"]),
+                                    "skipped": sorted(s["skipped"]),
+                                    "clients": sorted(s["clients"])}
+                           for e, s in self._epochs.items()},
+            }
+
+    @classmethod
+    def restore(cls, dumped: dict) -> "FleetCoverageLedger":
+        ledger = cls(int(dumped.get("planned_per_epoch", 0)))
+        ledger.violations = int(dumped.get("violations", 0))
+        ledger.duplicates = int(dumped.get("duplicates", 0))
+        ledger.late_acks = int(dumped.get("late_acks", 0))
+        for epoch_str, s in (dumped.get("epochs") or {}).items():
+            ledger._epochs[int(epoch_str)] = {
+                "delivered": set(int(p) for p in s.get("delivered") or ()),
+                "skipped": set(int(p) for p in s.get("skipped") or ()),
+                "clients": set(s.get("clients") or ()),
+            }
+        return ledger
 
     def accounted(self, epoch: int) -> int:
         with self._lock:

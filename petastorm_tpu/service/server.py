@@ -28,8 +28,9 @@ from typing import Dict, List, Optional, Tuple
 
 from petastorm_tpu.reader_impl.arrow_table_serializer import \
     ArrowTableSerializer
-from petastorm_tpu.service.wire import (WireError, WireTimeout, recv_msg,
-                                        rpc, send_msg, service_socket)
+from petastorm_tpu.service.wire import (WireError, WireTimeout, next_req_id,
+                                        recv_msg, rpc, send_msg,
+                                        service_fault_plan, service_socket)
 
 try:
     import zmq
@@ -39,6 +40,10 @@ except ImportError:  # pragma: no cover - pyzmq is an install-time dep
 logger = logging.getLogger(__name__)
 
 DEFAULT_CACHE_BYTES = 256 << 20
+
+#: Heartbeat cadence to the dispatcher (matches the dispatcher's
+#: ``server_heartbeat_s`` expectation); 0 disables heartbeating.
+DEFAULT_HEARTBEAT_S = 2.0
 
 
 class _BufferCache:
@@ -90,6 +95,7 @@ class DecodeServer:
                  server_id: Optional[str] = None, *,
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
                  stall_s: float = 0.0,
+                 heartbeat_s: float = DEFAULT_HEARTBEAT_S,
                  extra_reader_kwargs: Optional[dict] = None,
                  plan_cache_dir: Optional[str] = None,
                  telemetry_publish: Optional[str] = None,
@@ -100,6 +106,10 @@ class DecodeServer:
         self.dispatcher_addr = dispatcher_addr
         self.server_id = server_id or f"srv-{uuid.uuid4().hex[:8]}"
         self.stall_s = float(stall_s)
+        self.heartbeat_s = float(heartbeat_s)
+        #: True after an injected ``server.order`` death (the server is
+        #: gone as far as the fleet can tell: no heartbeats, no replies).
+        self.killed = False
         self.extra_reader_kwargs = dict(extra_reader_kwargs or {})
         self.plan_cache_dir = plan_cache_dir
         self.cache = _BufferCache(cache_bytes)
@@ -114,6 +124,7 @@ class DecodeServer:
         self._c_skips = t.counter("service.server.units_skipped_total")
         self._c_send_timeouts = t.counter("service.server.send_timeouts_total")
         self._c_wire_errors = t.counter("service.wire_errors_total")
+        self._c_heartbeats = t.counter("service.server.heartbeats_total")
         t.gauge("service.server.cache_bytes", lambda: self.cache.bytes)
         t.gauge("service.server.cache_hits", lambda: self.cache.hits)
 
@@ -177,8 +188,35 @@ class DecodeServer:
         self.stop()
 
     # ------------------------------------------------------------- the loop
+    def _heartbeat(self) -> None:
+        """Fire-and-forget liveness ping on the dispatcher DEALER (the
+        health plane's detection signal); replies are drained so the
+        pipe never fills."""
+        if self._disp is None:
+            return
+        try:
+            send_msg(self._disp, {"type": "server_heartbeat",
+                                  "addr": self.addr,
+                                  "server_id": self.server_id,
+                                  "req_id": next_req_id()})
+            self._c_heartbeats.add(1)
+        except WireError:
+            pass  # dispatcher down/failing over: keep beating; the new
+            #       primary picks us back up
+        while True:
+            try:
+                recv_msg(self._disp, timeout_ms=0)
+            except WireError:  # includes WireTimeout = drained
+                break
+
     def _run(self) -> None:
+        last_hb = 0.0
         while not self._stop.is_set():
+            if self.heartbeat_s > 0 and self._disp is not None:
+                now = time.monotonic()
+                if now - last_hb >= self.heartbeat_s:
+                    last_hb = now
+                    self._heartbeat()
             try:
                 ident, msg, _ = recv_msg(self._sock, timeout_ms=100,
                                          routed=True)
@@ -315,7 +353,36 @@ class DecodeServer:
                 skipped.append(ordinal)
         return decoded, skipped
 
+    def _maybe_die(self, order: dict) -> bool:
+        """The ``server.order`` chaos site, consulted as each work order
+        starts (``key`` = this server's id, so a seeded plan can kill one
+        specific fleet member). An injected death is abrupt: sockets
+        close mid-order with no ``order_done``, heartbeats stop, and the
+        dispatcher's silence detector evicts us."""
+        plan = service_fault_plan()
+        if plan is None:
+            return False
+        from petastorm_tpu.resilience.faults import InjectedFault
+        try:
+            plan.fire("server.order", key=self.server_id)
+        except Exception as e:  # noqa: BLE001 - any injected kind kills here
+            if not isinstance(e, InjectedFault):
+                raise
+            logger.warning("server %s: injected death at server.order (%s)",
+                           self.server_id, e)
+            self.killed = True
+            self._stop.set()
+            for sock_name in ("_sock", "_disp"):
+                sock = getattr(self, sock_name)
+                if sock is not None:
+                    setattr(self, sock_name, None)
+                    sock.close()
+            return True
+        return False
+
     def _serve_order(self, ident: bytes, order: dict) -> None:
+        if self._maybe_die(order):
+            return
         self._c_orders.add(1)
         if self.stall_s > 0:
             time.sleep(self.stall_s)

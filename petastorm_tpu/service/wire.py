@@ -28,6 +28,51 @@ SERVICE_WIRE_VERSION = 1
 DEFAULT_SNDTIMEO_MS = 5000
 DEFAULT_HWM = 1000
 
+#: Frame bounds (adversarial-input armor): a header is a small JSON
+#: control record and a payload at most one serialized row-group table.
+#: An oversized frame is a protocol violation (or an attack) — rejected
+#: as a per-connection :class:`WireError`, never buffered into memory
+#: pressure on the dispatcher or a decode server.
+MAX_HEADER_BYTES = 256 << 10
+MAX_PAYLOAD_BYTES = 1 << 30
+
+#: Process-wide seeded chaos hook (docs/resilience.md): when a
+#: :class:`~petastorm_tpu.resilience.faults.FaultPlan` is installed,
+#: every framed send/recv consults the ``service.wire.send`` /
+#: ``service.wire.recv`` sites (``key`` = the header's ``type``). An
+#: injected ``ioerror`` surfaces as :class:`WireTimeout` (the peer-gone
+#: shape every caller already survives), ``corruption`` as
+#: :class:`WireError` (the malformed-frame shape), ``latency`` sleeps in
+#: place — so fleet failure drills are deterministic and replayable.
+_FAULT_PLAN = None
+
+
+def install_service_fault_plan(plan) -> None:
+    """Arm (``FaultPlan``) or disarm (``None``) service chaos for this
+    process. Also consulted by the dispatcher (``dispatcher.kill``) and
+    decode servers (``server.order``) for whole-component deaths."""
+    global _FAULT_PLAN
+    _FAULT_PLAN = plan
+
+
+def service_fault_plan():
+    """The installed chaos plan, or None (component-death site hook)."""
+    return _FAULT_PLAN
+
+
+def _fire(site: str, key) -> None:
+    plan = _FAULT_PLAN
+    if plan is None:
+        return
+    from petastorm_tpu.resilience.faults import (InjectedCorruptionError,
+                                                 InjectedIOError)
+    try:
+        plan.fire(site, key=str(key or ""))
+    except InjectedIOError as e:
+        raise WireTimeout(f"injected wire fault at {site}: {e}") from e
+    except InjectedCorruptionError as e:
+        raise WireError(f"injected wire corruption at {site}: {e}") from e
+
 
 class WireError(Exception):
     """Malformed or version-incompatible service frame."""
@@ -82,8 +127,12 @@ def _encode(header: dict) -> bytes:
 
 
 def _decode(frame: bytes) -> dict:
+    raw = bytes(frame)
+    if len(raw) > MAX_HEADER_BYTES:
+        raise WireError(f"service header of {len(raw)} bytes exceeds the "
+                        f"{MAX_HEADER_BYTES}-byte bound")
     try:
-        header = json.loads(bytes(frame).decode("utf-8"))
+        header = json.loads(raw.decode("utf-8"))
     except (ValueError, UnicodeDecodeError) as e:
         raise WireError(f"undecodable service header: {e!r}")
     if not isinstance(header, dict):
@@ -103,6 +152,7 @@ def send_msg(sock, header: dict, payload: Optional[bytes] = None, *,
     the peer is gone or its pipe is full; callers drop or retry, they
     never block forever.
     """
+    _fire("service.wire.send", header.get("type"))
     frames = []
     if ident is not None:
         frames.append(ident)
@@ -142,7 +192,13 @@ def recv_msg(sock, timeout_ms: Optional[int] = None, *,
     if not frames or len(frames) > 2:
         raise WireError(f"expected [header][payload?], got {len(frames)} frames")
     header = _decode(frames[0])
-    payload = bytes(frames[1]) if len(frames) == 2 else None
+    payload = None
+    if len(frames) == 2:
+        if len(frames[1]) > MAX_PAYLOAD_BYTES:
+            raise WireError(f"service payload of {len(frames[1])} bytes "
+                            f"exceeds the {MAX_PAYLOAD_BYTES}-byte bound")
+        payload = bytes(frames[1])
+    _fire("service.wire.recv", header.get("type"))
     return ident, header, payload
 
 

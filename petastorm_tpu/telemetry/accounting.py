@@ -113,6 +113,35 @@ class AccountingLedger:
                 self._members[key] = member
         return deltas
 
+    def dump(self) -> dict:
+        """JSON-safe full ledger state (totals AND per-key delta
+        baselines) for the service journal's compacted snapshot; inverse
+        of :meth:`restore`. Keeping the baselines is what makes a
+        restored ledger bit-exact: the next cumulative record a client
+        replays differences against the same ``_last`` it would have on
+        the original incarnation."""
+        with self._lock:
+            return {
+                "last": [[list(k), dict(v)] for k, v in self._last.items()],
+                "totals": [[list(k), dict(v)]
+                           for k, v in self._totals.items()],
+                "windows": [[list(k), n] for k, n in self._windows.items()],
+                "members": [[list(k), m] for k, m in self._members.items()],
+            }
+
+    def restore(self, dumped: dict) -> None:
+        with self._lock:
+            self._last = {tuple(k): {f: float(v.get(f, 0.0) or 0.0)
+                                     for f in ACCOUNTING_FIELDS}
+                          for k, v in dumped.get("last") or []}
+            self._totals = {tuple(k): {f: float(v.get(f, 0.0) or 0.0)
+                                       for f in ACCOUNTING_FIELDS}
+                            for k, v in dumped.get("totals") or []}
+            self._windows = {tuple(k): int(n)
+                             for k, n in dumped.get("windows") or []}
+            self._members = {tuple(k): str(m)
+                             for k, m in dumped.get("members") or []}
+
     def forget(self, pipeline_id: str, tenant: Optional[str]) -> None:
         """Drop the per-key delta baseline (a member left); accumulated
         totals are kept — a departed tenant still owes its bill."""

@@ -60,7 +60,8 @@ def default_rules(input_stall_pct: float = 5.0,
                   ingest_lag_s: float = 300.0,
                   max_drift: float = 0.2,
                   coverage_violations: float = 0.0,
-                  index_lookup_p99_s: float = 0.010) -> List[SloRule]:
+                  index_lookup_p99_s: float = 0.010,
+                  torn_journal: float = 0.0) -> List[SloRule]:
     """The documented default rule set (thresholds per the tuning table in
     docs/observability.md). ``ingest_lag_s`` is the live-data freshness
     contract (docs/live_data.md): now minus the newest admitted file's
@@ -103,6 +104,14 @@ def default_rules(input_stall_pct: float = 5.0,
         # has served a call, so epoch-only pipelines skip the rule.
         SloRule("index_lookup_p99_s", "p99", "index.lookup_s",
                 index_lookup_p99_s),
+        # Journal-integrity contract (docs/service.md "Failure modes &
+        # recovery"): a torn line mid-WAL means disk corruption, not a
+        # crash artifact (only the FINAL line can legitimately be torn,
+        # and that one is counted separately as journal.torn_tail_total).
+        # The counter only exists on journaled dispatchers, so other
+        # pipelines skip the rule.
+        SloRule("torn_journal", "counter", "journal.torn_records_total",
+                torn_journal),
     ]
 
 

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Lint guard: exactly-once service state mutates through journal helpers.
+
+The dispatcher's survivability contract (docs/service.md "Failure modes
+& recovery") is write-ahead: every mutation of the lease book, the
+fleet coverage ledger, the plan registry, or the accounting ledger is
+journaled BEFORE it is applied in memory, so a crashed dispatcher
+replays to the exact pre-crash state and re-fences in-flight leases
+with zero coverage violations. One direct ``book.grant(...)`` call
+outside the ``_j_*`` helpers silently forks durable state from memory:
+the restarted dispatcher has no record of the lease, the client's ack
+hits ``lease_lost``, and the epoch's coverage ledger under-counts.
+
+This AST check flags every call of a state-mutating verb (lease-book
+transitions, ledger accounting, accounting applies) and every
+``_plan_registry[...]`` subscript assignment inside
+``petastorm_tpu/service/``, unless it happens where the write-ahead
+discipline lives:
+
+* inside a journal helper (function named ``_j_*``) — these append the
+  journal record first;
+* inside replay/recovery (``_replay*`` / ``_restore*`` / ``_recover*``)
+  — these re-apply records that are already durable;
+* on a line waived with ``# journal-ok: why`` — used for the fence
+  *pops* (``expire`` / ``complete`` / ``release_client`` / ``renew``)
+  whose durable transition is journaled one call later by the ``_j_*``
+  helper consuming the popped lease.
+
+The primitive definitions themselves (``lease.py``, ``journal.py``,
+``scheduler.py``) are exempt — they are the mutations.
+
+Usage::
+
+    python tools/check_journal.py          # lint (exit 1 on violations)
+    python tools/check_journal.py --list   # print every mutation site
+
+Wired into ``make ci-lint``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVICE = os.path.join(ROOT, "petastorm_tpu", "service")
+
+WAIVER = "journal-ok"
+
+#: Files that DEFINE the mutation primitives rather than invoke them.
+_EXEMPT_FILES = {"lease.py", "journal.py", "scheduler.py"}
+
+#: Enclosing-function name prefixes where mutations are legitimate:
+#: journal helpers (write-ahead) and replay/recovery (already durable).
+_ALLOWED_FN_PREFIXES = ("_j_", "_replay", "_restore", "_recover",
+                        "_apply_resync")
+
+#: State-mutating verbs on the lease book / coverage ledger /
+#: accounting ledger. ``renew``/``complete``/``expire``/
+#: ``release_client`` are the fence pops — waivable, since the durable
+#: transition is journaled by the ``_j_*`` helper that consumes the
+#: popped lease.
+_MUTATING_VERBS = {
+    "grant", "renew", "complete", "expire", "release_client",
+    "account", "fold_back", "note_late_ack", "restore",
+    "apply",
+}
+
+#: Attribute/subscript targets whose assignment is durable state.
+_MUTATING_SUBSCRIPTS = {"_plan_registry"}
+
+
+def _fn_ranges(tree):
+    """(start, end, name) for every function def, innermost resolvable
+    by taking the tightest enclosing range."""
+    ranges = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            ranges.append((node.lineno, end, node.name))
+    return ranges
+
+
+def _enclosing_fn(ranges, lineno):
+    best = None
+    for start, end, name in ranges:
+        if start <= lineno <= end:
+            if best is None or (end - start) < (best[1] - best[0]):
+                best = (start, end, name)
+    return best[2] if best else None
+
+
+def _subscript_name(target):
+    if isinstance(target, ast.Subscript):
+        value = target.value
+        if isinstance(value, ast.Attribute):
+            return value.attr
+        if isinstance(value, ast.Name):
+            return value.id
+    return None
+
+
+def _calls(path):
+    """Yield (verb, lineno, fn_name, waived) for every mutation site."""
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    ranges = _fn_ranges(tree)
+    for node in ast.walk(tree):
+        verb = lineno = None
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATING_VERBS):
+                verb, lineno = f".{func.attr}()", node.lineno
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                name = _subscript_name(target)
+                if name in _MUTATING_SUBSCRIPTS:
+                    verb, lineno = f"{name}[...] =", node.lineno
+                    break
+        if verb is None:
+            continue
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        yield (verb, lineno, _enclosing_fn(ranges, lineno) or "<module>",
+               WAIVER in line)
+
+
+def _iter_py_files():
+    if not os.path.isdir(SERVICE):
+        return
+    for dirpath, _dirnames, filenames in os.walk(SERVICE):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and fn not in _EXEMPT_FILES:
+                yield os.path.join(dirpath, fn)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    list_only = "--list" in argv
+    failures = []
+    seen = []
+    for path in _iter_py_files():
+        rel = os.path.relpath(path, ROOT)
+        for verb, lineno, fn_name, waived in _calls(path):
+            allowed = fn_name.startswith(_ALLOWED_FN_PREFIXES)
+            seen.append((rel, lineno, verb, fn_name, waived or allowed))
+            if list_only:
+                continue
+            if not waived and not allowed:
+                failures.append((rel, lineno, verb, fn_name))
+    if list_only:
+        for rel, lineno, verb, fn_name, ok in seen:
+            tag = " (ok)" if ok else " (VIOLATION)"
+            print(f"{rel}:{lineno}: {verb} in {fn_name}{tag}")
+        return 0
+    if failures:
+        print("check_journal: durable service state mutated outside the "
+              "write-ahead journal helpers:", file=sys.stderr)
+        for rel, lineno, verb, fn_name in failures:
+            print(f"  {rel}:{lineno}: {verb} in {fn_name}()",
+                  file=sys.stderr)
+        print(f"{len(failures)} unjournaled mutation(s). Route the "
+              f"transition through a _j_* helper (journal append BEFORE "
+              f"in-memory apply), or — for a fence pop whose transition "
+              f"is journaled by the consuming helper — waive the line "
+              f"with a '# {WAIVER}: why' comment.", file=sys.stderr)
+        return 1
+    ok_n = sum(1 for *_x, ok in seen if ok)
+    print(f"check_journal: {len(seen)} mutation site(s), {ok_n} in "
+          f"journal/replay helpers or waived, all write-ahead")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
