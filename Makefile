@@ -57,6 +57,7 @@ ci-lint:
 	python tools/check_lowering.py
 	python tools/check_wire.py
 	python tools/check_journal.py
+	python tools/check_cachekeys.py
 	# Shipped SLO rules + anomaly detectors, gated against the committed
 	# known-good bench telemetry snapshots (bench.py refreshes them each
 	# run): a rule/detector regression fails the BUILD, not just the bench.
@@ -79,6 +80,12 @@ ci-lint:
 	# server killed mid-epoch — must still hold the exactly-once SLO and
 	# show a clean journal; a failover/replay regression fails the BUILD.
 	python -m petastorm_tpu.telemetry check bench_snapshots/chaos_service_epoch.json --slo "counter:service.coverage_violations_total<=0" --slo "counter:journal.torn_records_total<=0"
+	# Fleet-cache contract (docs/service.md "Fleet cache tier"): the
+	# committed two-tenant 80%-overlap snapshot — one decode server killed
+	# mid-epoch — must stay exactly-once with bounded peer-fetch fallbacks
+	# (a handful of timeouts from the killed server are the designed
+	# degradation; unbounded growth is a directory-invalidation bug).
+	python -m petastorm_tpu.telemetry check bench_snapshots/fleet_cache_epoch.json --slo "counter:service.coverage_violations_total<=0" --slo "counter:service.cache.peer_fetch_timeouts_total<=8"
 
 # Diff the two newest committed round artifacts — both the CPU-bench
 # BENCH_r*.json series and the multi-chip MULTICHIP_r*.json series — and

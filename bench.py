@@ -2162,6 +2162,222 @@ def main():
     except Exception as e:  # noqa: BLE001 - partial bench beats no bench
         print(f"chaos-service phase failed: {e!r}", file=sys.stderr)
 
+    # ---- 4l. fleet cache tier (docs/service.md "Fleet cache tier"): two
+    # tenants whose datasets share 80% of their physical row groups
+    # (symlink-assembled from one file pool, so the content keys prove
+    # the sharing) drain sequential epochs against a 1-dispatcher +
+    # 4-server fleet, with one decode server killed mid-epoch in BOTH
+    # arms. Baseline arm: per-server caches only (peer_fetch off) — the
+    # second tenant re-decodes every shared group that landed on a
+    # different stripe. Fleet arm: content-addressed directory + peer
+    # fetch — tenant B's shared groups are served from tenant A's
+    # resident buffers (decoded-once fleet-wide), so its epoch is
+    # transfer-bound. Gated targets (ROADMAP fleet-cache item): aggregate
+    # throughput >= 1.3x baseline, tenant-B shared-group decodes ~ 0,
+    # byte-identical streams vs the local reference in both arms, and a
+    # warm fleet ServiceReader.lookup() p99 < 25ms through the same
+    # cache. The fleet dispatcher+server telemetry (cache counters
+    # merged) is flushed to bench_snapshots/fleet_cache_epoch.json — the
+    # `make ci-lint` SLO gate artifact (zero coverage violations,
+    # bounded peer-fetch timeouts).
+    fleet_cache_child = (
+        "import json, os, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import pyarrow as pa\n"
+        "import pyarrow.parquet as pq\n"
+        "from petastorm_tpu.reader import make_batch_reader\n"
+        "from petastorm_tpu.index import build_field_index\n"
+        "from petastorm_tpu.resilience.faults import FaultPlan, FaultSpec\n"
+        "from petastorm_tpu.service import (Dispatcher, DecodeServer,\n"
+        "                                   ServiceJobSpec,\n"
+        "                                   install_service_fault_plan,\n"
+        "                                   make_service_reader)\n"
+        "base = os.path.join(os.environ['PT_BENCH_DATA_DIR'], 'fleet_cache')\n"
+        "pool = os.path.join(base, 'pool')\n"
+        "dsa, dsb = os.path.join(base, 'dsA'), os.path.join(base, 'dsB')\n"
+        "NFILES, RG, NCOLS = 24, 1024, 2048\n"
+        "if not os.path.exists(os.path.join(pool, 'f00.parquet')):\n"
+        "    # Decode-heavy shape: many narrow zstd column chunks make the\n"
+        "    # per-group parquet decode (~150ms) dwarf the Arrow-IPC serve\n"
+        "    # (~5ms) -- the regime where a peer fetch beats a re-decode.\n"
+        "    os.makedirs(pool, exist_ok=True)\n"
+        "    rng = np.random.default_rng(20)\n"
+        "    for i in range(NFILES):\n"
+        "        cols = {'id': np.arange(i * RG, (i + 1) * RG,\n"
+        "                                dtype=np.int64)}\n"
+        "        for c in range(NCOLS):\n"
+        "            cols['f%04d' % c] = rng.integers(0, 512, RG)"
+        ".astype(np.int16)\n"
+        "        pq.write_table(pa.table(cols),\n"
+        "                       os.path.join(pool, 'f%02d.parquet' % i),\n"
+        "                       row_group_size=RG, compression='zstd')\n"
+        "    # 80% overlap: A = files 0..19, B = files 4..23, via symlinks\n"
+        "    # to one physical pool (content keys stat the realpath).\n"
+        "    for d, files in ((dsa, range(0, 20)), (dsb, range(4, 24))):\n"
+        "        os.makedirs(d, exist_ok=True)\n"
+        "        for i in files:\n"
+        "            os.symlink(os.path.join(pool, 'f%02d.parquet' % i),\n"
+        "                       os.path.join(d, 'p%02d.parquet' % i))\n"
+        "    build_field_index('file://' + dsa, ['id'])\n"
+        "SEED, pid = 20260807, os.getpid()\n"
+        "ua, ub = 'file://' + dsa, 'file://' + dsb\n"
+        "def local_ref(url):\n"
+        "    out = []\n"
+        "    with make_batch_reader(url, shuffle_row_groups=True, seed=SEED,\n"
+        "                           num_epochs=1,\n"
+        "                           sample_order='deterministic') as r:\n"
+        "        for b in r:\n"
+        "            out.append({f: getattr(b, f) for f in b._fields})\n"
+        "    return out\n"
+        "refa, refb = local_ref(ua), local_ref(ub)\n"
+        "mkjobs = lambda: [ServiceJobSpec('job-a', ua, tenant='ta',\n"
+        "                                 seed=SEED, chunk=4),\n"
+        "                  ServiceJobSpec('job-b', ub, tenant='tb',\n"
+        "                                 seed=SEED, chunk=4)]\n"
+        "def match(got, ref):\n"
+        "    return (len(got) == len(ref)\n"
+        "            and all(set(g) == set(r)\n"
+        "                    and all(np.array_equal(g[k], r[k]) for k in r)\n"
+        "                    for g, r in zip(got, ref)))\n"
+        "def run_arm(tag, peer_fetch):\n"
+        "    daddr = 'ipc:///tmp/pt-fc-%s-d-%d' % (tag, pid)\n"
+        "    saddrs = ['ipc:///tmp/pt-fc-%s-%d-%d' % (tag, i, pid)\n"
+        "              for i in range(4)]\n"
+        "    disp = Dispatcher(daddr, jobs=mkjobs(), lease_ttl_s=30.0,\n"
+        "                      hedge_delay_s=1.0,\n"
+        "                      server_heartbeat_s=2.0).start()\n"
+        "    servers = [DecodeServer(a, dispatcher_addr=daddr,\n"
+        "                            heartbeat_s=0.25, workers=1,\n"
+        "                            peer_fetch=peer_fetch,\n"
+        "                            cache_bytes=1 << 30,\n"
+        "                            server_id=('fc-%s-victim' % tag\n"
+        "                                       if i == 3\n"
+        "                                       else 'fc-%s-%d' % (tag, i))\n"
+        "                            ).start()\n"
+        "               for i, a in enumerate(saddrs)]\n"
+        "    install_service_fault_plan(FaultPlan([\n"
+        "        FaultSpec(site='server.order', kind='ioerror', at=2,\n"
+        "                  key_substring='fc-%s-victim' % tag)], seed=SEED))\n"
+        "    got = {'a': [], 'b': []}\n"
+        "    def consume(cl, job, tenant):\n"
+        "        r = make_service_reader(daddr, job_id=job, tenant=tenant,\n"
+        "                                client_id='%s-%s' % (tag, cl),\n"
+        "                                hedge_delay_s=1.0,\n"
+        "                                unit_timeout_s=30.0)\n"
+        "        try:\n"
+        "            for b in r:\n"
+        "                got[cl].append({f: getattr(b, f)\n"
+        "                                for f in b._fields})\n"
+        "        finally:\n"
+        "            r.join()\n"
+        "    snap_decodes = lambda: {k: n for s in servers\n"
+        "                            for k, n in s.cache.decodes.items()}\n"
+        "    t0 = time.perf_counter()\n"
+        "    consume('a', 'job-a', 'ta')   # tenant A: cold fleet + kill\n"
+        "    ta = time.perf_counter() - t0\n"
+        "    keys_a = set(snap_decodes())\n"
+        "    consume('b', 'job-b', 'tb')   # tenant B: 80% overlap, warm\n"
+        "    sec = time.perf_counter() - t0\n"
+        "    install_service_fault_plan(None)\n"
+        "    rows = sum(len(b['id']) for cl in got for b in got[cl])\n"
+        "    decodes = {}\n"
+        "    for s in servers:\n"
+        "        for k, n in s.cache.decodes.items():\n"
+        "            decodes[k] = decodes.get(k, 0) + n\n"
+        "    return dict(\n"
+        "        sps=rows / sec, secs_a=ta, secs_b=sec - ta,\n"
+        "        byte_ok=match(got['a'], refa) and match(got['b'], refb),\n"
+        "        decodes=sum(decodes.values()), groups=len(decodes),\n"
+        "        max_decodes_per_group=max(decodes.values() or [0]),\n"
+        "        tenant_b_shared_decodes=sum(\n"
+        "            n for k, n in decodes.items() if k in keys_a)\n"
+        "            - sum(1 for k in keys_a),\n"
+        "        peer_hits=sum(s.cache.peer_hits for s in servers),\n"
+        "        timeouts=sum(int(s.telemetry.peek_counter(\n"
+        "            'service.cache.peer_fetch_timeouts_total'))\n"
+        "            for s in servers),\n"
+        "        killed=bool(servers[3].killed),\n"
+        "        disp=disp, servers=servers, daddr=daddr)\n"
+        "bl = run_arm('bl', peer_fetch=False)\n"
+        "bl['disp'].stop()\n"
+        "for s in bl['servers']:\n"
+        "    s.stop()\n"
+        "fc = run_arm('fc', peer_fetch=True)\n"
+        "speedup = fc['sps'] / bl['sps']\n"
+        "# warm fleet point reads through the same cache tier\n"
+        "reader = make_service_reader(fc['daddr'], job_id='job-a',\n"
+        "                             tenant='ta', client_id='fc-lookup')\n"
+        "LCOLS = ['id', 'f0000']\n"
+        "# warming pass: one key per dsA file re-warms the groups the dead\n"
+        "# victim took down (a warm-lookup SLO is about the steady state)\n"
+        "reader.lookup([f * RG + 7 for f in range(20)], field='id',\n"
+        "              columns=LCOLS)\n"
+        "rng = np.random.default_rng(SEED)\n"
+        "ids = rng.integers(0, 20 * RG, 220)\n"
+        "reader.lookup([int(ids[0])], field='id', columns=LCOLS)\n"
+        "lat = []\n"
+        "for k in ids[1:201]:\n"
+        "    t1 = time.perf_counter()\n"
+        "    rows = reader.lookup([int(k)], field='id', columns=LCOLS)\n"
+        "    lat.append(time.perf_counter() - t1)\n"
+        "    assert rows and rows[0]['id'] == int(k)\n"
+        "lat.sort()\n"
+        "p50, p99 = lat[len(lat) // 2], lat[int(len(lat) * 0.99) - 1]\n"
+        "report = fc['disp'].service_report()\n"
+        "cov_ok = all(report['jobs'][j]['coverage']['reconciled']\n"
+        "             for j in ('job-a', 'job-b'))\n"
+        "snap = fc['disp'].telemetry.snapshot()\n"
+        "for s in fc['servers']:\n"
+        "    for name, val in s.telemetry.metrics_view()['counters']"
+        ".items():\n"
+        "        if name.startswith('service.cache.'):\n"
+        "            snap['counters'][name] = (snap['counters']"
+        ".get(name, 0) + val)\n"
+        "snap['counters'].setdefault(\n"
+        "    'service.cache.peer_fetch_timeouts_total', 0)\n"
+        "os.makedirs(os.environ['PT_BENCH_SNAPSHOT_DIR'], exist_ok=True)\n"
+        "with open(os.path.join(os.environ['PT_BENCH_SNAPSHOT_DIR'],\n"
+        "                       'fleet_cache_epoch.json'), 'w') as f:\n"
+        "    json.dump(snap, f, default=str)\n"
+        "reader.close()\n"
+        "fc['disp'].stop()\n"
+        "for s in fc['servers']:\n"
+        "    s.stop()\n"
+        "print('BENCHJSON:' + json.dumps({'fleet_cache_epoch': {\n"
+        "    'fleet': '1 dispatcher + 4 servers, 2 tenants, 80% overlap',\n"
+        "    'baseline_samples_per_sec_aggregate': round(bl['sps'], 1),\n"
+        "    'fleet_cache_samples_per_sec_aggregate': round(fc['sps'], 1),\n"
+        "    'fleet_cache_speedup': round(speedup, 3),\n"
+        "    'speedup_ok': bool(speedup >= 1.3),\n"
+        "    'tenant_secs': {'baseline': [round(bl['secs_a'], 2),\n"
+        "                                 round(bl['secs_b'], 2)],\n"
+        "                    'fleet': [round(fc['secs_a'], 2),\n"
+        "                              round(fc['secs_b'], 2)]},\n"
+        "    'fleet_decodes': fc['decodes'],\n"
+        "    'baseline_decodes': bl['decodes'],\n"
+        "    'distinct_groups': fc['groups'],\n"
+        "    'max_decodes_per_group': fc['max_decodes_per_group'],\n"
+        "    'tenant_b_shared_decodes': {'baseline':\n"
+        "                                bl['tenant_b_shared_decodes'],\n"
+        "                                'fleet':\n"
+        "                                fc['tenant_b_shared_decodes']},\n"
+        "    'peer_hits': fc['peer_hits'],\n"
+        "    'peer_fetch_timeouts': fc['timeouts'],\n"
+        "    'server_killed_mid_epoch': bool(bl['killed']\n"
+        "                                    and fc['killed']),\n"
+        "    'byte_identical': bool(bl['byte_ok'] and fc['byte_ok']),\n"
+        "    'coverage_reconciled': bool(cov_ok),\n"
+        "    'lookup_p50_s': round(p50, 5),\n"
+        "    'lookup_p99_s': round(p99, 5),\n"
+        "    'lookup_ok': bool(p99 < 0.025)}}))\n")
+    try:
+        out.update(_cpu_subprocess(fleet_cache_child, data_dir,
+                                   timeout_s=600.0))
+    except Exception as e:  # noqa: BLE001 - partial bench beats no bench
+        print(f"fleet-cache phase failed: {e!r}", file=sys.stderr)
+
     # ---- 4m. RL-replay mixed access (docs/random_access.md): one dataset
     # served BOTH ways at once — a sequential epoch streams batches while a
     # replay sampler fires keyed lookup() calls against the same reader

@@ -42,7 +42,7 @@ from petastorm_tpu.resilience.quarantine import RowGroupSkipped
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["IndexLookupPlane"]
+__all__ = ["IndexLookupPlane", "matching_offsets"]
 
 
 class IndexLookupPlane:
@@ -161,7 +161,7 @@ class IndexLookupPlane:
             key_col = data.get(field)
             for pos, key, off in wants:
                 if off == GROUP_GRANULAR:
-                    offs = _matching_offsets(key_col, key)
+                    offs = matching_offsets(key_col, key)
                 else:
                     offs = (off,)
                 for o in offs:
@@ -354,10 +354,14 @@ class IndexLookupPlane:
         return data
 
 
-def _matching_offsets(key_col, key) -> List[int]:
+def matching_offsets(key_col, key) -> List[int]:
     """Row offsets whose cell matches ``key`` — the group-granular
     (legacy-bridge) filter. Scalar cells compare through the same typed
-    encoding the index uses; array cells match on membership."""
+    encoding the index uses; array cells match on membership. Public
+    because the service plane's fleet point reads
+    (docs/random_access.md "Serving lookups through the fleet") apply
+    the identical filter server-side, so both planes resolve
+    group-granular entries to the same rows."""
     if key_col is None:
         return []
     want = encode_key(key)

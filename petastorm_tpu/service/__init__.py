@@ -15,6 +15,9 @@ The whole plane is optional — importable without pyzmq, gated by
 from petastorm_tpu.service.wire import (SERVICE_WIRE_VERSION,
                                         install_service_fault_plan,
                                         service_available)
+from petastorm_tpu.service.fleet_cache import (ContentKeyer,
+                                               FleetBufferCache,
+                                               content_keyer_for)
 from petastorm_tpu.service.lease import (Lease, LeaseBook,
                                          FleetCoverageLedger)
 from petastorm_tpu.service.scheduler import FairShareScheduler
@@ -27,6 +30,7 @@ from petastorm_tpu.service.client import ServiceReader, make_service_reader
 __all__ = [
     "SERVICE_WIRE_VERSION", "service_available",
     "install_service_fault_plan",
+    "ContentKeyer", "FleetBufferCache", "content_keyer_for",
     "Lease", "LeaseBook", "FleetCoverageLedger",
     "FairShareScheduler",
     "ServiceJournal", "JournalTail", "WarmStandby",

@@ -129,6 +129,10 @@ class ServiceReader:
         self._c_order_retries = t.counter(
             "service.client.order_retries_total")
         self._c_detach_timeouts = t.counter("service.detach_timeouts_total")
+        self._c_lookups = t.counter("service.client.lookups_total")
+        self._c_lookup_missing = t.counter("index.keys_missing_total")
+        self._c_lookup_skipped = t.counter("index.keys_skipped_total")
+        self._h_lookup = t.histogram("index.lookup_s")
 
         self._publisher = None
         if telemetry_publish:
@@ -142,6 +146,10 @@ class ServiceReader:
         self._ctrl = service_socket(self._ctx, zmq.DEALER,
                                     connect=dispatcher_addr)
         self._data_socks: Dict[str, object] = {}
+        #: Dedicated per-server sockets for point reads — separate from
+        #: the order stream's ``_data_socks`` so a lookup's RPC never
+        #: interleaves with (or swallows) in-flight unit frames.
+        self._lookup_socks: Dict[str, object] = {}
         self._poller = zmq.Poller()
 
         #: plan positions this client has consumed, per epoch — the
@@ -327,6 +335,106 @@ class ServiceReader:
             self._data_socks[addr] = sock
             self._poller.register(sock, zmq.POLLIN)
         return sock
+
+    def _lookup_sock(self, addr: str):
+        sock = self._lookup_socks.get(addr)
+        if sock is None:
+            sock = service_socket(self._ctx, zmq.DEALER, connect=addr)
+            self._lookup_socks[addr] = sock
+        return sock
+
+    def lookup(self, keys, field: Optional[str] = None,
+               columns: Optional[List[str]] = None,
+               on_missing: str = "error",
+               timeout_s: Optional[float] = None) -> List[dict]:
+        """Fleet-fronted point reads (docs/random_access.md "Serving
+        lookups through the fleet"): the dispatcher resolves ``keys``
+        through the job's persisted field index and routes each touched
+        row group to its stripe owner, where the fleet cache tier serves
+        the group's serialized buffer (warm: no decode anywhere). Same
+        surface and semantics as the local
+        :meth:`IndexLookupPlane.lookup <petastorm_tpu.index.lookup.IndexLookupPlane.lookup>`:
+        rows come back ordered by key position; ``on_missing='error'``
+        raises :class:`KeyError`, ``'skip'`` drops absent keys (counted
+        on ``index.keys_missing_total``); a quarantined/undecodable
+        group skips its keys (``index.keys_skipped_total``), never
+        hangs. Each group read is bounded by ``timeout_s`` per server
+        with one backup attempt."""
+        t0 = time.perf_counter()
+        keys = list(keys)
+        timeout_ms = max(100, int(
+            (timeout_s if timeout_s is not None
+             else min(self._unit_timeout_s, 10.0)) * 1000))
+        while True:
+            try:
+                plan = self._rpc({
+                    "type": "lookup_plan", "job_id": self._job["job_id"],
+                    "field": field, "keys": keys,
+                    "columns": (list(columns) if columns is not None
+                                else None)})
+                break
+            except _GenerationChanged:
+                continue
+        if plan.get("type") != "lookup_plan":
+            raise ServiceError(
+                f"lookup_plan failed: {plan.get('error') or plan}")
+        resolved_field = plan["field"]
+        missing_pos = [int(p) for p in plan.get("missing") or ()]
+        if missing_pos:
+            if on_missing == "error":
+                missing = [keys[p] for p in missing_pos]
+                raise KeyError(
+                    f"{len(missing)} key(s) not in the "
+                    f"{resolved_field!r} index (first: {missing[:5]!r}); "
+                    f"pass on_missing='skip' to drop absent keys")
+            self._c_lookup_missing.add(len(missing_pos))
+        order: List[list] = [[] for _ in keys]
+        skipped_keys = 0
+        for group in plan.get("groups") or ():
+            header = {"type": "point_read",
+                      "dataset_url": plan["dataset_url"],
+                      "fingerprint": plan.get("fingerprint"),
+                      "field": resolved_field,
+                      "columns": (list(columns) if columns is not None
+                                  else None),
+                      "ordinal": int(group["ordinal"]),
+                      "rows": group["rows"]}
+            reply = payload = None
+            for addr in (group.get("server"), group.get("backup")):
+                if not addr:
+                    continue
+                try:
+                    reply, payload = rpc(self._lookup_sock(addr), header,
+                                         timeout_ms=timeout_ms)
+                    break
+                except (WireTimeout, WireError):
+                    continue  # primary unreachable: one backup attempt
+            if reply is None:
+                raise ServiceError(
+                    f"point read for row group {group['ordinal']} failed "
+                    f"on {group.get('server')}/{group.get('backup')}")
+            rtype = reply.get("type")
+            if rtype == "point_skip":
+                # Quarantined/undecodable group: its keys are skipped
+                # (and counted), exactly like the local plane.
+                skipped_keys += len(group["rows"])
+                continue
+            if rtype != "point_rows" or payload is None:
+                raise ServiceError(
+                    f"point read failed: {reply.get('error') or reply}")
+            table = self._serializer.deserialize(payload)
+            out_cols = (list(columns) if columns is not None
+                        else list(table.column_names))
+            for i, pos in enumerate(reply.get("positions") or ()):
+                order[int(pos)].append(
+                    {c: table.column(c)[i].as_py()
+                     for c in out_cols if c in table.column_names})
+        rows = [row for slot in order for row in slot]
+        self._c_lookups.add(1)
+        if skipped_keys:
+            self._c_lookup_skipped.add(skipped_keys)
+        self._h_lookup.observe(time.perf_counter() - t0)
+        return rows
 
     def _send_order(self, run: _LeaseRun, addr: str) -> str:
         order_id = uuid.uuid4().hex[:12]
@@ -704,6 +812,9 @@ class ServiceReader:
                 pass
             sock.close()
         self._data_socks = {}
+        for sock in self._lookup_socks.values():
+            sock.close()
+        self._lookup_socks = {}
         if self._ctrl is not None:
             ctrl, self._ctrl = self._ctrl, None
             ctrl.close()

@@ -126,6 +126,10 @@ class _Job:
         self.pending: List[int] = []
         self.outstanding: set = set()
         self.coverage: Optional[FleetCoverageLedger] = None
+        #: Lazy lookup-plan state (fleet point reads): the persisted
+        #: FieldIndex plus ``(rel_path, row_group) -> global ordinal``.
+        self.lookup_index = None
+        self.loc_to_ordinal: Optional[Dict[Tuple[str, int], int]] = None
 
     def load(self) -> None:
         if self.loaded:
@@ -165,6 +169,25 @@ class _Job:
     def fold_back(self, positions: Sequence[int]) -> None:
         """Reclaimed positions return to the pending pool in plan order."""
         self.pending = sorted(set(self.pending) | set(positions))
+
+    def field_index(self):
+        """The persisted field index + location→ordinal map, loaded on
+        the first ``lookup_plan`` (raises if the dataset has no sidecar —
+        fleet lookups require the same build_field_index step the local
+        plane does)."""
+        if self.lookup_index is None:
+            from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                            load_row_groups)
+            from petastorm_tpu.index.sidecar import FieldIndex
+            ctx = DatasetContext(self.spec.dataset_url)
+            index = FieldIndex.load(ctx)
+            loc2ord: Dict[Tuple[str, int], int] = {}
+            for ordinal, ref in enumerate(load_row_groups(ctx)):
+                rel = os.path.relpath(ref.path, ctx.root_path)
+                loc2ord[(rel, ref.row_group)] = ordinal
+            self.loc_to_ordinal = loc2ord
+            self.lookup_index = index
+        return self.lookup_index
 
 
 class Dispatcher:
@@ -215,6 +238,14 @@ class Dispatcher:
         #: PlanCache under its own host key).
         self._plan_registry: Dict[Tuple[str, str], dict] = {}
         self._registry_lock = threading.Lock()
+        #: Fleet cache directory: content key -> decode-server addrs
+        #: believed to hold that serialized buffer (docs/service.md
+        #: "Fleet cache tier"). Fed by heartbeat-piggybacked
+        #: advertisements (journaled, so a failover replays it),
+        #: trimmed by evict advertisements, server death and re-hello.
+        #: Advisory only: a stale entry costs one bounded peer-fetch
+        #: timeout, never correctness.
+        self._cache_dir: Dict[str, set] = {}
 
         from petastorm_tpu.telemetry import make_registry
         self.telemetry = make_registry()
@@ -234,6 +265,12 @@ class Dispatcher:
             "service.failover.replayed_records_total")
         self._c_evicted = t.counter("service.failover.servers_evicted_total")
         self._c_rejoins = t.counter("service.failover.server_rejoins_total")
+        self._c_cache_ads = t.counter("service.cache.adverts_total")
+        self._c_cache_drops = t.counter(
+            "service.cache.directory_drops_total")
+        self._c_lookup_plans = t.counter("service.lookup_plans_total")
+        t.gauge("service.cache.directory_keys",
+                lambda: len(self._cache_dir))
         t.gauge("service.leases_active", self.book.active_count)
         t.gauge("service.servers", lambda: len(self._servers))
         t.gauge("service.pending_units",
@@ -406,6 +443,56 @@ class Dispatcher:
     def _j_late_ack(self, job: _Job) -> None:
         job.coverage.note_late_ack()
 
+    def _j_cache_advert(self, addr: str, adds: List[str],
+                        evicts: List[str]) -> None:
+        """Journal + apply one server's cache-directory advertisement
+        (heartbeat piggyback). Journaled so a failed-over dispatcher
+        replays the directory instead of starting blind — every peer
+        fetch it can still broker is a decode the fleet doesn't repeat."""
+        self._append("cache_ad", {"addr": addr, "adds": adds,
+                                  "evicts": evicts})
+        self._apply_cache_ad(addr, adds, evicts)
+        self._c_cache_ads.add(1)
+
+    def _j_cache_drop(self, addr: str, cause: str) -> int:
+        """Journal + apply dropping every directory entry owned by
+        ``addr`` (server death, silence eviction, or re-hello — a fresh
+        server re-advertises its full resident set on its next beat)."""
+        with self._lock:
+            present = any(addr in owners
+                          for owners in self._cache_dir.values())
+        if not present:
+            return 0
+        self._append("cache_drop", {"addr": addr, "cause": cause})
+        dropped = self._apply_cache_drop(addr)
+        if dropped:
+            self._c_cache_drops.add(dropped)
+        return dropped
+
+    def _apply_cache_ad(self, addr: str, adds: Sequence[str],
+                        evicts: Sequence[str]) -> None:
+        with self._lock:
+            for key in adds:
+                self._cache_dir.setdefault(str(key), set()).add(addr)
+            for key in evicts:
+                owners = self._cache_dir.get(str(key))
+                if owners is not None:
+                    owners.discard(addr)
+                    if not owners:
+                        self._cache_dir.pop(str(key), None)
+
+    def _apply_cache_drop(self, addr: str) -> int:
+        dropped = 0
+        with self._lock:
+            for key in list(self._cache_dir):
+                owners = self._cache_dir[key]
+                if addr in owners:
+                    owners.discard(addr)
+                    dropped += 1
+                    if not owners:
+                        self._cache_dir.pop(key, None)
+        return dropped
+
     # ------------------------------------------------------------ recovery
     def _recover(self) -> None:
         """Replay the journal (snapshot + WAL) into this incarnation.
@@ -481,6 +568,9 @@ class Dispatcher:
         for key, record in (state.get("plan_registry") or []):
             with self._registry_lock:
                 self._plan_registry[tuple(key)] = record
+        for key, addrs in (state.get("cache_dir") or {}).items():
+            with self._lock:
+                self._cache_dir[str(key)] = {str(a) for a in addrs}
         if state.get("accounting"):
             self.accounting.restore(state["accounting"])
 
@@ -503,6 +593,14 @@ class Dispatcher:
             with self._registry_lock:
                 self._plan_registry[(rec["fingerprint"],
                                      rec["store_type"])] = rec["record"]
+            return
+        if kind == "cache_ad":
+            self._apply_cache_ad(str(rec.get("addr")),
+                                 [str(k) for k in rec.get("adds") or ()],
+                                 [str(k) for k in rec.get("evicts") or ()])
+            return
+        if kind == "cache_drop":
+            self._apply_cache_drop(str(rec.get("addr")))
             return
         job = self._jobs.get(rec.get("job_id"))
         if job is None or not job.loaded:
@@ -591,7 +689,10 @@ class Dispatcher:
                                 "coverage": job.coverage.dump()}
         with self._registry_lock:
             registry = [[list(k), v] for k, v in self._plan_registry.items()]
+        with self._lock:
+            cache_dir = {k: sorted(v) for k, v in self._cache_dir.items()}
         return {"jobs": jobs, "plan_registry": registry,
+                "cache_dir": cache_dir,
                 "accounting": self.accounting.dump()}
 
     def start(self) -> "Dispatcher":
@@ -735,6 +836,10 @@ class Dispatcher:
                 self._down.add(addr)
         for addr in dead:
             self._c_evicted.add(1)
+            # A dead server's cache entries are unreachable: drop them
+            # from the fleet directory (journaled) so peers stop trying
+            # to fetch from a corpse and fall straight back to decode.
+            self._j_cache_drop(addr, cause="evicted")
             self.telemetry.record_event("service.failover.server_evicted",
                                         {"addr": addr})
             logger.warning("decode server %s silent > %.1fs; evicted from "
@@ -966,6 +1071,10 @@ class Dispatcher:
         addr = msg.get("addr")
         if addr:
             self._note_server_alive(str(addr), heartbeat=False)
+            # A (re)hello means a fresh cache: whatever the directory
+            # believed this addr held is gone. The server re-advertises
+            # its full resident set on its first post-hello heartbeat.
+            self._j_cache_drop(str(addr), cause="hello")
         return {"type": "server_ok", "servers": list(self._servers)}
 
     def _on_server_heartbeat(self, msg: dict) -> dict:
@@ -973,7 +1082,88 @@ class Dispatcher:
         if not addr:
             return {"type": "error", "error": "heartbeat without addr"}
         self._note_server_alive(str(addr), heartbeat=True)
+        adds = [str(k) for k in msg.get("cache_adds") or ()]
+        evicts = [str(k) for k in msg.get("cache_evicts") or ()]
+        if adds or evicts:
+            self._j_cache_advert(str(addr), adds, evicts)
         return {"type": "hb_ok"}
+
+    def _on_cache_locate(self, msg: dict) -> dict:
+        """Fleet cache directory consult: which *live* servers (other
+        than the asker) hold each content key. Purely advisory — the
+        asker bounds its fetch and falls back to local decode."""
+        exclude = msg.get("exclude")
+        keys = [str(k) for k in (msg.get("keys") or ())][:1024]
+        locations = {}
+        with self._lock:
+            live = set(self._servers)
+            for key in keys:
+                owners = [a for a in sorted(self._cache_dir.get(key) or ())
+                          if a != exclude and a in live]
+                if owners:
+                    locations[key] = owners
+        return {"type": "cache_locations", "locations": locations}
+
+    def _on_lookup_plan(self, msg: dict) -> dict:
+        """Plan one fleet point-read batch (docs/random_access.md
+        "Serving lookups through the fleet"): resolve keys through the
+        job's persisted field index, group rows by global row-group
+        ordinal, and route each group through the SAME stripe-affinity
+        map work orders use — a lookup lands where the epoch stream
+        already warmed the fleet cache."""
+        job = self._job_for(msg)
+        if job is None:
+            return {"type": "error",
+                    "error": f"no job matches "
+                             f"{msg.get('job_id') or msg.get('tenant')!r}"}
+        with self._lock:
+            self._j_job_load(job)
+        try:
+            index = job.field_index()
+        except Exception as e:  # noqa: BLE001 - surface as a wire error
+            return {"type": "error",
+                    "error": f"field index unavailable for job "
+                             f"{job.spec.job_id!r}: {e!r}"}
+        field = msg.get("field")
+        if field is None:
+            indexed = index.fields_indexed
+            if len(indexed) != 1:
+                return {"type": "error",
+                        "error": f"lookup field required when "
+                                 f"{len(indexed)} fields are indexed "
+                                 f"({indexed})"}
+            field = indexed[0]
+        field = str(field)
+        keys = list(msg.get("keys") or ())
+        missing: List[int] = []
+        by_ordinal: Dict[int, list] = {}
+        try:
+            for pos, key in enumerate(keys):
+                entries = index.entries_for(field, key)
+                if not entries:
+                    missing.append(pos)
+                    continue
+                for rel, rg, off in entries:
+                    ordinal = job.loc_to_ordinal.get((rel, rg))
+                    if ordinal is None:
+                        # Index names a file the current listing lacks
+                        # (sidecar ahead of the listing): treat as absent.
+                        missing.append(pos)
+                        continue
+                    by_ordinal.setdefault(int(ordinal),
+                                          []).append([pos, key, int(off)])
+        except Exception as e:  # noqa: BLE001 - e.g. field not indexed
+            return {"type": "error", "error": repr(e)}
+        groups = []
+        for ordinal in sorted(by_ordinal):
+            primary, backup = self._assign_servers([ordinal], job.num_items)
+            groups.append({"ordinal": ordinal, "rows": by_ordinal[ordinal],
+                           "server": primary, "backup": backup})
+        self._c_lookup_plans.add(1)
+        return {"type": "lookup_plan", "field": field,
+                "dataset_url": job.spec.dataset_url,
+                "fingerprint": job.fingerprint,
+                "missing": sorted(set(missing)), "groups": groups}
 
     def _on_plan_get(self, msg: dict) -> dict:
         key = (str(msg.get("fingerprint")), str(msg.get("store_type")))
@@ -1023,6 +1213,10 @@ class Dispatcher:
             "jobs": jobs,
             "servers": list(self._servers),
             "down_servers": sorted(self._down),
+            "cache_directory": {
+                "keys": len(self._cache_dir),
+                "entries": sum(len(v) for v in self._cache_dir.values()),
+            },
             "standby": self.standby_addr,
             "journal": (None if self.journal is None
                         else {"dir": self.journal.directory,

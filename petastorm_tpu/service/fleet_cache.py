@@ -1,0 +1,358 @@
+"""Content-addressed fleet cache tier (docs/service.md "Fleet cache
+tier").
+
+Two pieces, shared by every decode server:
+
+* **Content keys** — :class:`ContentKeyer` fingerprints one row group's
+  decode as ``(owning file realpath + mtime + size, row-group index
+  within the file, column projection, decode-relevant plan kwargs)``.
+  Identical work is identical bytes regardless of which tenant, job, or
+  plan ordered it: two datasets assembled from (symlinks to) the same
+  physical parquet files key the shared groups identically, so the
+  fleet decodes each one **once**, while a rewritten file (new mtime)
+  keys differently and can never serve stale bytes. Keys are opaque
+  ``ck1-<hex>`` strings — ``tools/check_cachekeys.py`` lints that
+  service caches are only ever addressed through this helper, never
+  through ad-hoc tuples (the PR 17 projection-collision bug).
+
+* **:class:`FleetBufferCache`** — the per-server store the keys address:
+  a byte-bounded map of *serialized* Arrow row-group buffers with
+
+  - **single-flight dedup** (:meth:`FleetBufferCache.begin` /
+    :meth:`~FleetBufferCache.fulfill` / :meth:`~FleetBufferCache.wait`):
+    concurrent misses on one key elect exactly one owner to produce the
+    buffer (peer fetch or local decode); everyone else blocks on the
+    flight event and is served from the filled entry;
+  - **cost-aware admission/eviction** (the PR 3
+    ``InMemoryRowGroupCache`` idea at fleet scope): entries carry their
+    fill cost (decode seconds), eviction victims are chosen by lowest
+    decode-seconds-per-byte, and a candidate whose cost is lower than
+    what it would displace is *rejected* instead of churning hot
+    entries;
+  - **advertisement draining** — admissions and evictions accumulate and
+    are piggybacked on the server's dispatcher heartbeat
+    (:meth:`~FleetBufferCache.drain_advertisements`), feeding the
+    dispatcher's journaled fleet cache directory (key -> owning
+    servers) that powers the peer-fetch path and fleet point reads.
+
+Telemetry lives under ``service.cache.*`` (docs/observability.md).
+"""
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ContentKeyer", "FleetBufferCache", "content_keyer_for",
+           "invalidate_content_keyers", "CONTENT_KEY_PREFIX"]
+
+#: Every content key starts with this tag; bump on any recipe change so
+#: mixed-version fleets never cross-serve incompatible buffers.
+CONTENT_KEY_PREFIX = "ck1-"
+
+#: How long a built keyer's file stamps stay fresh. Appending datasets
+#: (docs/live_data.md) mutate files; a stale stamp would key new bytes
+#: under the old content identity, so stamps are rebuilt past this age.
+DEFAULT_KEYER_TTL_S = 30.0
+
+
+class ContentKeyer:
+    """Content-key mint for one dataset: global row-group ordinal ->
+    ``ck1-<hex>``. Built from the dataset's row-group listing; each
+    group's stamp is its owning file's ``(realpath, mtime_ns, size)``
+    plus the group's index *within that file* — deliberately not the
+    dataset URL, so datasets that share physical files share keys."""
+
+    def __init__(self, dataset_url: str):
+        self.dataset_url = dataset_url
+        self.built_at = time.monotonic()
+        from petastorm_tpu.etl.dataset_metadata import (DatasetContext,
+                                                        load_row_groups)
+        ctx = DatasetContext(dataset_url)
+        refs = load_row_groups(ctx)
+        stats: Dict[str, str] = {}
+        self._stamps: List[str] = []
+        for ref in refs:
+            stamp = stats.get(ref.path)
+            if stamp is None:
+                stamp = self._file_stamp(ref.path)
+                stats[ref.path] = stamp
+            self._stamps.append(f"{stamp}#rg{int(ref.row_group)}")
+
+    @staticmethod
+    def _file_stamp(path: str) -> str:
+        """``realpath:mtime_ns:size`` — realpath so symlink-assembled
+        datasets (overlap composition) share per-file identity. Remote
+        stores without a stat fall back to the raw path: still a valid
+        (same-URL) cache key, just without cross-dataset dedup or
+        mtime invalidation."""
+        try:
+            real = os.path.realpath(path)
+            st = os.stat(real)
+            return f"{real}:{st.st_mtime_ns}:{st.st_size}"
+        except OSError:
+            return f"unstattable:{path}"
+
+    @property
+    def num_items(self) -> int:
+        return len(self._stamps)
+
+    def key(self, ordinal: int, projection: Optional[Sequence[str]] = None,
+            plan_kwargs: Optional[dict] = None) -> str:
+        """The content key for one global row-group ordinal under one
+        column projection (``None``/empty = all columns) and the
+        decode-relevant plan kwargs (anything that changes decoded
+        bytes; today that is the projection itself — the hook exists so
+        future decode-shaping kwargs are key-safe by construction)."""
+        stamp = self._stamps[int(ordinal)]
+        proj = ",".join(sorted(projection)) if projection else "*"
+        kw = json.dumps(plan_kwargs or {}, sort_keys=True)
+        digest = hashlib.sha1(
+            f"{stamp}|cols={proj}|kw={kw}".encode("utf-8")).hexdigest()
+        return CONTENT_KEY_PREFIX + digest[:32]
+
+
+_KEYERS: Dict[str, ContentKeyer] = {}
+_KEYERS_LOCK = threading.Lock()
+
+
+def content_keyer_for(dataset_url: str,
+                      ttl_s: float = DEFAULT_KEYER_TTL_S) -> ContentKeyer:
+    """Process-cached :class:`ContentKeyer` for a dataset URL, rebuilt
+    (re-listing + re-statting) past ``ttl_s`` so appended/rewritten
+    files re-key within one TTL."""
+    now = time.monotonic()
+    with _KEYERS_LOCK:
+        keyer = _KEYERS.get(dataset_url)
+    if keyer is not None and now - keyer.built_at <= ttl_s:
+        return keyer
+    keyer = ContentKeyer(dataset_url)
+    with _KEYERS_LOCK:
+        _KEYERS[dataset_url] = keyer
+    return keyer
+
+
+def invalidate_content_keyers() -> None:
+    """Drop every cached keyer (tests; dataset mutations faster than the
+    TTL)."""
+    with _KEYERS_LOCK:
+        _KEYERS.clear()
+
+
+class _Entry:
+    __slots__ = ("buf", "nbytes", "fill_s", "source")
+
+    def __init__(self, buf, fill_s: float, source: str):
+        self.buf = buf
+        self.nbytes = len(buf)
+        self.fill_s = float(fill_s)
+        self.source = source
+
+    @property
+    def density(self) -> float:
+        """Decode-seconds-per-byte: the entry's protection score."""
+        return self.fill_s / max(1, self.nbytes)
+
+
+class FleetBufferCache:
+    """Content-keyed, byte-bounded, single-flight buffer store — one per
+    decode server, federated into a fleet tier by the dispatcher's cache
+    directory. Thread-safe (the decode-server worker pool shares it)."""
+
+    def __init__(self, capacity_bytes: int, telemetry=None):
+        self.capacity = int(capacity_bytes)
+        self._items: "OrderedDict[str, _Entry]" = OrderedDict()
+        #: key -> flight event for every decode/fetch in progress.
+        self._flights: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.hits = 0
+        self.peer_hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.rejected_admissions = 0
+        self.singleflight_waits = 0
+        #: key -> how many times THIS server decoded it locally — the
+        #: fleet-wide decodes-per-group proof the bench sums.
+        self.decodes: Dict[str, int] = {}
+        self._pending_adds: List[str] = []
+        self._pending_evicts: List[str] = []
+        self._telemetry = telemetry
+        if telemetry is not None:
+            t = telemetry
+            self._c_hits = t.counter("service.cache.hits_total")
+            self._c_peer_hits = t.counter("service.cache.peer_hits_total")
+            self._c_misses = t.counter("service.cache.misses_total")
+            self._c_waits = t.counter(
+                "service.cache.singleflight_waits_total")
+            self._c_evictions = t.counter("service.cache.evictions_total")
+            self._c_rejected = t.counter(
+                "service.cache.rejected_admissions_total")
+            t.gauge("service.cache.bytes", lambda: self.bytes)
+            t.gauge("service.cache.entries", lambda: len(self._items))
+
+    # ------------------------------------------------------------- reads
+    def get(self, key: str):
+        """Counted lookup: the buffer, or None (a miss)."""
+        with self._lock:
+            entry = self._items.get(key)
+            if entry is None:
+                self._miss_locked()
+                return None
+            self._items.move_to_end(key)
+            self._hit_locked()
+            return entry.buf
+
+    def peek(self, key: str):
+        """Uncounted lookup (peer ``cache_get`` serving, flight waits):
+        ``(buf, fill_s)`` or ``None``. The *requester* accounts the hit."""
+        with self._lock:
+            entry = self._items.get(key)
+            if entry is None:
+                return None
+            self._items.move_to_end(key)
+            return entry.buf, entry.fill_s
+
+    def resident_keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items)
+
+    # ------------------------------------------------------ single-flight
+    def begin(self, key: str):
+        """Single-flight entry point. Atomically one of:
+
+        * ``("hit", buf)`` — resident, counted as a hit;
+        * ``("owner", None)`` — caller owns the flight: it must
+          :meth:`fulfill` or :meth:`abandon` this key, whatever happens;
+        * ``("wait", event)`` — someone else is producing it: block on
+          :meth:`wait` and read the filled entry.
+        """
+        with self._lock:
+            entry = self._items.get(key)
+            if entry is not None:
+                self._items.move_to_end(key)
+                self._hit_locked()
+                return "hit", entry.buf
+            event = self._flights.get(key)
+            if event is not None:
+                self.singleflight_waits += 1
+                if self._telemetry is not None:
+                    self._c_waits.add(1)
+                return "wait", event
+            self._flights[key] = threading.Event()
+            self._miss_locked()
+            return "owner", None
+
+    def fulfill(self, key: str, buf, fill_s: float,
+                source: str = "decode") -> bool:
+        """Land one produced buffer (ending its flight, waking waiters)
+        and run cost-aware admission. ``source`` is ``"decode"`` (counted
+        on :attr:`decodes`) or ``"peer"`` (counted as a peer hit —
+        decode-cost provenance rides along from the peer so the entry
+        keeps its true protection score). Returns whether admitted."""
+        with self._lock:
+            if source == "decode":
+                self.decodes[key] = self.decodes.get(key, 0) + 1
+            elif source == "peer":
+                self.peer_hits += 1
+                if self._telemetry is not None:
+                    self._c_peer_hits.add(1)
+            admitted = self._admit_locked(key, _Entry(buf, fill_s, source))
+            event = self._flights.pop(key, None)
+        if event is not None:
+            event.set()
+        return admitted
+
+    def abandon(self, key: str) -> None:
+        """End a flight without a buffer (decode failed / undecodable
+        group): waiters wake, find no entry, and handle the miss
+        themselves — a poisoned key never wedges the fleet."""
+        with self._lock:
+            event = self._flights.pop(key, None)
+        if event is not None:
+            event.set()
+
+    def wait(self, key: str, event: threading.Event, timeout_s: float):
+        """Block on another caller's flight; the filled ``(buf, fill_s)``
+        or ``None`` (owner abandoned, entry already evicted, or
+        timeout — callers fall back to producing the buffer
+        themselves)."""
+        event.wait(timeout_s)
+        return self.peek(key)
+
+    # ----------------------------------------------------------- writes
+    def put(self, key: str, buf, fill_s: float = 0.0,
+            source: str = "decode") -> bool:
+        """Flight-less insert (tests, warm seeding): admission only."""
+        with self._lock:
+            return self._admit_locked(key, _Entry(buf, fill_s, source))
+
+    def _admit_locked(self, key: str, entry: _Entry) -> bool:
+        if key in self._items:
+            return True
+        if entry.nbytes > self.capacity:
+            return False
+        if self.bytes + entry.nbytes > self.capacity:
+            # Victims in ascending decode-seconds-per-byte (ties: LRU
+            # order, which the OrderedDict iteration already yields).
+            ranked = sorted(self._items.items(),
+                            key=lambda kv: kv[1].density)
+            victims, freed, displaced_cost = [], 0, 0.0
+            for vkey, ventry in ranked:
+                if self.bytes - freed + entry.nbytes <= self.capacity:
+                    break
+                victims.append(vkey)
+                freed += ventry.nbytes
+                displaced_cost += ventry.fill_s
+            if displaced_cost > entry.fill_s:
+                # The candidate is cheaper to re-produce than what it
+                # would displace: keep the hot expensive entries.
+                self.rejected_admissions += 1
+                if self._telemetry is not None:
+                    self._c_rejected.add(1)
+                return False
+            for vkey in victims:
+                ventry = self._items.pop(vkey)
+                self.bytes -= ventry.nbytes
+                self.evictions += 1
+                self._pending_evicts.append(vkey)
+                if self._telemetry is not None:
+                    self._c_evictions.add(1)
+        self._items[key] = entry
+        self.bytes += entry.nbytes
+        self._pending_adds.append(key)
+        return True
+
+    def _hit_locked(self) -> None:
+        self.hits += 1
+        if self._telemetry is not None:
+            self._c_hits.add(1)
+
+    def _miss_locked(self) -> None:
+        self.misses += 1
+        if self._telemetry is not None:
+            self._c_misses.add(1)
+
+    # ---------------------------------------------------- advertisements
+    def drain_advertisements(self, limit: int = 2000
+                             ) -> Tuple[List[str], List[str]]:
+        """``(adds, evicts)`` accumulated since the last drain, for the
+        heartbeat piggyback; anything beyond ``limit`` stays queued for
+        the next beat. Each drained key is reconciled against current
+        residency, so an add-evict(-add) churn within one window
+        advertises only the final state."""
+        with self._lock:
+            adds = {k for k in self._pending_adds if k in self._items}
+            evicts = {k for k in self._pending_evicts
+                      if k not in self._items}
+            adds_out = sorted(adds)[:limit]
+            evicts_out = sorted(evicts)[:limit]
+            self._pending_adds = sorted(adds - set(adds_out))
+            self._pending_evicts = sorted(evicts - set(evicts_out))
+        return adds_out, evicts_out

@@ -14,6 +14,14 @@ generation, and resumes the *same* minted :class:`EpochPlan` — the
 journal records the minted seed, so the post-restart fleet stream stays
 byte-identical even when the job never pinned one.
 
+Record kinds: ``job_load``, ``grant``, ``ack``, ``reclaim``,
+``resync``, ``plan_put``, ``hb``, plus the fleet cache directory pair
+``cache_ad`` (one server's heartbeat-piggybacked content-key
+advertisement) and ``cache_drop`` (all of one server's entries
+invalidated on death/eviction/re-hello) — so a failed-over dispatcher
+resumes brokering peer fetches instead of starting with a blind
+directory (docs/service.md "Fleet cache tier").
+
 Crash semantics: appends are flushed per record and fsynced every
 ``fsync_every`` records (and always at compaction), so a crash loses at
 most the tail of un-fsynced records — each of which describes work the

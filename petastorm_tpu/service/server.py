@@ -9,25 +9,40 @@ framed messages: a JSON ``unit`` header per plan position plus an Arrow
 IPC payload (the PR 6 ``ArrowTableSerializer`` bytes), then an
 ``order_done`` summary.
 
-Decoded row groups are cached by ``(dataset fingerprint, ordinal)`` as
-their *serialized* Arrow buffers — the exact bytes the wire wants — so
-N clients drawing the same dataset (or the same client across epochs)
-pay one decode per row group fleet-wide per server. The fast path
-decodes a whole order through one ``rowgroup_subset`` reader in
-deterministic order; any decode failure falls back to per-ordinal
-readers so a poisoned row group becomes a ``skip`` unit (the quarantine
-interplay, docs/service.md) instead of poisoning its neighbors.
+Decoded row groups are cached as their *serialized* Arrow buffers —
+the exact bytes the wire wants — in the content-addressed
+:class:`~petastorm_tpu.service.fleet_cache.FleetBufferCache`
+(docs/service.md "Fleet cache tier"): keys fingerprint the owning
+file's identity + the group ordinal + the column projection, so
+identical work is identical bytes across tenants, jobs and plans, and
+two jobs with different projections can never collide. On a local miss
+the server consults the dispatcher's fleet cache directory
+(``cache_locate``) and pulls the already-serialized buffer from a peer
+(``cache_get``, bounded timeout) before paying a decode; concurrent
+misses on one key single-flight so each group is decoded **once per
+fleet**. Orders run on a small worker pool behind the single socket
+loop (an out-queue serializes every send onto the loop thread — ZeroMQ
+sockets are not thread-safe), so a warm ``point_read`` is never stuck
+behind a cold decode.
+
+The fast path decodes a whole order through one ``rowgroup_subset``
+reader in deterministic order; any decode failure falls back to
+per-ordinal readers so a poisoned row group becomes a ``skip`` unit
+(the quarantine interplay, docs/service.md) instead of poisoning its
+neighbors.
 """
 
 import logging
+import queue
 import threading
 import time
 import uuid
-from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from petastorm_tpu.reader_impl.arrow_table_serializer import \
     ArrowTableSerializer
+from petastorm_tpu.service.fleet_cache import (FleetBufferCache,
+                                               content_keyer_for)
 from petastorm_tpu.service.wire import (WireError, WireTimeout, next_req_id,
                                         recv_msg, rpc, send_msg,
                                         service_fault_plan, service_socket)
@@ -45,50 +60,32 @@ DEFAULT_CACHE_BYTES = 256 << 20
 #: ``server_heartbeat_s`` expectation); 0 disables heartbeating.
 DEFAULT_HEARTBEAT_S = 2.0
 
+#: Order/point-read worker threads behind the socket loop. Two is
+#: enough for the contract that matters: a warm lookup (or a cache-hit
+#: order) never queues behind a cold decode.
+DEFAULT_WORKERS = 2
 
-class _BufferCache:
-    """Byte-bounded LRU of serialized row-group tables."""
+#: Bound on one peer ``cache_locate`` + ``cache_get`` round trip. A
+#: stale directory entry (peer died, entry evicted) costs at most this
+#: before the server falls back to decoding locally — counted on
+#: ``service.cache.peer_fetch_timeouts_total``, never a hang.
+DEFAULT_PEER_FETCH_TIMEOUT_S = 2.0
 
-    def __init__(self, capacity_bytes: int):
-        self.capacity = int(capacity_bytes)
-        self._items: "OrderedDict[Tuple[str, int], object]" = OrderedDict()
-        self.bytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self._lock = threading.Lock()
-
-    def get(self, key):
-        with self._lock:
-            buf = self._items.get(key)
-            if buf is None:
-                self.misses += 1
-                return None
-            self._items.move_to_end(key)
-            self.hits += 1
-            return buf
-
-    def put(self, key, buf) -> None:
-        size = len(buf)
-        with self._lock:
-            if key in self._items:
-                return
-            while self._items and self.bytes + size > self.capacity:
-                _, old = self._items.popitem(last=False)
-                self.bytes -= len(old)
-                self.evictions += 1
-            if size <= self.capacity:
-                self._items[key] = buf
-                self.bytes += size
+#: How long a single-flight waiter trusts the owner before giving up
+#: and producing the buffer itself (owner died mid-decode).
+DEFAULT_SINGLEFLIGHT_WAIT_S = 30.0
 
 
 class DecodeServer:
-    """One stateless decode server; ``start()`` spawns the order loop.
+    """One stateless decode server; ``start()`` spawns the socket loop
+    plus ``workers`` order threads.
 
     ``stall_s`` delays every order — the fault-injection knob the hedging
     tests and bench use to manufacture a straggler. ``extra_reader_kwargs``
     merge into every reader this server builds (process-local, never on
-    the wire): tests inject ``fault_plan`` here.
+    the wire): tests inject ``fault_plan`` here. ``peer_fetch=False``
+    degrades to the per-server cache (the PR 17 behavior — the bench's
+    baseline arm).
     """
 
     def __init__(self, addr: str, dispatcher_addr: Optional[str] = None,
@@ -96,6 +93,9 @@ class DecodeServer:
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
                  stall_s: float = 0.0,
                  heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+                 workers: int = DEFAULT_WORKERS,
+                 peer_fetch: bool = True,
+                 peer_fetch_timeout_s: float = DEFAULT_PEER_FETCH_TIMEOUT_S,
                  extra_reader_kwargs: Optional[dict] = None,
                  plan_cache_dir: Optional[str] = None,
                  telemetry_publish: Optional[str] = None,
@@ -107,24 +107,32 @@ class DecodeServer:
         self.server_id = server_id or f"srv-{uuid.uuid4().hex[:8]}"
         self.stall_s = float(stall_s)
         self.heartbeat_s = float(heartbeat_s)
+        self.workers = max(1, int(workers))
+        self.peer_fetch = bool(peer_fetch) and dispatcher_addr is not None
+        self.peer_fetch_timeout_s = float(peer_fetch_timeout_s)
+        self.singleflight_wait_s = DEFAULT_SINGLEFLIGHT_WAIT_S
         #: True after an injected ``server.order`` death (the server is
         #: gone as far as the fleet can tell: no heartbeats, no replies).
         self.killed = False
         self.extra_reader_kwargs = dict(extra_reader_kwargs or {})
         self.plan_cache_dir = plan_cache_dir
-        self.cache = _BufferCache(cache_bytes)
         self._serializer = ArrowTableSerializer()
         self._seeded_fingerprints = set()
 
         from petastorm_tpu.telemetry import make_registry
         self.telemetry = make_registry()
         t = self.telemetry
+        self.cache = FleetBufferCache(cache_bytes, telemetry=t)
         self._c_orders = t.counter("service.server.orders_total")
         self._c_units = t.counter("service.server.units_sent_total")
         self._c_skips = t.counter("service.server.units_skipped_total")
         self._c_send_timeouts = t.counter("service.server.send_timeouts_total")
         self._c_wire_errors = t.counter("service.wire_errors_total")
         self._c_heartbeats = t.counter("service.server.heartbeats_total")
+        self._c_point_reads = t.counter("service.server.point_reads_total")
+        self._c_peer_timeouts = t.counter(
+            "service.cache.peer_fetch_timeouts_total")
+        self._h_peer_fetch = t.histogram("service.cache.peer_fetch_s")
         t.gauge("service.server.cache_bytes", lambda: self.cache.bytes)
         t.gauge("service.server.cache_hits", lambda: self.cache.hits)
 
@@ -140,6 +148,27 @@ class DecodeServer:
         self._disp = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._worker_threads: List[threading.Thread] = []
+        #: work items for the order pool: ("order"|"point", ident, msg).
+        self._tasks: "queue.Queue" = queue.Queue()
+        #: outbound frames, drained (and sent) only by the loop thread:
+        #: (ident, header, payload).
+        self._out: "queue.Queue" = queue.Queue(maxsize=512)
+        #: order_ids whose client went away mid-stream (a bounded send
+        #: timed out) — workers stop producing units for them.
+        self._aborted_orders: set = set()
+        self._aborted_lock = threading.Lock()
+        #: Worker tasks mid-execution; >0 switches the loop to a 1ms poll
+        #: so queued replies are drained with sub-tick latency.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        #: After (re)registering with the dispatcher, the next heartbeat
+        #: advertises the FULL resident key set — the dispatcher dropped
+        #: our directory entries on hello, so this rebuilds them.
+        self._readvertise = False
+        self._tls = threading.local()
+        self._aux_socks: List[object] = []
+        self._aux_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "DecodeServer":
@@ -155,12 +184,19 @@ class DecodeServer:
                 rpc(self._disp, {"type": "server_hello", "addr": self.addr,
                                  "server_id": self.server_id},
                     timeout_ms=5000)
+                self._readvertise = True
             except WireError:
                 logger.warning("server %s could not register with "
                                "dispatcher %s", self.server_id,
                                self.dispatcher_addr)
         if self._publisher is not None:
             self._publisher.start()
+        for i in range(self.workers):
+            worker = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name=f"petastorm-tpu-svc-{self.server_id}-w{i}")
+            worker.start()
+            self._worker_threads.append(worker)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"petastorm-tpu-svc-{self.server_id}")
         self._thread.start()
@@ -171,13 +207,26 @@ class DecodeServer:
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=10.0)
+        workers, self._worker_threads = self._worker_threads, []
+        for worker in workers:
+            worker.join(timeout=10.0)
         if self._publisher is not None:
             self._publisher.stop()
+        self._close_sockets()
+
+    def _close_sockets(self) -> None:
         for sock_name in ("_sock", "_disp"):
             sock = getattr(self, sock_name)
             if sock is not None:
                 setattr(self, sock_name, None)
                 sock.close()
+        with self._aux_lock:
+            aux, self._aux_socks = self._aux_socks, []
+        for sock in aux:
+            try:
+                sock.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
 
     def __enter__(self) -> "DecodeServer":
         if self._thread is None:
@@ -190,14 +239,22 @@ class DecodeServer:
     # ------------------------------------------------------------- the loop
     def _heartbeat(self) -> None:
         """Fire-and-forget liveness ping on the dispatcher DEALER (the
-        health plane's detection signal); replies are drained so the
-        pipe never fills."""
+        health plane's detection signal), carrying the fleet-cache
+        directory piggyback: keys admitted/evicted since the last beat
+        (or the full resident set right after a (re)hello). Replies are
+        drained so the pipe never fills."""
         if self._disp is None:
             return
+        adds, evicts = self.cache.drain_advertisements()
+        if self._readvertise:
+            self._readvertise = False
+            adds = sorted(set(adds) | set(self.cache.resident_keys()))
         try:
             send_msg(self._disp, {"type": "server_heartbeat",
                                   "addr": self.addr,
                                   "server_id": self.server_id,
+                                  "cache_adds": adds,
+                                  "cache_evicts": evicts,
                                   "req_id": next_req_id()})
             self._c_heartbeats.add(1)
         except WireError:
@@ -209,42 +266,138 @@ class DecodeServer:
             except WireError:  # includes WireTimeout = drained
                 break
 
-    def _run(self) -> None:
-        last_hb = 0.0
+    def _enqueue(self, ident: bytes, header: dict,
+                 payload: Optional[bytes] = None) -> bool:
+        """Queue one outbound frame for the loop thread to send; False
+        once the server is stopping (workers drop their stream)."""
         while not self._stop.is_set():
-            if self.heartbeat_s > 0 and self._disp is not None:
-                now = time.monotonic()
-                if now - last_hb >= self.heartbeat_s:
-                    last_hb = now
-                    self._heartbeat()
             try:
-                ident, msg, _ = recv_msg(self._sock, timeout_ms=100,
-                                         routed=True)
-            except WireTimeout:
+                self._out.put((ident, header, payload), timeout=0.25)
+                return True
+            except queue.Full:
                 continue
+        return False
+
+    def _drain_out(self) -> None:
+        while True:
+            try:
+                ident, header, payload = self._out.get_nowait()
+            except queue.Empty:
+                return
+            order_id = header.get("order_id")
+            with self._aborted_lock:
+                aborted = order_id is not None \
+                    and order_id in self._aborted_orders
+            if aborted:
+                continue
+            try:
+                send_msg(self._sock, header, payload=payload, ident=ident)
+            except WireTimeout:
+                # Client gone or wedged: abandon the rest of the order —
+                # the lease will expire and fold back.
+                self._c_send_timeouts.add(1)
+                if order_id is not None:
+                    with self._aborted_lock:
+                        self._aborted_orders.add(order_id)
             except WireError:
                 self._c_wire_errors.add(1)
-                continue
-            if msg.get("type") != "work_order":
+
+    def _run(self) -> None:
+        last_hb = 0.0
+        try:
+            while not self._stop.is_set():
+                if self.heartbeat_s > 0 and self._disp is not None:
+                    now = time.monotonic()
+                    if now - last_hb >= self.heartbeat_s:
+                        last_hb = now
+                        self._heartbeat()
+                self._drain_out()
+                # While workers are mid-task their replies land in the
+                # out-queue between polls: tighten the poll so a finished
+                # unit/point-read never waits out a full idle tick (this
+                # is the warm-lookup latency floor).
+                poll_ms = (1 if self._inflight or not self._tasks.empty()
+                           else 10)
                 try:
-                    send_msg(self._sock, {"type": "error",
-                                          "error": f"unknown request "
-                                                   f"{msg.get('type')!r}"},
-                             ident=ident)
+                    ident, msg, _ = recv_msg(self._sock, timeout_ms=poll_ms,
+                                             routed=True)
+                except WireTimeout:
+                    continue
                 except WireError:
                     self._c_wire_errors.add(1)
-                continue
+                    continue
+                mtype = msg.get("type")
+                if mtype == "work_order":
+                    self._tasks.put(("order", ident, msg))
+                elif mtype == "point_read":
+                    self._tasks.put(("point", ident, msg))
+                elif mtype == "cache_get":
+                    self._on_cache_get(ident, msg)
+                else:
+                    try:
+                        send_msg(self._sock, {"type": "error",
+                                              "error": f"unknown request "
+                                                       f"{mtype!r}"},
+                                 ident=ident)
+                    except WireError:
+                        self._c_wire_errors.add(1)
+        finally:
+            if self.killed:
+                # Injected death is abrupt: the loop thread (the socket
+                # owner) drops the ROUTER + heartbeat DEALER so peers and
+                # the dispatcher see silence, not clean shutdown.
+                for sock_name in ("_sock", "_disp"):
+                    sock = getattr(self, sock_name)
+                    if sock is not None:
+                        setattr(self, sock_name, None)
+                        sock.close()
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
             try:
-                self._serve_order(ident, msg)
-            except Exception as e:  # noqa: BLE001 - loop must survive
-                logger.exception("work order failed")
-                try:
-                    send_msg(self._sock,
-                             {"type": "order_error",
-                              "order_id": msg.get("order_id"),
-                              "error": repr(e)}, ident=ident)
-                except WireError:
-                    self._c_wire_errors.add(1)
+                kind, ident, msg = self._tasks.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                if kind == "order":
+                    self._serve_order(ident, msg)
+                else:
+                    self._serve_point_read(ident, msg)
+            except Exception as e:  # noqa: BLE001 - pool must survive
+                logger.exception("%s failed", kind)
+                err_type = ("order_error" if kind == "order"
+                            else "point_error")
+                header = {"type": err_type, "error": repr(e),
+                          "order_id": msg.get("order_id")}
+                if msg.get("req_id") is not None:
+                    header["re"] = msg["req_id"]
+                self._enqueue(ident, header)
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+
+    def _on_cache_get(self, ident: bytes, msg: dict) -> None:
+        """Serve one peer's fetch from the local cache — resident bytes
+        or a miss, never a decode on the peer's behalf (the requester
+        owns the fallback). Runs inline on the loop thread: it is a dict
+        lookup plus one bounded send."""
+        key = str(msg.get("key") or "")
+        header = {"type": "cache_miss", "key": key}
+        found = self.cache.peek(key)
+        payload = None
+        if found is not None:
+            payload, fill_s = found
+            header = {"type": "cache_buf", "key": key, "fill_s": fill_s}
+        if msg.get("req_id") is not None:
+            header["re"] = msg["req_id"]
+        try:
+            send_msg(self._sock, header, payload=payload, ident=ident)
+        except WireTimeout:
+            self._c_send_timeouts.add(1)
+        except WireError:
+            self._c_wire_errors.add(1)
 
     # ------------------------------------------------------------- decoding
     #: Keys the server pins itself in ``_read_subset`` — the work order's
@@ -264,13 +417,40 @@ class DecodeServer:
         kwargs.update(self.extra_reader_kwargs)
         return kwargs
 
+    def _worker_disp(self):
+        """Per-worker-thread dispatcher DEALER (the loop thread owns
+        ``self._disp`` for heartbeats; ZeroMQ sockets are single-thread)."""
+        if self.dispatcher_addr is None:
+            return None
+        sock = getattr(self._tls, "disp", None)
+        if sock is None:
+            sock = service_socket(self._ctx, zmq.DEALER,
+                                  connect=self.dispatcher_addr)
+            self._tls.disp = sock
+            with self._aux_lock:
+                self._aux_socks.append(sock)
+        return sock
+
+    def _peer_sock(self, addr: str):
+        socks = getattr(self._tls, "peers", None)
+        if socks is None:
+            socks = self._tls.peers = {}
+        sock = socks.get(addr)
+        if sock is None:
+            sock = service_socket(self._ctx, zmq.DEALER, connect=addr)
+            socks[addr] = sock
+            with self._aux_lock:
+                self._aux_socks.append(sock)
+        return sock
+
     def _seed_plan_cache(self, order: dict) -> None:
         """Fleet plan registry exchange, once per dataset fingerprint:
         pull the dispatcher's promoted record into this host's local
         PlanCache (warm start), or push our local record up if the
         registry is still cold."""
         fp, store = order.get("fingerprint"), order.get("store_type")
-        if not fp or self._disp is None or fp in self._seeded_fingerprints:
+        disp = self._worker_disp()
+        if not fp or disp is None or fp in self._seeded_fingerprints:
             return
         self._seeded_fingerprints.add(fp)
         import socket as _socket
@@ -279,9 +459,9 @@ class DecodeServer:
         key = PlanKey(fingerprint=fp, store_type=store or "file",
                       host=_socket.gethostname())
         try:
-            reply, _ = rpc(self._disp, {"type": "plan_get",
-                                        "fingerprint": fp,
-                                        "store_type": key.store_type},
+            reply, _ = rpc(disp, {"type": "plan_get",
+                                  "fingerprint": fp,
+                                  "store_type": key.store_type},
                            timeout_ms=2000)
         except WireError:
             return
@@ -293,10 +473,10 @@ class DecodeServer:
         local = cache.load(key)
         if local:
             try:
-                rpc(self._disp, {"type": "plan_put", "fingerprint": fp,
-                                 "store_type": key.store_type,
-                                 "record": {k: v for k, v in local.items()
-                                            if k != "key"}},
+                rpc(disp, {"type": "plan_put", "fingerprint": fp,
+                           "store_type": key.store_type,
+                           "record": {k: v for k, v in local.items()
+                                      if k != "key"}},
                     timeout_ms=2000)
             except WireError:
                 pass
@@ -353,6 +533,142 @@ class DecodeServer:
                 skipped.append(ordinal)
         return decoded, skipped
 
+    # ------------------------------------------------------ content keys
+    def _content_key(self, order: dict, ordinal: int) -> str:
+        """This order's content key for one global ordinal: file
+        identity + in-file group index + column projection. Falls back
+        to a fingerprint-scoped key when the dataset can't be listed
+        (the key still carries the projection, so the PR 17
+        projection-collision bug stays fixed either way)."""
+        projection = sorted((order.get("reader_kwargs") or {})
+                            .get("schema_fields") or ())
+        try:
+            keyer = content_keyer_for(order["dataset_url"])
+            return keyer.key(ordinal, projection)
+        except Exception:  # noqa: BLE001 - unlistable store
+            import hashlib
+            fp = order.get("fingerprint") or order.get("dataset_url")
+            digest = hashlib.sha1(
+                f"fp:{fp}:{ordinal}:cols={','.join(projection) or '*'}"
+                .encode("utf-8")).hexdigest()
+            return "ck1-" + digest[:32]
+
+    def _peer_fetch_keys(self, keys: List[str]) -> Dict[str, Tuple[object,
+                                                                   float]]:
+        """Pull already-serialized buffers for ``keys`` from fleet peers:
+        one directory consult, then per-peer ``cache_get`` round trips,
+        each bounded by ``peer_fetch_timeout_s``. Anything not fetched
+        (no owner, stale entry, timeout) is simply absent from the
+        result — the caller decodes it locally."""
+        out: Dict[str, Tuple[object, float]] = {}
+        if not self.peer_fetch or not keys:
+            return out
+        disp = self._worker_disp()
+        if disp is None:
+            return out
+        timeout_ms = max(100, int(self.peer_fetch_timeout_s * 1000))
+        try:
+            reply, _ = rpc(disp, {"type": "cache_locate", "keys": keys,
+                                  "exclude": self.addr},
+                           timeout_ms=timeout_ms)
+        except WireError:
+            return out
+        locations = reply.get("locations") or {}
+        by_peer: Dict[str, List[str]] = {}
+        for key in keys:
+            owners = locations.get(key) or []
+            if owners:
+                by_peer.setdefault(owners[0], []).append(key)
+        for peer, peer_keys in by_peer.items():
+            sock = self._peer_sock(peer)
+            for key in peer_keys:
+                t0 = time.perf_counter()
+                try:
+                    reply, payload = rpc(sock, {"type": "cache_get",
+                                                "key": key},
+                                         timeout_ms=timeout_ms)
+                except WireTimeout:
+                    # Stale directory entry or dead peer: bounded, counted,
+                    # and the rest of this peer's keys skip straight to
+                    # local decode.
+                    self._c_peer_timeouts.add(1)
+                    break
+                except WireError:
+                    self._c_wire_errors.add(1)
+                    break
+                if reply.get("type") == "cache_buf" and payload is not None:
+                    self._h_peer_fetch.observe(time.perf_counter() - t0)
+                    out[key] = (payload, float(reply.get("fill_s") or 0.0))
+        return out
+
+    def _acquire_buffers(self, order: dict, ordinals: List[int]
+                         ) -> Tuple[Dict[int, object], List[int]]:
+        """``ordinal -> serialized buffer`` through the fleet cache tier:
+        local hit -> peer fetch -> local decode, single-flighted per
+        content key so concurrent misses (two tenants, a client and its
+        hedge backup, a sibling worker) produce each buffer once.
+        Returns the buffers plus the undecodable ordinals."""
+        keys = {o: self._content_key(order, o) for o in set(ordinals)}
+        bufs: Dict[int, object] = {}
+        owned: List[int] = []
+        waits: List[Tuple[int, threading.Event]] = []
+        for ordinal in sorted(set(ordinals)):
+            state, val = self.cache.begin(keys[ordinal])
+            if state == "hit":
+                bufs[ordinal] = val
+            elif state == "owner":
+                owned.append(ordinal)
+            else:
+                waits.append((ordinal, val))
+        undecodable: List[int] = []
+        if owned:
+            try:
+                fetched = self._peer_fetch_keys([keys[o] for o in owned])
+                to_decode = []
+                for ordinal in owned:
+                    hit = fetched.get(keys[ordinal])
+                    if hit is not None:
+                        buf, fill_s = hit
+                        self.cache.fulfill(keys[ordinal], buf, fill_s,
+                                           source="peer")
+                        bufs[ordinal] = buf
+                    else:
+                        to_decode.append(ordinal)
+                if to_decode:
+                    t0 = time.perf_counter()
+                    decoded, undecodable = self._decode_ordinals(order,
+                                                                 to_decode)
+                    fill_s = (time.perf_counter() - t0) \
+                        / max(1, len(decoded))
+                    for ordinal in to_decode:
+                        buf = decoded.get(ordinal)
+                        if buf is None:
+                            self.cache.abandon(keys[ordinal])
+                        else:
+                            self.cache.fulfill(keys[ordinal], buf, fill_s,
+                                               source="decode")
+                            bufs[ordinal] = buf
+            except BaseException:
+                # Never strand a flight: waiters elsewhere in the fleet
+                # would block the full timeout for a buffer that is not
+                # coming.
+                for ordinal in owned:
+                    if ordinal not in bufs and ordinal not in undecodable:
+                        self.cache.abandon(keys[ordinal])
+                raise
+        for ordinal, event in waits:
+            found = self.cache.wait(keys[ordinal], event,
+                                    self.singleflight_wait_s)
+            if found is not None:
+                bufs[ordinal] = found[0]
+                continue
+            # The owner abandoned (or its entry was evicted before we
+            # woke): produce it ourselves, re-entering the flight gate.
+            sub, skipped = self._acquire_buffers(order, [ordinal])
+            bufs.update(sub)
+            undecodable.extend(skipped)
+        return bufs, sorted(set(undecodable))
+
     def _maybe_die(self, order: dict) -> bool:
         """The ``server.order`` chaos site, consulted as each work order
         starts (``key`` = this server's id, so a seeded plan can kill one
@@ -370,13 +686,11 @@ class DecodeServer:
                 raise
             logger.warning("server %s: injected death at server.order (%s)",
                            self.server_id, e)
+            # Flags only: the loop thread owns the sockets and closes
+            # them in its ``finally`` — closing them from this worker
+            # while the loop is polling is not thread-safe.
             self.killed = True
             self._stop.set()
-            for sock_name in ("_sock", "_disp"):
-                sock = getattr(self, sock_name)
-                if sock is not None:
-                    setattr(self, sock_name, None)
-                    sock.close()
             return True
         return False
 
@@ -387,50 +701,85 @@ class DecodeServer:
         if self.stall_s > 0:
             time.sleep(self.stall_s)
         self._seed_plan_cache(order)
-        fp = order.get("fingerprint") or order.get("dataset_url")
         epoch = int(order.get("epoch") or 0)
         positions = [int(p) for p in order.get("positions") or ()]
         ordinals = [int(o) for o in order.get("ordinals") or ()]
         if len(positions) != len(ordinals):
             raise ValueError("work order positions/ordinals length mismatch")
 
-        missing = [o for o in ordinals
-                   if self.cache.get((fp, o)) is None]
-        decoded, undecodable = ({}, [])
-        if missing:
-            decoded, undecodable = self._decode_ordinals(order, missing)
-            for ordinal, buf in decoded.items():
-                self.cache.put((fp, ordinal), buf)
+        bufs, _undecodable = self._acquire_buffers(order, ordinals)
 
         delivered = 0
         skipped_positions: List[int] = []
         for position, ordinal in zip(positions, ordinals):
-            buf = self.cache.get((fp, ordinal))
-            if buf is None:
-                buf = decoded.get(ordinal)
+            buf = bufs.get(ordinal)
             header = {"type": "unit", "order_id": order.get("order_id"),
                       "position": position, "epoch": epoch}
-            try:
-                if buf is None:
-                    skipped_positions.append(position)
-                    self._c_skips.add(1)
-                    send_msg(self._sock, dict(header, kind="skip"),
-                             ident=ident)
-                else:
-                    delivered += 1
-                    self._c_units.add(1)
-                    send_msg(self._sock, dict(header, kind="data"),
-                             payload=buf, ident=ident)
-            except WireTimeout:
-                # Client gone or wedged: abandon the rest of the order —
-                # the lease will expire and fold back.
-                self._c_send_timeouts.add(1)
-                return
-        try:
-            send_msg(self._sock, {"type": "order_done",
-                                  "order_id": order.get("order_id"),
-                                  "delivered": delivered,
-                                  "skipped": skipped_positions},
-                     ident=ident)
-        except WireTimeout:
-            self._c_send_timeouts.add(1)
+            if buf is None:
+                skipped_positions.append(position)
+                self._c_skips.add(1)
+                if not self._enqueue(ident, dict(header, kind="skip")):
+                    return
+            else:
+                delivered += 1
+                self._c_units.add(1)
+                if not self._enqueue(ident, dict(header, kind="data"),
+                                     payload=buf):
+                    return
+        self._enqueue(ident, {"type": "order_done",
+                              "order_id": order.get("order_id"),
+                              "delivered": delivered,
+                              "skipped": skipped_positions})
+
+    # ---------------------------------------------------------- point reads
+    def _serve_point_read(self, ident: bytes, msg: dict) -> None:
+        """One fleet point read (docs/random_access.md "Serving lookups
+        through the fleet"): decode-or-fetch the addressed row group
+        through the fleet cache under the request's own projection,
+        select the addressed row offsets (group-granular entries filter
+        by key, exactly like the local plane), and reply one Arrow
+        payload of the selected rows. A quarantined/undecodable group
+        replies ``point_skip`` — skip semantics, never a hang."""
+        if self._maybe_die(msg):
+            return
+        self._c_point_reads.add(1)
+        req_id = msg.get("req_id")
+        field = str(msg.get("field"))
+        columns = msg.get("columns")
+        ordinal = int(msg.get("ordinal") or 0)
+        needed = sorted(set(columns or ()) | {field}) if columns else None
+        order_like = {"dataset_url": msg["dataset_url"],
+                      "fingerprint": msg.get("fingerprint"),
+                      "reader_kwargs": ({"schema_fields": needed}
+                                        if needed else {})}
+        bufs, _skipped = self._acquire_buffers(order_like, [ordinal])
+        buf = bufs.get(ordinal)
+        if buf is None:
+            self._enqueue(ident, {"type": "point_skip", "re": req_id,
+                                  "ordinal": ordinal})
+            return
+        table = self._serializer.deserialize(buf)
+        from petastorm_tpu.index.lookup import matching_offsets
+        from petastorm_tpu.index.sidecar import GROUP_GRANULAR
+        key_cells = None
+        indices: List[int] = []
+        out_positions: List[int] = []
+        for pos, key, off in (msg.get("rows") or ()):
+            if int(off) == GROUP_GRANULAR:
+                if key_cells is None:
+                    key_cells = (table.column(field).to_pylist()
+                                 if field in table.column_names else [])
+                offs = matching_offsets(key_cells, key)
+            else:
+                offs = (int(off),)
+            for o in offs:
+                indices.append(o)
+                out_positions.append(int(pos))
+        sub = table.take(indices)
+        if columns:
+            keep = [c for c in columns if c in sub.column_names]
+            sub = sub.select(keep)
+        self._enqueue(ident, {"type": "point_rows", "re": req_id,
+                              "ordinal": ordinal,
+                              "positions": out_positions},
+                      payload=self._serializer.serialize(sub))

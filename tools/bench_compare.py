@@ -48,9 +48,19 @@ _PHASE_RE = re.compile(
     r"(_samples_per_sec|_per_sec|_speedup|_improvement)$")
 
 #: Lower-is-better phase keys (suffix match): time-to-first-batch
-#: latencies from the plan warm-start phase (docs/plan.md) — a regression
-#: here is an INCREASE beyond the threshold.
-_LOWER_PHASE_RE = re.compile(r"_ttfb_s$")
+#: latencies from the plan warm-start phase (docs/plan.md) and the
+#: fleet-lookup p99 (docs/random_access.md "Serving lookups through the
+#: fleet") — a regression here is an INCREASE beyond the threshold.
+_LOWER_PHASE_RE = re.compile(r"(_ttfb_s|_p99_s)$")
+
+#: Higher-is-better phase keys the suffix patterns don't cover: the
+#: data-service and fleet-cache bench fleet aggregates
+#: (docs/service.md; ``*_aggregate`` sums per-client throughput).
+_EXPLICIT_PHASES = frozenset({
+    "fleet_samples_per_sec_aggregate",        # data_service_epoch
+    "fleet_cache_samples_per_sec_aggregate",  # fleet_cache_epoch
+    "baseline_samples_per_sec_aggregate",     # fleet_cache_epoch baseline
+})
 
 
 def load_round(path: str) -> dict:
@@ -88,6 +98,7 @@ def phase_values(doc: dict) -> dict:
                 visit(f"{k}.", v)
             elif isinstance(v, (int, float)) and not isinstance(v, bool) \
                     and (_PHASE_RE.search(k) or _LOWER_PHASE_RE.search(k)
+                         or k in _EXPLICIT_PHASES
                          or (not prefix and k == "value")):
                 p50 = d.get(f"{k}_p50")
                 out[name] = float(p50 if isinstance(p50, (int, float))

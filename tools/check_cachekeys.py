@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Lint guard: service-cache keys go through the content-key helper.
+
+The PR 17 regression this pins: the decode-server buffer cache was
+keyed by a raw ``(fingerprint, ordinal)`` tuple — no column projection —
+so two jobs over the same dataset with different ``schema_fields``
+collided and one was served the other's wrong-width buffers. The fix
+(docs/service.md "Fleet cache tier") is that every service-cache key is
+a *content key* minted by ``fleet_cache.ContentKeyer.key(...)`` /
+``content_keyer_for(...)`` (file identity + row-group ordinal + column
+projection + plan kwargs), so identical work is identical bytes and
+different projections can never alias.
+
+This AST check flags every cache-shaped call (receiver name containing
+``cache``, method in the get/put/begin/peek/fulfill/wait/abandon
+surface) inside ``petastorm_tpu/service/`` whose key argument is a
+*composed literal* — a tuple, f-string, string concatenation/formatting
+BinOp, dict, or list — instead of a value produced by the content-key
+helper. Key arguments that are plain names, attributes, subscripts
+(``keys[ordinal]``) or calls (``self._content_key(...)``,
+``keyer.key(...)``) pass: the helper's result travels through those.
+
+``fleet_cache.py`` itself is exempt (it *defines* the cache), and any
+line can be waived with ``# cachekey-ok: why`` for a deliberate
+non-content key (say, a test harness's sentinel entries).
+
+Usage::
+
+    python tools/check_cachekeys.py          # lint (exit 1 on violations)
+    python tools/check_cachekeys.py --list   # print every cache-key site
+
+Wired into ``make ci-lint``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVICE = os.path.join(ROOT, "petastorm_tpu", "service")
+
+WAIVER = "cachekey-ok"
+
+#: The file that defines the cache + content-key helper.
+_EXEMPT_FILES = {"fleet_cache.py"}
+
+#: Cache-surface methods whose first positional argument is a key.
+_KEYED_METHODS = {"get", "put", "begin", "peek", "fulfill", "wait",
+                  "abandon"}
+
+#: Key-argument node types that mean "composed inline" rather than
+#: "minted by the content-key helper".
+_RAW_KEY_NODES = (ast.Tuple, ast.JoinedStr, ast.BinOp, ast.Dict, ast.List)
+
+
+def _receiver_name(func: ast.Attribute) -> str:
+    value = func.value
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    if isinstance(value, ast.Name):
+        return value.id
+    return ""
+
+
+def _fmt(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - display-only
+        return type(node).__name__
+
+
+def _sites(path):
+    """Yield (lineno, call repr, raw, waived) for every keyed cache call."""
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr not in _KEYED_METHODS:
+            continue
+        if "cache" not in _receiver_name(func).lower():
+            continue
+        if not node.args:
+            continue
+        key_arg = node.args[0]
+        raw = isinstance(key_arg, _RAW_KEY_NODES)
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        yield (node.lineno,
+               f"{_receiver_name(func)}.{func.attr}({_fmt(key_arg)}, ...)",
+               raw, WAIVER in line)
+
+
+def _iter_py_files():
+    if not os.path.isdir(SERVICE):
+        return
+    for dirpath, _dirnames, filenames in os.walk(SERVICE):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and fn not in _EXEMPT_FILES:
+                yield os.path.join(dirpath, fn)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    list_only = "--list" in argv
+    failures = []
+    seen = []
+    for path in _iter_py_files():
+        rel = os.path.relpath(path, ROOT)
+        for lineno, repr_, raw, waived in _sites(path):
+            seen.append((rel, lineno, repr_, raw and not waived))
+            if raw and not waived and not list_only:
+                failures.append((rel, lineno, repr_))
+    if list_only:
+        for rel, lineno, repr_, bad in seen:
+            tag = " (VIOLATION)" if bad else " (ok)"
+            print(f"{rel}:{lineno}: {repr_}{tag}")
+        return 0
+    if failures:
+        print("check_cachekeys: service-cache call keyed by a composed "
+              "literal instead of the content-key helper:", file=sys.stderr)
+        for rel, lineno, repr_ in failures:
+            print(f"  {rel}:{lineno}: {repr_}", file=sys.stderr)
+        print(f"{len(failures)} raw cache key(s). Mint the key with "
+              f"fleet_cache.content_keyer_for(...).key(ordinal, projection) "
+              f"(it folds in file identity + column projection, the PR 17 "
+              f"collision fix), or waive the line with a "
+              f"'# {WAIVER}: why' comment.", file=sys.stderr)
+        return 1
+    print(f"check_cachekeys: {len(seen)} service cache-key site(s), all "
+          f"minted through the content-key helper or waived")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,7 +53,7 @@ _EXEMPT_FILES = {"lease.py", "journal.py", "scheduler.py"}
 #: Enclosing-function name prefixes where mutations are legitimate:
 #: journal helpers (write-ahead) and replay/recovery (already durable).
 _ALLOWED_FN_PREFIXES = ("_j_", "_replay", "_restore", "_recover",
-                        "_apply_resync")
+                        "_apply_resync", "_apply_cache")
 
 #: State-mutating verbs on the lease book / coverage ledger /
 #: accounting ledger. ``renew``/``complete``/``expire``/
@@ -67,7 +67,9 @@ _MUTATING_VERBS = {
 }
 
 #: Attribute/subscript targets whose assignment is durable state.
-_MUTATING_SUBSCRIPTS = {"_plan_registry"}
+#: ``_cache_dir`` is the fleet cache directory — journaled (``cache_ad``
+#: / ``cache_drop``) so a failed-over dispatcher replays it.
+_MUTATING_SUBSCRIPTS = {"_plan_registry", "_cache_dir"}
 
 
 def _fn_ranges(tree):
